@@ -1,0 +1,63 @@
+"""ray_tpu — a TPU-native distributed AI runtime.
+
+A brand-new framework with the capabilities of Ray (reference:
+python/ray/__init__.py in Deegue/ray @ 2024-10-08), designed JAX/XLA-first:
+
+- Core: task/actor runtime with a shared-memory object store, ownership-based
+  reference counting, leases, and placement groups (incl. slice-atomic gang
+  scheduling of TPU pod slices).
+- parallel/ops/models: GSPMD mesh utilities, Pallas kernels (flash/ring
+  attention), and flagship JAX models.
+- Libraries: train (JaxTrainer), data (streaming datasets), tune
+  (hyperparameter search), serve (model serving), rllib (RL).
+
+Public core API parity target: ``ray.init/remote/get/put/wait``
+(reference python/ray/_private/worker.py:1225,2551; remote_function.py:40).
+"""
+
+from ray_tpu._private.worker import (
+    init,
+    shutdown,
+    is_initialized,
+    get,
+    put,
+    wait,
+    kill,
+    cancel,
+    get_runtime_context,
+)
+from ray_tpu._private.api import remote, method
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.actor import ActorHandle, ActorClass
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "method",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_runtime_context",
+    "ObjectRef",
+    "ActorHandle",
+    "ActorClass",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # Lazy imports of subpackages so that `import ray_tpu` stays fast and
+    # JAX-free for pure-runtime users.
+    import importlib
+
+    if name in ("train", "data", "tune", "serve", "rllib", "util",
+                "parallel", "ops", "models", "collective", "dag", "air",
+                "workflow"):
+        return importlib.import_module(f"ray_tpu.{name}")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

@@ -1,0 +1,42 @@
+"""Build the native components on demand (g++ → .so, cached by mtime).
+
+The reference ships prebuilt native artifacts via Bazel (BUILD.bazel →
+_raylet.so, raylet, gcs_server); here the native library is compiled once at
+first import and cached under _native/build/.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_DIR, "build")
+_LOCK = threading.Lock()
+
+_LIBS = {
+    "ray_tpu_store": ["shm_store.cpp"],
+}
+
+
+def lib_path(name: str) -> str:
+    return os.path.join(_BUILD_DIR, f"lib{name}.so")
+
+
+def ensure_built(name: str) -> str:
+    """Compile lib<name>.so if missing or stale; return its path."""
+    sources = [os.path.join(_DIR, s) for s in _LIBS[name]]
+    out = lib_path(name)
+    with _LOCK:
+        if os.path.exists(out):
+            src_mtime = max(os.path.getmtime(s) for s in sources)
+            if os.path.getmtime(out) >= src_mtime:
+                return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = out + ".tmp"
+        cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-Wall",
+               "-o", tmp] + sources + ["-lpthread"]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out)
+    return out

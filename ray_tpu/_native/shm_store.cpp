@@ -1,0 +1,464 @@
+// shm_store — shared-memory object store (plasma equivalent).
+//
+// TPU-native counterpart of the reference's plasma store
+// (src/ray/object_manager/plasma/: dlmalloc over mmap, object lifecycle
+// manager, LRU eviction, unix-socket client protocol). Key design change:
+// instead of a store *daemon* serving create/get over a socket with fd
+// passing, the entire store — header, object table, and data arena — lives
+// in ONE file-backed mapping that every process on the node maps directly.
+// Lookup/create/seal are lock-protected shared-memory operations (robust
+// process-shared pthread mutex + condvar), so the hot path (get of a sealed
+// object) is a table probe + refcount bump with zero syscalls and zero
+// copies. This fits the TPU runtime's per-host layout: a handful of worker
+// processes per host feeding chips, not thousands of clients.
+//
+// Concurrency: one global robust mutex (EOWNERDEAD-recovering) guards the
+// table + allocator; a process-shared condvar broadcasts seals so blocked
+// getters wake. Eviction is LRU over sealed refcount==0 objects, triggered
+// on allocation failure (reference: eviction_policy.h).
+//
+// Build: g++ -O2 -fPIC -shared -o libray_tpu_store.so shm_store.cpp -lpthread
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x5241595f545055ULL;  // "RAY_TPU"
+constexpr uint32_t kVersion = 1;
+constexpr int kIdSize = 24;
+constexpr uint64_t kAlign = 64;
+
+enum SlotState : uint32_t {
+  SLOT_FREE = 0,
+  SLOT_CREATED = 1,   // allocated, being written by creator
+  SLOT_SEALED = 2,    // immutable, readable
+};
+
+enum Status : int {
+  OK = 0,
+  ERR_EXISTS = -1,
+  ERR_NOT_FOUND = -2,
+  ERR_FULL = -3,
+  ERR_TIMEOUT = -4,
+  ERR_INVALID = -5,
+  ERR_NOT_SEALED = -6,
+  ERR_IN_USE = -7,
+};
+
+struct Slot {
+  uint8_t id[kIdSize];
+  uint32_t state;
+  uint32_t _pad;
+  uint64_t offset;      // into data arena (absolute file offset)
+  uint64_t size;
+  int64_t refcount;
+  uint64_t lru_tick;    // bumped on each release-to-zero; lowest evicted first
+};
+
+struct Header {
+  uint64_t magic;
+  uint32_t version;
+  uint32_t n_slots;
+  uint64_t capacity;       // bytes in data arena
+  uint64_t data_start;     // file offset of arena
+  uint64_t bytes_used;
+  uint64_t tick;           // LRU clock
+  uint64_t num_evictions;
+  uint64_t num_created;
+  pthread_mutex_t mutex;
+  pthread_cond_t cond;
+  // Slot table follows, then data arena.
+};
+
+struct Handle {
+  int fd;
+  uint8_t* base;
+  uint64_t map_size;
+  Header* hdr;
+  Slot* slots;
+};
+
+inline uint64_t align_up(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+// Robust-mutex lock: recover if a holder died.
+int lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mutex);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mutex);
+    rc = 0;
+  }
+  return rc;
+}
+
+inline void unlock(Header* h) { pthread_mutex_unlock(&h->mutex); }
+
+Slot* find_slot(Handle* st, const uint8_t* id) {
+  // Linear probe over an open-addressed table keyed by the id's first 8
+  // bytes (ids are uniformly random).
+  uint64_t key;
+  memcpy(&key, id, 8);
+  uint32_t n = st->hdr->n_slots;
+  uint32_t start = static_cast<uint32_t>(key % n);
+  for (uint32_t i = 0; i < n; i++) {
+    Slot* s = &st->slots[(start + i) % n];
+    if (s->state != SLOT_FREE && memcmp(s->id, id, kIdSize) == 0) return s;
+  }
+  return nullptr;
+}
+
+Slot* find_empty_slot(Handle* st, const uint8_t* id) {
+  uint64_t key;
+  memcpy(&key, id, 8);
+  uint32_t n = st->hdr->n_slots;
+  uint32_t start = static_cast<uint32_t>(key % n);
+  for (uint32_t i = 0; i < n; i++) {
+    Slot* s = &st->slots[(start + i) % n];
+    if (s->state == SLOT_FREE) return s;
+  }
+  return nullptr;
+}
+
+// First-fit allocation by scanning live slots (sorted scan each time).
+// n_slots is small (<= 64Ki) and creates are not the hot path — gets are.
+bool allocate(Handle* st, uint64_t size, uint64_t* out_offset) {
+  Header* h = st->hdr;
+  uint64_t need = align_up(size);
+  if (need > h->capacity) return false;
+  // Gather live extents.
+  uint64_t cursor = h->data_start;
+  const uint64_t arena_end = h->data_start + h->capacity;
+  // Repeatedly find the live slot with the smallest offset >= cursor; if the
+  // gap before it fits, take it. O(live^2) worst case; fine at this scale.
+  while (true) {
+    Slot* next = nullptr;
+    for (uint32_t i = 0; i < h->n_slots; i++) {
+      Slot* s = &st->slots[i];
+      if (s->state == SLOT_FREE) continue;
+      if (s->offset >= cursor && (!next || s->offset < next->offset)) next = s;
+    }
+    uint64_t gap_end = next ? next->offset : arena_end;
+    if (gap_end - cursor >= need) {
+      *out_offset = cursor;
+      return true;
+    }
+    if (!next) return false;
+    cursor = align_up(next->offset + next->size);
+  }
+}
+
+// Evict LRU sealed refcount==0 objects until a `size` allocation fits.
+bool evict_for(Handle* st, uint64_t size, uint64_t* out_offset) {
+  Header* h = st->hdr;
+  while (true) {
+    if (allocate(st, size, out_offset)) return true;
+    Slot* victim = nullptr;
+    for (uint32_t i = 0; i < h->n_slots; i++) {
+      Slot* s = &st->slots[i];
+      if (s->state == SLOT_SEALED && s->refcount == 0 &&
+          (!victim || s->lru_tick < victim->lru_tick)) {
+        victim = s;
+      }
+    }
+    if (!victim) return false;
+    h->bytes_used -= victim->size;
+    h->num_evictions++;
+    victim->state = SLOT_FREE;
+  }
+}
+
+void monotonic_deadline(struct timespec* ts, long timeout_ms) {
+  clock_gettime(CLOCK_MONOTONIC, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create + initialize the store file. Returns 0 or -errno.
+int shm_store_create(const char* path, uint64_t capacity, uint32_t n_slots) {
+  uint64_t table_bytes = sizeof(Slot) * static_cast<uint64_t>(n_slots);
+  uint64_t data_start = align_up(sizeof(Header) + table_bytes);
+  uint64_t total = data_start + capacity;
+
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) return -errno;
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    int e = errno;
+    close(fd);
+    unlink(path);
+    return -e;
+  }
+  void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    int e = errno;
+    close(fd);
+    unlink(path);
+    return -e;
+  }
+  Header* h = reinterpret_cast<Header*>(base);
+  memset(h, 0, sizeof(Header) + table_bytes);
+  h->version = kVersion;
+  h->n_slots = n_slots;
+  h->capacity = capacity;
+  h->data_start = data_start;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &ma);
+  pthread_mutexattr_destroy(&ma);
+
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+  pthread_cond_init(&h->cond, &ca);
+  pthread_condattr_destroy(&ca);
+
+  h->magic = kMagic;  // last: marks initialized
+  msync(base, sizeof(Header), MS_SYNC);
+  munmap(base, total);
+  close(fd);
+  return 0;
+}
+
+void* shm_store_open(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat sb;
+  if (fstat(fd, &sb) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, sb.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* h = reinterpret_cast<Header*>(base);
+  if (h->magic != kMagic || h->version != kVersion) {
+    munmap(base, sb.st_size);
+    close(fd);
+    return nullptr;
+  }
+  Handle* st = new Handle;
+  st->fd = fd;
+  st->base = reinterpret_cast<uint8_t*>(base);
+  st->map_size = sb.st_size;
+  st->hdr = h;
+  st->slots = reinterpret_cast<Slot*>(st->base + sizeof(Header));
+  return st;
+}
+
+void shm_store_close(void* handle) {
+  Handle* st = reinterpret_cast<Handle*>(handle);
+  if (!st) return;
+  munmap(st->base, st->map_size);
+  close(st->fd);
+  delete st;
+}
+
+// Allocate an object. On OK, *out_offset is the file offset to write into.
+// Creator holds one reference (release after seal or abort).
+int shm_create(void* handle, const uint8_t* id, uint64_t size,
+               uint64_t* out_offset) {
+  Handle* st = reinterpret_cast<Handle*>(handle);
+  Header* h = st->hdr;
+  if (lock(h) != 0) return ERR_INVALID;
+  if (find_slot(st, id)) {
+    unlock(h);
+    return ERR_EXISTS;
+  }
+  Slot* slot = find_empty_slot(st, id);
+  uint64_t offset = 0;
+  if (!slot || !evict_for(st, size, &offset)) {
+    unlock(h);
+    return ERR_FULL;
+  }
+  memcpy(slot->id, id, kIdSize);
+  slot->state = SLOT_CREATED;
+  slot->offset = offset;
+  slot->size = size;
+  slot->refcount = 1;
+  slot->lru_tick = ++h->tick;
+  h->bytes_used += size;
+  h->num_created++;
+  *out_offset = offset;
+  unlock(h);
+  return OK;
+}
+
+int shm_seal(void* handle, const uint8_t* id) {
+  Handle* st = reinterpret_cast<Handle*>(handle);
+  Header* h = st->hdr;
+  if (lock(h) != 0) return ERR_INVALID;
+  Slot* s = find_slot(st, id);
+  if (!s) {
+    unlock(h);
+    return ERR_NOT_FOUND;
+  }
+  s->state = SLOT_SEALED;
+  pthread_cond_broadcast(&h->cond);
+  unlock(h);
+  return OK;
+}
+
+// Abort an in-progress create (creator crashed or errored before seal).
+int shm_abort(void* handle, const uint8_t* id) {
+  Handle* st = reinterpret_cast<Handle*>(handle);
+  Header* h = st->hdr;
+  if (lock(h) != 0) return ERR_INVALID;
+  Slot* s = find_slot(st, id);
+  if (!s) {
+    unlock(h);
+    return ERR_NOT_FOUND;
+  }
+  if (s->state != SLOT_CREATED) {
+    unlock(h);
+    return ERR_INVALID;
+  }
+  h->bytes_used -= s->size;
+  s->state = SLOT_FREE;
+  unlock(h);
+  return OK;
+}
+
+// Blocking get: waits (timeout_ms; 0 = non-blocking, <0 = forever) for the
+// object to be sealed, then pins it (refcount+1) and returns offset+size.
+int shm_get(void* handle, const uint8_t* id, long timeout_ms,
+            uint64_t* out_offset, uint64_t* out_size) {
+  Handle* st = reinterpret_cast<Handle*>(handle);
+  Header* h = st->hdr;
+  struct timespec deadline;
+  if (timeout_ms > 0) monotonic_deadline(&deadline, timeout_ms);
+  if (lock(h) != 0) return ERR_INVALID;
+  while (true) {
+    Slot* s = find_slot(st, id);
+    if (s && s->state == SLOT_SEALED) {
+      s->refcount++;
+      *out_offset = s->offset;
+      *out_size = s->size;
+      unlock(h);
+      return OK;
+    }
+    if (timeout_ms == 0) {
+      unlock(h);
+      return ERR_NOT_FOUND;
+    }
+    int rc;
+    if (timeout_ms < 0) {
+      rc = pthread_cond_wait(&h->cond, &h->mutex);
+    } else {
+      rc = pthread_cond_timedwait(&h->cond, &h->mutex, &deadline);
+    }
+    if (rc == ETIMEDOUT) {
+      unlock(h);
+      return ERR_TIMEOUT;
+    }
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mutex);
+  }
+}
+
+int shm_release(void* handle, const uint8_t* id) {
+  Handle* st = reinterpret_cast<Handle*>(handle);
+  Header* h = st->hdr;
+  if (lock(h) != 0) return ERR_INVALID;
+  Slot* s = find_slot(st, id);
+  if (!s) {
+    unlock(h);
+    return ERR_NOT_FOUND;
+  }
+  if (s->refcount > 0) s->refcount--;
+  if (s->refcount == 0) s->lru_tick = ++h->tick;
+  unlock(h);
+  return OK;
+}
+
+// Delete a sealed, unreferenced object (owner-driven eviction: the
+// distributed refcounter decided the object is out of scope).
+int shm_delete(void* handle, const uint8_t* id) {
+  Handle* st = reinterpret_cast<Handle*>(handle);
+  Header* h = st->hdr;
+  if (lock(h) != 0) return ERR_INVALID;
+  Slot* s = find_slot(st, id);
+  if (!s) {
+    unlock(h);
+    return ERR_NOT_FOUND;
+  }
+  if (s->refcount > 0) {
+    unlock(h);
+    return ERR_IN_USE;
+  }
+  h->bytes_used -= s->size;
+  s->state = SLOT_FREE;
+  unlock(h);
+  return OK;
+}
+
+// 1 if sealed-present, 0 otherwise.
+int shm_contains(void* handle, const uint8_t* id) {
+  Handle* st = reinterpret_cast<Handle*>(handle);
+  Header* h = st->hdr;
+  if (lock(h) != 0) return 0;
+  Slot* s = find_slot(st, id);
+  int present = (s && s->state == SLOT_SEALED) ? 1 : 0;
+  unlock(h);
+  return present;
+}
+
+int shm_stats(void* handle, uint64_t* used, uint64_t* capacity,
+              uint64_t* num_objects, uint64_t* num_evictions) {
+  Handle* st = reinterpret_cast<Handle*>(handle);
+  Header* h = st->hdr;
+  if (lock(h) != 0) return ERR_INVALID;
+  *used = h->bytes_used;
+  *capacity = h->capacity;
+  uint64_t n = 0;
+  for (uint32_t i = 0; i < h->n_slots; i++) {
+    if (st->slots[i].state != SLOT_FREE) n++;
+  }
+  *num_objects = n;
+  *num_evictions = h->num_evictions;
+  unlock(h);
+  return OK;
+}
+
+// List up to max sealed object ids (for the object directory / spilling
+// scans). Returns count written.
+int shm_list(void* handle, uint8_t* out_ids, uint64_t* out_sizes,
+             int64_t* out_refcounts, int max) {
+  Handle* st = reinterpret_cast<Handle*>(handle);
+  Header* h = st->hdr;
+  if (lock(h) != 0) return 0;
+  int n = 0;
+  for (uint32_t i = 0; i < h->n_slots && n < max; i++) {
+    Slot* s = &st->slots[i];
+    if (s->state == SLOT_SEALED) {
+      memcpy(out_ids + n * kIdSize, s->id, kIdSize);
+      out_sizes[n] = s->size;
+      out_refcounts[n] = s->refcount;
+      n++;
+    }
+  }
+  unlock(h);
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,129 @@
+"""@remote decorator — remote functions and actor classes.
+
+Equivalent of the reference's remote_function.py:40 (RemoteFunction,
+``_remote`` :266) and actor.py:566 (ActorClass): ``@remote`` wraps a
+function into ``.remote()/.options()`` task submission or a class into an
+actor factory.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Optional
+
+from ray_tpu.core.actor import ActorClass
+
+_OPTION_KEYS = {
+    "num_cpus", "num_gpus", "num_tpus", "memory", "resources", "num_returns",
+    "max_retries", "retry_exceptions", "max_restarts", "max_task_retries",
+    "max_concurrency", "name", "namespace", "lifetime", "runtime_env",
+    "scheduling_strategy", "placement_group", "placement_group_bundle_index",
+    "label_selector",
+}
+
+
+def _check_opts(opts: dict) -> None:
+    bad = set(opts) - _OPTION_KEYS
+    if bad:
+        raise ValueError(f"unknown @remote options: {sorted(bad)}")
+
+
+class RemoteFunction:
+    def __init__(self, fn: Callable, opts: dict):
+        _check_opts(opts)
+        self._function = fn
+        self._opts = opts
+        self._descriptor = None
+        self.__name__ = getattr(fn, "__name__", "remote_function")
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self.__name__}() cannot be called directly; "
+            f"use {self.__name__}.remote()")
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = {**self._opts, **opts}
+        new = RemoteFunction(self._function, merged)
+        new._descriptor = self._descriptor
+        return new
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu._private.worker import global_worker
+
+        worker = global_worker()
+        if self._descriptor is None:
+            self._descriptor = worker.export(self._function)
+        opts = _resolve_strategy(self._opts)
+        refs = worker.submit_task(self._descriptor, args, kwargs, opts)
+        num_returns = opts.get("num_returns", 1)
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference: python/ray/dag/dag_node.py .bind())."""
+        from ray_tpu.dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
+
+def _resolve_strategy(opts: dict) -> dict:
+    """Normalize scheduling_strategy / placement_group options to wire form."""
+    from ray_tpu.core.placement_group import PlacementGroup
+    from ray_tpu.core.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+
+    opts = dict(opts)
+    strategy = opts.get("scheduling_strategy")
+    pg = opts.pop("placement_group", None)
+    bundle = opts.pop("placement_group_bundle_index", -1)
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        opts["scheduling_strategy"] = {
+            "type": "placement_group",
+            "pg_id": strategy.placement_group.id.binary(),
+            "bundle_index": strategy.placement_group_bundle_index,
+        }
+    elif isinstance(strategy, NodeAffinitySchedulingStrategy):
+        opts["scheduling_strategy"] = {
+            "type": "node_affinity",
+            "node_id": strategy.node_id if isinstance(strategy.node_id, bytes)
+            else bytes.fromhex(strategy.node_id),
+            "soft": strategy.soft,
+        }
+    elif isinstance(strategy, str) and strategy == "SPREAD":
+        opts["scheduling_strategy"] = {"type": "spread"}
+    elif isinstance(pg, PlacementGroup):
+        opts["scheduling_strategy"] = {
+            "type": "placement_group",
+            "pg_id": pg.id.binary(),
+            "bundle_index": bundle,
+        }
+    return opts
+
+
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(num_cpus=..., num_tpus=...)`` decorator."""
+    if len(args) == 1 and not kwargs and (inspect.isclass(args[0]) or
+                                          callable(args[0])):
+        return _wrap(args[0], {})
+    if args:
+        raise TypeError("remote() takes keyword options only")
+    return lambda obj: _wrap(obj, kwargs)
+
+
+def _wrap(obj, opts: dict):
+    if inspect.isclass(obj):
+        return ActorClass(obj, opts)
+    return RemoteFunction(obj, opts)
+
+
+def method(**opts):
+    """Per-method options on actors (reference: python/ray/actor.py
+    ``@ray.method(num_returns=...)``)."""
+
+    def decorator(fn):
+        fn.__ray_tpu_method_opts__ = opts
+        return fn
+
+    return decorator

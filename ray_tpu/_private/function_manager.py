@@ -1,0 +1,66 @@
+"""Function manager: export/import pickled functions and actor classes.
+
+Equivalent of the reference's FunctionActorManager
+(python/ray/_private/function_manager.py): the driver exports the
+cloudpickled callable to the GCS KV function table under a content-addressed
+key; executors fetch + unpickle lazily by descriptor and cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Callable, Dict
+
+import cloudpickle
+
+from ray_tpu.core.task_spec import FunctionDescriptor
+
+_FUNC_NS = b"fn"
+
+
+class FunctionManager:
+    def __init__(self, kv_put: Callable, kv_get: Callable):
+        """kv_put(ns, key, value) / kv_get(ns, key) -> bytes are sync
+        callables bridged to the GCS client."""
+        self._kv_put = kv_put
+        self._kv_get = kv_get
+        self._cache: Dict[bytes, Any] = {}
+        self._exported: set[bytes] = set()
+        self._lock = threading.Lock()
+
+    def export(self, fn: Callable) -> FunctionDescriptor:
+        blob = cloudpickle.dumps(fn)
+        key = hashlib.sha1(blob).digest()
+        with self._lock:
+            if key not in self._exported:
+                self._kv_put(_FUNC_NS, key, blob)
+                self._exported.add(key)
+                self._cache[key] = fn
+        return FunctionDescriptor(
+            module=getattr(fn, "__module__", "") or "",
+            qualname=getattr(fn, "__qualname__", repr(fn)),
+            function_key=key,
+        )
+
+    def fetch(self, descriptor: FunctionDescriptor) -> Any:
+        key = descriptor.function_key
+        with self._lock:
+            if key in self._cache:
+                return self._cache[key]
+        blob = self._kv_get(_FUNC_NS, key)
+        return self.load(descriptor, blob)
+
+    def get_cached(self, descriptor: FunctionDescriptor) -> Any:
+        with self._lock:
+            return self._cache.get(descriptor.function_key)
+
+    def load(self, descriptor: FunctionDescriptor, blob: bytes) -> Any:
+        if blob is None:
+            raise RuntimeError(
+                f"function {descriptor.display()} not found in GCS "
+                f"function table (key={descriptor.function_key.hex()})")
+        fn = cloudpickle.loads(blob)
+        with self._lock:
+            self._cache[descriptor.function_key] = fn
+        return fn

@@ -1,0 +1,774 @@
+"""GCS — Global Control Service: the cluster control plane.
+
+Equivalent of the reference's gcs_server (src/ray/gcs/gcs_server/
+gcs_server.h:78) hosting, in one process: node manager + health checks
+(gcs_health_check_manager.h:39), actor manager + scheduler
+(gcs_actor_manager.cc:311, gcs_actor_scheduler.cc:49), placement-group
+manager with 2-phase bundle commit (gcs_placement_group_manager.cc), job
+manager, internal KV (function table rides on it), object directory,
+task-event store (gcs_task_manager.h), and long-poll pubsub fan-out
+(src/ray/pubsub/publisher.h:296 — here: push notifications over the
+persistent RPC connections).
+
+TPU-native addition: nodes register slice topology (slice_id, hosts per
+slice, chips per host) and the placement-group SLICE strategy gang-schedules
+one bundle per host of a single slice, atomically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu.core.task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+# Actor states (reference: rpc::ActorTableData state machine)
+PENDING, ALIVE, RESTARTING, DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
+
+
+class NodeInfo:
+    def __init__(self, node_id: NodeID, data: dict):
+        self.node_id = node_id
+        self.address: str = data["address"]
+        self.hostname: str = data.get("hostname", "")
+        self.store_path: str = data.get("store_path", "")
+        self.resources_total: Dict[str, float] = dict(data["resources"])
+        self.resources_available: Dict[str, float] = dict(data["resources"])
+        self.labels: Dict[str, str] = data.get("labels", {})
+        self.slice_id: str = data.get("slice_id", "")
+        self.state = ALIVE
+        self.last_heartbeat = time.monotonic()
+        self.conn: Optional[rpc.Connection] = None
+
+    def view(self) -> dict:
+        return {
+            "node_id": self.node_id.binary(),
+            "address": self.address,
+            "hostname": self.hostname,
+            "store_path": self.store_path,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "labels": self.labels,
+            "slice_id": self.slice_id,
+            "state": self.state,
+        }
+
+
+class ActorInfo:
+    def __init__(self, actor_id: ActorID, data: dict):
+        self.actor_id = actor_id
+        self.name: str = data.get("name") or ""
+        self.namespace: str = data.get("namespace") or "default"
+        self.class_name: str = data.get("class_name", "")
+        self.max_restarts: int = data.get("max_restarts", 0)
+        self.detached: bool = data.get("detached", False)
+        self.creation_task: dict = data["creation_task"]  # wire TaskSpec
+        self.job_id: JobID = JobID(data["job_id"])
+        self.state = PENDING
+        self.address: str = ""
+        self.node_id: Optional[NodeID] = None
+        self.num_restarts = 0
+        self.death_cause: str = ""
+
+    def view(self) -> dict:
+        return {
+            "actor_id": self.actor_id.binary(),
+            "name": self.name,
+            "namespace": self.namespace,
+            "class_name": self.class_name,
+            "state": self.state,
+            "address": self.address,
+            "node_id": self.node_id.binary() if self.node_id else None,
+            "job_id": self.job_id.binary(),
+            "num_restarts": self.num_restarts,
+            "max_restarts": self.max_restarts,
+            "death_cause": self.death_cause,
+        }
+
+
+class PlacementGroupInfo:
+    def __init__(self, pg_id: PlacementGroupID, data: dict):
+        self.pg_id = pg_id
+        self.name: str = data.get("name", "")
+        self.strategy: str = data.get("strategy", "PACK")
+        self.bundles: List[Dict[str, float]] = data["bundles"]
+        self.job_id = JobID(data["job_id"]) if data.get("job_id") else None
+        self.state = "PENDING"
+        # bundle index -> node_id
+        self.bundle_locations: Dict[int, NodeID] = {}
+        self.ready_event = asyncio.Event()
+
+    def view(self) -> dict:
+        return {
+            "pg_id": self.pg_id.binary(),
+            "name": self.name,
+            "strategy": self.strategy,
+            "bundles": self.bundles,
+            "state": self.state,
+            "bundle_locations": {
+                str(i): n.binary() for i, n in self.bundle_locations.items()
+            },
+        }
+
+
+class GcsServer:
+    def __init__(self, config: Config):
+        self.config = config
+        self.kv: Dict[Tuple[bytes, bytes], bytes] = {}
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.jobs: Dict[JobID, dict] = {}
+        self.object_locations: Dict[bytes, Set[bytes]] = {}
+        self.spilled_objects: Dict[bytes, str] = {}
+        self.task_events: List[dict] = []
+        self.subscribers: Dict[str, Set[rpc.Connection]] = {}
+        self._next_job = 0
+        self._server: Optional[rpc.Server] = None
+        self._bg: List[asyncio.Task] = []
+        self._pg_lock = asyncio.Lock()
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = rpc.Server(self, host, port)
+        port = await self._server.start()
+        self._bg.append(asyncio.get_running_loop().create_task(
+            self._health_check_loop()))
+        self._bg.append(asyncio.get_running_loop().create_task(
+            self._broadcast_view_loop()))
+        logger.info("GCS listening on %s:%s", host, port)
+        return port
+
+    async def close(self) -> None:
+        for t in self._bg:
+            t.cancel()
+        if self._server:
+            await self._server.close()
+
+    def on_connection(self, conn: rpc.Connection) -> None:
+        conn.on_close = self._on_disconnect
+
+    def _on_disconnect(self, conn: rpc.Connection) -> None:
+        self._server.connections.discard(conn)
+        for subs in self.subscribers.values():
+            subs.discard(conn)
+        # Driver disconnect ⇒ job finished (reference: GcsJobManager
+        # MarkJobFinished on driver exit).
+        job_id = getattr(conn, "_job_id", None)
+        if job_id is not None and job_id in self.jobs:
+            asyncio.get_event_loop().create_task(self._finish_job(job_id))
+        node_id = getattr(conn, "_node_id", None)
+        if node_id is not None and node_id in self.nodes:
+            asyncio.get_event_loop().create_task(
+                self._fail_node(node_id, "raylet disconnected"))
+
+    # ------------------------------------------------------------- pubsub
+    async def publish(self, channel: str, data: Any) -> None:
+        dead = []
+        for conn in self.subscribers.get(channel, set()):
+            try:
+                await conn.notify("publish", {"channel": channel, "data": data})
+            except Exception:
+                dead.append(conn)
+        for conn in dead:
+            self.subscribers.get(channel, set()).discard(conn)
+
+    async def handle_subscribe(self, data, conn) -> bool:
+        self.subscribers.setdefault(data["channel"], set()).add(conn)
+        return True
+
+    # ------------------------------------------------------------- KV
+    async def handle_kv_put(self, data, conn) -> bool:
+        overwrite = data.get("overwrite", True)
+        key = (data["ns"], data["key"])
+        if not overwrite and key in self.kv:
+            return False
+        self.kv[key] = data["value"]
+        return True
+
+    async def handle_kv_get(self, data, conn):
+        return self.kv.get((data["ns"], data["key"]))
+
+    async def handle_kv_del(self, data, conn) -> bool:
+        return self.kv.pop((data["ns"], data["key"]), None) is not None
+
+    async def handle_kv_exists(self, data, conn) -> bool:
+        return (data["ns"], data["key"]) in self.kv
+
+    async def handle_kv_keys(self, data, conn) -> list:
+        ns, prefix = data["ns"], data.get("prefix", b"")
+        return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+
+    # ------------------------------------------------------------- nodes
+    async def handle_register_node(self, data, conn) -> dict:
+        node_id = NodeID(data["node_id"])
+        info = NodeInfo(node_id, data)
+        info.conn = conn
+        conn._node_id = node_id
+        self.nodes[node_id] = info
+        await self.publish("nodes", info.view())
+        logger.info("node %s registered at %s (resources=%s, slice=%r)",
+                    node_id.hex()[:8], info.address, info.resources_total,
+                    info.slice_id)
+        return {"ok": True}
+
+    async def handle_heartbeat(self, data, conn) -> dict:
+        node_id = NodeID(data["node_id"])
+        info = self.nodes.get(node_id)
+        if info is None or info.state == DEAD:
+            return {"ok": False}  # tells a zombie raylet to exit
+        info.last_heartbeat = time.monotonic()
+        info.resources_available = data.get(
+            "resources_available", info.resources_available)
+        return {"ok": True}
+
+    async def handle_get_nodes(self, data, conn) -> list:
+        return [n.view() for n in self.nodes.values()]
+
+    async def handle_drain_node(self, data, conn) -> bool:
+        node_id = NodeID(data["node_id"])
+        await self._fail_node(node_id, "drained")
+        return True
+
+    async def _health_check_loop(self) -> None:
+        period = self.config.health_check_period_ms / 1000
+        timeout = period * self.config.health_check_failure_threshold
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.state == ALIVE and now - node.last_heartbeat > timeout:
+                    await self._fail_node(node.node_id, "health check timeout")
+
+    async def _broadcast_view_loop(self) -> None:
+        """Broadcast the cluster resource view for raylet spillback decisions
+        (reference: RaySyncer resource-usage gossip,
+        src/ray/common/ray_syncer/ray_syncer.h:88). Faster cadence than
+        health checks so scheduling sees fresh availability."""
+        while True:
+            await asyncio.sleep(
+                min(self.config.health_check_period_ms, 200) / 1000)
+            await self.publish("cluster_view", [
+                n.view() for n in self.nodes.values() if n.state == ALIVE
+            ])
+
+    async def _fail_node(self, node_id: NodeID, reason: str) -> None:
+        node = self.nodes.get(node_id)
+        if node is None or node.state == DEAD:
+            return
+        node.state = DEAD
+        logger.warning("node %s failed: %s", node_id.hex()[:8], reason)
+        await self.publish("nodes", node.view())
+        # Restart or kill actors that lived there (reference:
+        # GcsActorManager::OnNodeDead).
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (ALIVE, PENDING,
+                                                            RESTARTING):
+                await self._restart_or_kill_actor(
+                    actor, f"node died: {reason}")
+        # Placement groups with bundles there reschedule.
+        for pg in self.placement_groups.values():
+            if node_id in pg.bundle_locations.values() and pg.state == "CREATED":
+                pg.state = "RESCHEDULING"
+                pg.ready_event.clear()
+                asyncio.get_event_loop().create_task(self._schedule_pg(pg))
+        # Objects whose only copy was there are lost.
+        for oid, locs in list(self.object_locations.items()):
+            locs.discard(node_id.binary())
+
+    # ------------------------------------------------------------- jobs
+    async def handle_register_job(self, data, conn) -> dict:
+        self._next_job += 1
+        job_id = JobID.from_int(self._next_job)
+        conn._job_id = job_id
+        self.jobs[job_id] = {
+            "state": "RUNNING",
+            "driver_address": data.get("driver_address", ""),
+            "start_time": time.time(),
+        }
+        return {"job_id": job_id.binary()}
+
+    async def _finish_job(self, job_id: JobID) -> None:
+        job = self.jobs.get(job_id)
+        if not job or job["state"] == "FINISHED":
+            return
+        job["state"] = "FINISHED"
+        await self.publish("jobs", {"job_id": job_id.binary(),
+                                    "state": "FINISHED"})
+        # Kill non-detached actors of the job (reference:
+        # GcsActorManager::OnJobFinished).
+        for actor in list(self.actors.values()):
+            if actor.job_id == job_id and not actor.detached and \
+                    actor.state != DEAD:
+                actor.max_restarts = 0
+                await self._restart_or_kill_actor(actor, "job finished")
+        for pg in list(self.placement_groups.values()):
+            if pg.job_id == job_id:
+                await self._remove_pg(pg)
+
+    # ------------------------------------------------------------- actors
+    async def handle_register_actor(self, data, conn) -> dict:
+        actor_id = ActorID(data["actor_id"])
+        info = ActorInfo(actor_id, data)
+        if info.name:
+            key = (info.namespace, info.name)
+            if key in self.named_actors:
+                return {"ok": False,
+                        "error": f"actor name {info.name!r} already taken"}
+            self.named_actors[key] = actor_id
+        self.actors[actor_id] = info
+        asyncio.get_running_loop().create_task(self._schedule_actor(info))
+        return {"ok": True}
+
+    async def _schedule_actor(self, actor: ActorInfo) -> None:
+        """GCS-driven actor placement (reference:
+        GcsActorScheduler::ScheduleByGcs, gcs_actor_scheduler.cc:60)."""
+        spec = TaskSpec.from_wire(actor.creation_task)
+        for attempt in range(120):
+            node = self._pick_node(spec.resources, spec.scheduling_strategy,
+                                   spec.placement_group_id,
+                                   spec.placement_group_bundle_index)
+            if node is None:
+                await asyncio.sleep(0.25)  # wait for resources/nodes
+                continue
+            try:
+                reply = await node.conn.call("lease_worker_for_actor", {
+                    "actor_id": actor.actor_id.binary(),
+                    "task": actor.creation_task,
+                }, timeout=self.config.worker_startup_timeout_s)
+            except Exception as e:
+                logger.warning("actor lease on %s failed: %s",
+                               node.node_id.hex()[:8], e)
+                await asyncio.sleep(0.25)
+                continue
+            if reply.get("ok"):
+                actor.node_id = node.node_id
+                return  # worker will report actor_ready
+            await asyncio.sleep(0.25)
+        await self._restart_or_kill_actor(actor, "no feasible node")
+
+    def _pick_node(self, resources: Dict[str, float],
+                   strategy: Optional[dict],
+                   pg_id: Optional[PlacementGroupID] = None,
+                   bundle_index: int = -1) -> Optional[NodeInfo]:
+        """Hybrid policy: pack onto best-utilized feasible node below the
+        spread threshold, else least utilized (reference:
+        hybrid_scheduling_policy.cc)."""
+        alive = [n for n in self.nodes.values() if n.state == ALIVE]
+        if strategy and strategy.get("type") == "node_affinity":
+            target = NodeID(strategy["node_id"])
+            for n in alive:
+                if n.node_id == target:
+                    return n
+            return None if not strategy.get("soft") else \
+                self._pick_node(resources, None)
+        if pg_id is not None:
+            pg = self.placement_groups.get(pg_id)
+            if not pg or pg.state != "CREATED":
+                return None
+            if bundle_index >= 0:
+                nid = pg.bundle_locations.get(bundle_index)
+            else:
+                nid = next(iter(pg.bundle_locations.values()), None)
+            return next((n for n in alive if n.node_id == nid), None)
+        feasible = [n for n in alive if _fits(resources, n.resources_available)]
+        if not feasible:
+            return None
+        if strategy and strategy.get("type") == "spread":
+            return min(feasible, key=lambda n: _utilization(n))
+        feasible.sort(key=lambda n: (_utilization(n) >
+                                     self.config.scheduler_spread_threshold,
+                                     -_utilization(n)))
+        return feasible[0]
+
+    async def handle_actor_ready(self, data, conn) -> bool:
+        actor = self.actors.get(ActorID(data["actor_id"]))
+        if actor is None:
+            return False
+        actor.state = ALIVE
+        actor.address = data["address"]
+        actor.node_id = NodeID(data["node_id"])
+        await self.publish("actors", actor.view())
+        return True
+
+    async def handle_actor_creation_failed(self, data, conn) -> bool:
+        actor = self.actors.get(ActorID(data["actor_id"]))
+        if actor is None:
+            return False
+        await self._restart_or_kill_actor(actor, data.get("error", "creation failed"))
+        return True
+
+    async def handle_report_worker_death(self, data, conn) -> bool:
+        """Raylet reports a dead worker; fail any actor hosted there."""
+        actor_id = data.get("actor_id")
+        if actor_id:
+            actor = self.actors.get(ActorID(actor_id))
+            if actor and actor.state in (ALIVE, PENDING):
+                await self._restart_or_kill_actor(
+                    actor, data.get("reason", "worker died"))
+        return True
+
+    async def _restart_or_kill_actor(self, actor: ActorInfo, reason: str):
+        if actor.max_restarts != 0 and (
+                actor.max_restarts < 0 or
+                actor.num_restarts < actor.max_restarts):
+            actor.num_restarts += 1
+            actor.state = RESTARTING
+            await self.publish("actors", actor.view())
+            logger.info("restarting actor %s (%d/%s): %s",
+                        actor.actor_id.hex()[:8], actor.num_restarts,
+                        actor.max_restarts, reason)
+            asyncio.get_event_loop().create_task(self._schedule_actor(actor))
+        else:
+            actor.state = DEAD
+            actor.death_cause = reason
+            if actor.name:
+                self.named_actors.pop((actor.namespace, actor.name), None)
+            await self.publish("actors", actor.view())
+
+    async def handle_get_actor_info(self, data, conn):
+        if data.get("actor_id"):
+            actor = self.actors.get(ActorID(data["actor_id"]))
+        else:
+            key = (data.get("namespace", "default"), data["name"])
+            aid = self.named_actors.get(key)
+            actor = self.actors.get(aid) if aid else None
+        return actor.view() if actor else None
+
+    async def handle_wait_actor_alive(self, data, conn):
+        """Block until the actor is ALIVE or DEAD (bounded by client timeout)."""
+        actor_id = ActorID(data["actor_id"])
+        deadline = time.monotonic() + data.get("timeout", 60.0)
+        while time.monotonic() < deadline:
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                return None
+            if actor.state in (ALIVE, DEAD):
+                return actor.view()
+            await asyncio.sleep(0.02)
+        actor = self.actors.get(actor_id)
+        return actor.view() if actor else None
+
+    async def handle_kill_actor(self, data, conn) -> bool:
+        actor = self.actors.get(ActorID(data["actor_id"]))
+        if actor is None:
+            return False
+        actor.max_restarts = 0 if data.get("no_restart", True) else actor.max_restarts
+        if actor.state == ALIVE and actor.address:
+            host, port = actor.address.rsplit(":", 1)
+            try:
+                c = await rpc.connect(host, int(port), timeout=2.0)
+                await c.notify("exit_worker", {"force": True})
+                await c.close()
+            except Exception:
+                pass
+        await self._restart_or_kill_actor(actor, "killed via kill()")
+        return True
+
+    async def handle_list_actors(self, data, conn) -> list:
+        return [a.view() for a in self.actors.values()]
+
+    # ------------------------------------------------------------- placement groups
+    async def handle_create_placement_group(self, data, conn) -> dict:
+        pg_id = PlacementGroupID(data["pg_id"])
+        pg = PlacementGroupInfo(pg_id, data)
+        self.placement_groups[pg_id] = pg
+        asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        return {"ok": True}
+
+    async def handle_wait_placement_group(self, data, conn) -> dict:
+        pg = self.placement_groups.get(PlacementGroupID(data["pg_id"]))
+        if pg is None:
+            return {"ok": False, "error": "no such placement group"}
+        try:
+            await asyncio.wait_for(pg.ready_event.wait(),
+                                   data.get("timeout", 60.0))
+        except asyncio.TimeoutError:
+            return {"ok": False, "error": "timeout", "state": pg.state}
+        return {"ok": pg.state == "CREATED", "state": pg.state,
+                "pg": pg.view()}
+
+    async def handle_remove_placement_group(self, data, conn) -> bool:
+        pg = self.placement_groups.get(PlacementGroupID(data["pg_id"]))
+        if pg:
+            await self._remove_pg(pg)
+        return True
+
+    async def handle_get_pg_raylet(self, data, conn) -> dict:
+        """Address of the raylet hosting a PG bundle (waits for creation) —
+        used by submitters to route bundle-pinned lease requests."""
+        pg = self.placement_groups.get(PlacementGroupID(data["pg_id"]))
+        if pg is None:
+            return {"error": "no such placement group"}
+        try:
+            await asyncio.wait_for(pg.ready_event.wait(),
+                                   data.get("timeout", 60.0))
+        except asyncio.TimeoutError:
+            return {"error": f"placement group not ready: {pg.state}"}
+        if pg.state != "CREATED":
+            return {"error": f"placement group state: {pg.state}"}
+        idx = data.get("bundle_index", -1)
+        if idx < 0:
+            idx = 0
+        node_id = pg.bundle_locations.get(idx)
+        node = self.nodes.get(node_id) if node_id else None
+        if node is None or node.state != ALIVE:
+            return {"error": "bundle node is not alive"}
+        return {"address": node.address}
+
+    async def handle_get_placement_group(self, data, conn):
+        pg = self.placement_groups.get(PlacementGroupID(data["pg_id"]))
+        return pg.view() if pg else None
+
+    async def _remove_pg(self, pg: PlacementGroupInfo) -> None:
+        pg.state = "REMOVED"
+        for idx, node_id in pg.bundle_locations.items():
+            node = self.nodes.get(node_id)
+            if node and node.conn and node.state == ALIVE:
+                try:
+                    await node.conn.call("cancel_bundle", {
+                        "pg_id": pg.pg_id.binary(), "bundle_index": idx})
+                except Exception:
+                    pass
+        pg.bundle_locations.clear()
+        self.placement_groups.pop(pg.pg_id, None)
+
+    async def _schedule_pg(self, pg: PlacementGroupInfo) -> None:
+        """Two-phase bundle placement (reference:
+        GcsPlacementGroupScheduler prepare/commit;
+        bundle_scheduling_policy.cc PACK/SPREAD/STRICT_*). The SLICE strategy
+        is TPU-native: bundles land one-per-host on a single slice's hosts,
+        all-or-nothing, so an SPMD gang gets an intact ICI domain."""
+        async with self._pg_lock:
+            for _ in range(240):
+                plan = self._plan_bundles(pg)
+                if plan is not None:
+                    ok = await self._prepare_commit(pg, plan)
+                    if ok:
+                        pg.state = "CREATED"
+                        pg.bundle_locations = dict(enumerate(plan))
+                        pg.ready_event.set()
+                        await self.publish("placement_groups", pg.view())
+                        return
+                await asyncio.sleep(0.25)
+            pg.state = "INFEASIBLE"
+            pg.ready_event.set()
+            await self.publish("placement_groups", pg.view())
+
+    def _plan_bundles(self, pg: PlacementGroupInfo) -> Optional[List[NodeID]]:
+        alive = [n for n in self.nodes.values() if n.state == ALIVE]
+        avail = {n.node_id: dict(n.resources_available) for n in alive}
+
+        def take(node: NodeInfo, bundle: Dict[str, float]) -> bool:
+            a = avail[node.node_id]
+            if not _fits(bundle, a):
+                return False
+            for k, v in bundle.items():
+                a[k] = a.get(k, 0) - v
+            return True
+
+        strategy = pg.strategy
+        plan: List[NodeID] = []
+        if strategy == "SLICE":
+            # Group nodes by slice_id; need one distinct host per bundle,
+            # all in the same slice.
+            by_slice: Dict[str, List[NodeInfo]] = {}
+            for n in alive:
+                if n.slice_id:
+                    by_slice.setdefault(n.slice_id, []).append(n)
+            for slice_nodes in by_slice.values():
+                if len(slice_nodes) < len(pg.bundles):
+                    continue
+                trial = []
+                used = set()
+                ok = True
+                for bundle in pg.bundles:
+                    pick = next((n for n in slice_nodes
+                                 if n.node_id not in used and take(n, bundle)),
+                                None)
+                    if pick is None:
+                        ok = False
+                        break
+                    used.add(pick.node_id)
+                    trial.append(pick.node_id)
+                if ok:
+                    return trial
+            return None
+        if strategy in ("STRICT_SPREAD", "SPREAD"):
+            used: Set[NodeID] = set()
+            for bundle in pg.bundles:
+                candidates = sorted(alive, key=_utilization)
+                pick = next((n for n in candidates
+                             if n.node_id not in used and take(n, bundle)),
+                            None)
+                if pick is None and strategy == "SPREAD":
+                    pick = next((n for n in candidates if take(n, bundle)),
+                                None)
+                if pick is None:
+                    return None
+                used.add(pick.node_id)
+                plan.append(pick.node_id)
+            return plan
+        # PACK / STRICT_PACK: try to fit all on one node first.
+        for n in sorted(alive, key=_utilization, reverse=True):
+            trial_avail = dict(n.resources_available)
+            if all(_fits_take(b, trial_avail) for b in pg.bundles):
+                return [n.node_id] * len(pg.bundles)
+        if strategy == "STRICT_PACK":
+            return None
+        for bundle in pg.bundles:  # PACK fallback: fewest nodes greedy
+            pick = next((n for n in sorted(alive, key=_utilization,
+                                           reverse=True) if take(n, bundle)),
+                        None)
+            if pick is None:
+                return None
+            plan.append(pick.node_id)
+        return plan
+
+    async def _prepare_commit(self, pg: PlacementGroupInfo,
+                              plan: List[NodeID]) -> bool:
+        prepared: List[Tuple[NodeID, int]] = []
+        for idx, node_id in enumerate(plan):
+            node = self.nodes.get(node_id)
+            try:
+                r = await node.conn.call("prepare_bundle", {
+                    "pg_id": pg.pg_id.binary(), "bundle_index": idx,
+                    "resources": pg.bundles[idx]}, timeout=5.0)
+                if not r.get("ok"):
+                    raise RuntimeError(r.get("error", "prepare refused"))
+                prepared.append((node_id, idx))
+            except Exception as e:
+                logger.info("pg prepare failed on %s: %s",
+                            node_id.hex()[:8], e)
+                for nid, i in prepared:
+                    n2 = self.nodes.get(nid)
+                    if n2 and n2.conn:
+                        try:
+                            await n2.conn.call("cancel_bundle", {
+                                "pg_id": pg.pg_id.binary(), "bundle_index": i})
+                        except Exception:
+                            pass
+                return False
+        for (node_id, idx) in prepared:
+            node = self.nodes.get(node_id)
+            await node.conn.call("commit_bundle", {
+                "pg_id": pg.pg_id.binary(), "bundle_index": idx})
+        return True
+
+    # ------------------------------------------------------------- object directory
+    async def handle_add_object_location(self, data, conn) -> bool:
+        self.object_locations.setdefault(data["object_id"], set()).add(
+            data["node_id"])
+        return True
+
+    async def handle_remove_object_location(self, data, conn) -> bool:
+        locs = self.object_locations.get(data["object_id"])
+        if locs:
+            locs.discard(data["node_id"])
+        return True
+
+    async def handle_get_object_locations(self, data, conn) -> dict:
+        oid = data["object_id"]
+        return {
+            "nodes": [
+                self.nodes[NodeID(n)].view()
+                for n in self.object_locations.get(oid, set())
+                if NodeID(n) in self.nodes and
+                self.nodes[NodeID(n)].state == ALIVE
+            ],
+            "spilled_url": self.spilled_objects.get(oid),
+        }
+
+    async def handle_add_spilled_object(self, data, conn) -> bool:
+        self.spilled_objects[data["object_id"]] = data["url"]
+        return True
+
+    # ------------------------------------------------------------- task events
+    async def handle_report_task_events(self, data, conn) -> bool:
+        self.task_events.extend(data["events"])
+        overflow = len(self.task_events) - self.config.task_events_max_buffer
+        if overflow > 0:
+            del self.task_events[:overflow]
+        return True
+
+    async def handle_list_task_events(self, data, conn) -> list:
+        limit = data.get("limit", 1000)
+        return self.task_events[-limit:]
+
+    # ------------------------------------------------------------- misc
+    async def handle_cluster_resources(self, data, conn) -> dict:
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if n.state != ALIVE:
+                continue
+            for k, v in n.resources_total.items():
+                total[k] = total.get(k, 0) + v
+            for k, v in n.resources_available.items():
+                avail[k] = avail.get(k, 0) + v
+        return {"total": total, "available": avail}
+
+    async def handle_ping(self, data, conn) -> str:
+        return "pong"
+
+
+def _fits(demand: Dict[str, float], available: Dict[str, float]) -> bool:
+    return all(available.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
+
+
+def _fits_take(demand: Dict[str, float], available: Dict[str, float]) -> bool:
+    if not _fits(demand, available):
+        return False
+    for k, v in demand.items():
+        available[k] = available.get(k, 0) - v
+    return True
+
+
+def _utilization(node: NodeInfo) -> float:
+    """Max over resources of used/total (critical-resource utilization)."""
+    u = 0.0
+    for k, total in node.resources_total.items():
+        if total > 0:
+            used = total - node.resources_available.get(k, 0)
+            u = max(u, used / total)
+    return u
+
+
+def main():  # pragma: no cover - exercised via subprocess in tests
+    import argparse
+    import json
+    import sys
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--config", default="{}")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s GCS %(levelname)s %(message)s")
+
+    async def run():
+        cfg = Config.from_dict(json.loads(args.config)) if args.config != "{}" \
+            else Config.from_env()
+        server = GcsServer(cfg)
+        port = await server.start(args.host, args.port)
+        # Announce the bound port on stdout for the parent process.
+        print(json.dumps({"port": port}), flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""In-process memory store for small/inline objects.
+
+Equivalent of the reference's CoreWorkerMemoryStore
+(src/ray/core_worker/store_provider/memory_store/memory_store.h:43): small
+objects (< max_direct_call_object_size) live in the owner's process and are
+inlined into task replies instead of round-tripping through shared memory.
+Waiters are asyncio futures resolved on put.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+from ray_tpu.core.ids import ObjectID
+
+IN_PLASMA = object()  # sentinel: value lives in the shm store
+
+
+class MemoryStore:
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        self._objects: Dict[ObjectID, bytes] = {}
+        self._plasma_markers: set[ObjectID] = set()
+        self._waiters: Dict[ObjectID, List[asyncio.Future]] = {}
+
+    def put(self, object_id: ObjectID, data: bytes) -> None:
+        """Store serialized bytes and wake waiters. Thread-safe via loop."""
+        self._loop.call_soon_threadsafe(self._put_in_loop, object_id, data)
+
+    def _put_in_loop(self, object_id: ObjectID, data) -> None:
+        if data is IN_PLASMA:
+            self._plasma_markers.add(object_id)
+        else:
+            self._objects[object_id] = data
+        for fut in self._waiters.pop(object_id, []):
+            if not fut.done():
+                fut.set_result(True)
+
+    def put_in_loop(self, object_id: ObjectID, data: bytes) -> None:
+        """Same as put() but caller is already on the loop."""
+        self._put_in_loop(object_id, data)
+
+    def mark_in_plasma(self, object_id: ObjectID) -> None:
+        self._loop.call_soon_threadsafe(self._put_in_loop, object_id, IN_PLASMA)
+
+    def get_if_exists(self, object_id: ObjectID) -> Optional[bytes]:
+        return self._objects.get(object_id)
+
+    def is_in_plasma(self, object_id: ObjectID) -> bool:
+        return object_id in self._plasma_markers
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return object_id in self._objects or object_id in self._plasma_markers
+
+    async def wait_ready(self, object_id: ObjectID,
+                         timeout: Optional[float] = None) -> bool:
+        """Wait until the object is in this store or marked in-plasma."""
+        if self.contains(object_id):
+            return True
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.setdefault(object_id, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+        finally:
+            lst = self._waiters.get(object_id)
+            if lst and fut in lst:
+                lst.remove(fut)
+
+    def delete(self, object_id: ObjectID) -> None:
+        self._objects.pop(object_id, None)
+        self._plasma_markers.discard(object_id)
+
+    def fail(self, object_id: ObjectID, error_bytes: bytes) -> None:
+        """Store an error envelope (raised on get)."""
+        self.put(object_id, error_bytes)
+
+    def size(self) -> int:
+        return len(self._objects) + len(self._plasma_markers)

@@ -1,0 +1,153 @@
+"""Ownership-based distributed reference counting.
+
+Equivalent of the reference's ReferenceCounter
+(src/ray/core_worker/reference_count.cc): every object has exactly one owner
+(the worker that created it — by `put` or by submitting the producing task).
+The owner tracks:
+  - local references (ObjectRef instances alive in the owner process),
+  - submitted-task references (the object is an argument of an in-flight task),
+  - borrower processes (processes that deserialized a ref to this object).
+When all counts reach zero the object is out of scope: it is deleted from
+the memory store and the shm store, and borrower notifications stop.
+
+Borrowers track local refs per borrowed object and notify the owner when
+their count drops to zero (ref_removed RPC to the owner address).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Set
+
+from ray_tpu.core.ids import ObjectID
+
+
+class _Ref:
+    __slots__ = ("local", "submitted", "borrowers", "owned", "owner_address",
+                 "lineage_task", "pinned")
+
+    def __init__(self):
+        self.local = 0
+        self.submitted = 0
+        self.borrowers: Set[str] = set()
+        self.owned = False
+        self.owner_address: Optional[str] = None
+        self.lineage_task = None  # TaskSpec that can reproduce the object
+        self.pinned = False
+
+    def out_of_scope(self) -> bool:
+        return self.local <= 0 and self.submitted <= 0 and not self.borrowers \
+            and not self.pinned
+
+
+class ReferenceCounter:
+    def __init__(self, on_object_out_of_scope: Optional[Callable] = None,
+                 notify_owner_ref_removed: Optional[Callable] = None):
+        self._refs: Dict[ObjectID, _Ref] = {}
+        self._lock = threading.RLock()
+        # owner-side: delete the object everywhere
+        self._on_out_of_scope = on_object_out_of_scope
+        # borrower-side: tell the owner we dropped our refs
+        self._notify_owner = notify_owner_ref_removed
+
+    def _get(self, object_id: ObjectID) -> _Ref:
+        ref = self._refs.get(object_id)
+        if ref is None:
+            ref = self._refs[object_id] = _Ref()
+        return ref
+
+    # --- owner registration ---
+    def add_owned_object(self, object_id: ObjectID,
+                         lineage_task=None) -> None:
+        with self._lock:
+            ref = self._get(object_id)
+            ref.owned = True
+            ref.lineage_task = lineage_task
+
+    def add_borrowed_object(self, object_id: ObjectID,
+                            owner_address: str) -> None:
+        with self._lock:
+            ref = self._get(object_id)
+            if not ref.owned:
+                ref.owner_address = owner_address
+
+    def is_owned(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return bool(ref and ref.owned)
+
+    def owner_address(self, object_id: ObjectID) -> Optional[str]:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return ref.owner_address if ref else None
+
+    def get_lineage(self, object_id: ObjectID):
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return ref.lineage_task if ref else None
+
+    def pin(self, object_id: ObjectID, pinned: bool = True) -> None:
+        with self._lock:
+            self._get(object_id).pinned = pinned
+
+    # --- local refs (ObjectRef lifecycle) ---
+    def add_local_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._get(object_id).local += 1
+
+    def remove_local_ref(self, object_id: ObjectID) -> None:
+        self._decrement(object_id, "local")
+
+    # --- submitted-task refs ---
+    def add_submitted_task_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._get(object_id).submitted += 1
+
+    def remove_submitted_task_ref(self, object_id: ObjectID) -> None:
+        self._decrement(object_id, "submitted")
+
+    # --- borrowers (owner side) ---
+    def add_borrower(self, object_id: ObjectID, borrower_address: str) -> None:
+        with self._lock:
+            self._get(object_id).borrowers.add(borrower_address)
+
+    def remove_borrower(self, object_id: ObjectID,
+                        borrower_address: str) -> None:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if not ref:
+                return
+            ref.borrowers.discard(borrower_address)
+            self._maybe_out_of_scope(object_id, ref)
+
+    def _decrement(self, object_id: ObjectID, field: str) -> None:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return
+            setattr(ref, field, getattr(ref, field) - 1)
+            self._maybe_out_of_scope(object_id, ref)
+
+    def _maybe_out_of_scope(self, object_id: ObjectID, ref: _Ref) -> None:
+        if not ref.out_of_scope():
+            return
+        self._refs.pop(object_id, None)
+        if ref.owned:
+            if self._on_out_of_scope:
+                self._on_out_of_scope(object_id)
+        elif ref.owner_address and self._notify_owner:
+            self._notify_owner(object_id, ref.owner_address)
+
+    def num_refs(self) -> int:
+        with self._lock:
+            return len(self._refs)
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                oid.hex(): {
+                    "local": r.local, "submitted": r.submitted,
+                    "borrowers": len(r.borrowers), "owned": r.owned,
+                }
+                for oid, r in self._refs.items()
+            }

@@ -1,0 +1,308 @@
+"""Global worker + the synchronous public core API.
+
+Equivalent of the reference's worker module (python/ray/_private/worker.py):
+``init`` (:1225) boots or joins a cluster and connects a driver CoreWorker;
+``get``/``put``/``wait`` (:2551+) bridge the synchronous user thread onto the
+CoreWorker's io loop; ``shutdown`` (:1824) tears the session down.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import threading
+from typing import Any, List, Optional, Sequence, Union
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, NodeID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+
+logger = logging.getLogger(__name__)
+
+_global_worker: Optional["Worker"] = None
+_init_lock = threading.Lock()
+
+
+class Worker:
+    """Driver- or executor-side facade over a CoreWorker."""
+
+    def __init__(self, core, io_thread=None, node=None,
+                 namespace: str = "default"):
+        self.core = core
+        self.io = io_thread
+        self.node = node
+        self.namespace = namespace
+        self.loop = core.loop
+
+    # -- bridging helpers --------------------------------------------------
+    def _run(self, coro, timeout: Optional[float] = None):
+        import asyncio
+
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self.loop:
+            raise RuntimeError(
+                "sync API called from the io loop; use the async variants")
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    # -- public ops --------------------------------------------------------
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        for r in ref_list:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() takes ObjectRefs, got {type(r)}")
+        values = self._run(self.core.get_objects(ref_list, timeout))
+        return values[0] if single else values
+
+    def put(self, value) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("put() of an ObjectRef is not allowed")
+        return self._run(self.core.put_object(value))
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True):
+        refs = list(refs)
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds the number of refs")
+        return self._run(self.core.wait_objects(
+            refs, num_returns, timeout, fetch_local))
+
+    def as_future(self, ref: ObjectRef):
+        import asyncio
+
+        return asyncio.run_coroutine_threadsafe(
+            self.core.get_objects([ref]), self.loop)
+
+    async def get_async(self, ref: ObjectRef):
+        return (await self.core.get_objects([ref]))[0]
+
+    @property
+    def reference_counter(self):
+        return self.core.reference_counter
+
+    def submit_task(self, descriptor, args, kwargs, opts) -> List[ObjectRef]:
+        return self._run(
+            self.core.submit_task(descriptor, args, kwargs, opts))
+
+    def create_actor(self, descriptor, args, kwargs, opts) -> ActorID:
+        return self._run(
+            self.core.create_actor(descriptor, args, kwargs, opts))
+
+    def submit_actor_task(self, actor_id, method, args, kwargs, opts):
+        return self._run(self.core.submit_actor_task(
+            actor_id, method, args, kwargs, opts))
+
+    def export(self, fn):
+        return self.core.function_manager.export(fn)
+
+    def gcs_call(self, method: str, data=None, timeout: float = 30.0):
+        return self._run(self.core.gcs.call(method, data, timeout=timeout))
+
+
+def global_worker() -> Worker:
+    if _global_worker is None:
+        raise RuntimeError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first")
+    return _global_worker
+
+
+def global_worker_or_none() -> Optional[Worker]:
+    return _global_worker
+
+
+def is_initialized() -> bool:
+    return _global_worker is not None
+
+
+def _attach_executor_worker(core) -> None:
+    """Called inside worker processes so user task code can use the API."""
+    global _global_worker
+    _global_worker = Worker(core)
+
+
+def init(address: Optional[str] = None, *,
+         resources: Optional[dict] = None,
+         num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         object_store_memory: Optional[int] = None,
+         namespace: str = "default",
+         system_config: Optional[dict] = None,
+         ignore_reinit_error: bool = False,
+         _node_kwargs: Optional[dict] = None) -> "RuntimeContext":
+    """Start a new single-node cluster (head) or connect to an existing one.
+
+    Reference: python/ray/_private/worker.py:1225.
+    """
+    global _global_worker
+    with _init_lock:
+        if _global_worker is not None:
+            if ignore_reinit_error:
+                return get_runtime_context()
+            raise RuntimeError("ray_tpu.init() called twice")
+        import asyncio
+
+        from ray_tpu._private.core_worker import DRIVER, CoreWorker
+        from ray_tpu._private.node import Node
+
+        config = Config.from_env(system_config)
+        if object_store_memory:
+            config.object_store_memory = object_store_memory
+        node = None
+        if address is None:
+            res = dict(resources or {})
+            if num_cpus is not None:
+                res["CPU"] = float(num_cpus)
+            if num_tpus is not None:
+                res["TPU"] = float(num_tpus)
+            node = Node(config, resources=res or None,
+                        **(_node_kwargs or {}))
+            node.start()
+            gcs_address = node.gcs_address
+            raylet_address = node.raylet_address
+            store_path = node.store_path
+            session_dir = node.session_dir
+        else:
+            gcs_address = address
+            raylet_address, store_path, session_dir = \
+                _find_local_node(address, config)
+            if raylet_address is None:
+                raise RuntimeError(
+                    "no alive raylet found on this host for cluster "
+                    f"{address}; drivers must run on a cluster node "
+                    "(start one with Cluster.add_node or ray_tpu.init())")
+        io = rpc.EventLoopThread()
+        core = CoreWorker(
+            mode=DRIVER, gcs_address=gcs_address, config=config,
+            loop=io.loop, raylet_address=raylet_address,
+            store_path=store_path, session_dir=session_dir)
+        try:
+            asyncio.run_coroutine_threadsafe(core.connect(), io.loop).result(60)
+        except Exception:
+            if node is not None:
+                node.shutdown()
+            io.stop()
+            raise
+        _global_worker = Worker(core, io_thread=io, node=node,
+                                namespace=namespace)
+        atexit.register(shutdown)
+        return get_runtime_context()
+
+
+def _find_local_node(address: str, config: Config):
+    """Join an existing cluster: locate (or lack) a raylet on this host."""
+    import asyncio
+
+    async def probe():
+        host, port = address.rsplit(":", 1)
+        conn = await rpc.connect(host, int(port), timeout=10.0)
+        nodes = await conn.call("get_nodes")
+        await conn.close()
+        hostname = os.uname().nodename
+        for n in nodes:
+            if n["hostname"] == hostname and n["state"] == "ALIVE" and \
+                    os.path.exists(n["store_path"]):
+                return n["address"], n["store_path"]
+        return None, None
+
+    raylet_address, store_path = asyncio.run(probe())
+    return raylet_address, store_path, config.temp_dir
+
+
+def shutdown() -> None:
+    global _global_worker
+    with _init_lock:
+        w = _global_worker
+        if w is None:
+            return
+        _global_worker = None
+        import asyncio
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                w.core.disconnect(), w.loop).result(5)
+        except Exception:
+            pass
+        if w.io is not None:
+            w.io.stop()
+        if w.node is not None:
+            w.node.shutdown()
+        try:
+            atexit.unregister(shutdown)
+        except Exception:
+            pass
+
+
+def get(refs, *, timeout: Optional[float] = None):
+    return global_worker().get(refs, timeout=timeout)
+
+
+def put(value) -> ObjectRef:
+    return global_worker().put(value)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: Optional[float] = None,
+         fetch_local: bool = True):
+    return global_worker().wait(refs, num_returns, timeout, fetch_local)
+
+
+def kill(actor, *, no_restart: bool = True) -> None:
+    from ray_tpu.core.actor import ActorHandle
+
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() takes an ActorHandle")
+    w = global_worker()
+    w._run(w.core.kill_actor(actor._actor_id, no_restart))
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
+    """Best-effort: queued-but-unsent tasks are dropped (True); tasks
+    already dispatched keep running (False).
+    Reference: CoreWorker::CancelTask non-force path."""
+    w = global_worker()
+    return w._run(w.core.cancel_task(ref))
+
+
+class RuntimeContext:
+    def __init__(self, worker: Worker):
+        self._worker = worker
+
+    @property
+    def job_id(self):
+        return self._worker.core.job_id
+
+    @property
+    def node_id(self) -> Optional[NodeID]:
+        return self._worker.core.node_id
+
+    @property
+    def worker_id(self) -> WorkerID:
+        return self._worker.core.worker_id
+
+    @property
+    def gcs_address(self) -> str:
+        return self._worker.core.gcs_address
+
+    @property
+    def current_actor_id(self) -> Optional[ActorID]:
+        return self._worker.core._local_actor_id
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return False
+
+    def get_task_id(self):
+        spec = self._worker.core._current_task
+        return spec.task_id if spec else None
+
+    def cluster_resources(self) -> dict:
+        return self._worker.gcs_call("cluster_resources")
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(global_worker())

@@ -1,0 +1,54 @@
+"""Worker process entrypoint.
+
+Equivalent of the reference's default_worker.py (python/ray/_private/
+workers/default_worker.py): spawned by the raylet, connects back, serves
+push_task RPCs until told to exit. TPU visibility env vars
+(TPU_VISIBLE_CHIPS etc.) are set by the raylet before spawn when the lease
+carries TPU resources.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s worker %(levelname)s %(message)s")
+    from ray_tpu.core.config import Config
+    from ray_tpu.core.ids import NodeID, WorkerID
+    from ray_tpu._private.core_worker import WORKER, CoreWorker
+
+    async def amain():
+        cfg_json = os.environ.get("RAY_TPU_CONFIG_JSON")
+        config = Config.from_dict(json.loads(cfg_json)) if cfg_json \
+            else Config.from_env()
+        cw = CoreWorker(
+            mode=WORKER,
+            gcs_address=os.environ["RAY_TPU_GCS_ADDRESS"],
+            config=config,
+            loop=asyncio.get_running_loop(),
+            raylet_address=os.environ["RAY_TPU_RAYLET_ADDRESS"],
+            store_path=os.environ.get("RAY_TPU_STORE_PATH"),
+            node_id=NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"]),
+            session_dir=os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu"),
+            worker_id=WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"]),
+        )
+        # Make this worker the process-global worker so user code running in
+        # tasks can call ray_tpu.get/put/remote recursively.
+        from ray_tpu._private import worker as worker_mod
+
+        worker_mod._attach_executor_worker(cw)
+        await cw.connect()
+        await cw._should_exit.wait()
+        await cw.disconnect()
+
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

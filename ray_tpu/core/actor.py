@@ -1,0 +1,114 @@
+"""Actor API: ActorClass, ActorHandle, ActorMethod.
+
+Equivalent of the reference's python/ray/actor.py (ActorClass :566,
+``_remote`` :854 → create_actor; method calls :1460 → submit_actor_task).
+Handles are serializable: passing one into a task gives the receiver a
+working handle to the same actor (resolved through the GCS actor table).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ray_tpu.core.ids import ActorID
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, opts: dict):
+        self._handle = handle
+        self._name = name
+        self._opts = opts
+
+    def options(self, **opts) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, {**self._opts, **opts})
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu._private.worker import global_worker
+
+        worker = global_worker()
+        refs = worker.submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs, self._opts)
+        if self._opts.get("num_returns", 1) == 1:
+            return refs[0]
+        return refs
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag.dag_node import ActorMethodNode
+
+        return ActorMethodNode(self._handle, self._name, args, kwargs)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, method_opts: Optional[dict] = None):
+        object.__setattr__(self, "_actor_id", actor_id)
+        object.__setattr__(self, "_method_opts", method_opts or {})
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name, dict(self._method_opts.get(name, {})))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:12]})"
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and \
+            other._actor_id == self._actor_id
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_opts))
+
+
+class ActorClass:
+    def __init__(self, cls: type, opts: dict):
+        self._cls = cls
+        self._opts = opts
+        self._descriptor = None
+        self.__name__ = cls.__name__
+        # Collect per-method options declared with @method(...).
+        self._method_opts = {
+            name: getattr(fn, "__ray_tpu_method_opts__")
+            for name, fn in vars(cls).items()
+            if callable(fn) and hasattr(fn, "__ray_tpu_method_opts__")
+        }
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self.__name__} cannot be instantiated directly; "
+            f"use {self.__name__}.remote()")
+
+    def options(self, **opts) -> "ActorClass":
+        new = ActorClass(self._cls, {**self._opts, **opts})
+        new._descriptor = self._descriptor
+        return new
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu._private.api import _resolve_strategy
+        from ray_tpu._private.worker import global_worker
+
+        worker = global_worker()
+        if self._descriptor is None:
+            self._descriptor = worker.export(self._cls)
+        opts = _resolve_strategy(self._opts)
+        actor_id = worker.create_actor(self._descriptor, args, kwargs, opts)
+        return ActorHandle(actor_id, self._method_opts)
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag.dag_node import ActorClassNode
+
+        return ActorClassNode(self, args, kwargs)
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    """Look up a named actor (reference: ray.get_actor)."""
+    from ray_tpu._private.worker import global_worker
+
+    worker = global_worker()
+    view = worker.gcs_call("get_actor_info",
+                           {"name": name, "namespace": namespace})
+    if view is None or view["state"] == "DEAD":
+        raise ValueError(f"no live actor named {name!r}")
+    return ActorHandle(ActorID(view["actor_id"]))

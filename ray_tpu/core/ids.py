@@ -1,0 +1,159 @@
+"""Binary IDs for jobs, tasks, actors, objects, nodes, workers, placement groups.
+
+TPU-native equivalent of the reference's ID system
+(src/ray/common/id.h; python/ray/includes/unique_ids.pxi): fixed-size random
+binary IDs with structured derivation (object IDs derive from the producing
+task ID + return index, actor IDs embed the job ID) so ownership and lineage
+can be recovered from the ID alone.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+_NIL = b"\xff"
+
+
+class BaseID:
+    SIZE = 16
+    __slots__ = ("_binary", "_hash")
+
+    def __init__(self, binary: bytes):
+        if len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}"
+            )
+        self._binary = binary
+        self._hash = hash((type(self).__name__, binary))
+
+    @classmethod
+    def from_random(cls) -> "BaseID":
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str) -> "BaseID":
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls(_NIL * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._binary == _NIL * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._binary
+
+    def hex(self) -> str:
+        return self._binary.hex()
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._binary == self._binary
+
+    def __lt__(self, other):
+        return self._binary < other._binary
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._binary.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._binary,))
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+    @classmethod
+    def from_int(cls, value: int) -> "JobID":
+        return cls(struct.pack(">I", value))
+
+    def int_value(self) -> int:
+        return struct.unpack(">I", self._binary)[0]
+
+
+class NodeID(BaseID):
+    SIZE = 16
+
+
+class WorkerID(BaseID):
+    SIZE = 16
+
+
+class ActorID(BaseID):
+    """12 random bytes + 4-byte job id suffix."""
+
+    SIZE = 16
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(os.urandom(cls.SIZE - JobID.SIZE) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._binary[-JobID.SIZE:])
+
+
+class TaskID(BaseID):
+    """16 random bytes + 4-byte job id; actor tasks embed the actor id."""
+
+    SIZE = 20
+
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        return cls(b"\x00" * (cls.SIZE - JobID.SIZE) + job_id.binary())
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "TaskID":
+        return cls(os.urandom(cls.SIZE - JobID.SIZE) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._binary[-JobID.SIZE:])
+
+
+class ObjectID(BaseID):
+    """TaskID (20 bytes) + big-endian return index (4 bytes).
+
+    Mirrors the reference's ObjectID = TaskID + index scheme
+    (src/ray/common/id.h) so lineage (which task produced this object)
+    is recoverable from the ID.
+    """
+
+    SIZE = 24
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        return cls(task_id.binary() + struct.pack(">I", index))
+
+    @classmethod
+    def from_random(cls) -> "ObjectID":
+        # Put objects: synthesize a fresh task id namespace.
+        return cls(os.urandom(TaskID.SIZE) + struct.pack(">I", 0))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._binary[: TaskID.SIZE])
+
+    def return_index(self) -> int:
+        return struct.unpack(">I", self._binary[TaskID.SIZE:])[0]
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 16
+
+
+class ClusterID(BaseID):
+    SIZE = 16
+
+
+class _Counter:
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._value += 1
+            return self._value

@@ -1,0 +1,80 @@
+"""ObjectRef — handle to a (possibly pending) object in the cluster.
+
+Equivalent of the reference's ObjectRef (python/ray/includes/
+object_ref.pxi:36): carries the binary ObjectID plus the owner's address so
+any holder can locate/borrow the object, and participates in distributed
+reference counting — the owning CoreWorker is notified when refs are
+created/destroyed in this process.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.core.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_address", "_worker", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_address: Optional[str] = None,
+                 _register: bool = True):
+        self.id = object_id
+        self.owner_address = owner_address
+        self._worker = None
+        if _register:
+            from ray_tpu._private.worker import global_worker_or_none
+
+            w = global_worker_or_none()
+            if w is not None:
+                self._worker = w
+                w.reference_counter.add_local_ref(self.id)
+                if owner_address:
+                    # Borrower protocol: record + notify the owner.
+                    w.core.register_borrow(self.id, owner_address)
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def task_id(self):
+        return self.id.task_id()
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the object's value."""
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker().as_future(self)
+
+    def __await__(self):
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker().get_async(self).__await__()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __del__(self):
+        w = self._worker
+        if w is not None:
+            try:
+                w.reference_counter.remove_local_ref(self.id)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        # Serializing a ref inside a task arg / another object makes the
+        # receiver a borrower; registration on deserialize adds a local ref.
+        return (_deserialize_ref, (self.id.binary(), self.owner_address))
+
+
+def _deserialize_ref(id_binary: bytes, owner_address: Optional[str]) -> "ObjectRef":
+    return ObjectRef(ObjectID(id_binary), owner_address)

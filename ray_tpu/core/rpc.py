@@ -1,0 +1,274 @@
+"""Async RPC layer: length-prefixed msgpack frames over TCP/unix sockets.
+
+TPU-native equivalent of the reference's gRPC wrappers (src/ray/rpc/
+grpc_server.h, client_call.h): a small, dependency-light framed protocol with
+request/response correlation, notifications (one-way), per-handler chaos
+delay injection (src/ray/common/asio/asio_chaos.h analog), and automatic
+reconnect-with-retry clients. Control-plane only — bulk object data rides the
+same connections but in dedicated chunked messages, and device data never
+touches this layer (XLA collectives over ICI carry it in-program).
+
+All values are msgpack-encodable: ints/floats/str/bytes/list/dict. Binary
+IDs travel as raw bytes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import threading
+import time
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+import msgpack
+
+from ray_tpu.core.config import get_rpc_delay_us
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<I")
+
+REQUEST, RESPONSE, NOTIFY, ERROR = 0, 1, 2, 3
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def _pack(msg) -> bytes:
+    payload = msgpack.packb(msg, use_bin_type=True)
+    return _LEN.pack(len(payload)) + payload
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    payload = await reader.readexactly(length)
+    return msgpack.unpackb(payload, raw=False)
+
+
+class Connection:
+    """One bidirectional RPC connection.
+
+    Both ends can issue requests; the handler (if any) serves incoming ones.
+    """
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        handler: Optional[Callable[[str, Any, "Connection"], Awaitable[Any]]] = None,
+        name: str = "",
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self.name = name
+        self._next_id = 0
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._read_task: Optional[asyncio.Task] = None
+        self._write_lock = asyncio.Lock()
+        self.on_close: Optional[Callable[["Connection"], None]] = None
+
+    def start(self) -> None:
+        self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = await _read_frame(self.reader)
+                kind = msg[0]
+                if kind == REQUEST:
+                    _, msgid, method, data = msg
+                    asyncio.get_running_loop().create_task(
+                        self._serve(msgid, method, data))
+                elif kind == NOTIFY:
+                    _, method, data = msg
+                    asyncio.get_running_loop().create_task(
+                        self._serve(None, method, data))
+                elif kind == RESPONSE:
+                    _, msgid, data = msg
+                    fut = self._pending.pop(msgid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(data)
+                elif kind == ERROR:
+                    _, msgid, err = msg
+                    fut = self._pending.pop(msgid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(RpcError(err))
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        except Exception:
+            logger.exception("rpc read loop failed (%s)", self.name)
+        finally:
+            await self._teardown()
+
+    async def _serve(self, msgid: Optional[int], method: str, data: Any) -> None:
+        delay_us = get_rpc_delay_us(method)
+        if delay_us:
+            await asyncio.sleep(delay_us / 1e6)
+        try:
+            if self.handler is None:
+                raise RpcError(f"no handler for {method}")
+            result = await self.handler(method, data, self)
+            if msgid is not None:
+                await self.send((RESPONSE, msgid, result))
+        except Exception as e:
+            if msgid is not None:
+                try:
+                    await self.send((ERROR, msgid, f"{type(e).__name__}: {e}"))
+                except Exception:
+                    pass
+            else:
+                logger.exception("notify handler %s failed", method)
+
+    async def send(self, msg) -> None:
+        data = _pack(msg)
+        async with self._write_lock:
+            if self._closed:
+                raise ConnectionLost(f"connection {self.name} closed")
+            self.writer.write(data)
+            await self.writer.drain()
+
+    async def call(self, method: str, data: Any = None,
+                   timeout: Optional[float] = None) -> Any:
+        self._next_id += 1
+        msgid = self._next_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[msgid] = fut
+        await self.send((REQUEST, msgid, method, data))
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(msgid, None)
+
+    async def notify(self, method: str, data: Any = None) -> None:
+        await self.send((NOTIFY, method, data))
+
+    async def _teardown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close:
+            try:
+                self.on_close(self)
+            except Exception:
+                logger.exception("on_close callback failed")
+
+    async def close(self) -> None:
+        if self._read_task:
+            self._read_task.cancel()
+        await self._teardown()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+class Server:
+    """RPC server: dispatches `method` to handler.handle_<method>(data, conn)."""
+
+    def __init__(self, handler_obj, host: str = "127.0.0.1", port: int = 0):
+        self.handler_obj = handler_obj
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: set[Connection] = set()
+
+    async def _dispatch(self, method: str, data: Any, conn: Connection) -> Any:
+        fn = getattr(self.handler_obj, "handle_" + method, None)
+        if fn is None:
+            raise RpcError(f"unknown method {method}")
+        result = fn(data, conn)
+        if asyncio.iscoroutine(result):
+            result = await result
+        return result
+
+    async def _on_client(self, reader, writer) -> None:
+        conn = Connection(reader, writer, handler=self._dispatch, name="server")
+        self.connections.add(conn)
+        conn.on_close = self.connections.discard
+        if hasattr(self.handler_obj, "on_connection"):
+            self.handler_obj.on_connection(conn)
+        conn.start()
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def close(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self.connections):
+            await conn.close()
+
+
+async def connect(host: str, port: int,
+                  handler: Optional[Callable] = None,
+                  name: str = "",
+                  timeout: float = 10.0,
+                  retry_interval: float = 0.1) -> Connection:
+    """Connect with retry (the peer process may still be starting)."""
+    deadline = time.monotonic() + timeout
+    last_err: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            conn = Connection(reader, writer, handler=handler, name=name)
+            conn.start()
+            return conn
+        except (ConnectionRefusedError, OSError) as e:
+            last_err = e
+            await asyncio.sleep(retry_interval)
+    raise ConnectionLost(f"could not connect to {host}:{port}: {last_err}")
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a background thread.
+
+    The reference embeds io threads inside CoreWorker
+    (src/ray/core_worker/core_worker_process.cc); here the driver/worker's
+    synchronous public API posts coroutines onto this loop.
+    """
+
+    def __init__(self, name: str = "ray_tpu_io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def run_async(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self) -> None:
+        def _cancel_all():
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+        self.loop.call_soon_threadsafe(_cancel_all)
+        time.sleep(0.05)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=2)

@@ -1,0 +1,33 @@
+"""Scheduling strategies (reference: python/ray/util/scheduling_strategies.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from ray_tpu.core.placement_group import PlacementGroup
+
+
+class PlacementGroupSchedulingStrategy:
+    """Pin a task/actor to a placement-group bundle (reference :15)."""
+
+    def __init__(self, placement_group: PlacementGroup,
+                 placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: bool = False):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = \
+            placement_group_capture_child_tasks
+
+
+class NodeAffinitySchedulingStrategy:
+    """Pin to a node by id (reference :41)."""
+
+    def __init__(self, node_id: Union[str, bytes], soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+
+# "DEFAULT" and "SPREAD" are passed as plain strings, like the reference.
+DEFAULT = "DEFAULT"
+SPREAD = "SPREAD"

@@ -1,0 +1,175 @@
+"""Serialization: cloudpickle + out-of-band buffers, zero-copy numpy views.
+
+Equivalent of the reference's serialization stack
+(python/ray/_private/serialization.py + vendored cloudpickle): pickle
+protocol 5 with out-of-band buffer extraction so large numpy arrays are
+written to the shared-memory object store without an intermediate copy and
+deserialized as zero-copy views onto the store segment.
+
+Wire layout of a serialized object:
+
+    [u32 nbuf][u64 meta_len][meta pickle][pad][buf0][pad][buf1]...
+    ...[u64 size0..sizeN-1][u32 nbuf]
+      buffers are 64-byte aligned so numpy views are aligned; sizes live in a
+      fixed-position trailer so deserialization never copies buffer bytes.
+
+jax.Array values are device-fetched to numpy on serialize (the object store
+is host memory); layers that must keep data on device ship it through
+device-native channels (ray_tpu.dag) instead.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List
+
+import cloudpickle
+
+_ALIGN = 64
+_HEADER = struct.Struct("<IQ")
+
+
+def _aligned(pos: int) -> int:
+    return (pos + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def _to_numpy_if_jax(value: Any) -> Any:
+    # Device arrays are fetched to host for the object store. Avoid importing
+    # jax unless the object actually came from it.
+    mod = type(value).__module__
+    if mod.startswith("jaxlib") or mod.startswith("jax"):
+        import numpy as np
+
+        try:
+            return np.asarray(value)
+        except Exception:
+            return value
+    return value
+
+
+class SerializedObject:
+    """A pickled value plus its out-of-band buffers, ready to write."""
+
+    __slots__ = ("meta", "buffers", "total_size")
+
+    def __init__(self, meta: bytes, buffers: List[memoryview]):
+        self.meta = meta
+        self.buffers = buffers
+        size = _HEADER.size + len(meta)
+        for b in buffers:
+            size = _aligned(size) + b.nbytes
+        self.total_size = size + 8 * len(buffers) + 4
+
+    def write_to(self, dest: memoryview) -> None:
+        _HEADER.pack_into(dest, 0, len(self.buffers), len(self.meta))
+        pos = _HEADER.size
+        dest[pos: pos + len(self.meta)] = self.meta
+        pos += len(self.meta)
+        sizes = []
+        for b in self.buffers:
+            pos = _aligned(pos)
+            dest[pos: pos + b.nbytes] = b
+            sizes.append(b.nbytes)
+            pos += b.nbytes
+        n = len(sizes)
+        if n:
+            struct.pack_into(f"<{n}Q", dest, len(dest) - 4 - 8 * n, *sizes)
+        struct.pack_into("<I", dest, len(dest) - 4, n)
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size)
+        self.write_to(memoryview(out))
+        return bytes(out)
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+    value = _to_numpy_if_jax(value)
+    meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    views = []
+    for pb in buffers:
+        v = pb.raw()
+        if not v.contiguous:
+            v = memoryview(v.tobytes())
+        elif v.format != "B" or v.ndim != 1:
+            v = v.cast("B")
+        views.append(v)
+    return SerializedObject(meta, views)
+
+
+def deserialize(data: memoryview) -> Any:
+    """Deserialize from a (possibly shm-backed) buffer, zero-copy for arrays.
+
+    The returned object may hold views into ``data``; the store client ties
+    the lifetime of the underlying segment to these views.
+    """
+    nbuf, meta_len = _HEADER.unpack_from(data, 0)
+    trailer_n = struct.unpack_from("<I", data, len(data) - 4)[0]
+    if trailer_n != nbuf:
+        raise ValueError("corrupt serialized object trailer")
+    sizes = struct.unpack_from(f"<{nbuf}Q", data, len(data) - 4 - 8 * nbuf) if nbuf else ()
+    pos = _HEADER.size
+    meta = bytes(data[pos: pos + meta_len])
+    pos += meta_len
+    bufs = []
+    for size in sizes:
+        pos = _aligned(pos)
+        bufs.append(data[pos: pos + size])
+        pos += size
+    return pickle.loads(meta, buffers=bufs)
+
+
+def dumps(value: Any) -> bytes:
+    return serialize(value).to_bytes()
+
+
+def loads(data: bytes | bytearray | memoryview) -> Any:
+    if isinstance(data, (bytes, bytearray)):
+        data = memoryview(data)
+    return deserialize(data)
+
+
+# --- exceptions -----------------------------------------------------------
+class RayTaskError(Exception):
+    """Wraps an exception raised inside a remote task/actor method.
+
+    Reference: python/ray/exceptions.py RayTaskError — re-raised at every
+    ``get()`` of the errored object, with the remote traceback attached.
+    """
+
+    def __init__(self, function_name: str, traceback_str: str, cause_repr: str,
+                 cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause_repr = cause_repr
+        self.cause = cause
+        super().__init__(f"Task '{function_name}' failed:\n{traceback_str}")
+
+    def __reduce__(self):
+        return (type(self), (self.function_name, self.traceback_str,
+                             self.cause_repr, self.cause))
+
+
+class WorkerCrashedError(Exception):
+    pass
+
+
+class ActorDiedError(Exception):
+    pass
+
+
+class ObjectLostError(Exception):
+    pass
+
+
+class GetTimeoutError(TimeoutError):
+    pass
+
+
+class TaskCancelledError(Exception):
+    pass
+
+
+class PlacementGroupUnavailableError(Exception):
+    pass

@@ -1,0 +1,241 @@
+"""Python client for the native shared-memory object store.
+
+Counterpart of the reference's plasma client (src/ray/object_manager/plasma/
+client.cc) — but since the store is a single file-backed mapping (see
+_native/shm_store.cpp), the "client" is just ctypes calls into the mapped
+region plus an mmap for zero-copy buffer views. Buffers returned by ``get``
+pin the object (shm refcount) until the last view is garbage collected.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import weakref
+from typing import Optional, Tuple
+
+from ray_tpu._native.build import ensure_built
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.serialization import SerializedObject
+
+OK = 0
+ERR_EXISTS = -1
+ERR_NOT_FOUND = -2
+ERR_FULL = -3
+ERR_TIMEOUT = -4
+ERR_INVALID = -5
+ERR_NOT_SEALED = -6
+ERR_IN_USE = -7
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(ensure_built("ray_tpu_store"))
+        lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                         ctypes.c_uint32]
+        lib.shm_store_create.restype = ctypes.c_int
+        lib.shm_store_open.argtypes = [ctypes.c_char_p]
+        lib.shm_store_open.restype = ctypes.c_void_p
+        lib.shm_store_close.argtypes = [ctypes.c_void_p]
+        for fn, extra in [
+            ("shm_create", [ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)]),
+            ("shm_seal", []),
+            ("shm_abort", []),
+            ("shm_get", [ctypes.c_long, ctypes.POINTER(ctypes.c_uint64),
+                         ctypes.POINTER(ctypes.c_uint64)]),
+            ("shm_release", []),
+            ("shm_delete", []),
+            ("shm_contains", []),
+        ]:
+            f = getattr(lib, fn)
+            f.argtypes = [ctypes.c_void_p, ctypes.c_char_p] + extra
+            f.restype = ctypes.c_int
+        lib.shm_stats.argtypes = [ctypes.c_void_p] + [
+            ctypes.POINTER(ctypes.c_uint64)] * 4
+        lib.shm_stats.restype = ctypes.c_int
+        _lib = lib
+    return _lib
+
+
+class StoreFullError(Exception):
+    pass
+
+
+class _PinState:
+    """Shared pin count for one get(): 1 owner pin (the PlasmaBuffer object)
+    + one per exported buffer view. The shm refcount is released only when
+    all of them are gone, so zero-copy numpy views deserialized out of the
+    store keep the object pinned against eviction/spilling for their entire
+    lifetime (reference: plasma client buffers pin objects while mapped)."""
+
+    __slots__ = ("pins", "handle_ref", "id_binary", "view")
+
+    def __init__(self, handle_ref, id_binary: bytes, view: memoryview):
+        self.pins = 1
+        self.handle_ref = handle_ref
+        self.id_binary = id_binary
+        self.view = view
+
+    def drop_pin(self):
+        self.pins -= 1
+        if self.pins == 0:
+            self.view.release()
+            handle = self.handle_ref()
+            if handle is not None and handle.value_ptr:
+                _load().shm_release(handle.value_ptr, self.id_binary)
+
+
+class PlasmaBuffer:
+    """Zero-copy handle to a sealed object.
+
+    Exports the buffer protocol (PEP 688): ``memoryview(buf)`` / ``.data``
+    and every slice derived from it holds a pin; the shm refcount drops only
+    after the buffer object *and* all views are gone.
+    """
+
+    __slots__ = ("_view", "_state", "_finalizer", "__weakref__")
+
+    def __init__(self, client: "ShmClient", object_id: ObjectID,
+                 view: memoryview):
+        self._view = view
+        self._state = _PinState(client._lib_handle_ref, object_id.binary(),
+                                view)
+        self._finalizer = weakref.finalize(self, self._state.drop_pin)
+
+    @property
+    def data(self) -> memoryview:
+        return memoryview(self)
+
+    def __buffer__(self, flags) -> memoryview:
+        self._state.pins += 1
+        return self._view[:]
+
+    def __release_buffer__(self, view: memoryview) -> None:
+        view.release()
+        self._state.drop_pin()
+
+    def __len__(self) -> int:
+        return self._view.nbytes
+
+    def release(self):
+        """Drop the owner pin (idempotent); exported views keep their own."""
+        self._finalizer()
+
+
+class _HandleBox:
+    """Keeps the ctypes store handle alive for finalizers after client close."""
+
+    def __init__(self, ptr):
+        self.value_ptr = ptr
+
+
+class ShmClient:
+    def __init__(self, path: str):
+        self.path = path
+        lib = _load()
+        ptr = lib.shm_store_open(path.encode())
+        if not ptr:
+            raise RuntimeError(f"cannot open shm store at {path}")
+        self._handle = _HandleBox(ptr)
+        self._lib_handle_ref = weakref.ref(self._handle)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self._mm = mmap.mmap(fd, 0)
+        finally:
+            os.close(fd)
+        self._mv = memoryview(self._mm)
+
+    @staticmethod
+    def create_store(path: str, capacity: int, n_slots: int = 4096) -> None:
+        rc = _load().shm_store_create(path.encode(), capacity, n_slots)
+        if rc != 0:
+            raise OSError(-rc, f"shm_store_create({path}) failed")
+
+    @property
+    def _ptr(self):
+        return self._handle.value_ptr
+
+    def put_serialized(self, object_id: ObjectID, sobj: SerializedObject) -> bool:
+        """Returns False if the object already exists (idempotent put)."""
+        off = ctypes.c_uint64()
+        rc = _load().shm_create(self._ptr, object_id.binary(),
+                                sobj.total_size, ctypes.byref(off))
+        if rc == ERR_EXISTS:
+            return False
+        if rc == ERR_FULL:
+            raise StoreFullError(
+                f"object of {sobj.total_size} bytes does not fit in store")
+        if rc != OK:
+            raise RuntimeError(f"shm_create failed: {rc}")
+        try:
+            dest = self._mv[off.value: off.value + sobj.total_size]
+            sobj.write_to(dest)
+            dest.release()
+        except BaseException:
+            _load().shm_abort(self._ptr, object_id.binary())
+            raise
+        _load().shm_seal(self._ptr, object_id.binary())
+        # Creator's initial reference: hand it off — the object is now
+        # owned by the distributed refcounter, not this client.
+        _load().shm_release(self._ptr, object_id.binary())
+        return True
+
+    def put_bytes(self, object_id: ObjectID, data: bytes) -> bool:
+        off = ctypes.c_uint64()
+        rc = _load().shm_create(self._ptr, object_id.binary(), len(data),
+                                ctypes.byref(off))
+        if rc == ERR_EXISTS:
+            return False
+        if rc == ERR_FULL:
+            raise StoreFullError(f"object of {len(data)} bytes does not fit")
+        if rc != OK:
+            raise RuntimeError(f"shm_create failed: {rc}")
+        self._mv[off.value: off.value + len(data)] = data
+        _load().shm_seal(self._ptr, object_id.binary())
+        _load().shm_release(self._ptr, object_id.binary())
+        return True
+
+    def get(self, object_id: ObjectID,
+            timeout_ms: int = 0) -> Optional[PlasmaBuffer]:
+        """Pin + return a zero-copy buffer, or None if absent (timeout)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = _load().shm_get(self._ptr, object_id.binary(), timeout_ms,
+                             ctypes.byref(off), ctypes.byref(size))
+        if rc in (ERR_NOT_FOUND, ERR_TIMEOUT):
+            return None
+        if rc != OK:
+            raise RuntimeError(f"shm_get failed: {rc}")
+        view = self._mv[off.value: off.value + size.value]
+        return PlasmaBuffer(self, object_id, view)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return bool(_load().shm_contains(self._ptr, object_id.binary()))
+
+    def delete(self, object_id: ObjectID) -> bool:
+        return _load().shm_delete(self._ptr, object_id.binary()) == OK
+
+    def stats(self) -> dict:
+        vals = [ctypes.c_uint64() for _ in range(4)]
+        _load().shm_stats(self._ptr, *[ctypes.byref(v) for v in vals])
+        return {
+            "bytes_used": vals[0].value,
+            "capacity": vals[1].value,
+            "num_objects": vals[2].value,
+            "num_evictions": vals[3].value,
+        }
+
+    def close(self):
+        ptr = self._handle.value_ptr
+        self._handle.value_ptr = None
+        if ptr:
+            _load().shm_store_close(ptr)
+        try:
+            self._mv.release()
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass  # outstanding zero-copy views keep the mapping alive

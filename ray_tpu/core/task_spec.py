@@ -1,0 +1,138 @@
+"""TaskSpec — the unit shipped from caller to executor.
+
+Equivalent of the reference's TaskSpecification
+(src/ray/common/task/task_spec.h): function descriptor, inlined small args /
+object-ref args, resource demands, scheduling strategy, retry policy, actor
+identity for actor tasks. Wire format is a msgpack dict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+
+NORMAL_TASK = 0
+ACTOR_CREATION_TASK = 1
+ACTOR_TASK = 2
+
+
+@dataclasses.dataclass
+class FunctionDescriptor:
+    """Identifies the callable: module-qualified name + a GCS function-table
+    key holding the pickled definition (reference:
+    python/ray/_private/function_manager.py export scheme)."""
+
+    module: str
+    qualname: str
+    function_key: bytes  # GCS KV key of the pickled function/class
+
+    def to_wire(self) -> list:
+        return [self.module, self.qualname, self.function_key]
+
+    @classmethod
+    def from_wire(cls, w: list) -> "FunctionDescriptor":
+        return cls(w[0], w[1], w[2])
+
+    def display(self) -> str:
+        return f"{self.module}.{self.qualname}"
+
+
+# An argument is either an inlined serialized value ("v") or an object ref
+# ("r") that the executor must resolve from the store. DependencyResolver
+# inlines small owner-local objects before submission (reference:
+# src/ray/core_worker/transport/dependency_resolver.cc).
+ARG_VALUE = 0
+ARG_REF = 1
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    task_type: int
+    function: FunctionDescriptor
+    args: List[Tuple[int, bytes, Optional[str]]]  # (kind, payload|id, owner_addr)
+    num_returns: int
+    resources: Dict[str, float]
+    caller_address: str
+    # scheduling
+    scheduling_strategy: Optional[dict] = None
+    placement_group_id: Optional[PlacementGroupID] = None
+    placement_group_bundle_index: int = -1
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # actor fields
+    actor_id: Optional[ActorID] = None
+    actor_method: str = ""
+    actor_seqno: int = -1
+    actor_creation_spec: Optional[dict] = None  # max_restarts, max_concurrency...
+    # runtime env / options
+    runtime_env: Optional[dict] = None
+    name: str = ""
+    # keyword-argument names: args holds positional args followed by the
+    # kwarg values in this order
+    kwarg_keys: List[str] = dataclasses.field(default_factory=list)
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i)
+                for i in range(self.num_returns)]
+
+    def to_wire(self) -> dict:
+        return {
+            "task_id": self.task_id.binary(),
+            "job_id": self.job_id.binary(),
+            "task_type": self.task_type,
+            "function": self.function.to_wire(),
+            "args": [list(a) for a in self.args],
+            "num_returns": self.num_returns,
+            "resources": self.resources,
+            "caller_address": self.caller_address,
+            "scheduling_strategy": self.scheduling_strategy,
+            "pg_id": self.placement_group_id.binary() if self.placement_group_id else None,
+            "pg_bundle": self.placement_group_bundle_index,
+            "max_retries": self.max_retries,
+            "retry_exceptions": self.retry_exceptions,
+            "actor_id": self.actor_id.binary() if self.actor_id else None,
+            "actor_method": self.actor_method,
+            "actor_seqno": self.actor_seqno,
+            "actor_creation_spec": self.actor_creation_spec,
+            "runtime_env": self.runtime_env,
+            "name": self.name,
+            "kwarg_keys": self.kwarg_keys,
+        }
+
+    @classmethod
+    def from_wire(cls, w: dict) -> "TaskSpec":
+        return cls(
+            task_id=TaskID(w["task_id"]),
+            job_id=JobID(w["job_id"]),
+            task_type=w["task_type"],
+            function=FunctionDescriptor.from_wire(w["function"]),
+            args=[tuple(a) for a in w["args"]],
+            num_returns=w["num_returns"],
+            resources=w["resources"],
+            caller_address=w["caller_address"],
+            scheduling_strategy=w.get("scheduling_strategy"),
+            placement_group_id=PlacementGroupID(w["pg_id"]) if w.get("pg_id") else None,
+            placement_group_bundle_index=w.get("pg_bundle", -1),
+            max_retries=w.get("max_retries", 0),
+            retry_exceptions=w.get("retry_exceptions", False),
+            actor_id=ActorID(w["actor_id"]) if w.get("actor_id") else None,
+            actor_method=w.get("actor_method", ""),
+            actor_seqno=w.get("actor_seqno", -1),
+            actor_creation_spec=w.get("actor_creation_spec"),
+            runtime_env=w.get("runtime_env"),
+            name=w.get("name", ""),
+            kwarg_keys=w.get("kwarg_keys", []),
+        )
+
+    def scheduling_key(self) -> tuple:
+        """Tasks with the same key can reuse each other's worker leases
+        (reference: NormalTaskSubmitter scheduling_key)."""
+        return (
+            self.function.function_key,
+            tuple(sorted(self.resources.items())),
+            self.placement_group_id.binary() if self.placement_group_id else b"",
+        )

@@ -1,0 +1,13 @@
+"""Lazy task/actor DAGs (reference: python/ray/dag/).
+
+``fn.bind(...)`` / ``actor.method.bind(...)`` build a DAG without executing;
+``dag.execute(...)`` submits it. ``experimental_compile`` (compiled graphs
+with preallocated channels, reference python/ray/dag/compiled_dag_node.py)
+lands with the channel layer.
+"""
+
+from ray_tpu.dag.dag_node import (ActorClassNode, ActorMethodNode, DAGNode,
+                                  FunctionNode, InputNode, MultiOutputNode)
+
+__all__ = ["DAGNode", "FunctionNode", "ActorClassNode", "ActorMethodNode",
+           "InputNode", "MultiOutputNode"]

@@ -1,0 +1,114 @@
+"""DAG nodes: build lazily with .bind(), run with .execute().
+
+Reference: python/ray/dag/dag_node.py (DAGNode, ``.bind()``), input_node.py.
+Execution walks the DAG bottom-up, submitting each node as a task/actor call
+whose upstream results are passed as ObjectRefs (so the object store, not
+the driver, carries intermediate data).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DAGNode:
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    def _resolve(self, value, input_value, cache: dict):
+        if isinstance(value, DAGNode):
+            return value._execute(input_value, cache)
+        if isinstance(value, (list, tuple)):
+            return type(value)(self._resolve(v, input_value, cache)
+                               for v in value)
+        return value
+
+    def _resolved_args(self, input_value, cache: dict) -> Tuple[tuple, dict]:
+        args = tuple(self._resolve(a, input_value, cache)
+                     for a in self._bound_args)
+        kwargs = {k: self._resolve(v, input_value, cache)
+                  for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute(self, input_value, cache: dict):
+        if id(self) not in cache:
+            cache[id(self)] = self._execute_impl(input_value, cache)
+        return cache[id(self)]
+
+    def _execute_impl(self, input_value, cache: dict):
+        raise NotImplementedError
+
+    def execute(self, input_value: Any = None):
+        """Submit the DAG; returns the root's ObjectRef(s)."""
+        return self._execute(input_value, {})
+
+    def experimental_compile(self, **kwargs):
+        from ray_tpu.dag.compiled_dag import CompiledDAG
+
+        return CompiledDAG(self, **kwargs)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value passed to ``dag.execute(x)``."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_impl(self, input_value, cache):
+        return input_value
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_impl(self, input_value, cache):
+        args, kwargs = self._resolved_args(input_value, cache)
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class ActorClassNode(DAGNode):
+    def __init__(self, actor_cls, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+        self._handle = None
+
+    def _execute_impl(self, input_value, cache):
+        if self._handle is None:
+            args, kwargs = self._resolved_args(input_value, cache)
+            self._handle = self._actor_cls.remote(*args, **kwargs)
+        return self._handle
+
+
+class ActorMethodNode(DAGNode):
+    def __init__(self, handle_or_node, method: str, args: tuple,
+                 kwargs: dict):
+        super().__init__(args, kwargs)
+        self._target = handle_or_node
+        self._method = method
+
+    def _execute_impl(self, input_value, cache):
+        target = self._target
+        if isinstance(target, DAGNode):
+            target = target._execute(input_value, cache)
+        args, kwargs = self._resolved_args(input_value, cache)
+        return getattr(target, self._method).remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Groups several leaves: execute() returns a list of refs."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__((), {})
+        self._outputs = outputs
+
+    def _execute_impl(self, input_value, cache):
+        return [o._execute(input_value, cache) for o in self._outputs]

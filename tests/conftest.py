@@ -1,0 +1,64 @@
+"""Test fixtures.
+
+Mirrors the reference's python/ray/tests/conftest.py pattern:
+``ray_start_regular`` (:419) boots a real single-node cluster per test
+module; ``ray_start_cluster`` (:500) yields a multi-raylet Cluster.
+
+JAX tests run on a virtual 8-device CPU mesh
+(xla_force_host_platform_device_count) so multi-chip sharding is exercised
+without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "") +
+     " --xla_force_host_platform_device_count=8").strip())
+# Keep worker processes CPU-only and fast to spawn in tests.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    import ray_tpu
+
+    ctx = ray_tpu.init(num_cpus=4,
+                       ignore_reinit_error=True)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu._private.cluster_utils import Cluster
+
+    cluster = Cluster()
+    created = []
+
+    def factory():
+        created.append(cluster)
+        return cluster
+
+    yield factory
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    for c in created:
+        c.shutdown()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, (
+        "tests need xla_force_host_platform_device_count=8; got "
+        f"{len(devices)}")
+    return devices

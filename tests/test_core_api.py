@@ -1,0 +1,212 @@
+"""Core public API tests on a real single-node cluster.
+
+Mirrors the reference's python/ray/tests/test_basic*.py tier.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.serialization import (ActorDiedError, GetTimeoutError,
+                                        RayTaskError)
+
+
+@ray_tpu.remote
+def add(x, y):
+    return x + y
+
+
+@ray_tpu.remote
+def identity(x):
+    return x
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.v = start
+
+    def inc(self, n=1):
+        self.v += n
+        return self.v
+
+    def get(self):
+        return self.v
+
+
+class TestObjects:
+    def test_put_get_small(self, ray_start_regular):
+        ref = ray_tpu.put({"k": [1, 2, 3]})
+        assert ray_tpu.get(ref) == {"k": [1, 2, 3]}
+
+    def test_put_get_large_numpy(self, ray_start_regular):
+        arr = np.random.rand(1000, 1000)  # ~8MB → shm store
+        ref = ray_tpu.put(arr)
+        out = ray_tpu.get(ref)
+        assert np.array_equal(out, arr)
+
+    def test_get_list_preserves_order(self, ray_start_regular):
+        refs = [ray_tpu.put(i) for i in range(20)]
+        assert ray_tpu.get(refs) == list(range(20))
+
+    def test_get_timeout(self, ray_start_regular):
+        @ray_tpu.remote
+        def sleepy():
+            time.sleep(5)
+
+        with pytest.raises(GetTimeoutError):
+            ray_tpu.get(sleepy.remote(), timeout=0.2)
+
+
+class TestTasks:
+    def test_basic(self, ray_start_regular):
+        assert ray_tpu.get(add.remote(1, 2)) == 3
+
+    def test_kwargs(self, ray_start_regular):
+        assert ray_tpu.get(add.remote(x=5, y=6)) == 11
+        assert ray_tpu.get(add.remote(1, y=2)) == 3
+
+    def test_fanout(self, ray_start_regular):
+        refs = [add.remote(i, i) for i in range(100)]
+        assert ray_tpu.get(refs) == [2 * i for i in range(100)]
+
+    def test_ref_args_chain(self, ray_start_regular):
+        a = add.remote(1, 1)
+        b = add.remote(a, 1)
+        c = add.remote(b, b)
+        assert ray_tpu.get(c) == 6
+
+    def test_large_arg_and_return(self, ray_start_regular):
+        arr = np.arange(2_000_000, dtype=np.float32)
+        ref = ray_tpu.put(arr)
+        out_ref = identity.remote(ref)
+        assert np.array_equal(ray_tpu.get(out_ref), arr)
+
+    def test_num_returns(self, ray_start_regular):
+        @ray_tpu.remote(num_returns=3)
+        def three():
+            return 1, 2, 3
+
+        a, b, c = three.remote()
+        assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+    def test_error_propagation(self, ray_start_regular):
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("kaboom")
+
+        with pytest.raises(RayTaskError, match="kaboom"):
+            ray_tpu.get(boom.remote())
+
+    def test_error_through_dependency(self, ray_start_regular):
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("kaboom")
+
+        with pytest.raises(RayTaskError):
+            ray_tpu.get(identity.remote(boom.remote()))
+
+    def test_nested_task_submission(self, ray_start_regular):
+        @ray_tpu.remote
+        def outer(n):
+            return sum(ray_tpu.get([add.remote(i, i) for i in range(n)]))
+
+        assert ray_tpu.get(outer.remote(5), timeout=60) == 20
+
+    def test_options_resources(self, ray_start_regular):
+        assert ray_tpu.get(add.options(num_cpus=2).remote(3, 4)) == 7
+
+    def test_wait(self, ray_start_regular):
+        @ray_tpu.remote
+        def sleepy(t):
+            time.sleep(t)
+            return t
+
+        fast = sleepy.remote(0.01)
+        slow = sleepy.remote(5)
+        ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1,
+                                        timeout=3)
+        assert ready == [fast]
+        assert not_ready == [slow]
+
+
+class TestActors:
+    def test_basic_lifecycle(self, ray_start_regular):
+        c = Counter.remote(5)
+        assert ray_tpu.get(c.inc.remote()) == 6
+        assert ray_tpu.get(c.inc.remote(4)) == 10
+        assert ray_tpu.get(c.get.remote()) == 10
+
+    def test_call_ordering(self, ray_start_regular):
+        c = Counter.remote(0)
+        refs = [c.inc.remote() for _ in range(50)]
+        assert ray_tpu.get(refs) == list(range(1, 51))
+
+    def test_handle_passing(self, ray_start_regular):
+        c = Counter.remote(100)
+
+        @ray_tpu.remote
+        def poke(h):
+            return ray_tpu.get(h.inc.remote())
+
+        assert ray_tpu.get(poke.remote(c), timeout=60) == 101
+
+    def test_named_actor(self, ray_start_regular):
+        from ray_tpu.core.actor import get_actor
+
+        Counter.options(name="shared_counter").remote(7)
+        h = get_actor("shared_counter")
+        assert ray_tpu.get(h.get.remote()) == 7
+
+    def test_actor_error(self, ray_start_regular):
+        @ray_tpu.remote
+        class Fragile:
+            def crash(self):
+                raise RuntimeError("actor method failed")
+
+        f = Fragile.remote()
+        with pytest.raises(RayTaskError, match="actor method failed"):
+            ray_tpu.get(f.crash.remote())
+
+    def test_kill(self, ray_start_regular):
+        c = Counter.remote(0)
+        assert ray_tpu.get(c.inc.remote()) == 1
+        ray_tpu.kill(c)
+        time.sleep(0.5)
+        with pytest.raises(ActorDiedError):
+            ray_tpu.get(c.inc.remote(), timeout=15)
+
+    def test_async_actor(self, ray_start_regular):
+        @ray_tpu.remote
+        class AsyncActor:
+            async def work(self, x):
+                import asyncio
+
+                await asyncio.sleep(0.01)
+                return x * 2
+
+        a = AsyncActor.remote()
+        assert ray_tpu.get(a.work.remote(21)) == 42
+
+    def test_max_concurrency(self, ray_start_regular):
+        @ray_tpu.remote(max_concurrency=4)
+        class Parallel:
+            def block(self, t):
+                time.sleep(t)
+                return 1
+
+        p = Parallel.remote()
+        t0 = time.time()
+        ray_tpu.get([p.block.remote(0.3) for _ in range(4)], timeout=30)
+        assert time.time() - t0 < 1.0  # ran concurrently, not 1.2s serial
+
+
+class TestRuntimeContext:
+    def test_context_fields(self, ray_start_regular):
+        ctx = ray_tpu.get_runtime_context()
+        assert ctx.job_id is not None
+        assert ctx.node_id is not None
+        res = ctx.cluster_resources()
+        assert res["total"].get("CPU", 0) >= 4

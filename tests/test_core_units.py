@@ -1,0 +1,133 @@
+"""Unit tests for IDs, serialization, and the native shm store.
+
+Mirrors the reference's native-layer unit tier (SURVEY.md §4: gtest units
+like cluster_task_manager_test.cc) — no cluster processes involved.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu.core.shm_client import ShmClient, StoreFullError
+
+
+class TestIDs:
+    def test_roundtrip(self):
+        t = TaskID.of(JobID.from_int(7))
+        assert t.job_id().int_value() == 7
+        o = ObjectID.for_task_return(t, 3)
+        assert o.task_id() == t
+        assert o.return_index() == 3
+        assert ObjectID.from_hex(o.hex()) == o
+
+    def test_actor_id_embeds_job(self):
+        a = ActorID.of(JobID.from_int(42))
+        assert a.job_id().int_value() == 42
+
+    def test_nil_and_eq(self):
+        assert JobID.nil().is_nil()
+        assert TaskID.of(JobID.from_int(1)) != TaskID.of(JobID.from_int(1))
+        x = ObjectID.from_random()
+        assert len({x, ObjectID(x.binary())}) == 1
+
+
+class TestSerialization:
+    def test_small_values(self):
+        for v in [1, "x", None, [1, 2], {"a": (1, 2)}, b"bytes", 3.14]:
+            assert ser.loads(ser.dumps(v)) == v
+
+    def test_numpy_zero_copy(self):
+        arr = np.arange(10000, dtype=np.float64).reshape(100, 100)
+        blob = ser.dumps({"w": arr})
+        out = ser.loads(blob)["w"]
+        assert np.array_equal(out, arr)
+        assert out.base is not None  # view, not copy
+
+    def test_error_envelope(self):
+        e = ser.RayTaskError("f", "traceback...", "ValueError('x')")
+        e2 = ser.loads(ser.dumps(e))
+        assert isinstance(e2, ser.RayTaskError)
+        assert e2.function_name == "f"
+
+
+@pytest.fixture
+def store(tmp_path):
+    path = f"/dev/shm/ray_tpu_test_{os.getpid()}_{os.urandom(4).hex()}"
+    ShmClient.create_store(path, 32 << 20, n_slots=256)
+    client = ShmClient(path)
+    yield client
+    client.close()
+    os.unlink(path)
+
+
+class TestShmStore:
+    def test_put_get_roundtrip(self, store):
+        oid = ObjectID.from_random()
+        value = {"x": np.arange(1000), "tag": "hello"}
+        assert store.put_serialized(oid, ser.serialize(value))
+        buf = store.get(oid, timeout_ms=100)
+        out = ser.deserialize(buf.data)
+        assert out["tag"] == "hello"
+        assert np.array_equal(out["x"], value["x"])
+
+    def test_idempotent_put(self, store):
+        oid = ObjectID.from_random()
+        sobj = ser.serialize("v")
+        assert store.put_serialized(oid, sobj)
+        assert not store.put_serialized(oid, ser.serialize("v"))
+
+    def test_missing_and_contains(self, store):
+        oid = ObjectID.from_random()
+        assert store.get(oid) is None
+        assert not store.contains(oid)
+
+    def test_second_client_sees_objects(self, store):
+        oid = ObjectID.from_random()
+        store.put_serialized(oid, ser.serialize([1, 2, 3]))
+        c2 = ShmClient(store.path)
+        try:
+            assert c2.contains(oid)
+            assert ser.deserialize(c2.get(oid).data) == [1, 2, 3]
+        finally:
+            c2.close()
+
+    def test_eviction_under_pressure(self, store):
+        big = np.zeros(8 << 20, dtype=np.uint8)
+        ids = []
+        for _ in range(8):  # 64MB into a 32MB store
+            oid = ObjectID.from_random()
+            store.put_serialized(oid, ser.serialize(big))
+            ids.append(oid)
+        stats = store.stats()
+        assert stats["num_evictions"] > 0
+        assert stats["bytes_used"] <= stats["capacity"]
+        # newest object survives
+        assert store.contains(ids[-1])
+
+    def test_pinned_objects_not_evicted(self, store):
+        oid = ObjectID.from_random()
+        store.put_serialized(oid, ser.serialize(np.zeros(8 << 20,
+                                                         dtype=np.uint8)))
+        pin = store.get(oid)  # holds a reference
+        assert pin is not None
+        for _ in range(8):
+            store.put_serialized(ObjectID.from_random(),
+                                 ser.serialize(np.zeros(4 << 20,
+                                                        dtype=np.uint8)))
+        assert store.contains(oid)  # pinned ⇒ survived the pressure
+        pin.release()
+
+    def test_oversized_object_raises(self, store):
+        with pytest.raises(StoreFullError):
+            store.put_serialized(
+                ObjectID.from_random(),
+                ser.serialize(np.zeros(64 << 20, dtype=np.uint8)))
+
+    def test_delete(self, store):
+        oid = ObjectID.from_random()
+        store.put_serialized(oid, ser.serialize("x"))
+        assert store.delete(oid)
+        assert not store.contains(oid)
