@@ -1,0 +1,111 @@
+"""Headline benchmark: Llama train-step MFU on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+North star (BASELINE.json): >=40% MFU — vs_baseline = MFU / 40%.
+
+The reference publishes no training-throughput numbers (BASELINE.md), so
+this benchmark IS the baseline being established. Model sizing targets a
+single 16 GiB v5e chip; scale-out numbers come from the multi-host train
+library, not this script.
+"""
+
+import json
+import os
+import time
+
+
+PEAK_BF16_FLOPS = {
+    # per-chip dense bf16 peak
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def _detect_peak() -> float:
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in gen:
+            return val
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+        for key, val in PEAK_BF16_FLOPS.items():
+            if key in kind.replace(" ", ""):
+                return val
+        if "v5 lite" in kind or "v5lite" in kind:
+            return PEAK_BF16_FLOPS["v5e"]
+    except Exception:
+        pass
+    return PEAK_BF16_FLOPS["v5e"]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import (LlamaConfig, llama_init, llama_loss,
+                                llama_param_specs)
+    from ray_tpu.models.training import make_sharded_train_step
+    from ray_tpu.models.llama import llama_flops_per_token
+    from ray_tpu.parallel import create_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, dim=1536, n_layers=16, n_heads=12,
+            n_kv_heads=12, ffn_dim=4096, max_seq_len=2048,
+            remat=True, attn_impl="flash")
+        batch_size, seq_len, steps = 8, 2048, 10
+    else:  # smoke mode off-TPU
+        cfg = LlamaConfig.nano()
+        batch_size, seq_len, steps = 4, 128, 3
+
+    devices = jax.devices()[:1] if on_tpu else jax.devices()
+    mesh = create_mesh({"dp": len(devices)}, devices)
+
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    init_fn, step_fn = make_sharded_train_step(
+        lambda p, b: llama_loss(p, b, cfg),
+        optax.adamw(3e-4, weight_decay=0.0),
+        mesh, llama_param_specs(cfg))
+    params, opt_state = init_fn(params)
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch_size, seq_len + 1), 0, cfg.vocab_size)
+    batch = {"tokens": tokens}
+
+    # compile + warmup
+    params, opt_state, metrics = step_fn(params, opt_state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, metrics = step_fn(params, opt_state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch_size * seq_len
+    tokens_per_sec = tokens_per_step * steps / dt
+    flops_per_token = llama_flops_per_token(cfg, seq_len)
+    achieved = tokens_per_sec * flops_per_token / len(devices)
+    peak = _detect_peak()
+    mfu = achieved / peak * 100.0
+
+    print(json.dumps({
+        "metric": "llama_train_mfu_1chip",
+        "value": round(mfu, 2),
+        "unit": "%MFU",
+        "vs_baseline": round(mfu / 40.0, 4),
+        "tokens_per_sec_per_chip": round(tokens_per_sec / len(devices)),
+        "model_params": cfg.num_params(),
+        "backend": jax.default_backend(),
+        "loss": float(metrics["loss"]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""ray_tpu.models — flagship JAX model families.
+
+The reference ships no model code (models are torch user code fed to
+TorchTrainer); here model families are first-class so the train/serve/rllib
+libraries and benchmarks have TPU-native flagships. Llama-2 is the
+north-star benchmark model (BASELINE.md: ≥40% MFU on v5e).
+"""
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    llama_init,
+    llama_forward,
+    llama_loss,
+    llama_param_specs,
+)
+from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_forward
+
+__all__ = [
+    "LlamaConfig",
+    "llama_init",
+    "llama_forward",
+    "llama_loss",
+    "llama_param_specs",
+    "MLPConfig",
+    "mlp_init",
+    "mlp_forward",
+]

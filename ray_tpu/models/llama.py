@@ -1,0 +1,256 @@
+"""Llama-family decoder LM, TPU-first.
+
+Design choices (vs a torch translation):
+- Pure functional: params are a pytree dict; a parallel tree of logical
+  axis names drives GSPMD sharding (ray_tpu.parallel.sharding rules map
+  them onto the dp/fsdp/tp/sp mesh).
+- All layers are stacked and iterated with `lax.scan` ("scanned layers"),
+  so compile time is O(1) in depth and XLA pipelines the weight
+  all-gathers of layer i+1 under the compute of layer i.
+- bf16 activations / f32 master params by default; matmuls hit the MXU.
+- Attention via ray_tpu.ops (Pallas flash attention on TPU; ring
+  attention over the `sp` axis for long context).
+- `jax.checkpoint` (remat) per layer to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.ops import attention
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.parallel.sharding import logical_to_mesh, LogicalAxisRules
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16          # activation dtype
+    param_dtype: Any = jnp.float32     # master weights
+    remat: bool = True
+    attn_impl: str = "auto"            # auto|flash|reference|ring
+    ring_axis: str = "sp"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    # ---- presets ----
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama2_13b(**kw) -> "LlamaConfig":
+        return LlamaConfig(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40,
+                           ffn_dim=13824, **kw)
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, dim=4096, n_layers=32,
+                           n_heads=32, n_kv_heads=8, ffn_dim=14336,
+                           rope_theta=500000.0, max_seq_len=8192, **kw)
+
+    @staticmethod
+    def nano(**kw) -> "LlamaConfig":
+        """Tiny config for tests / dryruns (runs on the CPU mesh)."""
+        defaults = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+                        dtype=jnp.float32, remat=False)
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+    def num_params(self) -> int:
+        d, v, f, L = self.dim, self.vocab_size, self.ffn_dim, self.n_layers
+        hd = self.head_dim
+        attn = d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd \
+            + self.n_heads * hd * d
+        mlp = 3 * d * f
+        return v * d + L * (attn + mlp + 2 * d) + d + d * v
+
+
+# ---------------------------------------------------------------------------
+# Parameter init + sharding specs
+# ---------------------------------------------------------------------------
+
+def _layer_shapes(cfg: LlamaConfig) -> Dict[str, Any]:
+    """name -> (shape, logical axes, fan_in of the contraction)."""
+    d, hd = cfg.dim, cfg.head_dim
+    return {
+        "wq": ((d, cfg.n_heads, hd), ("embed", "heads", "head_dim"), d),
+        "wk": ((d, cfg.n_kv_heads, hd), ("embed", "kv", "head_dim"), d),
+        "wv": ((d, cfg.n_kv_heads, hd), ("embed", "kv", "head_dim"), d),
+        "wo": ((cfg.n_heads, hd, d), ("heads", "head_dim", "embed"),
+               cfg.n_heads * hd),
+        "w_gate": ((d, cfg.ffn_dim), ("embed", "mlp"), d),
+        "w_up": ((d, cfg.ffn_dim), ("embed", "mlp"), d),
+        "w_down": ((cfg.ffn_dim, d), ("mlp", "embed"), cfg.ffn_dim),
+        "attn_norm": ((d,), ("embed",), None),
+        "mlp_norm": ((d,), ("embed",), None),
+    }
+
+
+def llama_init(rng: jax.Array, cfg: LlamaConfig) -> Params:
+    """Stacked-layer param tree: every per-layer leaf has leading [n_layers]."""
+    shapes = _layer_shapes(cfg)
+    keys = jax.random.split(rng, len(shapes) + 3)
+
+    layers = {}
+    for i, (name, (shape, _, fan_in)) in enumerate(shapes.items()):
+        if fan_in is None:  # norm scales
+            layers[name] = jnp.ones((cfg.n_layers,) + shape, cfg.param_dtype)
+        else:
+            layers[name] = (jax.random.normal(
+                keys[i], (cfg.n_layers,) + shape) * fan_in ** -0.5
+                ).astype(cfg.param_dtype)
+    return {
+        "tok_embed": (jax.random.normal(
+            keys[-3], (cfg.vocab_size, cfg.dim)) * 0.02
+            ).astype(cfg.param_dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.dim,), cfg.param_dtype),
+        "lm_head": (jax.random.normal(
+            keys[-1], (cfg.dim, cfg.vocab_size)) * cfg.dim ** -0.5
+            ).astype(cfg.param_dtype),
+    }
+
+
+def llama_logical_specs(cfg: LlamaConfig) -> Params:
+    """Tree of logical-axis tuples matching llama_init's tree."""
+    layer_specs = {name: ("layers",) + logical
+                   for name, (_, logical, _f) in _layer_shapes(cfg).items()}
+    return {
+        "tok_embed": ("vocab", "embed"),
+        "layers": layer_specs,
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def llama_param_specs(cfg: LlamaConfig,
+                      rules: Optional[LogicalAxisRules] = None) -> Params:
+    """Tree of PartitionSpecs for the param tree under the given rules."""
+    return jax.tree_util.tree_map(
+        lambda logical: logical_to_mesh(logical, rules),
+        llama_logical_specs(cfg),
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(e, (str, type(None))) for e in x))
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [B, S, H, D]; rotate pairs (d, d + D/2)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def _attention_call(q, k, v, cfg: LlamaConfig):
+    """q,k,v: [B, S, H, D] -> [B, S, H, D]."""
+    qT = q.transpose(0, 2, 1, 3)
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    if cfg.attn_impl == "ring":
+        out = ring_attention(qT, kT, vT, axis_name=cfg.ring_axis, causal=True)
+    else:
+        out = attention(qT, kT, vT, causal=True, impl=cfg.attn_impl)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _decoder_layer(h: jax.Array, layer: Params, positions: jax.Array,
+                   cfg: LlamaConfig) -> jax.Array:
+    dt = cfg.dtype
+    x = _rmsnorm(h, layer["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", x, layer["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", x, layer["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", x, layer["wv"].astype(dt))
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    o = _attention_call(q, k, v, cfg)
+    h = h + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
+
+    x = _rmsnorm(h, layer["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", x, layer["w_gate"].astype(dt))
+    up = jnp.einsum("bsd,df->bsf", x, layer["w_up"].astype(dt))
+    h = h + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                       layer["w_down"].astype(dt))
+    return h
+
+
+def llama_forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+                  positions: Optional[jax.Array] = None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (float32)."""
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape)
+    h = params["tok_embed"].astype(cfg.dtype)[tokens]
+
+    layer_fn = functools.partial(_decoder_layer, positions=positions, cfg=cfg)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(h, layer):
+        return layer_fn(h, layer), None
+
+    h, _ = jax.lax.scan(scan_body, h, params["layers"])
+    h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", h, params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits
+
+
+def llama_loss(params: Params, batch: Dict[str, jax.Array],
+               cfg: LlamaConfig) -> jax.Array:
+    """Next-token cross-entropy. batch: {'tokens': [B,S]} or
+    {'inputs': [B,S], 'targets': [B,S]} (optional 'mask')."""
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+        mask = batch.get("mask")
+    else:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+        mask = None
+    logits = llama_forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)
+
+
+def llama_flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Approximate training FLOPs/token (fwd+bwd): 6*N + attention term."""
+    n = cfg.num_params()
+    attn = 12 * cfg.n_layers * cfg.dim * seq_len  # causal: *0.5 of full
+    return 6.0 * n + attn * 0.5

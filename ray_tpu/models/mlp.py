@@ -1,0 +1,50 @@
+"""Minimal MLP classifier — the MNIST end-to-end slice model
+(SURVEY.md §7 'minimum end-to-end slice')."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Sequence[int] = (512, 512)
+    n_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+def mlp_init(rng: jax.Array, cfg: MLPConfig) -> Dict[str, Any]:
+    dims = [cfg.in_dim, *cfg.hidden, cfg.n_classes]
+    keys = jax.random.split(rng, len(dims) - 1)
+    return {
+        f"layer{i}": {
+            "w": (jax.random.normal(keys[i], (dims[i], dims[i + 1]))
+                  * dims[i] ** -0.5).astype(cfg.dtype),
+            "b": jnp.zeros((dims[i + 1],), cfg.dtype),
+        }
+        for i in range(len(dims) - 1)
+    }
+
+
+def mlp_forward(params: Dict[str, Any], x: jax.Array,
+                cfg: MLPConfig) -> jax.Array:
+    n = len(params)
+    for i in range(n):
+        layer = params[f"layer{i}"]
+        x = x @ layer["w"] + layer["b"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_loss(params: Dict[str, Any], batch: Dict[str, jax.Array],
+             cfg: MLPConfig) -> jax.Array:
+    logits = mlp_forward(params, batch["x"], cfg)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(
+        jnp.take_along_axis(logp, batch["y"][:, None], axis=-1))

@@ -1,0 +1,18 @@
+"""ray_tpu.ops — TPU compute kernels (Pallas) with pure-JAX fallbacks.
+
+The reference has no kernel layer (it delegates compute to torch/CUDA);
+this package is where the new framework's performance lives: flash
+attention on the MXU, ring attention over the ICI `sp` axis for long
+context (capability absent from the reference — SURVEY.md §5.7).
+"""
+
+from ray_tpu.ops.attention import attention, mha_reference
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.ring_attention import ring_attention
+
+__all__ = [
+    "attention",
+    "mha_reference",
+    "flash_attention",
+    "ring_attention",
+]

@@ -1,0 +1,80 @@
+"""Attention dispatch + pure-JAX reference implementation.
+
+`attention` picks the best implementation for the current backend:
+Pallas flash attention on TPU, an XLA-fused reference elsewhere (CPU
+tests run on the reference path; the Pallas kernel is also unit-tested in
+interpret mode against it).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """[B, Hkv, S, D] -> [B, Hkv*n_rep, S, D] for grouped-query attention."""
+    if n_rep == 1:
+        return k
+    b, hkv, s, d = k.shape
+    k = jnp.broadcast_to(k[:, :, None], (b, hkv, n_rep, s, d))
+    return k.reshape(b, hkv * n_rep, s, d)
+
+
+def mha_reference(q: jax.Array,
+                  k: jax.Array,
+                  v: jax.Array,
+                  *,
+                  causal: bool = True,
+                  sm_scale: Optional[float] = None,
+                  segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Stable-softmax attention. q: [B,H,Sq,D]; k,v: [B,Hkv,Sk,D].
+
+    Computes in float32 regardless of input dtype (bf16 inputs hit the MXU
+    via preferred_element_type), returns q.dtype.
+    """
+    *_, h, sq, d = q.shape
+    hkv = k.shape[1]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    k = _repeat_kv(k, h // hkv)
+    v = _repeat_kv(v, h // hkv)
+    sk = k.shape[2]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    mask = None
+    if causal:
+        qi = jnp.arange(sq)[:, None] + (sk - sq)  # allow kv prefix (decode)
+        ki = jnp.arange(sk)[None, :]
+        mask = qi >= ki
+    if segment_ids is not None:
+        seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        mask = seg if mask is None else (mask[None, None] & seg)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def attention(q: jax.Array,
+              k: jax.Array,
+              v: jax.Array,
+              *,
+              causal: bool = True,
+              sm_scale: Optional[float] = None,
+              impl: str = "auto") -> jax.Array:
+    """Dispatch: impl in {'auto', 'flash', 'reference'}."""
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "reference"
+    if impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)

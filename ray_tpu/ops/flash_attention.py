@@ -1,0 +1,177 @@
+"""Pallas TPU flash attention (forward kernel + recompute backward).
+
+Online-softmax tiling keeps the working set in VMEM and the score matmuls
+on the MXU; the kv-block grid axis iterates fastest so the (m, l, acc)
+scratch accumulators persist across kv blocks for a fixed q block.
+Backward is flash-style recompute in plain JAX under `jax.custom_vjp`
+(XLA fuses it well; a Pallas backward is a later optimization).
+
+Semantics match `ray_tpu.ops.attention.mha_reference` exactly, including
+the kv-prefix causal offset when Sq != Sk (decode) and GQA. Sequence
+lengths that don't divide the block size are zero-padded; padded kv
+columns are masked by global index, padded q rows are sliced off.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                sm_scale: float, causal: bool, block_q: int, block_k: int,
+                q_offset: int, sk_orig: int):
+    """q_offset = sk_orig - sq_orig (kv-prefix shift for decode);
+    sk_orig masks zero-padded kv columns."""
+    i = pl.program_id(2)  # q block
+    j = pl.program_id(3)  # kv block (fastest)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: kv block j contributes iff its first kv index <= the global
+    # position of this q block's last row.
+    should_compute = True
+    if causal:
+        should_compute = (j * block_k
+                          <= q_offset + i * block_q + block_q - 1)
+
+    @pl.when(should_compute)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        qi = q_offset + i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        ki = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = ki < sk_orig  # zero-padded kv columns
+        if causal:
+            mask = mask & (qi >= ki)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:]                      # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[:] = m_new
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        # l == 0 only for zero-padded q rows (sliced off by the caller).
+        l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _pad_seq(x, block):
+    s = x.shape[2]
+    pad = (-s) % block
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    g = h // hkv
+    block_q = min(block_q, max(sq, 1))
+    block_k = min(block_k, max(sk, 1))
+    qp, kp, vp = _pad_seq(q, block_q), _pad_seq(k, block_k), _pad_seq(v,
+                                                                      block_k)
+    sq_p, sk_p = qp.shape[2], kp.shape[2]
+    grid = (b, h, sq_p // block_q, sk_p // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+        q_offset=sk - sq, sk_orig=sk)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j, g=g: (b_, h_ // g, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j, g=g: (b_, h_ // g, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, i, j: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :, :sq] if sq_p != sq else out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret,
+                   residuals, g):
+    from ray_tpu.ops.attention import mha_reference
+
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: mha_reference(
+            q_, k_, v_, causal=causal, sm_scale=sm_scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: jax.Array,
+                    k: jax.Array,
+                    v: jax.Array,
+                    *,
+                    causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """q: [B,H,Sq,D]; k,v: [B,Hkv,Sk,D] (GQA when Hkv < H). -> [B,H,Sq,D]."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, float(sm_scale), bool(causal),
+                  int(block_q), int(block_k), bool(interpret))

@@ -1,0 +1,121 @@
+"""Ring attention — sequence/context parallelism over an ICI mesh axis.
+
+Capability absent from the reference (verified in SURVEY.md §5.7): long
+sequences are sharded over the `sp` mesh axis; each device computes
+blockwise attention for its local q shard while k/v shards rotate around
+the ring via `ppermute`, overlapping compute with ICI transfer. Online
+softmax combines partial results exactly (same math as flash attention).
+
+`ring_attention` is SPMD-internal: call it inside `shard_map`/pjit with
+q,k,v already sharded over `axis_name` on the sequence dim.
+`ring_attention_sharded` wraps it for a given mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, m, l, acc, q_off, k_off, causal, sm_scale):
+    """One online-softmax accumulation step. q:[B,H,Sq,D] k,v:[B,Hkv,Sk,D]."""
+    from ray_tpu.ops.attention import _repeat_kv
+
+    h, hkv = q.shape[1], k.shape[1]
+    k = _repeat_kv(k, h // hkv)
+    v = _repeat_kv(v, h // hkv)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        qi = q_off + jnp.arange(sq)[:, None]
+        ki = k_off + jnp.arange(sk)[None, :]
+        s = jnp.where(qi >= ki, s, _NEG_INF)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    # Guard fully-masked steps: exp(-inf - -inf) -> keep alpha finite.
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q: jax.Array,
+                   k: jax.Array,
+                   v: jax.Array,
+                   *,
+                   axis_name: str = "sp",
+                   causal: bool = True,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Per-shard ring attention. Shapes are LOCAL: q [B,H,S/sp,D]."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, sq_local, d = q.shape
+    sk_local = k.shape[2]
+    q_off = my_idx * sq_local
+
+    m0 = jnp.full((b, h, sq_local, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq_local, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, sq_local, d), jnp.float32)
+
+    # Ring: device i sends its current kv to i+1; after t steps device i
+    # holds the shard originally on (i - t) mod axis_size.
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, t):
+        k_cur, v_cur, m, l, acc = carry
+        src = jax.lax.rem(my_idx - t + axis_size, axis_size)
+        k_off = src * sk_local
+        if causal:
+            # Skip shards entirely in the future of this q shard.
+            relevant = k_off <= q_off + sq_local - 1
+            m, l, acc = jax.lax.cond(
+                relevant,
+                lambda: _block_attn(q, k_cur, v_cur, m, l, acc,
+                                    q_off, k_off, True, sm_scale),
+                lambda: (m, l, acc))
+        else:
+            m, l, acc = _block_attn(q, k_cur, v_cur, m, l, acc,
+                                    q_off, k_off, False, sm_scale)
+        # Skip the rotation on the last step: its output is never consumed,
+        # and the dead ppermute would cost one full kv shard of ICI traffic.
+        k_nxt, v_nxt = jax.lax.cond(
+            t < axis_size - 1,
+            lambda: (jax.lax.ppermute(k_cur, axis_name, perm),
+                     jax.lax.ppermute(v_cur, axis_name, perm)),
+            lambda: (k_cur, v_cur))
+        return (k_nxt, v_nxt, m, l, acc), None
+
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(axis_size))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (non-causal edge)
+    return (acc / l).astype(q.dtype)
+
+
+def ring_attention_sharded(q: jax.Array,
+                           k: jax.Array,
+                           v: jax.Array,
+                           mesh: Mesh,
+                           *,
+                           axis_name: str = "sp",
+                           causal: bool = True,
+                           sm_scale: Optional[float] = None) -> jax.Array:
+    """shard_map wrapper: q,k,v are GLOBAL [B,H,S,D], sharded over seq."""
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)(q, k, v)

@@ -1,0 +1,48 @@
+"""ray_tpu.parallel — GSPMD mesh / sharding / collective layer.
+
+This is the TPU-native replacement for the reference's collective plane
+(python/ray/util/collective/ + torch.distributed process groups set up by
+Ray Train, python/ray/train/torch/config.py:65): instead of NCCL process
+groups, parallelism is expressed as a `jax.sharding.Mesh` with named axes
+(dp / fsdp / tp / sp / ep) plus logical-axis sharding rules, and XLA
+inserts collectives over ICI. Eager host-driven collectives (the
+ray.util.collective API shape) live in `ray_tpu.collective`.
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshSpec,
+    create_mesh,
+    auto_mesh,
+    mesh_shape_for,
+    local_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    LogicalAxisRules,
+    DEFAULT_RULES,
+    logical_to_mesh,
+    spec_for,
+    shard_pytree,
+    with_logical_constraint,
+    named_sharding,
+)
+from ray_tpu.parallel.bootstrap import (
+    initialize_distributed,
+    distributed_info,
+)
+
+__all__ = [
+    "MeshSpec",
+    "create_mesh",
+    "auto_mesh",
+    "mesh_shape_for",
+    "local_mesh",
+    "LogicalAxisRules",
+    "DEFAULT_RULES",
+    "logical_to_mesh",
+    "spec_for",
+    "shard_pytree",
+    "with_logical_constraint",
+    "named_sharding",
+    "initialize_distributed",
+    "distributed_info",
+]

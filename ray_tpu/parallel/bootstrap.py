@@ -1,0 +1,55 @@
+"""Multi-host JAX bootstrap.
+
+Replaces the reference's rank-0 TCP-store rendezvous for
+torch.distributed (python/ray/train/torch/config.py:65-150) with
+`jax.distributed.initialize`: each per-host worker actor in a gang calls
+`initialize_distributed(coordinator, num_processes, process_id)`; the
+train library (ray_tpu.train.JaxBackend) wires the coordinator address the
+same way _TorchBackend wires MASTER_ADDR.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DistributedInfo:
+    coordinator_address: Optional[str]
+    num_processes: int
+    process_id: int
+    local_device_count: int
+    global_device_count: int
+
+
+_initialized = False
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> DistributedInfo:
+    """Idempotent jax.distributed init; no-op for single-process worlds."""
+    global _initialized
+    import jax
+
+    if (num_processes or 1) > 1 and not _initialized:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+        _initialized = True
+    return distributed_info()
+
+
+def distributed_info() -> DistributedInfo:
+    import jax
+
+    return DistributedInfo(
+        coordinator_address=os.environ.get("JAX_COORDINATOR_ADDRESS"),
+        num_processes=jax.process_count(),
+        process_id=jax.process_index(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )

@@ -1,0 +1,128 @@
+"""Device-mesh construction.
+
+TPU-first replacement for the reference's process-group world
+(python/ray/train/torch/config.py:65 `_setup_torch_process_group`): the
+unit of parallelism is a `jax.sharding.Mesh` over named axes, not a flat
+rank list. Axis names follow the scaling-book convention:
+
+- ``dp``   pure data parallelism (params replicated)
+- ``fsdp`` data parallelism with parameter sharding (ZeRO-3 analog —
+           the reference delegates this to torch FSDP,
+           python/ray/train/torch/train_loop_utils.py:184; in GSPMD it is
+           just a mesh axis params are sharded over)
+- ``tp``   tensor (megatron) parallelism
+- ``sp``   sequence/context parallelism (ring attention axis)
+- ``ep``   expert parallelism (MoE)
+
+Mesh axis order matters on hardware: axes that carry the heaviest
+collectives (tp, sp) must map to minor / adjacent ICI dimensions, so they
+come LAST in the axis tuple (jax device order is minor-to-major locality
+in reverse order of the mesh shape tuple's last axes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_ORDER = ("dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh: axis name -> size. -1 means 'absorb remaining'.
+
+    Example::
+
+        MeshSpec(dp=-1, tp=4)   # on 32 devices -> {"dp": 8, "tp": 4}
+    """
+
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        wildcards = [a for a, s in sizes.items() if s == -1]
+        if len(wildcards) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wildcards}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wildcards:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[wildcards[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices, have {n_devices}")
+        return sizes
+
+
+def mesh_shape_for(n_devices: int,
+                   tp: int = 1,
+                   sp: int = 1,
+                   fsdp: Optional[int] = None) -> Dict[str, int]:
+    """Heuristic mesh for n_devices: tp/sp as asked, rest fsdp (or dp)."""
+    rest = n_devices // (tp * sp)
+    if rest * tp * sp != n_devices:
+        raise ValueError(f"tp*sp={tp * sp} must divide n_devices={n_devices}")
+    if fsdp is None:
+        return {"dp": 1, "fsdp": rest, "ep": 1, "sp": sp, "tp": tp}
+    if rest % fsdp:
+        raise ValueError(f"fsdp={fsdp} must divide {rest}")
+    return {"dp": rest // fsdp, "fsdp": fsdp, "ep": 1, "sp": sp, "tp": tp}
+
+
+def create_mesh(axis_sizes: Dict[str, int],
+                devices: Optional[Sequence] = None,
+                allow_split_physical_axes: bool = False):
+    """Build a `jax.sharding.Mesh` with AXIS_ORDER-ordered named axes.
+
+    Uses `mesh_utils.create_device_mesh` when the full device set is used so
+    the logical mesh is laid out along physical ICI topology (keeps tp/sp
+    collectives on-wire neighbors); falls back to reshape for subsets.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    names = tuple(a for a in AXIS_ORDER if axis_sizes.get(a, 1) >= 1)
+    shape = tuple(axis_sizes.get(a, 1) for a in names)
+    if math.prod(shape) != len(devices):
+        raise ValueError(
+            f"mesh shape {dict(zip(names, shape))} != {len(devices)} devices")
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=devices,
+            allow_split_physical_axes=allow_split_physical_axes)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def auto_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence] = None):
+    """Mesh from a MeshSpec (default: all devices on the fsdp axis)."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = MeshSpec(fsdp=-1)
+    return create_mesh(spec.resolve(len(devices)), devices)
+
+
+def local_mesh():
+    """Single-process mesh over addressable devices, all on fsdp."""
+    import jax
+
+    devs = jax.local_devices()
+    return create_mesh({"fsdp": len(devs)}, devs)

@@ -1,0 +1,92 @@
+"""Logical-axis sharding rules.
+
+GSPMD subsumes the reference's DDP/FSDP wrapper utilities
+(python/ray/train/torch/train_loop_utils.py:158 `prepare_model`): instead
+of wrapping a model, arrays carry logical axis names ("batch", "embed",
+"heads", ...) and a rule table maps logical axes to mesh axes. This is the
+idiom used by t5x/maxtext-style JAX trainers and is the natural TPU form.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicate)
+LogicalAxisRules = Dict[str, Union[None, str, Tuple[str, ...]]]
+
+# Default rule table for transformer LMs. Batch is split over every
+# data-ish axis; embed over fsdp (ZeRO-3 analog); heads/mlp over tp;
+# sequence over sp (ring attention); experts over ep.
+DEFAULT_RULES: LogicalAxisRules = {
+    "batch": ("dp", "fsdp"),
+    "embed": "fsdp",
+    "mlp": "tp",
+    "heads": "tp",
+    "kv": None,
+    "head_dim": None,
+    "qkv": "tp",
+    "vocab": "tp",
+    "length": "sp",
+    "expert": "ep",
+    "layers": None,
+    "stage": None,
+}
+
+
+def logical_to_mesh(logical_axes: Sequence[Optional[str]],
+                    rules: Optional[LogicalAxisRules] = None) -> P:
+    """('batch','length','embed') -> PartitionSpec(('dp','fsdp'),'sp','fsdp')."""
+    rules = DEFAULT_RULES if rules is None else rules
+    out = []
+    used = set()
+    for ax in logical_axes:
+        mesh_ax = rules.get(ax) if ax is not None else None
+        # A mesh axis may appear only once in a PartitionSpec; later logical
+        # axes that map to an already-used mesh axis replicate instead.
+        if mesh_ax is None:
+            out.append(None)
+            continue
+        axes = (mesh_ax,) if isinstance(mesh_ax, str) else tuple(mesh_ax)
+        axes = tuple(a for a in axes if a not in used)
+        used.update(axes)
+        if not axes:
+            out.append(None)
+        elif len(axes) == 1:
+            out.append(axes[0])
+        else:
+            out.append(axes)
+    return P(*out)
+
+
+def spec_for(*logical_axes: Optional[str],
+             rules: Optional[LogicalAxisRules] = None) -> P:
+    return logical_to_mesh(logical_axes, rules)
+
+
+def named_sharding(mesh: Mesh, *logical_axes: Optional[str],
+                   rules: Optional[LogicalAxisRules] = None) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_mesh(logical_axes, rules))
+
+
+def shard_pytree(tree, spec_tree, mesh: Mesh):
+    """Device-put a pytree according to a matching tree of PartitionSpecs."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree, spec_tree)
+
+
+def with_logical_constraint(x, *logical_axes: Optional[str],
+                            rules: Optional[LogicalAxisRules] = None,
+                            mesh: Optional[Mesh] = None):
+    """`lax.with_sharding_constraint` via logical names; no-op outside jit
+    when no mesh is available."""
+    spec = logical_to_mesh(logical_axes, rules)
+    if mesh is not None:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, TypeError):
+        return x

@@ -11,14 +11,21 @@ without TPU hardware.
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault(
-    "XLA_FLAGS",
+# Force CPU even when a TPU tunnel is present: the suite exercises sharding
+# semantics on the 8-device virtual mesh; kernels are tested in interpret
+# mode (real-TPU numerics are covered by bench.py, not pytest). The axon
+# sitecustomize imports jax and latches JAX_PLATFORMS before conftest runs,
+# so env vars alone are not enough — override via jax.config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
     (os.environ.get("XLA_FLAGS", "") +
      " --xla_force_host_platform_device_count=8").strip())
 # Keep worker processes CPU-only and fast to spawn in tests.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

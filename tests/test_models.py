@@ -1,0 +1,122 @@
+"""Model-layer tests on the 8-device CPU mesh: forward shapes, sharded
+train step convergence, graft entry points."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def test_llama_forward_shapes():
+    from ray_tpu.models import LlamaConfig, llama_init, llama_forward
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = llama_forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_llama_causality():
+    """Changing a future token must not change past logits."""
+    from ray_tpu.models import LlamaConfig, llama_init, llama_forward
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    t1 = jnp.zeros((1, 16), jnp.int32)
+    t2 = t1.at[0, 10].set(7)
+    l1 = llama_forward(params, t1, cfg)
+    l2 = llama_forward(params, t2, cfg)
+    np.testing.assert_allclose(l1[0, :10], l2[0, :10], atol=1e-5)
+    assert not np.allclose(l1[0, 10:], l2[0, 10:])
+
+
+def test_sharded_train_step_loss_decreases():
+    import optax
+
+    from ray_tpu.models import (LlamaConfig, llama_init, llama_loss,
+                                llama_param_specs)
+    from ray_tpu.models.training import make_sharded_train_step
+    from ray_tpu.parallel import MeshSpec, create_mesh
+
+    cfg = LlamaConfig.nano()
+    mesh = create_mesh(MeshSpec(dp=2, fsdp=2, tp=2).resolve(8))
+    init_fn, step_fn = make_sharded_train_step(
+        lambda p, b: llama_loss(p, b, cfg),
+        optax.adamw(1e-2), mesh, llama_param_specs(cfg))
+    params, opt_state = init_fn(llama_init(jax.random.PRNGKey(0), cfg))
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens}
+    losses = []
+    for _ in range(5):
+        params, opt_state, metrics = step_fn(params, opt_state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+
+    # param sharding actually applied
+    leaf = params["layers"]["w_gate"]
+    assert len(leaf.sharding.device_set) == 8
+
+
+def test_ring_attention_in_model():
+    """attn_impl='ring' under shard_map matches reference forward."""
+    import functools
+
+    from ray_tpu.models import LlamaConfig, llama_init, llama_forward
+    from ray_tpu.parallel import create_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg_ref = LlamaConfig.nano(n_layers=1, n_kv_heads=4)
+    cfg_ring = LlamaConfig.nano(n_layers=1, n_kv_heads=4, attn_impl="ring")
+    params = llama_init(jax.random.PRNGKey(0), cfg_ref)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                cfg_ref.vocab_size)
+
+    mesh = create_mesh({"sp": 4}, jax.devices()[:4])
+
+    def fwd(params, tokens, positions):
+        return llama_forward(params, tokens, cfg_ring, positions=positions)
+
+    positions = jnp.broadcast_to(jnp.arange(32), (2, 32))
+    shard = jax.shard_map(
+        fwd, mesh=mesh,
+        in_specs=(P(), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"), check_vma=False)
+    out_ring = shard(params, tokens, positions)
+    out_ref = llama_forward(params, tokens, cfg_ref)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_graft_entry():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.ndim == 3
+    ge.dryrun_multichip(8)
+
+
+def test_mlp():
+    import optax
+
+    from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_loss
+
+    cfg = MLPConfig(in_dim=16, hidden=(32,), n_classes=4)
+    params = mlp_init(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+    y = jnp.arange(8) % 4
+    opt = optax.adam(1e-2)
+    state = opt.init(params)
+    loss0 = None
+    for _ in range(20):
+        loss, grads = jax.value_and_grad(mlp_loss)(params, {"x": x, "y": y},
+                                                   cfg)
+        updates, state = opt.update(grads, state)
+        import optax as _o
+        params = _o.apply_updates(params, updates)
+        loss0 = loss0 if loss0 is not None else float(loss)
+    assert float(loss) < loss0
