@@ -120,6 +120,11 @@ class Node:
         env = {**os.environ, "RAY_TPU_CONFIG_JSON": self.config.to_json()}
         env["PYTHONPATH"] = pkg_root + (
             ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        # Ship the driver's sys.path so workers can unpickle functions
+        # defined in driver-side modules (reference: JobConfig
+        # py_driver_sys_path propagated to default_worker.py).
+        env.setdefault("RAY_TPU_DRIVER_SYS_PATH",
+                       ":".join(p for p in sys.path if p))
         # Control-plane processes never touch JAX; skip the TPU plugin
         # registration hook (sitecustomize) that would import jax (~2s).
         # The raylet restores it for worker processes on TPU nodes.

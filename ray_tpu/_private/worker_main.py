@@ -19,6 +19,14 @@ def main() -> None:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s worker %(levelname)s %(message)s")
+    # Driver sys.path (shipped via the raylet) so functions pickled by
+    # reference from driver-side modules (e.g. test files) import here.
+    import sys
+
+    for p in reversed(
+            os.environ.get("RAY_TPU_DRIVER_SYS_PATH", "").split(":")):
+        if p and p not in sys.path:
+            sys.path.insert(0, p)
     from ray_tpu.core.config import Config
     from ray_tpu.core.ids import NodeID, WorkerID
     from ray_tpu._private.core_worker import WORKER, CoreWorker

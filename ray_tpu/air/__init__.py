@@ -1,0 +1,20 @@
+"""ray_tpu.air — shared config/result types for the AI libraries.
+
+Parity with python/ray/air/config.py and result.py in the reference.
+"""
+
+from ray_tpu.air.config import (
+    ScalingConfig,
+    RunConfig,
+    FailureConfig,
+    CheckpointConfig,
+)
+from ray_tpu.air.result import Result
+
+__all__ = [
+    "ScalingConfig",
+    "RunConfig",
+    "FailureConfig",
+    "CheckpointConfig",
+    "Result",
+]

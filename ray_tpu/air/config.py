@@ -1,0 +1,75 @@
+"""Run/scaling/failure/checkpoint configs.
+
+Reference: python/ray/air/config.py (`ScalingConfig` :170, `RunConfig`,
+`FailureConfig`, `CheckpointConfig`). TPU-first addition: `topology` — a
+pod-slice spec that makes the trainer lease whole slices atomically via
+`slice_placement_group` instead of independent per-worker bundles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each one holds.
+
+    num_workers: worker actors (for TPU, one per host).
+    use_tpu: workers request TPU chips and the gang is slice-atomic.
+    chips_per_worker: TPU chips per host (v5e host = 4 or 8).
+    topology: optional slice topology string (e.g. "v5e-64"); when set,
+        placement is slice-atomic gang scheduling.
+    resources_per_worker: extra custom resources per worker bundle.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 4
+    topology: Optional[str] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_workers * self.chips_per_worker if self.use_tpu else 0
+
+    def bundle(self) -> Dict[str, float]:
+        b: Dict[str, float] = {"CPU": 1.0}
+        if self.use_tpu:
+            b["TPU"] = float(self.chips_per_worker)
+        if self.resources_per_worker:
+            b.update(self.resources_per_worker)
+        return b
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: trial restarts from the latest checkpoint; -1 = inf."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Top-K retention by a result metric (reference CheckpointConfig)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")

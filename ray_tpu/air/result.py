@@ -1,0 +1,20 @@
+"""Result of a training/tuning run (reference python/ray/air/result.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Any] = None          # ray_tpu.train.Checkpoint
+    error: Optional[BaseException] = None
+    path: Optional[str] = None
+    metrics_dataframe: Optional[Any] = None
+    best_checkpoints: Optional[List[Tuple[Any, Dict[str, Any]]]] = None
+
+    @property
+    def config(self) -> Optional[Dict[str, Any]]:
+        return self.metrics.get("config") if self.metrics else None

@@ -1,0 +1,32 @@
+"""ray_tpu.data — streaming data engine (host-side, feeds TPU workers).
+
+Parity map to the reference (python/ray/data/):
+- Dataset lazy API        <- dataset.py:383 (map_batches), :3668
+  (iter_batches), :4615 (materialize), :1236 (streaming_split)
+- StreamingExecutor       <- _internal/execution/streaming_executor.py:48
+- Blocks (Arrow)          <- block.py + _internal/arrow_block.py
+- read_api                <- read_api.py:327,621
+TPU-native addition: Dataset.iter_jax_batches(sharding=...) device-puts
+batches straight onto a mesh sharding.
+"""
+
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import (DataIterator, Dataset, from_arrow,
+                                  from_items, from_numpy, from_pandas,
+                                  range, read_csv, read_json, read_parquet,
+                                  read_text)
+
+__all__ = [
+    "DataContext",
+    "DataIterator",
+    "Dataset",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_csv",
+    "read_json",
+    "read_parquet",
+    "read_text",
+]

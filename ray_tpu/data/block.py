@@ -1,0 +1,128 @@
+"""Block — the unit of data movement. Arrow-backed.
+
+Reference: python/ray/data/block.py (+ _internal/arrow_block.py): a block
+is an immutable pyarrow.Table shipped by ObjectRef between operators;
+accessors convert to/from rows, numpy, pandas, and build batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+BatchFormat = Union[str]  # "numpy" | "pandas" | "pyarrow" | "rows"
+
+
+def block_from_items(items: List[Any]) -> Block:
+    if items and isinstance(items[0], dict):
+        cols: Dict[str, List[Any]] = {k: [] for k in items[0]}
+        for row in items:
+            for k in cols:
+                cols[k].append(row.get(k))
+        return pa.table(cols)
+    return pa.table({"item": list(items)})
+
+
+def block_from_numpy(arrays: Dict[str, np.ndarray]) -> Block:
+    cols = {}
+    for k, v in arrays.items():
+        v = np.asarray(v)
+        if v.ndim <= 1:
+            cols[k] = pa.array(v)
+        else:
+            # tensor column: fixed-shape list-of-lists
+            flat = v.reshape(len(v), -1)
+            cols[k] = pa.FixedSizeListArray.from_arrays(
+                pa.array(flat.reshape(-1)), flat.shape[1])
+    return pa.table(cols)
+
+
+def block_from_pandas(df) -> Block:
+    return pa.Table.from_pandas(df, preserve_index=False)
+
+
+def block_num_rows(block: Block) -> int:
+    return block.num_rows
+
+
+def block_size_bytes(block: Block) -> int:
+    return block.nbytes
+
+
+def block_slice(block: Block, start: int, end: int) -> Block:
+    return block.slice(start, end - start)
+
+
+def block_to_rows(block: Block) -> List[Dict[str, Any]]:
+    return block.to_pylist()
+
+
+def block_to_numpy(block: Block) -> Dict[str, np.ndarray]:
+    out = {}
+    for name in block.column_names:
+        col = block.column(name)
+        if pa.types.is_fixed_size_list(col.type):
+            width = col.type.list_size
+            flat = col.combine_chunks().flatten().to_numpy(
+                zero_copy_only=False)
+            out[name] = flat.reshape(block.num_rows, width)
+        else:
+            out[name] = col.to_numpy(zero_copy_only=False)
+    return out
+
+
+def block_to_pandas(block: Block):
+    return block.to_pandas()
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if b is not None and b.num_rows >= 0]
+    if not blocks:
+        return pa.table({})
+    return pa.concat_tables(blocks, promote_options="default")
+
+
+def format_batch(block: Block, batch_format: str):
+    if batch_format in ("numpy", "np", "default"):
+        return block_to_numpy(block)
+    if batch_format in ("pandas", "pd"):
+        return block_to_pandas(block)
+    if batch_format in ("pyarrow", "arrow"):
+        return block
+    if batch_format == "rows":
+        return block_to_rows(block)
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def batch_to_block(batch) -> Block:
+    """Normalize a UDF's output batch back into a Block."""
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        return block_from_numpy(
+            {k: np.asarray(v) for k, v in batch.items()})
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return block_from_pandas(batch)
+    except ImportError:
+        pass
+    if isinstance(batch, list):
+        return block_from_items(batch)
+    raise TypeError(f"cannot convert batch of type {type(batch)} to block")
+
+
+def iter_block_batches(block: Block, batch_size: Optional[int],
+                       batch_format: str) -> Iterator[Any]:
+    if batch_size is None or batch_size >= block.num_rows:
+        if block.num_rows:
+            yield format_batch(block, batch_format)
+        return
+    for start in range(0, block.num_rows, batch_size):
+        yield format_batch(
+            block.slice(start, min(batch_size, block.num_rows - start)),
+            batch_format)

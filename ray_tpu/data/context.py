@@ -1,0 +1,25 @@
+"""DataContext — execution knobs (reference python/ray/data/context.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+
+@dataclasses.dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    max_tasks_in_flight: int = 16
+    default_batch_format: str = "numpy"
+    actor_pool_size: int = 2
+    verbose_progress: bool = False
+
+    _local = threading.local()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        ctx = getattr(cls._local, "ctx", None)
+        if ctx is None:
+            ctx = cls()
+            cls._local.ctx = ctx
+        return ctx

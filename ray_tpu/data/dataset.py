@@ -1,0 +1,528 @@
+"""Dataset — the lazy public API.
+
+Reference: python/ray/data/dataset.py (`map_batches` :383, `iter_batches`
+:3668, `materialize` :4615, `streaming_split` :1236) and read_api.py.
+Execution is deferred until iteration/materialization and runs on the
+streaming executor (executor.py).
+"""
+
+from __future__ import annotations
+
+import builtins
+import random as _random
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import datasource
+from ray_tpu.data.block import (Block, batch_to_block, block_from_items,
+                                block_to_numpy, block_to_pandas,
+                                block_to_rows, concat_blocks, format_batch,
+                                iter_block_batches)
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.executor import (AllToAllStage, MapStage,
+                                   StreamingExecutor)
+
+
+class Dataset:
+    def __init__(self, read_tasks: List[Callable[[], Block]],
+                 stages: Optional[List[Any]] = None):
+        self._read_tasks = read_tasks
+        self._stages = stages or []
+
+    # ---------------- transformations (lazy) ----------------
+    def _with(self, stage) -> "Dataset":
+        return Dataset(self._read_tasks, self._stages + [stage])
+
+    def map_batches(self, fn: Union[Callable, type], *,
+                    batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
+                    compute: Optional[str] = None,
+                    concurrency: Optional[int] = None,
+                    fn_constructor_args: tuple = (),
+                    **_ignored) -> "Dataset":
+        """Apply fn to batches. Class UDFs run on an actor pool."""
+        if isinstance(fn, type):
+            pool = concurrency or DataContext.get_current().actor_pool_size
+            ctor_args = fn_constructor_args
+
+            def make():
+                return fn(*ctor_args)
+
+            def apply(callable_obj, block: Block) -> Block:
+                out = []
+                for batch in iter_block_batches(block, batch_size,
+                                                batch_format):
+                    out.append(batch_to_block(callable_obj(batch)))
+                return concat_blocks(out)
+
+            return self._with(MapStage(
+                f"MapBatches({fn.__name__})", apply,
+                compute=("actors", pool, make)))
+
+        def transform(block: Block, _fn=fn) -> Block:
+            out = []
+            for batch in iter_block_batches(block, batch_size, batch_format):
+                out.append(batch_to_block(_fn(batch)))
+            return concat_blocks(out)
+
+        return self._with(MapStage(f"MapBatches({_name(fn)})", transform))
+
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        def transform(block: Block) -> Block:
+            return block_from_items([fn(r) for r in block_to_rows(block)])
+        return self._with(MapStage(f"Map({_name(fn)})", transform))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        def transform(block: Block) -> Block:
+            rows: List[Dict] = []
+            for r in block_to_rows(block):
+                rows.extend(fn(r))
+            return block_from_items(rows)
+        return self._with(MapStage(f"FlatMap({_name(fn)})", transform))
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        def transform(block: Block) -> Block:
+            rows = [r for r in block_to_rows(block) if fn(r)]
+            if not rows:
+                return block.slice(0, 0)
+            return block_from_items(rows)
+        return self._with(MapStage(f"Filter({_name(fn)})", transform))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def transform(batch):
+            batch[name] = fn(batch)
+            return batch
+        return self.map_batches(transform, batch_format="pandas")
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def transform(block: Block) -> Block:
+            return block.drop_columns([c for c in cols
+                                       if c in block.column_names])
+        return self._with(MapStage(f"DropColumns({cols})", transform))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def transform(block: Block) -> Block:
+            return block.select(cols)
+        return self._with(MapStage(f"SelectColumns({cols})", transform))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def transform(block: Block) -> Block:
+            return block.rename_columns(
+                [mapping.get(c, c) for c in block.column_names])
+        return self._with(MapStage("RenameColumns", transform))
+
+    # ---------------- all-to-all ----------------
+    def repartition(self, num_blocks: int) -> "Dataset":
+        def exchange(blocks: List[Block]) -> List[Block]:
+            total = concat_blocks(blocks)
+            n = total.num_rows
+            if n == 0:
+                return [total]
+            step = (n + num_blocks - 1) // num_blocks
+            return [total.slice(i, min(step, n - i))
+                    for i in builtins.range(0, n, step)]
+        return self._with(AllToAllStage(f"Repartition({num_blocks})",
+                                        exchange))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        def exchange(blocks: List[Block]) -> List[Block]:
+            total = concat_blocks(blocks)
+            n = total.num_rows
+            rng = np.random.RandomState(seed)
+            perm = rng.permutation(n)
+            shuffled = total.take(perm)
+            k = max(1, len(blocks))
+            step = (n + k - 1) // k if n else 1
+            return [shuffled.slice(i, min(step, n - i))
+                    for i in builtins.range(0, n, step)]
+        return self._with(AllToAllStage("RandomShuffle", exchange))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        def exchange(blocks: List[Block]) -> List[Block]:
+            total = concat_blocks(blocks)
+            order = "descending" if descending else "ascending"
+            return [total.sort_by([(key, order)])]
+        return self._with(AllToAllStage(f"Sort({key})", exchange))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # ---------------- combining ----------------
+    def union(self, *others: "Dataset") -> "Dataset":
+        if self._stages:
+            return self.materialize().union(*others)
+        tasks = list(self._read_tasks)
+        for o in others:
+            tasks += o._read_tasks if not o._stages else \
+                o.materialize()._read_tasks
+        return Dataset(tasks)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        left = self.materialize()._read_tasks
+        right = other.materialize()._read_tasks
+
+        def exchange(blocks: List[Block]) -> List[Block]:
+            import pyarrow as pa
+
+            lt = concat_blocks([t() for t in left])
+            rt = concat_blocks([t() for t in right])
+            if lt.num_rows != rt.num_rows:
+                raise ValueError("zip requires equal row counts")
+            cols = {c: lt.column(c) for c in lt.column_names}
+            for c in rt.column_names:
+                name = c if c not in cols else f"{c}_1"
+                cols[name] = rt.column(c)
+            return [pa.table(cols)]
+
+        return Dataset([lambda: concat_blocks([])],
+                       [AllToAllStage("Zip", exchange)])
+
+    def limit(self, n: int) -> "Dataset":
+        from ray_tpu.data.executor import LimitStage
+
+        return self._with(LimitStage(n))
+
+    # ---------------- execution ----------------
+    def iter_block_refs(self) -> Iterator[Any]:
+        return StreamingExecutor().execute(self._read_tasks, self._stages)
+
+    def iter_blocks(self) -> Iterator[Block]:
+        for ref in self.iter_block_refs():
+            yield ray_tpu.get(ref)
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None
+                     ) -> Iterator[Any]:
+        if local_shuffle_buffer_size:
+            # Real shuffle buffer: accumulate >= buffer_size rows, shuffle,
+            # drain down to buffer_size/2, refill (reference
+            # _internal/block_batching shuffle-buffer semantics).
+            rng = np.random.RandomState(local_shuffle_seed)
+            buf: Optional[Block] = None
+            bs = batch_size or 256
+            low = max(local_shuffle_buffer_size // 2, bs)
+            for block in self.iter_blocks():
+                buf = block if buf is None else concat_blocks([buf, block])
+                if buf.num_rows >= local_shuffle_buffer_size:
+                    buf = buf.take(rng.permutation(buf.num_rows))
+                    start = 0
+                    while buf.num_rows - start >= low + bs:
+                        yield format_batch(buf.slice(start, bs),
+                                           batch_format)
+                        start += bs
+                    buf = buf.slice(start, buf.num_rows - start)
+            if buf is not None and buf.num_rows:
+                buf = buf.take(rng.permutation(buf.num_rows))
+                start = 0
+                while buf.num_rows - start >= bs:
+                    yield format_batch(buf.slice(start, bs), batch_format)
+                    start += bs
+                if buf.num_rows - start and not drop_last:
+                    yield format_batch(
+                        buf.slice(start, buf.num_rows - start), batch_format)
+            return
+        carry: Optional[Block] = None
+        for block in self.iter_blocks():
+            if carry is not None and carry.num_rows:
+                block = concat_blocks([carry, block])
+                carry = None
+            if batch_size is None:
+                if block.num_rows:
+                    yield format_batch(block, batch_format)
+                continue
+            start = 0
+            while block.num_rows - start >= batch_size:
+                yield format_batch(block.slice(start, batch_size),
+                                   batch_format)
+                start += batch_size
+            if start < block.num_rows:
+                carry = block.slice(start, block.num_rows - start)
+        if carry is not None and carry.num_rows and not drop_last:
+            yield format_batch(carry, batch_format)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self.iter_blocks():
+            yield from block_to_rows(block)
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           **kwargs) -> Iterator[Any]:
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kwargs):
+            yield {k: torch.as_tensor(v) for k, v in batch.items()}
+
+    def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
+                         sharding=None, **kwargs) -> Iterator[Any]:
+        """TPU-native iterator: numpy batches device_put onto a sharding."""
+        import jax
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kwargs):
+            if sharding is not None:
+                yield {k: jax.device_put(v, sharding)
+                       for k, v in batch.items()}
+            else:
+                yield {k: jax.numpy.asarray(v) for k, v in batch.items()}
+
+    def streaming_split(self, n: int, *, equal: bool = False
+                        ) -> List["DataIterator"]:
+        """n iterators fed by ONE streaming execution inside a coordinator
+        actor (reference dataset.py:1236 + _internal/execution/
+        streaming_executor — the SplitCoordinator actor pattern). Blocks
+        are produced on demand with per-split backpressure; each train
+        worker consumes one split."""
+        coordinator = _SplitCoordinator.options(max_concurrency=n + 1).remote(
+            self._read_tasks, self._stages, n)
+        return [DataIterator(coordinator=coordinator, split_index=i)
+                for i in builtins.range(n)]
+
+    def materialize(self) -> "Dataset":
+        blocks = [ray_tpu.get(r) for r in self.iter_block_refs()]
+
+        def make(b: Block):
+            return lambda: b
+        return Dataset([make(b) for b in blocks])
+
+    # ---------------- consumption ----------------
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(b.num_rows for b in self.iter_blocks())
+
+    def schema(self):
+        for b in self.iter_blocks():
+            return b.schema
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s else []
+
+    def to_pandas(self):
+        return block_to_pandas(concat_blocks(list(self.iter_blocks())))
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        return block_to_numpy(concat_blocks(list(self.iter_blocks())))
+
+    def stats(self) -> str:
+        return f"Dataset(read_tasks={len(self._read_tasks)}, " \
+               f"stages={[getattr(s, 'name', '?') for s in self._stages]})"
+
+    def __repr__(self) -> str:
+        return self.stats()
+
+
+@ray_tpu.remote
+class _SplitCoordinator:
+    """Owns ONE streaming execution, fans blocks out to n bounded queues.
+
+    The per-split queues (maxsize=2) give backpressure: the producer thread
+    stalls when consumers fall behind, which in turn stalls upstream task
+    submission via the executor's bounded in-flight window."""
+
+    def __init__(self, read_tasks, stages, n: int):
+        import queue as _q
+        import threading as _t
+
+        from ray_tpu.data.executor import StreamingExecutor
+
+        self._queues = [_q.Queue(maxsize=2) for _ in builtins.range(n)]
+        self._n = n
+
+        def produce():
+            try:
+                i = 0
+                for ref in StreamingExecutor().execute(read_tasks, stages):
+                    block = ray_tpu.get(ref)
+                    self._queues[i % n].put(("block", block))
+                    i += 1
+            except BaseException as e:  # surface to all consumers
+                for q in self._queues:
+                    q.put(("error", repr(e)))
+                return
+            for q in self._queues:
+                q.put(("done", None))
+
+        self._producer = _t.Thread(target=produce, daemon=True)
+        self._producer.start()
+
+    def get_next(self, split_index: int):
+        kind, payload = self._queues[split_index].get()
+        if kind == "error":
+            raise RuntimeError(f"streaming_split producer failed: {payload}")
+        return payload  # Block or None when done
+
+
+class DataIterator:
+    """One split of a streaming_split — iterable on a remote worker.
+    Holds either a coordinator actor handle (streaming) or a fixed list of
+    block refs (materialized)."""
+
+    def __init__(self, block_refs: Optional[List[Any]] = None,
+                 coordinator=None, split_index: int = 0):
+        self._refs = block_refs
+        self._coordinator = coordinator
+        self._split_index = split_index
+
+    def _iter_local_blocks(self) -> Iterator[Block]:
+        if self._coordinator is not None:
+            while True:
+                block = ray_tpu.get(
+                    self._coordinator.get_next.remote(self._split_index))
+                if block is None:
+                    return
+                yield block
+        else:
+            for ref in self._refs or []:
+                yield ray_tpu.get(ref)
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        carry: Optional[Block] = None
+        for block in self._iter_local_blocks():
+            if carry is not None and carry.num_rows:
+                block = concat_blocks([carry, block])
+                carry = None
+            if batch_size is None:
+                if block.num_rows:
+                    yield format_batch(block, batch_format)
+                continue
+            start = 0
+            while block.num_rows - start >= batch_size:
+                yield format_batch(block.slice(start, batch_size),
+                                   batch_format)
+                start += batch_size
+            if start < block.num_rows:
+                carry = block.slice(start, block.num_rows - start)
+        if carry is not None and carry.num_rows and not drop_last:
+            yield format_batch(carry, batch_format)
+
+    def count(self) -> int:
+        return sum(b.num_rows for b in self._iter_local_blocks())
+
+
+class GroupedData:
+    """Hash aggregation: per-block partial aggs combined on the driver
+    (reference: python/ray/data/grouped_data.py)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, col: Optional[str], init, update, merge, finalize=None):
+        key = self._key
+        partials: Dict[Any, Any] = {}
+        for block in self._ds.iter_blocks():
+            import pandas as pd
+
+            df = block_to_pandas(block)
+            for k, group in df.groupby(key):
+                acc = partials.get(k, init())
+                partials[k] = update(acc, group)
+        rows = []
+        for k in sorted(partials, key=lambda x: (x is None, x)):
+            v = partials[k]
+            if finalize:
+                v = finalize(v)
+            rows.append({key: k, **v})
+        return Dataset(datasource.items_tasks(rows, parallelism=1))
+
+    def count(self) -> Dataset:
+        return self._agg(
+            None, lambda: {"count()": 0},
+            lambda acc, g: {"count()": acc["count()"] + len(g)},
+            None)
+
+    def sum(self, col: str) -> Dataset:
+        name = f"sum({col})"
+        return self._agg(
+            col, lambda: {name: 0},
+            lambda acc, g: {name: acc[name] + g[col].sum()}, None)
+
+    def min(self, col: str) -> Dataset:
+        name = f"min({col})"
+        return self._agg(
+            col, lambda: {name: None},
+            lambda acc, g: {name: g[col].min() if acc[name] is None
+                            else min(acc[name], g[col].min())}, None)
+
+    def max(self, col: str) -> Dataset:
+        name = f"max({col})"
+        return self._agg(
+            col, lambda: {name: None},
+            lambda acc, g: {name: g[col].max() if acc[name] is None
+                            else max(acc[name], g[col].max())}, None)
+
+    def mean(self, col: str) -> Dataset:
+        name = f"mean({col})"
+        return self._agg(
+            col, lambda: {"_s": 0.0, "_n": 0},
+            lambda acc, g: {"_s": acc["_s"] + g[col].sum(),
+                            "_n": acc["_n"] + len(g)},
+            None,
+            finalize=lambda acc: {name: acc["_s"] / max(acc["_n"], 1)})
+
+
+def _name(fn) -> str:
+    return getattr(fn, "__name__", repr(fn))
+
+
+# ---------------------------------------------------------------------------
+# read_api (reference python/ray/data/read_api.py)
+# ---------------------------------------------------------------------------
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    return Dataset(datasource.range_tasks(n, parallelism))
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    return Dataset(datasource.items_tasks(items, parallelism))
+
+
+def from_numpy(arrays, *, parallelism: int = 8) -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = {"data": arrays}
+    return Dataset(datasource.numpy_tasks(arrays, parallelism))
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+
+    block = pa.Table.from_pandas(df, preserve_index=False)
+    return Dataset([lambda: block])
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset([lambda: table])
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    return Dataset(datasource.parquet_tasks(paths, columns))
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    return Dataset(datasource.csv_tasks(paths, **kwargs))
+
+
+def read_json(paths) -> Dataset:
+    return Dataset(datasource.json_tasks(paths))
+
+
+def read_text(paths) -> Dataset:
+    return Dataset(datasource.text_tasks(paths))

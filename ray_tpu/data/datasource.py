@@ -1,0 +1,117 @@
+"""Datasources — lazy read tasks.
+
+Reference: python/ray/data/datasource/ + read_api.py:327,621. A read is a
+list of zero-arg callables, each producing one Block; the executor runs
+them as remote tasks (streaming) like any other operator stage.
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import (Block, block_from_items, block_from_numpy,
+                                block_from_pandas)
+
+ReadTask = Callable[[], Block]
+
+
+def _chunk(n: int, k: int) -> List[range]:
+    k = max(1, min(k, n)) if n else 1
+    step = (n + k - 1) // k if n else 1
+    return [range(i, min(i + step, n)) for i in range(0, n, step)]
+
+
+def range_tasks(n: int, parallelism: int = 8) -> List[ReadTask]:
+    def make(r: range) -> ReadTask:
+        return lambda: block_from_numpy({"id": np.arange(r.start, r.stop)})
+    return [make(r) for r in _chunk(n, parallelism)]
+
+
+def items_tasks(items: List[Any], parallelism: int = 8) -> List[ReadTask]:
+    chunks = _chunk(len(items), parallelism)
+
+    def make(r: range) -> ReadTask:
+        part = items[r.start:r.stop]
+        return lambda: block_from_items(part)
+    return [make(r) for r in chunks]
+
+
+def numpy_tasks(arrays: Dict[str, np.ndarray],
+                parallelism: int = 8) -> List[ReadTask]:
+    n = len(next(iter(arrays.values()))) if arrays else 0
+
+    def make(r: range) -> ReadTask:
+        part = {k: v[r.start:r.stop] for k, v in arrays.items()}
+        return lambda: block_from_numpy(part)
+    return [make(r) for r in _chunk(n, parallelism)]
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def parquet_tasks(paths, columns: Optional[List[str]] = None
+                  ) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def make(f: str) -> ReadTask:
+        def read() -> Block:
+            import pyarrow.parquet as pq
+
+            return pq.read_table(f, columns=columns)
+        return read
+    return [make(f) for f in files]
+
+
+def csv_tasks(paths, **read_options) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def make(f: str) -> ReadTask:
+        def read() -> Block:
+            import pyarrow.csv as pacsv
+
+            return pacsv.read_csv(f)
+        return read
+    return [make(f) for f in files]
+
+
+def json_tasks(paths) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def make(f: str) -> ReadTask:
+        def read() -> Block:
+            import pyarrow.json as pajson
+
+            return pajson.read_json(f)
+        return read
+    return [make(f) for f in files]
+
+
+def text_tasks(paths) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def make(f: str) -> ReadTask:
+        def read() -> Block:
+            with open(f) as fh:
+                lines = [ln.rstrip("\n") for ln in fh]
+            return block_from_items([{"text": ln} for ln in lines])
+        return read
+    return [make(f) for f in files]

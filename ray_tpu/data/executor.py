@@ -1,0 +1,201 @@
+"""Streaming executor.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py:48 —
+operators run as remote tasks over Block ObjectRefs with bounded
+in-flight tasks (backpressure); consecutive map stages are fused into one
+task (the reference's fusion optimizer rule); all-to-all stages
+materialize their input frontier then fan back out.
+
+The TPU angle: this engine is deliberately host-side (CPU) — it feeds
+per-host train workers via streaming_split iterators; device transfer
+happens in the consumer (SURVEY.md §2.4 'elastic/data-pipeline
+parallelism' row).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data.block import Block, concat_blocks
+from ray_tpu.data.context import DataContext
+
+
+@dataclasses.dataclass
+class MapStage:
+    name: str
+    fn: Callable[[Block], Block]          # pure block transform
+    # "tasks" or ("actors", pool_size, cls_factory)
+    compute: Any = "tasks"
+
+
+@dataclasses.dataclass
+class AllToAllStage:
+    name: str
+    # driver-side: takes materialized blocks, returns new block list
+    fn: Callable[[List[Block]], List[Block]]
+
+
+@dataclasses.dataclass
+class LimitStage:
+    """Streaming row limit: stops pulling upstream once n rows are out."""
+
+    n: int
+
+    @property
+    def name(self) -> str:
+        return f"Limit({self.n})"
+
+
+Stage = Any  # MapStage | AllToAllStage | LimitStage
+
+
+def _fuse(stages: List[Stage]) -> List[Stage]:
+    """Fuse runs of task-compute MapStages into single stages."""
+    fused: List[Stage] = []
+    for st in stages:
+        if (isinstance(st, MapStage) and st.compute == "tasks" and fused
+                and isinstance(fused[-1], MapStage)
+                and fused[-1].compute == "tasks"):
+            prev = fused.pop()
+
+            def composed(block, f1=prev.fn, f2=st.fn):
+                return f2(f1(block))
+
+            fused.append(MapStage(f"{prev.name}->{st.name}", composed))
+        else:
+            fused.append(st)
+    return fused
+
+
+@ray_tpu.remote
+def _exec_read(read_task) -> Block:
+    return read_task()
+
+
+@ray_tpu.remote
+def _exec_map(fn, block: Block) -> Block:
+    return fn(block)
+
+
+@ray_tpu.remote
+class _MapActor:
+    """Actor-pool worker for class-based UDFs (stateful map_batches)."""
+
+    def __init__(self, cls_factory):
+        self._callable = cls_factory()
+
+    def apply(self, fn, block: Block) -> Block:
+        return fn(self._callable, block)
+
+
+class StreamingExecutor:
+    def __init__(self, context: Optional[DataContext] = None):
+        self.context = context or DataContext.get_current()
+
+    # ------------------------------------------------------------------
+    def execute(self, read_tasks: List[Callable[[], Block]],
+                stages: List[Stage]) -> Iterator[Any]:
+        """Yields Block ObjectRefs in completion order (streaming)."""
+        stages = _fuse(list(stages))
+        # Split pipeline at barriers (all-to-all) / stream-truncators.
+        segments: List[Tuple[List[MapStage], Optional[Stage]]] = []
+        cur: List[MapStage] = []
+        for st in stages:
+            if isinstance(st, (AllToAllStage, LimitStage)):
+                segments.append((cur, st))
+                cur = []
+            else:
+                cur.append(st)
+        segments.append((cur, None))
+
+        source: Iterator[Any] = self._stream_source(read_tasks)
+        for map_stages, boundary in segments:
+            source = self._stream_maps(source, map_stages)
+            if isinstance(boundary, LimitStage):
+                source = self._stream_limit(source, boundary.n)
+            elif boundary is not None:
+                blocks = [ray_tpu.get(r) for r in source]
+                out_blocks = boundary.fn(blocks)
+                source = iter([ray_tpu.put(b) for b in out_blocks])
+        return source
+
+    @staticmethod
+    def _stream_limit(source: Iterator[Any], n: int) -> Iterator[Any]:
+        """Early-exit: stops consuming `source` (and thus all upstream task
+        submission) once n rows have been yielded."""
+        seen = 0
+        for ref in source:
+            if seen >= n:
+                break
+            block = ray_tpu.get(ref)
+            take = min(block.num_rows, n - seen)
+            seen += take
+            if take == block.num_rows:
+                yield ref
+            else:
+                yield ray_tpu.put(block.slice(0, take))
+            if seen >= n:
+                break
+
+    # ------------------------------------------------------------------
+    def _stream_source(self, read_tasks) -> Iterator[Any]:
+        limit = self.context.max_tasks_in_flight
+        pending = collections.deque(read_tasks)
+        in_flight: List[Any] = []
+        while pending or in_flight:
+            while pending and len(in_flight) < limit:
+                in_flight.append(_exec_read.remote(pending.popleft()))
+            ready, in_flight_l = ray_tpu.wait(in_flight, num_returns=1)
+            in_flight = list(in_flight_l)
+            for r in ready:
+                yield r
+
+    def _stream_maps(self, source: Iterator[Any],
+                     map_stages: List[MapStage]) -> Iterator[Any]:
+        for st in map_stages:
+            source = self._stream_one(source, st)
+        return source
+
+    def _stream_one(self, source: Iterator[Any],
+                    stage: MapStage) -> Iterator[Any]:
+        limit = self.context.max_tasks_in_flight
+        if stage.compute == "tasks":
+            in_flight: List[Any] = []
+            for ref in source:
+                in_flight.append(_exec_map.remote(stage.fn, ref))
+                if len(in_flight) >= limit:
+                    ready, rest = ray_tpu.wait(in_flight, num_returns=1)
+                    in_flight = list(rest)
+                    yield from ready
+            while in_flight:
+                ready, rest = ray_tpu.wait(in_flight, num_returns=1)
+                in_flight = list(rest)
+                yield from ready
+        else:
+            _, pool_size, cls_factory = stage.compute
+            actors = [_MapActor.remote(cls_factory)
+                      for _ in range(pool_size)]
+            try:
+                in_flight = []
+                i = 0
+                for ref in source:
+                    actor = actors[i % len(actors)]
+                    i += 1
+                    in_flight.append(actor.apply.remote(stage.fn, ref))
+                    if len(in_flight) >= limit:
+                        ready, rest = ray_tpu.wait(in_flight, num_returns=1)
+                        in_flight = list(rest)
+                        yield from ready
+                while in_flight:
+                    ready, rest = ray_tpu.wait(in_flight, num_returns=1)
+                    in_flight = list(rest)
+                    yield from ready
+            finally:
+                for a in actors:
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:
+                        pass

@@ -1,0 +1,37 @@
+"""ray_tpu.train — distributed training library (the north-star library).
+
+Parity map to the reference (python/ray/train/):
+- JaxTrainer / DataParallelTrainer  <- torch/torch_trainer.py:11,
+  data_parallel_trainer.py:25
+- JaxConfig/_JaxBackend             <- torch/config.py:150 (_TorchBackend)
+- report/get_checkpoint/get_context <- _internal/session.py:403,754
+- Checkpoint                        <- _checkpoint.py:56
+- ScalingConfig/RunConfig/...       <- ray.air.config (re-exported)
+"""
+
+from ray_tpu.air import (CheckpointConfig, FailureConfig, Result, RunConfig,
+                         ScalingConfig)
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.data_parallel_trainer import (DataParallelTrainer,
+                                                 JaxTrainer)
+from ray_tpu.train.jax_backend import JaxConfig
+from ray_tpu.train._internal.session import (get_checkpoint, get_context,
+                                             report)
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "get_checkpoint",
+    "get_context",
+    "report",
+]

@@ -1,0 +1,83 @@
+"""BackendExecutor — drives the worker gang through a training run.
+
+Reference: python/ray/train/_internal/backend_executor.py:67 (`start`
+:129 creates the WorkerGroup + backend.on_start; `start_training` :445
+launches the session on every worker; the trainer then polls results).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.air import ScalingConfig
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train._internal.session import TrainContext
+from ray_tpu.train._internal.worker_group import WorkerGroup
+
+
+class TrainingWorkerError(RuntimeError):
+    pass
+
+
+def _session_entrypoint(train_fn, config):
+    return functools.partial(train_fn, config) if _takes_arg(train_fn) \
+        else train_fn
+
+
+def _takes_arg(fn) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    return len(sig.parameters) >= 1
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: BackendConfig,
+                 scaling_config: ScalingConfig):
+        self.backend_config = backend_config
+        self.scaling_config = scaling_config
+        self.backend = backend_config.backend_cls()
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self) -> None:
+        self.worker_group = WorkerGroup(self.scaling_config)
+        self.worker_group.start()
+        self.backend.on_start(self.worker_group, self.backend_config)
+
+    def start_training(self, train_fn: Callable, config: Dict[str, Any],
+                       experiment_name: str, trial_name: str, trial_dir: str,
+                       checkpoint: Optional[Checkpoint] = None) -> None:
+        assert self.worker_group is not None, "call start() first"
+        self.backend.on_training_start(self.worker_group, self.backend_config)
+        n = len(self.worker_group)
+        entry = _session_entrypoint(train_fn, config)
+        refs = []
+        for rank, w in enumerate(self.worker_group.workers):
+            ctx = TrainContext(
+                world_size=n, world_rank=rank, local_rank=0, node_rank=rank,
+                experiment_name=experiment_name, trial_name=trial_name,
+                trial_dir=trial_dir)
+            refs.append(w.start_session.remote(entry, ctx, checkpoint))
+        import ray_tpu
+
+        ray_tpu.get(refs)
+
+    def poll(self) -> List[Dict[str, Any]]:
+        """One poll round: per-worker {results, done, error}."""
+        return self.worker_group.execute("poll")
+
+    def shutdown(self) -> None:
+        if self.worker_group is not None:
+            try:
+                self.backend.on_shutdown(self.worker_group,
+                                         self.backend_config)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None

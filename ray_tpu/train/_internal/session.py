@@ -1,0 +1,140 @@
+"""Per-worker train session.
+
+Reference: python/ray/train/_internal/session.py:111 (`_TrainSession`) —
+runs the user loop in a RunnerThread; `report()` (:403,667) enqueues
+(metrics, checkpoint) for the driver-side executor to poll;
+`get_checkpoint()` (:754) hands the restore checkpoint to the user loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class TrainContext:
+    world_size: int = 1
+    world_rank: int = 0
+    local_rank: int = 0
+    node_rank: int = 0
+    experiment_name: str = ""
+    trial_name: str = ""
+    trial_dir: str = ""
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+    def get_trial_name(self) -> str:
+        return self.trial_name
+
+
+class _TrainSession:
+    def __init__(self, train_fn: Callable[[], None], context: TrainContext,
+                 checkpoint: Optional[Checkpoint] = None):
+        self.context = context
+        self.checkpoint = checkpoint
+        self.result_queue: "queue.Queue" = queue.Queue()
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+        self._thread = threading.Thread(
+            target=self._run, args=(train_fn,), daemon=True)
+        # Backpressure: the user loop blocks in report() until the driver
+        # drains, bounding in-flight results (reference uses the same
+        # queue-handshake in session.py:212).
+        self._continue = threading.Semaphore(8)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self, train_fn):
+        try:
+            train_fn()
+        except BaseException:
+            self.error = traceback.format_exc()
+        finally:
+            self.done.set()
+
+    # ---- called from the user loop (worker thread) ----
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None):
+        self._continue.acquire()
+        self.result_queue.put({"metrics": dict(metrics),
+                               "checkpoint": checkpoint})
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.checkpoint
+
+    # ---- called by the worker actor (poll RPC) ----
+    def poll(self):
+        out = []
+        while True:
+            try:
+                out.append(self.result_queue.get_nowait())
+                self._continue.release()
+            except queue.Empty:
+                break
+        return {
+            "results": out,
+            "done": self.done.is_set(),
+            "error": self.error,
+        }
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# Module-level API surfaced as ray_tpu.train.report / get_checkpoint /
+# get_context (modern reference API: python/ray/train/_internal/session.py
+# module functions).
+# ---------------------------------------------------------------------------
+
+_session: Optional[_TrainSession] = None
+
+
+def _set_session(s: Optional[_TrainSession]):
+    global _session
+    _session = s
+
+
+def get_session() -> Optional[_TrainSession]:
+    return _session
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    if _session is None:
+        raise RuntimeError(
+            "ray_tpu.train.report() called outside a train session")
+    _session.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    if _session is None:
+        return None
+    return _session.get_checkpoint()
+
+
+def get_context() -> TrainContext:
+    if _session is None:
+        return TrainContext()
+    return _session.context

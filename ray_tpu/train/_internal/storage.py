@@ -1,0 +1,68 @@
+"""StorageContext — resolves run/trial/checkpoint paths and persists
+checkpoints (reference python/ray/train/_internal/storage.py:352).
+
+Filesystem only (local, NFS, gcsfuse mounts); remote object stores can be
+added behind the same interface later.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+class StorageContext:
+    def __init__(self, storage_path: str, experiment_name: str,
+                 trial_name: Optional[str] = None):
+        self.storage_path = os.path.abspath(storage_path)
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        os.makedirs(self.trial_dir, exist_ok=True)
+
+    @property
+    def experiment_dir(self) -> str:
+        return os.path.join(self.storage_path, self.experiment_name)
+
+    @property
+    def trial_dir(self) -> str:
+        if self.trial_name is None:
+            return self.experiment_dir
+        return os.path.join(self.experiment_dir, self.trial_name)
+
+    def checkpoint_dir(self, index: int) -> str:
+        return os.path.join(self.trial_dir, f"checkpoint_{index:06d}")
+
+    def persist_checkpoint(self, checkpoint: Checkpoint, index: int,
+                           rank: int = 0) -> Checkpoint:
+        """Copy a worker-local checkpoint dir into durable storage.
+
+        Rank 0's files land at the checkpoint root; other ranks' under
+        shard_rank_<k>/ so same-named per-rank files never clobber each
+        other (multi-host GSPMD shard layout)."""
+        root = self.checkpoint_dir(index)
+        dest = root if rank == 0 else os.path.join(root,
+                                                   f"shard_rank_{rank}")
+        if os.path.abspath(checkpoint.path) == dest:
+            return Checkpoint(root)
+        os.makedirs(dest, exist_ok=True)
+        shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+        return Checkpoint(root)
+
+    def list_checkpoints(self) -> list:
+        if not os.path.isdir(self.trial_dir):
+            return []
+        return [Checkpoint(os.path.join(self.trial_dir, d))
+                for d in sorted(os.listdir(self.trial_dir))
+                if d.startswith("checkpoint_")]
+
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        cks = self.list_checkpoints()
+        return cks[-1] if cks else None
+
+
+def make_experiment_name(prefix: str = "train") -> str:
+    return f"{prefix}_{time.strftime('%Y%m%d_%H%M%S')}"

@@ -1,0 +1,36 @@
+"""Backend plugin interface.
+
+Reference: python/ray/train/backend.py:32 (`Backend` with
+on_start/on_training_start/on_shutdown hooks run by the BackendExecutor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ray_tpu.train._internal.worker_group import WorkerGroup
+
+
+@dataclasses.dataclass
+class BackendConfig:
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Framework-setup hooks around a WorkerGroup's lifetime."""
+
+    def on_start(self, worker_group: "WorkerGroup",
+                 backend_config: BackendConfig) -> None:
+        pass
+
+    def on_training_start(self, worker_group: "WorkerGroup",
+                          backend_config: BackendConfig) -> None:
+        pass
+
+    def on_shutdown(self, worker_group: "WorkerGroup",
+                    backend_config: BackendConfig) -> None:
+        pass

@@ -1,0 +1,76 @@
+"""Checkpoint — a directory of files, referenced by path.
+
+Reference: python/ray/train/_checkpoint.py:56 (`Checkpoint` = directory +
+pyarrow filesystem). Local/NFS/GCS-fuse paths are plain directories here;
+sharded jax.Array checkpointing (per-host writes, orbax-style) is layered
+on top in ray_tpu.train.jax.checkpointing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+
+class Checkpoint:
+    """A directory snapshot. Create with `from_directory` (takes ownership
+    of the path) or `from_dict` (writes a pickle into a temp dir)."""
+
+    _DICT_FILE = "_dict_checkpoint.pkl"
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    # ---- constructors ----
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        with open(os.path.join(d, cls._DICT_FILE), "wb") as f:
+            pickle.dump(data, f)
+        return cls(d)
+
+    # ---- accessors ----
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None or os.path.abspath(path) == self.path:
+            return self.path
+        os.makedirs(path, exist_ok=True)
+        shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        yield self.path
+
+    def to_dict(self) -> Dict[str, Any]:
+        with open(os.path.join(self.path, self._DICT_FILE), "rb") as f:
+            return pickle.load(f)
+
+    def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        meta = self.get_metadata()
+        meta.update(metadata)
+        os.makedirs(self.path, exist_ok=True)
+        with open(os.path.join(self.path, ".metadata.json"), "w") as f:
+            json.dump(meta, f, default=str)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, ".metadata.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def __repr__(self) -> str:
+        return f"Checkpoint(path={self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))

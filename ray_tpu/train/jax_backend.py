@@ -1,0 +1,58 @@
+"""JaxConfig / _JaxBackend — the TPU analog of _TorchBackend.
+
+Reference: python/ray/train/torch/config.py:150 (`_TorchBackend.on_start`
+→ `_setup_torch_process_group` :65 with a rank-0 TCP store). Here the
+rendezvous is `jax.distributed.initialize`: rank 0's address becomes the
+coordinator; every worker gets (coordinator, num_processes, process_id)
+and its JAX runtime joins one global device world over ICI/DCN. The
+precedent in the reference for an XLA backend is
+python/ray/train/torch/xla/config.py:120 (`_TorchAwsNeuronXLABackend`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ray_tpu.train.backend import Backend, BackendConfig
+
+
+@dataclasses.dataclass
+class JaxConfig(BackendConfig):
+    """jax_distributed: bootstrap a multi-process JAX world (one process
+    per worker/host). Off for single-process or CPU-test worlds."""
+
+    jax_distributed: bool = True
+    coordinator_port: Optional[int] = None
+
+    @property
+    def backend_cls(self):
+        return _JaxBackend
+
+
+def _init_jax_distributed(coordinator_address: str, num_processes: int,
+                          process_id: int) -> dict:
+    from ray_tpu.parallel.bootstrap import initialize_distributed
+
+    info = initialize_distributed(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    return dataclasses.asdict(info)
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group, backend_config: JaxConfig) -> None:
+        if not backend_config.jax_distributed or len(worker_group) <= 1:
+            return
+        infos = worker_group.execute("get_node_info")
+        port = backend_config.coordinator_port or infos[0]["free_port"]
+        coordinator = f"{infos[0]['ip']}:{port}"
+        import ray_tpu
+
+        refs = [
+            w.run_fn.remote(_init_jax_distributed, coordinator,
+                            len(worker_group), rank)
+            for rank, w in enumerate(worker_group.workers)
+        ]
+        ray_tpu.get(refs)

@@ -1,0 +1,177 @@
+"""ray_tpu.data tests — mirror reference data/tests style: in-process
+streaming executor over a real local cluster."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_range_count_take():
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_map_batches_numpy():
+    ds = rd.range(64, parallelism=4).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+    out = ds.to_numpy()
+    np.testing.assert_array_equal(np.sort(out["sq"]),
+                                  np.arange(64) ** 2)
+
+
+def test_map_filter_flatmap():
+    ds = rd.from_items([{"x": i} for i in range(10)])
+    ds = ds.map(lambda r: {"x": r["x"] * 2})
+    ds = ds.filter(lambda r: r["x"] % 4 == 0)
+    ds = ds.flat_map(lambda r: [{"x": r["x"]}, {"x": r["x"] + 1}])
+    xs = sorted(r["x"] for r in ds.take_all())
+    assert xs == sorted(
+        v for i in range(10) if (2 * i) % 4 == 0 for v in (2 * i, 2 * i + 1))
+
+
+def test_actor_pool_map_batches():
+    class AddConst:
+        def __init__(self, c=100):
+            self.c = c
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.c}
+
+    ds = rd.range(32, parallelism=4).map_batches(
+        AddConst, concurrency=2)
+    out = sorted(ds.to_numpy()["id"].tolist())
+    assert out == list(range(100, 132))
+
+
+def test_iter_batches_sizes():
+    ds = rd.range(100, parallelism=3)
+    batches = list(ds.iter_batches(batch_size=32))
+    sizes = [len(b["id"]) for b in batches]
+    assert sum(sizes) == 100
+    assert all(s == 32 for s in sizes[:-1])
+
+
+def test_shuffle_sort_repartition():
+    ds = rd.range(50, parallelism=4).random_shuffle(seed=42)
+    vals = ds.to_numpy()["id"]
+    assert sorted(vals.tolist()) == list(range(50))
+    assert vals.tolist() != list(range(50))
+
+    ds2 = rd.from_items([{"k": i % 5, "v": i} for i in range(20)])
+    s = ds2.sort("v", descending=True).take(3)
+    assert [r["v"] for r in s] == [19, 18, 17]
+
+    ds3 = rd.range(100, parallelism=2).repartition(10)
+    blocks = list(ds3.iter_blocks())
+    assert len(blocks) == 10
+
+
+def test_groupby():
+    ds = rd.from_items([{"k": i % 3, "v": i} for i in range(12)])
+    out = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert out == {0: 4, 1: 4, 2: 4}
+    means = {r["k"]: r["mean(v)"]
+             for r in ds.groupby("k").mean("v").take_all()}
+    assert means[0] == pytest.approx(np.mean([0, 3, 6, 9]))
+
+
+def test_limit_union_zip():
+    assert rd.range(100).limit(7).count() == 7
+    u = rd.range(10).union(rd.range(5))
+    assert u.count() == 15
+    z = rd.range(5).zip(rd.from_items([{"y": i * 10} for i in range(5)]))
+    rows = z.take_all()
+    assert len(rows) == 5
+    assert {"id", "y"} <= set(rows[0].keys())
+
+
+def test_read_write_files(tmp_path):
+    import pandas as pd
+
+    df = pd.DataFrame({"a": range(20), "b": [f"s{i}" for i in range(20)]})
+    pq = str(tmp_path / "f.parquet")
+    df.to_parquet(pq)
+    assert rd.read_parquet(pq).count() == 20
+
+    csv = str(tmp_path / "f.csv")
+    df.to_csv(csv, index=False)
+    out = rd.read_csv(csv).to_pandas()
+    assert len(out) == 20 and set(out.columns) == {"a", "b"}
+
+
+def test_streaming_split():
+    ds = rd.range(90, parallelism=6)
+    splits = ds.streaming_split(3)
+    counts = [s.count() for s in splits]
+    assert sum(counts) == 90
+    assert all(c > 0 for c in counts)
+
+
+def test_tensor_columns():
+    arr = np.random.rand(10, 4).astype(np.float32)
+    ds = rd.from_numpy({"feat": arr, "label": np.arange(10)})
+    out = ds.to_numpy()
+    np.testing.assert_allclose(out["feat"], arr)
+
+
+def test_streaming_split_in_train_worker(tmp_path):
+    """Data ingest path: DataConfig-style streaming into train workers."""
+    from ray_tpu.air import RunConfig, ScalingConfig
+    from ray_tpu.train import JaxConfig, JaxTrainer
+
+    ds = rd.range(64, parallelism=4)
+    splits = ds.streaming_split(2)
+
+    def loop(config):
+        from ray_tpu import train
+
+        it = config["_datasets"]["train"][train.get_context().get_world_rank()]
+        total = sum(int(b["id"].sum()) for b in it.iter_batches(batch_size=8))
+        train.report({"total": total})
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={},
+        jax_config=JaxConfig(jax_distributed=False),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ingest", storage_path=str(tmp_path)),
+        datasets={"train": splits})
+    result = trainer.fit()
+    assert result.error is None
+
+
+def test_limit_is_streaming():
+    """limit() must not execute the whole upstream pipeline."""
+    executed = []
+
+    def spy(batch):
+        executed.append(len(batch["id"]))
+        return batch
+
+    ds = rd.range(1000, parallelism=50).map_batches(spy).limit(7)
+    assert ds.count() == 7
+    # far fewer than all 50 read tasks should have run through the map
+    assert sum(executed) < 1000, executed
+
+
+def test_local_shuffle_buffer():
+    ds = rd.range(200, parallelism=4)
+    batches = list(ds.iter_batches(batch_size=20,
+                                   local_shuffle_buffer_size=100,
+                                   local_shuffle_seed=0))
+    flat = np.concatenate([b["id"] for b in batches])
+    assert sorted(flat.tolist()) == list(range(200))
+    assert flat.tolist() != list(range(200))
