@@ -68,6 +68,7 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
+    stop: Optional[Dict[str, Any]] = None  # e.g. {"training_iteration": 10}
     verbose: int = 1
 
     def resolved_storage_path(self) -> str:

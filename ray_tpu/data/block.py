@@ -27,17 +27,27 @@ def block_from_items(items: List[Any]) -> Block:
 
 
 def block_from_numpy(arrays: Dict[str, np.ndarray]) -> Block:
+    import json
+
     cols = {}
+    fields = []
     for k, v in arrays.items():
         v = np.asarray(v)
         if v.ndim <= 1:
-            cols[k] = pa.array(v)
+            arr = pa.array(v)
+            fields.append(pa.field(k, arr.type))
         else:
-            # tensor column: fixed-shape list-of-lists
+            # tensor column: flattened fixed-size list + the element shape
+            # in field metadata so >2-D tensors round-trip exactly
             flat = v.reshape(len(v), -1)
-            cols[k] = pa.FixedSizeListArray.from_arrays(
+            arr = pa.FixedSizeListArray.from_arrays(
                 pa.array(flat.reshape(-1)), flat.shape[1])
-    return pa.table(cols)
+            fields.append(pa.field(
+                k, arr.type,
+                metadata={b"tensor_shape":
+                          json.dumps(list(v.shape[1:])).encode()}))
+        cols[k] = arr
+    return pa.table(cols, schema=pa.schema(fields))
 
 
 def block_from_pandas(df) -> Block:
@@ -61,14 +71,21 @@ def block_to_rows(block: Block) -> List[Dict[str, Any]]:
 
 
 def block_to_numpy(block: Block) -> Dict[str, np.ndarray]:
+    import json
+
     out = {}
-    for name in block.column_names:
+    for i, name in enumerate(block.column_names):
         col = block.column(name)
         if pa.types.is_fixed_size_list(col.type):
             width = col.type.list_size
             flat = col.combine_chunks().flatten().to_numpy(
                 zero_copy_only=False)
-            out[name] = flat.reshape(block.num_rows, width)
+            meta = block.schema.field(i).metadata or {}
+            if b"tensor_shape" in meta:
+                shape = json.loads(meta[b"tensor_shape"])
+                out[name] = flat.reshape(block.num_rows, *shape)
+            else:
+                out[name] = flat.reshape(block.num_rows, width)
         else:
             out[name] = col.to_numpy(zero_copy_only=False)
     return out
@@ -79,10 +96,15 @@ def block_to_pandas(block: Block):
 
 
 def concat_blocks(blocks: List[Block]) -> Block:
-    blocks = [b for b in blocks if b is not None and b.num_rows >= 0]
+    # Drop schema-less empty placeholders so they can't poison promotion.
+    blocks = [b for b in blocks
+              if b is not None and (b.num_rows > 0 or b.column_names)]
     if not blocks:
         return pa.table({})
-    return pa.concat_tables(blocks, promote_options="default")
+    nonempty = [b for b in blocks if b.num_rows > 0]
+    if not nonempty:
+        return blocks[0]
+    return pa.concat_tables(nonempty, promote_options="default")
 
 
 def format_batch(block: Block, batch_format: str):

@@ -329,11 +329,16 @@ class Dataset:
 
 @ray_tpu.remote
 class _SplitCoordinator:
-    """Owns ONE streaming execution, fans blocks out to n bounded queues.
+    """Owns ONE streaming execution, dispatches blocks to splits on demand.
 
-    The per-split queues (maxsize=2) give backpressure: the producer thread
-    stalls when consumers fall behind, which in turn stalls upstream task
-    submission via the executor's bounded in-flight window."""
+    Blocks go into a single bounded queue and each get_next() pops the next
+    available one (first-come-first-served — the reference's output-splitter
+    dispatch, data/_internal/execution/operators/output_splitter.py). This
+    cannot deadlock under any consumption order: a split that is consumed
+    sequentially simply drains more blocks. The bounded queue gives
+    backpressure: the producer stalls when all consumers fall behind,
+    which stalls upstream task submission via the executor's bounded
+    in-flight window."""
 
     def __init__(self, read_tasks, stages, n: int):
         import queue as _q
@@ -341,28 +346,27 @@ class _SplitCoordinator:
 
         from ray_tpu.data.executor import StreamingExecutor
 
-        self._queues = [_q.Queue(maxsize=2) for _ in builtins.range(n)]
+        self._queue = _q.Queue(maxsize=max(2, 2 * n))
         self._n = n
 
         def produce():
             try:
-                i = 0
                 for ref in StreamingExecutor().execute(read_tasks, stages):
                     block = ray_tpu.get(ref)
-                    self._queues[i % n].put(("block", block))
-                    i += 1
+                    self._queue.put(("block", block))
             except BaseException as e:  # surface to all consumers
-                for q in self._queues:
-                    q.put(("error", repr(e)))
+                for _ in builtins.range(n):
+                    self._queue.put(("error", repr(e)))
                 return
-            for q in self._queues:
-                q.put(("done", None))
+            # one sentinel per split; each consumer stops at its first one
+            for _ in builtins.range(n):
+                self._queue.put(("done", None))
 
         self._producer = _t.Thread(target=produce, daemon=True)
         self._producer.start()
 
     def get_next(self, split_index: int):
-        kind, payload = self._queues[split_index].get()
+        kind, payload = self._queue.get()
         if kind == "error":
             raise RuntimeError(f"streaming_split producer failed: {payload}")
         return payload  # Block or None when done

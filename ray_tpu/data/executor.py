@@ -142,16 +142,18 @@ class StreamingExecutor:
 
     # ------------------------------------------------------------------
     def _stream_source(self, read_tasks) -> Iterator[Any]:
+        # Blocks are yielded in task-SUBMISSION order (the reference's
+        # default preserve_order semantics): only the head ref is waited
+        # on, so later tasks still execute concurrently behind it.
         limit = self.context.max_tasks_in_flight
         pending = collections.deque(read_tasks)
-        in_flight: List[Any] = []
+        in_flight: collections.deque = collections.deque()
         while pending or in_flight:
             while pending and len(in_flight) < limit:
                 in_flight.append(_exec_read.remote(pending.popleft()))
-            ready, in_flight_l = ray_tpu.wait(in_flight, num_returns=1)
-            in_flight = list(in_flight_l)
-            for r in ready:
-                yield r
+            head = in_flight.popleft()
+            ray_tpu.wait([head], num_returns=1)
+            yield head
 
     def _stream_maps(self, source: Iterator[Any],
                      map_stages: List[MapStage]) -> Iterator[Any]:
@@ -163,36 +165,36 @@ class StreamingExecutor:
                     stage: MapStage) -> Iterator[Any]:
         limit = self.context.max_tasks_in_flight
         if stage.compute == "tasks":
-            in_flight: List[Any] = []
+            in_flight: collections.deque = collections.deque()
             for ref in source:
                 in_flight.append(_exec_map.remote(stage.fn, ref))
                 if len(in_flight) >= limit:
-                    ready, rest = ray_tpu.wait(in_flight, num_returns=1)
-                    in_flight = list(rest)
-                    yield from ready
+                    head = in_flight.popleft()
+                    ray_tpu.wait([head], num_returns=1)
+                    yield head
             while in_flight:
-                ready, rest = ray_tpu.wait(in_flight, num_returns=1)
-                in_flight = list(rest)
-                yield from ready
+                head = in_flight.popleft()
+                ray_tpu.wait([head], num_returns=1)
+                yield head
         else:
             _, pool_size, cls_factory = stage.compute
             actors = [_MapActor.remote(cls_factory)
                       for _ in range(pool_size)]
             try:
-                in_flight = []
+                in_flight = collections.deque()
                 i = 0
                 for ref in source:
                     actor = actors[i % len(actors)]
                     i += 1
                     in_flight.append(actor.apply.remote(stage.fn, ref))
                     if len(in_flight) >= limit:
-                        ready, rest = ray_tpu.wait(in_flight, num_returns=1)
-                        in_flight = list(rest)
-                        yield from ready
+                        head = in_flight.popleft()
+                        ray_tpu.wait([head], num_returns=1)
+                        yield head
                 while in_flight:
-                    ready, rest = ray_tpu.wait(in_flight, num_returns=1)
-                    in_flight = list(rest)
-                    yield from ready
+                    head = in_flight.popleft()
+                    ray_tpu.wait([head], num_returns=1)
+                    yield head
             finally:
                 for a in actors:
                     try:

@@ -107,43 +107,56 @@ class DataParallelTrainer:
                 trial_name=run_name, trial_dir=storage.trial_dir,
                 checkpoint=checkpoint)
 
+            rank_reports = None  # per-rank FIFO of not-yet-aligned reports
             while True:
                 rounds = executor.poll()
-                # Persist checkpoints BEFORE raising worker errors: results
-                # already reported by healthy ranks in this round must land
-                # so the restart attempt can resume from them.
-                reports_per_rank = [r["results"] for r in rounds]
-                n_reports = max((len(r) for r in reports_per_rank), default=0)
-                for i in range(n_reports):
+                if rank_reports is None:
+                    rank_reports = [[] for _ in rounds]
+                for rank, r in enumerate(rounds):
+                    rank_reports[rank].extend(r["results"])
+                done = [r["done"] for r in rounds]
+                # Ranks report in lockstep (every worker calls report() the
+                # same number of times — reference contract), so the i-th
+                # report of each rank forms one logical result/checkpoint.
+                # A report index is processed only once every rank has
+                # delivered it (or finished) — regardless of which 50ms
+                # poll round each rank's report arrived in. Checkpoints are
+                # persisted BEFORE worker errors are raised so a restart
+                # can resume from them.
+                while any(rank_reports) and \
+                        all(buf or d
+                            for buf, d in zip(rank_reports, done)):
+                    batch = [(rank, buf.pop(0))
+                             for rank, buf in enumerate(rank_reports) if buf]
+                    metrics_i = next(
+                        (rep["metrics"] for rank, rep in batch if rank == 0),
+                        batch[0][1]["metrics"])
                     ckpt_here = None
-                    for rank, reports in enumerate(reports_per_rank):
-                        if i < len(reports) and reports[i]["checkpoint"]:
+                    for rank, rep in batch:
+                        if rep["checkpoint"]:
                             # rank 0 lands at the checkpoint root; other
                             # ranks under shard_rank_<k>/ so same-named
-                            # files (e.g. _dict_checkpoint.pkl) never clobber
+                            # files never clobber
                             persisted = storage.persist_checkpoint(
-                                reports[i]["checkpoint"], ckpt_index,
-                                rank=rank)
+                                rep["checkpoint"], ckpt_index, rank=rank)
                             if rank == 0 or ckpt_here is None:
                                 ckpt_here = persisted
+                    last_metrics = metrics_i
                     if ckpt_here is not None:
                         latest_checkpoint = ckpt_here
-                        metrics_i = (reports_per_rank[0][i]["metrics"]
-                                     if i < len(reports_per_rank[0]) else {})
                         ckpt_here.update_metadata({"metrics": metrics_i})
                         checkpoints_with_metrics.append(
                             (ckpt_here, metrics_i))
                         ckpt_index += 1
                         self._apply_retention(storage,
                                               checkpoints_with_metrics,
-                                              ckpt_config)
-                    if i < len(reports_per_rank[0]):
-                        last_metrics = reports_per_rank[0][i]["metrics"]
+                                              ckpt_config,
+                                              protect=latest_checkpoint)
                 for err_rank, r in enumerate(rounds):
                     if r["error"]:
                         raise RuntimeError(
                             f"worker {err_rank} failed:\n{r['error']}")
-                if all(r["done"] for r in rounds):
+                if all(done):
                     break
                 time.sleep(0.05)
         finally:
@@ -154,8 +167,11 @@ class DataParallelTrainer:
                       best_checkpoints=list(checkpoints_with_metrics))
 
     @staticmethod
-    def _apply_retention(storage: StorageContext, ckpts, cfg):
-        """Keep top-K by score attr (reference CheckpointManager)."""
+    def _apply_retention(storage: StorageContext, ckpts, cfg, protect=None):
+        """Keep top-K by score attr (reference CheckpointManager). The
+        `protect` checkpoint (the latest) is never deleted — Result.
+        checkpoint and restart-resume must stay valid even when the newest
+        checkpoint scores worst."""
         import shutil
 
         if not cfg.num_to_keep or len(ckpts) <= cfg.num_to_keep:
@@ -176,9 +192,12 @@ class DataParallelTrainer:
         else:
             ranked = sorted(ckpts, key=score, reverse=True)
             keep, doomed = ranked[:cfg.num_to_keep], ranked[cfg.num_to_keep:]
+        protected = [d for d in doomed
+                     if protect is not None and d[0].path == protect.path]
+        doomed = [d for d in doomed if d not in protected]
         for c, _ in doomed:
             shutil.rmtree(c.path, ignore_errors=True)
-        ckpts[:] = keep
+        ckpts[:] = keep + protected
 
 
 class JaxTrainer(DataParallelTrainer):

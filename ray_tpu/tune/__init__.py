@@ -1,0 +1,34 @@
+"""ray_tpu.tune — hyperparameter tuning over the actor runtime.
+
+Parity map to the reference (python/ray/tune/):
+- Tuner/TuneConfig/ResultGrid      <- tuner.py:44, tune_config.py, result_grid.py
+- Trainable (class + function API) <- trainable/trainable.py:58
+- TuneController                   <- execution/tune_controller.py:68
+- schedulers (ASHA/PBT/median)     <- schedulers/
+- search (grid/random/Searcher)    <- search/
+"""
+
+from ray_tpu.tune import schedulers, search
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+                                     HyperBandScheduler, MedianStoppingRule,
+                                     PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
+                                 Searcher, choice, grid_search, lograndint,
+                                 loguniform, qloguniform, quniform, randint,
+                                 randn, sample_from, uniform)
+from ray_tpu.tune.trainable import (Trainable, get_checkpoint, report,
+                                    wrap_function)
+from ray_tpu.tune.tuner import (TuneConfig, Tuner, run, with_parameters,
+                                with_resources)
+
+__all__ = [
+    "AsyncHyperBandScheduler", "BasicVariantGenerator", "ConcurrencyLimiter",
+    "FIFOScheduler", "HyperBandScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining", "ResultGrid", "Searcher", "Trainable",
+    "TrialScheduler", "TuneConfig", "Tuner", "choice", "get_checkpoint",
+    "grid_search", "lograndint", "loguniform", "qloguniform", "quniform",
+    "randint", "randn", "report", "run", "sample_from", "schedulers",
+    "search", "uniform", "with_parameters", "with_resources",
+    "wrap_function",
+]

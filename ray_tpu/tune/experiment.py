@@ -1,0 +1,45 @@
+"""Trial model.
+
+Reference: python/ray/tune/experiment/trial.py (Trial: id, config, status
+lifecycle PENDING→RUNNING→TERMINATED/ERROR, last_result, checkpoints).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import uuid
+from typing import Any, Dict, List, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class Trial:
+    config: Dict[str, Any]
+    experiment_dir: str
+    trial_id: str = dataclasses.field(
+        default_factory=lambda: uuid.uuid4().hex[:8])
+    status: str = PENDING
+    last_result: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    results: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    checkpoint_path: Optional[str] = None
+    num_failures: int = 0
+
+    @property
+    def trial_dir(self) -> str:
+        return os.path.join(self.experiment_dir, f"trial_{self.trial_id}")
+
+    def best_metric(self, metric: str, mode: str) -> Optional[float]:
+        vals = [r[metric] for r in self.results if metric in r
+                and isinstance(r[metric], (int, float))]
+        if not vals:
+            return None
+        return max(vals) if mode == "max" else min(vals)
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status})"

@@ -1,0 +1,86 @@
+"""ResultGrid — results of a Tuner.fit().
+
+Reference: python/ray/tune/result_grid.py (get_best_result,
+get_dataframe, indexing).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ray_tpu.air.result import Result
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune.experiment import ERROR, Trial
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str],
+                 mode: str):
+        self._trials = trials
+        self._metric, self._mode = metric, mode
+        self._results = [self._to_result(t) for t in trials]
+
+    @staticmethod
+    def _to_result(trial: Trial) -> Result:
+        metrics = dict(trial.last_result)
+        metrics["config"] = trial.config
+        metrics["trial_id"] = trial.trial_id
+        ckpt = Checkpoint(trial.checkpoint_path) \
+            if trial.checkpoint_path else None
+        err = RuntimeError(trial.error) if trial.error else None
+        return Result(metrics=metrics, checkpoint=ckpt, error=err,
+                      path=trial.trial_dir)
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def num_errors(self) -> int:
+        return sum(1 for t in self._trials if t.status == ERROR)
+
+    @property
+    def num_terminated(self) -> int:
+        return len(self._trials) - self.num_errors
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None,
+                        scope: str = "last") -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric is required (set in TuneConfig or "
+                             "pass explicitly)")
+        sign = 1 if mode == "max" else -1
+
+        def key(pair):
+            trial, _ = pair
+            if scope == "all":
+                v = trial.best_metric(metric, mode)
+            else:
+                v = trial.last_result.get(metric)
+                v = v if isinstance(v, (int, float)) else None
+            return -float("inf") if v is None else sign * v
+
+        trial, result = max(zip(self._trials, self._results), key=key)
+        return result
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for t in self._trials:
+            row = {k: v for k, v in t.last_result.items()
+                   if not isinstance(v, (dict, list))}
+            row["trial_id"] = t.trial_id
+            row["status"] = t.status
+            for k, v in t.config.items():
+                if not isinstance(v, (dict, list)):
+                    row[f"config/{k}"] = v
+            rows.append(row)
+        return pd.DataFrame(rows)

@@ -1,0 +1,205 @@
+"""Trial schedulers: FIFO, ASHA, median-stopping, PBT.
+
+Reference: python/ray/tune/schedulers/ — async_hyperband.py
+(AsyncHyperBandScheduler: rungs at reduction_factor^k, cutoff = top
+1/reduction_factor quantile of recorded rung results), median_stopping_rule
+.py, pbt.py (PopulationBasedTraining: quantile exploit + perturb/resample
+explore via checkpoint transfer). Decisions are returned to the
+TuneController which owns actor lifecycle.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu.tune.search.sample import Domain
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def set_search_properties(self, metric: Optional[str],
+                              mode: Optional[str]) -> None:
+        if getattr(self, "metric", None) is None and metric:
+            self.metric = metric
+        if getattr(self, "mode", None) is None and mode:
+            self.mode = mode
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, controller, trial, result: Dict) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: asynchronous successive halving."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4, brackets: int = 1):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.max_t, self.grace_period = max_t, grace_period
+        self.rf = reduction_factor
+        # rung levels: grace_period * rf^k below max_t; {level: [scores]}
+        self._rungs: List[Dict] = []
+        for b in range(brackets):
+            levels = []
+            t = grace_period * (self.rf ** b)
+            while t < max_t:
+                levels.append(int(t))
+                t *= self.rf
+            self._rungs.append({lv: [] for lv in levels})
+        self._trial_bracket: Dict[str, int] = {}
+
+    def _score(self, result: Dict) -> Optional[float]:
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        return float(v) if self.mode == "max" else -float(v)
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        b = self._trial_bracket.setdefault(
+            trial.trial_id, len(self._trial_bracket) % len(self._rungs))
+        rung = self._rungs[b]
+        decision = CONTINUE
+        for level in sorted(rung, reverse=True):
+            if t < level:
+                continue
+            recorded = rung[level]
+            if trial.trial_id not in [r[0] for r in recorded]:
+                recorded.append((trial.trial_id, score))
+                k = max(1, int(len(recorded) / self.rf))
+                cutoff = sorted((s for _, s in recorded),
+                                reverse=True)[k - 1]
+                if score < cutoff:
+                    decision = STOP
+            break
+        return decision
+
+
+# Synchronous HyperBand shares the successive-halving math; the async
+# variant dominates it in practice (reference recommends ASHA,
+# python/ray/tune/schedulers/async_hyperband.py module docstring).
+HyperBandScheduler = AsyncHyperBandScheduler
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result is worse than the median of the
+    running means of completed/running trials at the same step."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._means: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        v = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if v is None or t < self.grace_period:
+            return CONTINUE
+        s = float(v) if self.mode == "max" else -float(v)
+        hist = self._means.setdefault(trial.trial_id, [])
+        hist.append(s)
+        means = [sum(h) / len(h) for tid, h in self._means.items() if h]
+        if len(means) < self.min_samples:
+            return CONTINUE
+        median = sorted(means)[len(means) // 2]
+        my_mean = sum(hist) / len(hist)
+        return STOP if my_mean < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: every perturbation_interval steps, bottom-quantile trials clone
+    a top-quantile trial's checkpoint and continue with perturbed
+    hyperparameters (reference pbt.py: _exploit, explore factors 1.2/0.8,
+    resample_probability 0.25)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 custom_explore_fn: Optional[Callable] = None,
+                 seed: int = 0):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.custom_explore_fn = custom_explore_fn
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        self._scores: Dict[str, float] = {}
+
+    def _score(self, result: Dict) -> Optional[float]:
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        return float(v) if self.mode == "max" else -float(v)
+
+    def explore(self, config: Dict) -> Dict:
+        import numpy as np
+
+        new = dict(config)
+        for k, spec in self.mutations.items():
+            resample = self._rng.random() < self.resample_p or k not in new
+            if isinstance(spec, Domain):
+                if resample or not isinstance(new[k], (int, float)):
+                    new[k] = spec.sample(np.random.default_rng(
+                        self._rng.randrange(2 ** 31)))
+                else:  # continuous perturbation ×0.8 / ×1.2
+                    factor = self._rng.choice([0.8, 1.2])
+                    new[k] = type(new[k])(new[k] * factor)
+            elif isinstance(spec, list):
+                if resample or new[k] not in spec:
+                    new[k] = self._rng.choice(spec)
+                else:  # shift to a neighboring value
+                    idx = spec.index(new[k]) + self._rng.choice([-1, 1])
+                    new[k] = spec[max(0, min(len(spec) - 1, idx))]
+            elif callable(spec):
+                new[k] = spec()
+        if self.custom_explore_fn:
+            new = self.custom_explore_fn(new)
+        return new
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        score = self._score(result)
+        if score is not None:
+            self._scores[trial.trial_id] = score
+        t = result.get(self.time_attr, 0)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval or len(self._scores) < 2:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        ranked = sorted(self._scores.items(), key=lambda kv: kv[1])
+        n = len(ranked)
+        k = max(1, int(math.ceil(n * self.quantile)))
+        bottom = {tid for tid, _ in ranked[:k]}
+        top = [tid for tid, _ in ranked[-k:]]
+        if trial.trial_id in bottom and top:
+            donor_id = self._rng.choice(top)
+            if donor_id != trial.trial_id:
+                controller.exploit(trial, donor_id, self.explore)
+        return CONTINUE

@@ -1,0 +1,76 @@
+"""Grid/random variant generation.
+
+Reference: python/ray/tune/search/basic_variant.py (BasicVariantGenerator)
+and variant_generator.py (grid expansion). A param_space is a (possibly
+nested) dict whose leaves may be plain values, Domain samplers, or
+``grid_search`` marker dicts. The generator yields num_samples copies of
+the full grid cross-product, sampling the Domain leaves independently for
+each variant.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.tune.search.sample import Domain
+from ray_tpu.tune.search.searcher import Searcher
+
+
+def _find_grid_leaves(space: Dict, path=()) -> List[Tuple[Tuple, List]]:
+    out = []
+    for k, v in space.items():
+        if isinstance(v, dict) and "grid_search" in v and \
+                len(v) == 1 and isinstance(v["grid_search"], list):
+            out.append((path + (k,), v["grid_search"]))
+        elif isinstance(v, dict):
+            out.extend(_find_grid_leaves(v, path + (k,)))
+    return out
+
+
+def _set_path(d: Dict, path: Tuple, value: Any) -> None:
+    for k in path[:-1]:
+        d = d[k]
+    d[path[-1]] = value
+
+
+def _sample_leaves(space: Any, rng: np.random.Generator) -> Any:
+    if isinstance(space, Domain):
+        return space.sample(rng)
+    if isinstance(space, dict):
+        return {k: _sample_leaves(v, rng) for k, v in space.items()}
+    return space
+
+
+class BasicVariantGenerator(Searcher):
+    """Exhaustive grid cross-product × num_samples random samples."""
+
+    def __init__(self, max_concurrent: int = 0, random_state: int = 0):
+        super().__init__()
+        self.max_concurrent = max_concurrent
+        self._rng = np.random.default_rng(random_state or None)
+
+    def generate_variants(self, param_space: Dict,
+                          num_samples: int) -> Iterator[Dict]:
+        grids = _find_grid_leaves(param_space)
+        grid_values = [vals for _, vals in grids]
+        combos = list(itertools.product(*grid_values)) if grids else [()]
+        for _ in range(num_samples):
+            for combo in combos:
+                variant = _sample_leaves(param_space, self._rng)
+                for (path, _), val in zip(grids, combo):
+                    _set_path(variant, path, val)
+                yield variant
+
+    # Searcher interface: basic variants don't adapt to results.
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        return None
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        pass

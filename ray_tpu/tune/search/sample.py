@@ -1,0 +1,118 @@
+"""Search-space primitives.
+
+Reference: python/ray/tune/search/sample.py (Categorical/Float/Integer
+domains and the ``tune.uniform/loguniform/choice/randint/...`` factory
+functions) and python/ray/tune/search/variant_generator.py (grid_search
+marker dicts). Samplers draw from a numpy Generator so variant generation
+is deterministic under a seed.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Sequence
+
+import numpy as np
+
+
+class Domain:
+    """A sampleable hyperparameter domain."""
+
+    def sample(self, rng: np.random.Generator) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return self.categories[int(rng.integers(len(self.categories)))]
+
+    def __repr__(self):
+        return f"choice({self.categories})"
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False,
+                 q: float | None = None):
+        self.lower, self.upper, self.log, self.q = lower, upper, log, q
+
+    def sample(self, rng):
+        if self.log:
+            v = math.exp(rng.uniform(math.log(self.lower),
+                                     math.log(self.upper)))
+        else:
+            v = float(rng.uniform(self.lower, self.upper))
+        if self.q is not None:
+            v = round(v / self.q) * self.q
+        return float(v)
+
+    def __repr__(self):
+        kind = "loguniform" if self.log else "uniform"
+        return f"{kind}({self.lower}, {self.upper})"
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int, log: bool = False):
+        self.lower, self.upper, self.log = lower, upper, log
+
+    def sample(self, rng):
+        if self.log:
+            return int(math.exp(rng.uniform(math.log(self.lower),
+                                            math.log(self.upper))))
+        return int(rng.integers(self.lower, self.upper))
+
+    def __repr__(self):
+        return f"randint({self.lower}, {self.upper})"
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None) if self.fn.__code__.co_argcount else self.fn()
+
+
+# ---- factory API (parity with ray.tune top-level samplers) ----
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def quniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, q=q)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def qloguniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, log=True, q=q)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Function:
+    return Function(lambda: float(np.random.normal(mean, sd)))
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def lograndint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper, log=True)
+
+
+def choice(categories: Sequence[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable) -> Function:
+    return Function(fn)
+
+
+def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
+    """Marker dict; expanded exhaustively by BasicVariantGenerator."""
+    return {"grid_search": list(values)}

@@ -1,0 +1,64 @@
+"""Searcher plugin interface + ConcurrencyLimiter.
+
+Reference: python/ray/tune/search/searcher.py (Searcher base: suggest /
+on_trial_result / on_trial_complete) and concurrency_limiter.py. Adaptive
+searchers (Optuna-style TPE, bayesopt, ...) plug in by implementing
+``suggest``; grid/random search lives in BasicVariantGenerator which
+pre-generates variants instead.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class Searcher:
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric: Optional[str],
+                              mode: Optional[str], config: Dict) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        """Return a config for a new trial, or None if exhausted."""
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions from a wrapped searcher."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if len(self._live) >= self.max_concurrent:
+            return None
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)

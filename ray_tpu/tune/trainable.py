@@ -1,0 +1,235 @@
+"""Trainable APIs: class Trainable, function trainables, the trial actor.
+
+Reference: python/ray/tune/trainable/trainable.py:58 (class API —
+setup/step/save_checkpoint/load_checkpoint) and
+trainable/function_trainable.py (function API driven through a
+RunnerThread + result queue, same pattern as the train session
+python/ray/train/_internal/session.py:111). Both are executed stepwise:
+the controller calls ``train()`` once per iteration, which enables
+ASHA early stopping and PBT exploit/explore without cooperation from
+user code.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import os
+import queue
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+# ---------------------------------------------------------------------------
+# tune session (function API)
+
+_session_lock = threading.local()
+
+
+def _get_session():
+    return getattr(_session_lock, "session", None)
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report one iteration's metrics (and optionally a checkpoint).
+
+    Works inside both tune function trainables and train loops: if no tune
+    session is active, falls through to ray_tpu.train.report.
+    """
+    sess = _get_session()
+    if sess is not None:
+        sess.report(metrics, checkpoint)
+        return
+    from ray_tpu.train._internal import session as train_session
+
+    train_session.report(metrics, checkpoint=checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    sess = _get_session()
+    if sess is not None:
+        return sess.checkpoint
+    from ray_tpu.train._internal import session as train_session
+
+    return train_session.get_checkpoint()
+
+
+class _FnSession:
+    """Thread-side mailbox between the user function and train() calls."""
+
+    def __init__(self, checkpoint: Optional[Checkpoint]):
+        self.checkpoint = checkpoint
+        self.results: "queue.Queue" = queue.Queue(maxsize=1)
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+
+    def report(self, metrics, checkpoint):
+        self.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+
+# ---------------------------------------------------------------------------
+# class API
+
+
+class Trainable:
+    """Subclass and implement setup/step (+ save/load_checkpoint)."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        self.config = config or {}
+        self.iteration = 0
+        self.setup(self.config)
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[str]:
+        return None
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        pass
+
+    def reset_config(self, new_config: Dict[str, Any]) -> bool:
+        """Return True if the trainable reconfigured in place (PBT fast
+        path; otherwise the controller restarts the actor)."""
+        return False
+
+    def cleanup(self) -> None:
+        pass
+
+    def train(self) -> Dict[str, Any]:
+        result = self.step()
+        self.iteration += 1
+        return result
+
+
+class FunctionTrainable(Trainable):
+    """Adapts fn(config) + tune.report() to the stepwise interface."""
+
+    _fn: Callable = None  # set by wrap_function subclassing
+
+    def __init__(self, config=None, checkpoint: Optional[Checkpoint] = None):
+        self._session = _FnSession(checkpoint)
+        self._thread: Optional[threading.Thread] = None
+        super().__init__(config)
+
+    def setup(self, config):
+        fn = type(self)._fn
+
+        def run():
+            _session_lock.session = self._session
+            try:
+                if len(inspect.signature(fn).parameters) >= 1:
+                    fn(dict(config))
+                else:
+                    fn()
+            except BaseException:
+                self._session.error = traceback.format_exc()
+            finally:
+                self._session.done.set()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def step(self):
+        while True:
+            try:
+                item = self._session.results.get(timeout=0.05)
+                break
+            except queue.Empty:
+                if self._session.done.is_set():
+                    # drain any result reported between the last get and done
+                    try:
+                        item = self._session.results.get_nowait()
+                        break
+                    except queue.Empty:
+                        pass
+                    if self._session.error:
+                        raise RuntimeError(
+                            f"trainable failed:\n{self._session.error}")
+                    return {"done": True}
+        out = dict(item["metrics"])
+        out["_tune_checkpoint"] = item["checkpoint"]
+        return out
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[str]:
+        # Function trainables checkpoint via report(checkpoint=...).
+        return None
+
+
+def wrap_function(fn: Callable) -> type:
+    return type(f"fn_{getattr(fn, '__name__', 'trainable')}",
+                (FunctionTrainable,), {"_fn": staticmethod(fn)})
+
+
+# ---------------------------------------------------------------------------
+# trial actor — one per running trial, driven by the TuneController
+
+
+class TrainableActor:
+    """Hosts a Trainable instance inside a ray_tpu actor."""
+
+    def __init__(self, trainable_cls: type, config: Dict[str, Any],
+                 trial_dir: str,
+                 restore_from: Optional[str] = None):
+        os.makedirs(trial_dir, exist_ok=True)
+        self._trial_dir = trial_dir
+        self._ckpt_index = 0
+        self._latest_checkpoint: Optional[str] = restore_from
+        restore_ckpt = Checkpoint(restore_from) if restore_from else None
+        if issubclass(trainable_cls, FunctionTrainable):
+            self._trainable = trainable_cls(config, checkpoint=restore_ckpt)
+        else:
+            self._trainable = trainable_cls(config)
+            if restore_from:
+                self._trainable.load_checkpoint(restore_from)
+        with open(os.path.join(trial_dir, "params.json"), "w") as f:
+            json.dump(config, f, default=str)
+
+    def train(self) -> Dict[str, Any]:
+        result = self._trainable.train()
+        ckpt = result.pop("_tune_checkpoint", None)
+        if ckpt is not None:
+            # persist the function-API checkpoint under the trial dir
+            d = os.path.join(self._trial_dir,
+                             f"checkpoint_{self._ckpt_index:06d}")
+            self._ckpt_index += 1
+            ckpt.to_directory(d)
+            self._latest_checkpoint = d
+        result.setdefault("done", False)
+        result["training_iteration"] = self._trainable.iteration
+        result["timestamp"] = time.time()
+        with open(os.path.join(self._trial_dir, "result.json"), "a") as f:
+            json.dump({k: v for k, v in result.items()
+                       if not k.startswith("_")}, f, default=str)
+            f.write("\n")
+        return result
+
+    def save(self) -> Optional[str]:
+        if isinstance(self._trainable, FunctionTrainable):
+            return self._latest_checkpoint
+        d = os.path.join(self._trial_dir,
+                         f"checkpoint_{self._ckpt_index:06d}")
+        self._ckpt_index += 1
+        os.makedirs(d, exist_ok=True)
+        self._trainable.save_checkpoint(d)
+        self._latest_checkpoint = d
+        return d
+
+    def latest_checkpoint(self) -> Optional[str]:
+        return self._latest_checkpoint
+
+    def reset_config(self, new_config: Dict[str, Any]) -> bool:
+        ok = self._trainable.reset_config(new_config)
+        if ok:
+            self._trainable.config = new_config
+        return ok
+
+    def stop(self) -> None:
+        self._trainable.cleanup()

@@ -1,0 +1,227 @@
+"""TuneController — the experiment event loop.
+
+Reference: python/ray/tune/execution/tune_controller.py:68. Drives trials
+as ray_tpu actors (one TrainableActor per running trial), stepwise: each
+``train()`` actor call produces one result; the controller feeds it to the
+searcher + scheduler, applies stop criteria, and handles PBT
+exploit/explore via checkpoint transfer between actors. Failed trials
+restart from their latest checkpoint up to FailureConfig.max_failures.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.experiment import (ERROR, PENDING, RUNNING, TERMINATED,
+                                     Trial)
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.searcher import Searcher
+from ray_tpu.tune.trainable import TrainableActor
+
+
+class TuneController:
+    def __init__(self,
+                 trainable_cls: type,
+                 param_space: Dict,
+                 *,
+                 num_samples: int = 1,
+                 metric: Optional[str] = None,
+                 mode: str = "max",
+                 scheduler: Optional[TrialScheduler] = None,
+                 search_alg: Optional[Searcher] = None,
+                 max_concurrent_trials: int = 0,
+                 experiment_dir: str = "",
+                 stop: Optional[Dict] = None,
+                 max_failures: int = 0,
+                 trial_resources: Optional[Dict[str, float]] = None):
+        self.trainable_cls = trainable_cls
+        self.metric, self.mode = metric, mode
+        self.scheduler = scheduler or FIFOScheduler()
+        self.scheduler.set_search_properties(metric, mode)
+        self.search_alg = search_alg or BasicVariantGenerator()
+        self.search_alg.set_search_properties(metric, mode, param_space)
+        self.stop = stop or {}
+        self.max_failures = max_failures if max_failures >= 0 else 10 ** 9
+        self.experiment_dir = experiment_dir
+        os.makedirs(experiment_dir, exist_ok=True)
+        self.trial_resources = trial_resources or {"CPU": 1.0}
+
+        # Pending configs: grid/random searchers pre-generate; adaptive
+        # searchers are polled via suggest() as slots open.
+        self._pending: List[Trial] = []
+        self._adaptive = not isinstance(self.search_alg,
+                                        BasicVariantGenerator)
+        if self._adaptive:
+            self._remaining_suggestions = num_samples
+        else:
+            for cfg in self.search_alg.generate_variants(
+                    param_space, num_samples):
+                self._pending.append(Trial(cfg, experiment_dir))
+        if max_concurrent_trials <= 0:
+            ncpu = os.cpu_count() or 8
+            max_concurrent_trials = max(1, min(16, ncpu))
+        self.max_concurrent = max_concurrent_trials
+
+        self.trials: List[Trial] = list(self._pending)
+        self._actors: Dict[str, object] = {}        # trial_id -> handle
+        self._inflight: Dict[object, Trial] = {}    # train() ref -> trial
+        self._actor_cls = ray_tpu.remote(TrainableActor)
+
+    # ------------------------------------------------------------------
+    def _launch(self, trial: Trial, restore_from: Optional[str] = None):
+        opts = {"num_cpus": self.trial_resources.get("CPU", 1.0)}
+        custom = {k: v for k, v in self.trial_resources.items()
+                  if k != "CPU"}
+        if "TPU" in custom:
+            opts["num_tpus"] = custom.pop("TPU")
+        if custom:
+            opts["resources"] = custom
+        handle = self._actor_cls.options(**opts).remote(
+            self.trainable_cls, trial.config, trial.trial_dir,
+            restore_from=restore_from or trial.checkpoint_path)
+        trial.status = RUNNING
+        self._actors[trial.trial_id] = handle
+        ref = handle.train.remote()
+        self._inflight[ref] = trial
+
+    def _stop_actor(self, trial: Trial):
+        handle = self._actors.pop(trial.trial_id, None)
+        if handle is None:
+            return
+        try:
+            ray_tpu.get(handle.stop.remote(), timeout=5)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(handle)
+        except Exception:
+            pass
+        self._inflight = {r: t for r, t in self._inflight.items()
+                          if t.trial_id != trial.trial_id}
+
+    def _next_trial(self) -> Optional[Trial]:
+        if self._pending:
+            return self._pending.pop(0)
+        if self._adaptive and self._remaining_suggestions > 0:
+            t = Trial({}, self.experiment_dir)
+            cfg = self.search_alg.suggest(t.trial_id)
+            if cfg is None:
+                return None
+            self._remaining_suggestions -= 1
+            t.config = cfg
+            self.trials.append(t)
+            return t
+        return None
+
+    def _should_stop(self, result: Dict) -> bool:
+        if result.get("done"):
+            return True
+        for k, v in self.stop.items():
+            if k in result and result[k] >= v:
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    def exploit(self, trial: Trial, donor_id: str,
+                explore_fn: Callable[[Dict], Dict]) -> None:
+        """PBT: restart `trial` from `donor`'s checkpoint with a mutated
+        config (reference pbt.py _exploit)."""
+        donor = next((t for t in self.trials if t.trial_id == donor_id), None)
+        if donor is None:
+            return
+        donor_handle = self._actors.get(donor_id)
+        ckpt = None
+        if donor_handle is not None:
+            try:
+                ckpt = ray_tpu.get(donor_handle.save.remote(), timeout=60)
+            except Exception:
+                ckpt = donor.checkpoint_path
+        else:
+            ckpt = donor.checkpoint_path
+        if not ckpt:
+            return
+        donor.checkpoint_path = ckpt
+        new_config = explore_fn(donor.config)
+        self._stop_actor(trial)
+        trial.config = new_config
+        trial.checkpoint_path = ckpt
+        self._launch(trial, restore_from=ckpt)
+
+    # ------------------------------------------------------------------
+    def step(self) -> bool:
+        """One controller iteration. Returns False when the run is over."""
+        # fill open slots
+        while len(self._actors) < self.max_concurrent:
+            trial = self._next_trial()
+            if trial is None:
+                break
+            self._launch(trial)
+
+        if not self._inflight:
+            return False
+
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                timeout=60.0)
+        for ref in ready:
+            # A trial processed earlier in this batch may have exploited
+            # this one, dropping its in-flight ref.
+            trial = self._inflight.pop(ref, None)
+            if trial is None or trial.trial_id not in self._actors:
+                continue
+            handle = self._actors[trial.trial_id]
+            try:
+                result = ray_tpu.get(ref)
+            except Exception as e:  # trial crashed
+                trial.num_failures += 1
+                self.search_alg.on_trial_result(trial.trial_id,
+                                                {"error": str(e)})
+                self._stop_actor(trial)
+                if trial.num_failures <= self.max_failures:
+                    self._launch(trial)  # restart from latest checkpoint
+                else:
+                    trial.status = ERROR
+                    trial.error = str(e)
+                    self.search_alg.on_trial_complete(
+                        trial.trial_id, error=True)
+                continue
+
+            # Merge so the bare {"done": True} end-of-function sentinel
+            # doesn't clobber the last real metrics.
+            trial.last_result = {**trial.last_result, **result}
+            trial.results.append(result)
+            self.search_alg.on_trial_result(trial.trial_id, result)
+            decision = self.scheduler.on_trial_result(self, trial, result)
+            if self._should_stop(result) or decision == sched_mod.STOP:
+                # capture the final checkpoint before teardown
+                try:
+                    ckpt = ray_tpu.get(
+                        handle.latest_checkpoint.remote(), timeout=30)
+                    if ckpt:
+                        trial.checkpoint_path = ckpt
+                except Exception:
+                    pass
+                trial.status = TERMINATED
+                self.search_alg.on_trial_complete(trial.trial_id, result)
+                self.scheduler.on_trial_complete(self, trial, result)
+                self._stop_actor(trial)
+            else:
+                if trial.trial_id in self._actors:
+                    nref = self._actors[trial.trial_id].train.remote()
+                    self._inflight[nref] = trial
+        return bool(self._inflight or self._pending or
+                    (self._adaptive and self._remaining_suggestions > 0))
+
+    def run(self) -> List[Trial]:
+        try:
+            while self.step():
+                pass
+        finally:
+            for trial in self.trials:
+                if trial.status == RUNNING:
+                    trial.status = TERMINATED
+                self._stop_actor(trial)
+        return self.trials

@@ -1,0 +1,152 @@
+"""Tuner — the public tuning entrypoint.
+
+Reference: python/ray/tune/tuner.py:44 (`Tuner`, `fit` :344) and
+tune_config.py (TuneConfig). Accepts a function trainable, a Trainable
+subclass, or a ray_tpu.train trainer instance (whose param_space may
+override ``train_loop_config``, mirroring base_trainer.py:608's
+Trainer↔Tune coupling — inverted here: the Tuner wraps the trainer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import os
+import uuid
+from typing import Any, Callable, Dict, Optional, Union
+
+from ray_tpu.air import RunConfig
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.search.searcher import Searcher
+from ray_tpu.tune.trainable import Trainable, report, wrap_function
+from ray_tpu.tune.tune_controller import TuneController
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 0
+    scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Searcher] = None
+
+
+def with_resources(trainable, resources: Dict[str, float]):
+    """Attach per-trial resource requests (reference tune/trainable/util)."""
+    trainable.__ray_tpu_resources__ = dict(resources)
+    return trainable
+
+
+def with_parameters(fn: Callable, **params):
+    """Bind large constant objects to a function trainable."""
+
+    def inner(config):
+        return fn(config, **params)
+
+    inner.__name__ = getattr(fn, "__name__", "trainable")
+    if hasattr(fn, "__ray_tpu_resources__"):
+        inner.__ray_tpu_resources__ = fn.__ray_tpu_resources__
+    return inner
+
+
+def _trainer_to_fn(trainer) -> Callable:
+    """Wrap a train.*Trainer so each trial re-fits it with the trial's
+    config merged into train_loop_config."""
+    import copy
+
+    def fit_trial(config):
+        t = copy.copy(trainer)
+        loop_cfg = dict(t.train_loop_config or {})
+        loop_cfg.update(config.get("train_loop_config", config))
+        t.train_loop_config = loop_cfg
+        if "scaling_config" in config:
+            t.scaling_config = config["scaling_config"]
+        # Isolate each trial's storage: sharing the trainer's RunConfig
+        # name would make concurrent trials resume from (and prune) each
+        # other's checkpoints.
+        t.run_config = copy.copy(t.run_config)
+        t.run_config.name = (f"{t.run_config.name or 'trainer'}"
+                             f"_{uuid.uuid4().hex[:8]}")
+        result = t.fit()
+        if result.error:
+            raise result.error
+        metrics = dict(result.metrics or {})
+        report(metrics, checkpoint=result.checkpoint)
+
+    fit_trial.__name__ = f"fit_{type(trainer).__name__}"
+    return fit_trial
+
+
+class Tuner:
+    def __init__(self,
+                 trainable: Union[Callable, type, Any],
+                 *,
+                 param_space: Optional[Dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        self._resources = getattr(trainable, "__ray_tpu_resources__", None)
+
+        from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+        if isinstance(trainable, DataParallelTrainer):
+            # trainer workers hold the real resources; the driver trial is
+            # lightweight
+            self._resources = self._resources or {"CPU": 0.5}
+            trainable = _trainer_to_fn(trainable)
+        if isinstance(trainable, type) and issubclass(trainable, Trainable):
+            self._trainable_cls = trainable
+        elif callable(trainable):
+            self._trainable_cls = wrap_function(trainable)
+        else:
+            raise TypeError(f"unsupported trainable: {trainable!r}")
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        cfg = self._tune_config
+        run = self._run_config
+        name = run.name or f"tune_{uuid.uuid4().hex[:8]}"
+        exp_dir = os.path.join(run.resolved_storage_path(), name)
+        failure = run.failure_config
+        controller = TuneController(
+            self._trainable_cls,
+            self._param_space,
+            num_samples=cfg.num_samples,
+            metric=cfg.metric,
+            mode=cfg.mode,
+            scheduler=cfg.scheduler,
+            search_alg=cfg.search_alg,
+            max_concurrent_trials=cfg.max_concurrent_trials,
+            experiment_dir=exp_dir,
+            stop=getattr(run, "stop", None),
+            max_failures=failure.max_failures if failure else 0,
+            trial_resources=self._resources)
+        trials = controller.run()
+        return ResultGrid(trials, cfg.metric, cfg.mode)
+
+
+def run(trainable, *, config: Optional[Dict] = None, num_samples: int = 1,
+        metric: Optional[str] = None, mode: str = "max",
+        scheduler: Optional[TrialScheduler] = None,
+        search_alg: Optional[Searcher] = None,
+        stop: Optional[Dict] = None,
+        storage_path: Optional[str] = None,
+        name: Optional[str] = None) -> ResultGrid:
+    """Legacy ``tune.run`` convenience API (reference tune/tune.py)."""
+    rc = RunConfig(name=name, storage_path=storage_path)
+    rc.stop = stop  # type: ignore[attr-defined]
+    return Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples, scheduler=scheduler,
+                               search_alg=search_alg),
+        run_config=rc,
+    ).fit()

@@ -112,12 +112,32 @@ def test_read_write_files(tmp_path):
     assert len(out) == 20 and set(out.columns) == {"a", "b"}
 
 
-def test_streaming_split():
+def test_streaming_split_sequential_no_deadlock():
+    # Blocks are dispatched on demand (first-come-first-served), so
+    # draining splits one at a time must not deadlock; the first consumer
+    # may take everything.
     ds = rd.range(90, parallelism=6)
     splits = ds.streaming_split(3)
     counts = [s.count() for s in splits]
     assert sum(counts) == 90
-    assert all(c > 0 for c in counts)
+
+
+def test_streaming_split_concurrent_consumers():
+    import threading
+
+    ds = rd.range(120, parallelism=8)
+    splits = ds.streaming_split(3)
+    counts = [0] * 3
+
+    def consume(i):
+        counts[i] = splits[i].count()
+
+    threads = [threading.Thread(target=consume, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert sum(counts) == 120
 
 
 def test_tensor_columns():
@@ -125,6 +145,25 @@ def test_tensor_columns():
     ds = rd.from_numpy({"feat": arr, "label": np.arange(10)})
     out = ds.to_numpy()
     np.testing.assert_allclose(out["feat"], arr)
+
+
+def test_tensor_columns_ndim3_roundtrip():
+    # >2-D tensors keep their shape through the Arrow block encoding
+    img = np.arange(10 * 3 * 4, dtype=np.float32).reshape(10, 3, 4)
+    ds = rd.from_numpy({"img": img})
+    out = ds.to_numpy()
+    assert out["img"].shape == (10, 3, 4)
+    np.testing.assert_allclose(out["img"], img)
+    # and through a map_batches round-trip
+    out2 = ds.map_batches(lambda b: {"img": b["img"] * 2}).to_numpy()
+    assert out2["img"].shape == (10, 3, 4)
+    np.testing.assert_allclose(out2["img"], img * 2)
+
+
+def test_take_preserves_order():
+    ds = rd.range(100, parallelism=8)
+    assert [r["id"] for r in ds.take(10)] == list(range(10))
+    assert [r["id"] for r in ds.take_all()] == list(range(100))
 
 
 def test_streaming_split_in_train_worker(tmp_path):
