@@ -361,6 +361,12 @@ class CoreWorker:
                    for ref in refs}
         ready: List[ObjectRef] = []
         try:
+            # One scheduling pass so each _ready probe runs its first local
+            # availability check even with timeout=0 (ray.wait(timeout=0)
+            # must report already-available objects).
+            await asyncio.sleep(0)
+            ready = [r for r, f in pending.items()
+                     if f.done() and not f.cancelled() and f.result()]
             while len(ready) < num_returns:
                 remaining = _remaining(deadline)
                 if remaining is not None and remaining <= 0:

@@ -1,0 +1,48 @@
+"""ray_tpu.serve — scalable model serving on the TPU-native runtime.
+
+Reference: python/ray/serve/__init__.py public API. Architecture mirrors
+the reference (controller actor + HTTP proxy + power-of-two router +
+replica actors) with TPU-first replicas: deployments hold jitted JAX
+callables and the router keeps batches large for the MXU.
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, \
+    HTTPOptions
+from ray_tpu.serve.deployment import Application, Deployment, deployment, \
+    ingress
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve._private.proxy import ServeRequest
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "HTTPOptions",
+    "ServeRequest",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "get_multiplexed_model_id",
+    "ingress",
+    "multiplexed",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

@@ -1,0 +1,136 @@
+"""Application-level state: a named group of deployments + a route prefix.
+
+Reference: python/ray/serve/_private/application_state.py —
+ApplicationState (:119) owns its deployments' target state and aggregates
+their statuses; ApplicationStateManager reconciles all apps.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.serve.config import DeploymentConfig
+from ray_tpu.serve._private.common import (
+    ApplicationStatus, ApplicationStatusInfo, DeploymentID,
+    DeploymentStatus)
+from ray_tpu.serve._private.deployment_state import DeploymentStateManager
+
+logger = logging.getLogger(__name__)
+
+
+class ApplicationState:
+    def __init__(self, name: str, deployment_state_manager:
+                 DeploymentStateManager):
+        self.name = name
+        self.route_prefix: Optional[str] = None
+        self.ingress_deployment: Optional[str] = None
+        self.deployment_names: List[str] = []
+        self.status = ApplicationStatus.NOT_STARTED
+        self.message = ""
+        self.deployed_at = time.time()
+        self.deleting = False
+        self._dsm = deployment_state_manager
+
+    def deploy(self, deployments: List[dict],
+               route_prefix: Optional[str]) -> None:
+        """deployments: [{name, serialized_def, init_args_blob, config_dict,
+        is_ingress}]"""
+        self.route_prefix = route_prefix
+        self.deployed_at = time.time()
+        self.deleting = False
+        new_names = []
+        for d in deployments:
+            did = DeploymentID(d["name"], self.name)
+            config = DeploymentConfig.from_dict(d["config_dict"])
+            self._dsm.deploy(did, d["serialized_def"], d["init_args_blob"],
+                             config)
+            new_names.append(d["name"])
+            if d.get("is_ingress"):
+                self.ingress_deployment = d["name"]
+        # Remove deployments dropped from the app definition.
+        for name in self.deployment_names:
+            if name not in new_names:
+                self._dsm.delete(DeploymentID(name, self.name))
+        self.deployment_names = new_names
+        self.status = ApplicationStatus.DEPLOYING
+
+    def delete(self) -> None:
+        self.deleting = True
+        self.status = ApplicationStatus.DELETING
+        for name in self.deployment_names:
+            self._dsm.delete(DeploymentID(name, self.name))
+
+    def update_status(self) -> None:
+        if self.deleting:
+            if not self._dsm.states_for_app(self.name):
+                self.status = ApplicationStatus.NOT_STARTED
+            return
+        infos = [self._dsm.get(DeploymentID(n, self.name)).curr_status_info()
+                 for n in self.deployment_names
+                 if self._dsm.get(DeploymentID(n, self.name)) is not None]
+        if any(i.status == DeploymentStatus.UNHEALTHY for i in infos):
+            self.status = ApplicationStatus.DEPLOY_FAILED
+            self.message = "; ".join(
+                i.message for i in infos
+                if i.status == DeploymentStatus.UNHEALTHY)
+        elif all(i.status == DeploymentStatus.HEALTHY for i in infos):
+            self.status = ApplicationStatus.RUNNING
+            self.message = ""
+        else:
+            self.status = ApplicationStatus.DEPLOYING
+
+    def status_info(self) -> ApplicationStatusInfo:
+        deployments = {}
+        for n in self.deployment_names:
+            st = self._dsm.get(DeploymentID(n, self.name))
+            if st is not None:
+                deployments[n] = st.curr_status_info()
+        return ApplicationStatusInfo(
+            name=self.name, status=self.status, message=self.message,
+            deployed_at=self.deployed_at, deployments=deployments,
+            route_prefix=self.route_prefix)
+
+    def is_deleted(self) -> bool:
+        return self.deleting and not self._dsm.states_for_app(self.name)
+
+
+class ApplicationStateManager:
+    def __init__(self, deployment_state_manager: DeploymentStateManager):
+        self._dsm = deployment_state_manager
+        self._apps: Dict[str, ApplicationState] = {}
+
+    def deploy_app(self, name: str, deployments: List[dict],
+                   route_prefix: Optional[str]) -> None:
+        if name not in self._apps:
+            self._apps[name] = ApplicationState(name, self._dsm)
+        self._apps[name].deploy(deployments, route_prefix)
+
+    def delete_app(self, name: str) -> None:
+        if name in self._apps:
+            self._apps[name].delete()
+
+    def get(self, name: str) -> Optional[ApplicationState]:
+        return self._apps.get(name)
+
+    def update_all(self) -> None:
+        for app in list(self._apps.values()):
+            app.update_status()
+        for name in [n for n, a in self._apps.items() if a.is_deleted()]:
+            del self._apps[name]
+
+    def route_table(self) -> Dict[str, dict]:
+        """{route_prefix: {app_name, ingress_deployment}} for the proxy."""
+        table = {}
+        for app in self._apps.values():
+            if app.route_prefix and app.ingress_deployment and \
+                    not app.deleting:
+                table[app.route_prefix] = {
+                    "app_name": app.name,
+                    "deployment": app.ingress_deployment,
+                }
+        return table
+
+    def all_status_infos(self) -> Dict[str, ApplicationStatusInfo]:
+        return {n: a.status_info() for n, a in self._apps.items()}

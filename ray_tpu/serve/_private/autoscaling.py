@@ -1,0 +1,60 @@
+"""Request-driven deployment autoscaling.
+
+Reference: python/ray/serve/_private/autoscaling_state.py +
+python/ray/serve/autoscaling_policy.py — desired = ceil(total_ongoing /
+target_ongoing_requests), clamped to [min, max], applied only after the
+decision has held for upscale_delay_s / downscale_delay_s.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+from ray_tpu.serve.config import AutoscalingConfig
+
+
+class AutoscalingState:
+    def __init__(self, config: AutoscalingConfig):
+        self.config = config
+        self._metrics: Deque[Tuple[float, float]] = deque()  # (ts, ongoing)
+        self._decision_value: Optional[int] = None
+        self._decision_since: float = 0.0
+
+    def record(self, total_ongoing_requests: float) -> None:
+        now = time.time()
+        self._metrics.append((now, total_ongoing_requests))
+        cutoff = now - self.config.look_back_period_s
+        while self._metrics and self._metrics[0][0] < cutoff:
+            self._metrics.popleft()
+
+    def _avg_ongoing(self) -> float:
+        if not self._metrics:
+            return 0.0
+        return sum(v for _, v in self._metrics) / len(self._metrics)
+
+    def desired_replicas(self, current: int) -> int:
+        cfg = self.config
+        avg = self._avg_ongoing()
+        raw = math.ceil(avg / max(cfg.target_ongoing_requests, 1e-9))
+        if raw > current and cfg.upscaling_factor:
+            raw = min(raw, math.ceil(current * cfg.upscaling_factor) or 1)
+        if raw < current and cfg.downscaling_factor:
+            raw = max(raw, int(current * cfg.downscaling_factor))
+        desired = min(max(raw, cfg.min_replicas), cfg.max_replicas)
+        now = time.time()
+        if desired == current:
+            self._decision_value = None
+            return current
+        if self._decision_value != desired:
+            self._decision_value = desired
+            self._decision_since = now
+            return current
+        delay = (cfg.upscale_delay_s if desired > current
+                 else cfg.downscale_delay_s)
+        if now - self._decision_since >= delay:
+            self._decision_value = None
+            return desired
+        return current

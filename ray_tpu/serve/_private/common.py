@@ -1,0 +1,127 @@
+"""Shared Serve types.
+
+Reference: python/ray/serve/_private/common.py (DeploymentID, ReplicaID,
+DeploymentStatus, ApplicationStatus, RunningReplicaInfo).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+SERVE_CONTROLLER_NAME = "SERVE_CONTROLLER"
+SERVE_NAMESPACE = "serve"
+SERVE_DEFAULT_APP_NAME = "default"
+
+
+@dataclass(frozen=True)
+class DeploymentID:
+    name: str
+    app_name: str = SERVE_DEFAULT_APP_NAME
+
+    def __str__(self) -> str:
+        return f"{self.app_name}#{self.name}"
+
+    def to_replica_actor_prefix(self) -> str:
+        return f"SERVE_REPLICA::{self.app_name}#{self.name}"
+
+
+class DeploymentStatus(str, enum.Enum):
+    UPDATING = "UPDATING"
+    HEALTHY = "HEALTHY"
+    UNHEALTHY = "UNHEALTHY"
+    UPSCALING = "UPSCALING"
+    DOWNSCALING = "DOWNSCALING"
+
+
+class ApplicationStatus(str, enum.Enum):
+    NOT_STARTED = "NOT_STARTED"
+    DEPLOYING = "DEPLOYING"
+    RUNNING = "RUNNING"
+    DEPLOY_FAILED = "DEPLOY_FAILED"
+    DELETING = "DELETING"
+    UNHEALTHY = "UNHEALTHY"
+
+
+class ReplicaState(str, enum.Enum):
+    STARTING = "STARTING"
+    RUNNING = "RUNNING"
+    STOPPING = "STOPPING"
+
+
+@dataclass
+class RunningReplicaInfo:
+    """What routers need to reach a replica: its named-actor name.
+
+    The reference ships ActorHandles in LongPoll snapshots
+    (python/ray/serve/_private/common.py RunningReplicaInfo); here replicas
+    are *named* actors so routers resolve handles with ray_tpu.get_actor —
+    handles stay process-local.
+    """
+
+    replica_id: str
+    actor_name: str
+    deployment: str
+    app_name: str
+    max_ongoing_requests: int = 5
+
+    def to_dict(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "actor_name": self.actor_name,
+            "deployment": self.deployment,
+            "app_name": self.app_name,
+            "max_ongoing_requests": self.max_ongoing_requests,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "RunningReplicaInfo":
+        return RunningReplicaInfo(**d)
+
+
+@dataclass
+class RequestMetadata:
+    """Per-request routing metadata (reference:
+    python/ray/serve/_private/common.py RequestMetadata)."""
+
+    request_id: str = ""
+    call_method: str = "__call__"
+    multiplexed_model_id: str = ""
+    is_http_request: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "call_method": self.call_method,
+            "multiplexed_model_id": self.multiplexed_model_id,
+            "is_http_request": self.is_http_request,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "RequestMetadata":
+        return RequestMetadata(**d)
+
+
+@dataclass
+class DeploymentStatusInfo:
+    name: str
+    status: DeploymentStatus
+    message: str = ""
+    replica_states: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class ApplicationStatusInfo:
+    name: str
+    status: ApplicationStatus
+    message: str = ""
+    deployed_at: float = field(default_factory=time.time)
+    deployments: Dict[str, DeploymentStatusInfo] = field(default_factory=dict)
+    route_prefix: Optional[str] = None
+
+
+def format_replica_actor_name(deployment_id: DeploymentID,
+                              replica_suffix: str) -> str:
+    return f"{deployment_id.to_replica_actor_prefix()}#{replica_suffix}"

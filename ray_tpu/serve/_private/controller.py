@@ -1,0 +1,206 @@
+"""Serve controller: the cluster-singleton control plane actor.
+
+Reference: python/ray/serve/_private/controller.py — ServeController (:86)
+owns ApplicationStateManager + DeploymentStateManager + autoscaling +
+proxy state, runs a reconcile loop, broadcasts config via LongPollHost,
+and checkpoints its state to the GCS KV so a restarted controller recovers
+(reference :222 run_control_loop, :722 deploy_application).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core import serialization as ser
+from ray_tpu.serve.config import HTTPOptions
+from ray_tpu.serve._private.application_state import ApplicationStateManager
+from ray_tpu.serve._private.autoscaling import AutoscalingState
+from ray_tpu.serve._private.common import (DeploymentID, SERVE_NAMESPACE)
+from ray_tpu.serve._private.deployment_state import DeploymentStateManager
+from ray_tpu.serve._private.long_poll import LongPollHost
+
+logger = logging.getLogger(__name__)
+
+CONTROL_LOOP_INTERVAL_S = 0.2
+CHECKPOINT_KEY = b"serve:controller_checkpoint"
+ROUTE_TABLE_KEY = "route_table"
+
+
+def replicas_key(app_name: str, deployment: str) -> str:
+    return f"replicas::{app_name}#{deployment}"
+
+
+@ray_tpu.remote(max_concurrency=1000, lifetime="detached",
+                namespace=SERVE_NAMESPACE)
+class ServeController:
+    def __init__(self, http_options: Optional[dict] = None):
+        self._long_poll = LongPollHost()
+        self._dsm = DeploymentStateManager(self._on_running_changed)
+        self._asm = ApplicationStateManager(self._dsm)
+        self._autoscaling: Dict[DeploymentID, AutoscalingState] = {}
+        self._http_options = http_options or HTTPOptions().to_dict()
+        self._proxy_handle = None
+        self._shutting_down = False
+        self._loop_running = False
+        self._app_blobs: Dict[str, dict] = {}  # for checkpoint/recovery
+        self._recover_from_checkpoint()
+        import threading
+
+        self._loop_thread = threading.Thread(
+            target=self._control_loop, daemon=True, name="serve-control-loop")
+        self._loop_thread.start()
+
+    # -------------------------------------------------------- control loop
+    def run_control_loop(self) -> None:
+        """API-parity no-op: the loop starts in __init__ (daemon thread) so
+        a recovered controller reconciles without an external kick."""
+        return
+
+    def _control_loop(self) -> None:
+        """Reconcile forever. Plain thread: blocking ray_tpu.get/wait are
+        safe here (they hop to the worker's io loop)."""
+        if self._loop_running:
+            return
+        self._loop_running = True
+        last_autoscale = 0.0
+        while not self._shutting_down:
+            try:
+                self._dsm.reconcile_all()
+                self._asm.update_all()
+                now = time.time()
+                if now - last_autoscale >= 1.0:
+                    self._run_autoscaling_pass()
+                    last_autoscale = now
+                self._long_poll.notify_if_changed(
+                    ROUTE_TABLE_KEY, self._asm.route_table())
+            except Exception:
+                logger.exception("control loop iteration failed")
+            time.sleep(CONTROL_LOOP_INTERVAL_S)
+
+    def _run_autoscaling_pass(self) -> None:
+        for did, state in self._dsm.all_states().items():
+            cfg = state.target_config
+            if cfg is None or cfg.autoscaling_config is None:
+                self._autoscaling.pop(did, None)
+                continue
+            if did not in self._autoscaling:
+                self._autoscaling[did] = AutoscalingState(
+                    cfg.autoscaling_config)
+            a = self._autoscaling[did]
+            a.config = cfg.autoscaling_config
+            state.collect_autoscaling_stats()
+            a.record(state.total_ongoing_requests())
+            desired = a.desired_replicas(state.target_num_replicas)
+            if desired != state.target_num_replicas:
+                logger.info("autoscaling %s: %d -> %d replicas", did,
+                            state.target_num_replicas, desired)
+                state.set_target_num_replicas(desired)
+
+    def _on_running_changed(self, deployment_id: DeploymentID,
+                            infos: List[dict]) -> None:
+        self._long_poll.notify_changed(
+            replicas_key(deployment_id.app_name, deployment_id.name), infos)
+
+    # ----------------------------------------------------------- public API
+    def deploy_application(self, name: str, deployments: List[dict],
+                           route_prefix: Optional[str]) -> None:
+        logger.info("deploying application %r (%d deployments)", name,
+                    len(deployments))
+        self._asm.deploy_app(name, deployments, route_prefix)
+        self._app_blobs[name] = {"deployments": deployments,
+                                 "route_prefix": route_prefix}
+        self._checkpoint()
+        self._long_poll.notify_changed(ROUTE_TABLE_KEY,
+                                       self._asm.route_table())
+
+    def delete_application(self, name: str) -> None:
+        self._asm.delete_app(name)
+        self._app_blobs.pop(name, None)
+        self._checkpoint()
+        self._long_poll.notify_changed(ROUTE_TABLE_KEY,
+                                       self._asm.route_table())
+
+    def get_app_statuses(self) -> Dict[str, dict]:
+        out = {}
+        for name, info in self._asm.all_status_infos().items():
+            out[name] = {
+                "status": info.status.value,
+                "message": info.message,
+                "deployed_at": info.deployed_at,
+                "route_prefix": info.route_prefix,
+                "deployments": {
+                    dn: {"status": di.status.value, "message": di.message,
+                         "replica_states": di.replica_states}
+                    for dn, di in info.deployments.items()},
+            }
+        return out
+
+    def get_running_replicas(self, app_name: str,
+                             deployment: str) -> List[dict]:
+        state = self._dsm.get(DeploymentID(deployment, app_name))
+        return state.running_replica_infos() if state else []
+
+    def get_route_table(self) -> Dict[str, dict]:
+        return self._asm.route_table()
+
+    def get_http_options(self) -> dict:
+        return self._http_options
+
+    def set_proxy_started(self) -> None:
+        self._proxy_started = True
+
+    async def listen_for_change(self, keys_to_snapshot_ids: Dict[str, int]
+                                ) -> dict:
+        return await self._long_poll.listen_for_change(keys_to_snapshot_ids)
+
+    def graceful_shutdown(self) -> None:
+        """Delete all apps and wait for replicas to stop."""
+        self._shutting_down = True
+        for name in list(self._asm.all_status_infos()):
+            self._asm.delete_app(name)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            self._dsm.reconcile_all()
+            self._asm.update_all()
+            if not self._dsm.all_states():
+                break
+            time.sleep(0.1)
+        try:
+            worker = ray_tpu.get_runtime_context()._worker
+            worker.gcs_call("kv_del", {"ns": b"serve", "key": CHECKPOINT_KEY})
+        except Exception:
+            pass
+
+    # ---------------------------------------------------------- checkpoint
+    def _checkpoint(self) -> None:
+        """Persist app definitions to GCS KV (reference: controller
+        checkpointing via _private/storage/kv_store.py)."""
+        try:
+            worker = ray_tpu.get_runtime_context()._worker
+            worker.gcs_call("kv_put", {
+                "ns": b"serve", "key": CHECKPOINT_KEY,
+                "value": ser.dumps(self._app_blobs)})
+        except Exception:
+            logger.exception("controller checkpoint failed")
+
+    def _recover_from_checkpoint(self) -> None:
+        try:
+            worker = ray_tpu.get_runtime_context()._worker
+            blob = worker.gcs_call("kv_get", {"ns": b"serve",
+                                              "key": CHECKPOINT_KEY})
+            if not blob:
+                return
+            apps = ser.loads(blob)
+        except Exception:
+            return
+        for name, app in apps.items():
+            try:
+                self._asm.deploy_app(name, app["deployments"],
+                                     app["route_prefix"])
+                self._app_blobs[name] = app
+            except Exception:
+                logger.exception("failed to recover app %r", name)

@@ -1,0 +1,352 @@
+"""Deployment replica rollout state machine.
+
+Reference: python/ray/serve/_private/deployment_state.py —
+DeploymentState (:1226) reconciles target config vs live replicas
+(DeploymentReplica :879): scale up/down, rolling update on version change,
+health checking, graceful stop. Runs inside the controller's control loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core import serialization as ser
+from ray_tpu.serve.config import DeploymentConfig
+from ray_tpu.serve._private.common import (
+    DeploymentID, DeploymentStatus, DeploymentStatusInfo, ReplicaState,
+    RunningReplicaInfo, SERVE_NAMESPACE, format_replica_actor_name)
+
+logger = logging.getLogger(__name__)
+
+
+class DeploymentVersion:
+    """Code + user_config hash; a change triggers rolling update
+    (reference deployment_state.py DeploymentVersion)."""
+
+    @staticmethod
+    def compute(serialized_def: bytes, config: DeploymentConfig) -> str:
+        if config.version:
+            return config.version
+        h = hashlib.sha1(serialized_def)
+        h.update(repr(config.user_config).encode())
+        return h.hexdigest()[:16]
+
+
+class DeploymentReplica:
+    """Tracks one replica actor through STARTING → RUNNING → STOPPING."""
+
+    _counter = 0
+
+    def __init__(self, deployment_id: DeploymentID, version: str):
+        DeploymentReplica._counter += 1
+        self.replica_id = f"{deployment_id.name}#{DeploymentReplica._counter:05d}"
+        self.actor_name = format_replica_actor_name(
+            deployment_id, f"{DeploymentReplica._counter:05d}")
+        self.deployment_id = deployment_id
+        self.version = version
+        self.state = ReplicaState.STARTING
+        self.handle = None
+        self.ready_ref = None
+        self.stop_ref = None
+        self.last_health_check: float = time.time()
+        self.health_ref = None
+        self.num_ongoing: int = 0
+
+    def start(self, serialized_def: bytes, init_args_blob: bytes,
+              config: DeploymentConfig) -> None:
+        from ray_tpu.serve._private.replica import ReplicaActor
+
+        actor_options = dict(config.ray_actor_options)
+        actor_options.update(
+            name=self.actor_name,
+            namespace=SERVE_NAMESPACE,
+            lifetime="detached",
+            max_concurrency=max(config.max_ongoing_requests * 2, 16),
+        )
+        self.handle = ReplicaActor.options(**actor_options).remote(
+            self.replica_id, self.deployment_id.name,
+            self.deployment_id.app_name, serialized_def, init_args_blob,
+            config.to_dict())
+        # First call resolves once __init__ finished.
+        self.ready_ref = self.handle.get_metadata.remote()
+
+    def check_started(self) -> Optional[bool]:
+        """True=ready, False=failed, None=still starting."""
+        if self.ready_ref is None:
+            return True
+        done, _ = ray_tpu.wait([self.ready_ref], timeout=0)
+        if not done:
+            return None
+        try:
+            ray_tpu.get(self.ready_ref)
+            self.ready_ref = None
+            self.state = ReplicaState.RUNNING
+            return True
+        except Exception as e:
+            logger.error("replica %s failed to start: %s", self.replica_id, e)
+            return False
+
+    def begin_stop(self, timeout_s: float) -> None:
+        self.state = ReplicaState.STOPPING
+        if self.handle is not None:
+            try:
+                self.stop_ref = self.handle.prepare_for_shutdown.remote(
+                    timeout_s)
+            except Exception:
+                self.stop_ref = None
+
+    def check_stopped(self) -> bool:
+        if self.handle is None:
+            return True
+        if self.stop_ref is not None:
+            done, _ = ray_tpu.wait([self.stop_ref], timeout=0)
+            if not done:
+                return False
+            self.stop_ref = None
+        try:
+            ray_tpu.kill(self.handle)
+        except Exception:
+            pass
+        self.handle = None
+        return True
+
+    def running_info(self, config: DeploymentConfig) -> RunningReplicaInfo:
+        return RunningReplicaInfo(
+            replica_id=self.replica_id,
+            actor_name=self.actor_name,
+            deployment=self.deployment_id.name,
+            app_name=self.deployment_id.app_name,
+            max_ongoing_requests=config.max_ongoing_requests)
+
+
+class DeploymentState:
+    def __init__(self, deployment_id: DeploymentID,
+                 on_running_replicas_changed):
+        self.deployment_id = deployment_id
+        self.target_config: Optional[DeploymentConfig] = None
+        self.target_version: Optional[str] = None
+        self.target_num_replicas: int = 0
+        self.serialized_def: bytes = b""
+        self.init_args_blob: bytes = ser.dumps(((), {}))
+        self.replicas: List[DeploymentReplica] = []
+        self.deleting = False
+        self.message = ""
+        self._on_running_changed = on_running_replicas_changed
+        self._last_broadcast: Optional[list] = None
+        self._consecutive_start_failures = 0
+
+    # ------------------------------------------------------------- targets
+    def deploy(self, serialized_def: bytes, init_args_blob: bytes,
+               config: DeploymentConfig) -> None:
+        version = DeploymentVersion.compute(serialized_def, config)
+        self.serialized_def = serialized_def
+        self.init_args_blob = init_args_blob
+        self.target_config = config
+        self.target_version = version
+        self.deleting = False
+        if config.autoscaling_config is not None:
+            ac = config.autoscaling_config
+            current = self.target_num_replicas or (
+                ac.initial_replicas if ac.initial_replicas is not None
+                else ac.min_replicas)
+            self.target_num_replicas = min(max(current, ac.min_replicas),
+                                           ac.max_replicas)
+        else:
+            self.target_num_replicas = config.num_replicas
+
+    def set_target_num_replicas(self, n: int) -> None:
+        self.target_num_replicas = n
+
+    def delete(self) -> None:
+        self.deleting = True
+        self.target_num_replicas = 0
+
+    # ------------------------------------------------------------ reconcile
+    def reconcile(self) -> None:
+        """One pass of the rollout state machine. Driven by the controller
+        loop (reference deployment_state.py update())."""
+        cfg = self.target_config
+        if cfg is None:
+            return
+        # 1. Reap stopping replicas.
+        self.replicas = [
+            r for r in self.replicas
+            if not (r.state == ReplicaState.STOPPING and r.check_stopped())]
+        # 2. Promote started replicas; drop failed starts.
+        alive: List[DeploymentReplica] = []
+        for r in self.replicas:
+            if r.state == ReplicaState.STARTING:
+                status = r.check_started()
+                if status is False:
+                    self._consecutive_start_failures += 1
+                    r.begin_stop(0)
+                    r.check_stopped()
+                    continue
+                if status is True:
+                    self._consecutive_start_failures = 0
+            alive.append(r)
+        self.replicas = alive
+        # 3. Rolling update with surge: new-version replicas are started
+        #    first (stale ones don't count toward target in step 4); a stale
+        #    replica is only stopped once a new-version replica is RUNNING
+        #    to take its place, so serving capacity never drops to zero.
+        stale_running = [r for r in self.replicas
+                         if r.state == ReplicaState.RUNNING
+                         and r.version != self.target_version]
+        new_running = sum(1 for r in self.replicas
+                          if r.state == ReplicaState.RUNNING
+                          and r.version == self.target_version)
+        for r in stale_running[:new_running]:
+            r.begin_stop(cfg.graceful_shutdown_timeout_s)
+        # 4. Scale to target (counting only target-version replicas).
+        active = [r for r in self.replicas
+                  if r.state in (ReplicaState.STARTING, ReplicaState.RUNNING)
+                  and r.version == self.target_version]
+        delta = self.target_num_replicas - len(active)
+        if delta > 0 and self._consecutive_start_failures < 3:
+            for _ in range(delta):
+                rep = DeploymentReplica(self.deployment_id,
+                                        self.target_version)
+                try:
+                    rep.start(self.serialized_def, self.init_args_blob, cfg)
+                    self.replicas.append(rep)
+                except Exception as e:
+                    logger.error("failed to start replica: %s", e)
+                    self._consecutive_start_failures += 1
+                    break
+        elif delta < 0:
+            # Stop the newest non-running first, then excess running ones.
+            excess = sorted(
+                active, key=lambda r: r.state == ReplicaState.RUNNING)
+            for r in excess[:-delta]:
+                r.begin_stop(cfg.graceful_shutdown_timeout_s)
+        self._broadcast_running()
+
+    def check_health(self) -> None:
+        """Kick/collect health checks on RUNNING replicas; replace dead
+        ones (reference: replica health_check in deployment_state.py)."""
+        cfg = self.target_config
+        if cfg is None:
+            return
+        now = time.time()
+        for r in list(self.replicas):
+            if r.state != ReplicaState.RUNNING:
+                continue
+            if r.health_ref is not None:
+                done, _ = ray_tpu.wait([r.health_ref], timeout=0)
+                if done:
+                    try:
+                        ray_tpu.get(r.health_ref)
+                        r.last_health_check = now
+                    except Exception as e:
+                        logger.warning("replica %s unhealthy: %s",
+                                       r.replica_id, e)
+                        r.begin_stop(0)
+                    r.health_ref = None
+                elif now - r.last_health_check > cfg.health_check_timeout_s:
+                    logger.warning("replica %s health check timed out",
+                                   r.replica_id)
+                    r.health_ref = None
+                    r.begin_stop(0)
+            elif now - r.last_health_check > cfg.health_check_period_s:
+                try:
+                    r.health_ref = r.handle.check_health.remote()
+                except Exception:
+                    r.begin_stop(0)
+        self._broadcast_running()
+
+    def collect_autoscaling_stats(self) -> None:
+        """Refresh per-replica ongoing-request counts (best effort)."""
+        refs, reps = [], []
+        for r in self.replicas:
+            if r.state == ReplicaState.RUNNING and r.handle is not None:
+                try:
+                    refs.append(r.handle.get_num_ongoing_requests.remote())
+                    reps.append(r)
+                except Exception:
+                    pass
+        if not refs:
+            return
+        done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=2.0)
+        for r, ref in zip(reps, refs):
+            if ref in done:
+                try:
+                    r.num_ongoing = ray_tpu.get(ref)
+                except Exception:
+                    pass
+
+    def total_ongoing_requests(self) -> float:
+        return float(sum(r.num_ongoing for r in self.replicas
+                         if r.state == ReplicaState.RUNNING))
+
+    # ------------------------------------------------------------- queries
+    def running_replica_infos(self) -> List[dict]:
+        cfg = self.target_config
+        return [r.running_info(cfg).to_dict() for r in self.replicas
+                if r.state == ReplicaState.RUNNING]
+
+    def _broadcast_running(self) -> None:
+        infos = self.running_replica_infos()
+        if infos != self._last_broadcast:
+            self._last_broadcast = infos
+            self._on_running_changed(self.deployment_id, infos)
+
+    def curr_status_info(self) -> DeploymentStatusInfo:
+        counts: Dict[str, int] = {}
+        for r in self.replicas:
+            counts[r.state.value] = counts.get(r.state.value, 0) + 1
+        running = counts.get("RUNNING", 0)
+        if self._consecutive_start_failures >= 3:
+            status = DeploymentStatus.UNHEALTHY
+            msg = "replicas failed to start 3 times in a row"
+        elif running < self.target_num_replicas:
+            status = DeploymentStatus.UPDATING
+            msg = (f"{running}/{self.target_num_replicas} replicas running")
+        else:
+            status = DeploymentStatus.HEALTHY
+            msg = ""
+        return DeploymentStatusInfo(
+            name=self.deployment_id.name, status=status, message=msg,
+            replica_states=counts)
+
+    def is_deleted(self) -> bool:
+        return self.deleting and not self.replicas
+
+
+class DeploymentStateManager:
+    def __init__(self, on_running_replicas_changed):
+        self._states: Dict[DeploymentID, DeploymentState] = {}
+        self._on_running_changed = on_running_replicas_changed
+
+    def deploy(self, deployment_id: DeploymentID, serialized_def: bytes,
+               init_args_blob: bytes, config: DeploymentConfig) -> None:
+        if deployment_id not in self._states:
+            self._states[deployment_id] = DeploymentState(
+                deployment_id, self._on_running_changed)
+        self._states[deployment_id].deploy(serialized_def, init_args_blob,
+                                           config)
+
+    def delete(self, deployment_id: DeploymentID) -> None:
+        if deployment_id in self._states:
+            self._states[deployment_id].delete()
+
+    def get(self, deployment_id: DeploymentID) -> Optional[DeploymentState]:
+        return self._states.get(deployment_id)
+
+    def states_for_app(self, app_name: str) -> List[DeploymentState]:
+        return [s for d, s in self._states.items() if d.app_name == app_name]
+
+    def reconcile_all(self) -> None:
+        for state in list(self._states.values()):
+            state.reconcile()
+            state.check_health()
+        for did in [d for d, s in self._states.items() if s.is_deleted()]:
+            del self._states[did]
+
+    def all_states(self) -> Dict[DeploymentID, DeploymentState]:
+        return dict(self._states)

@@ -1,0 +1,105 @@
+"""Long-poll config push.
+
+Reference: python/ray/serve/_private/long_poll.py — LongPollHost (:177)
+lives in the controller; LongPollClient (:64) loops an async actor call
+that blocks server-side until the watched keys change, so config updates
+(route tables, running-replica sets) propagate without polling storms.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+LISTEN_TIMEOUT_S = 30.0
+
+
+class LongPollHost:
+    """Embedded in the controller actor. Keys map to (snapshot_id, value)."""
+
+    def __init__(self):
+        self._snapshot_ids: Dict[str, int] = {}
+        self._values: Dict[str, Any] = {}
+
+    def notify_changed(self, key: str, value: Any) -> None:
+        """Thread-safe under the GIL: called from the controller's sync
+        control loop (executor thread) while listeners read on the event
+        loop."""
+        self._values[key] = value
+        self._snapshot_ids[key] = self._snapshot_ids.get(key, -1) + 1
+
+    def notify_if_changed(self, key: str, value: Any) -> None:
+        """notify_changed, but a no-op when the value is unchanged — safe to
+        call every control-loop tick."""
+        if key in self._values and self._values[key] == value:
+            return
+        self.notify_changed(key, value)
+
+    async def listen_for_change(
+            self, keys_to_snapshot_ids: Dict[str, int]) -> dict:
+        """Block until any watched key's snapshot_id advances past the
+        client's, then return {key: {"snapshot_id": i, "value": v}}.
+        Internally sleep-polls the snapshot table (cheap dict reads) so no
+        cross-thread asyncio primitives are needed."""
+        deadline = asyncio.get_running_loop().time() + LISTEN_TIMEOUT_S
+        while True:
+            updates = {
+                key: {"snapshot_id": self._snapshot_ids[key],
+                      "value": self._values[key]}
+                for key, client_id in keys_to_snapshot_ids.items()
+                if self._snapshot_ids.get(key, -1) > client_id
+            }
+            if updates:
+                return updates
+            if asyncio.get_running_loop().time() >= deadline:
+                return {}
+            await asyncio.sleep(0.05)
+
+
+class LongPollClient:
+    """Runs a listen loop against the controller from any process.
+
+    ``callbacks``: {key: fn(value)} invoked on each update.
+    """
+
+    def __init__(self, host_actor, callbacks: Dict[str, Callable[[Any], None]],
+                 loop: Optional[asyncio.AbstractEventLoop] = None):
+        self._host = host_actor
+        self._callbacks = callbacks
+        self._snapshot_ids = {key: -1 for key in callbacks}
+        self._stopped = False
+        self._task = None
+        loop = loop or asyncio.get_event_loop()
+        self._task = loop.create_task(self._poll_loop())
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _poll_loop(self) -> None:
+        import ray_tpu
+
+        while not self._stopped:
+            try:
+                ref = self._host.listen_for_change.remote(self._snapshot_ids)
+                updates = await asyncio.wait_for(
+                    ray_tpu.get_runtime_context()._worker.get_async(ref),
+                    timeout=LISTEN_TIMEOUT_S + 10)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                continue
+            except Exception as e:
+                if self._stopped:
+                    return
+                logger.warning("long poll failed: %s; retrying", e)
+                await asyncio.sleep(1.0)
+                continue
+            for key, update in (updates or {}).items():
+                self._snapshot_ids[key] = update["snapshot_id"]
+                try:
+                    self._callbacks[key](update["value"])
+                except Exception:
+                    logger.exception("long poll callback for %r failed", key)

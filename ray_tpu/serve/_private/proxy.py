@@ -1,0 +1,191 @@
+"""HTTP proxy actor.
+
+Reference: python/ray/serve/_private/proxy.py — ProxyActor (:1130) hosts an
+HTTPProxy (:761, ASGI/uvicorn in the reference; aiohttp here) that matches
+routes against the controller-pushed route table and forwards to
+DeploymentHandles. Built-in endpoints: /-/routes, /-/healthz.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.common import SERVE_NAMESPACE
+
+logger = logging.getLogger(__name__)
+
+
+class ServeRequest:
+    """What an ingress deployment's __call__ receives for HTTP requests.
+    A picklable stand-in for starlette.requests.Request (reference ships
+    the ASGI scope over the handle; python/ray/serve/_private/
+    http_util.py)."""
+
+    def __init__(self, method: str, path: str, route_prefix: str,
+                 query: Dict[str, str], headers: Dict[str, str],
+                 body: bytes):
+        self.method = method
+        self.path = path
+        self.route_prefix = route_prefix
+        self.query_params = query
+        self.headers = headers
+        self.body = body
+
+    def json(self):
+        return json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return (self.body or b"").decode()
+
+
+@ray_tpu.remote(max_concurrency=1000, lifetime="detached",
+                namespace=SERVE_NAMESPACE)
+class ProxyActor:
+    def __init__(self, http_options: dict):
+        self._host = http_options.get("host", "127.0.0.1")
+        self._port = int(http_options.get("port", 8000))
+        self._route_table: Dict[str, dict] = {}
+        self._num_requests = 0
+        self._ready_evt = threading.Event()
+        self._stop_evt: Optional[asyncio.Event] = None
+        self._server_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._error: Optional[str] = None
+        self._thread = threading.Thread(target=self._serve_thread,
+                                        daemon=True, name="serve-proxy-http")
+        self._thread.start()
+        self._poll = threading.Thread(target=self._route_poll_loop,
+                                      daemon=True, name="serve-proxy-poll")
+        self._poll.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def ready(self) -> str:
+        if not self._ready_evt.wait(timeout=30):
+            raise RuntimeError(f"proxy failed to start: {self._error}")
+        return f"http://{self._host}:{self._port}"
+
+    def status(self) -> dict:
+        return {"address": f"http://{self._host}:{self._port}",
+                "num_requests": self._num_requests,
+                "routes": sorted(self._route_table)}
+
+    def stop_server(self) -> None:
+        if self._server_loop is not None and self._stop_evt is not None:
+            self._server_loop.call_soon_threadsafe(self._stop_evt.set)
+
+    # ---------------------------------------------------------- route table
+    def _route_poll_loop(self) -> None:
+        from ray_tpu.core.actor import get_actor
+        from ray_tpu.serve._private.common import SERVE_CONTROLLER_NAME
+        from ray_tpu.serve._private.controller import ROUTE_TABLE_KEY
+
+        snapshot_id = -1
+        controller = None
+        while True:
+            try:
+                if controller is None:
+                    controller = get_actor(SERVE_CONTROLLER_NAME,
+                                           namespace=SERVE_NAMESPACE)
+                ref = controller.listen_for_change.remote(
+                    {ROUTE_TABLE_KEY: snapshot_id})
+                updates = ray_tpu.get(ref, timeout=60)
+                if ROUTE_TABLE_KEY in (updates or {}):
+                    update = updates[ROUTE_TABLE_KEY]
+                    snapshot_id = update["snapshot_id"]
+                    self._route_table = update["value"]
+                    logger.info("route table updated: %s",
+                                sorted(self._route_table))
+            except Exception as e:
+                logger.debug("route poll failed: %s", e)
+                controller = None
+                time.sleep(1.0)
+
+    def _match_route(self, path: str) -> Optional[tuple]:
+        best = None
+        for prefix, entry in self._route_table.items():
+            norm = prefix.rstrip("/") or ""
+            if path == norm or path.startswith(norm + "/") or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, entry)
+        return best
+
+    # ----------------------------------------------------------- http server
+    def _serve_thread(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._server_loop = loop
+        try:
+            loop.run_until_complete(self._run_server())
+        except Exception as e:
+            self._error = str(e)
+            logger.exception("proxy server died")
+        finally:
+            loop.close()
+
+    async def _run_server(self) -> None:
+        from aiohttp import web
+
+        self._stop_evt = asyncio.Event()
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle_http)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, self._host, self._port)
+        await site.start()
+        self._ready_evt.set()
+        logger.info("Serve proxy listening on %s:%d", self._host, self._port)
+        await self._stop_evt.wait()
+        await runner.cleanup()
+
+    async def _handle_http(self, request):
+        from aiohttp import web
+
+        path = "/" + request.match_info.get("tail", "")
+        if path == "/-/healthz":
+            return web.Response(text="success")
+        if path == "/-/routes":
+            return web.json_response(
+                {p: f"{e['app_name']}#{e['deployment']}"
+                 for p, e in self._route_table.items()})
+        match = self._match_route(path)
+        if match is None:
+            return web.Response(
+                status=404,
+                text=f"no Serve application at {path!r}; "
+                     f"routes: {sorted(self._route_table)}")
+        prefix, entry = match
+        body = await request.read()
+        serve_req = ServeRequest(
+            method=request.method, path=path, route_prefix=prefix,
+            query=dict(request.query),
+            headers={k: v for k, v in request.headers.items()},
+            body=body)
+        self._num_requests += 1
+        try:
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, self._call_handle, entry, serve_req)
+        except Exception as e:
+            logger.exception("request to %s failed", path)
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        return self._to_response(result)
+
+    def _call_handle(self, entry: dict, serve_req: ServeRequest):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        handle = DeploymentHandle(entry["deployment"], entry["app_name"])
+        return handle.remote(serve_req).result(timeout_s=60)
+
+    @staticmethod
+    def _to_response(result):
+        from aiohttp import web
+
+        if isinstance(result, (bytes, bytearray)):
+            return web.Response(body=bytes(result))
+        if isinstance(result, str):
+            return web.Response(text=result)
+        return web.json_response(result)

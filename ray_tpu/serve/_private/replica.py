@@ -1,0 +1,160 @@
+"""Replica actor: hosts the user callable.
+
+Reference: python/ray/serve/_private/replica.py — ReplicaActor (:231) wraps
+the user class/function in a UserCallableWrapper (:750), tracks ongoing
+requests, exposes health checks and graceful drain.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import time
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.core import serialization as ser
+from ray_tpu.serve._private.common import RequestMetadata
+
+logger = logging.getLogger(__name__)
+
+
+class UserCallableWrapper:
+    """Instantiates and calls the user's deployment class/function."""
+
+    def __init__(self, serialized_def: bytes, init_args: tuple,
+                 init_kwargs: dict):
+        self._def = ser.loads(serialized_def)
+        self._init_args = init_args
+        self._init_kwargs = init_kwargs
+        self._callable: Any = None
+
+    def initialize(self) -> None:
+        if inspect.isclass(self._def):
+            self._callable = self._def(*self._init_args, **self._init_kwargs)
+        else:
+            # Plain function deployment: calls go straight to it.
+            self._callable = self._def
+
+    def get_method(self, name: str):
+        if inspect.isfunction(self._def) or inspect.ismethod(self._def):
+            return self._callable
+        target = getattr(self._callable, name, None)
+        if target is None:
+            raise AttributeError(
+                f"deployment has no method {name!r}")
+        return target
+
+    def reconfigure(self, user_config: Any) -> None:
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    def call_health_check(self) -> None:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            fn()
+
+
+@ray_tpu.remote
+class ReplicaActor:
+    """One serving replica. Created as a named detached actor by the
+    controller; routers resolve it with ray_tpu.get_actor."""
+
+    def __init__(self, replica_id: str, deployment: str, app_name: str,
+                 serialized_def: bytes, init_args_blob: bytes,
+                 config_dict: dict):
+        self._replica_id = replica_id
+        self._deployment = deployment
+        self._app_name = app_name
+        init_args, init_kwargs = ser.loads(init_args_blob)
+        self._wrapper = UserCallableWrapper(serialized_def, init_args,
+                                            init_kwargs)
+        self._wrapper.initialize()
+        user_config = config_dict.get("user_config")
+        if user_config is not None:
+            self._wrapper.reconfigure(user_config)
+        self._num_ongoing = 0
+        self._total_served = 0
+        self._draining = False
+        self._multiplexed_model_ids: list = []
+        self._started_at = time.time()
+        global _current_replica
+        _current_replica = self
+
+    # ------------------------------------------------------------- data path
+    async def handle_request(self, request_meta: dict, *args, **kwargs):
+        """Execute one request (reference replica.py handle_request)."""
+        meta = RequestMetadata.from_dict(request_meta)
+        self._num_ongoing += 1
+        try:
+            method = self._wrapper.get_method(meta.call_method)
+            if meta.multiplexed_model_id:
+                _set_multiplex_context(meta.multiplexed_model_id)
+            if inspect.iscoroutinefunction(method):
+                result = await method(*args, **kwargs)
+            else:
+                # to_thread (not run_in_executor) so the multiplex
+                # ContextVar propagates into the worker thread.
+                result = await asyncio.to_thread(method, *args, **kwargs)
+            self._total_served += 1
+            return result
+        finally:
+            self._num_ongoing -= 1
+
+    # ----------------------------------------------------------- control path
+    def get_num_ongoing_requests(self) -> int:
+        return self._num_ongoing
+
+    def get_metadata(self) -> dict:
+        return {
+            "replica_id": self._replica_id,
+            "deployment": self._deployment,
+            "app_name": self._app_name,
+            "num_ongoing": self._num_ongoing,
+            "total_served": self._total_served,
+            "started_at": self._started_at,
+            "multiplexed_model_ids": list(self._multiplexed_model_ids),
+        }
+
+    def record_multiplexed_model(self, model_id: str) -> None:
+        if model_id not in self._multiplexed_model_ids:
+            self._multiplexed_model_ids.append(model_id)
+
+    def reconfigure(self, user_config: Any) -> None:
+        self._wrapper.reconfigure(user_config)
+
+    def check_health(self) -> str:
+        self._wrapper.call_health_check()
+        return "ok"
+
+    async def prepare_for_shutdown(self, timeout_s: float = 20.0,
+                                   wait_loop_s: float = 0.5) -> bool:
+        """Drain: wait until no ongoing requests (graceful_shutdown in
+        reference replica.py)."""
+        self._draining = True
+        deadline = time.time() + timeout_s
+        while self._num_ongoing > 0 and time.time() < deadline:
+            await asyncio.sleep(wait_loop_s)
+        return self._num_ongoing == 0
+
+
+import contextvars
+
+# Per-request, not process-global: concurrent requests on an async replica
+# must not clobber each other's model id (reference uses a ContextVar in
+# serve/context.py).
+_multiplex_context: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default="")
+_current_replica = None  # the ReplicaActor instance living in this process
+
+
+def _set_multiplex_context(model_id: str) -> None:
+    _multiplex_context.set(model_id)
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica: the model id requested by the current call
+    (reference: serve.get_multiplexed_model_id)."""
+    return _multiplex_context.get()

@@ -1,0 +1,219 @@
+"""Request router: picks a replica per request.
+
+Reference: python/ray/serve/_private/router.py (Router :319) +
+replica_scheduler/pow_2_scheduler.py (PowerOfTwoChoicesReplicaScheduler
+:49) — sample two replicas, send to the one with fewer ongoing requests.
+Replica membership is pushed from the controller via long poll; ongoing
+counts are tracked client-side and reconciled when responses complete.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.actor import get_actor
+from ray_tpu.serve._private.common import (RequestMetadata,
+                                           RunningReplicaInfo,
+                                           SERVE_NAMESPACE)
+
+logger = logging.getLogger(__name__)
+
+
+class _ReplicaEntry:
+    __slots__ = ("info", "handle", "ongoing")
+
+    def __init__(self, info: RunningReplicaInfo):
+        self.info = info
+        self.handle = None
+        self.ongoing = 0
+
+    def resolve(self):
+        if self.handle is None:
+            self.handle = get_actor(self.info.actor_name,
+                                    namespace=SERVE_NAMESPACE)
+        return self.handle
+
+
+class PowerOfTwoChoicesReplicaScheduler:
+    def __init__(self):
+        self._replicas: Dict[str, _ReplicaEntry] = {}
+        self._lock = threading.Lock()
+
+    def update_replicas(self, infos: List[dict]) -> None:
+        with self._lock:
+            new = {}
+            for d in infos:
+                info = RunningReplicaInfo.from_dict(d)
+                prev = self._replicas.get(info.replica_id)
+                entry = prev if prev is not None else _ReplicaEntry(info)
+                entry.info = info
+                new[info.replica_id] = entry
+            self._replicas = new
+
+    def num_replicas(self) -> int:
+        return len(self._replicas)
+
+    def choose_replica(self) -> Optional[_ReplicaEntry]:
+        with self._lock:
+            entries = list(self._replicas.values())
+        if not entries:
+            return None
+        if len(entries) == 1:
+            return entries[0]
+        a, b = random.sample(entries, 2)
+        return a if a.ongoing <= b.ongoing else b
+
+    def on_request_sent(self, entry: _ReplicaEntry) -> None:
+        entry.ongoing += 1
+
+    def on_request_done(self, entry: _ReplicaEntry) -> None:
+        entry.ongoing = max(entry.ongoing - 1, 0)
+
+    def drop_replica(self, replica_id: str) -> None:
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+
+
+class Router:
+    """One per (handle, deployment). Owns a scheduler + a membership
+    long-poll thread against the controller."""
+
+    _routers: Dict[tuple, "Router"] = {}
+    _routers_lock = threading.Lock()
+
+    def __init__(self, controller, app_name: str, deployment: str):
+        self._controller = controller
+        self._app_name = app_name
+        self._deployment = deployment
+        self._scheduler = PowerOfTwoChoicesReplicaScheduler()
+        self._snapshot_id = -1
+        self._stopped = False
+        try:
+            infos = ray_tpu.get(
+                controller.get_running_replicas.remote(app_name, deployment),
+                timeout=30)
+            self._scheduler.update_replicas(infos)
+        except Exception as e:
+            logger.warning("initial replica fetch failed: %s", e)
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, daemon=True,
+            name=f"serve-router-{app_name}#{deployment}")
+        self._poll_thread.start()
+
+    @classmethod
+    def shared(cls, controller, app_name: str, deployment: str) -> "Router":
+        key = (app_name, deployment)
+        with cls._routers_lock:
+            r = cls._routers.get(key)
+            if r is None or r._stopped:
+                r = Router(controller, app_name, deployment)
+                cls._routers[key] = r
+            return r
+
+    @classmethod
+    def stop_all(cls) -> None:
+        with cls._routers_lock:
+            for r in cls._routers.values():
+                r._stopped = True
+            cls._routers.clear()
+
+    def _poll_loop(self) -> None:
+        from ray_tpu.serve._private.controller import replicas_key
+
+        key = replicas_key(self._app_name, self._deployment)
+        while not self._stopped:
+            try:
+                ref = self._controller.listen_for_change.remote(
+                    {key: self._snapshot_id})
+                updates = ray_tpu.get(ref, timeout=60)
+            except Exception:
+                if self._stopped:
+                    return
+                time.sleep(1.0)
+                continue
+            if key in (updates or {}):
+                self._snapshot_id = updates[key]["snapshot_id"]
+                self._scheduler.update_replicas(updates[key]["value"])
+
+    # --------------------------------------------------------------- sending
+    def assign_request(self, meta: RequestMetadata, args: tuple,
+                       kwargs: dict, timeout_s: float = 30.0):
+        """Pick a replica and submit; returns (ObjectRef, completion_cb)."""
+        deadline = time.time() + timeout_s
+        entry = self._scheduler.choose_replica()
+        while entry is None:
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"no running replicas for deployment "
+                    f"{self._app_name}#{self._deployment} after "
+                    f"{timeout_s:.0f}s")
+            time.sleep(0.1)
+            entry = self._scheduler.choose_replica()
+        if meta.multiplexed_model_id:
+            entry = self._choose_multiplexed(entry, meta)
+        handle = entry.resolve()
+        self._scheduler.on_request_sent(entry)
+        try:
+            ref = handle.handle_request.remote(meta.to_dict(), *args,
+                                               **kwargs)
+        except Exception:
+            self._scheduler.on_request_done(entry)
+            self._scheduler.drop_replica(entry.info.replica_id)
+            raise
+        worker = ray_tpu.get_runtime_context()._worker
+        fut = worker.as_future(ref)
+        fut.add_done_callback(
+            lambda _f: self._scheduler.on_request_done(entry))
+        return ref, fut
+
+    _MULTIPLEX_CACHE_TTL_S = 2.0
+
+    def _choose_multiplexed(self, fallback: _ReplicaEntry,
+                            meta: RequestMetadata) -> _ReplicaEntry:
+        """Prefer a replica that already has the model loaded (reference:
+        multiplex-aware routing in pow_2_scheduler.py). The model→replica
+        map is cached with a short TTL so the hot path does no RPCs."""
+        now = time.time()
+        if now - getattr(self, "_mux_fetched_at", 0.0) > \
+                self._MULTIPLEX_CACHE_TTL_S:
+            self._refresh_multiplex_cache()
+            self._mux_fetched_at = now
+        cache: Dict[str, List[str]] = getattr(self, "_mux_models", {})
+        replica_ids = cache.get(meta.multiplexed_model_id, [])
+        if replica_ids:
+            with self._scheduler._lock:
+                candidates = [self._scheduler._replicas[rid]
+                              for rid in replica_ids
+                              if rid in self._scheduler._replicas]
+            if candidates:
+                return min(candidates, key=lambda e: e.ongoing)
+        return fallback
+
+    def _refresh_multiplex_cache(self) -> None:
+        with self._scheduler._lock:
+            entries = list(self._scheduler._replicas.values())
+        refs, ids = [], []
+        for e in entries:
+            try:
+                refs.append(e.resolve().get_metadata.remote())
+                ids.append(e.info.replica_id)
+            except Exception:
+                pass
+        models: Dict[str, List[str]] = {}
+        if refs:
+            done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=2.0)
+            for rid, ref in zip(ids, refs):
+                if ref not in done:
+                    continue
+                try:
+                    meta = ray_tpu.get(ref)
+                except Exception:
+                    continue
+                for mid in meta.get("multiplexed_model_ids", []):
+                    models.setdefault(mid, []).append(rid)
+        self._mux_models = models

@@ -1,0 +1,200 @@
+"""serve.start / run / shutdown / status / handles.
+
+Reference: python/ray/serve/api.py — serve.run (:535) deploys an
+Application to the controller and returns the ingress DeploymentHandle;
+serve.start boots the controller + HTTP proxy.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.core.actor import get_actor
+from ray_tpu.serve.config import HTTPOptions
+from ray_tpu.serve.deployment import Application, build_app
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve._private.common import (
+    SERVE_CONTROLLER_NAME, SERVE_DEFAULT_APP_NAME, SERVE_NAMESPACE)
+
+logger = logging.getLogger(__name__)
+
+_controller_handle = None
+
+
+def _get_controller(create: bool = False,
+                    http_options: Optional[HTTPOptions] = None):
+    global _controller_handle
+    if _controller_handle is not None:
+        return _controller_handle
+    try:
+        _controller_handle = get_actor(SERVE_CONTROLLER_NAME,
+                                       namespace=SERVE_NAMESPACE)
+        return _controller_handle
+    except Exception:
+        if not create:
+            raise RuntimeError(
+                "Serve is not running; call serve.start() or serve.run()")
+    from ray_tpu.serve._private.controller import ServeController
+
+    http_dict = (http_options or HTTPOptions()).to_dict()
+    _controller_handle = ServeController.options(
+        name=SERVE_CONTROLLER_NAME).remote(http_dict)
+    # Fire-and-forget: the reconcile loop runs for the controller's life.
+    _controller_handle.run_control_loop.remote()
+    return _controller_handle
+
+
+def start(http_options: Optional[HTTPOptions] = None, *,
+          proxy: bool = True) -> None:
+    """Boot the Serve control plane (controller + optional HTTP proxy).
+    Reference: serve.start (python/ray/serve/api.py:83)."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    controller = _get_controller(create=True, http_options=http_options)
+    if proxy:
+        _ensure_proxy(controller, http_options)
+
+
+def _ensure_proxy(controller,
+                  http_options: Optional[HTTPOptions] = None) -> None:
+    from ray_tpu.serve._private.proxy import ProxyActor
+
+    try:
+        get_actor("SERVE_PROXY", namespace=SERVE_NAMESPACE)
+        return
+    except Exception:
+        pass
+    if http_options is not None:
+        http = http_options.to_dict()
+    else:
+        http = ray_tpu.get(controller.get_http_options.remote(), timeout=30)
+    proxy = ProxyActor.options(
+        name="SERVE_PROXY", namespace=SERVE_NAMESPACE,
+        lifetime="detached", max_concurrency=1000).remote(http)
+    # Block until the HTTP server is listening.
+    ray_tpu.get(proxy.ready.remote(), timeout=60)
+
+
+def run(app: Application, *, name: str = SERVE_DEFAULT_APP_NAME,
+        route_prefix: Optional[str] = "/", blocking: bool = False,
+        _proxy: bool = True, timeout_s: float = 60.0) -> DeploymentHandle:
+    """Deploy an application and wait for it to be RUNNING.
+    Reference: serve.run (python/ray/serve/api.py:535, _run :459)."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    controller = _get_controller(create=True)
+    if _proxy and route_prefix is not None:
+        _ensure_proxy(controller)
+    payloads = build_app(app, name)
+    ingress = payloads[-1]["name"]  # root visited last (post-order append)
+    ray_tpu.get(controller.deploy_application.remote(
+        name, payloads, route_prefix), timeout=30)
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        statuses = ray_tpu.get(controller.get_app_statuses.remote(),
+                               timeout=30)
+        st = statuses.get(name, {})
+        if st.get("status") == "RUNNING":
+            break
+        if st.get("status") == "DEPLOY_FAILED":
+            raise RuntimeError(
+                f"deploying app {name!r} failed: {st.get('message')}")
+        time.sleep(0.1)
+    else:
+        raise TimeoutError(
+            f"app {name!r} did not become RUNNING within {timeout_s}s")
+    handle = DeploymentHandle(ingress, name)
+    if blocking:
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    return handle
+
+
+def delete(name: str, _blocking: bool = True,
+           timeout_s: float = 30.0) -> None:
+    controller = _get_controller()
+    ray_tpu.get(controller.delete_application.remote(name), timeout=30)
+    if _blocking:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            statuses = ray_tpu.get(controller.get_app_statuses.remote(),
+                                   timeout=30)
+            if name not in statuses:
+                return
+            time.sleep(0.1)
+
+
+def status() -> dict:
+    """Cluster-wide Serve status (reference: serve.status →
+    python/ray/serve/schema.py ServeStatus)."""
+    try:
+        controller = _get_controller()
+    except RuntimeError:
+        return {"applications": {}, "proxies": {}}
+    apps = ray_tpu.get(controller.get_app_statuses.remote(), timeout=30)
+    return {"applications": apps, "proxies": _proxy_status()}
+
+
+def _proxy_status() -> dict:
+    try:
+        proxy = get_actor("SERVE_PROXY", namespace=SERVE_NAMESPACE)
+        return ray_tpu.get(proxy.status.remote(), timeout=5)
+    except Exception:
+        return {}
+
+
+def get_app_handle(name: str = SERVE_DEFAULT_APP_NAME) -> DeploymentHandle:
+    controller = _get_controller()
+    statuses = ray_tpu.get(controller.get_app_statuses.remote(), timeout=30)
+    if name not in statuses:
+        raise ValueError(f"no application named {name!r}")
+    route_table = ray_tpu.get(controller.get_route_table.remote(),
+                              timeout=30)
+    for _prefix, entry in route_table.items():
+        if entry["app_name"] == name:
+            return DeploymentHandle(entry["deployment"], name)
+    # No route (route_prefix=None): find the app's ingress deployment.
+    deployments = statuses[name].get("deployments", {})
+    if not deployments:
+        raise ValueError(f"application {name!r} has no deployments")
+    return DeploymentHandle(next(iter(deployments)), name)
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = SERVE_DEFAULT_APP_NAME
+                          ) -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def shutdown() -> None:
+    """Tear down all Serve actors (reference: serve.shutdown)."""
+    global _controller_handle
+    from ray_tpu.serve._private.router import Router
+
+    Router.stop_all()
+    try:
+        controller = _get_controller()
+    except Exception:
+        _controller_handle = None
+        return
+    try:
+        ray_tpu.get(controller.graceful_shutdown.remote(), timeout=60)
+    except Exception:
+        pass
+    try:
+        proxy = get_actor("SERVE_PROXY", namespace=SERVE_NAMESPACE)
+        ray_tpu.get(proxy.stop_server.remote(), timeout=10)
+        ray_tpu.kill(proxy)
+    except Exception:
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    _controller_handle = None

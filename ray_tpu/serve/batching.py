@@ -1,0 +1,100 @@
+"""@serve.batch — transparent request batching.
+
+Reference: python/ray/serve/batching.py — queued calls are flushed to the
+underlying method as a list once max_batch_size accumulate or
+batch_wait_timeout_s elapses; each caller gets its element back.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import inspect
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max_batch_size = max_batch_size
+        self._timeout_s = batch_wait_timeout_s
+        self._queue: List[tuple] = []  # (item, future)
+        self._flush_task: Optional[asyncio.Task] = None
+
+    async def submit(self, item: Any) -> Any:
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._queue.append((item, fut))
+        if len(self._queue) >= self._max_batch_size:
+            await self._flush()
+        elif self._flush_task is None or self._flush_task.done():
+            self._flush_task = loop.create_task(self._delayed_flush())
+        return await fut
+
+    async def _delayed_flush(self) -> None:
+        await asyncio.sleep(self._timeout_s)
+        await self._flush()
+
+    async def _flush(self) -> None:
+        if not self._queue:
+            return
+        batch, self._queue = self._queue, []
+        items = [b[0] for b in batch]
+        futs = [b[1] for b in batch]
+        try:
+            if inspect.iscoroutinefunction(self._fn):
+                results = await self._fn(items)
+            else:
+                results = await asyncio.get_running_loop().run_in_executor(
+                    None, self._fn, items)
+            if len(results) != len(items):
+                raise ValueError(
+                    f"batched function returned {len(results)} results for "
+                    f"{len(items)} inputs")
+            for fut, r in zip(futs, results):
+                if not fut.done():
+                    fut.set_result(r)
+        except Exception as e:
+            for fut in futs:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorate a method taking a list of inputs; callers pass single
+    inputs and get single outputs (reference serve.batch)."""
+
+    def wrap(fn):
+        queues = {}  # per bound instance (or None for free functions)
+
+        if len(inspect.signature(fn).parameters) >= 2 or \
+                inspect.signature(fn).parameters.get("self") is not None:
+            pass
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:  # bound method: (self, item)
+                owner, item = args
+                bound = functools.partial(fn, owner)
+                key = id(owner)
+            elif len(args) == 1:
+                owner, item = None, args[0]
+                bound = fn
+                key = None
+            else:
+                raise TypeError(
+                    "@serve.batch methods take exactly one request argument")
+            q = queues.get(key)
+            if q is None:
+                q = queues[key] = _BatchQueue(bound, max_batch_size,
+                                              batch_wait_timeout_s)
+            return await q.submit(item)
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -1,0 +1,78 @@
+"""Serve config dataclasses.
+
+Reference: python/ray/serve/config.py (AutoscalingConfig, HTTPOptions),
+python/ray/serve/_private/config.py (DeploymentConfig, ReplicaConfig).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Request-driven autoscaling (reference: python/ray/serve/config.py
+    AutoscalingConfig; policy python/ray/serve/autoscaling_policy.py)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    initial_replicas: Optional[int] = None
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 60.0
+    upscaling_factor: Optional[float] = None
+    downscaling_factor: Optional[float] = None
+    metrics_interval_s: float = 1.0
+    look_back_period_s: float = 10.0
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__dataclass_fields__}
+
+    @staticmethod
+    def from_dict(d: Optional[dict]) -> Optional["AutoscalingConfig"]:
+        if d is None:
+            return None
+        return AutoscalingConfig(**d)
+
+
+@dataclass
+class DeploymentConfig:
+    """Per-deployment behavior knobs (reference:
+    python/ray/serve/_private/config.py DeploymentConfig)."""
+
+    num_replicas: int = 1
+    max_ongoing_requests: int = 5
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    user_config: Any = None
+    graceful_shutdown_timeout_s: float = 20.0
+    graceful_shutdown_wait_loop_s: float = 2.0
+    health_check_period_s: float = 10.0
+    health_check_timeout_s: float = 30.0
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    version: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        d = {k: getattr(self, k) for k in self.__dataclass_fields__}
+        if self.autoscaling_config is not None:
+            d["autoscaling_config"] = self.autoscaling_config.to_dict()
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "DeploymentConfig":
+        d = dict(d)
+        d["autoscaling_config"] = AutoscalingConfig.from_dict(
+            d.get("autoscaling_config"))
+        return DeploymentConfig(**d)
+
+
+@dataclass
+class HTTPOptions:
+    """Proxy bind options (reference: python/ray/serve/config.py
+    HTTPOptions)."""
+
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+    def to_dict(self) -> dict:
+        return {"host": self.host, "port": self.port}

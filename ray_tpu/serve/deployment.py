@@ -1,0 +1,161 @@
+"""@serve.deployment decorator + Application bind graph.
+
+Reference: python/ray/serve/deployment.py (Deployment, Application) and
+python/ray/serve/api.py:@deployment. ``D.bind(*args)`` builds a lazy graph;
+nested bound deployments become DeploymentHandles at deploy time (model
+composition, reference python/ray/serve/_private/build_app.py).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from ray_tpu.core import serialization as ser
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+class Deployment:
+    def __init__(self, func_or_class: Union[Callable, type],
+                 name: str, config: DeploymentConfig):
+        self._func_or_class = func_or_class
+        self._name = name
+        self._config = config
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def func_or_class(self):
+        return self._func_or_class
+
+    def options(self, **kwargs) -> "Deployment":
+        cfg = DeploymentConfig.from_dict(self._config.to_dict())
+        name = kwargs.pop("name", self._name)
+        auto = kwargs.pop("autoscaling_config", None)
+        if auto is not None and not isinstance(auto, AutoscalingConfig):
+            auto = AutoscalingConfig(**auto)
+        if auto is not None:
+            cfg.autoscaling_config = auto
+        for k, v in kwargs.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown deployment option {k!r}")
+            setattr(cfg, k, v)
+        return Deployment(self._func_or_class, name, cfg)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def __call__(self, *a, **kw):
+        raise RuntimeError(
+            "deployments cannot be called directly; use handle.remote() "
+            "or serve.run(deployment.bind())")
+
+
+class Application:
+    """A bound deployment (possibly with nested bound deployments in its
+    init args)."""
+
+    def __init__(self, deployment: Deployment, init_args: tuple,
+                 init_kwargs: dict):
+        self._deployment = deployment
+        self._init_args = init_args
+        self._init_kwargs = init_kwargs
+
+    @property
+    def deployment(self) -> Deployment:
+        return self._deployment
+
+
+def build_app(app: Application, app_name: str) -> List[dict]:
+    """Flatten the bind graph into controller deploy payloads. The root is
+    the ingress deployment; nested Applications are replaced with
+    DeploymentHandles (reference build_app.py)."""
+    out: List[dict] = []
+    seen: Dict[int, str] = {}
+    used_names: Dict[str, int] = {}
+
+    def unique_name(base: str) -> str:
+        n = used_names.get(base, 0)
+        used_names[base] = n + 1
+        return base if n == 0 else f"{base}_{n}"
+
+    def visit(node: Application, is_ingress: bool) -> str:
+        if id(node) in seen:
+            return seen[id(node)]
+        name = unique_name(node._deployment.name)
+        seen[id(node)] = name
+
+        def resolve(v):
+            if isinstance(v, Application):
+                return DeploymentHandle(visit(v, False), app_name)
+            if isinstance(v, (list, tuple)):
+                return type(v)(resolve(x) for x in v)
+            if isinstance(v, dict):
+                return {k: resolve(x) for k, x in v.items()}
+            return v
+
+        args = tuple(resolve(a) for a in node._init_args)
+        kwargs = {k: resolve(v) for k, v in node._init_kwargs.items()}
+        out.append({
+            "name": name,
+            "serialized_def": ser.dumps(node._deployment.func_or_class),
+            "init_args_blob": ser.dumps((args, kwargs)),
+            "config_dict": node._deployment._config.to_dict(),
+            "is_ingress": is_ingress,
+        })
+        return name
+
+    visit(app, True)
+    return out
+
+
+def deployment(_func_or_class: Optional[Callable] = None, *,
+               name: Optional[str] = None,
+               num_replicas: Optional[Union[int, str]] = None,
+               autoscaling_config: Optional[Union[dict,
+                                                  AutoscalingConfig]] = None,
+               max_ongoing_requests: int = 5,
+               user_config: Any = None,
+               ray_actor_options: Optional[dict] = None,
+               health_check_period_s: float = 10.0,
+               health_check_timeout_s: float = 30.0,
+               graceful_shutdown_timeout_s: float = 20.0,
+               version: Optional[str] = None):
+    """@serve.deployment (reference python/ray/serve/api.py:deployment)."""
+
+    def wrap(func_or_class):
+        nonlocal autoscaling_config, num_replicas
+        if num_replicas == "auto":
+            num_replicas = None
+            if autoscaling_config is None:
+                autoscaling_config = AutoscalingConfig(min_replicas=1,
+                                                      max_replicas=100)
+        if isinstance(autoscaling_config, dict):
+            autoscaling_config = AutoscalingConfig(**autoscaling_config)
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas or 1,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=autoscaling_config,
+            user_config=user_config,
+            ray_actor_options=ray_actor_options or {},
+            health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+            version=version,
+        )
+        return Deployment(func_or_class,
+                          name or func_or_class.__name__, cfg)
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+def ingress(app_builder):
+    """Marker passthrough for ASGI-style ingress classes (reference:
+    serve.ingress). The TPU-native proxy speaks plain dict requests, so
+    this is an identity decorator kept for API parity."""
+    return app_builder

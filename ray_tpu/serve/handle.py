@@ -1,0 +1,88 @@
+"""DeploymentHandle: Python-native calls into a deployment.
+
+Reference: python/ray/serve/handle.py — DeploymentHandle (:714) routes
+through a Router; calls return DeploymentResponse (lazy future over an
+ObjectRef) supporting .result() and await.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.common import RequestMetadata
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference handle.py
+    DeploymentResponse)."""
+
+    def __init__(self, ref, fut):
+        self._ref = ref
+        self._fut = fut
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def __await__(self):
+        async def _get():
+            values = await asyncio.wrap_future(self._fut)
+            return values[0]
+
+        return _get().__await__()
+
+    @property
+    def object_ref(self):
+        """The underlying ObjectRef (pass to other tasks for zero-copy
+        composition)."""
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment: str, app_name: str,
+                 method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
+        self.deployment_name = deployment
+        self.app_name = app_name
+        self._method_name = method_name
+        self._multiplexed_model_id = multiplexed_model_id
+        self._router = None
+
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name=method_name or self._method_name,
+            multiplexed_model_id=(multiplexed_model_id
+                                  if multiplexed_model_id is not None
+                                  else self._multiplexed_model_id))
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def _get_router(self):
+        if self._router is None:
+            from ray_tpu.serve._private.router import Router
+            from ray_tpu.serve.api import _get_controller
+
+            self._router = Router.shared(_get_controller(), self.app_name,
+                                         self.deployment_name)
+        return self._router
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        meta = RequestMetadata(
+            request_id=uuid.uuid4().hex,
+            call_method=self._method_name,
+            multiplexed_model_id=self._multiplexed_model_id)
+        ref, fut = self._get_router().assign_request(meta, args, kwargs)
+        return DeploymentResponse(ref, fut)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._method_name,
+                 self._multiplexed_model_id))

@@ -1,0 +1,86 @@
+"""@serve.multiplexed — per-replica LRU of loaded models.
+
+Reference: python/ray/serve/multiplex.py (_ModelMultiplexWrapper) +
+serve.get_multiplexed_model_id. A replica loads up to max_num_models_per_
+replica models on demand and evicts least-recently-used; the router
+prefers replicas that already hold the requested model.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import inspect
+from collections import OrderedDict
+from typing import Callable, Optional
+
+
+def multiplexed(_fn: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    def wrap(fn):
+        caches = {}
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:
+                owner, model_id = args
+                bound = functools.partial(fn, owner)
+                key = id(owner)
+            else:
+                (model_id,) = args
+                owner, bound, key = None, fn, None
+            cache = caches.get(key)
+            if cache is None:
+                cache = caches[key] = OrderedDict()
+            if model_id in cache:
+                cache.move_to_end(model_id)
+                return cache[model_id]
+            if inspect.iscoroutinefunction(fn):
+                model = await bound(model_id)
+            else:
+                model = await asyncio.get_running_loop().run_in_executor(
+                    None, bound, model_id)
+            cache[model_id] = model
+            _record_model(model_id)
+            while len(cache) > max_num_models_per_replica:
+                evicted_id, _evicted = cache.popitem(last=False)
+                _unrecord_model(evicted_id)
+            return model
+
+        wrapper._is_serve_multiplexed = True
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap
+
+
+def _record_model(model_id: str) -> None:
+    """Advertise the loaded model on this replica so the router can route
+    matching requests here."""
+    try:
+        from ray_tpu.serve._private import replica as replica_mod
+
+        actor = replica_mod._current_replica
+        if actor is not None:
+            actor.record_multiplexed_model(model_id)
+    except Exception:
+        pass
+
+
+def _unrecord_model(model_id: str) -> None:
+    try:
+        from ray_tpu.serve._private import replica as replica_mod
+
+        actor = replica_mod._current_replica
+        if actor is not None and \
+                model_id in actor._multiplexed_model_ids:
+            actor._multiplexed_model_ids.remove(model_id)
+    except Exception:
+        pass
+
+
+def get_multiplexed_model_id() -> str:
+    from ray_tpu.serve._private.replica import get_multiplexed_model_id as g
+
+    return g()

@@ -51,19 +51,31 @@ class TuneController:
         self.trial_resources = trial_resources or {"CPU": 1.0}
 
         # Pending configs: grid/random searchers pre-generate; adaptive
-        # searchers are polled via suggest() as slots open.
+        # searchers are polled via suggest() as slots open. Unwrap
+        # ConcurrencyLimiter so a wrapped BasicVariantGenerator still takes
+        # the pre-generation path (its suggest() yields nothing).
+        from ray_tpu.tune.search.searcher import ConcurrencyLimiter
+
+        base_searcher = self.search_alg
+        limiter_cap = None
+        while isinstance(base_searcher, ConcurrencyLimiter):
+            limiter_cap = (base_searcher.max_concurrent
+                           if limiter_cap is None
+                           else min(limiter_cap, base_searcher.max_concurrent))
+            base_searcher = base_searcher.searcher
         self._pending: List[Trial] = []
-        self._adaptive = not isinstance(self.search_alg,
-                                        BasicVariantGenerator)
+        self._adaptive = not isinstance(base_searcher, BasicVariantGenerator)
         if self._adaptive:
             self._remaining_suggestions = num_samples
         else:
-            for cfg in self.search_alg.generate_variants(
+            for cfg in base_searcher.generate_variants(
                     param_space, num_samples):
                 self._pending.append(Trial(cfg, experiment_dir))
         if max_concurrent_trials <= 0:
             ncpu = os.cpu_count() or 8
             max_concurrent_trials = max(1, min(16, ncpu))
+        if limiter_cap is not None:
+            max_concurrent_trials = min(max_concurrent_trials, limiter_cap)
         self.max_concurrent = max_concurrent_trials
 
         self.trials: List[Trial] = list(self._pending)
@@ -179,6 +191,16 @@ class TuneController:
                 trial.num_failures += 1
                 self.search_alg.on_trial_result(trial.trial_id,
                                                 {"error": str(e)})
+                # The actor may still be alive (user code raised): grab its
+                # latest checkpoint so the restart resumes instead of
+                # starting over.
+                try:
+                    ckpt = ray_tpu.get(
+                        handle.latest_checkpoint.remote(), timeout=30)
+                    if ckpt:
+                        trial.checkpoint_path = ckpt
+                except Exception:
+                    pass
                 self._stop_actor(trial)
                 if trial.num_failures <= self.max_failures:
                     self._launch(trial)  # restart from latest checkpoint
@@ -209,7 +231,12 @@ class TuneController:
                 self.scheduler.on_trial_complete(self, trial, result)
                 self._stop_actor(trial)
             else:
-                if trial.trial_id in self._actors:
+                # exploit() may have relaunched this trial during
+                # scheduler.on_trial_result, already enqueuing a train()
+                # ref — don't double-schedule on the fresh actor.
+                has_inflight = any(t.trial_id == trial.trial_id
+                                   for t in self._inflight.values())
+                if trial.trial_id in self._actors and not has_inflight:
                     nref = self._actors[trial.trial_id].train.remote()
                     self._inflight[nref] = trial
         return bool(self._inflight or self._pending or
