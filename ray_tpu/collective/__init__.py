@@ -1,0 +1,45 @@
+"""ray_tpu.collective — eager host-driven collective communication.
+
+Parity target: python/ray/util/collective/ (group management + allreduce/
+allgather/broadcast/reduce/reducescatter/send/recv across actors). The
+in-program TPU collective plane is GSPMD/XLA over ICI (ray_tpu.parallel);
+this package is the host/DCN plane.
+"""
+
+from ray_tpu.collective.coordinator import ReduceOp
+from ray_tpu.collective.collective import (
+    init_collective_group,
+    create_collective_group,
+    destroy_collective_group,
+    is_group_initialized,
+    get_rank,
+    get_collective_group_size,
+    allreduce,
+    allgather,
+    broadcast,
+    reduce,
+    reducescatter,
+    alltoall,
+    barrier,
+    send,
+    recv,
+)
+
+__all__ = [
+    "ReduceOp",
+    "init_collective_group",
+    "create_collective_group",
+    "destroy_collective_group",
+    "is_group_initialized",
+    "get_rank",
+    "get_collective_group_size",
+    "allreduce",
+    "allgather",
+    "broadcast",
+    "reduce",
+    "reducescatter",
+    "alltoall",
+    "barrier",
+    "send",
+    "recv",
+]

@@ -1,0 +1,260 @@
+"""Eager host-driven collectives across actors/tasks.
+
+API parity with the reference's python/ray/util/collective/collective.py
+(init_collective_group :120, create_collective_group :151, allreduce :258,
+send :350 / recv :376 in the NCCL group). Backend difference, by design:
+on TPU the *in-program* collective plane is XLA ops over ICI inserted by
+GSPMD (ray_tpu.parallel); this module is the out-of-program host plane —
+numpy tensors rendezvous through a coordinator actor over the object
+store (the DCN path), matching the role of the reference's gloo backend.
+
+Launch-order discipline: every rank of a group must issue the same
+collective ops in the same order (the same contract NCCL imposes). Each
+process keeps a per-group sequence counter; mismatched orders deadlock,
+exactly as they would on NCCL — use the `timeout_s` escape hatch to turn
+deadlocks into errors.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.collective.coordinator import (COORDINATOR_NAME,
+                                            COORDINATOR_NAMESPACE,
+                                            CollectiveCoordinator, ReduceOp)
+
+_local = threading.local()
+_DEFAULT_TIMEOUT_S = 120.0
+
+
+class _GroupState:
+    def __init__(self, group_name: str, rank: int, world_size: int,
+                 coordinator):
+        self.group_name = group_name
+        self.rank = rank
+        self.world_size = world_size
+        self.coordinator = coordinator
+        self.seq = 0
+
+    def next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+
+def _groups() -> Dict[str, _GroupState]:
+    if not hasattr(_local, "groups"):
+        _local.groups = {}
+    return _local.groups
+
+
+def _get_or_create_coordinator():
+    from ray_tpu.core.actor import get_actor
+
+    try:
+        return get_actor(COORDINATOR_NAME, namespace=COORDINATOR_NAMESPACE)
+    except ValueError:
+        pass
+    try:
+        cls = ray_tpu.remote(CollectiveCoordinator)
+        return cls.options(name=COORDINATOR_NAME,
+                           namespace=COORDINATOR_NAMESPACE,
+                           lifetime="detached").remote()
+    except Exception:
+        # Lost the creation race; resolve the winner's actor.
+        return get_actor(COORDINATOR_NAME, namespace=COORDINATOR_NAMESPACE)
+
+
+def _my_actor_id_hex() -> Optional[str]:
+    ctx = ray_tpu.get_runtime_context()
+    actor_id = ctx.current_actor_id
+    if actor_id is None:
+        return None
+    return actor_id.hex() if hasattr(actor_id, "hex") else str(actor_id)
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "store",
+                          group_name: str = "default") -> None:
+    """Initialize this process's membership in a collective group.
+
+    Reference: python/ray/util/collective/collective.py:120. `backend`
+    accepts "store" (the only host backend; "nccl"/"gloo" map to it for
+    API compatibility).
+    """
+    if rank < 0 or rank >= world_size:
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    coordinator = _get_or_create_coordinator()
+    ray_tpu.get(coordinator.declare_group.remote(
+        group_name, world_size,
+        {_my_actor_id_hex() or f"rank-{rank}": rank}))
+    _groups()[group_name] = _GroupState(group_name, rank, world_size,
+                                        coordinator)
+
+
+def create_collective_group(actors: List[Any], world_size: int,
+                            ranks: List[int],
+                            backend: str = "store",
+                            group_name: str = "default") -> None:
+    """Driver-side declarative group setup over existing actors.
+
+    Reference: python/ray/util/collective/collective.py:151. Actors join
+    lazily: their first collective op resolves their rank from the
+    coordinator's membership table by actor id.
+    """
+    if len(actors) != len(ranks) or len(actors) != world_size:
+        raise ValueError("need exactly one actor per rank")
+    coordinator = _get_or_create_coordinator()
+    members = {a._actor_id.hex(): r for a, r in zip(actors, ranks)}
+    ray_tpu.get(coordinator.declare_group.remote(group_name, world_size,
+                                                 members))
+
+
+def _resolve_group(group_name: str) -> _GroupState:
+    state = _groups().get(group_name)
+    if state is not None:
+        return state
+    # Declaratively-created group: look up our rank by actor id.
+    coordinator = _get_or_create_coordinator()
+    info = ray_tpu.get(coordinator.group_info.remote(group_name))
+    if info is None:
+        raise ValueError(f"collective group {group_name!r} does not exist; "
+                         "call init_collective_group or "
+                         "create_collective_group first")
+    me = _my_actor_id_hex()
+    rank = info["members"].get(me)
+    if rank is None:
+        raise ValueError(
+            f"this process is not a member of group {group_name!r}")
+    state = _GroupState(group_name, rank, info["world_size"], coordinator)
+    _groups()[group_name] = state
+    return state
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups()
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    state = _groups().pop(group_name, None)
+    coordinator = state.coordinator if state else _get_or_create_coordinator()
+    ray_tpu.get(coordinator.destroy_group.remote(group_name))
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _resolve_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _resolve_group(group_name).world_size
+
+
+# ---- ops ----
+
+
+def _as_numpy(tensor) -> np.ndarray:
+    return np.asarray(tensor)
+
+
+def _run_op(group_name: str, op_kind: str, payload, meta: dict,
+            timeout_s: float) -> Any:
+    state = _resolve_group(group_name)
+    seq = state.next_seq()
+    ray_tpu.get(state.coordinator.contribute.remote(
+        group_name, op_kind, seq, state.rank, state.world_size, payload,
+        meta))
+    deadline = time.monotonic() + timeout_s
+    delay = 0.001
+    while True:
+        ready, result = ray_tpu.get(state.coordinator.poll.remote(
+            group_name, op_kind, seq, state.rank))
+        if ready:
+            return result
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"collective {op_kind} seq={seq} timed out after "
+                f"{timeout_s}s in group {group_name!r} (rank {state.rank}); "
+                "check that all ranks issue the same ops in the same order")
+        time.sleep(delay)
+        delay = min(delay * 2, 0.05)
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: str = ReduceOp.SUM,
+              timeout_s: float = _DEFAULT_TIMEOUT_S) -> np.ndarray:
+    """Reference: collective.py:258 (in-place on GPU; value-returning here —
+    host numpy tensors are copies by construction)."""
+    return _run_op(group_name, "allreduce", _as_numpy(tensor),
+                   {"reduce_op": op}, timeout_s)
+
+
+def allgather(tensor, group_name: str = "default",
+              timeout_s: float = _DEFAULT_TIMEOUT_S) -> List[np.ndarray]:
+    return _run_op(group_name, "allgather", _as_numpy(tensor), {}, timeout_s)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
+              timeout_s: float = _DEFAULT_TIMEOUT_S) -> np.ndarray:
+    return _run_op(group_name, "broadcast", _as_numpy(tensor),
+                   {"src_rank": src_rank}, timeout_s)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = ReduceOp.SUM,
+           timeout_s: float = _DEFAULT_TIMEOUT_S) -> Optional[np.ndarray]:
+    """Non-dst ranks receive None."""
+    return _run_op(group_name, "reduce", _as_numpy(tensor),
+                   {"reduce_op": op, "dst_rank": dst_rank}, timeout_s)
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: str = ReduceOp.SUM,
+                  timeout_s: float = _DEFAULT_TIMEOUT_S) -> np.ndarray:
+    """Each rank receives its axis-0 shard of the reduced tensor."""
+    return _run_op(group_name, "reducescatter", _as_numpy(tensor),
+                   {"reduce_op": op}, timeout_s)
+
+
+def alltoall(tensor_list: List[Any], group_name: str = "default",
+             timeout_s: float = _DEFAULT_TIMEOUT_S) -> List[np.ndarray]:
+    """tensor_list[i] goes to rank i; returns one chunk from every rank."""
+    state = _resolve_group(group_name)
+    if len(tensor_list) != state.world_size:
+        raise ValueError("alltoall needs exactly world_size tensors")
+    return _run_op(group_name, "alltoall",
+                   [_as_numpy(t) for t in tensor_list], {}, timeout_s)
+
+
+def barrier(group_name: str = "default",
+            timeout_s: float = _DEFAULT_TIMEOUT_S) -> None:
+    _run_op(group_name, "barrier", None, {}, timeout_s)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default",
+         tag: int = 0) -> None:
+    """P2P send (reference: nccl_collective_group.py:350)."""
+    state = _resolve_group(group_name)
+    ray_tpu.get(state.coordinator.p2p_send.remote(
+        group_name, state.rank, dst_rank, tag, _as_numpy(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0,
+         timeout_s: float = _DEFAULT_TIMEOUT_S) -> np.ndarray:
+    """P2P recv (reference: nccl_collective_group.py:376)."""
+    state = _resolve_group(group_name)
+    deadline = time.monotonic() + timeout_s
+    delay = 0.001
+    while True:
+        ready, payload = ray_tpu.get(state.coordinator.p2p_recv.remote(
+            group_name, src_rank, state.rank, tag))
+        if ready:
+            return payload
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"recv from rank {src_rank} tag={tag} timed out")
+        time.sleep(delay)
+        delay = min(delay * 2, 0.05)

@@ -1,0 +1,174 @@
+"""Collective coordinator actor — rendezvous + host-side reduction.
+
+This is the DCN/host plane of the collective layer (reference:
+python/ray/util/collective/collective_group/ — NCCL/Gloo groups). On TPU,
+in-program collectives are XLA ops over ICI (jax.lax.psum et al., see
+ray_tpu.parallel); this coordinator serves the *eager, host-driven* path
+the reference's gloo backend serves: numpy tensors moved between actor
+processes through the object store, reduced on the coordinator.
+
+One named coordinator actor exists per collective group namespace. All
+ranks of a group must issue the same ops in the same order (NCCL-style
+launch-order discipline); each op gets a monotonically increasing sequence
+number on every rank, and the coordinator keys rendezvous state on
+(group, op, seq).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+COORDINATOR_NAME = "_ray_tpu_collective_coordinator"
+COORDINATOR_NAMESPACE = "ray_tpu.collective"
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+def _reduce(op: str, tensors: List[np.ndarray]) -> np.ndarray:
+    stack = np.stack(tensors)
+    if op == ReduceOp.SUM:
+        return stack.sum(axis=0)
+    if op == ReduceOp.PRODUCT:
+        return stack.prod(axis=0)
+    if op == ReduceOp.MIN:
+        return stack.min(axis=0)
+    if op == ReduceOp.MAX:
+        return stack.max(axis=0)
+    raise ValueError(f"unknown reduce op: {op}")
+
+
+class _Rendezvous:
+    """State for one in-flight collective op instance."""
+
+    __slots__ = ("world_size", "payloads", "result", "fetched")
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.payloads: Dict[int, Any] = {}
+        self.result: Any = None
+        self.fetched: set = set()
+
+
+class CollectiveCoordinator:
+    """Named actor holding group membership and op rendezvous state."""
+
+    def __init__(self):
+        # group_name -> {"world_size": int, "members": {actor_id_hex: rank}}
+        self._groups: Dict[str, Dict[str, Any]] = {}
+        # (group, op_kind, seq) -> _Rendezvous
+        self._ops: Dict[Tuple[str, str, int], _Rendezvous] = {}
+        # (group, src, dst, tag) -> FIFO of payloads (p2p mailbox)
+        self._mailbox: Dict[Tuple[str, int, int, int], List[Any]] = {}
+
+    # ---- membership ----
+
+    def declare_group(self, group_name: str, world_size: int,
+                      members: Optional[Dict[str, int]] = None) -> None:
+        """Register a group (declarative driver-side setup).
+
+        members maps actor-id hex -> rank, used by actors that never called
+        init_collective_group locally (reference: create_collective_group,
+        python/ray/util/collective/collective.py:151). Declarations merge:
+        each rank's init_collective_group contributes its own entry.
+        """
+        group = self._groups.setdefault(
+            group_name, {"world_size": world_size, "members": {}})
+        if group["world_size"] != world_size:
+            raise ValueError(
+                f"group {group_name!r} redeclared with world_size "
+                f"{world_size}, was {group['world_size']}")
+        group["members"].update(members or {})
+
+    def group_info(self, group_name: str) -> Optional[Dict[str, Any]]:
+        return self._groups.get(group_name)
+
+    def rank_of(self, group_name: str, actor_id_hex: str) -> Optional[int]:
+        group = self._groups.get(group_name)
+        if group is None:
+            return None
+        return group["members"].get(actor_id_hex)
+
+    def destroy_group(self, group_name: str) -> None:
+        self._groups.pop(group_name, None)
+        for key in [k for k in self._ops if k[0] == group_name]:
+            del self._ops[key]
+        for key in [k for k in self._mailbox if k[0] == group_name]:
+            del self._mailbox[key]
+
+    # ---- collective rendezvous ----
+
+    def contribute(self, group: str, op_kind: str, seq: int, rank: int,
+                   world_size: int, payload: Any,
+                   meta: Optional[dict] = None) -> None:
+        key = (group, op_kind, seq)
+        rdv = self._ops.get(key)
+        if rdv is None:
+            rdv = self._ops[key] = _Rendezvous(world_size)
+        rdv.payloads[rank] = payload
+        if len(rdv.payloads) == rdv.world_size and rdv.result is None:
+            rdv.result = self._finalize(op_kind, rdv, meta or {})
+
+    def poll(self, group: str, op_kind: str, seq: int,
+             rank: int) -> Tuple[bool, Any]:
+        """Returns (ready, result-for-rank); cleans up after all fetched."""
+        key = (group, op_kind, seq)
+        rdv = self._ops.get(key)
+        if rdv is None or rdv.result is None:
+            return False, None
+        result = rdv.result[rank] if isinstance(rdv.result, dict) \
+            else rdv.result
+        rdv.fetched.add(rank)
+        if len(rdv.fetched) == rdv.world_size:
+            del self._ops[key]
+        return True, result
+
+    def _finalize(self, op_kind: str, rdv: _Rendezvous, meta: dict) -> Any:
+        kind = op_kind.split(":")[0]
+        by_rank = [rdv.payloads[r] for r in range(rdv.world_size)]
+        if kind == "allreduce":
+            return _reduce(meta.get("reduce_op", ReduceOp.SUM), by_rank)
+        if kind == "allgather":
+            return list(by_rank)
+        if kind == "broadcast":
+            return by_rank[meta.get("src_rank", 0)]
+        if kind == "reduce":
+            # Only dst rank receives the reduced tensor.
+            reduced = _reduce(meta.get("reduce_op", ReduceOp.SUM), by_rank)
+            dst = meta.get("dst_rank", 0)
+            return {r: (reduced if r == dst else None)
+                    for r in range(rdv.world_size)}
+        if kind == "reducescatter":
+            reduced = _reduce(meta.get("reduce_op", ReduceOp.SUM), by_rank)
+            chunks = np.array_split(reduced, rdv.world_size, axis=0)
+            return {r: chunks[r] for r in range(rdv.world_size)}
+        if kind == "alltoall":
+            # payload per rank is a list of world_size chunks.
+            return {r: [by_rank[s][r] for s in range(rdv.world_size)]
+                    for r in range(rdv.world_size)}
+        if kind == "barrier":
+            return True
+        raise ValueError(f"unknown collective kind: {kind}")
+
+    # ---- p2p mailbox ----
+
+    def p2p_send(self, group: str, src: int, dst: int, tag: int,
+                 payload: Any) -> None:
+        self._mailbox.setdefault((group, src, dst, tag), []).append(payload)
+
+    def p2p_recv(self, group: str, src: int, dst: int,
+                 tag: int) -> Tuple[bool, Any]:
+        key = (group, src, dst, tag)
+        queue = self._mailbox.get(key)
+        if queue:
+            payload = queue.pop(0)
+            if not queue:
+                del self._mailbox[key]
+            return True, payload
+        return False, None

@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -44,9 +45,13 @@ class DeploymentReplica:
 
     def __init__(self, deployment_id: DeploymentID, version: str):
         DeploymentReplica._counter += 1
-        self.replica_id = f"{deployment_id.name}#{DeploymentReplica._counter:05d}"
-        self.actor_name = format_replica_actor_name(
-            deployment_id, f"{DeploymentReplica._counter:05d}")
+        # Random suffix keeps replica names unique across controller
+        # restarts: a recovered controller's counter restarts at 1 while
+        # detached replicas from the previous incarnation still hold their
+        # names in the GCS.
+        uid = f"{DeploymentReplica._counter:05d}-{os.urandom(3).hex()}"
+        self.replica_id = f"{deployment_id.name}#{uid}"
+        self.actor_name = format_replica_actor_name(deployment_id, uid)
         self.deployment_id = deployment_id
         self.version = version
         self.state = ReplicaState.STARTING
@@ -300,7 +305,12 @@ class DeploymentState:
         counts: Dict[str, int] = {}
         for r in self.replicas:
             counts[r.state.value] = counts.get(r.state.value, 0) + 1
-        running = counts.get("RUNNING", 0)
+        # Only current-version replicas count toward readiness: during a
+        # rollout the surviving stale replicas keep serving, but the deploy
+        # is not HEALTHY until the new version reaches target scale.
+        running = sum(1 for r in self.replicas
+                      if r.state == ReplicaState.RUNNING and
+                      r.version == self.target_version)
         if self._consecutive_start_failures >= 3:
             status = DeploymentStatus.UNHEALTHY
             msg = "replicas failed to start 3 times in a row"

@@ -167,18 +167,28 @@ class ProxyActor:
             body=body)
         self._num_requests += 1
         try:
-            result = await asyncio.get_running_loop().run_in_executor(
-                None, self._call_handle, entry, serve_req)
+            # Routing (replica pick + submit) is short blocking work — run
+            # it on the executor; the long wait for the reply is awaited on
+            # the event loop, so one slow request does not hold a thread
+            # (reference: fully-async HTTPProxy, proxy.py:761).
+            response = await asyncio.get_running_loop().run_in_executor(
+                None, self._submit, entry, serve_req)
+            result = await asyncio.wait_for(
+                self._await_response(response), timeout=60)
         except Exception as e:
             logger.exception("request to %s failed", path)
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         return self._to_response(result)
 
-    def _call_handle(self, entry: dict, serve_req: ServeRequest):
+    def _submit(self, entry: dict, serve_req: ServeRequest):
         from ray_tpu.serve.handle import DeploymentHandle
 
         handle = DeploymentHandle(entry["deployment"], entry["app_name"])
-        return handle.remote(serve_req).result(timeout_s=60)
+        return handle.remote(serve_req)
+
+    @staticmethod
+    async def _await_response(response):
+        return await response
 
     @staticmethod
     def _to_response(result):

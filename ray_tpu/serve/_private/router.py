@@ -177,12 +177,23 @@ class Router:
                             meta: RequestMetadata) -> _ReplicaEntry:
         """Prefer a replica that already has the model loaded (reference:
         multiplex-aware routing in pow_2_scheduler.py). The model→replica
-        map is cached with a short TTL so the hot path does no RPCs."""
+        map is cached and refreshed from a background thread so the hot
+        path never blocks on the fan-out RPC."""
         now = time.time()
         if now - getattr(self, "_mux_fetched_at", 0.0) > \
-                self._MULTIPLEX_CACHE_TTL_S:
-            self._refresh_multiplex_cache()
-            self._mux_fetched_at = now
+                self._MULTIPLEX_CACHE_TTL_S and \
+                not getattr(self, "_mux_refreshing", False):
+            self._mux_refreshing = True
+
+            def _bg():
+                try:
+                    self._refresh_multiplex_cache()
+                    self._mux_fetched_at = time.time()
+                finally:
+                    self._mux_refreshing = False
+
+            threading.Thread(target=_bg, daemon=True,
+                             name="serve-mux-refresh").start()
         cache: Dict[str, List[str]] = getattr(self, "_mux_models", {})
         replica_ids = cache.get(meta.multiplexed_model_id, [])
         if replica_ids:

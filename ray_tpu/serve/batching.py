@@ -69,10 +69,6 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
     def wrap(fn):
         queues = {}  # per bound instance (or None for free functions)
 
-        if len(inspect.signature(fn).parameters) >= 2 or \
-                inspect.signature(fn).parameters.get("self") is not None:
-            pass
-
         @functools.wraps(fn)
         async def wrapper(*args):
             if len(args) == 2:  # bound method: (self, item)

@@ -1,0 +1,104 @@
+"""Collective library tests.
+
+Reference test model: python/ray/util/collective tests — ranks are actors
+that each issue the same collective ops; assertions on reduced values.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import collective as col
+
+
+@ray_tpu.remote
+class Rank:
+    def __init__(self, rank, world):
+        self.rank = rank
+        self.world = world
+
+    def setup(self, group="default"):
+        col.init_collective_group(self.world, self.rank, group_name=group)
+        return True
+
+    def do_allreduce(self, value, group="default"):
+        return col.allreduce(np.full((4,), value, np.float32),
+                             group_name=group)
+
+    def do_allgather(self, group="default"):
+        return col.allgather(np.array([self.rank], np.int32),
+                             group_name=group)
+
+    def do_broadcast(self, group="default"):
+        return col.broadcast(
+            np.array([self.rank * 10], np.int32), src_rank=1,
+            group_name=group)
+
+    def do_reducescatter(self, group="default"):
+        t = np.arange(8, dtype=np.float32)
+        return col.reducescatter(t, group_name=group)
+
+    def do_sendrecv(self, group="default"):
+        if self.rank == 0:
+            col.send(np.array([42]), dst_rank=1, group_name=group)
+            return None
+        return col.recv(src_rank=0, group_name=group)
+
+    def do_barrier_then_rank(self, group="default"):
+        col.barrier(group_name=group)
+        return col.get_rank(group_name=group)
+
+
+@pytest.fixture(scope="module")
+def two_ranks(ray_start_regular):
+    actors = [Rank.remote(r, 2) for r in range(2)]
+    ray_tpu.get([a.setup.remote() for a in actors])
+    yield actors
+
+
+def test_allreduce(two_ranks):
+    out = ray_tpu.get([a.do_allreduce.remote(v)
+                       for a, v in zip(two_ranks, [1.0, 2.0])])
+    for res in out:
+        np.testing.assert_allclose(res, np.full((4,), 3.0))
+
+
+def test_allgather(two_ranks):
+    out = ray_tpu.get([a.do_allgather.remote() for a in two_ranks])
+    for res in out:
+        assert [int(x[0]) for x in res] == [0, 1]
+
+
+def test_broadcast(two_ranks):
+    out = ray_tpu.get([a.do_broadcast.remote() for a in two_ranks])
+    assert all(int(r[0]) == 10 for r in out)
+
+
+def test_reducescatter(two_ranks):
+    out = ray_tpu.get([a.do_reducescatter.remote() for a in two_ranks])
+    np.testing.assert_allclose(out[0], 2 * np.arange(4))
+    np.testing.assert_allclose(out[1], 2 * np.arange(4, 8))
+
+
+def test_send_recv(two_ranks):
+    out = ray_tpu.get([a.do_sendrecv.remote() for a in two_ranks])
+    assert out[0] is None
+    assert int(out[1][0]) == 42
+
+
+def test_barrier_and_rank(two_ranks):
+    out = ray_tpu.get([a.do_barrier_then_rank.remote() for a in two_ranks])
+    assert sorted(out) == [0, 1]
+
+
+def test_declarative_group(ray_start_regular):
+    actors = [Rank.remote(r, 3) for r in range(3)]
+    col.create_collective_group(actors, 3, [0, 1, 2], group_name="g3")
+    out = ray_tpu.get(
+        [a.do_allreduce.remote(float(i + 1), "g3")
+         for i, a in enumerate(actors)])
+    for res in out:
+        np.testing.assert_allclose(res, np.full((4,), 6.0))
+    col.destroy_collective_group("g3")
+    for a in actors:
+        ray_tpu.kill(a)
