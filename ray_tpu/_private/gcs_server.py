@@ -703,6 +703,20 @@ class GcsServer:
         limit = data.get("limit", 1000)
         return self.task_events[-limit:]
 
+    # ------------------------------------------------------------- state API
+    async def handle_list_object_locations(self, data, conn) -> list:
+        return [{"object_id": oid.hex() if isinstance(oid, bytes) else oid,
+                 "node_ids": [n.hex() for n in locs],
+                 "spilled_url": self.spilled_objects.get(oid)}
+                for oid, locs in self.object_locations.items()]
+
+    async def handle_list_placement_groups(self, data, conn) -> list:
+        return [pg.view() for pg in self.placement_groups.values()]
+
+    async def handle_list_jobs(self, data, conn) -> list:
+        return [{"job_id": jid.hex(), **info}
+                for jid, info in self.jobs.items()]
+
     # ------------------------------------------------------------- misc
     async def handle_cluster_resources(self, data, conn) -> dict:
         total: Dict[str, float] = {}

@@ -1,0 +1,22 @@
+"""ray_tpu.rllib — reinforcement learning library.
+
+Parity target: the reference's rllib/ new API stack (AlgorithmConfig /
+Algorithm / EnvRunnerGroup / RLModule / Learner / LearnerGroup) with
+JAX/TPU learners and CPU env-runner actors. Algorithms: PPO, DQN.
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.env.registry import register_env
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "PPO",
+    "PPOConfig",
+    "DQN",
+    "DQNConfig",
+    "register_env",
+]

@@ -1,0 +1,138 @@
+"""Algorithm — the trainable driver of an RL experiment.
+
+Reference: rllib/algorithms/algorithm.py:229 (Algorithm extends Trainable;
+step() :894 calls training_step() :1670; save/restore via Checkpointable).
+Subclasses implement training_step(); Tune runs Algorithms directly
+because Algorithm is a ray_tpu.tune Trainable.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.rllib.env.registry import make_env
+from ray_tpu.tune.trainable import Trainable
+
+
+class Algorithm(Trainable):
+    config_class = AlgorithmConfig
+    learner_class: type = None  # set by subclass
+    module_class: type = None   # set by subclass
+
+    # ---- Trainable hooks ----
+
+    def setup(self, config) -> None:
+        if isinstance(config, AlgorithmConfig):
+            self.config = config
+        else:
+            self.config = self.config_class().update_from_dict(
+                dict(config or {}))
+        if self.config.env is None:
+            raise ValueError("config.environment(env=...) is required")
+        probe = make_env(self.config.env, self.config.env_config)
+        obs_dim = int(probe.observation_space.shape[0])
+        num_actions = int(probe.action_space.n)
+        self.module_spec = self._make_module_spec(obs_dim, num_actions)
+        cfg = self.config.to_dict()
+        cfg["module_spec"] = self.module_spec
+        self.env_runner_group = EnvRunnerGroup(cfg)
+        self.learner_group = LearnerGroup(
+            self.learner_class, self.module_spec, cfg)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self._iteration = 0
+        self._env_steps_total = 0
+
+    def _make_module_spec(self, obs_dim: int, num_actions: int):
+        from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+        return RLModuleSpec(self.module_class, obs_dim, num_actions,
+                            dict(self.config.model))
+
+    def step(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        results = self.training_step()
+        self._iteration += 1
+        if self.config.restart_failed_env_runners:
+            restored = self.env_runner_group.restore_failed(
+                self.learner_group.get_weights)
+            if restored:
+                results["num_env_runners_restored"] = restored
+        metrics = self.env_runner_group.aggregate_metrics()
+        results.update(metrics)
+        self._env_steps_total = metrics.get("num_env_steps",
+                                            self._env_steps_total)
+        results["training_iteration"] = self._iteration
+        results["time_this_iter_s"] = time.perf_counter() - t0
+        return results
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # Reference-style convenience: algo.train() loops come from Trainable.
+
+    def save_checkpoint(self, checkpoint_dir: str) -> str:
+        state = {
+            "learner": self.learner_group.get_state(),
+            "iteration": self._iteration,
+            "algo_state": self.get_extra_state(),
+        }
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(state, f)
+        return checkpoint_dir
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_state(state["learner"])
+        self._iteration = state["iteration"]
+        self.set_extra_state(state.get("algo_state", {}))
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def get_extra_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_extra_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+    def cleanup(self) -> None:
+        try:
+            self.env_runner_group.stop()
+        finally:
+            self.learner_group.stop()
+
+    stop = cleanup
+
+    # ---- evaluation ----
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
+        """Greedy evaluation on a fresh env."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        env = make_env(self.config.env, self.config.env_config)
+        module = self.module_spec.build()
+        params = jax.tree_util.tree_map(
+            jnp.asarray, self.learner_group.get_weights())
+        infer = jax.jit(module.forward_inference)
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=10_000 + ep)
+            total, done = 0.0, False
+            while not done:
+                out = infer(params, obs[None])
+                obs, r, term, trunc, _ = env.step(int(out["actions"][0]))
+                total += r
+                done = term or trunc
+            returns.append(total)
+        return {"evaluation": {
+            "episode_return_mean": float(np.mean(returns)),
+            "num_episodes": num_episodes}}

@@ -1,0 +1,193 @@
+"""DQN — deep Q-learning with target network and (optional) PER.
+
+Reference: rllib/algorithms/dqn/ (DQN new-stack: epsilon-greedy sampling
+into an episode replay buffer, double-Q TD targets, periodic target-net
+sync). Loss is jit-compiled JAX with a Huber TD error.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner
+from ray_tpu.rllib.core.rl_module import QNetModule
+from ray_tpu.rllib.utils import sample_batch as sb
+from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
+                                                ReplayBuffer)
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.replay_buffer_capacity: int = 50_000
+        self.prioritized_replay: bool = False
+        self.num_steps_sampled_before_learning_starts: int = 500
+        self.target_network_update_freq: int = 500  # in env steps
+        self.epsilon_initial: float = 1.0
+        self.epsilon_final: float = 0.05
+        self.epsilon_decay_steps: int = 5_000
+        self.double_q: bool = True
+        self.tau: float = 1.0  # 1.0 = hard target sync
+        self.rollout_fragment_length = 50
+        self.train_batch_size = 32
+        self.updates_per_step: int = 4
+
+    @property
+    def algo_class(self):
+        return DQN
+
+
+class DQNLearner(JaxLearner):
+    def __init__(self, module_spec, config):
+        super().__init__(module_spec, config)
+        import jax
+        import jax.numpy as jnp
+
+        # Real copies: the online params are donated into the jitted step,
+        # so the target tree must not alias their buffers.
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: jnp.array(x, copy=True), self.params)
+
+    def loss_fn(self, params, batch, rng):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        q_all = self.module.forward_train(params, batch[sb.OBS])["q_values"]
+        actions = batch[sb.ACTIONS].astype(jnp.int32)
+        q = jnp.take_along_axis(q_all, actions[:, None], axis=-1)[:, 0]
+
+        q_next_target = self.module.forward_train(
+            batch["target_params"], batch[sb.NEXT_OBS])["q_values"]
+        if cfg.get("double_q", True):
+            q_next_online = self.module.forward_train(
+                params, batch[sb.NEXT_OBS])["q_values"]
+            next_actions = jnp.argmax(q_next_online, axis=-1)
+        else:
+            next_actions = jnp.argmax(q_next_target, axis=-1)
+        q_next = jnp.take_along_axis(
+            q_next_target, next_actions[:, None], axis=-1)[:, 0]
+        q_next = jax.lax.stop_gradient(q_next)
+
+        not_done = 1.0 - batch[sb.TERMINATEDS].astype(jnp.float32)
+        targets = batch[sb.REWARDS] + gamma * not_done * q_next
+        td = q - targets
+        huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                          jnp.abs(td) - 0.5)
+        weights = batch.get("weights")
+        loss = (huber * weights).mean() if weights is not None \
+            else huber.mean()
+        return loss, {"td_error_mean": jnp.abs(td).mean(),
+                      "td_abs": jnp.abs(td),
+                      "q_mean": q.mean()}
+
+    def update_dqn(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """update() with the target params threaded through the batch
+        (keeps the jitted step pure; target sync stays outside jit)."""
+        batch = dict(batch)
+        batch["target_params"] = self.target_params
+        return self.update(batch)
+
+    def _shard_batch(self, batch):
+        # target_params rides along unsharded.
+        import jax.numpy as jnp
+
+        batch = dict(batch)
+        target = batch.pop("target_params", None)
+        out = super()._shard_batch(batch)
+        if target is not None:
+            out["target_params"] = target
+        return out
+
+    def sync_target(self, tau: float = 1.0) -> None:
+        import jax
+
+        self.target_params = jax.tree_util.tree_map(
+            lambda t, p: t * (1 - tau) + p * tau,
+            self.target_params, self.params)
+
+    def get_state(self):
+        import jax
+
+        state = super().get_state()
+        state["target_params"] = jax.tree_util.tree_map(
+            np.asarray, self.target_params)
+        return state
+
+    def set_state(self, state) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.asarray, state["target_params"])
+        else:
+            self.target_params = jax.tree_util.tree_map(
+                lambda x: jnp.array(x, copy=True), self.params)
+
+
+class DQN(Algorithm):
+    config_class = DQNConfig
+    learner_class = DQNLearner
+    module_class = QNetModule
+
+    def setup(self, config) -> None:
+        # Validate before super() spawns any learner actors (a raise after
+        # would leak the remote LearnerGroup).
+        cfg = config if isinstance(config, DQNConfig) else \
+            self.config_class().update_from_dict(dict(config or {}))
+        if cfg.num_learners != 0:
+            raise ValueError(
+                "DQN uses a local learner (target-net state is per-learner)")
+        super().setup(cfg)
+        cfg = self.config
+        buffer_cls = PrioritizedReplayBuffer if cfg.prioritized_replay \
+            else ReplayBuffer
+        self.replay = buffer_cls(cfg.replay_buffer_capacity, seed=cfg.seed)
+        self._env_steps = 0
+        self._last_target_sync = 0
+
+    @property
+    def _learner(self) -> DQNLearner:
+        return self.learner_group._local
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final -
+                                             cfg.epsilon_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        rollout = self.env_runner_group.sample(
+            cfg.rollout_fragment_length, epsilon=self._epsilon())
+        self._env_steps += len(rollout)
+        self.replay.add(rollout)
+
+        metrics: Dict[str, float] = {"epsilon": self._epsilon(),
+                                     "replay_size": len(self.replay)}
+        if len(self.replay) >= \
+                cfg.num_steps_sampled_before_learning_starts:
+            for _ in range(cfg.updates_per_step):
+                batch = self.replay.sample(cfg.train_batch_size)
+                m = self._learner.update_dqn(batch)
+                td_abs = m.pop("td_abs", None)
+                if cfg.prioritized_replay and "batch_indexes" in batch \
+                        and td_abs is not None:
+                    self.replay.update_priorities(
+                        batch["batch_indexes"], td_abs)
+                metrics.update(m)
+            if self._env_steps - self._last_target_sync >= \
+                    cfg.target_network_update_freq:
+                self._learner.sync_target(cfg.tau)
+                self._last_target_sync = self._env_steps
+            self.env_runner_group.sync_weights(
+                self.learner_group.get_weights())
+        return metrics

@@ -1,0 +1,113 @@
+"""PPO — Proximal Policy Optimization.
+
+Reference: rllib/algorithms/ppo/ppo.py:401 (PPO, training_step :427:
+synchronous_parallel_sample → GAE → LearnerGroup.update minibatch SGD →
+weight broadcast) and ppo/torch/ppo_torch_learner.py (clipped surrogate
+loss). The loss is jit-compiled JAX; rollouts are CPU actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner
+from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+from ray_tpu.rllib.utils import sample_batch as sb
+from ray_tpu.rllib.utils.postprocessing import compute_gae, standardize
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lambda_: float = 0.95
+        self.clip_param: float = 0.2
+        self.vf_clip_param: float = 10.0
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.0
+        self.kl_target: float = 0.01
+        self.use_kl_loss: bool = False
+        self.kl_coeff: float = 0.2
+
+    @property
+    def algo_class(self):
+        return PPO
+
+
+class PPOLearner(JaxLearner):
+    def loss_fn(self, params, batch, rng):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        out = self.module.forward_train(params, batch[sb.OBS])
+        logits = out["action_dist_inputs"]
+        values = out["vf_preds"]
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch[sb.ACTIONS].astype(jnp.int32)
+        logp = jnp.take_along_axis(
+            logp_all, actions[:, None], axis=-1)[:, 0]
+        old_logp = batch[sb.ACTION_LOGP]
+        adv = batch[sb.ADVANTAGES]
+
+        ratio = jnp.exp(logp - old_logp)
+        clip = cfg.get("clip_param", 0.2)
+        surrogate = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+        policy_loss = -surrogate.mean()
+
+        # Clipped value loss (reference: ppo_torch_learner vf_loss_clipped).
+        vf_err = (values - batch[sb.VALUE_TARGETS]) ** 2
+        vf_loss = jnp.clip(vf_err, 0.0,
+                           cfg.get("vf_clip_param", 10.0)).mean()
+
+        probs = jax.nn.softmax(logits)
+        entropy = -(probs * logp_all).sum(-1).mean()
+
+        kl = (old_logp - logp).mean()
+        total = (policy_loss +
+                 cfg.get("vf_loss_coeff", 0.5) * vf_loss -
+                 cfg.get("entropy_coeff", 0.0) * entropy)
+        if cfg.get("use_kl_loss", False):
+            total = total + cfg.get("kl_coeff", 0.2) * kl
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "kl": kl,
+        }
+
+
+class PPO(Algorithm):
+    config_class = PPOConfig
+    learner_class = PPOLearner
+    module_class = DiscreteMLPModule
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        pairs = self.env_runner_group.sample_with_bootstraps(
+            cfg.train_batch_size)
+        train_batch = SampleBatch.concat_samples([
+            compute_gae(batch, cfg.gamma, cfg.lambda_, bootstrap)
+            for batch, bootstrap in pairs])
+        train_batch[sb.ADVANTAGES] = standardize(
+            train_batch[sb.ADVANTAGES])
+
+        rng = np.random.default_rng(cfg.seed + self._iteration)
+        metrics: Dict[str, float] = {}
+        count = 0
+        for _ in range(cfg.num_epochs):
+            for minibatch in train_batch.minibatches(cfg.minibatch_size,
+                                                     rng):
+                m = self.learner_group.update(minibatch)
+                count += 1
+                for k, v in m.items():
+                    metrics[k] = metrics.get(k, 0.0) + v
+        metrics = {k: v / max(1, count) for k, v in metrics.items()}
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return metrics

@@ -1,0 +1,1 @@
+"""RLModule / Learner core."""

@@ -1,0 +1,148 @@
+"""RLModule — the neural-network component of an algorithm, in JAX.
+
+Reference: rllib/core/rl_module/rl_module.py (RLModule: forward_
+exploration/inference/train over a framework-specific network). TPU-first
+difference: modules are pure functions over a params pytree (haiku-style),
+so the same module runs vmapped/jitted on the learner (TPU) and eagerly on
+CPU env runners from numpy weights — no torch/DDP wrapping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Builds an RLModule from config (reference: SingleAgentRLModuleSpec)."""
+
+    module_class: type
+    obs_dim: int = 0
+    num_actions: int = 0
+    model_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def build(self) -> "RLModule":
+        return self.module_class(self.obs_dim, self.num_actions,
+                                 self.model_config)
+
+
+class RLModule:
+    """Pure-functional module: params pytree + forward methods."""
+
+    def init_params(self, rng: jax.Array) -> Any:
+        raise NotImplementedError
+
+    def forward_train(self, params: Any, obs: jnp.ndarray) -> Dict[str, Any]:
+        """Differentiable path used by the learner loss."""
+        raise NotImplementedError
+
+    def forward_exploration(self, params: Any, obs: jnp.ndarray,
+                            rng: jax.Array) -> Dict[str, Any]:
+        """Stochastic action selection for rollouts."""
+        raise NotImplementedError
+
+    def forward_inference(self, params: Any,
+                          obs: jnp.ndarray) -> Dict[str, Any]:
+        """Greedy action selection for evaluation."""
+        raise NotImplementedError
+
+
+def _mlp_init(rng: jax.Array, sizes: Sequence[int]) -> list:
+    params = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for key, fan_in, fan_out in zip(keys, sizes[:-1], sizes[1:]):
+        w = jax.random.normal(key, (fan_in, fan_out)) * np.sqrt(2.0 / fan_in)
+        params.append({"w": w, "b": jnp.zeros((fan_out,))})
+    return params
+
+
+def _mlp_apply(params: list, x: jnp.ndarray,
+               final_activation: bool = False) -> jnp.ndarray:
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or final_activation:
+            x = jnp.tanh(x)
+    return x
+
+
+class DiscreteMLPModule(RLModule):
+    """MLP torso + policy-logits head + value head for discrete actions.
+
+    The default module for PPO (analog of the reference's default
+    PPOTorchRLModule built by the catalog)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 model_config: Optional[dict] = None):
+        cfg = model_config or {}
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hiddens = tuple(cfg.get("fcnet_hiddens", (64, 64)))
+
+    def init_params(self, rng: jax.Array) -> Dict[str, Any]:
+        k_torso, k_pi, k_vf = jax.random.split(rng, 3)
+        torso_sizes = (self.obs_dim,) + self.hiddens
+        return {
+            "torso": _mlp_init(k_torso, torso_sizes),
+            "pi": _mlp_init(k_pi, (self.hiddens[-1], self.num_actions)),
+            "vf": _mlp_init(k_vf, (self.hiddens[-1], 1)),
+        }
+
+    def _torso(self, params, obs):
+        return _mlp_apply(params["torso"], obs, final_activation=True)
+
+    def forward_train(self, params, obs):
+        feat = self._torso(params, obs)
+        logits = _mlp_apply(params["pi"], feat)
+        value = _mlp_apply(params["vf"], feat)[..., 0]
+        return {"action_dist_inputs": logits, "vf_preds": value}
+
+    def forward_exploration(self, params, obs, rng):
+        out = self.forward_train(params, obs)
+        logits = out["action_dist_inputs"]
+        action = jax.random.categorical(rng, logits, axis=-1)
+        logp = jax.nn.log_softmax(logits)
+        action_logp = jnp.take_along_axis(
+            logp, action[..., None], axis=-1)[..., 0]
+        return {"actions": action, "action_logp": action_logp,
+                "vf_preds": out["vf_preds"]}
+
+    def forward_inference(self, params, obs):
+        out = self.forward_train(params, obs)
+        return {"actions": jnp.argmax(out["action_dist_inputs"], axis=-1)}
+
+
+class QNetModule(RLModule):
+    """MLP Q-network for DQN (analog of the reference's DQN RLModule)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 model_config: Optional[dict] = None):
+        cfg = model_config or {}
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hiddens = tuple(cfg.get("fcnet_hiddens", (64, 64)))
+
+    def init_params(self, rng: jax.Array) -> Dict[str, Any]:
+        sizes = (self.obs_dim,) + self.hiddens + (self.num_actions,)
+        return {"q": _mlp_init(rng, sizes)}
+
+    def forward_train(self, params, obs):
+        return {"q_values": _mlp_apply(params["q"], obs)}
+
+    def forward_exploration(self, params, obs, rng):
+        # Epsilon handling lives in the env runner (needs the schedule).
+        q = self.forward_train(params, obs)["q_values"]
+        return {"actions": jnp.argmax(q, axis=-1), "q_values": q}
+
+    def forward_inference(self, params, obs):
+        q = self.forward_train(params, obs)["q_values"]
+        return {"actions": jnp.argmax(q, axis=-1)}
+
+
+def params_to_numpy(params: Any) -> Any:
+    """Device → host pytree (for shipping weights to env runners)."""
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), params)

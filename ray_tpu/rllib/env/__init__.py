@@ -1,0 +1,1 @@
+"""Env runners and built-in envs."""

@@ -1,0 +1,136 @@
+"""SingleAgentEnvRunner — samples episodes with the current policy.
+
+Reference: rllib/env/single_agent_env_runner.py:60. Runs as a CPU actor:
+holds the env + an RLModule evaluated eagerly from host weights (jit on
+CPU backend), returns SampleBatches through the object store.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.env.registry import make_env
+from ray_tpu.rllib.utils import sample_batch as sb
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+
+class SingleAgentEnvRunner:
+    """One rollout worker. Methods are called via actor RPCs."""
+
+    def __init__(self, config: dict, worker_index: int = 0):
+        import jax
+
+        self.config = config
+        self.worker_index = worker_index
+        self.env = make_env(config["env"], config.get("env_config"))
+        spec = config["module_spec"]
+        self.module = spec.build()
+        self._rng = jax.random.PRNGKey(
+            config.get("seed", 0) * 1000 + worker_index)
+        self._np_rng = np.random.default_rng(
+            config.get("seed", 0) * 1000 + worker_index)
+        self.params = None
+        self._obs, _ = self.env.reset(
+            seed=config.get("seed", 0) * 1000 + worker_index)
+        self._episode_return = 0.0
+        self._episode_len = 0
+        self._eps_id = worker_index * 1_000_000
+        self._recent_returns: collections.deque = collections.deque(
+            maxlen=100)
+        self._explore_fn = None
+        self._total_steps = 0
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def get_weights(self):
+        return self.params
+
+    def _explore(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        import jax
+
+        if self._explore_fn is None:
+            self._explore_fn = jax.jit(self.module.forward_exploration)
+        self._rng, key = jax.random.split(self._rng)
+        out = self._explore_fn(self.params, obs[None, ...], key)
+        return {k: np.asarray(v)[0] for k, v in out.items()}
+
+    def sample(self, num_steps: int, explore: bool = True,
+               epsilon: float = 0.0) -> SampleBatch:
+        """Collect exactly num_steps transitions (episodes may span calls).
+
+        epsilon > 0 overrides the sampled action with a uniform-random one
+        (for value-based algorithms; reference: EpsilonGreedy connector).
+        """
+        assert self.params is not None, "set_weights before sample"
+        cols: Dict[str, List[Any]] = collections.defaultdict(list)
+        last_terminated = last_truncated = False
+        last_next_obs = self._obs
+        for _ in range(num_steps):
+            out = self._explore(self._obs)
+            action = int(out["actions"])
+            if epsilon > 0.0 and self._np_rng.random() < epsilon:
+                action = int(self._np_rng.integers(
+                    self.env.action_space.n))
+            next_obs, reward, terminated, truncated, _ = self.env.step(
+                action)
+            cols[sb.OBS].append(self._obs)
+            cols[sb.NEXT_OBS].append(next_obs)
+            cols[sb.ACTIONS].append(action)
+            cols[sb.REWARDS].append(reward)
+            cols[sb.TERMINATEDS].append(terminated)
+            cols[sb.TRUNCATEDS].append(truncated)
+            cols[sb.EPS_ID].append(self._eps_id)
+            if "action_logp" in out:
+                cols[sb.ACTION_LOGP].append(out["action_logp"])
+            if "vf_preds" in out:
+                cols[sb.VF_PREDS].append(out["vf_preds"])
+            self._episode_return += reward
+            self._episode_len += 1
+            self._total_steps += 1
+            last_terminated, last_truncated = terminated, truncated
+            last_next_obs = next_obs
+            if terminated or truncated:
+                self._recent_returns.append(self._episode_return)
+                self._episode_return = 0.0
+                self._episode_len = 0
+                self._eps_id += 1
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = next_obs
+        # Exact bootstrap for this rollout's final step (computed BEFORE
+        # the post-reset obs can leak in): terminated → 0; truncated →
+        # V(final next_obs); cut mid-episode → V(current obs).
+        if last_terminated:
+            self._end_bootstrap = 0.0
+        elif last_truncated:
+            out = self._explore(last_next_obs)
+            self._end_bootstrap = float(out.get("vf_preds", 0.0))
+        else:
+            out = self._explore(self._obs)
+            self._end_bootstrap = float(out.get("vf_preds", 0.0))
+        return SampleBatch({
+            k: np.asarray(v) for k, v in cols.items()})
+
+    def bootstrap_value(self) -> float:
+        """Value bootstrap for the last sample() rollout's final step —
+        used by GAE (see sample() for the terminated/truncated cases)."""
+        if hasattr(self, "_end_bootstrap"):
+            return self._end_bootstrap
+        out = self._explore(self._obs)
+        return float(out.get("vf_preds", 0.0))
+
+    def get_metrics(self) -> Dict[str, Any]:
+        returns = list(self._recent_returns)
+        return {
+            "episode_return_mean":
+                float(np.mean(returns)) if returns else float("nan"),
+            "num_episodes": len(returns),
+            "num_env_steps": self._total_steps,
+        }
+
+    def ping(self) -> bool:
+        return True

@@ -1,0 +1,129 @@
+"""EnvRunnerGroup — manages N env-runner actors.
+
+Reference: rllib/env/env_runner_group.py:71 + the synchronous_parallel_
+sample util (rllib/algorithms/ppo/ppo.py:441 uses it). Runners are CPU
+actors; weights ship via the object store (one put, N gets).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.utils.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+
+class EnvRunnerGroup:
+    def __init__(self, config: dict):
+        self.config = config
+        self.num_remote = int(config.get("num_env_runners", 0))
+        cpus_per_runner = config.get("num_cpus_per_env_runner", 1)
+        self._local_runner: Optional[SingleAgentEnvRunner] = None
+        self._manager: Optional[FaultTolerantActorManager] = None
+        if self.num_remote == 0:
+            self._local_runner = SingleAgentEnvRunner(config, 0)
+        else:
+            cls = ray_tpu.remote(SingleAgentEnvRunner)
+
+            def factory(i: int):
+                return cls.options(
+                    num_cpus=cpus_per_runner,
+                    max_restarts=config.get("max_restarts", 1),
+                ).remote(config, i + 1)
+
+            actors = [factory(i) for i in range(self.num_remote)]
+            self._manager = FaultTolerantActorManager(actors, factory)
+
+    # ---- weights ----
+
+    def sync_weights(self, params) -> None:
+        if self._local_runner is not None:
+            self._local_runner.set_weights(params)
+            return
+        ref = ray_tpu.put(params)
+        self._manager.foreach(lambda a: a.set_weights.remote(ref))
+
+    # ---- sampling ----
+
+    def sample(self, total_steps: int,
+               epsilon: float = 0.0) -> SampleBatch:
+        """Synchronous parallel sample: each healthy runner collects an
+        equal share of total_steps."""
+        batches = [b for b, _ in
+                   self.sample_with_bootstraps(total_steps, epsilon)]
+        return SampleBatch.concat_samples(batches)
+
+    def sample_with_bootstraps(self, total_steps: int, epsilon: float = 0.0
+                               ) -> List[tuple]:
+        """Returns [(batch, bootstrap_value)] per healthy runner — the
+        bootstrap is that runner's exact value estimate for its rollout's
+        final step (GAE needs it per-runner, not averaged)."""
+        if self._local_runner is not None:
+            batch = self._local_runner.sample(total_steps, epsilon=epsilon)
+            return [(batch, self._local_runner.bootstrap_value())]
+        n = max(1, self._manager.num_healthy_actors())
+        per_runner = max(1, total_steps // n)
+        results = self._manager.foreach(
+            lambda a: a.sample.remote(per_runner, epsilon=epsilon))
+        out = []
+        for i, batch in results.ok:
+            try:
+                boot = ray_tpu.get(
+                    self._manager.actor(i).bootstrap_value.remote(),
+                    timeout=30.0)
+            except Exception:
+                boot = 0.0
+            out.append((batch, boot))
+        if not out:
+            raise RuntimeError("all env runners failed during sample()")
+        return out
+
+    # ---- health / metrics ----
+
+    def restore_failed(self, params_fn=None) -> int:
+        """params_fn: zero-arg callable producing current weights — only
+        invoked when an actor was actually restored (weight pulls are a
+        full device→host transfer; don't pay per-iteration)."""
+        if self._manager is None:
+            return 0
+        restored = self._manager.probe_unhealthy()
+        if restored and params_fn is not None:
+            ref = ray_tpu.put(params_fn())
+            for i in restored:
+                ray_tpu.get(self._manager.actor(i).set_weights.remote(ref))
+        return len(restored)
+
+    def num_healthy(self) -> int:
+        if self._local_runner is not None:
+            return 1
+        return self._manager.num_healthy_actors()
+
+    def aggregate_metrics(self) -> Dict[str, Any]:
+        if self._local_runner is not None:
+            metrics = [self._local_runner.get_metrics()]
+        else:
+            metrics = self._manager.foreach(
+                lambda a: a.get_metrics.remote()).values()
+        if not metrics:
+            return {}
+        returns = [m["episode_return_mean"] for m in metrics
+                   if m["num_episodes"] > 0]
+        return {
+            "episode_return_mean":
+                float(np.mean(returns)) if returns else float("nan"),
+            "num_episodes": sum(m["num_episodes"] for m in metrics),
+            "num_env_steps": sum(m["num_env_steps"] for m in metrics),
+            "num_healthy_env_runners": self.num_healthy(),
+        }
+
+    def stop(self) -> None:
+        if self._manager is not None:
+            for i in list(self._manager._actors):
+                try:
+                    ray_tpu.kill(self._manager.actor(i))
+                except Exception:
+                    pass

@@ -1,0 +1,48 @@
+"""Environment registry.
+
+Reference: ray.tune.registry.register_env (used by RLlib configs to map a
+string env id to a creator). Built-ins resolve first; unknown ids fall
+back to gymnasium when it is importable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_env(name: str, creator: Callable) -> None:
+    _REGISTRY[name] = creator
+
+
+def _builtin(name: str) -> Optional[Callable]:
+    from ray_tpu.rllib.env.tiny_envs import CartPole, GridWorld
+
+    table = {
+        "CartPole-v1": CartPole,
+        "CartPole": CartPole,
+        "GridWorld-v0": GridWorld,
+        "GridWorld": GridWorld,
+    }
+    return table.get(name)
+
+
+def make_env(env: object, env_config: Optional[dict] = None):
+    """Instantiate an env from an id string, creator callable, or class."""
+    env_config = env_config or {}
+    if callable(env):
+        return env(env_config)
+    if isinstance(env, str):
+        creator = _REGISTRY.get(env) or _builtin(env)
+        if creator is not None:
+            return creator(env_config)
+        try:
+            import gymnasium
+
+            return gymnasium.make(env)
+        except Exception:
+            raise ValueError(
+                f"unknown env id {env!r}: not registered, not a built-in, "
+                "and gymnasium could not create it")
+    raise TypeError(f"env must be a str id or callable, got {type(env)}")

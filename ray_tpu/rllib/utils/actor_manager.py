@@ -1,0 +1,99 @@
+"""FaultTolerantActorManager — RPC fan-out with failure tolerance.
+
+Reference: rllib/utils/actor_manager.py:196. Wraps a set of same-class
+actors; foreach() fans a call out, collects results, marks actors that
+raise as unhealthy, and can recreate them from a factory (restored actors
+get the latest weights pushed by the caller).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+class RemoteCallResults:
+    def __init__(self):
+        self.ok: List[Tuple[int, Any]] = []
+        self.errors: List[Tuple[int, Exception]] = []
+
+    def values(self) -> List[Any]:
+        return [v for _, v in sorted(self.ok)]
+
+
+class FaultTolerantActorManager:
+    def __init__(self, actors: List[Any],
+                 actor_factory: Optional[Callable[[int], Any]] = None,
+                 max_remote_requests_in_flight: int = 2):
+        self._actors: Dict[int, Any] = dict(enumerate(actors))
+        self._healthy: Dict[int, bool] = {i: True for i in self._actors}
+        self._factory = actor_factory
+
+    @property
+    def num_actors(self) -> int:
+        return len(self._actors)
+
+    def num_healthy_actors(self) -> int:
+        return sum(self._healthy.values())
+
+    def healthy_actor_ids(self) -> List[int]:
+        return [i for i, h in self._healthy.items() if h]
+
+    def actor(self, actor_id: int) -> Any:
+        return self._actors[actor_id]
+
+    def foreach(self, fn: Callable[[Any], Any],
+                *, healthy_only: bool = True,
+                timeout_s: Optional[float] = None) -> RemoteCallResults:
+        """fn maps an actor handle to an ObjectRef (e.g. lambda a:
+        a.sample.remote(50)); results gathered with per-actor error
+        isolation."""
+        ids = self.healthy_actor_ids() if healthy_only \
+            else list(self._actors)
+        refs = {}
+        results = RemoteCallResults()
+        for i in ids:
+            try:
+                refs[i] = fn(self._actors[i])
+            except Exception as e:  # submission itself failed
+                self._mark_unhealthy(i, e)
+                results.errors.append((i, e))
+        for i, ref in refs.items():
+            try:
+                results.ok.append((i, ray_tpu.get(ref, timeout=timeout_s)))
+            except Exception as e:
+                self._mark_unhealthy(i, e)
+                results.errors.append((i, e))
+        return results
+
+    def _mark_unhealthy(self, actor_id: int, error: Exception) -> None:
+        logger.warning("actor %d failed: %s", actor_id, error)
+        self._healthy[actor_id] = False
+
+    def probe_unhealthy(self) -> List[int]:
+        """Ping unhealthy actors; recreate dead ones via the factory.
+        Returns ids restored this call (caller re-syncs their state)."""
+        restored = []
+        for i, healthy in list(self._healthy.items()):
+            if healthy:
+                continue
+            try:
+                ray_tpu.get(self._actors[i].ping.remote(), timeout=5.0)
+                self._healthy[i] = True
+                restored.append(i)
+            except Exception:
+                if self._factory is not None:
+                    try:
+                        self._actors[i] = self._factory(i)
+                        ray_tpu.get(self._actors[i].ping.remote(),
+                                    timeout=10.0)
+                        self._healthy[i] = True
+                        restored.append(i)
+                    except Exception as e:
+                        logger.warning("restore of actor %d failed: %s",
+                                       i, e)
+        return restored

@@ -1,0 +1,61 @@
+"""Advantage estimation (GAE) over concatenated rollout batches.
+
+Reference: rllib/evaluation/postprocessing.py (compute_advantages) /
+connectors GeneralAdvantageEstimation. Computed host-side in numpy —
+rollouts arrive as numpy and the scan is O(T) with trivial FLOPs, so
+there is nothing for the MXU here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.utils import sample_batch as sb
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+
+def compute_gae(batch: SampleBatch, gamma: float, lambda_: float,
+                bootstrap_value: float = 0.0) -> SampleBatch:
+    """Adds ADVANTAGES and VALUE_TARGETS columns.
+
+    Episode boundaries come from EPS_ID + TERMINATEDS/TRUNCATEDS; a rollout
+    cut mid-episode bootstraps from `bootstrap_value` (the runner's value
+    estimate of its current obs). Truncated (but not terminated) episodes
+    bootstrap from the value prediction of their final next_obs — absent
+    per-step next-values, we approximate with the last vf_pred, which is
+    the standard one-step-stale bootstrap.
+    """
+    rewards = np.asarray(batch[sb.REWARDS], np.float32)
+    values = np.asarray(batch[sb.VF_PREDS], np.float32)
+    terminateds = np.asarray(batch[sb.TERMINATEDS], bool)
+    truncateds = np.asarray(batch[sb.TRUNCATEDS], bool)
+    eps_ids = np.asarray(batch[sb.EPS_ID])
+    n = len(rewards)
+    advantages = np.zeros(n, np.float32)
+    last_gae = 0.0
+    next_value = bootstrap_value
+    for t in range(n - 1, -1, -1):
+        boundary = (t == n - 1) or (eps_ids[t + 1] != eps_ids[t])
+        if boundary:
+            last_gae = 0.0
+            if terminateds[t]:
+                next_value = 0.0
+            elif t == n - 1:
+                # Chronologically-last step: caller's bootstrap is exact.
+                next_value = bootstrap_value
+            else:
+                # Episode truncated or cut mid-batch: one-step-stale
+                # bootstrap from its own last value estimate.
+                next_value = values[t]
+        delta = rewards[t] + gamma * next_value - values[t]
+        last_gae = delta + gamma * lambda_ * last_gae
+        advantages[t] = last_gae
+        next_value = values[t]
+    out = SampleBatch(batch)
+    out[sb.ADVANTAGES] = advantages
+    out[sb.VALUE_TARGETS] = advantages + values
+    return out
+
+
+def standardize(x: np.ndarray) -> np.ndarray:
+    return (x - x.mean()) / max(1e-6, x.std())

@@ -1,0 +1,115 @@
+"""State API — programmatic queries over live cluster state.
+
+Reference: python/ray/util/state/api.py (list_actors/list_nodes/
+list_tasks/list_objects/list_placement_groups + summaries) backed by the
+GCS actor/node/task tables; here each call is one GCS RPC through the
+connected worker.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def _gcs(method: str, data: Optional[dict] = None):
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().gcs_call(method, data or {})
+
+
+def _filter(rows: List[dict], filters) -> List[dict]:
+    """filters: list of (key, predicate-str, value) like the reference's
+    state API ('=' and '!=' supported)."""
+    if not filters:
+        return rows
+    out = []
+    for row in rows:
+        keep = True
+        for key, op, value in filters:
+            have = row.get(key)
+            if op == "=":
+                keep = keep and (str(have) == str(value))
+            elif op == "!=":
+                keep = keep and (str(have) != str(value))
+            else:
+                raise ValueError(f"unsupported filter op {op!r}")
+        if keep:
+            out.append(row)
+    return out
+
+
+def list_actors(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = _gcs("list_actors")
+    for r in rows:
+        if isinstance(r.get("actor_id"), bytes):
+            r["actor_id"] = r["actor_id"].hex()
+    return _filter(rows, filters)[:limit]
+
+
+def list_nodes(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = _gcs("get_nodes")
+    for r in rows:
+        if isinstance(r.get("node_id"), bytes):
+            r["node_id"] = r["node_id"].hex()
+    return _filter(rows, filters)[:limit]
+
+
+def list_tasks(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    """Task state transitions recorded by workers' task event buffers
+    (reference: GcsTaskManager-backed `ray list tasks`). Collapses events
+    to one row per task with its latest state."""
+    events = _gcs("list_task_events", {"limit": 100_000})
+    by_task: Dict[str, Dict[str, Any]] = {}
+    for ev in events:
+        tid = ev.get("task_id")
+        tid = tid.hex() if isinstance(tid, bytes) else str(tid)
+        row = by_task.setdefault(tid, {"task_id": tid})
+        row.update({
+            k: (v.hex() if isinstance(v, bytes) else v)
+            for k, v in ev.items() if k != "task_id"})
+    rows = list(by_task.values())
+    return _filter(rows, filters)[:limit]
+
+
+def list_objects(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    """Objects with known locations in the GCS object directory."""
+    rows = _gcs("list_object_locations", {})
+    return _filter(rows, filters)[:limit]
+
+
+def list_placement_groups(filters=None,
+                          limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = _gcs("list_placement_groups", {})
+    for r in rows:
+        if isinstance(r.get("pg_id"), bytes):
+            r["pg_id"] = r["pg_id"].hex()
+        if isinstance(r.get("bundle_locations"), dict):
+            r["bundle_locations"] = {
+                k: (v.hex() if isinstance(v, bytes) else v)
+                for k, v in r["bundle_locations"].items()}
+    return _filter(rows, filters)[:limit]
+
+
+def list_jobs(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = _gcs("list_jobs", {})
+    return _filter(rows, filters)[:limit]
+
+
+def cluster_resources() -> Dict[str, Dict[str, float]]:
+    return _gcs("cluster_resources")
+
+
+def summarize_tasks() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for row in list_tasks(limit=100_000):
+        state = row.get("state", "UNKNOWN")
+        counts[state] = counts.get(state, 0) + 1
+    return counts
+
+
+def summarize_actors() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for row in list_actors(limit=100_000):
+        state = row.get("state", "UNKNOWN")
+        counts[state] = counts.get(state, 0) + 1
+    return counts

@@ -1,0 +1,218 @@
+"""RLlib library tests.
+
+Reference test model: rllib CI runs tiny-config PPO/DQN on CartPole and
+asserts learning progress; unit tests cover GAE, replay buffers, and the
+fault-tolerant actor manager (rllib/utils/actor_manager.py tests).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.utils import sample_batch as sb
+from ray_tpu.rllib.utils.postprocessing import compute_gae
+from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
+                                                ReplayBuffer)
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+
+def test_gae_single_terminated_episode():
+    batch = SampleBatch({
+        sb.REWARDS: np.array([1.0, 1.0, 1.0], np.float32),
+        sb.VF_PREDS: np.array([0.5, 0.5, 0.5], np.float32),
+        sb.TERMINATEDS: np.array([False, False, True]),
+        sb.TRUNCATEDS: np.array([False, False, False]),
+        sb.EPS_ID: np.array([7, 7, 7]),
+    })
+    out = compute_gae(batch, gamma=1.0, lambda_=1.0)
+    # Terminal step: delta = 1 - 0.5 = 0.5; t=1: r + V(t+1) - V = 1.0 +
+    # 0.5*... full returns-to-go minus value.
+    np.testing.assert_allclose(out[sb.ADVANTAGES], [2.5, 1.5, 0.5])
+    np.testing.assert_allclose(out[sb.VALUE_TARGETS], [3.0, 2.0, 1.0])
+
+
+def test_gae_respects_episode_boundaries():
+    batch = SampleBatch({
+        sb.REWARDS: np.array([1.0, 1.0, 1.0, 1.0], np.float32),
+        sb.VF_PREDS: np.zeros(4, np.float32),
+        sb.TERMINATEDS: np.array([False, True, False, True]),
+        sb.TRUNCATEDS: np.zeros(4, bool),
+        sb.EPS_ID: np.array([1, 1, 2, 2]),
+    })
+    out = compute_gae(batch, gamma=1.0, lambda_=1.0)
+    np.testing.assert_allclose(out[sb.ADVANTAGES], [2.0, 1.0, 2.0, 1.0])
+
+
+def test_replay_buffer_ring():
+    buf = ReplayBuffer(capacity=10)
+    buf.add(SampleBatch({"x": np.arange(8)}))
+    assert len(buf) == 8
+    buf.add(SampleBatch({"x": np.arange(8, 16)}))
+    assert len(buf) == 10
+    s = buf.sample(32)
+    assert len(s) == 32
+    assert s["x"].min() >= 6  # 0..5 were overwritten
+
+
+def test_prioritized_replay_weights():
+    buf = PrioritizedReplayBuffer(capacity=100, seed=1)
+    buf.add(SampleBatch({"x": np.arange(50, dtype=np.float32)}))
+    buf.update_priorities(np.array([0, 1]), np.array([100.0, 100.0]))
+    s = buf.sample(64)
+    assert "weights" in s and "batch_indexes" in s
+    # High-priority indices should be heavily oversampled.
+    hits = np.isin(s["batch_indexes"], [0, 1]).mean()
+    assert hits > 0.3
+
+
+def test_tiny_envs_api():
+    from ray_tpu.rllib.env.tiny_envs import CartPole, GridWorld
+
+    for env in (CartPole(), GridWorld({"size": 3})):
+        obs, info = env.reset(seed=0)
+        assert obs.shape == env.observation_space.shape
+        obs2, r, term, trunc, _ = env.step(1)
+        assert obs2.shape == obs.shape
+        assert isinstance(r, float)
+
+
+def test_ppo_learns_cartpole_local():
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0)
+              .training(train_batch_size=512, minibatch_size=128,
+                        num_epochs=6, lr=3e-4)
+              .debugging(seed=3))
+    algo = config.build_algo()
+    first_return = None
+    best = -np.inf
+    for i in range(12):
+        result = algo.step()
+        ret = result.get("episode_return_mean", float("nan"))
+        if first_return is None and np.isfinite(ret):
+            first_return = ret
+        if np.isfinite(ret):
+            best = max(best, ret)
+    assert first_return is not None
+    # Learning signal: mean return should improve markedly over ~6k steps.
+    assert best > first_return + 20, (first_return, best)
+    algo.cleanup()
+
+
+def test_ppo_remote_env_runners(ray_start_regular):
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2)
+              .training(train_batch_size=256, minibatch_size=64,
+                        num_epochs=2)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    result = algo.step()
+    assert result["num_env_steps"] >= 256
+    assert result["num_healthy_env_runners"] == 2
+    algo.cleanup()
+
+
+def test_ppo_multi_learner_ddp(ray_start_regular):
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0)
+              .training(train_batch_size=128, minibatch_size=64,
+                        num_epochs=1)
+              .learners(num_learners=2)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    r1 = algo.step()
+    assert "total_loss" in r1
+    # DDP invariant: both learners hold identical weights after updates.
+    w = [ray_tpu.get(a.get_weights.remote())
+         for a in algo.learner_group._actors]
+    a0 = w[0]["torso"][0]["w"]
+    a1 = w[1]["torso"][0]["w"]
+    np.testing.assert_allclose(a0, a1, rtol=1e-5)
+    algo.cleanup()
+
+
+def test_dqn_learns_gridworld():
+    from ray_tpu.rllib.algorithms.dqn import DQNConfig
+
+    config = (DQNConfig()
+              .environment("GridWorld-v0", env_config={"size": 3})
+              .training(train_batch_size=64, lr=5e-4, gamma=0.95,
+                        num_steps_sampled_before_learning_starts=200,
+                        target_network_update_freq=100,
+                        epsilon_decay_steps=1500,
+                        rollout_fragment_length=100)
+              .debugging(seed=1))
+    algo = config.build_algo()
+    for _ in range(40):
+        result = algo.step()
+    ret = result.get("episode_return_mean", float("nan"))
+    # The rolling window still contains early exploratory episodes; the
+    # greedy policy is the real learning check: optimal return for a 3x3
+    # grid is 1 - 0.01*3 ≈ 0.97.
+    assert np.isfinite(ret) and ret > 0.3, result
+    eval_result = algo.evaluate(num_episodes=3)
+    assert eval_result["evaluation"]["episode_return_mean"] > 0.9
+    algo.cleanup()
+
+
+def test_algorithm_checkpoint_roundtrip(tmp_path):
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .training(train_batch_size=128, minibatch_size=64,
+                        num_epochs=1))
+    algo = config.build_algo()
+    algo.step()
+    algo.save_checkpoint(str(tmp_path))
+    w_before = algo.learner_group.get_weights()
+
+    algo2 = config.build_algo()
+    algo2.load_checkpoint(str(tmp_path))
+    w_after = algo2.learner_group.get_weights()
+    np.testing.assert_allclose(
+        w_before["torso"][0]["w"], w_after["torso"][0]["w"])
+    algo.cleanup()
+    algo2.cleanup()
+
+
+def test_fault_tolerant_actor_manager(ray_start_regular):
+    from ray_tpu.rllib.utils.actor_manager import FaultTolerantActorManager
+
+    @ray_tpu.remote
+    class Worker:
+        def __init__(self, i):
+            self.i = i
+
+        def work(self):
+            return self.i
+
+        def ping(self):
+            return True
+
+    def factory(i):
+        return Worker.remote(i)
+
+    actors = [factory(i) for i in range(3)]
+    mgr = FaultTolerantActorManager(actors, factory)
+    res = mgr.foreach(lambda a: a.work.remote())
+    assert sorted(res.values()) == [0, 1, 2]
+
+    ray_tpu.kill(mgr.actor(1))
+    import time
+
+    time.sleep(0.2)
+    res = mgr.foreach(lambda a: a.work.remote(), timeout_s=5.0)
+    assert mgr.num_healthy_actors() == 2
+    restored = mgr.probe_unhealthy()
+    assert restored == [1]
+    res = mgr.foreach(lambda a: a.work.remote())
+    assert sorted(res.values()) == [0, 1, 2]
