@@ -19,32 +19,40 @@ from ray_tpu._private.node import Node
 
 
 class Cluster:
-    def __init__(self, config: Optional[Config] = None):
+    def __init__(self, config: Optional[Config] = None,
+                 _existing_address: Optional[str] = None):
+        """_existing_address: join an already-running GCS instead of
+        starting a head on the first add_node (autoscaler providers add
+        nodes to a live cluster)."""
         self.config = config or Config.from_env()
         self.head: Optional[Node] = None
         self.nodes: list[Node] = []
+        self._existing_address = _existing_address
         self.session_dir = os.path.join(
             self.config.temp_dir,
             f"cluster_{int(time.time() * 1000)}_{os.getpid()}")
 
     @property
     def address(self) -> str:
-        return self.head.gcs_address
+        return self._existing_address or self.head.gcs_address
 
     def add_node(self, resources: Optional[Dict[str, float]] = None,
                  slice_id: str = "",
                  labels: Optional[Dict[str, str]] = None) -> Node:
-        """Add a raylet process (the first call also starts the GCS)."""
+        """Add a raylet process (the first call also starts the GCS,
+        unless the cluster joins an existing address)."""
+        gcs_address = self._existing_address or (
+            self.head.gcs_address if self.head else None)
         node = Node(
             self.config,
             resources=resources or {"CPU": 2.0},
-            gcs_address=self.head.gcs_address if self.head else None,
+            gcs_address=gcs_address,
             session_dir=self.session_dir,
             labels=labels,
             slice_id=slice_id,
         )
         node.start()
-        if self.head is None:
+        if self.head is None and self._existing_address is None:
             self.head = node
         self.nodes.append(node)
         return node

@@ -139,6 +139,7 @@ class CoreWorker:
             max_workers=1, thread_name_prefix="task_exec")
         self._pending_tasks: Dict[TaskID, TaskSpec] = {}
         self._task_events: List[dict] = []
+        self._task_events_last_flush: float = 0.0
         self._borrowed_notified: set = set()
         self._should_exit = asyncio.Event()
 
@@ -171,8 +172,30 @@ class CoreWorker:
                 self.node_id = NodeID(r["node_id"])
         if self.store_path:
             self.plasma = ShmClient(self.store_path)
+        if self.config.task_events_enabled:
+            self._task_event_flusher = asyncio.get_running_loop(
+            ).create_task(self._task_event_flush_loop())
+
+    async def _task_event_flush_loop(self) -> None:
+        """Periodic flush so trailing events (sub-batch-size bursts after
+        the last task) still reach the GCS (reference: TaskEventBuffer's
+        timer-driven flush)."""
+        while not self._should_exit.is_set():
+            await asyncio.sleep(1.0)
+            if self._task_events:
+                self._flush_task_events()
 
     async def disconnect(self) -> None:
+        flusher = getattr(self, "_task_event_flusher", None)
+        if flusher is not None:
+            flusher.cancel()
+        if self._task_events and self.gcs and not self.gcs.closed:
+            events, self._task_events = self._task_events, []
+            try:
+                await self.gcs.call("report_task_events",
+                                    {"events": events})
+            except Exception:
+                pass
         self._executor.shutdown(wait=False, cancel_futures=True)
         for conn in list(self._peer_conns.values()):
             await conn.close()
@@ -1059,10 +1082,14 @@ class CoreWorker:
             "worker_id": self.worker_id.binary(),
             "actor_id": spec.actor_id.binary() if spec.actor_id else None,
         })
-        if len(self._task_events) >= 100:
+        # Flush on batch size or a 1s cadence (reference: TaskEventBuffer
+        # periodic flush, task_event_buffer.h:206).
+        if len(self._task_events) >= 100 or \
+                time.time() - self._task_events_last_flush > 1.0:
             self._flush_task_events()
 
     def _flush_task_events(self) -> None:
+        self._task_events_last_flush = time.time()
         events, self._task_events = self._task_events, []
         if self.gcs and not self.gcs.closed:
             asyncio.run_coroutine_threadsafe(

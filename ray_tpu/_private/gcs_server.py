@@ -46,6 +46,8 @@ class NodeInfo:
         self.state = ALIVE
         self.last_heartbeat = time.monotonic()
         self.conn: Optional[rpc.Connection] = None
+        # Queued lease demands from the latest heartbeat (autoscaler input).
+        self.pending_demands: List[Dict[str, float]] = []
 
     def view(self) -> dict:
         return {
@@ -228,6 +230,7 @@ class GcsServer:
         info.last_heartbeat = time.monotonic()
         info.resources_available = data.get(
             "resources_available", info.resources_available)
+        info.pending_demands = data.get("pending_demands", [])
         return {"ok": True}
 
     async def handle_get_nodes(self, data, conn) -> list:
@@ -702,6 +705,31 @@ class GcsServer:
     async def handle_list_task_events(self, data, conn) -> list:
         limit = data.get("limit", 1000)
         return self.task_events[-limit:]
+
+    # ------------------------------------------------------------- autoscaler
+    async def handle_autoscaler_state(self, data, conn) -> dict:
+        """Aggregate load for the autoscaler (reference:
+        GcsAutoscalerStateManager / autoscaler.proto)."""
+        demands: List[Dict[str, float]] = []
+        nodes = []
+        for n in self.nodes.values():
+            if n.state != ALIVE:
+                continue
+            demands.extend(n.pending_demands)
+            nodes.append({
+                "node_id": n.node_id.binary().hex(),
+                "resources_total": n.resources_total,
+                "resources_available": n.resources_available,
+                "slice_id": n.slice_id,
+                "idle": all(
+                    n.resources_available.get(k, 0) >= v
+                    for k, v in n.resources_total.items()),
+            })
+        # Infeasible PG bundles also create demand.
+        for pg in self.placement_groups.values():
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                demands.extend(pg.bundles)
+        return {"pending_demands": demands, "nodes": nodes}
 
     # ------------------------------------------------------------- state API
     async def handle_list_object_locations(self, data, conn) -> list:

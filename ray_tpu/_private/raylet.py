@@ -164,6 +164,11 @@ class Raylet:
                 r = await self.gcs.call("heartbeat", {
                     "node_id": self.node_id.binary(),
                     "resources_available": self.available,
+                    # Queued lease demands feed the autoscaler (reference:
+                    # resource-load piggybacked on raylet heartbeats and
+                    # aggregated by GcsAutoscalerStateManager).
+                    "pending_demands": [
+                        req.resources for req in self.lease_queue[:100]],
                 }, timeout=5.0)
                 if not r.get("ok"):
                     logger.error("GCS declared this node dead; exiting")

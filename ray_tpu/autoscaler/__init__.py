@@ -1,0 +1,19 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Parity target: python/ray/autoscaler/ (Monitor, StandardAutoscaler,
+ResourceDemandScheduler, NodeProvider plugins incl. FakeMultiNodeProvider
+for cloudless tests). TPU-first: slice-typed node groups scale atomically.
+"""
+
+from ray_tpu.autoscaler.autoscaler import (Monitor, ResourceDemandScheduler,
+                                           StandardAutoscaler)
+from ray_tpu.autoscaler.node_provider import (FakeMultiNodeProvider,
+                                              NodeProvider)
+
+__all__ = [
+    "Monitor",
+    "StandardAutoscaler",
+    "ResourceDemandScheduler",
+    "NodeProvider",
+    "FakeMultiNodeProvider",
+]

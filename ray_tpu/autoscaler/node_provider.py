@@ -1,0 +1,90 @@
+"""NodeProvider plugin interface + built-in providers.
+
+Reference: python/ray/autoscaler/node_provider.py (NodeProvider ABC) and
+_private/fake_multi_node/node_provider.py:237 (FakeMultiNodeProvider —
+"launches" nodes as local processes, the workhorse for autoscaler tests
+without a cloud). Cloud providers (GCE TPU pods) implement the same
+interface.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal provider contract: create/terminate/list typed nodes."""
+
+    def __init__(self, provider_config: Dict[str, Any]):
+        self.provider_config = provider_config
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def node_tags(self, provider_node_id: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Launches raylet processes on this machine with the resource shape
+    declared per node type — real control plane, simulated hardware.
+
+    provider_config: {"gcs_address": ..., "node_types": {name:
+    {"resources": {...}, "max_workers": N}}}.
+    """
+
+    def __init__(self, provider_config: Dict[str, Any]):
+        super().__init__(provider_config)
+        from ray_tpu._private.cluster_utils import Cluster
+
+        self._gcs_address = provider_config["gcs_address"]
+        self._cluster = Cluster(_existing_address=self._gcs_address)
+        self._nodes: Dict[str, Any] = {}
+        self._tags: Dict[str, Dict[str, str]] = {}
+        self._lock = threading.Lock()
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        cfg = self.provider_config["node_types"][node_type]
+        created = []
+        for _ in range(count):
+            pid = f"fake-{node_type}-{uuid.uuid4().hex[:8]}"
+            node = self._cluster.add_node(
+                resources=dict(cfg.get("resources", {})),
+                slice_id=cfg.get("slice_id", ""))
+            with self._lock:
+                self._nodes[pid] = node
+                self._tags[pid] = {"node_type": node_type,
+                                   "launch_time": str(time.time())}
+            created.append(pid)
+        return created
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        with self._lock:
+            node = self._nodes.pop(provider_node_id, None)
+            self._tags.pop(provider_node_id, None)
+        if node is not None:
+            self._cluster.remove_node(node)
+
+    def node_tags(self, provider_node_id: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._tags.get(provider_node_id, {}))
+
+    def shutdown(self) -> None:
+        for pid in self.non_terminated_nodes():
+            self.terminate_node(pid)

@@ -1,0 +1,13 @@
+"""Job submission: run shell entrypoints as supervised cluster jobs.
+
+Reference: python/ray/job_submission/ + dashboard/modules/job/
+(JobSubmissionClient.submit_job sdk.py:35 → REST → JobManager spawns a
+supervisor actor running the entrypoint command, job_manager.py). Here the
+client talks straight to the cluster (no REST hop): job metadata lives in
+the GCS KV, and each job runs under a detached JobSupervisor actor.
+"""
+
+from ray_tpu.job_submission.sdk import (JobStatus, JobSubmissionClient,
+                                        JobInfo)
+
+__all__ = ["JobSubmissionClient", "JobStatus", "JobInfo"]

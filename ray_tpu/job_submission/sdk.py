@@ -1,0 +1,281 @@
+"""JobSubmissionClient + the JobSupervisor actor.
+
+Reference: python/ray/dashboard/modules/job/sdk.py:35 (submit_job :125),
+job_manager.py (JobManager + JobSupervisor actor running the entrypoint
+shell command). Metadata is stored in the GCS KV under the "job" namespace
+so any client connected to the cluster can list/poll jobs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+_KV_NS = b"job_submission"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+@dataclass
+class JobInfo:
+    submission_id: str
+    entrypoint: str
+    status: str = JobStatus.PENDING
+    message: str = ""
+    start_time: float = 0.0
+    end_time: float = 0.0
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @staticmethod
+    def from_json(raw: bytes) -> "JobInfo":
+        return JobInfo(**json.loads(raw))
+
+
+class JobSupervisor:
+    """Detached actor that runs one job's entrypoint as a subprocess.
+
+    Reference: dashboard/modules/job/job_supervisor.py — owns the child
+    process, streams logs to a file, records the terminal status in KV.
+    """
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 env: Optional[Dict[str, str]] = None,
+                 log_dir: Optional[str] = None,
+                 metadata: Optional[Dict[str, str]] = None):
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.log_dir = log_dir or "/tmp/ray_tpu/job_logs"
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.log_path = os.path.join(self.log_dir,
+                                     f"{submission_id}.log")
+        self.proc: Optional[subprocess.Popen] = None
+        self._env = dict(env or {})
+        self._metadata = dict(metadata or {})
+        self._lock = threading.Lock()
+        self._stop_requested = False
+        self._status = JobStatus.PENDING
+        self._message = ""
+        self._start_time = time.time()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _put_info(self) -> None:
+        info = JobInfo(
+            submission_id=self.submission_id,
+            entrypoint=self.entrypoint,
+            status=self._status,
+            message=self._message,
+            start_time=self._start_time,
+            end_time=time.time() if self._status in JobStatus.TERMINAL
+            else 0.0,
+            metadata=self._metadata)
+        from ray_tpu._private.worker import global_worker
+
+        global_worker().gcs_call("kv_put", {
+            "ns": _KV_NS, "key": self.submission_id.encode(),
+            "value": info.to_json()})
+
+    def _run(self) -> None:
+        env = dict(os.environ)
+        env.update(self._env)
+        env["RAY_TPU_JOB_SUBMISSION_ID"] = self.submission_id
+        # Let the entrypoint connect to this cluster with
+        # ray_tpu.init(address=os.environ["RAY_TPU_ADDRESS"]).
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            env["RAY_TPU_ADDRESS"] = global_worker().core.gcs_address
+        except Exception:
+            pass
+        try:
+            with self._lock:
+                if self._stop_requested:
+                    self._status = JobStatus.STOPPED
+                    self._put_info()
+                    return
+                self._status = JobStatus.RUNNING
+                log = open(self.log_path, "wb")
+                self.proc = subprocess.Popen(
+                    self.entrypoint, shell=True, stdout=log,
+                    stderr=subprocess.STDOUT, env=env,
+                    start_new_session=True)
+            self._put_info()
+            with log:
+                code = self.proc.wait()
+            with self._lock:
+                if self._stop_requested:
+                    self._status = JobStatus.STOPPED
+                elif code == 0:
+                    self._status = JobStatus.SUCCEEDED
+                else:
+                    self._status = JobStatus.FAILED
+                    self._message = f"entrypoint exited with code {code}"
+        except Exception as e:
+            self._status = JobStatus.FAILED
+            self._message = f"{type(e).__name__}: {e}"
+        self._put_info()
+
+    def status(self) -> str:
+        return self._status
+
+    def logs(self, offset: int = 0) -> str:
+        """Log contents from byte offset (incremental tailing stays O(n))."""
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(offset)
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+    def log_size(self) -> int:
+        try:
+            return os.path.getsize(self.log_path)
+        except OSError:
+            return 0
+
+    def stop(self) -> bool:
+        with self._lock:
+            if self._status in JobStatus.TERMINAL:
+                return False
+            self._stop_requested = True
+            proc = self.proc
+        if proc and proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), 15)
+            except Exception:
+                proc.terminate()
+            return True
+        # Not launched yet: _run will observe the flag and mark STOPPED.
+        return True
+
+    def ping(self) -> bool:
+        return True
+
+
+class JobSubmissionClient:
+    """Reference: python/ray/job_submission/JobSubmissionClient — same
+    method surface (submit_job/get_job_status/get_job_logs/stop_job/
+    list_jobs/delete_job), minus the HTTP hop."""
+
+    def __init__(self, address: Optional[str] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+
+    def _gcs(self, method: str, data: dict):
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker().gcs_call(method, data)
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[Dict[str, str]] = None,
+                   entrypoint_num_cpus: float = 1.0) -> str:
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        env = {}
+        if runtime_env and runtime_env.get("env_vars"):
+            env.update(runtime_env["env_vars"])
+        info = JobInfo(submission_id=submission_id, entrypoint=entrypoint,
+                       metadata=dict(metadata or {}),
+                       start_time=time.time())
+        self._gcs("kv_put", {"ns": _KV_NS,
+                             "key": submission_id.encode(),
+                             "value": info.to_json()})
+        supervisor_cls = ray_tpu.remote(JobSupervisor)
+        supervisor_cls.options(
+            name=f"_job_supervisor:{submission_id}",
+            namespace="ray_tpu.jobs",
+            lifetime="detached",
+            num_cpus=entrypoint_num_cpus,
+        ).remote(submission_id, entrypoint, env,
+                 metadata=dict(metadata or {}))
+        return submission_id
+
+    def _supervisor(self, submission_id: str):
+        from ray_tpu.core.actor import get_actor
+
+        return get_actor(f"_job_supervisor:{submission_id}",
+                         namespace="ray_tpu.jobs")
+
+    def get_job_info(self, submission_id: str) -> JobInfo:
+        raw = self._gcs("kv_get", {"ns": _KV_NS,
+                                   "key": submission_id.encode()})
+        if raw is None:
+            raise ValueError(f"no job {submission_id!r}")
+        return JobInfo.from_json(raw)
+
+    def get_job_status(self, submission_id: str) -> str:
+        # Prefer the live supervisor; fall back to the KV record (e.g.
+        # after the supervisor exited or its node died).
+        try:
+            sup = self._supervisor(submission_id)
+            return ray_tpu.get(sup.status.remote(), timeout=10.0)
+        except Exception:
+            return self.get_job_info(submission_id).status
+
+    def get_job_logs(self, submission_id: str) -> str:
+        sup = self._supervisor(submission_id)
+        return ray_tpu.get(sup.logs.remote(), timeout=10.0)
+
+    def stop_job(self, submission_id: str) -> bool:
+        try:
+            sup = self._supervisor(submission_id)
+            return ray_tpu.get(sup.stop.remote(), timeout=10.0)
+        except ValueError:
+            return False
+
+    def delete_job(self, submission_id: str) -> bool:
+        try:
+            sup = self._supervisor(submission_id)
+            ray_tpu.kill(sup)
+        except Exception:
+            pass
+        return bool(self._gcs("kv_del", {"ns": _KV_NS,
+                                         "key": submission_id.encode()}))
+
+    def list_jobs(self) -> List[JobInfo]:
+        keys = self._gcs("kv_keys", {"ns": _KV_NS}) or []
+        out = []
+        for key in keys:
+            raw = self._gcs("kv_get", {"ns": _KV_NS, "key": key})
+            if raw:
+                out.append(JobInfo.from_json(raw))
+        return out
+
+    def tail_job_logs(self, submission_id: str,
+                      poll_interval_s: float = 0.5):
+        """Generator yielding log increments until the job terminates.
+        Polls with a byte offset so each RPC ships only new output."""
+        sup = self._supervisor(submission_id)
+        offset = 0
+        while True:
+            chunk = ray_tpu.get(sup.logs.remote(offset), timeout=10.0)
+            if chunk:
+                yield chunk
+                offset += len(chunk.encode())
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                chunk = ray_tpu.get(sup.logs.remote(offset), timeout=10.0)
+                if chunk:
+                    yield chunk
+                return
+            time.sleep(poll_interval_s)

@@ -46,7 +46,6 @@ class Algorithm(Trainable):
             self.learner_class, self.module_spec, cfg)
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
         self._iteration = 0
-        self._env_steps_total = 0
 
     def _make_module_spec(self, obs_dim: int, num_actions: int):
         from ray_tpu.rllib.core.rl_module import RLModuleSpec
@@ -65,8 +64,6 @@ class Algorithm(Trainable):
                 results["num_env_runners_restored"] = restored
         metrics = self.env_runner_group.aggregate_metrics()
         results.update(metrics)
-        self._env_steps_total = metrics.get("num_env_steps",
-                                            self._env_steps_total)
         results["training_iteration"] = self._iteration
         results["time_this_iter_s"] = time.perf_counter() - t0
         return results

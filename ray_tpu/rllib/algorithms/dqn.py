@@ -164,6 +164,33 @@ class DQN(Algorithm):
         return cfg.epsilon_initial + frac * (cfg.epsilon_final -
                                              cfg.epsilon_initial)
 
+    def get_extra_state(self) -> Dict[str, Any]:
+        state = {
+            "env_steps": self._env_steps,
+            "last_target_sync": self._last_target_sync,
+            "replay_cols": dict(self.replay._cols),
+            "replay_size": self.replay._size,
+            "replay_next": self.replay._next,
+        }
+        if isinstance(self.replay, PrioritizedReplayBuffer):
+            state["replay_priorities"] = self.replay._priorities.copy()
+            state["replay_max_priority"] = self.replay._max_priority
+        return state
+
+    def set_extra_state(self, state: Dict[str, Any]) -> None:
+        if not state:
+            return
+        self._env_steps = state["env_steps"]
+        self._last_target_sync = state["last_target_sync"]
+        self.replay._cols = dict(state["replay_cols"])
+        self.replay._size = state["replay_size"]
+        self.replay._next = state["replay_next"]
+        if isinstance(self.replay, PrioritizedReplayBuffer) and \
+                "replay_priorities" in state:
+            self.replay._priorities = np.asarray(
+                state["replay_priorities"])
+            self.replay._max_priority = state["replay_max_priority"]
+
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
         rollout = self.env_runner_group.sample(

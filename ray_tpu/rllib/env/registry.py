@@ -40,7 +40,7 @@ def make_env(env: object, env_config: Optional[dict] = None):
         try:
             import gymnasium
 
-            return gymnasium.make(env)
+            return gymnasium.make(env, **env_config)
         except Exception:
             raise ValueError(
                 f"unknown env id {env!r}: not registered, not a built-in, "

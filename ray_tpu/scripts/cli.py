@@ -1,0 +1,255 @@
+"""ray-tpu CLI — cluster lifecycle + introspection.
+
+Reference: python/ray/scripts/scripts.py (`ray start` :571, stop, status,
+list, timeline, memory, job submit). Invoke as `python -m ray_tpu.scripts
+<command>`. `start --head` runs the head node processes and writes the
+cluster address to /tmp/ray_tpu/cluster_address so later commands (and
+`start` on worker machines) can find it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+ADDRESS_FILE = "/tmp/ray_tpu/cluster_address"
+
+
+def _write_address(address: str, pid: int) -> None:
+    os.makedirs(os.path.dirname(ADDRESS_FILE), exist_ok=True)
+    with open(ADDRESS_FILE, "w") as f:
+        json.dump({"address": address, "pid": pid}, f)
+
+
+def _read_address() -> dict:
+    try:
+        with open(ADDRESS_FILE) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        raise SystemExit(
+            "no running cluster found (missing "
+            f"{ADDRESS_FILE}); start one with: "
+            "python -m ray_tpu.scripts start --head")
+
+
+def _connect(address: str = None):
+    import ray_tpu
+
+    addr = address or _read_address()["address"]
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(address=addr)
+
+
+def cmd_start(args) -> None:
+    from ray_tpu._private.node import Node
+    from ray_tpu.core.config import Config
+
+    resources = {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    if args.num_tpus is not None:
+        resources["TPU"] = float(args.num_tpus)
+    for spec in args.resources or []:
+        name, val = spec.split("=", 1)
+        resources[name] = float(val)
+
+    config = Config.from_env(None)
+    if args.head:
+        node = Node(config, resources=resources or None)
+        node.start()
+        _write_address(node.gcs_address, os.getpid())
+        print(f"ray_tpu head started; address={node.gcs_address}")
+    else:
+        address = args.address or _read_address()["address"]
+        node = Node(config, resources=resources or None,
+                    gcs_address=address)
+        node.start()
+        print(f"ray_tpu node started; joined {address}")
+
+    if args.block:
+        stop = []
+        signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+        signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+        try:
+            while not stop:
+                time.sleep(0.5)
+        finally:
+            node.shutdown()
+    else:
+        print("(processes continue in background; this process must stay "
+              "alive — use --block in scripts, or `stop` to tear down)")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            node.shutdown()
+
+
+def cmd_stop(args) -> None:
+    info = _read_address()
+    pid = info.get("pid")
+    if pid:
+        try:
+            os.kill(pid, signal.SIGTERM)
+            print(f"sent SIGTERM to head process {pid}")
+        except ProcessLookupError:
+            print("head process already gone")
+    try:
+        os.remove(ADDRESS_FILE)
+    except FileNotFoundError:
+        pass
+
+
+def cmd_status(args) -> None:
+    _connect(args.address)
+    from ray_tpu.util import state
+
+    res = state.cluster_resources()
+    nodes = state.list_nodes()
+    alive = [n for n in nodes if n.get("state") == "ALIVE"]
+    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
+    print("resources (available / total):")
+    for key in sorted(res["total"]):
+        print(f"  {key}: {res['available'].get(key, 0):g} / "
+              f"{res['total'][key]:g}")
+
+
+def cmd_list(args) -> None:
+    _connect(args.address)
+    from ray_tpu.util import state
+
+    fn = {
+        "tasks": state.list_tasks,
+        "actors": state.list_actors,
+        "nodes": state.list_nodes,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+        "jobs": state.list_jobs,
+    }[args.what]
+    rows = fn(limit=args.limit)
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_summary(args) -> None:
+    _connect(args.address)
+    from ray_tpu.util import state
+
+    fn = {"tasks": state.summarize_tasks,
+          "actors": state.summarize_actors}[args.what]
+    print(json.dumps(fn(), indent=2))
+
+
+def cmd_timeline(args) -> None:
+    _connect(args.address)
+    from ray_tpu.util.timeline import timeline
+
+    events = timeline(args.output)
+    print(f"wrote {len(events)} events to {args.output}")
+
+
+def cmd_memory(args) -> None:
+    _connect(args.address)
+    from ray_tpu.util import state
+
+    rows = state.list_objects(limit=args.limit)
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_job(args) -> None:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    address = args.address or _read_address()["address"]
+    client = JobSubmissionClient(address)
+    if args.job_cmd == "submit":
+        sid = client.submit_job(entrypoint=" ".join(args.entrypoint))
+        print(f"submitted job {sid}")
+        if args.wait:
+            for chunk in client.tail_job_logs(sid):
+                sys.stdout.write(chunk)
+            print(f"status: {client.get_job_status(sid)}")
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.id))
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.id))
+    elif args.job_cmd == "list":
+        for info in client.list_jobs():
+            print(f"{info.submission_id}  {info.status:10s}  "
+                  f"{info.entrypoint}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ray_tpu",
+                                description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("start", help="start head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="existing cluster address to join")
+    sp.add_argument("--num-cpus", type=float)
+    sp.add_argument("--num-tpus", type=float)
+    sp.add_argument("--resources", nargs="*",
+                    help="extra resources, e.g. TPU-v5e-8-head=1")
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the local cluster")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster resource summary")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("what", choices=["tasks", "actors", "nodes", "objects",
+                                     "placement-groups", "jobs"])
+    sp.add_argument("--limit", type=int, default=100)
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="state summaries")
+    sp.add_argument("what", choices=["tasks", "actors"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline", help="dump chrome trace of tasks")
+    sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("memory", help="object store contents")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("job", help="job submission")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("--address")
+    j.set_defaults(fn=cmd_job)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("id")
+        j.add_argument("--address")
+        j.set_defaults(fn=cmd_job)
+    j = jsub.add_parser("list")
+    j.add_argument("--address")
+    j.set_defaults(fn=cmd_job)
+
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""ray_tpu.util — state API, timeline, collective re-exports."""

@@ -59,6 +59,9 @@ def list_tasks(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
     (reference: GcsTaskManager-backed `ray list tasks`). Collapses events
     to one row per task with its latest state."""
     events = _gcs("list_task_events", {"limit": 100_000})
+    # Workers flush on independent cadences; GCS arrival order is not
+    # event order. Merge by per-event timestamp.
+    events = sorted(events, key=lambda e: e.get("time", 0.0))
     by_task: Dict[str, Dict[str, Any]] = {}
     for ev in events:
         tid = ev.get("task_id")

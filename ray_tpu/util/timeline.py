@@ -1,0 +1,46 @@
+"""Chrome-trace timeline export from GCS task events.
+
+Reference: python/ray/_private/profiling.py:84 (`ray timeline` dumps a
+chrome://tracing JSON of task state transitions stored in GcsTaskManager).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Returns chrome-trace events; optionally writes them to filename."""
+    from ray_tpu._private.worker import global_worker
+
+    events = global_worker().gcs_call("list_task_events",
+                                      {"limit": 100_000}) or []
+    events = sorted(events, key=lambda e: e.get("time", 0.0))
+    # Pair RUNNING -> FINISHED/FAILED per task into complete ("X") events.
+    running: Dict[str, dict] = {}
+    trace: List[Dict[str, Any]] = []
+    for ev in events:
+        tid = ev["task_id"]
+        tid = tid.hex() if isinstance(tid, bytes) else str(tid)
+        state = ev.get("state")
+        if state == "RUNNING":
+            running[tid] = ev
+        elif state in ("FINISHED", "FAILED") and tid in running:
+            start = running.pop(tid)
+            worker = start.get("worker_id", b"")
+            worker = worker.hex() if isinstance(worker, bytes) else worker
+            trace.append({
+                "name": start.get("name", "task"),
+                "cat": "task",
+                "ph": "X",
+                "ts": start["time"] * 1e6,
+                "dur": (ev["time"] - start["time"]) * 1e6,
+                "pid": worker[:8],
+                "tid": worker[:8],
+                "args": {"task_id": tid, "end_state": state},
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

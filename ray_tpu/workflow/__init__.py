@@ -1,0 +1,26 @@
+"""ray_tpu.workflow — durable workflows on task DAGs.
+
+Parity target: python/ray/workflow/ (step checkpointing via
+WorkflowStorage workflow_storage.py:229, run/resume semantics, events).
+"""
+
+from ray_tpu.workflow.api import (WorkflowStatus, delete, get_output,
+                                  get_status, init, list_all, resume,
+                                  resume_all, run, run_async,
+                                  wait_for_event)
+from ray_tpu.workflow.storage import WorkflowStorage
+
+__all__ = [
+    "WorkflowStatus",
+    "WorkflowStorage",
+    "init",
+    "run",
+    "run_async",
+    "resume",
+    "resume_all",
+    "get_status",
+    "get_output",
+    "list_all",
+    "delete",
+    "wait_for_event",
+]

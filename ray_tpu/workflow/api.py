@@ -1,0 +1,209 @@
+"""Durable workflow execution over task DAGs.
+
+Reference: python/ray/workflow/api.py + task_executor.py — a DAG built
+with .bind() runs step-by-step; every step's output is checkpointed to
+WorkflowStorage before its downstream runs, so a crashed workflow resumes
+from its last completed step instead of rerunning finished work.
+
+Step identity: a deterministic id derived from the DAG structure
+(function name + argument positions), matching the reference's
+name-based step ids. Steps whose id already has a checkpoint are skipped
+on resume. Non-deterministic DAG shapes across resumes are the user's
+responsibility, as in the reference.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.dag.dag_node import DAGNode, FunctionNode, InputNode
+from ray_tpu.workflow.storage import WorkflowStorage
+
+
+class WorkflowStatus:
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    RESUMABLE = "RESUMABLE"
+
+
+_storage: Optional[WorkflowStorage] = None
+
+
+def init(storage_root: Optional[str] = None) -> None:
+    """Configure the storage root (reference: workflow.init(storage=...))."""
+    global _storage
+    _storage = WorkflowStorage(storage_root)
+
+
+def _get_storage() -> WorkflowStorage:
+    global _storage
+    if _storage is None:
+        _storage = WorkflowStorage()
+    return _storage
+
+
+def _step_id(node: DAGNode, path: str) -> str:
+    """Deterministic step id: function name + structural path."""
+    if isinstance(node, FunctionNode):
+        name = getattr(node._remote_fn, "__name__", "fn")
+    elif isinstance(node, InputNode):
+        name = "input"
+    else:
+        name = type(node).__name__
+    digest = hashlib.sha1(path.encode()).hexdigest()[:8]
+    return f"{name}-{digest}"
+
+
+def _execute_node(node: Any, workflow_id: str, input_value: Any,
+                  storage: WorkflowStorage, path: str,
+                  cache: Dict[int, Any]) -> Any:
+    """Bottom-up execution with per-step checkpointing."""
+    if not isinstance(node, DAGNode):
+        if isinstance(node, (list, tuple)):
+            return type(node)(
+                _execute_node(v, workflow_id, input_value, storage,
+                              f"{path}.{i}", cache)
+                for i, v in enumerate(node))
+        return node
+    if id(node) in cache:
+        return cache[id(node)]
+    if isinstance(node, InputNode):
+        cache[id(node)] = input_value
+        return input_value
+
+    step_id = _step_id(node, path)
+    if storage.has_step_output(workflow_id, step_id):
+        value = storage.load_step_output(workflow_id, step_id)
+        cache[id(node)] = value
+        return value
+
+    args = tuple(
+        _execute_node(a, workflow_id, input_value, storage,
+                      f"{path}.a{i}", cache)
+        for i, a in enumerate(node._bound_args))
+    kwargs = {
+        k: _execute_node(v, workflow_id, input_value, storage,
+                         f"{path}.k{k}", cache)
+        for k, v in node._bound_kwargs.items()}
+
+    if isinstance(node, FunctionNode):
+        value = ray_tpu.get(node._remote_fn.remote(*args, **kwargs))
+    else:
+        raise TypeError(
+            f"workflows support function DAGs; got {type(node).__name__} "
+            "(actor nodes are not durable)")
+    storage.save_step_output(workflow_id, step_id, value)
+    cache[id(node)] = value
+    return value
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        input_value: Any = None) -> Any:
+    """Run a DAG durably; returns the root output.
+
+    Reference: workflow.run(dag, workflow_id=...)."""
+    workflow_id = workflow_id or f"workflow-{int(time.time() * 1000):x}"
+    storage = _get_storage()
+    # Preserve the original start_time across failure/resume cycles.
+    prev = storage.load_meta(workflow_id) or {}
+    start_time = prev.get("start_time", time.time())
+    storage.save_meta(workflow_id, {
+        "status": WorkflowStatus.RUNNING, "start_time": start_time})
+    try:
+        storage.save_dag(workflow_id, dag)
+    except Exception:
+        pass  # non-picklable closures: resume() then needs the dag passed
+    try:
+        result = _execute_node(dag, workflow_id, input_value, storage,
+                               "root", {})
+    except Exception as e:
+        storage.save_meta(workflow_id, {
+            "status": WorkflowStatus.RESUMABLE,
+            "error": f"{type(e).__name__}: {e}",
+            "start_time": start_time})
+        raise
+    storage.save_step_output(workflow_id, "__output__", result)
+    storage.save_meta(workflow_id, {
+        "status": WorkflowStatus.SUCCESSFUL, "start_time": start_time,
+        "end_time": time.time()})
+    return result
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              input_value: Any = None):
+    """Returns a concurrent.futures.Future of the workflow output."""
+    import concurrent.futures
+
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    fut = pool.submit(run, dag, workflow_id=workflow_id,
+                      input_value=input_value)
+    pool.shutdown(wait=False)
+    return fut
+
+
+def resume(workflow_id: str, dag: Optional[DAGNode] = None,
+           input_value: Any = None) -> Any:
+    """Re-run a workflow; completed steps load from their checkpoints."""
+    storage = _get_storage()
+    if storage.has_step_output(workflow_id, "__output__"):
+        return storage.load_step_output(workflow_id, "__output__")
+    if dag is None:
+        dag = storage.load_dag(workflow_id)
+    return run(dag, workflow_id=workflow_id, input_value=input_value)
+
+
+def resume_all() -> List[str]:
+    """Resume every RESUMABLE workflow; returns their ids."""
+    storage = _get_storage()
+    resumed = []
+    for wid in storage.list_workflows():
+        meta = storage.load_meta(wid) or {}
+        if meta.get("status") == WorkflowStatus.RESUMABLE:
+            try:
+                resume(wid)
+                resumed.append(wid)
+            except Exception:
+                pass
+    return resumed
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    meta = _get_storage().load_meta(workflow_id)
+    return meta.get("status") if meta else None
+
+
+def get_output(workflow_id: str) -> Any:
+    storage = _get_storage()
+    if not storage.has_step_output(workflow_id, "__output__"):
+        raise ValueError(f"workflow {workflow_id!r} has no output yet")
+    return storage.load_step_output(workflow_id, "__output__")
+
+
+def list_all() -> List[Dict[str, Any]]:
+    storage = _get_storage()
+    return [{"workflow_id": wid,
+             **(storage.load_meta(wid) or {})}
+            for wid in storage.list_workflows()]
+
+
+def delete(workflow_id: str) -> bool:
+    return _get_storage().delete_workflow(workflow_id)
+
+
+def wait_for_event(poll_fn: Callable[[], Any], timeout_s: float = 300.0,
+                   poll_interval_s: float = 0.5) -> Any:
+    """Minimal event-listener analog (reference: event_listener.py):
+    polls until poll_fn returns a truthy value, then returns it. Use
+    inside a step function to gate on external state."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = poll_fn()
+        if value:
+            return value
+        time.sleep(poll_interval_s)
+    raise TimeoutError("wait_for_event timed out")

@@ -1,0 +1,104 @@
+"""WorkflowStorage — durable step-output checkpointing.
+
+Reference: python/ray/workflow/workflow_storage.py:229 (WorkflowStorage)
+with the filesystem backend (storage/filesystem.py): one directory per
+workflow, one pickle per completed step, a JSON status record, atomic
+writes via rename.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, List, Optional
+
+_DEFAULT_ROOT = os.path.expanduser(
+    os.environ.get("RAY_TPU_WORKFLOW_ROOT", "/tmp/ray_tpu/workflows"))
+
+
+class WorkflowStorage:
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or _DEFAULT_ROOT
+        os.makedirs(self.root, exist_ok=True)
+
+    def _wf_dir(self, workflow_id: str) -> str:
+        return os.path.join(self.root, workflow_id)
+
+    def _atomic_write(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+
+    # ---- step outputs ----
+
+    def _step_path(self, workflow_id: str, step_id: str) -> str:
+        return os.path.join(self._wf_dir(workflow_id), "steps",
+                            f"{step_id}.pkl")
+
+    def has_step_output(self, workflow_id: str, step_id: str) -> bool:
+        return os.path.exists(self._step_path(workflow_id, step_id))
+
+    def save_step_output(self, workflow_id: str, step_id: str,
+                         value: Any) -> None:
+        self._atomic_write(self._step_path(workflow_id, step_id),
+                           pickle.dumps(value))
+
+    def load_step_output(self, workflow_id: str, step_id: str) -> Any:
+        with open(self._step_path(workflow_id, step_id), "rb") as f:
+            return pickle.load(f)
+
+    # ---- workflow records ----
+
+    def _meta_path(self, workflow_id: str) -> str:
+        return os.path.join(self._wf_dir(workflow_id), "meta.json")
+
+    def save_meta(self, workflow_id: str, meta: Dict[str, Any]) -> None:
+        self._atomic_write(self._meta_path(workflow_id),
+                           json.dumps(meta).encode())
+
+    def load_meta(self, workflow_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._meta_path(workflow_id)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def save_dag(self, workflow_id: str, dag: Any) -> None:
+        import cloudpickle
+
+        self._atomic_write(
+            os.path.join(self._wf_dir(workflow_id), "dag.pkl"),
+            cloudpickle.dumps(dag))
+
+    def load_dag(self, workflow_id: str) -> Any:
+        with open(os.path.join(self._wf_dir(workflow_id), "dag.pkl"),
+                  "rb") as f:
+            return pickle.load(f)
+
+    def list_workflows(self) -> List[str]:
+        try:
+            return sorted(
+                d for d in os.listdir(self.root)
+                if os.path.isdir(self._wf_dir(d)))
+        except FileNotFoundError:
+            return []
+
+    def delete_workflow(self, workflow_id: str) -> bool:
+        import shutil
+
+        path = self._wf_dir(workflow_id)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+            return True
+        return False

@@ -1,0 +1,111 @@
+"""Workflow library tests.
+
+Reference test model: python/ray/workflow/tests — checkpoint/resume
+semantics: a failing step leaves the workflow RESUMABLE, resume skips
+completed steps (verified via side-effect counters in files).
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture()
+def wf_storage(tmp_path):
+    workflow.init(str(tmp_path))
+    yield str(tmp_path)
+
+
+@ray_tpu.remote
+def _add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def _double(x):
+    return 2 * x
+
+
+def test_workflow_run_dag(ray_start_regular, wf_storage):
+    dag = _double.bind(_add.bind(2, 3))
+    result = workflow.run(dag, workflow_id="wf1")
+    assert result == 10
+    assert workflow.get_status("wf1") == workflow.WorkflowStatus.SUCCESSFUL
+    assert workflow.get_output("wf1") == 10
+    assert any(w["workflow_id"] == "wf1" for w in workflow.list_all())
+
+
+def test_workflow_resume_skips_completed_steps(ray_start_regular,
+                                               wf_storage, tmp_path):
+    marker = tmp_path / "exec_count"
+    marker.write_text("0")
+
+    @ray_tpu.remote
+    def counted(x):
+        n = int(marker.read_text()) + 1
+        marker.write_text(str(n))
+        return x + 100
+
+    @ray_tpu.remote
+    def flaky(x):
+        if os.path.exists(str(tmp_path / "fail")):
+            raise RuntimeError("injected failure")
+        return x * 3
+
+    (tmp_path / "fail").write_text("1")
+    dag = flaky.bind(counted.bind(1))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf2")
+    assert workflow.get_status("wf2") == workflow.WorkflowStatus.RESUMABLE
+    assert marker.read_text() == "1"  # counted ran once
+
+    os.remove(str(tmp_path / "fail"))
+    result = workflow.resume("wf2", dag)
+    assert result == 303
+    # counted was NOT re-executed: its checkpoint was reused.
+    assert marker.read_text() == "1"
+    assert workflow.get_status("wf2") == workflow.WorkflowStatus.SUCCESSFUL
+
+
+def test_workflow_resume_idempotent_output(ray_start_regular, wf_storage):
+    dag = _add.bind(1, 2)
+    assert workflow.run(dag, workflow_id="wf3") == 3
+    # resume of a finished workflow returns the stored output directly.
+    assert workflow.resume("wf3") == 3
+
+
+def test_workflow_resume_all(ray_start_regular, wf_storage, tmp_path):
+    @ray_tpu.remote
+    def gated():
+        if os.path.exists(str(tmp_path / "gate")):
+            raise RuntimeError("gated")
+        return "done"
+
+    (tmp_path / "gate").write_text("1")
+    with pytest.raises(Exception):
+        workflow.run(gated.bind(), workflow_id="wf4")
+    os.remove(str(tmp_path / "gate"))
+    resumed = workflow.resume_all()
+    assert "wf4" in resumed
+    assert workflow.get_output("wf4") == "done"
+
+
+def test_workflow_delete(ray_start_regular, wf_storage):
+    workflow.run(_add.bind(1, 1), workflow_id="wf5")
+    assert workflow.delete("wf5")
+    assert workflow.get_status("wf5") is None
+
+
+def test_wait_for_event():
+    calls = []
+
+    def poll():
+        calls.append(1)
+        return len(calls) >= 3
+
+    assert workflow.wait_for_event(poll, timeout_s=5.0,
+                                   poll_interval_s=0.01)
+    assert len(calls) == 3
