@@ -137,14 +137,23 @@ class JobSupervisor:
     def status(self) -> str:
         return self._status
 
-    def logs(self, offset: int = 0) -> str:
-        """Log contents from byte offset (incremental tailing stays O(n))."""
+    def logs(self) -> str:
         try:
             with open(self.log_path, "rb") as f:
-                f.seek(offset)
                 return f.read().decode(errors="replace")
         except FileNotFoundError:
             return ""
+
+    def read_logs(self, offset: int = 0) -> bytes:
+        """Raw bytes from offset — incremental tailing stays O(n) and
+        byte offsets never drift on multibyte characters (the client
+        decodes incrementally)."""
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(offset)
+                return f.read()
+        except FileNotFoundError:
+            return b""
 
     def log_size(self) -> int:
         try:
@@ -244,6 +253,19 @@ class JobSubmissionClient:
             return False
 
     def delete_job(self, submission_id: str) -> bool:
+        # Stop first: killing only the supervisor actor would orphan the
+        # entrypoint subprocess (it runs in its own session).
+        try:
+            status = self.get_job_status(submission_id)
+            if status not in JobStatus.TERMINAL:
+                self.stop_job(submission_id)
+                deadline = time.time() + 10
+                while time.time() < deadline and \
+                        self.get_job_status(submission_id) not in \
+                        JobStatus.TERMINAL:
+                    time.sleep(0.2)
+        except Exception:
+            pass
         try:
             sup = self._supervisor(submission_id)
             ray_tpu.kill(sup)
@@ -264,17 +286,27 @@ class JobSubmissionClient:
     def tail_job_logs(self, submission_id: str,
                       poll_interval_s: float = 0.5):
         """Generator yielding log increments until the job terminates.
-        Polls with a byte offset so each RPC ships only new output."""
+        Polls with a byte offset so each RPC ships only new output; an
+        incremental decoder keeps multibyte chars intact across reads."""
+        import codecs
+
         sup = self._supervisor(submission_id)
+        decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
         offset = 0
+
+        def _read():
+            nonlocal offset
+            raw = ray_tpu.get(sup.read_logs.remote(offset), timeout=10.0)
+            offset += len(raw)
+            return decoder.decode(raw) if raw else ""
+
         while True:
-            chunk = ray_tpu.get(sup.logs.remote(offset), timeout=10.0)
+            chunk = _read()
             if chunk:
                 yield chunk
-                offset += len(chunk.encode())
             status = self.get_job_status(submission_id)
             if status in JobStatus.TERMINAL:
-                chunk = ray_tpu.get(sup.logs.remote(offset), timeout=10.0)
+                chunk = _read() + decoder.decode(b"", final=True)
                 if chunk:
                     yield chunk
                 return

@@ -14,6 +14,13 @@ from ray_tpu.models.llama import (
     llama_param_specs,
 )
 from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_forward
+from ray_tpu.models.moe import (
+    MoeConfig,
+    moe_init,
+    moe_forward,
+    moe_loss,
+    moe_param_specs,
+)
 
 __all__ = [
     "LlamaConfig",
@@ -24,4 +31,9 @@ __all__ = [
     "MLPConfig",
     "mlp_init",
     "mlp_forward",
+    "MoeConfig",
+    "moe_init",
+    "moe_forward",
+    "moe_loss",
+    "moe_param_specs",
 ]

@@ -184,6 +184,11 @@ def _attention_call(q, k, v, cfg: LlamaConfig):
     vT = v.transpose(0, 2, 1, 3)
     if cfg.attn_impl == "ring":
         out = ring_attention(qT, kT, vT, axis_name=cfg.ring_axis, causal=True)
+    elif cfg.attn_impl == "ulysses":
+        from ray_tpu.ops.ulysses import ulysses_attention
+
+        out = ulysses_attention(qT, kT, vT, axis_name=cfg.ring_axis,
+                                causal=True)
     else:
         out = attention(qT, kT, vT, causal=True, impl=cfg.attn_impl)
     return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,254 @@
+"""Mixture-of-Experts decoder LM (Mixtral-style), TPU-first.
+
+Expert parallelism is a capability the reference lacks entirely
+(SURVEY.md §2.4: "Expert parallel (EP/MoE) — absent"); this module is the
+new-framework original. Design:
+
+- Top-k (default 2) token-choice routing with GShard/Switch-style static
+  capacity: dispatch/combine are one-hot einsums so every shape is static
+  and XLA tiles the expert matmuls onto the MXU — no ragged gather in the
+  hot path. Overflow tokens are dropped (standard capacity semantics);
+  the aux load-balancing loss keeps drop rates low.
+- The expert dimension is a logical axis ("expert") mapped to the `ep`
+  mesh axis: dispatch einsums become XLA all-to-alls over ICI, expert
+  FFN weights shard E-way with zero code changes.
+- Everything else (attention, RoPE, rmsnorm, scanned layers, remat)
+  reuses the Llama building blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import (LlamaConfig, _attention_call,
+                                  _layer_shapes, _rmsnorm, _rope)
+from ray_tpu.parallel.sharding import LogicalAxisRules, logical_to_mesh
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig(LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+
+    @staticmethod
+    def mixtral_8x7b(**kw) -> "MoeConfig":
+        return MoeConfig(vocab_size=32000, dim=4096, n_layers=32,
+                         n_heads=32, n_kv_heads=8, ffn_dim=14336,
+                         n_experts=8, top_k=2, **kw)
+
+    @staticmethod
+    def nano_moe(**kw) -> "MoeConfig":
+        defaults = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, ffn_dim=128, n_experts=4, top_k=2,
+                        max_seq_len=128)
+        defaults.update(kw)
+        return MoeConfig(**defaults)
+
+    def num_params(self) -> int:
+        d, f, e = self.dim, self.ffn_dim, self.n_experts
+        per_layer_attn = d * self.n_heads * self.head_dim * 2 + \
+            d * self.n_kv_heads * self.head_dim * 2
+        per_layer_moe = e * 3 * d * f + d * e  # experts + router
+        return (self.vocab_size * d * 2 +
+                self.n_layers * (per_layer_attn + per_layer_moe))
+
+    def active_params(self) -> int:
+        """Params touched per token (top-k experts only) — the MFU basis."""
+        d, f = self.dim, self.ffn_dim
+        per_layer_attn = d * self.n_heads * self.head_dim * 2 + \
+            d * self.n_kv_heads * self.head_dim * 2
+        per_layer_moe = self.top_k * 3 * d * f + d * self.n_experts
+        return (self.vocab_size * d * 2 +
+                self.n_layers * (per_layer_attn + per_layer_moe))
+
+
+def _moe_layer_shapes(cfg: MoeConfig) -> Dict[str, Any]:
+    """Llama attention shapes + expert-stacked FFN + router."""
+    d, f, e = cfg.dim, cfg.ffn_dim, cfg.n_experts
+    shapes = {k: v for k, v in _layer_shapes(cfg).items()
+              if not k.startswith("w_")}  # drop dense FFN
+    shapes.update({
+        "w_router": ((d, e), ("embed", None), d),
+        "we_gate": ((e, d, f), ("expert", "embed", "mlp"), d),
+        "we_up": ((e, d, f), ("expert", "embed", "mlp"), d),
+        "we_down": ((e, f, d), ("expert", "mlp", "embed"), f),
+    })
+    return shapes
+
+
+def moe_init(rng: jax.Array, cfg: MoeConfig) -> Params:
+    shapes = _moe_layer_shapes(cfg)
+    keys = jax.random.split(rng, len(shapes) + 3)
+    layers = {}
+    for i, (name, (shape, _, fan_in)) in enumerate(shapes.items()):
+        if fan_in is None:
+            layers[name] = jnp.ones((cfg.n_layers,) + shape,
+                                    cfg.param_dtype)
+        else:
+            layers[name] = (jax.random.normal(
+                keys[i], (cfg.n_layers,) + shape) * fan_in ** -0.5
+                ).astype(cfg.param_dtype)
+    return {
+        "tok_embed": (jax.random.normal(
+            keys[-3], (cfg.vocab_size, cfg.dim)) * 0.02
+            ).astype(cfg.param_dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.dim,), cfg.param_dtype),
+        "lm_head": (jax.random.normal(
+            keys[-1], (cfg.dim, cfg.vocab_size)) * cfg.dim ** -0.5
+            ).astype(cfg.param_dtype),
+    }
+
+
+def moe_logical_specs(cfg: MoeConfig) -> Params:
+    layer_specs = {name: ("layers",) + logical
+                   for name, (_, logical, _f) in
+                   _moe_layer_shapes(cfg).items()}
+    return {
+        "tok_embed": ("vocab", "embed"),
+        "layers": layer_specs,
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def moe_param_specs(cfg: MoeConfig,
+                    rules: Optional[LogicalAxisRules] = None) -> Params:
+    return jax.tree_util.tree_map(
+        lambda logical: logical_to_mesh(logical, rules),
+        moe_logical_specs(cfg),
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(e, (str, type(None))) for e in x))
+
+
+def _route_topk(gates: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """gates [G,E] -> (weights [G,k], expert_idx [G,k]); weights
+    renormalized over the chosen k."""
+    weights, idx = jax.lax.top_k(gates, k)
+    weights = weights / jnp.maximum(
+        weights.sum(-1, keepdims=True), 1e-9)
+    return weights, idx
+
+
+def _moe_ffn(x: jax.Array, layer: Params,
+             cfg: MoeConfig) -> Tuple[jax.Array, jax.Array]:
+    """x [B,S,d] -> (out [B,S,d], aux_loss scalar). Static-capacity
+    token-choice top-k dispatch."""
+    dt = cfg.dtype
+    b, s, d = x.shape
+    g = b * s
+    e, k = cfg.n_experts, cfg.top_k
+    capacity = max(1, int(cfg.capacity_factor * g * k / e))
+
+    xf = x.reshape(g, d)
+    router_logits = jnp.einsum(
+        "gd,de->ge", xf.astype(jnp.float32),
+        layer["w_router"].astype(jnp.float32))
+    gates = jax.nn.softmax(router_logits, axis=-1)          # [G,E]
+    weights, expert_idx = _route_topk(gates, k)             # [G,k]
+
+    # Position of each (token, choice) within its expert's capacity.
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # [G,k,E]
+    flat = onehot.reshape(g * k, e)
+    # Order: token-major, choice-minor — earlier tokens win capacity.
+    position = jnp.cumsum(flat, axis=0) - 1                  # [G*k,E]
+    position = (position * flat).sum(-1).reshape(g, k)       # [G,k]
+    in_capacity = position < capacity
+
+    # Combine weights [G,k] -> combine tensor [G,E,C] (one-hot einsum).
+    keep = weights * in_capacity.astype(weights.dtype)
+    pos_onehot = jax.nn.one_hot(position, capacity,
+                                dtype=dt)                    # [G,k,C]
+    exp_onehot = jax.nn.one_hot(expert_idx, e, dtype=dt)     # [G,k,E]
+    combine = jnp.einsum("gk,gke,gkc->gec",
+                         keep.astype(dt), exp_onehot, pos_onehot)
+    dispatch = (combine > 0).astype(dt)                      # [G,E,C]
+
+    # Expert compute: [E,C,d] batched matmuls (MXU-shaped, ep-sharded).
+    expert_in = jnp.einsum("gec,gd->ecd", dispatch, xf.astype(dt))
+    gate = jnp.einsum("ecd,edf->ecf", expert_in,
+                      layer["we_gate"].astype(dt))
+    up = jnp.einsum("ecd,edf->ecf", expert_in,
+                    layer["we_up"].astype(dt))
+    expert_out = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up,
+                            layer["we_down"].astype(dt))
+    out = jnp.einsum("gec,ecd->gd", combine, expert_out)
+
+    # Load-balancing aux loss (Switch/GShard): E * sum_e f_e * p_e.
+    me = gates.mean(0)                                       # [E]
+    ce = exp_onehot.sum(1).mean(0)                           # [E] frac routed
+    aux = e * jnp.sum(me * ce) / k
+
+    return out.reshape(b, s, d), aux.astype(jnp.float32)
+
+
+def _moe_decoder_layer(carry, layer: Params, positions: jax.Array,
+                       cfg: MoeConfig):
+    h, aux_sum = carry
+    dt = cfg.dtype
+    x = _rmsnorm(h, layer["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", x, layer["wq"].astype(dt))
+    kk = jnp.einsum("bsd,dhk->bshk", x, layer["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", x, layer["wv"].astype(dt))
+    q = _rope(q, positions, cfg.rope_theta)
+    kk = _rope(kk, positions, cfg.rope_theta)
+    o = _attention_call(q, kk, v, cfg)
+    h = h + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
+
+    x = _rmsnorm(h, layer["mlp_norm"], cfg.norm_eps)
+    moe_out, aux = _moe_ffn(x, layer, cfg)
+    return (h + moe_out, aux_sum + aux)
+
+
+def moe_forward(params: Params, tokens: jax.Array, cfg: MoeConfig,
+                positions: Optional[jax.Array] = None
+                ) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B,S] -> (logits [B,S,V] f32, mean aux loss)."""
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape)
+    h = params["tok_embed"].astype(cfg.dtype)[tokens]
+
+    layer_fn = functools.partial(_moe_decoder_layer, positions=positions,
+                                 cfg=cfg)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(carry, layer):
+        return layer_fn(carry, layer), None
+
+    (h, aux_sum), _ = jax.lax.scan(
+        scan_body, (h, jnp.zeros((), jnp.float32)), params["layers"])
+    h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", h,
+                        params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, aux_sum / cfg.n_layers
+
+
+def moe_loss(params: Params, batch: Dict[str, jax.Array],
+             cfg: MoeConfig) -> jax.Array:
+    """Next-token CE + router aux loss."""
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+    else:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    logits, aux = moe_forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll) + cfg.router_aux_coef * aux
+
+
+def moe_flops_per_token(cfg: MoeConfig, seq_len: int) -> float:
+    """Training FLOPs/token on ACTIVE params (top-k experts)."""
+    attn = 12 * cfg.n_layers * cfg.dim * seq_len
+    return 6.0 * cfg.active_params() + attn * 0.5

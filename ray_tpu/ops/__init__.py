@@ -9,10 +9,12 @@ context (capability absent from the reference — SURVEY.md §5.7).
 from ray_tpu.ops.attention import attention, mha_reference
 from ray_tpu.ops.flash_attention import flash_attention
 from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.ulysses import ulysses_attention
 
 __all__ = [
     "attention",
     "mha_reference",
     "flash_attention",
     "ring_attention",
+    "ulysses_attention",
 ]

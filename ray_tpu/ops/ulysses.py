@@ -1,0 +1,47 @@
+"""Ulysses (DeepSpeed-Ulysses-style) sequence parallelism.
+
+Absent from the reference (SURVEY.md §5.7) — a new-framework capability.
+Complement to ring attention (ops/ring_attention.py): instead of rotating
+K/V blocks around the `sp` ring, Ulysses swaps the sharded dimension with
+two all-to-alls over ICI — sequence-sharded activations become
+head-sharded for the attention itself, so each device runs FULL-sequence
+attention on H/sp heads (exact, no online-softmax bookkeeping; best when
+n_heads % sp == 0 and sequence fits HBM after the swap).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "sp", causal: bool = True,
+                      impl: str = "auto") -> jax.Array:
+    """q,k,v: [B, H, S_shard, D] (sequence sharded over axis_name, inside
+    shard_map/jit). Returns [B, H, S_shard, D].
+
+    all_to_all #1: split heads, gather sequence -> [B, H/sp, S, D]
+    full attention on the local head group
+    all_to_all #2: split sequence, gather heads -> [B, H, S_shard, D]
+    """
+    from ray_tpu.ops import attention
+
+    sp = jax.lax.psum(1, axis_name)
+    if q.shape[1] % sp:
+        raise ValueError(
+            f"n_heads={q.shape[1]} must be divisible by sp={sp}")
+
+    def swap_in(x):  # [B,H,Ss,D] -> [B,H/sp,S,D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    def swap_out(x):  # [B,H/sp,S,D] -> [B,H,Ss,D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    qh, kh, vh = swap_in(q), swap_in(k), swap_in(v)
+    out = attention(qh, kh, vh, causal=causal, impl=impl)
+    return swap_out(out)

@@ -10,6 +10,7 @@ rank list. Axis names follow the scaling-book convention:
            the reference delegates this to torch FSDP,
            python/ray/train/torch/train_loop_utils.py:184; in GSPMD it is
            just a mesh axis params are sharded over)
+- ``pp``   pipeline parallelism (stage axis; see parallel/pipeline.py)
 - ``tp``   tensor (megatron) parallelism
 - ``sp``   sequence/context parallelism (ring attention axis)
 - ``ep``   expert parallelism (MoE)
@@ -28,7 +29,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-AXIS_ORDER = ("dp", "fsdp", "ep", "sp", "tp")
+AXIS_ORDER = ("dp", "pp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,6 +42,7 @@ class MeshSpec:
     """
 
     dp: int = 1
+    pp: int = 1
     fsdp: int = 1
     ep: int = 1
     sp: int = 1
@@ -72,10 +74,12 @@ def mesh_shape_for(n_devices: int,
     if rest * tp * sp != n_devices:
         raise ValueError(f"tp*sp={tp * sp} must divide n_devices={n_devices}")
     if fsdp is None:
-        return {"dp": 1, "fsdp": rest, "ep": 1, "sp": sp, "tp": tp}
+        return {"dp": 1, "pp": 1, "fsdp": rest, "ep": 1, "sp": sp,
+                "tp": tp}
     if rest % fsdp:
         raise ValueError(f"fsdp={fsdp} must divide {rest}")
-    return {"dp": rest // fsdp, "fsdp": fsdp, "ep": 1, "sp": sp, "tp": tp}
+    return {"dp": rest // fsdp, "pp": 1, "fsdp": fsdp, "ep": 1, "sp": sp,
+            "tp": tp}
 
 
 def create_mesh(axis_sizes: Dict[str, int],

@@ -1,0 +1,104 @@
+"""Pipeline parallelism — GPipe schedule inside one compiled program.
+
+The reference has no built-in pipeline parallelism (SURVEY.md §2.4: "PP —
+absent as a built-in"); its primitive is the compiled-DAG actor pipeline
+with NCCL p2p channels (python/ray/dag/compiled_dag_node.py:391). The
+TPU-native form is radically different: the whole pipeline is ONE jitted
+SPMD program via `shard_map` over the `pp` mesh axis — each device group
+holds one stage's params, activations hop stages with
+`lax.ppermute` over ICI, and the microbatch schedule is a `lax.scan`
+(static shapes, MXU-friendly, zero per-step driver involvement).
+
+Bubble fraction is the GPipe (S-1)/(T+S-1); raise n_microbatches to
+amortize. Backward runs through the same scan (XLA differentiates the
+ppermute ring), so fwd+bwd are both pipelined.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+Pytree = Any
+
+
+def stack_stage_params(per_stage_params: list) -> Pytree:
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim
+    (shard it over `pp` via the "stage" logical axis)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def make_pipelined_fn(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
+                      mesh: Mesh,
+                      n_microbatches: int,
+                      axis: str = "pp"):
+    """Builds pipelined(params, x) -> y.
+
+    stage_fn(stage_params, x_microbatch) -> y_microbatch — one stage's
+    compute (e.g. a scan over its layers). Activations must keep shape
+    across stages (standard for decoder stacks).
+
+    params: pytree whose leaves have a leading [n_stages] dim.
+    x: [global_batch, ...] with global_batch % n_microbatches == 0.
+    Returns y of the same leading shape, replicated across `pp`.
+    """
+    n_stages = mesh.shape[axis]
+
+    def _program(params, x):
+        # Inside shard_map: params leaves have leading dim 1 (this
+        # stage's block); x is replicated.
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage_id = jax.lax.axis_index(axis)
+        gb = x.shape[0]
+        mb = gb // n_microbatches
+        micro = x.reshape((n_microbatches, mb) + x.shape[1:])
+        n_ticks = n_microbatches + n_stages - 1
+
+        state = jnp.zeros_like(micro[0])
+        outputs = jnp.zeros_like(micro)
+
+        def tick(carry, t):
+            state, outputs = carry
+            in_idx = jnp.clip(t, 0, n_microbatches - 1)
+            x_in = jnp.where(stage_id == 0, micro[in_idx], state)
+            y = stage_fn(params, x_in)
+            out_idx = t - (n_stages - 1)
+            write = jnp.logical_and(stage_id == n_stages - 1, out_idx >= 0)
+            safe_idx = jnp.clip(out_idx, 0, n_microbatches - 1)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(write, y,
+                          jax.lax.dynamic_index_in_dim(
+                              outputs, safe_idx, keepdims=False)),
+                safe_idx, axis=0)
+            # Activations hop to the next stage over ICI.
+            state = jax.lax.ppermute(
+                y, axis, [(i, i + 1) for i in range(n_stages - 1)])
+            return (state, outputs), None
+
+        (state, outputs), _ = jax.lax.scan(
+            tick, (state, outputs), jnp.arange(n_ticks))
+        # Only the last stage wrote outputs; psum broadcasts them so the
+        # result is replicated over pp (other stages contributed zeros).
+        outputs = jax.lax.psum(
+            jnp.where(stage_id == n_stages - 1, outputs,
+                      jnp.zeros_like(outputs)), axis)
+        return outputs.reshape((gb,) + outputs.shape[2:])
+
+    def pipelined(params, x):
+        from jax import shard_map
+
+        in_specs = (
+            jax.tree_util.tree_map(lambda _: P(axis), params),
+            P(),
+        )
+        fn = shard_map(_program, mesh=mesh, in_specs=in_specs,
+                       out_specs=P(), check_vma=False)
+        return fn(params, x)
+
+    return pipelined

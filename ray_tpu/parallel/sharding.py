@@ -32,7 +32,7 @@ DEFAULT_RULES: LogicalAxisRules = {
     "length": "sp",
     "expert": "ep",
     "layers": None,
-    "stage": None,
+    "stage": "pp",
 }
 
 

@@ -70,23 +70,19 @@ def cmd_start(args) -> None:
         node.start()
         print(f"ray_tpu node started; joined {address}")
 
-    if args.block:
-        stop = []
-        signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
-        signal.signal(signal.SIGINT, lambda *a: stop.append(1))
-        try:
-            while not stop:
-                time.sleep(0.5)
-        finally:
-            node.shutdown()
-    else:
-        print("(processes continue in background; this process must stay "
-              "alive — use --block in scripts, or `stop` to tear down)")
-        try:
-            while True:
-                time.sleep(3600)
-        except KeyboardInterrupt:
-            node.shutdown()
+    # Both modes stay resident and tear the node down on SIGTERM/SIGINT —
+    # otherwise `stop`'s SIGTERM would kill only this process and orphan
+    # the GCS/raylet children (spawned in their own sessions).
+    if not args.block:
+        print("(head process stays resident; `stop` tears it down)")
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.5)
+    finally:
+        node.shutdown()
 
 
 def cmd_stop(args) -> None:
@@ -165,7 +161,10 @@ def cmd_job(args) -> None:
     address = args.address or _read_address()["address"]
     client = JobSubmissionClient(address)
     if args.job_cmd == "submit":
-        sid = client.submit_job(entrypoint=" ".join(args.entrypoint))
+        import shlex
+
+        entrypoint = [a for a in args.entrypoint if a != "--"]
+        sid = client.submit_job(entrypoint=shlex.join(entrypoint))
         print(f"submitted job {sid}")
         if args.wait:
             for chunk in client.tail_job_logs(sid):

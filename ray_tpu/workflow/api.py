@@ -14,7 +14,6 @@ responsibility, as in the reference.
 
 from __future__ import annotations
 
-import hashlib
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -47,28 +46,51 @@ def _get_storage() -> WorkflowStorage:
     return _storage
 
 
-def _step_id(node: DAGNode, path: str) -> str:
-    """Deterministic step id: function name + structural path."""
-    if isinstance(node, FunctionNode):
-        name = getattr(node._remote_fn, "__name__", "fn")
-    elif isinstance(node, InputNode):
-        name = "input"
-    else:
-        name = type(node).__name__
-    digest = hashlib.sha1(path.encode()).hexdigest()[:8]
-    return f"{name}-{digest}"
+def _assign_step_ids(root: Any) -> Dict[int, str]:
+    """Canonical step ids: one per unique node, numbered in deterministic
+    first-visit (depth-first, args-then-kwargs) order.
+
+    Keyed per NODE, not per structural path, so a diamond-shaped DAG
+    (one node feeding two parents) gets exactly one checkpoint and is
+    never re-executed on resume regardless of which parent reaches it
+    first."""
+    ids: Dict[int, str] = {}
+    counter = [0]
+
+    def walk(node: Any) -> None:
+        if isinstance(node, (list, tuple)):
+            for v in node:
+                walk(v)
+            return
+        if not isinstance(node, DAGNode) or id(node) in ids:
+            return
+        if isinstance(node, FunctionNode):
+            name = getattr(node._remote_fn, "__name__", "fn")
+        elif isinstance(node, InputNode):
+            name = "input"
+        else:
+            name = type(node).__name__
+        ids[id(node)] = f"{name}-{counter[0]:04d}"
+        counter[0] += 1
+        for a in node._bound_args:
+            walk(a)
+        for k in sorted(node._bound_kwargs):
+            walk(node._bound_kwargs[k])
+
+    walk(root)
+    return ids
 
 
 def _execute_node(node: Any, workflow_id: str, input_value: Any,
-                  storage: WorkflowStorage, path: str,
+                  storage: WorkflowStorage, step_ids: Dict[int, str],
                   cache: Dict[int, Any]) -> Any:
     """Bottom-up execution with per-step checkpointing."""
     if not isinstance(node, DAGNode):
         if isinstance(node, (list, tuple)):
             return type(node)(
                 _execute_node(v, workflow_id, input_value, storage,
-                              f"{path}.{i}", cache)
-                for i, v in enumerate(node))
+                              step_ids, cache)
+                for v in node)
         return node
     if id(node) in cache:
         return cache[id(node)]
@@ -76,19 +98,19 @@ def _execute_node(node: Any, workflow_id: str, input_value: Any,
         cache[id(node)] = input_value
         return input_value
 
-    step_id = _step_id(node, path)
+    step_id = step_ids[id(node)]
     if storage.has_step_output(workflow_id, step_id):
         value = storage.load_step_output(workflow_id, step_id)
         cache[id(node)] = value
         return value
 
     args = tuple(
-        _execute_node(a, workflow_id, input_value, storage,
-                      f"{path}.a{i}", cache)
-        for i, a in enumerate(node._bound_args))
+        _execute_node(a, workflow_id, input_value, storage, step_ids,
+                      cache)
+        for a in node._bound_args)
     kwargs = {
-        k: _execute_node(v, workflow_id, input_value, storage,
-                         f"{path}.k{k}", cache)
+        k: _execute_node(v, workflow_id, input_value, storage, step_ids,
+                         cache)
         for k, v in node._bound_kwargs.items()}
 
     if isinstance(node, FunctionNode):
@@ -120,7 +142,7 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
         pass  # non-picklable closures: resume() then needs the dag passed
     try:
         result = _execute_node(dag, workflow_id, input_value, storage,
-                               "root", {})
+                               _assign_step_ids(dag), {})
     except Exception as e:
         storage.save_meta(workflow_id, {
             "status": WorkflowStatus.RESUMABLE,

@@ -91,7 +91,8 @@ def test_mesh_and_sharding_rules():
     from jax.sharding import PartitionSpec as P
 
     sizes = MeshSpec(dp=-1, tp=2).resolve(8)
-    assert sizes == {"dp": 4, "fsdp": 1, "ep": 1, "sp": 1, "tp": 2}
+    assert sizes == {"dp": 4, "pp": 1, "fsdp": 1, "ep": 1, "sp": 1,
+                     "tp": 2}
     mesh = create_mesh(sizes)
     assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
 

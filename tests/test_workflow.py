@@ -93,6 +93,45 @@ def test_workflow_resume_all(ray_start_regular, wf_storage, tmp_path):
     assert workflow.get_output("wf4") == "done"
 
 
+def test_workflow_diamond_resume_runs_shared_step_once(
+        ray_start_regular, wf_storage, tmp_path):
+    """Diamond DAG: one node feeds two parents. The shared step must
+    checkpoint once and never re-execute on resume."""
+    marker = tmp_path / "shared_count"
+    marker.write_text("0")
+
+    @ray_tpu.remote
+    def shared():
+        marker.write_text(str(int(marker.read_text()) + 1))
+        return 5
+
+    @ray_tpu.remote
+    def left(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def right(x):
+        if os.path.exists(str(tmp_path / "fail_right")):
+            raise RuntimeError("boom")
+        return x + 2
+
+    @ray_tpu.remote
+    def join(a, b):
+        return a + b
+
+    n = shared.bind()
+    dag = join.bind(left.bind(n), right.bind(n))
+
+    (tmp_path / "fail_right").write_text("1")
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf_diamond")
+    assert marker.read_text() == "1"
+
+    os.remove(str(tmp_path / "fail_right"))
+    assert workflow.resume("wf_diamond", dag) == 13
+    assert marker.read_text() == "1"  # shared step not re-executed
+
+
 def test_workflow_delete(ray_start_regular, wf_storage):
     workflow.run(_add.bind(1, 1), workflow_id="wf5")
     assert workflow.delete("wf5")
