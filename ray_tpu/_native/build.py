@@ -17,6 +17,7 @@ _LOCK = threading.Lock()
 
 _LIBS = {
     "ray_tpu_store": ["shm_store.cpp"],
+    "ray_tpu_transfer": ["shm_store.cpp", "transfer.cpp"],
 }
 
 

@@ -34,6 +34,9 @@ namespace {
 
 constexpr uint64_t kMagic = 0x5241595f545055ULL;  // "RAY_TPU"
 constexpr uint32_t kVersion = 1;
+// MUST equal the Python ObjectID size (core/ids.py: 20-byte TaskID +
+// 4-byte return index = 24): ids cross the ctypes boundary as
+// exact-length buffers and find_slot memcmps the full kIdSize.
 constexpr int kIdSize = 24;
 constexpr uint64_t kAlign = 64;
 
@@ -413,6 +416,14 @@ int shm_delete(void* handle, const uint8_t* id) {
 }
 
 // 1 if sealed-present, 0 otherwise.
+// Raw pointer into the mapped arena (offset from shm_create/shm_get).
+// Valid while the object stays pinned — used by the native transfer
+// plane to stream object bytes without copies through Python.
+uint8_t* shm_data_pointer(void* handle, uint64_t offset) {
+  Handle* st = reinterpret_cast<Handle*>(handle);
+  return st->base + offset;
+}
+
 int shm_contains(void* handle, const uint8_t* id) {
   Handle* st = reinterpret_cast<Handle*>(handle);
   Header* h = st->hdr;

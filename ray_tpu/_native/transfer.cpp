@@ -1,0 +1,274 @@
+// transfer — native cross-node object streaming (object-manager data plane).
+//
+// TPU-native counterpart of the reference's chunked object push/pull
+// (src/ray/object_manager/object_manager.cc + object_buffer_pool.h): the
+// bulk bytes of an object move store-to-store over a raw TCP socket with
+// zero Python on the data path — the sender streams straight out of its
+// mapped shm arena, the receiver recv()s straight into a pinned allocation
+// in its own arena and seals it. Python (the raylet) only decides WHAT to
+// fetch from WHERE; the bytes never enter the interpreter.
+//
+// Protocol (one object per connection, receiver-initiated pull):
+//   request : u64 magic | u8 id[20]
+//   response: u32 status (0=ok, 1=not found) | u64 size | payload bytes
+//
+// Build: compiled together with shm_store.cpp into libray_tpu_transfer.so.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <atomic>
+
+// From shm_store.cpp (same shared library).
+extern "C" {
+void* shm_store_open(const char* path);
+void shm_store_close(void* handle);
+int shm_create(void* handle, const uint8_t* id, uint64_t size,
+               uint64_t* out_offset);
+int shm_seal(void* handle, const uint8_t* id);
+int shm_abort(void* handle, const uint8_t* id);
+int shm_get(void* handle, const uint8_t* id, long timeout_ms,
+            uint64_t* out_offset, uint64_t* out_size);
+int shm_release(void* handle, const uint8_t* id);
+uint8_t* shm_data_pointer(void* handle, uint64_t offset);
+}
+
+namespace {
+
+constexpr uint64_t kReqMagic = 0x5452414E53464552ULL;  // "TRANSFER"
+// Matches shm_store.cpp kIdSize (= Python ObjectID.SIZE = 24).
+constexpr int kIdSize = 24;
+
+// Bound every socket op: a stalled peer must fail the pull so the
+// caller can fall back to the rpc path (which carries its own timeouts).
+constexpr int kIoTimeoutSec = 30;
+
+void set_io_timeouts(int fd) {
+  timeval tv{kIoTimeoutSec, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
+bool read_exact(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t w = send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+struct Server {
+  void* store = nullptr;
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  pthread_t thread{};
+};
+
+struct ConnTask {
+  Server* server;
+  int fd;
+};
+
+void* handle_conn(void* arg) {
+  ConnTask* task = static_cast<ConnTask*>(arg);
+  int fd = task->fd;
+  Server* server = task->server;
+  delete task;
+
+  uint64_t magic = 0;
+  uint8_t id[kIdSize];
+  if (!read_exact(fd, &magic, sizeof(magic)) || magic != kReqMagic ||
+      !read_exact(fd, id, kIdSize)) {
+    close(fd);
+    return nullptr;
+  }
+  uint64_t offset = 0, size = 0;
+  int rc = shm_get(server->store, id, /*timeout_ms=*/0, &offset, &size);
+  uint32_t status = (rc == 0) ? 0u : 1u;
+  uint64_t send_size = (rc == 0) ? size : 0;
+  if (!write_exact(fd, &status, sizeof(status)) ||
+      !write_exact(fd, &send_size, sizeof(send_size))) {
+    if (rc == 0) shm_release(server->store, id);
+    close(fd);
+    return nullptr;
+  }
+  if (rc == 0) {
+    const uint8_t* data = shm_data_pointer(server->store, offset);
+    write_exact(fd, data, size);
+    shm_release(server->store, id);
+  }
+  close(fd);
+  return nullptr;
+}
+
+void* accept_loop(void* arg) {
+  Server* server = static_cast<Server*>(arg);
+  while (!server->stop.load()) {
+    int fd = accept(server->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (server->stop.load()) break;
+      if (errno == EINTR) continue;
+      break;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    set_io_timeouts(fd);
+    pthread_t t;
+    ConnTask* task = new ConnTask{server, fd};
+    if (pthread_create(&t, nullptr, handle_conn, task) == 0) {
+      pthread_detach(t);
+    } else {
+      delete task;
+      close(fd);
+    }
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start serving objects from the store at `store_path`. Returns the bound
+// port (>0) or -errno. `out_server` receives an opaque server handle.
+int obj_transfer_serve(const char* store_path, void** out_server) {
+  void* store = shm_store_open(store_path);
+  if (!store) return -EINVAL;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    shm_store_close(store);
+    return -errno;
+  }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = 0;
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    int e = errno;
+    close(fd);
+    shm_store_close(store);
+    return -e;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+
+  Server* server = new Server();
+  server->store = store;
+  server->listen_fd = fd;
+  if (pthread_create(&server->thread, nullptr, accept_loop, server) != 0) {
+    close(fd);
+    shm_store_close(store);
+    delete server;
+    return -EAGAIN;
+  }
+  *out_server = server;
+  return ntohs(addr.sin_port);
+}
+
+void obj_transfer_stop(void* server_ptr) {
+  Server* server = static_cast<Server*>(server_ptr);
+  server->stop.store(true);
+  shutdown(server->listen_fd, SHUT_RDWR);
+  close(server->listen_fd);
+  pthread_join(server->thread, nullptr);
+  shm_store_close(server->store);
+  delete server;
+}
+
+// Pull object `id` from host:port straight into the store at `store_path`.
+// Returns 0 ok, 1 remote miss, 2 local exists (fine), -errno on I/O error.
+int obj_transfer_fetch(const char* store_path, const char* host, int port,
+                       const uint8_t* id) {
+  void* store = shm_store_open(store_path);
+  if (!store) return -EINVAL;
+
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    shm_store_close(store);
+    return -errno;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  set_io_timeouts(fd);  // SO_SNDTIMEO also bounds connect() on Linux
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    shm_store_close(store);
+    return -EINVAL;
+  }
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    int e = errno;
+    close(fd);
+    shm_store_close(store);
+    return -e;
+  }
+  int result = -EIO;
+  uint64_t offset = 0;
+  bool created = false;
+  do {
+    if (!write_exact(fd, &kReqMagic, sizeof(kReqMagic)) ||
+        !write_exact(fd, id, kIdSize)) break;
+    uint32_t status = 0;
+    uint64_t size = 0;
+    if (!read_exact(fd, &status, sizeof(status)) ||
+        !read_exact(fd, &size, sizeof(size))) break;
+    if (status != 0) {
+      result = 1;  // remote miss
+      break;
+    }
+    int rc = shm_create(store, id, size, &offset);
+    if (rc == -1 /*ERR_EXISTS*/) {
+      result = 2;
+      break;
+    }
+    if (rc != 0) {
+      result = -ENOSPC;
+      break;
+    }
+    created = true;
+    uint8_t* dst = shm_data_pointer(store, offset);
+    if (!read_exact(fd, dst, size)) break;
+    if (shm_seal(store, id) != 0) break;
+    created = false;  // sealed — no abort needed
+    result = 0;
+  } while (false);
+  if (created) shm_abort(store, id);
+  close(fd);
+  shm_store_close(store);
+  return result;
+}
+
+}  // extern "C"

@@ -170,6 +170,12 @@ class CoreWorker:
             })
             if self.node_id is None:
                 self.node_id = NodeID(r["node_id"])
+            if self.mode == WORKER:
+                # A worker whose raylet dies must exit, not linger as an
+                # orphan (reference: workers poll the raylet socket and
+                # die with it).
+                self.raylet.on_close = \
+                    lambda conn: self._should_exit.set()
         if self.store_path:
             self.plasma = ShmClient(self.store_path)
         if self.config.task_events_enabled:
@@ -1084,6 +1090,27 @@ class CoreWorker:
         })
         # Flush on batch size or a 1s cadence (reference: TaskEventBuffer
         # periodic flush, task_event_buffer.h:206).
+        if len(self._task_events) >= 100 or \
+                time.time() - self._task_events_last_flush > 1.0:
+            self._flush_task_events()
+
+    def record_profile_event(self, name: str, start: float, end: float,
+                             extra: Optional[dict] = None) -> None:
+        """User span (reference: ProfileEvent, profile_event.h) — rides
+        the task-event pipeline, shows up in `ray timeline`."""
+        if not self.config.task_events_enabled:
+            return
+        self._task_events.append({
+            "task_id": os.urandom(8),
+            "job_id": self.job_id.binary() if self.job_id else b"",
+            "name": name,
+            "state": "PROFILE",
+            "time": start,
+            "end_time": end,
+            "worker_id": self.worker_id.binary(),
+            "actor_id": None,
+            "extra": extra or {},
+        })
         if len(self._task_events) >= 100 or \
                 time.time() - self._task_events_last_flush > 1.0:
             self._flush_task_events()

@@ -43,6 +43,7 @@ class NodeInfo:
         self.resources_available: Dict[str, float] = dict(data["resources"])
         self.labels: Dict[str, str] = data.get("labels", {})
         self.slice_id: str = data.get("slice_id", "")
+        self.transfer_port: int = data.get("transfer_port", 0)
         self.state = ALIVE
         self.last_heartbeat = time.monotonic()
         self.conn: Optional[rpc.Connection] = None
@@ -59,6 +60,7 @@ class NodeInfo:
             "resources_available": self.resources_available,
             "labels": self.labels,
             "slice_id": self.slice_id,
+            "transfer_port": self.transfer_port,
             "state": self.state,
         }
 
@@ -132,6 +134,8 @@ class GcsServer:
         self.object_locations: Dict[bytes, Set[bytes]] = {}
         self.spilled_objects: Dict[bytes, str] = {}
         self.task_events: List[dict] = []
+        # worker_id -> {"metrics": [...], "time": t}
+        self.worker_metrics: Dict[bytes, dict] = {}
         self.subscribers: Dict[str, Set[rpc.Connection]] = {}
         self._next_job = 0
         self._server: Optional[rpc.Server] = None
@@ -411,6 +415,8 @@ class GcsServer:
 
     async def handle_report_worker_death(self, data, conn) -> bool:
         """Raylet reports a dead worker; fail any actor hosted there."""
+        if data.get("worker_id"):
+            self.worker_metrics.pop(data["worker_id"], None)
         actor_id = data.get("actor_id")
         if actor_id:
             actor = self.actors.get(ActorID(actor_id))
@@ -705,6 +711,56 @@ class GcsServer:
     async def handle_list_task_events(self, data, conn) -> list:
         limit = data.get("limit", 1000)
         return self.task_events[-limit:]
+
+    # ------------------------------------------------------------- metrics
+    async def handle_report_metrics(self, data, conn) -> bool:
+        """Latest metric snapshots per reporting worker (reference: node
+        metrics agents feeding OpenCensusProxyCollector)."""
+        self.worker_metrics[data["worker_id"]] = {
+            "metrics": data["metrics"], "time": time.time()}
+        return True
+
+    async def handle_get_metrics(self, data, conn) -> list:
+        """Aggregate across workers: counters/histograms sum, gauges take
+        the latest value per tag set."""
+        # Prune snapshots from workers that stopped reporting (dead
+        # workers/nodes); healthy pushers report on a ~2s cadence.
+        cutoff = time.time() - 30.0
+        for wid in [w for w, e in self.worker_metrics.items()
+                    if e["time"] < cutoff]:
+            del self.worker_metrics[wid]
+        agg: Dict[tuple, dict] = {}
+        for entry in self.worker_metrics.values():
+            for m in entry["metrics"]:
+                key = (m["name"], tuple(sorted(m["tags"].items())))
+                cur = agg.get(key)
+                if cur is None:
+                    cur = agg[key] = {k: v for k, v in m.items()}
+                    cur["bucket_counts"] = list(
+                        m.get("bucket_counts", []))
+                    cur["_t"] = entry["time"]
+                elif m["kind"] == "gauge":
+                    # Latest report wins; _t moves only when accepted.
+                    if entry["time"] >= cur["_t"]:
+                        cur["value"] = m["value"]
+                        cur["_t"] = entry["time"]
+                elif m["kind"] == "counter":
+                    cur["value"] += m["value"]
+                else:
+                    cur["sum"] = cur.get("sum", 0) + m.get("sum", 0)
+                    cur["count"] = cur.get("count", 0) + m.get("count", 0)
+                    counts = m.get("bucket_counts", [])
+                    mine = cur["bucket_counts"]
+                    for i, c in enumerate(counts):
+                        if i < len(mine):
+                            mine[i] += c
+                        else:
+                            mine.append(c)
+        out = []
+        for v in agg.values():
+            v.pop("_t", None)
+            out.append(v)
+        return out
 
     # ------------------------------------------------------------- autoscaler
     async def handle_autoscaler_state(self, data, conn) -> dict:

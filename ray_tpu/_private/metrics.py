@@ -1,0 +1,123 @@
+"""Process-local metric registry with GCS push.
+
+Reference: src/ray/stats/ (OpenCensus registry in every process) +
+python/ray/_private/metrics_agent.py (per-node agent re-exposing
+Prometheus). Simplification, same shape: every process registers metrics
+locally and pushes snapshots to the GCS on a short cadence; the dashboard
+exposes the aggregate as Prometheus text.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_lock = threading.Lock()
+_registry: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], "_Metric"] = {}
+_pusher: Optional[threading.Thread] = None
+_push_stop = threading.Event()
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000]
+
+
+class _Metric:
+    def __init__(self, name: str, kind: str, description: str,
+                 tags: Dict[str, str],
+                 boundaries: Optional[List[float]] = None):
+        self.name = name
+        self.kind = kind  # counter | gauge | histogram
+        self.description = description
+        self.tags = dict(tags)
+        self.value = 0.0
+        self.boundaries = boundaries or []
+        self.bucket_counts = [0] * (len(self.boundaries) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def snapshot(self) -> Dict[str, Any]:
+        out = {"name": self.name, "kind": self.kind,
+               "description": self.description, "tags": self.tags,
+               "value": self.value}
+        if self.kind == "histogram":
+            out.update({"boundaries": self.boundaries,
+                        "bucket_counts": self.bucket_counts,
+                        "sum": self.sum, "count": self.count})
+        return out
+
+
+def register(name: str, kind: str, description: str,
+             tags: Dict[str, str],
+             boundaries: Optional[List[float]] = None) -> _Metric:
+    key = (name, tuple(sorted(tags.items())))
+    with _lock:
+        metric = _registry.get(key)
+        if metric is None:
+            metric = _registry[key] = _Metric(name, kind, description,
+                                              tags, boundaries)
+        return metric
+
+
+def record(metric: _Metric, value: float, kind: str) -> None:
+    with _lock:
+        if kind == "counter":
+            metric.value += value
+        elif kind == "gauge":
+            metric.value = value
+        else:
+            metric.sum += value
+            metric.count += 1
+            idx = 0
+            while idx < len(metric.boundaries) and \
+                    value > metric.boundaries[idx]:
+                idx += 1
+            metric.bucket_counts[idx] += 1
+
+
+def snapshots() -> List[Dict[str, Any]]:
+    with _lock:
+        return [m.snapshot() for m in _registry.values()]
+
+
+def _push_loop(interval_s: float) -> None:
+    from ray_tpu._private.worker import global_worker_or_none
+
+    while not _push_stop.wait(interval_s):
+        worker = global_worker_or_none()
+        if worker is None:
+            continue
+        snaps = snapshots()
+        if not snaps:
+            continue
+        try:
+            worker.gcs_call("report_metrics", {
+                "worker_id": worker.core.worker_id.binary(),
+                "metrics": snaps})
+        except Exception:
+            pass
+
+
+def ensure_pusher(interval_s: float = 2.0) -> None:
+    global _pusher
+    with _lock:
+        if _pusher is None or not _pusher.is_alive():
+            _push_stop.clear()
+            _pusher = threading.Thread(
+                target=_push_loop, args=(interval_s,), daemon=True,
+                name="metrics-pusher")
+            _pusher.start()
+
+
+def flush_now() -> None:
+    """Synchronous push (tests / shutdown)."""
+    from ray_tpu._private.worker import global_worker_or_none
+
+    worker = global_worker_or_none()
+    if worker is None:
+        return
+    snaps = snapshots()
+    if snaps:
+        worker.gcs_call("report_metrics", {
+            "worker_id": worker.core.worker_id.binary(),
+            "metrics": snaps})

@@ -105,6 +105,19 @@ class Raylet:
         self.gcs = await rpc.connect(ghost, int(gport),
                                      handler=self._on_gcs_message,
                                      name="raylet->gcs")
+        # Native object-transfer server: bulk object bytes move
+        # store-to-store over raw TCP (C++ threads), Python only
+        # coordinates (reference: ObjectManager's dedicated rpc service).
+        try:
+            from ray_tpu.core.transfer_client import TransferServer
+
+            self.transfer_server = TransferServer(self.store_path)
+            transfer_port = self.transfer_server.port
+        except Exception:
+            logger.exception("native transfer server failed to start; "
+                             "falling back to rpc chunk transfer")
+            self.transfer_server = None
+            transfer_port = 0
         await self.gcs.call("register_node", {
             "node_id": self.node_id.binary(),
             "address": self.address,
@@ -113,6 +126,7 @@ class Raylet:
             "resources": self.resources_total,
             "labels": self.labels,
             "slice_id": self.slice_id,
+            "transfer_port": transfer_port,
         })
         await self.gcs.call("subscribe", {"channel": "cluster_view"})
         await self.gcs.call("subscribe", {"channel": "jobs"})
@@ -131,6 +145,9 @@ class Raylet:
         for w in self.workers.values():
             if w.proc and w.proc.poll() is None:
                 w.proc.terminate()
+        if getattr(self, "transfer_server", None) is not None:
+            await asyncio.get_event_loop().run_in_executor(
+                None, self.transfer_server.stop)
         if self._server:
             await self._server.close()
         if self.gcs:
@@ -561,7 +578,8 @@ class Raylet:
             for node in locs.get("nodes", []):
                 if node["node_id"] == self.node_id.binary():
                     continue
-                ok = await self._fetch_from_remote(oid, node["address"])
+                ok = await self._fetch_from_remote(
+                    oid, node["address"], node.get("transfer_port", 0))
                 if ok:
                     await self.gcs.call("add_object_location", {
                         "object_id": key,
@@ -575,7 +593,25 @@ class Raylet:
             await asyncio.sleep(0.05)
         return {"status": "not_found"}
 
-    async def _fetch_from_remote(self, oid: ObjectID, address: str) -> bool:
+    async def _fetch_from_remote(self, oid: ObjectID, address: str,
+                                 transfer_port: int = 0) -> bool:
+        # Fast path: native store-to-store streaming (transfer.cpp) — no
+        # Python on the data plane. Falls back to rpc chunks if the remote
+        # has no transfer server or the native pull fails.
+        if transfer_port and self.transfer_server is not None:
+            host = address.rsplit(":", 1)[0]
+            try:
+                from ray_tpu.core import transfer_client as tc
+
+                rc = await asyncio.get_event_loop().run_in_executor(
+                    None, tc.fetch, self.store_path, host, transfer_port,
+                    oid.binary())
+                if rc in (tc.FETCH_OK, tc.FETCH_ALREADY_LOCAL):
+                    return True
+            except Exception as e:
+                logger.info("native fetch of %s from %s:%d failed (%s); "
+                            "falling back to rpc", oid.hex()[:8], host,
+                            transfer_port, e)
         try:
             host, port = address.rsplit(":", 1)
             c = await rpc.connect(host, int(port), timeout=5.0,

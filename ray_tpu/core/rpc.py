@@ -213,11 +213,19 @@ class Server:
         return self.port
 
     async def close(self) -> None:
+        # Close accepted connections BEFORE wait_closed(): since py3.12
+        # wait_closed blocks until every connection handler finishes, so
+        # waiting first deadlocks while peers (e.g. the driver) hold
+        # connections open.
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
         for conn in list(self.connections):
             await conn.close()
+        if self._server:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except asyncio.TimeoutError:
+                pass
 
 
 async def connect(host: str, port: int,

@@ -1,0 +1,68 @@
+"""ctypes binding for the native object-transfer plane.
+
+Reference role: src/ray/object_manager/ (chunked push/pull). The raylet
+starts one native transfer server over its shm store; pulls from remote
+nodes stream store-to-store over raw TCP with no Python on the data path
+(see _native/transfer.cpp).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+from ray_tpu._native.build import ensure_built
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        path = ensure_built("ray_tpu_transfer")
+        lib = ctypes.CDLL(path)
+        lib.obj_transfer_serve.argtypes = [ctypes.c_char_p,
+                                           ctypes.POINTER(ctypes.c_void_p)]
+        lib.obj_transfer_serve.restype = ctypes.c_int
+        lib.obj_transfer_stop.argtypes = [ctypes.c_void_p]
+        lib.obj_transfer_stop.restype = None
+        lib.obj_transfer_fetch.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p]
+        lib.obj_transfer_fetch.restype = ctypes.c_int
+        _lib = lib
+    return _lib
+
+
+class TransferServer:
+    """Serves local sealed objects to remote pullers (runs native threads
+    inside the raylet process)."""
+
+    def __init__(self, store_path: str):
+        self._handle = ctypes.c_void_p()
+        port = _load().obj_transfer_serve(store_path.encode(),
+                                          ctypes.byref(self._handle))
+        if port <= 0:
+            raise OSError(-port, "obj_transfer_serve failed")
+        self.port = port
+
+    def stop(self) -> None:
+        if self._handle:
+            _load().obj_transfer_stop(self._handle)
+            self._handle = ctypes.c_void_p()
+
+
+FETCH_OK = 0
+FETCH_REMOTE_MISS = 1
+FETCH_ALREADY_LOCAL = 2
+
+
+def fetch(store_path: str, host: str, port: int, object_id: bytes) -> int:
+    """Blocking native pull of one object into the local store. Returns a
+    FETCH_* code; raises OSError on I/O errors. Call from a thread
+    executor — it blocks on the socket."""
+    rc = _load().obj_transfer_fetch(store_path.encode(), host.encode(),
+                                    int(port), object_id)
+    if rc < 0:
+        raise OSError(-rc, f"obj_transfer_fetch({host}:{port}) failed")
+    return rc

@@ -1,0 +1,11 @@
+"""ray_tpu.dashboard — HTTP observability head.
+
+Parity target: python/ray/dashboard/ (head + state aggregation +
+Prometheus metrics export). JSON state endpoints + /metrics text; the
+reference's React frontend is out of scope — the endpoints carry the
+same data the state CLI/SDK uses.
+"""
+
+from ray_tpu.dashboard.head import DashboardHead, start_dashboard
+
+__all__ = ["DashboardHead", "start_dashboard"]

@@ -1,0 +1,186 @@
+"""Dashboard head: aiohttp server over GCS state.
+
+Reference: python/ray/dashboard/head.py + http_server_head.py (state
+endpoints, /metrics Prometheus via metrics_agent.py:244). Runs in a
+thread beside the driver or the CLI head process.
+
+Endpoints:
+  GET /healthz              -> "success"
+  GET /metrics              -> Prometheus text (user + runtime metrics)
+  GET /api/cluster_status   -> nodes + resources
+  GET /api/nodes            -> node table
+  GET /api/actors           -> actor table
+  GET /api/jobs             -> submitted jobs
+  GET /api/tasks/summary    -> task state counts
+  GET /api/timeline         -> chrome trace JSON
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def _prometheus_text(metrics: List[Dict[str, Any]]) -> str:
+    lines = []
+    seen_meta = set()
+    for m in metrics:
+        name = "ray_tpu_" + m["name"].replace(".", "_")
+        if name not in seen_meta:
+            seen_meta.add(name)
+            if m.get("description"):
+                lines.append(f"# HELP {name} {m['description']}")
+            kind = {"counter": "counter", "gauge": "gauge",
+                    "histogram": "histogram"}[m["kind"]]
+            lines.append(f"# TYPE {name} {kind}")
+        tag_str = ",".join(f'{k}="{v}"'
+                           for k, v in sorted(m["tags"].items()))
+        label = f"{{{tag_str}}}" if tag_str else ""
+        if m["kind"] == "histogram":
+            cumulative = 0
+            bounds = m.get("boundaries", [])
+            for i, c in enumerate(m.get("bucket_counts", [])):
+                cumulative += c
+                le = bounds[i] if i < len(bounds) else "+Inf"
+                extra = f'le="{le}"'
+                tags = f"{{{tag_str},{extra}}}" if tag_str else \
+                    f"{{{extra}}}"
+                lines.append(f"{name}_bucket{tags} {cumulative}")
+            lines.append(f"{name}_sum{label} {m.get('sum', 0)}")
+            lines.append(f"{name}_count{label} {m.get('count', 0)}")
+        else:
+            lines.append(f"{name}{label} {m['value']}")
+    return "\n".join(lines) + "\n"
+
+
+class DashboardHead:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._thread: Optional[threading.Thread] = None
+        self._loop = None
+        self._started = threading.Event()
+        self._stop_evt: Optional[asyncio.Event] = None
+
+    # ---- data helpers (worker-thread safe: gcs_call is sync) ----
+
+    def _gcs(self, method: str, data: Optional[dict] = None):
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker().gcs_call(method, data or {})
+
+    # ---- aiohttp app ----
+
+    async def _serve(self) -> None:
+        from aiohttp import web
+
+        routes = web.RouteTableDef()
+
+        def offload(fn, *args):
+            return asyncio.get_running_loop().run_in_executor(
+                None, fn, *args)
+
+        @routes.get("/healthz")
+        async def healthz(request):
+            return web.Response(text="success")
+
+        @routes.get("/metrics")
+        async def metrics(request):
+            data = await offload(self._gcs, "get_metrics")
+            return web.Response(text=_prometheus_text(data or []),
+                                content_type="text/plain")
+
+        @routes.get("/api/cluster_status")
+        async def cluster_status(request):
+            res = await offload(self._gcs, "cluster_resources")
+            nodes = await offload(self._gcs, "get_nodes")
+            alive = sum(1 for n in nodes if n.get("state") == "ALIVE")
+            return web.json_response({
+                "nodes_alive": alive, "nodes_total": len(nodes),
+                "resources": res}, dumps=_dumps)
+
+        @routes.get("/api/nodes")
+        async def nodes(request):
+            from ray_tpu.util import state
+
+            return web.json_response(
+                await offload(state.list_nodes), dumps=_dumps)
+
+        @routes.get("/api/actors")
+        async def actors(request):
+            from ray_tpu.util import state
+
+            return web.json_response(
+                await offload(state.list_actors), dumps=_dumps)
+
+        @routes.get("/api/jobs")
+        async def jobs(request):
+            from ray_tpu.job_submission import JobSubmissionClient
+
+            client = JobSubmissionClient()
+            infos = await offload(client.list_jobs)
+            return web.json_response([i.__dict__ for i in infos],
+                                     dumps=_dumps)
+
+        @routes.get("/api/tasks/summary")
+        async def tasks_summary(request):
+            from ray_tpu.util import state
+
+            return web.json_response(
+                await offload(state.summarize_tasks), dumps=_dumps)
+
+        @routes.get("/api/timeline")
+        async def timeline_route(request):
+            from ray_tpu.util.timeline import timeline
+
+            return web.json_response(await offload(timeline),
+                                     dumps=_dumps)
+
+        app = web.Application()
+        app.add_routes(routes)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, self.host, self.port)
+        await site.start()
+        self._stop_evt = asyncio.Event()
+        self._started.set()
+        logger.info("dashboard listening on %s:%d", self.host, self.port)
+        await self._stop_evt.wait()
+        await runner.cleanup()
+
+    def start(self) -> "DashboardHead":
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            try:
+                self._loop.run_until_complete(self._serve())
+            finally:
+                self._loop.close()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="dashboard-head")
+        self._thread.start()
+        if not self._started.wait(10.0):
+            raise RuntimeError("dashboard failed to start")
+        return self
+
+    def stop(self) -> None:
+        if self._loop and self._stop_evt:
+            self._loop.call_soon_threadsafe(self._stop_evt.set)
+        if self._thread:
+            self._thread.join(timeout=5.0)
+
+
+def _dumps(obj) -> str:
+    return json.dumps(obj, default=lambda o: o.hex()
+                      if isinstance(o, bytes) else str(o))
+
+
+def start_dashboard(host: str = "127.0.0.1",
+                    port: int = 8265) -> DashboardHead:
+    return DashboardHead(host, port).start()

@@ -58,30 +58,44 @@ def cmd_start(args) -> None:
         resources[name] = float(val)
 
     config = Config.from_env(None)
+    dash = None
     if args.head:
         node = Node(config, resources=resources or None)
-        node.start()
-        _write_address(node.gcs_address, os.getpid())
-        print(f"ray_tpu head started; address={node.gcs_address}")
     else:
         address = args.address or _read_address()["address"]
         node = Node(config, resources=resources or None,
                     gcs_address=address)
-        node.start()
-        print(f"ray_tpu node started; joined {address}")
-
-    # Both modes stay resident and tear the node down on SIGTERM/SIGINT —
-    # otherwise `stop`'s SIGTERM would kill only this process and orphan
-    # the GCS/raylet children (spawned in their own sessions).
-    if not args.block:
-        print("(head process stays resident; `stop` tears it down)")
-    stop = []
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
-    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    node.start()
+    # Everything after start() runs under try/finally: a failure (e.g.
+    # dashboard port in use) must still tear the GCS/raylet children down
+    # — they live in their own sessions and would otherwise be orphaned.
     try:
+        if args.head:
+            _write_address(node.gcs_address, os.getpid())
+            print(f"ray_tpu head started; address={node.gcs_address}")
+            if args.dashboard_port:
+                import ray_tpu
+                from ray_tpu.dashboard import start_dashboard
+
+                ray_tpu.init(address=node.gcs_address)
+                dash = start_dashboard(port=args.dashboard_port)
+                print(f"dashboard: "
+                      f"http://127.0.0.1:{args.dashboard_port}")
+        else:
+            print(f"ray_tpu node started; joined {node.gcs_address}")
+
+        # Both modes stay resident and tear the node down on
+        # SIGTERM/SIGINT (`stop` sends SIGTERM).
+        if not args.block:
+            print("(head process stays resident; `stop` tears it down)")
+        stop = []
+        signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+        signal.signal(signal.SIGINT, lambda *a: stop.append(1))
         while not stop:
             time.sleep(0.5)
     finally:
+        if dash is not None:
+            dash.stop()
         node.shutdown()
 
 
@@ -194,6 +208,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--num-tpus", type=float)
     sp.add_argument("--resources", nargs="*",
                     help="extra resources, e.g. TPU-v5e-8-head=1")
+    sp.add_argument("--dashboard-port", type=int, default=8265,
+                    help="0 disables the dashboard")
     sp.add_argument("--block", action="store_true")
     sp.set_defaults(fn=cmd_start)
 

@@ -1,0 +1,88 @@
+"""User-facing metrics API: Counter / Gauge / Histogram.
+
+Reference: python/ray/util/metrics.py (same three classes, same
+tag_keys/default-tags shape) over the native stats registry
+(src/ray/stats/). Metrics recorded in any worker flow to the GCS and are
+exposed as Prometheus text by the dashboard (/metrics).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private import metrics as _impl
+
+
+class _Base:
+    _kind = ""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        if not name:
+            raise ValueError("metric name is required")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        _impl.ensure_pusher()
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        bad = set(tags) - set(self._tag_keys)
+        if bad:
+            raise ValueError(f"tags {sorted(bad)} not in tag_keys")
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        merged = dict(self._default_tags)
+        if tags:
+            bad = set(tags) - set(self._tag_keys)
+            if bad:
+                raise ValueError(f"tags {sorted(bad)} not in tag_keys")
+            merged.update(tags)
+        return merged
+
+    @property
+    def info(self) -> Dict[str, object]:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys,
+                "default_tags": dict(self._default_tags)}
+
+
+class Counter(_Base):
+    _kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value <= 0:
+            raise ValueError("Counter.inc value must be positive")
+        m = _impl.register(self._name, "counter", self._description,
+                           self._merged(tags))
+        _impl.record(m, value, "counter")
+
+
+class Gauge(_Base):
+    _kind = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        m = _impl.register(self._name, "gauge", self._description,
+                           self._merged(tags))
+        _impl.record(m, value, "gauge")
+
+
+class Histogram(_Base):
+    _kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = list(
+            boundaries or _impl.DEFAULT_HISTOGRAM_BOUNDARIES)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        m = _impl.register(self._name, "histogram", self._description,
+                           self._merged(tags), self._boundaries)
+        _impl.record(m, value, "histogram")

@@ -1,0 +1,29 @@
+"""Profile events — user-annotated spans in the cluster timeline.
+
+Reference: src/ray/core_worker/profile_event.h (ProfileEvent buffered in
+TaskEventBuffer) + python `ray.timeline`. Spans recorded inside any task
+or actor flush through the same task-event pipeline and appear in
+`ray_tpu.util.timeline.timeline()` Chrome traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+
+@contextlib.contextmanager
+def profile(name: str, extra: Optional[dict] = None):
+    """``with profile("shuffle"):`` — records a span on the timeline."""
+    start = time.time()
+    try:
+        yield
+    finally:
+        end = time.time()
+        from ray_tpu._private.worker import global_worker_or_none
+
+        worker = global_worker_or_none()
+        if worker is not None:
+            worker.core.record_profile_event(name, start, end,
+                                             extra or {})

@@ -62,6 +62,8 @@ def list_tasks(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
     # Workers flush on independent cadences; GCS arrival order is not
     # event order. Merge by per-event timestamp.
     events = sorted(events, key=lambda e: e.get("time", 0.0))
+    # Profile spans ride the same pipeline but are not tasks.
+    events = [e for e in events if e.get("state") != "PROFILE"]
     by_task: Dict[str, Dict[str, Any]] = {}
     for ev in events:
         tid = ev.get("task_id")

@@ -24,7 +24,20 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         tid = ev["task_id"]
         tid = tid.hex() if isinstance(tid, bytes) else str(tid)
         state = ev.get("state")
-        if state == "RUNNING":
+        if state == "PROFILE":
+            worker = ev.get("worker_id", b"")
+            worker = worker.hex() if isinstance(worker, bytes) else worker
+            trace.append({
+                "name": ev.get("name", "span"),
+                "cat": "profile",
+                "ph": "X",
+                "ts": ev["time"] * 1e6,
+                "dur": (ev.get("end_time", ev["time"]) - ev["time"]) * 1e6,
+                "pid": worker[:8],
+                "tid": worker[:8],
+                "args": ev.get("extra", {}),
+            })
+        elif state == "RUNNING":
             running[tid] = ev
         elif state in ("FINISHED", "FAILED") and tid in running:
             start = running.pop(tid)

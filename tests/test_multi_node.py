@@ -212,3 +212,45 @@ class TestFaultTolerance:
 
         assert ray_tpu.get(flaky.remote(time.time()), timeout=60) == \
             "recovered"
+
+
+def test_workers_exit_when_raylet_dies(ray_start_cluster):
+    """A SIGKILLed raylet must not orphan its worker processes: workers
+    exit when the raylet connection drops (reference: workers die with
+    their raylet socket)."""
+    import subprocess
+    import time
+
+    import ray_tpu
+
+    if ray_tpu.is_initialized():  # module-scoped fixture may be live
+        ray_tpu.shutdown()
+    cluster = ray_start_cluster()
+    cluster.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=cluster.address)
+    node = cluster.add_node(resources={"CPU": 2, "mark": 1})
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(resources={"mark": 1})
+    def pidof():
+        import os
+
+        return os.getpid()
+
+    worker_pid = ray_tpu.get(pidof.remote(), timeout=30)
+
+    def alive(pid):
+        try:
+            import os
+
+            os.kill(pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+
+    assert alive(worker_pid)
+    cluster.remove_node(node)  # SIGKILLs that raylet
+    deadline = time.time() + 15
+    while time.time() < deadline and alive(worker_pid):
+        time.sleep(0.3)
+    assert not alive(worker_pid), "worker orphaned after raylet death"

@@ -1,0 +1,106 @@
+"""Native object-transfer plane tests.
+
+Reference test model: object manager push/pull tests — bytes must move
+store-to-store intact; cross-node ray_tpu.get must use the native path
+(asserted via raylet transfer ports registered in the GCS).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.ids import ObjectID
+
+
+def test_transfer_store_to_store(tmp_path):
+    from ray_tpu.core import shm_client as sc
+    from ray_tpu.core import transfer_client as tc
+
+    src_path = str(tmp_path / "src_store")
+    dst_path = str(tmp_path / "dst_store")
+    sc.ShmClient.create_store(src_path, capacity=1 << 20)
+    sc.ShmClient.create_store(dst_path, capacity=1 << 20)
+
+    src = sc.ShmClient(src_path)
+    dst = sc.ShmClient(dst_path)
+    oid = ObjectID.from_random()
+    payload = os.urandom(200_000)
+    src.put_bytes(oid, payload)
+
+    server = tc.TransferServer(src_path)
+    try:
+        rc = tc.fetch(dst_path, "127.0.0.1", server.port, oid.binary())
+        assert rc == tc.FETCH_OK
+        buf = dst.get(oid, timeout_ms=1000)
+        assert bytes(buf.data) == payload
+        buf.release()
+        # Second fetch: already local.
+        rc = tc.fetch(dst_path, "127.0.0.1", server.port, oid.binary())
+        assert rc == tc.FETCH_ALREADY_LOCAL
+        # Missing object: remote miss.
+        rc = tc.fetch(dst_path, "127.0.0.1", server.port,
+                      ObjectID.from_random().binary())
+        assert rc == tc.FETCH_REMOTE_MISS
+    finally:
+        server.stop()
+        src.close()
+        dst.close()
+
+
+def test_cross_node_get_uses_native_plane(ray_start_cluster):
+    cluster = ray_start_cluster()
+    cluster.add_node(resources={"CPU": 2})
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(address=cluster.address)
+    cluster.add_node(resources={"CPU": 2, "far": 1})
+    cluster.wait_for_nodes(2)
+
+    # Both raylets registered native transfer ports.
+    from ray_tpu.util import state
+
+    nodes = state.list_nodes()
+    assert all(n.get("transfer_port", 0) > 0 for n in nodes
+               if n["state"] == "ALIVE")
+
+    @ray_tpu.remote(resources={"far": 1})
+    def produce():
+        return np.arange(500_000, dtype=np.float32)
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(x):
+        return float(x.sum())
+
+    ref = produce.remote()
+    # Consume on the head node -> cross-node pull through the native plane.
+    out = ray_tpu.get(consume.options(
+        scheduling_strategy=None).remote(ref), timeout=60)
+    expected = float(np.arange(500_000, dtype=np.float32).sum())
+    assert out == expected
+
+
+def test_store_distinguishes_return_indices(tmp_path):
+    """ObjectIDs differing only in the 4-byte return index must key
+    distinct store slots (kIdSize covers the FULL 24-byte id)."""
+    from ray_tpu.core import shm_client as sc
+    from ray_tpu.core.ids import ObjectID, TaskID
+
+    path = str(tmp_path / "store")
+    sc.ShmClient.create_store(path, capacity=1 << 20)
+    client = sc.ShmClient(path)
+    task = TaskID.from_random()
+    import struct
+
+    oid0 = ObjectID(task.binary() + struct.pack(">I", 0))
+    oid1 = ObjectID(task.binary() + struct.pack(">I", 1))
+    client.put_bytes(oid0, b"return-zero")
+    client.put_bytes(oid1, b"return-one")
+    b0 = client.get(oid0, timeout_ms=1000)
+    b1 = client.get(oid1, timeout_ms=1000)
+    assert bytes(b0.data) == b"return-zero"
+    assert bytes(b1.data) == b"return-one"
+    b0.release()
+    b1.release()
+    client.close()

@@ -1,0 +1,117 @@
+"""Metrics, profiling spans, dashboard tests.
+
+Reference test model: python/ray/tests/test_metrics_agent.py (metric
+pipeline through to Prometheus text) and dashboard endpoint tests.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+
+def test_metrics_flow_to_gcs(ray_start_regular):
+    from ray_tpu._private import metrics as impl
+
+    c = Counter("unit_requests", description="reqs", tag_keys=("route",))
+    c.inc(2.0, {"route": "/a"})
+    c.inc(3.0, {"route": "/a"})
+    g = Gauge("unit_inflight")
+    g.set(7.0)
+    h = Histogram("unit_latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    impl.flush_now()
+
+    from ray_tpu._private.worker import global_worker
+
+    rows = global_worker().gcs_call("get_metrics")
+    by_name = {r["name"]: r for r in rows}
+    assert by_name["unit_requests"]["value"] == 5.0
+    assert by_name["unit_inflight"]["value"] == 7.0
+    hist = by_name["unit_latency"]
+    assert hist["count"] == 3
+    assert hist["bucket_counts"] == [1, 1, 1]
+
+
+def test_metrics_from_remote_worker(ray_start_regular):
+    @ray_tpu.remote
+    def work():
+        from ray_tpu._private import metrics as impl
+
+        Counter("unit_worker_counter").inc(4.0)
+        impl.flush_now()
+        return True
+
+    ray_tpu.get(work.remote())
+    from ray_tpu._private.worker import global_worker
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        rows = global_worker().gcs_call("get_metrics")
+        by_name = {r["name"]: r for r in rows}
+        if "unit_worker_counter" in by_name:
+            break
+        time.sleep(0.2)
+    assert by_name["unit_worker_counter"]["value"] == 4.0
+
+
+def test_profile_spans_in_timeline(ray_start_regular):
+    from ray_tpu.util.profiling import profile
+    from ray_tpu.util.timeline import timeline
+
+    @ray_tpu.remote
+    def traced():
+        with profile("expensive_section", {"k": "v"}):
+            time.sleep(0.05)
+        return True
+
+    ray_tpu.get(traced.remote())
+    deadline = time.time() + 5
+    spans = []
+    while time.time() < deadline and not spans:
+        time.sleep(0.3)
+        spans = [e for e in timeline()
+                 if e.get("cat") == "profile" and
+                 e["name"] == "expensive_section"]
+    assert spans, "profile span did not reach the timeline"
+    assert spans[0]["dur"] >= 0.04 * 1e6
+
+
+def test_dashboard_endpoints(ray_start_regular):
+    from ray_tpu.dashboard import start_dashboard
+
+    Counter("unit_dash_counter").inc(1.0)
+    from ray_tpu._private import metrics as impl
+
+    impl.flush_now()
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    dash = start_dashboard(port=port)
+    try:
+        base = f"http://127.0.0.1:{port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=5) as r:
+                return r.read().decode()
+
+        assert get("/healthz") == "success"
+        status = json.loads(get("/api/cluster_status"))
+        assert status["nodes_alive"] >= 1
+        nodes = json.loads(get("/api/nodes"))
+        assert len(nodes) >= 1
+        metrics_text = get("/metrics")
+        assert "ray_tpu_unit_dash_counter" in metrics_text
+        summary = json.loads(get("/api/tasks/summary"))
+        assert isinstance(summary, dict)
+    finally:
+        dash.stop()
