@@ -415,7 +415,6 @@ int shm_delete(void* handle, const uint8_t* id) {
   return OK;
 }
 
-// 1 if sealed-present, 0 otherwise.
 // Raw pointer into the mapped arena (offset from shm_create/shm_get).
 // Valid while the object stays pinned — used by the native transfer
 // plane to stream object bytes without copies through Python.
@@ -424,6 +423,7 @@ uint8_t* shm_data_pointer(void* handle, uint64_t offset) {
   return st->base + offset;
 }
 
+// 1 if sealed-present, 0 otherwise.
 int shm_contains(void* handle, const uint8_t* id) {
   Handle* st = reinterpret_cast<Handle*>(handle);
   Header* h = st->hdr;

@@ -9,7 +9,7 @@
 // fetch from WHERE; the bytes never enter the interpreter.
 //
 // Protocol (one object per connection, receiver-initiated pull):
-//   request : u64 magic | u8 id[20]
+//   request : u64 magic | u8 id[kIdSize=24]
 //   response: u32 status (0=ok, 1=not found) | u64 size | payload bytes
 //
 // Build: compiled together with shm_store.cpp into libray_tpu_transfer.so.
@@ -26,6 +26,8 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <string>
+#include <unordered_map>
 
 // From shm_store.cpp (same shared library).
 extern "C" {
@@ -89,8 +91,29 @@ struct Server {
   void* store = nullptr;
   int listen_fd = -1;
   std::atomic<bool> stop{false};
+  std::atomic<int> active_handlers{0};
   pthread_t thread{};
 };
+
+// Client-side store handles are opened once per (process, path) and kept
+// for the process lifetime: a pull must not pay mmap/munmap per object.
+pthread_mutex_t g_client_stores_mu = PTHREAD_MUTEX_INITIALIZER;
+std::unordered_map<std::string, void*>* g_client_stores = nullptr;
+
+void* client_store(const char* path) {
+  pthread_mutex_lock(&g_client_stores_mu);
+  if (!g_client_stores) {
+    g_client_stores = new std::unordered_map<std::string, void*>();
+  }
+  auto it = g_client_stores->find(path);
+  void* handle = (it != g_client_stores->end()) ? it->second : nullptr;
+  if (!handle) {
+    handle = shm_store_open(path);
+    if (handle) (*g_client_stores)[path] = handle;
+  }
+  pthread_mutex_unlock(&g_client_stores_mu);
+  return handle;
+}
 
 struct ConnTask {
   Server* server;
@@ -102,6 +125,12 @@ void* handle_conn(void* arg) {
   int fd = task->fd;
   Server* server = task->server;
   delete task;
+  // active_handlers was incremented by the accept loop BEFORE spawning
+  // us; obj_transfer_stop waits for it to drain before freeing server.
+  struct Guard {
+    Server* s;
+    ~Guard() { s->active_handlers.fetch_sub(1); }
+  } guard{server};
 
   uint64_t magic = 0;
   uint8_t id[kIdSize];
@@ -143,9 +172,11 @@ void* accept_loop(void* arg) {
     set_io_timeouts(fd);
     pthread_t t;
     ConnTask* task = new ConnTask{server, fd};
+    server->active_handlers.fetch_add(1);
     if (pthread_create(&t, nullptr, handle_conn, task) == 0) {
       pthread_detach(t);
     } else {
+      server->active_handlers.fetch_sub(1);
       delete task;
       close(fd);
     }
@@ -202,6 +233,13 @@ void obj_transfer_stop(void* server_ptr) {
   shutdown(server->listen_fd, SHUT_RDWR);
   close(server->listen_fd);
   pthread_join(server->thread, nullptr);
+  // Detached handlers may still be streaming; wait for them to drain
+  // (each socket op is bounded by kIoTimeoutSec) before freeing the
+  // store they read from.
+  for (int i = 0; i < (kIoTimeoutSec + 5) * 100; i++) {
+    if (server->active_handlers.load() == 0) break;
+    usleep(10 * 1000);
+  }
   shm_store_close(server->store);
   delete server;
 }
@@ -210,14 +248,11 @@ void obj_transfer_stop(void* server_ptr) {
 // Returns 0 ok, 1 remote miss, 2 local exists (fine), -errno on I/O error.
 int obj_transfer_fetch(const char* store_path, const char* host, int port,
                        const uint8_t* id) {
-  void* store = shm_store_open(store_path);
+  void* store = client_store(store_path);  // cached per-process handle
   if (!store) return -EINVAL;
 
   int fd = socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) {
-    shm_store_close(store);
-    return -errno;
-  }
+  if (fd < 0) return -errno;
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   set_io_timeouts(fd);  // SO_SNDTIMEO also bounds connect() on Linux
@@ -226,13 +261,11 @@ int obj_transfer_fetch(const char* store_path, const char* host, int port,
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
     close(fd);
-    shm_store_close(store);
     return -EINVAL;
   }
   if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
     int e = errno;
     close(fd);
-    shm_store_close(store);
     return -e;
   }
   int result = -EIO;
@@ -267,7 +300,6 @@ int obj_transfer_fetch(const char* store_path, const char* host, int port,
   } while (false);
   if (created) shm_abort(store, id);
   close(fd);
-  shm_store_close(store);
   return result;
 }
 

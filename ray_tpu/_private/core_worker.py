@@ -944,6 +944,32 @@ class CoreWorker:
     def _execute_user_code(self, fn: Callable, args: tuple, kwargs: dict):
         return fn(*args, **kwargs)
 
+    def _sync_gcs_call(self, method: str, data=None):
+        """GCS call usable from executor threads (runtime_env fetch).
+        MUST NOT be called on the event-loop thread (would deadlock) —
+        _prefetch_runtime_env materializes packages off-loop first."""
+        fut = asyncio.run_coroutine_threadsafe(
+            self.gcs.call(method, data), self.loop)
+        return fut.result(timeout=60.0)
+
+    async def _prefetch_runtime_env(self, runtime_env) -> None:
+        """Materialize env packages in an executor thread so the (sync)
+        apply step on the loop thread only hits warm caches."""
+        if not runtime_env:
+            return
+        uris = []
+        if runtime_env.get("working_dir"):
+            uris.append(runtime_env["working_dir"])
+        uris.extend(runtime_env.get("py_modules") or [])
+        if not uris:
+            return
+        from ray_tpu._private.runtime_env import _materialize
+
+        loop = asyncio.get_running_loop()
+        for uri in uris:
+            await loop.run_in_executor(
+                None, _materialize, uri, self._sync_gcs_call)
+
     async def _run_sync(self, fn, *args):
         return await asyncio.get_running_loop().run_in_executor(
             self._executor, fn, *args)
@@ -958,11 +984,21 @@ class CoreWorker:
 
     async def _execute_normal_task(self, spec: TaskSpec) -> dict:
         try:
-            fn = await self._fetch_function(spec.function)
-            args, kwargs = await self._resolve_args(spec)
-            self._current_task = spec
-            result = await self._run_sync(
-                lambda: self._execute_user_code(fn, args, kwargs))
+            # The env must be live BEFORE function unpickle and argument
+            # deserialization: shipped py_modules/working_dir code may be
+            # referenced by the pickled payloads themselves. Safe to span
+            # the awaits: a leased worker executes one normal task at a
+            # time (max_tasks_in_flight_per_worker).
+            from ray_tpu._private.runtime_env import applied_runtime_env
+
+            await self._prefetch_runtime_env(spec.runtime_env)
+            with applied_runtime_env(spec.runtime_env,
+                                     self._sync_gcs_call):
+                fn = await self._fetch_function(spec.function)
+                args, kwargs = await self._resolve_args(spec)
+                self._current_task = spec
+                result = await self._run_sync(
+                    lambda: self._execute_user_code(fn, args, kwargs))
             return await self._store_returns(spec, result)
         except Exception as e:
             return await self._store_exception(spec, e)
@@ -971,6 +1007,16 @@ class CoreWorker:
 
     async def _execute_actor_creation(self, spec: TaskSpec) -> dict:
         try:
+            # Actor workers are dedicated to their actor: apply the env
+            # permanently (visible to sync AND async methods, no
+            # save/restore races under max_concurrency>1) — and BEFORE
+            # unpickling, whose payloads may reference shipped modules.
+            from ray_tpu._private.runtime_env import \
+                apply_runtime_env_permanent
+
+            await self._prefetch_runtime_env(spec.runtime_env)
+            apply_runtime_env_permanent(spec.runtime_env,
+                                        self._sync_gcs_call)
             cls = await self._fetch_function(spec.function)
             args, kwargs = await self._resolve_args(spec)
             creation = spec.actor_creation_spec or {}
@@ -1013,8 +1059,10 @@ class CoreWorker:
                 if asyncio.iscoroutinefunction(method):
                     result = await method(*args, **kwargs)
                 else:
+                    # Actor env was applied permanently at creation.
                     result = await self._run_sync(
-                        lambda: self._execute_user_code(method, args, kwargs))
+                        lambda: self._execute_user_code(method, args,
+                                                        kwargs))
                 return await self._store_returns(spec, result)
             except Exception as e:
                 return await self._store_exception(spec, e)

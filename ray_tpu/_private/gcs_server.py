@@ -136,6 +136,9 @@ class GcsServer:
         self.task_events: List[dict] = []
         # worker_id -> {"metrics": [...], "time": t}
         self.worker_metrics: Dict[bytes, dict] = {}
+        # Counters/histograms folded in from dead workers — counter
+        # totals must stay monotonic across worker churn.
+        self.retired_metrics: Dict[tuple, dict] = {}
         self.subscribers: Dict[str, Set[rpc.Connection]] = {}
         self._next_job = 0
         self._server: Optional[rpc.Server] = None
@@ -416,7 +419,7 @@ class GcsServer:
     async def handle_report_worker_death(self, data, conn) -> bool:
         """Raylet reports a dead worker; fail any actor hosted there."""
         if data.get("worker_id"):
-            self.worker_metrics.pop(data["worker_id"], None)
+            self._retire_worker_metrics(data["worker_id"])
         actor_id = data.get("actor_id")
         if actor_id:
             actor = self.actors.get(ActorID(actor_id))
@@ -713,6 +716,33 @@ class GcsServer:
         return self.task_events[-limit:]
 
     # ------------------------------------------------------------- metrics
+    def _retire_worker_metrics(self, worker_id: bytes) -> None:
+        """Fold a dead worker's counters/histograms into the persistent
+        retired totals (monotonicity across worker churn); drop gauges."""
+        entry = self.worker_metrics.pop(worker_id, None)
+        if not entry:
+            return
+        for m in entry["metrics"]:
+            if m["kind"] == "gauge":
+                continue
+            key = (m["name"], tuple(sorted(m["tags"].items())))
+            cur = self.retired_metrics.get(key)
+            if cur is None:
+                cur = self.retired_metrics[key] = dict(m)
+                cur["bucket_counts"] = list(m.get("bucket_counts", []))
+                continue
+            if m["kind"] == "counter":
+                cur["value"] += m["value"]
+            else:
+                cur["sum"] = cur.get("sum", 0) + m.get("sum", 0)
+                cur["count"] = cur.get("count", 0) + m.get("count", 0)
+                mine = cur["bucket_counts"]
+                for i, c in enumerate(m.get("bucket_counts", [])):
+                    if i < len(mine):
+                        mine[i] += c
+                    else:
+                        mine.append(c)
+
     async def handle_report_metrics(self, data, conn) -> bool:
         """Latest metric snapshots per reporting worker (reference: node
         metrics agents feeding OpenCensusProxyCollector)."""
@@ -723,13 +753,21 @@ class GcsServer:
     async def handle_get_metrics(self, data, conn) -> list:
         """Aggregate across workers: counters/histograms sum, gauges take
         the latest value per tag set."""
-        # Prune snapshots from workers that stopped reporting (dead
-        # workers/nodes); healthy pushers report on a ~2s cadence.
+        # Workers that stopped reporting (dead workers/nodes; healthy
+        # pushers report ~2s) get their counters/histograms FOLDED into
+        # the retired totals — dropping them would make aggregated
+        # counters go backwards. Gauges from dead workers are dropped.
         cutoff = time.time() - 30.0
         for wid in [w for w, e in self.worker_metrics.items()
                     if e["time"] < cutoff]:
-            del self.worker_metrics[wid]
+            self._retire_worker_metrics(wid)
         agg: Dict[tuple, dict] = {}
+        for snap in self.retired_metrics.values():
+            key = (snap["name"], tuple(sorted(snap["tags"].items())))
+            cur = dict(snap)
+            cur["bucket_counts"] = list(snap.get("bucket_counts", []))
+            cur["_t"] = 0.0
+            agg[key] = cur
         for entry in self.worker_metrics.values():
             for m in entry["metrics"]:
                 key = (m["name"], tuple(sorted(m["tags"].items())))

@@ -1,0 +1,61 @@
+"""Node memory monitor + OOM worker-killing policy.
+
+Reference: src/ray/common/memory_monitor.h + raylet worker-killing
+policies (worker_killing_policy.h:34 — retriable-FIFO: kill the most
+recently started retriable work first, so long-running work survives).
+The raylet polls usage; past the threshold it kills the newest leased
+worker (its task retries per max_retries) before the kernel OOM killer
+takes down the raylet itself.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+
+def get_system_memory_bytes() -> Tuple[int, int]:
+    """(used, total) honoring cgroup v2 limits when present (containers)."""
+    total = used = 0
+    try:
+        with open("/proc/meminfo") as f:
+            info = {}
+            for line in f:
+                parts = line.split()
+                info[parts[0].rstrip(":")] = int(parts[1]) * 1024
+        total = info.get("MemTotal", 0)
+        available = info.get("MemAvailable", 0)
+        used = total - available
+    except OSError:
+        return 0, 0
+    # cgroup v2: a tighter container limit wins.
+    try:
+        with open("/sys/fs/cgroup/memory.max") as f:
+            raw = f.read().strip()
+        if raw != "max":
+            cg_total = int(raw)
+            if 0 < cg_total < total:
+                with open("/sys/fs/cgroup/memory.current") as f:
+                    cg_used = int(f.read().strip())
+                return cg_used, cg_total
+    except (OSError, ValueError):
+        pass
+    return used, total
+
+
+def memory_usage_fraction() -> float:
+    used, total = get_system_memory_bytes()
+    if total <= 0:
+        return 0.0
+    return used / total
+
+
+def pick_worker_to_kill(workers) -> Optional[object]:
+    """Retriable-FIFO analog: newest leased worker first (its lease began
+    last, so the least progress is lost and its task retries); never the
+    raylet's idle pool, never actors (actor restart is heavier — the
+    reference's group-by-owner policy also deprioritizes them)."""
+    leased = [w for w in workers if w.state == "leased"]
+    if leased:
+        return max(leased, key=lambda w: getattr(w, "lease_started", 0.0))
+    return None

@@ -134,6 +134,9 @@ class Raylet:
         self._bg.append(asyncio.get_event_loop().create_task(self._reap_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._spill_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._drain_loop()))
+        if self.config.memory_monitor_refresh_ms > 0:
+            self._bg.append(asyncio.get_event_loop().create_task(
+                self._memory_monitor_loop()))
         logger.info("raylet %s on %s resources=%s",
                     self.node_id.hex()[:8], self.address, self.resources_total)
         return port
@@ -195,6 +198,33 @@ class Raylet:
                     return
             await asyncio.sleep(
                 min(self.config.health_check_period_ms / 2, 100) / 1000)
+
+    async def _memory_monitor_loop(self) -> None:
+        """Kill the newest leased worker when node memory crosses the
+        threshold (reference: MemoryMonitor + retriable-FIFO policy) —
+        shed load before the kernel OOM killer shoots the raylet."""
+        from ray_tpu._private.memory_monitor import (memory_usage_fraction,
+                                                     pick_worker_to_kill)
+
+        period = self.config.memory_monitor_refresh_ms / 1000.0
+        while not self.dead:
+            await asyncio.sleep(period)
+            try:
+                frac = memory_usage_fraction()
+                if frac <= self.config.memory_usage_threshold:
+                    continue
+                victim = pick_worker_to_kill(self.workers.values())
+                if victim is None:
+                    continue
+                logger.warning(
+                    "memory usage %.1f%% > %.1f%%: killing worker %s "
+                    "(its task will retry)", frac * 100,
+                    self.config.memory_usage_threshold * 100,
+                    victim.worker_id.hex()[:12])
+                await self._kill_worker(
+                    victim, f"node OOM: memory usage {frac:.2%}")
+            except Exception:
+                logger.exception("memory monitor iteration failed")
 
     async def _drain_loop(self) -> None:
         """Periodic queue re-evaluation (cluster view changes over time)."""
@@ -434,6 +464,7 @@ class Raylet:
         worker.state = "leased"
         worker.lease_id = req.lease_id
         worker.job_id = req.job_id
+        worker.lease_started = time.monotonic()
         self.leases[req.lease_id] = (worker, dict(req.resources), bundle_key)
         req.grant_fut.set_result({
             "granted": True,
@@ -598,7 +629,9 @@ class Raylet:
         # Fast path: native store-to-store streaming (transfer.cpp) — no
         # Python on the data plane. Falls back to rpc chunks if the remote
         # has no transfer server or the native pull fails.
-        if transfer_port and self.transfer_server is not None:
+        # The fetch client opens the local store itself — the remote's
+        # transfer_port is all that matters.
+        if transfer_port:
             host = address.rsplit(":", 1)[0]
             try:
                 from ray_tpu.core import transfer_client as tc

@@ -1,0 +1,239 @@
+"""Runtime environments: per-task/actor execution environments.
+
+Reference: python/ray/_private/runtime_env/ (plugins: env_vars,
+working_dir, py_modules, pip/conda; per-node agent with URI caching,
+uri_cache.py; packaging = zips in the GCS KV). Simplification, same
+contract: the driver packages local dirs into content-addressed zips in
+the GCS KV; workers materialize them once per node into a shared cache
+and apply the env (env vars, sys.path, cwd) around user-code execution.
+
+pip/conda are accepted but gated: this deployment is hermetic (no
+package index), so requirements raise unless RAY_TPU_ALLOW_PIP=1
+explicitly opts into a live `pip install`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import zipfile
+from typing import Any, Dict, List, Optional
+
+_KV_NS = b"runtime_env_pkg"
+_CACHE_ROOT = os.path.join(
+    os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu"), "runtime_envs")
+_cache_lock = threading.Lock()
+_materialized: Dict[str, str] = {}  # uri -> extracted dir
+
+EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+
+
+# Driver-side upload memo: abspath -> (dir signature, uri). The signature
+# (file count + newest mtime + total size) is a cheap walk; only a changed
+# dir re-zips and re-uploads (reference: upload cache in packaging.py).
+_upload_cache: Dict[str, tuple] = {}
+
+
+def _dir_signature(path: str) -> tuple:
+    count = 0
+    newest = 0.0
+    total = 0
+    for root, dirs, files in os.walk(path):
+        dirs[:] = [d for d in dirs if d not in EXCLUDE_DIRS]
+        for name in files:
+            try:
+                st = os.stat(os.path.join(root, name))
+            except OSError:
+                continue
+            count += 1
+            total += st.st_size
+            newest = max(newest, st.st_mtime)
+    return (count, total, newest)
+
+
+def package_local_dir(path: str, gcs_call) -> str:
+    """Zip `path` and store it in the GCS KV under a content hash.
+    Returns the package URI (reference: packaging.py upload_package)."""
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env dir {path!r} does not exist")
+    sig = _dir_signature(path)
+    with _cache_lock:
+        cached = _upload_cache.get(path)
+        if cached and cached[0] == sig:
+            return cached[1]
+    buf = tempfile.SpooledTemporaryFile(max_size=MAX_PACKAGE_BYTES)
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in EXCLUDE_DIRS]
+            for name in files:
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, path)
+                zf.write(full, rel)
+    buf.seek(0)
+    blob = buf.read()
+    if len(blob) > MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} exceeds "
+            f"{MAX_PACKAGE_BYTES >> 20} MiB")
+    digest = hashlib.sha1(blob).hexdigest()
+    uri = f"gcs://{digest}"
+    gcs_call("kv_put", {"ns": _KV_NS, "key": digest.encode(),
+                        "value": blob, "overwrite": False})
+    with _cache_lock:
+        _upload_cache[path] = (sig, uri)
+    return uri
+
+
+def prepare_runtime_env(runtime_env: Optional[dict],
+                        gcs_call) -> Optional[dict]:
+    """Driver-side: replace local paths with uploaded package URIs.
+    Called at task/actor submission (reference: runtime_env validation +
+    upload in remote_function/actor options plumbing)."""
+    if not runtime_env:
+        return runtime_env
+    env = dict(runtime_env)
+    wd = env.get("working_dir")
+    if wd and not str(wd).startswith("gcs://"):
+        env["working_dir"] = package_local_dir(wd, gcs_call)
+    mods = env.get("py_modules")
+    if mods:
+        env["py_modules"] = [
+            m if str(m).startswith("gcs://")
+            else package_local_dir(m, gcs_call)
+            for m in mods]
+    return env
+
+
+def _materialize(uri: str, gcs_call) -> str:
+    """Download+extract a package URI once per node (uri_cache.py)."""
+    with _cache_lock:
+        cached = _materialized.get(uri)
+        if cached and os.path.isdir(cached):
+            return cached
+    digest = uri[len("gcs://"):]
+    dest = os.path.join(_CACHE_ROOT, digest)
+    done_marker = os.path.join(dest, ".ray_tpu_ready")
+    if not os.path.exists(done_marker):
+        blob = gcs_call("kv_get", {"ns": _KV_NS, "key": digest.encode()})
+        if blob is None:
+            raise RuntimeError(f"runtime_env package {uri} not in GCS")
+        tmp = dest + f".tmp.{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+        import io
+
+        with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+            zf.extractall(tmp)
+        open(os.path.join(tmp, ".ray_tpu_ready"), "w").close()
+        try:
+            os.replace(tmp, dest)
+        except OSError:
+            # Another worker won the race; use theirs.
+            shutil.rmtree(tmp, ignore_errors=True)
+            if not os.path.exists(done_marker):
+                raise
+    with _cache_lock:
+        _materialized[uri] = dest
+    return dest
+
+
+_pip_installed: set = set()
+
+
+def _check_pip(env: dict) -> None:
+    reqs = env.get("pip")
+    if not reqs:
+        return
+    if os.environ.get("RAY_TPU_ALLOW_PIP") != "1":
+        raise RuntimeError(
+            "runtime_env['pip'] requested but this deployment is hermetic "
+            "(no package index). Set RAY_TPU_ALLOW_PIP=1 to attempt a "
+            "live `pip install`, or bake dependencies into the image.")
+    if isinstance(reqs, dict):
+        reqs = reqs.get("packages", [])
+    key = tuple(sorted(map(str, reqs)))
+    with _cache_lock:
+        if key in _pip_installed:
+            return
+    subprocess.run([sys.executable, "-m", "pip", "install", *reqs],
+                   check=True)
+    with _cache_lock:
+        _pip_installed.add(key)
+
+
+@contextlib.contextmanager
+def applied_runtime_env(runtime_env: Optional[dict], gcs_call):
+    """Worker-side: apply env vars / working_dir / py_modules around user
+    code, restoring afterwards (workers are shared across envs here,
+    unlike the reference's dedicated-worker model — restore is required).
+    """
+    if not runtime_env:
+        yield
+        return
+    if runtime_env.get("conda"):
+        raise RuntimeError(
+            "runtime_env['conda'] is not supported in this deployment "
+            "(hermetic image); use the baked environment or py_modules.")
+    _check_pip(runtime_env)
+
+    saved_env: Dict[str, Optional[str]] = {}
+    saved_cwd = os.getcwd()
+    added_paths: List[str] = []
+    try:
+        for key, value in (runtime_env.get("env_vars") or {}).items():
+            saved_env[key] = os.environ.get(key)
+            os.environ[key] = str(value)
+        wd_uri = runtime_env.get("working_dir")
+        if wd_uri:
+            wd = _materialize(wd_uri, gcs_call)
+            os.chdir(wd)
+            sys.path.insert(0, wd)
+            added_paths.append(wd)
+        for uri in runtime_env.get("py_modules") or []:
+            mod_dir = _materialize(uri, gcs_call)
+            sys.path.insert(0, mod_dir)
+            added_paths.append(mod_dir)
+        yield
+    finally:
+        for p in added_paths:
+            with contextlib.suppress(ValueError):
+                sys.path.remove(p)
+        with contextlib.suppress(OSError):
+            os.chdir(saved_cwd)
+        for key, old in saved_env.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+
+def apply_runtime_env_permanent(runtime_env: Optional[dict],
+                                gcs_call) -> None:
+    """Apply without restore — for actor workers, which are DEDICATED to
+    their actor for the process lifetime (matching the reference's
+    dedicated-worker-per-env model). Permanent application makes the env
+    visible to sync AND async methods and is safe under
+    max_concurrency>1 (no save/restore races)."""
+    if not runtime_env:
+        return
+    if runtime_env.get("conda"):
+        raise RuntimeError(
+            "runtime_env['conda'] is not supported in this deployment")
+    _check_pip(runtime_env)
+    for key, value in (runtime_env.get("env_vars") or {}).items():
+        os.environ[key] = str(value)
+    wd_uri = runtime_env.get("working_dir")
+    if wd_uri:
+        wd = _materialize(wd_uri, gcs_call)
+        os.chdir(wd)
+        sys.path.insert(0, wd)
+    for uri in runtime_env.get("py_modules") or []:
+        sys.path.insert(0, _materialize(uri, gcs_call))

@@ -86,11 +86,22 @@ class Worker:
     def reference_counter(self):
         return self.core.reference_counter
 
+    def _prepare_env_opts(self, opts) -> dict:
+        if opts.get("runtime_env"):
+            from ray_tpu._private.runtime_env import prepare_runtime_env
+
+            opts = dict(opts)
+            opts["runtime_env"] = prepare_runtime_env(
+                opts["runtime_env"], self.gcs_call)
+        return opts
+
     def submit_task(self, descriptor, args, kwargs, opts) -> List[ObjectRef]:
+        opts = self._prepare_env_opts(opts)
         return self._run(
             self.core.submit_task(descriptor, args, kwargs, opts))
 
     def create_actor(self, descriptor, args, kwargs, opts) -> ActorID:
+        opts = self._prepare_env_opts(opts)
         return self._run(
             self.core.create_actor(descriptor, args, kwargs, opts))
 

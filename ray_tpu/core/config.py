@@ -51,6 +51,9 @@ class Config:
     task_max_retries: int = 3
     actor_max_restarts: int = 0
     lineage_enabled: bool = True
+    # --- memory monitor (reference: memory_monitor.h + kill policies) ---
+    memory_monitor_refresh_ms: int = 0  # 0 disables
+    memory_usage_threshold: float = 0.95
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_max_message_bytes: int = 512 << 20

@@ -26,6 +26,13 @@ from typing import Any, Dict, List, Optional
 logger = logging.getLogger(__name__)
 
 
+def _escape_label(value: str) -> str:
+    """Prometheus exposition-format label escaping (backslash, quote,
+    newline) — unescaped user tag values would break the whole scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _prometheus_text(metrics: List[Dict[str, Any]]) -> str:
     lines = []
     seen_meta = set()
@@ -38,7 +45,7 @@ def _prometheus_text(metrics: List[Dict[str, Any]]) -> str:
             kind = {"counter": "counter", "gauge": "gauge",
                     "histogram": "histogram"}[m["kind"]]
             lines.append(f"# TYPE {name} {kind}")
-        tag_str = ",".join(f'{k}="{v}"'
+        tag_str = ",".join(f'{k}="{_escape_label(v)}"'
                            for k, v in sorted(m["tags"].items()))
         label = f"{{{tag_str}}}" if tag_str else ""
         if m["kind"] == "histogram":
@@ -154,11 +161,16 @@ class DashboardHead:
         await runner.cleanup()
 
     def start(self) -> "DashboardHead":
+        self._error: Optional[BaseException] = None
+
         def run():
             self._loop = asyncio.new_event_loop()
             asyncio.set_event_loop(self._loop)
             try:
                 self._loop.run_until_complete(self._serve())
+            except BaseException as e:
+                self._error = e
+                self._started.set()  # unblock the waiter with the error
             finally:
                 self._loop.close()
 
@@ -166,7 +178,12 @@ class DashboardHead:
                                         name="dashboard-head")
         self._thread.start()
         if not self._started.wait(10.0):
-            raise RuntimeError("dashboard failed to start")
+            raise RuntimeError("dashboard failed to start (timeout)")
+        if self._error is not None:
+            raise RuntimeError(
+                f"dashboard failed to start on {self.host}:{self.port}: "
+                f"{type(self._error).__name__}: {self._error}"
+            ) from self._error
         return self
 
     def stop(self) -> None:

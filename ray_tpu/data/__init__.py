@@ -13,8 +13,10 @@ batches straight onto a mesh sharding.
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import (DataIterator, Dataset, from_arrow,
                                   from_items, from_numpy, from_pandas,
-                                  range, read_csv, read_json, read_parquet,
+                                  range, read_binary_files, read_csv,
+                                  read_json, read_numpy, read_parquet,
                                   read_text)
+from ray_tpu.data import preprocessors
 
 __all__ = [
     "DataContext",
@@ -24,9 +26,12 @@ __all__ = [
     "from_items",
     "from_numpy",
     "from_pandas",
+    "preprocessors",
     "range",
+    "read_binary_files",
     "read_csv",
     "read_json",
+    "read_numpy",
     "read_parquet",
     "read_text",
 ]

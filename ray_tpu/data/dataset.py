@@ -289,6 +289,47 @@ class Dataset:
             return lambda: b
         return Dataset([make(b) for b in blocks])
 
+    # ---------------- writes ----------------
+    def _write_blocks(self, path: str, ext: str, write_one) -> List[str]:
+        """One output file per block (reference: write_parquet et al.,
+        file-per-block layout)."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        written = []
+        for i, block in enumerate(self.iter_blocks()):
+            out = os.path.join(path, f"block_{i:05d}.{ext}")
+            write_one(block, out)
+            written.append(out)
+        return written
+
+    def write_parquet(self, path: str) -> List[str]:
+        def one(block: Block, out: str):
+            import pyarrow.parquet as pq
+
+            pq.write_table(block, out)  # blocks ARE arrow tables
+
+        return self._write_blocks(path, "parquet", one)
+
+    def write_csv(self, path: str) -> List[str]:
+        def one(block: Block, out: str):
+            block_to_pandas(block).to_csv(out, index=False)
+
+        return self._write_blocks(path, "csv", one)
+
+    def write_json(self, path: str) -> List[str]:
+        def one(block: Block, out: str):
+            block_to_pandas(block).to_json(out, orient="records",
+                                           lines=True)
+
+        return self._write_blocks(path, "json", one)
+
+    def write_numpy(self, path: str, column: str) -> List[str]:
+        def one(block: Block, out: str):
+            np.save(out, block_to_numpy(block)[column])
+
+        return self._write_blocks(path, "npy", one)
+
     # ---------------- consumption ----------------
     def take(self, n: int = 20) -> List[Dict[str, Any]]:
         out: List[Dict[str, Any]] = []
@@ -530,3 +571,11 @@ def read_json(paths) -> Dataset:
 
 def read_text(paths) -> Dataset:
     return Dataset(datasource.text_tasks(paths))
+
+
+def read_binary_files(paths) -> Dataset:
+    return Dataset(datasource.binary_tasks(paths))
+
+
+def read_numpy(paths, column: str = "data") -> Dataset:
+    return Dataset(datasource.numpy_file_tasks(paths, column))

@@ -115,3 +115,29 @@ def text_tasks(paths) -> List[ReadTask]:
             return block_from_items([{"text": ln} for ln in lines])
         return read
     return [make(f) for f in files]
+
+
+def binary_tasks(paths) -> List[ReadTask]:
+    """One row per file: {'path', 'bytes'} (reference:
+    read_binary_files)."""
+    files = _expand_paths(paths)
+
+    def make(f: str) -> ReadTask:
+        def read() -> Block:
+            with open(f, "rb") as fh:
+                data = fh.read()
+            return block_from_items([{"path": f, "bytes": data}])
+        return read
+    return [make(f) for f in files]
+
+
+def numpy_file_tasks(paths, column: str = "data") -> List[ReadTask]:
+    """One block per .npy file (reference: read_numpy)."""
+    files = _expand_paths(paths)
+
+    def make(f: str) -> ReadTask:
+        def read() -> Block:
+            arr = np.load(f)
+            return block_from_numpy({column: arr})
+        return read
+    return [make(f) for f in files]

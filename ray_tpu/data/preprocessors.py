@@ -1,0 +1,185 @@
+"""Preprocessors: fit on a Dataset, transform Datasets/batches.
+
+Reference: python/ray/data/preprocessors/ (Preprocessor base with
+fit/transform/transform_batch; StandardScaler, MinMaxScaler,
+LabelEncoder, OneHotEncoder, Concatenator, Chain). Stats are computed
+with the Dataset aggregation API; transforms are map_batches stages.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Preprocessor:
+    _fitted = False
+
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def transform(self, ds):
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} must be fit first")
+        return ds.map_batches(self.transform_batch, batch_format="pandas")
+
+    def transform_batch(self, batch):
+        raise NotImplementedError
+
+    def _fit(self, ds) -> None:
+        pass
+
+    def _needs_fit(self) -> bool:
+        return True
+
+
+def _col_stats(ds, columns: List[str]) -> Dict[str, Dict[str, float]]:
+    """One pass: count/sum/sumsq/min/max per column."""
+    stats = {c: {"count": 0, "sum": 0.0, "sumsq": 0.0,
+                 "min": float("inf"), "max": float("-inf")}
+             for c in columns}
+    for block in ds.iter_blocks():
+        from ray_tpu.data.block import block_to_numpy
+
+        arrays = block_to_numpy(block)
+        for c in columns:
+            v = np.asarray(arrays[c], dtype=np.float64)
+            s = stats[c]
+            s["count"] += v.size
+            s["sum"] += float(v.sum())
+            s["sumsq"] += float((v * v).sum())
+            if v.size:
+                s["min"] = min(s["min"], float(v.min()))
+                s["max"] = max(s["max"], float(v.max()))
+    return stats
+
+
+class StandardScaler(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds) -> None:
+        raw = _col_stats(ds, self.columns)
+        for c, s in raw.items():
+            mean = s["sum"] / max(1, s["count"])
+            var = s["sumsq"] / max(1, s["count"]) - mean * mean
+            self.stats_[c] = (mean, max(var, 0.0) ** 0.5)
+
+    def transform_batch(self, batch):
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            batch[c] = (batch[c] - mean) / (std if std > 0 else 1.0)
+        return batch
+
+
+class MinMaxScaler(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds) -> None:
+        raw = _col_stats(ds, self.columns)
+        for c, s in raw.items():
+            self.stats_[c] = (s["min"], s["max"])
+
+    def transform_batch(self, batch):
+        for c in self.columns:
+            lo, hi = self.stats_[c]
+            span = hi - lo
+            batch[c] = (batch[c] - lo) / (span if span > 0 else 1.0)
+        return batch
+
+
+class LabelEncoder(Preprocessor):
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: Dict = {}
+
+    def _fit(self, ds) -> None:
+        values = set()
+        for block in ds.iter_blocks():
+            from ray_tpu.data.block import block_to_pandas
+
+            values.update(block_to_pandas(block)[self.label_column]
+                          .unique().tolist())
+        self.classes_ = {v: i for i, v in enumerate(sorted(values))}
+
+    def transform_batch(self, batch):
+        batch[self.label_column] = batch[self.label_column].map(
+            self.classes_)
+        return batch
+
+
+class OneHotEncoder(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.categories_: Dict[str, List] = {}
+
+    def _fit(self, ds) -> None:
+        values: Dict[str, set] = {c: set() for c in self.columns}
+        for block in ds.iter_blocks():
+            from ray_tpu.data.block import block_to_pandas
+
+            df = block_to_pandas(block)
+            for c in self.columns:
+                values[c].update(df[c].unique().tolist())
+        self.categories_ = {c: sorted(v) for c, v in values.items()}
+
+    def transform_batch(self, batch):
+        for c in self.columns:
+            for cat in self.categories_[c]:
+                batch[f"{c}_{cat}"] = (batch[c] == cat).astype(np.int8)
+            batch = batch.drop(columns=[c])
+        return batch
+
+
+class Concatenator(Preprocessor):
+    """Concatenate feature columns into one vector column (the shape
+    Train ingest wants)."""
+
+    def __init__(self, columns: Optional[List[str]] = None,
+                 output_column_name: str = "concat_out",
+                 exclude: Optional[List[str]] = None):
+        self.columns = columns
+        self.output_column_name = output_column_name
+        self.exclude = set(exclude or [])
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def transform_batch(self, batch):
+        cols = self.columns or [c for c in batch.columns
+                                if c not in self.exclude]
+        mat = np.stack([np.asarray(batch[c], dtype=np.float64)
+                        for c in cols], axis=1)
+        out = batch.drop(columns=cols)
+        out[self.output_column_name] = list(mat)
+        return out
+
+
+class Chain(Preprocessor):
+    def __init__(self, *preprocessors: Preprocessor):
+        self.preprocessors = list(preprocessors)
+
+    def fit(self, ds) -> "Chain":
+        for p in self.preprocessors:
+            ds = p.fit_transform(ds).materialize()
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds
+
+    def transform_batch(self, batch):
+        for p in self.preprocessors:
+            batch = p.transform_batch(batch)
+        return batch

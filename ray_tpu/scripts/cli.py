@@ -78,9 +78,14 @@ def cmd_start(args) -> None:
                 from ray_tpu.dashboard import start_dashboard
 
                 ray_tpu.init(address=node.gcs_address)
-                dash = start_dashboard(port=args.dashboard_port)
-                print(f"dashboard: "
-                      f"http://127.0.0.1:{args.dashboard_port}")
+                try:
+                    dash = start_dashboard(port=args.dashboard_port)
+                    print(f"dashboard: "
+                          f"http://127.0.0.1:{args.dashboard_port}")
+                except Exception as e:
+                    # The head is useful without a dashboard (e.g. port
+                    # 8265 taken by another cluster) — warn, keep going.
+                    print(f"warning: dashboard disabled: {e}")
         else:
             print(f"ray_tpu node started; joined {node.gcs_address}")
 

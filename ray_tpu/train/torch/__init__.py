@@ -1,0 +1,12 @@
+"""ray_tpu.train.torch — torch (CPU/gloo) trainer for API parity.
+
+Reference: python/ray/train/torch/. The TPU path is JaxTrainer; this
+package lets reference users run existing torch train loops unchanged.
+"""
+
+from ray_tpu.train.torch.config import TorchConfig
+from ray_tpu.train.torch.torch_trainer import (TorchTrainer, prepare_model,
+                                               prepare_data_loader)
+
+__all__ = ["TorchConfig", "TorchTrainer", "prepare_model",
+           "prepare_data_loader"]

@@ -1,0 +1,88 @@
+"""TorchConfig / _TorchBackend — torch.distributed process groups.
+
+Reference: python/ray/train/torch/config.py:150 (`_TorchBackend.on_start`
+→ `_setup_torch_process_group` :65): rank 0 hosts the TCP store, every
+worker joins init_process_group. Torch here is CPU/gloo — the TPU compute
+path is JAX (JaxTrainer); TorchTrainer exists for CPU workloads and API
+parity so reference users can bring torch train loops unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import os
+
+from ray_tpu.train.backend import Backend, BackendConfig
+
+
+@dataclasses.dataclass
+class TorchConfig(BackendConfig):
+    backend: str = "gloo"
+    init_method: str = "env"
+    timeout_s: int = 1800
+
+    @property
+    def backend_cls(self):
+        return _TorchBackend
+
+
+def _setup_torch_process_group(backend: str, world_rank: int,
+                               world_size: int, init_method: str,
+                               master_addr: str, master_port: int,
+                               timeout_s: int) -> bool:
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        return True
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ["MASTER_PORT"] = str(master_port)
+    os.environ["RANK"] = str(world_rank)
+    os.environ["WORLD_SIZE"] = str(world_size)
+    if init_method == "env":
+        url = "env://"
+    elif init_method == "tcp":
+        url = f"tcp://{master_addr}:{master_port}"
+    else:
+        raise ValueError(f"unknown init_method {init_method!r}")
+    dist.init_process_group(
+        backend=backend, init_method=url, rank=world_rank,
+        world_size=world_size,
+        timeout=datetime.timedelta(seconds=timeout_s))
+    return True
+
+
+def _shutdown_torch() -> None:
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+class _TorchBackend(Backend):
+    def on_start(self, worker_group, backend_config: TorchConfig) -> None:
+        if len(worker_group) <= 1:
+            return
+        import ray_tpu
+
+        infos = worker_group.execute("get_node_info")
+        master_addr = infos[0]["ip"]
+        master_port = infos[0]["free_port"]
+        refs = [
+            w.run_fn.remote(_setup_torch_process_group,
+                            backend_config.backend, rank,
+                            len(worker_group), backend_config.init_method,
+                            master_addr, master_port,
+                            backend_config.timeout_s)
+            for rank, w in enumerate(worker_group.workers)
+        ]
+        ray_tpu.get(refs)
+
+    def on_shutdown(self, worker_group, backend_config) -> None:
+        import ray_tpu
+
+        try:
+            ray_tpu.get([w.run_fn.remote(_shutdown_torch)
+                         for w in worker_group.workers])
+        except Exception:
+            pass

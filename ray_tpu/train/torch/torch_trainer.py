@@ -1,0 +1,62 @@
+"""TorchTrainer + train-loop utilities (DDP prep).
+
+Reference: python/ray/train/torch/torch_trainer.py:11 (TorchTrainer) and
+train_loop_utils.py:158/:200 (prepare_model DDP wrap, prepare_data_loader
+DistributedSampler). CPU/gloo here — TPU training is JaxTrainer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.torch.config import TorchConfig
+
+
+class TorchTrainer(DataParallelTrainer):
+    def __init__(self, train_loop_per_worker, *,
+                 torch_config: Optional[TorchConfig] = None, **kwargs):
+        super().__init__(train_loop_per_worker,
+                         backend_config=torch_config or TorchConfig(),
+                         **kwargs)
+
+
+def prepare_model(model, parallel_strategy: Optional[str] = "ddp"):
+    """Wrap in DDP when a process group is live (reference:
+    train_loop_utils.py:158). parallel_strategy None returns the model
+    unwrapped (fsdp is torch-GPU territory; on TPU use JaxTrainer)."""
+    import torch.distributed as dist
+
+    if parallel_strategy is None or not dist.is_initialized() or \
+            dist.get_world_size() <= 1:
+        return model
+    if parallel_strategy == "ddp":
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    raise ValueError(
+        f"parallel_strategy {parallel_strategy!r} not supported here "
+        "(fsdp requires GPU; TPU sharding lives in JaxTrainer/GSPMD)")
+
+
+def prepare_data_loader(data_loader):
+    """Re-wrap a DataLoader with a DistributedSampler (reference:
+    train_loop_utils.py:200)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, RandomSampler
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not dist.is_initialized() or dist.get_world_size() <= 1:
+        return data_loader
+    # Preserve the loader's ordering semantics: only shuffle if the
+    # original sampler shuffled (eval loaders must stay ordered).
+    shuffle = isinstance(data_loader.sampler, RandomSampler)
+    sampler = DistributedSampler(data_loader.dataset, shuffle=shuffle)
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=data_loader.num_workers,
+        collate_fn=data_loader.collate_fn,
+        pin_memory=data_loader.pin_memory,
+        drop_last=data_loader.drop_last)

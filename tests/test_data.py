@@ -214,3 +214,92 @@ def test_local_shuffle_buffer():
     flat = np.concatenate([b["id"] for b in batches])
     assert sorted(flat.tolist()) == list(range(200))
     assert flat.tolist() != list(range(200))
+
+
+class TestWritesAndNewReaders:
+    def test_write_read_parquet_roundtrip(self, ray_start_regular,
+                                          tmp_path):
+        from ray_tpu import data
+
+        ds = data.range(100).map(lambda r: {"id": r["id"],
+                                            "sq": r["id"] ** 2})
+        files = ds.write_parquet(str(tmp_path / "pq"))
+        assert files
+        back = data.read_parquet(str(tmp_path / "pq"))
+        rows = sorted(back.take_all(), key=lambda r: r["id"])
+        assert len(rows) == 100 and rows[7]["sq"] == 49
+
+    def test_write_csv_json_numpy(self, ray_start_regular, tmp_path):
+        import numpy as np
+
+        from ray_tpu import data
+
+        ds = data.from_items([{"a": i, "b": float(i)} for i in range(10)])
+        assert ds.write_csv(str(tmp_path / "csv"))
+        assert ds.write_json(str(tmp_path / "json"))
+        back = data.read_csv(str(tmp_path / "csv"))
+        assert back.count() == 10
+
+        nds = data.from_numpy({"x": np.arange(12.0)})
+        assert nds.write_numpy(str(tmp_path / "npy"), "x")
+        nb = data.read_numpy(str(tmp_path / "npy") + "/*.npy", column="x")
+        assert nb.count() == 12
+
+    def test_read_binary_files(self, ray_start_regular, tmp_path):
+        from ray_tpu import data
+
+        (tmp_path / "a.bin").write_bytes(b"\x01\x02")
+        (tmp_path / "b.bin").write_bytes(b"\x03")
+        ds = data.read_binary_files(str(tmp_path) + "/*.bin")
+        rows = sorted(ds.take_all(), key=lambda r: r["path"])
+        assert rows[0]["bytes"] == b"\x01\x02"
+        assert rows[1]["bytes"] == b"\x03"
+
+
+class TestPreprocessors:
+    def test_standard_scaler(self, ray_start_regular):
+        import numpy as np
+
+        from ray_tpu import data
+        from ray_tpu.data.preprocessors import StandardScaler
+
+        ds = data.from_items([{"x": float(i)} for i in range(10)])
+        scaler = StandardScaler(["x"])
+        out = scaler.fit_transform(ds)
+        xs = np.array([r["x"] for r in out.take_all()])
+        assert abs(xs.mean()) < 1e-9
+        assert abs(xs.std() - 1.0) < 1e-6
+
+    def test_minmax_label_onehot_chain(self, ray_start_regular):
+        import numpy as np
+
+        from ray_tpu import data
+        from ray_tpu.data.preprocessors import (Chain, LabelEncoder,
+                                                MinMaxScaler,
+                                                OneHotEncoder)
+
+        ds = data.from_items([
+            {"x": float(i), "color": ["red", "blue"][i % 2],
+             "label": ["cat", "dog", "cat"][i % 3]}
+            for i in range(12)])
+        chain = Chain(MinMaxScaler(["x"]), LabelEncoder("label"),
+                      OneHotEncoder(["color"]))
+        out = chain.fit(ds).transform(ds)
+        rows = out.take_all()
+        xs = [r["x"] for r in rows]
+        assert min(xs) == 0.0 and max(xs) == 1.0
+        assert set(r["label"] for r in rows) <= {0, 1}
+        assert "color_red" in rows[0] and "color_blue" in rows[0]
+        assert all(r["color_red"] + r["color_blue"] == 1 for r in rows)
+
+    def test_concatenator(self, ray_start_regular):
+        from ray_tpu import data
+        from ray_tpu.data.preprocessors import Concatenator
+
+        ds = data.from_items([{"a": 1.0, "b": 2.0, "y": 9}
+                              for _ in range(3)])
+        out = Concatenator(columns=["a", "b"],
+                           output_column_name="features").transform(ds)
+        row = out.take(1)[0]
+        assert list(row["features"]) == [1.0, 2.0]
+        assert row["y"] == 9

@@ -1,0 +1,52 @@
+"""TorchTrainer tests (CPU/gloo DDP over the worker gang).
+
+Reference test model: python/ray/train/tests/test_torch_trainer.py — a
+2-worker gloo group trains a small model; ranks agree on gradients.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.air import ScalingConfig
+from ray_tpu.train.torch import TorchConfig, TorchTrainer, prepare_model
+
+
+def test_torch_trainer_ddp_two_workers(ray_start_regular):
+    def loop(config):
+        import torch
+        import torch.distributed as dist
+        import torch.nn as nn
+
+        rank = dist.get_rank()
+        world = dist.get_world_size()
+        assert world == 2
+
+        torch.manual_seed(0)
+        model = prepare_model(nn.Linear(4, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        torch.manual_seed(100 + rank)  # different data per rank
+        for step in range(3):
+            x = torch.randn(8, 4)
+            y = x.sum(dim=1, keepdim=True)
+            loss = ((model(x) - y) ** 2).mean()
+            opt.zero_grad()
+            loss.backward()
+            opt.step()
+        # DDP invariant: all ranks hold identical params after sync
+        # steps — verify in-loop via all_gather.
+        w = model.module.weight.detach().clone()
+        gathered = [torch.zeros_like(w) for _ in range(world)]
+        dist.all_gather(gathered, w)
+        ddp_in_sync = bool(torch.allclose(gathered[0], gathered[1]))
+        train.report({"loss": float(loss), "ddp_in_sync": ddp_in_sync})
+
+    trainer = TorchTrainer(
+        loop,
+        torch_config=TorchConfig(backend="gloo"),
+        scaling_config=ScalingConfig(num_workers=2),
+    )
+    result = trainer.fit()
+    assert result.metrics["loss"] >= 0.0
+    assert result.metrics["ddp_in_sync"] is True
