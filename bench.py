@@ -59,7 +59,7 @@ def main():
             vocab_size=32000, dim=1536, n_layers=16, n_heads=12,
             n_kv_heads=12, ffn_dim=4096, max_seq_len=2048,
             remat=True, attn_impl="flash")
-        batch_size, seq_len, steps = 8, 2048, 10
+        batch_size, seq_len, steps = 8, 2048, 20
     else:  # smoke mode off-TPU
         cfg = LlamaConfig.nano()
         batch_size, seq_len, steps = 4, 128, 3
@@ -82,11 +82,16 @@ def main():
     params, opt_state, metrics = step_fn(params, opt_state, batch)
     jax.block_until_ready(metrics["loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, metrics = step_fn(params, opt_state, batch)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
+    # Two timed trials, best-of: the chip may be shared (tunnel pool) and
+    # a single window under-measures steady-state throughput.
+    best_dt = float("inf")
+    for _ in range(2 if on_tpu else 1):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, metrics = step_fn(params, opt_state, batch)
+        jax.block_until_ready(metrics["loss"])
+        best_dt = min(best_dt, time.perf_counter() - t0)
+    dt = best_dt
 
     tokens_per_step = batch_size * seq_len
     tokens_per_sec = tokens_per_step * steps / dt

@@ -93,6 +93,25 @@ struct Server {
   std::atomic<bool> stop{false};
   std::atomic<int> active_handlers{0};
   pthread_t thread{};
+  // Live connection fds so stop() can force-close in-flight transfers.
+  pthread_mutex_t conn_mu = PTHREAD_MUTEX_INITIALIZER;
+  std::unordered_map<int, int> conn_fds;
+
+  void track(int fd) {
+    pthread_mutex_lock(&conn_mu);
+    conn_fds[fd] = fd;
+    pthread_mutex_unlock(&conn_mu);
+  }
+  void untrack(int fd) {
+    pthread_mutex_lock(&conn_mu);
+    conn_fds.erase(fd);
+    pthread_mutex_unlock(&conn_mu);
+  }
+  void shutdown_all() {
+    pthread_mutex_lock(&conn_mu);
+    for (auto& kv : conn_fds) shutdown(kv.first, SHUT_RDWR);
+    pthread_mutex_unlock(&conn_mu);
+  }
 };
 
 // Client-side store handles are opened once per (process, path) and kept
@@ -127,10 +146,15 @@ void* handle_conn(void* arg) {
   delete task;
   // active_handlers was incremented by the accept loop BEFORE spawning
   // us; obj_transfer_stop waits for it to drain before freeing server.
+  server->track(fd);
   struct Guard {
     Server* s;
-    ~Guard() { s->active_handlers.fetch_sub(1); }
-  } guard{server};
+    int fd;
+    ~Guard() {
+      s->untrack(fd);
+      s->active_handlers.fetch_sub(1);
+    }
+  } guard{server, fd};
 
   uint64_t magic = 0;
   uint8_t id[kIdSize];
@@ -233,12 +257,22 @@ void obj_transfer_stop(void* server_ptr) {
   shutdown(server->listen_fd, SHUT_RDWR);
   close(server->listen_fd);
   pthread_join(server->thread, nullptr);
-  // Detached handlers may still be streaming; wait for them to drain
-  // (each socket op is bounded by kIoTimeoutSec) before freeing the
-  // store they read from.
-  for (int i = 0; i < (kIoTimeoutSec + 5) * 100; i++) {
-    if (server->active_handlers.load() == 0) break;
+  // Force in-flight transfers to fail fast, then wait for handlers to
+  // drain before freeing the store they read from.
+  server->shutdown_all();
+  bool drained = false;
+  for (int i = 0; i < 500; i++) {  // ~5s; IO fails immediately after
+                                   // shutdown so this is generous
+    if (server->active_handlers.load() == 0) {
+      drained = true;
+      break;
+    }
     usleep(10 * 1000);
+  }
+  if (!drained) {
+    // A handler is wedged beyond reason: leak the server rather than
+    // free memory it still dereferences (shutdown path only).
+    return;
   }
   shm_store_close(server->store);
   delete server;

@@ -957,15 +957,16 @@ class CoreWorker:
         apply step on the loop thread only hits warm caches."""
         if not runtime_env:
             return
+        from ray_tpu._private.runtime_env import _check_pip, _materialize
+
+        loop = asyncio.get_running_loop()
+        if runtime_env.get("pip"):
+            # pip install can take minutes — never on the loop thread.
+            await loop.run_in_executor(None, _check_pip, runtime_env)
         uris = []
         if runtime_env.get("working_dir"):
             uris.append(runtime_env["working_dir"])
         uris.extend(runtime_env.get("py_modules") or [])
-        if not uris:
-            return
-        from ray_tpu._private.runtime_env import _materialize
-
-        loop = asyncio.get_running_loop()
         for uri in uris:
             await loop.run_in_executor(
                 None, _materialize, uri, self._sync_gcs_call)

@@ -139,6 +139,7 @@ class GcsServer:
         # Counters/histograms folded in from dead workers — counter
         # totals must stay monotonic across worker churn.
         self.retired_metrics: Dict[tuple, dict] = {}
+        self.retired_worker_ids: Set[bytes] = set()
         self.subscribers: Dict[str, Set[rpc.Connection]] = {}
         self._next_job = 0
         self._server: Optional[rpc.Server] = None
@@ -718,8 +719,13 @@ class GcsServer:
     # ------------------------------------------------------------- metrics
     def _retire_worker_metrics(self, worker_id: bytes) -> None:
         """Fold a dead worker's counters/histograms into the persistent
-        retired totals (monotonicity across worker churn); drop gauges."""
+        retired totals (monotonicity across worker churn); drop gauges.
+
+        A retired worker that reports again (it was stalled, not dead)
+        must NOT be double-counted: its id is remembered and later
+        reports are rejected (handle_report_metrics)."""
         entry = self.worker_metrics.pop(worker_id, None)
+        self.retired_worker_ids.add(worker_id)
         if not entry:
             return
         for m in entry["metrics"]:
@@ -746,6 +752,10 @@ class GcsServer:
     async def handle_report_metrics(self, data, conn) -> bool:
         """Latest metric snapshots per reporting worker (reference: node
         metrics agents feeding OpenCensusProxyCollector)."""
+        if data["worker_id"] in self.retired_worker_ids:
+            # Already folded into retired totals; accepting a new snapshot
+            # would double-count its cumulative counters.
+            return False
         self.worker_metrics[data["worker_id"]] = {
             "metrics": data["metrics"], "time": time.time()}
         return True

@@ -1,0 +1,50 @@
+"""Usage stats — opt-out telemetry recording (reference:
+python/ray/_private/usage/usage_lib.py).
+
+This deployment is hermetic (zero egress), so nothing is ever
+transmitted; the record is written next to the session logs for
+operators who want it, and RAY_TPU_USAGE_STATS_ENABLED=0 disables even
+that. API parity: usage_stats_enabled(), record_extra_usage_tag().
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import sys
+import time
+from typing import Dict
+
+_TAGS: Dict[str, str] = {}
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") == "1"
+
+
+def record_extra_usage_tag(key: str, value: str) -> None:
+    _TAGS[str(key)] = str(value)
+
+
+def write_usage_record(session_dir: str) -> None:
+    """Local-only usage snapshot (never leaves the machine)."""
+    if not usage_stats_enabled():
+        return
+    try:
+        import ray_tpu
+
+        record = {
+            "schema_version": 1,
+            "timestamp": time.time(),
+            "ray_tpu_version": ray_tpu.__version__,
+            "python_version": sys.version.split()[0],
+            "platform": platform.platform(),
+            "extra_tags": dict(_TAGS),
+        }
+        os.makedirs(session_dir, exist_ok=True)
+        with open(os.path.join(session_dir, "usage_stats.json"),
+                  "w") as f:
+            json.dump(record, f)
+    except Exception:
+        pass

@@ -156,6 +156,14 @@ def init(address: Optional[str] = None, *,
             if ignore_reinit_error:
                 return get_runtime_context()
             raise RuntimeError("ray_tpu.init() called twice")
+        if address and address.startswith("ray://"):
+            # Client mode: the driver runs remotely behind a proxy
+            # (reference: Ray Client, python/ray/util/client/).
+            from ray_tpu.util.client.worker import ClientWorker
+
+            host, _, port = address[len("ray://"):].partition(":")
+            _global_worker = ClientWorker(host, int(port or 10001))
+            return _global_worker
         import asyncio
 
         from ray_tpu._private.core_worker import DRIVER, CoreWorker
@@ -232,6 +240,9 @@ def shutdown() -> None:
         if w is None:
             return
         _global_worker = None
+        if getattr(w, "mode", None) == "client":
+            w.disconnect()
+            return
         import asyncio
 
         try:
@@ -268,6 +279,9 @@ def kill(actor, *, no_restart: bool = True) -> None:
     if not isinstance(actor, ActorHandle):
         raise TypeError("kill() takes an ActorHandle")
     w = global_worker()
+    if getattr(w, "mode", None) == "client":
+        w.kill_actor(actor._actor_id, no_restart)
+        return
     w._run(w.core.kill_actor(actor._actor_id, no_restart))
 
 

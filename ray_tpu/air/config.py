@@ -70,6 +70,9 @@ class RunConfig:
     checkpoint_config: Optional[CheckpointConfig] = None
     stop: Optional[Dict[str, Any]] = None  # e.g. {"training_iteration": 10}
     verbose: int = 1
+    # Trial loggers / lifecycle hooks (reference: RunConfig.callbacks;
+    # None -> the default JSON+CSV loggers, [] -> none).
+    callbacks: Optional[list] = None
 
     def resolved_storage_path(self) -> str:
         return self.storage_path or os.path.join(

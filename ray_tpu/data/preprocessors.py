@@ -112,8 +112,12 @@ class LabelEncoder(Preprocessor):
         self.classes_ = {v: i for i, v in enumerate(sorted(values))}
 
     def transform_batch(self, batch):
-        batch[self.label_column] = batch[self.label_column].map(
-            self.classes_)
+        col = batch[self.label_column]
+        unseen = set(col.unique()) - set(self.classes_)
+        if unseen:
+            raise ValueError(
+                f"labels not seen at fit time: {sorted(unseen)!r}")
+        batch[self.label_column] = col.map(self.classes_)
         return batch
 
 

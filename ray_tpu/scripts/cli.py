@@ -59,6 +59,7 @@ def cmd_start(args) -> None:
 
     config = Config.from_env(None)
     dash = None
+    client_proxy = None
     if args.head:
         node = Node(config, resources=resources or None)
     else:
@@ -86,6 +87,19 @@ def cmd_start(args) -> None:
                     # The head is useful without a dashboard (e.g. port
                     # 8265 taken by another cluster) — warn, keep going.
                     print(f"warning: dashboard disabled: {e}")
+            if args.client_server_port:
+                import ray_tpu
+                from ray_tpu.util.client import ClientProxyServer
+
+                ray_tpu.init(address=node.gcs_address,
+                             ignore_reinit_error=True)
+                try:
+                    client_proxy = ClientProxyServer(
+                        port=args.client_server_port).start()
+                    print(f"client proxy: ray://127.0.0.1:"
+                          f"{client_proxy.port}")
+                except Exception as e:
+                    print(f"warning: client proxy disabled: {e}")
         else:
             print(f"ray_tpu node started; joined {node.gcs_address}")
 
@@ -99,6 +113,8 @@ def cmd_start(args) -> None:
         while not stop:
             time.sleep(0.5)
     finally:
+        if client_proxy is not None:
+            client_proxy.stop()
         if dash is not None:
             dash.stop()
         node.shutdown()
@@ -215,6 +231,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="extra resources, e.g. TPU-v5e-8-head=1")
     sp.add_argument("--dashboard-port", type=int, default=8265,
                     help="0 disables the dashboard")
+    sp.add_argument("--client-server-port", type=int, default=0,
+                    help="host a ray:// client proxy on this port")
     sp.add_argument("--block", action="store_true")
     sp.set_defaults(fn=cmd_start)
 

@@ -1,0 +1,148 @@
+"""Trial loggers/callbacks: CSV, JSON, TensorBoard.
+
+Reference: python/ray/tune/logger/ (CSVLoggerCallback,
+JsonLoggerCallback, TBXLoggerCallback) — one file set per trial under the
+experiment dir, fed from every reported result.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+class Callback:
+    """Reference: ray.tune.Callback — controller lifecycle hooks."""
+
+    def on_trial_start(self, trial) -> None:
+        pass
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial) -> None:
+        pass
+
+    def on_experiment_end(self, trials: List) -> None:
+        pass
+
+
+def _flatten(d: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "/"))
+        else:
+            out[key] = v
+    return out
+
+
+class LoggerCallback(Callback):
+    def __init__(self):
+        self._trial_dirs: Dict[str, str] = {}
+
+    def _dir_for(self, trial) -> str:
+        d = self._trial_dirs.get(trial.trial_id)
+        if d is None:
+            d = getattr(trial, "trial_dir", None) or \
+                os.path.join(".", trial.trial_id)
+            os.makedirs(d, exist_ok=True)
+            self._trial_dirs[trial.trial_id] = d
+        return d
+
+
+class JsonLoggerCallback(LoggerCallback):
+    """result.json: one JSON line per reported result."""
+
+    def on_trial_start(self, trial) -> None:
+        with open(os.path.join(self._dir_for(trial), "params.json"),
+                  "w") as f:
+            json.dump(trial.config, f, default=str)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        with open(os.path.join(self._dir_for(trial), "result.json"),
+                  "a") as f:
+            json.dump(result, f, default=str)
+            f.write("\n")
+
+
+class CSVLoggerCallback(LoggerCallback):
+    """progress.csv with a header union-grown on first write."""
+
+    def __init__(self):
+        super().__init__()
+        self._writers: Dict[str, tuple] = {}
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        flat = _flatten(result)
+        entry = self._writers.get(trial.trial_id)
+        if entry is None:
+            path = os.path.join(self._dir_for(trial), "progress.csv")
+            f = open(path, "a", newline="")
+            writer = csv.DictWriter(f, fieldnames=sorted(flat))
+            writer.writeheader()
+            entry = self._writers[trial.trial_id] = (f, writer)
+        f, writer = entry
+        writer.writerow({k: flat.get(k) for k in writer.fieldnames})
+        f.flush()
+
+    def on_trial_complete(self, trial) -> None:
+        entry = self._writers.pop(trial.trial_id, None)
+        if entry:
+            entry[0].close()
+
+    def on_experiment_end(self, trials: List) -> None:
+        for f, _ in self._writers.values():
+            f.close()
+        self._writers.clear()
+
+
+class TBXLoggerCallback(LoggerCallback):
+    """TensorBoard events via tensorboardX/torch; no-op if neither is
+    importable (hermetic images)."""
+
+    def __init__(self):
+        super().__init__()
+        self._writers: Dict[str, Any] = {}
+        self._available = True
+
+    def _writer_for(self, trial):
+        w = self._writers.get(trial.trial_id)
+        if w is None and self._available:
+            try:
+                try:
+                    from tensorboardX import SummaryWriter
+                except ImportError:
+                    from torch.utils.tensorboard import SummaryWriter
+            except Exception:
+                self._available = False
+                return None
+            w = SummaryWriter(log_dir=self._dir_for(trial))
+            self._writers[trial.trial_id] = w
+        return w
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        w = self._writer_for(trial)
+        if w is None:
+            return
+        step = result.get("training_iteration", 0)
+        for k, v in _flatten(result).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                w.add_scalar(k, v, global_step=step)
+        w.flush()
+
+    def on_trial_complete(self, trial) -> None:
+        w = self._writers.pop(trial.trial_id, None)
+        if w is not None:
+            w.close()
+
+    def on_experiment_end(self, trials: List) -> None:
+        for w in self._writers.values():
+            w.close()
+        self._writers.clear()
+
+
+DEFAULT_CALLBACKS = [JsonLoggerCallback, CSVLoggerCallback]

@@ -37,7 +37,8 @@ class TuneController:
                  experiment_dir: str = "",
                  stop: Optional[Dict] = None,
                  max_failures: int = 0,
-                 trial_resources: Optional[Dict[str, float]] = None):
+                 trial_resources: Optional[Dict[str, float]] = None,
+                 callbacks: Optional[List] = None):
         self.trainable_cls = trainable_cls
         self.metric, self.mode = metric, mode
         self.scheduler = scheduler or FIFOScheduler()
@@ -49,6 +50,10 @@ class TuneController:
         self.experiment_dir = experiment_dir
         os.makedirs(experiment_dir, exist_ok=True)
         self.trial_resources = trial_resources or {"CPU": 1.0}
+        from ray_tpu.tune.logger import DEFAULT_CALLBACKS
+
+        self.callbacks = callbacks if callbacks is not None else \
+            [cls() for cls in DEFAULT_CALLBACKS]
 
         # Pending configs: grid/random searchers pre-generate; adaptive
         # searchers are polled via suggest() as slots open. Unwrap
@@ -85,6 +90,12 @@ class TuneController:
 
     # ------------------------------------------------------------------
     def _launch(self, trial: Trial, restore_from: Optional[str] = None):
+        if restore_from is None and not trial.results:
+            for cb in self.callbacks:
+                try:
+                    cb.on_trial_start(trial)
+                except Exception:
+                    pass
         opts = {"num_cpus": self.trial_resources.get("CPU", 1.0)}
         custom = {k: v for k, v in self.trial_resources.items()
                   if k != "CPU"}
@@ -215,6 +226,11 @@ class TuneController:
             # doesn't clobber the last real metrics.
             trial.last_result = {**trial.last_result, **result}
             trial.results.append(result)
+            for cb in self.callbacks:
+                try:
+                    cb.on_trial_result(trial, result)
+                except Exception:
+                    pass
             self.search_alg.on_trial_result(trial.trial_id, result)
             decision = self.scheduler.on_trial_result(self, trial, result)
             if self._should_stop(result) or decision == sched_mod.STOP:
@@ -227,6 +243,11 @@ class TuneController:
                 except Exception:
                     pass
                 trial.status = TERMINATED
+                for cb in self.callbacks:
+                    try:
+                        cb.on_trial_complete(trial)
+                    except Exception:
+                        pass
                 self.search_alg.on_trial_complete(trial.trial_id, result)
                 self.scheduler.on_trial_complete(self, trial, result)
                 self._stop_actor(trial)
@@ -251,4 +272,9 @@ class TuneController:
                 if trial.status == RUNNING:
                     trial.status = TERMINATED
                 self._stop_actor(trial)
+            for cb in self.callbacks:
+                try:
+                    cb.on_experiment_end(self.trials)
+                except Exception:
+                    pass
         return self.trials

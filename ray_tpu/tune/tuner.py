@@ -127,7 +127,8 @@ class Tuner:
             experiment_dir=exp_dir,
             stop=getattr(run, "stop", None),
             max_failures=failure.max_failures if failure else 0,
-            trial_resources=self._resources)
+            trial_resources=self._resources,
+            callbacks=getattr(run, "callbacks", None))
         trials = controller.run()
         return ResultGrid(trials, cfg.metric, cfg.mode)
 

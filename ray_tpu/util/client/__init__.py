@@ -1,0 +1,11 @@
+"""ray_tpu.util.client — remote-driver (Ray Client) proxy mode.
+
+Parity target: python/ray/util/client/ (gRPC proxy; ARCHITECTURE.md).
+Connect with ray_tpu.init(address="ray://host:port"); host a proxy with
+ClientProxyServer (or `start --head --client-server-port N`).
+"""
+
+from ray_tpu.util.client.server import ClientProxyServer
+from ray_tpu.util.client.worker import ClientWorker
+
+__all__ = ["ClientProxyServer", "ClientWorker"]

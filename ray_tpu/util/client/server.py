@@ -1,0 +1,223 @@
+"""Client proxy server — remote drivers over a thin wire protocol.
+
+Reference: python/ray/util/client/server/ (the Ray Client gRPC proxy:
+client-side ObjectRef stubs, server translates to the real core API —
+design notes in client/ARCHITECTURE.md). Here the wire is the
+framework's own rpc framing; one proxy serves many client sessions, each
+session's objects pinned until it disconnects.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import rpc
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.ids import ActorID, ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+
+logger = logging.getLogger(__name__)
+
+
+class _ClientSession:
+    """Per-connection state: refs pinned on behalf of the client."""
+
+    def __init__(self):
+        self.refs: Dict[bytes, ObjectRef] = {}
+
+    def pin(self, ref: ObjectRef) -> dict:
+        self.refs[ref.id.binary()] = ref
+        return {"object_id": ref.id.binary(),
+                "owner": ref.owner_address or ""}
+
+    def resolve(self, object_id: bytes, owner: str) -> ObjectRef:
+        ref = self.refs.get(object_id)
+        if ref is not None:
+            return ref
+        return ObjectRef(ObjectID(object_id), owner_address=owner or None)
+
+
+class ClientProxyHandler:
+    """rpc handler; methods run on the proxy's own event loop and offload
+    the (sync, thread-safe) driver API to an executor."""
+
+    def __init__(self):
+        self.sessions: Dict[Any, _ClientSession] = {}
+
+    def _session(self, conn) -> _ClientSession:
+        sess = self.sessions.get(conn)
+        if sess is None:
+            sess = self.sessions[conn] = _ClientSession()
+            prev = conn.on_close
+            def _cleanup(c, _prev=prev):
+                self.sessions.pop(c, None)
+                if _prev:
+                    _prev(c)
+            conn.on_close = _cleanup
+        return sess
+
+    async def _offload(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, fn, *args)
+
+    # ---- handlers ----
+
+    async def handle_cl_ping(self, data, conn) -> str:
+        return "pong"
+
+    async def handle_cl_put(self, data, conn) -> dict:
+        import ray_tpu
+
+        sess = self._session(conn)
+        value = ser.loads(data["value"])
+        ref = await self._offload(ray_tpu.put, value)
+        return sess.pin(ref)
+
+    async def handle_cl_get(self, data, conn):
+        import ray_tpu
+        from ray_tpu._private.worker import global_worker
+
+        sess = self._session(conn)
+        refs = [sess.resolve(oid, owner)
+                for oid, owner in zip(data["ids"], data["owners"])]
+        timeout = data.get("timeout")
+        # get() with a LIST argument always returns a list.
+        values = await self._offload(
+            lambda: global_worker().get(refs, timeout=timeout))
+        return [ser.dumps(v) for v in values]
+
+    async def handle_cl_wait(self, data, conn) -> dict:
+        from ray_tpu._private.worker import global_worker
+
+        sess = self._session(conn)
+        refs = [sess.resolve(oid, owner)
+                for oid, owner in zip(data["ids"], data["owners"])]
+        ready, pending = await self._offload(
+            lambda: global_worker().wait(
+                refs, data.get("num_returns", 1), data.get("timeout"),
+                data.get("fetch_local", True)))
+        return {"ready": [r.id.binary() for r in ready],
+                "pending": [r.id.binary() for r in pending]}
+
+    async def handle_cl_export(self, data, conn) -> dict:
+        from ray_tpu._private.worker import global_worker
+
+        import cloudpickle
+
+        fn = cloudpickle.loads(data["blob"])
+        descriptor = await self._offload(global_worker().export, fn)
+        key = descriptor.function_key if hasattr(
+            descriptor, "function_key") else descriptor
+        self._session(conn).refs  # touch session
+        self._descriptors = getattr(self, "_descriptors", {})
+        self._descriptors[key] = descriptor
+        return {"key": key}
+
+    def _descriptor(self, key):
+        return self._descriptors[key]
+
+    async def handle_cl_submit_task(self, data, conn) -> list:
+        from ray_tpu._private.worker import global_worker
+
+        sess = self._session(conn)
+        args = ser.loads(data["args"])
+        kwargs = ser.loads(data["kwargs"])
+        opts = ser.loads(data["opts"])
+        refs = await self._offload(
+            lambda: global_worker().submit_task(
+                self._descriptor(data["key"]), args, kwargs, opts))
+        return [sess.pin(r) for r in refs]
+
+    async def handle_cl_create_actor(self, data, conn) -> dict:
+        from ray_tpu._private.worker import global_worker
+
+        args = ser.loads(data["args"])
+        kwargs = ser.loads(data["kwargs"])
+        opts = ser.loads(data["opts"])
+        actor_id = await self._offload(
+            lambda: global_worker().create_actor(
+                self._descriptor(data["key"]), args, kwargs, opts))
+        return {"actor_id": actor_id.binary()}
+
+    async def handle_cl_submit_actor_task(self, data, conn) -> list:
+        from ray_tpu._private.worker import global_worker
+
+        sess = self._session(conn)
+        args = ser.loads(data["args"])
+        kwargs = ser.loads(data["kwargs"])
+        opts = ser.loads(data["opts"])
+        refs = await self._offload(
+            lambda: global_worker().submit_actor_task(
+                ActorID(data["actor_id"]), data["method"], args, kwargs,
+                opts))
+        return [sess.pin(r) for r in refs]
+
+    async def handle_cl_kill_actor(self, data, conn) -> bool:
+        import ray_tpu
+        from ray_tpu.core.actor import ActorHandle
+
+        handle = ActorHandle(ActorID(data["actor_id"]))
+        await self._offload(
+            lambda: ray_tpu.kill(handle,
+                                 no_restart=data.get("no_restart", True)))
+        return True
+
+    async def handle_cl_gcs_call(self, data, conn):
+        from ray_tpu._private.worker import global_worker
+
+        return await self._offload(
+            lambda: global_worker().gcs_call(data["method"],
+                                             data.get("data")))
+
+
+class ClientProxyServer:
+    """Hosts the proxy on its own thread/loop beside a connected driver."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 10001):
+        self.host = host
+        self.port = port
+        self._thread: Optional[threading.Thread] = None
+        self._loop = None
+        self._started = threading.Event()
+        self._stop_evt: Optional[asyncio.Event] = None
+        self._error: Optional[BaseException] = None
+
+    async def _serve(self) -> None:
+        server = rpc.Server(ClientProxyHandler(), self.host, self.port)
+        self.port = await server.start()
+        self._stop_evt = asyncio.Event()
+        self._started.set()
+        logger.info("client proxy on %s:%d", self.host, self.port)
+        await self._stop_evt.wait()
+        await server.close()
+
+    def start(self) -> "ClientProxyServer":
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            try:
+                self._loop.run_until_complete(self._serve())
+            except BaseException as e:
+                self._error = e
+                self._started.set()
+            finally:
+                self._loop.close()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="client-proxy")
+        self._thread.start()
+        self._started.wait(10.0)
+        if self._error is not None:
+            raise RuntimeError(
+                f"client proxy failed to start: {self._error}"
+            ) from self._error
+        return self
+
+    def stop(self) -> None:
+        if self._loop and self._stop_evt:
+            self._loop.call_soon_threadsafe(self._stop_evt.set)
+        if self._thread:
+            self._thread.join(timeout=5.0)

@@ -1,0 +1,161 @@
+"""Client-mode worker: the driver API over a proxy connection.
+
+Reference: python/ray/util/client/worker.py (client-side stubs whose
+ObjectRefs are ids minted by the server). Activated by
+ray_tpu.init(address="ray://host:port").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, List, Optional, Sequence
+
+import cloudpickle
+
+from ray_tpu.core import rpc
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.ids import ActorID, ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+
+
+class _NoopRefCounter:
+    """Client-side refs are pinned by the proxy session, not locally."""
+
+    def add_local_ref(self, object_id) -> None:
+        pass
+
+    def remove_local_ref(self, object_id) -> None:
+        pass
+
+
+class _CoreShim:
+    """Minimal `core` surface ObjectRef construction touches."""
+
+    def register_borrow(self, object_id, owner_address) -> None:
+        pass
+
+
+class ClientWorker:
+    """Implements the Worker surface the public API uses (submit_task /
+    create_actor / submit_actor_task / get / put / wait / export /
+    gcs_call / kill) by forwarding to a ClientProxyServer."""
+
+    mode = "client"
+    reference_counter = _NoopRefCounter()
+    core = _CoreShim()
+
+    def __init__(self, host: str, port: int):
+        self._loop = asyncio.new_event_loop()
+        self._ready = threading.Event()
+        self._conn: Optional[rpc.Connection] = None
+        self._conn_err: Optional[BaseException] = None
+
+        def run():
+            asyncio.set_event_loop(self._loop)
+
+            async def connect():
+                try:
+                    self._conn = await rpc.connect(host, port, timeout=10.0,
+                                                   name="ray-client")
+                except BaseException as e:
+                    self._conn_err = e
+                finally:
+                    self._ready.set()
+
+            self._loop.run_until_complete(connect())
+            if self._conn is not None:
+                self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="ray-client-io")
+        self._thread.start()
+        self._ready.wait(15.0)
+        if self._conn is None:
+            raise ConnectionError(
+                f"could not reach ray client proxy at {host}:{port}: "
+                f"{self._conn_err}")
+        self._exported: dict = {}
+
+    def _call(self, method: str, data: dict, timeout: float = 300.0):
+        fut = asyncio.run_coroutine_threadsafe(
+            self._conn.call(method, data, timeout=timeout), self._loop)
+        return fut.result(timeout + 5.0)
+
+    # ---- Worker surface ----
+
+    def export(self, fn) -> bytes:
+        key = self._exported.get(id(fn))
+        if key is None:
+            r = self._call("cl_export", {"blob": cloudpickle.dumps(fn)})
+            key = r["key"]
+            self._exported[id(fn)] = key
+        return key
+
+    def put(self, value) -> ObjectRef:
+        r = self._call("cl_put", {"value": ser.dumps(value)})
+        return ObjectRef(ObjectID(r["object_id"]),
+                         owner_address=r["owner"] or None)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        blobs = self._call("cl_get", {
+            "ids": [r.id.binary() for r in ref_list],
+            "owners": [r.owner_address or "" for r in ref_list],
+            "timeout": timeout,
+        }, timeout=(timeout or 300.0) + 30.0)
+        values = [ser.loads(b) for b in blobs]
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True):
+        by_id = {r.id.binary(): r for r in refs}
+        r = self._call("cl_wait", {
+            "ids": [x.id.binary() for x in refs],
+            "owners": [x.owner_address or "" for x in refs],
+            "num_returns": num_returns, "timeout": timeout,
+            "fetch_local": fetch_local,
+        }, timeout=(timeout or 300.0) + 30.0)
+        return ([by_id[i] for i in r["ready"]],
+                [by_id[i] for i in r["pending"]])
+
+    def _refs_from(self, pins: List[dict]) -> List[ObjectRef]:
+        return [ObjectRef(ObjectID(p["object_id"]),
+                          owner_address=p["owner"] or None) for p in pins]
+
+    def submit_task(self, descriptor, args, kwargs,
+                    opts) -> List[ObjectRef]:
+        pins = self._call("cl_submit_task", {
+            "key": descriptor, "args": ser.dumps(args),
+            "kwargs": ser.dumps(kwargs), "opts": ser.dumps(opts)})
+        return self._refs_from(pins)
+
+    def create_actor(self, descriptor, args, kwargs, opts) -> ActorID:
+        r = self._call("cl_create_actor", {
+            "key": descriptor, "args": ser.dumps(args),
+            "kwargs": ser.dumps(kwargs), "opts": ser.dumps(opts)})
+        return ActorID(r["actor_id"])
+
+    def submit_actor_task(self, actor_id: ActorID, method: str, args,
+                          kwargs, opts) -> List[ObjectRef]:
+        pins = self._call("cl_submit_actor_task", {
+            "actor_id": actor_id.binary(), "method": method,
+            "args": ser.dumps(args), "kwargs": ser.dumps(kwargs),
+            "opts": ser.dumps(opts)})
+        return self._refs_from(pins)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
+        self._call("cl_kill_actor", {"actor_id": actor_id.binary(),
+                                     "no_restart": no_restart})
+
+    def gcs_call(self, method: str, data=None, timeout: float = 30.0):
+        return self._call("cl_gcs_call", {"method": method, "data": data},
+                          timeout=timeout)
+
+    def disconnect(self) -> None:
+        if self._conn is not None:
+            asyncio.run_coroutine_threadsafe(
+                self._conn.close(), self._loop).result(5.0)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5.0)

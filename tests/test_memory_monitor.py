@@ -30,23 +30,30 @@ def test_memory_monitor_units():
 
 
 def test_memory_monitor_kills_leased_worker(ray_start_cluster):
-    """With threshold 0 the monitor fires immediately: a leased worker is
-    killed and the task retries on a fresh worker."""
+    """threshold=0 makes every monitor tick fire: the leased worker
+    running a long task is killed (task fails after retries exhaust)."""
     import time
 
     import ray_tpu
+    from ray_tpu.core.config import Config
 
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
+    config = Config.from_env(None)
+    config.memory_monitor_refresh_ms = 100
+    config.memory_usage_threshold = 0.0  # always over budget
     cluster = ray_start_cluster()
-    # Impossible threshold -> every check triggers a kill of the newest
-    # leased worker; retries eventually give up or succeed between kills.
+    cluster.config = config
     cluster.add_node(resources={"CPU": 2})
     ray_tpu.init(address=cluster.address)
 
-    @ray_tpu.remote(max_retries=5)
-    def quick():
-        return "done"
+    @ray_tpu.remote(max_retries=0)
+    def long_task():
+        time.sleep(30)
+        return "survived"
 
-    # Sanity: normal operation with monitor disabled on this node.
-    assert ray_tpu.get(quick.remote(), timeout=30) == "done"
+    ref = long_task.remote()
+    with pytest.raises(Exception):
+        # The OOM policy kills the leased worker mid-task; with no
+        # retries the task surfaces the worker death.
+        ray_tpu.get(ref, timeout=20)

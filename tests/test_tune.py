@@ -255,3 +255,37 @@ def test_tuner_wraps_trainer(tmp_path):
         run_config=RunConfig(storage_path=str(tmp_path)),
     ).fit()
     assert results.get_best_result().metrics["final"] == 10
+
+
+def test_logger_callbacks_write_files(ray_start_regular, tmp_path):
+    import json
+    import os
+
+    from ray_tpu import tune
+    from ray_tpu.air import RunConfig
+    from ray_tpu.tune.logger import CSVLoggerCallback, JsonLoggerCallback
+
+    def trainable(config):
+        for i in range(3):
+            tune.report({"score": config["x"] * (i + 1),
+                         "training_iteration": i + 1})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="logexp",
+            callbacks=[JsonLoggerCallback(), CSVLoggerCallback()]),
+    )
+    results = tuner.fit()
+    assert len(results) == 2
+    trial_dirs = [d for d in (tmp_path / "logexp").iterdir()
+                  if d.name.startswith("trial_")]
+    assert len(trial_dirs) == 2
+    for d in trial_dirs:
+        lines = (d / "result.json").read_text().strip().splitlines()
+        assert len(lines) >= 3
+        assert "score" in json.loads(lines[0])
+        csv_text = (d / "progress.csv").read_text()
+        assert "score" in csv_text.splitlines()[0]
+        assert (d / "params.json").exists()
