@@ -1,0 +1,152 @@
+"""IMPALA — importance-weighted actor-learner with V-trace.
+
+Reference: rllib/algorithms/impala/ (V-trace off-policy correction,
+Espeholt et al. 2018). The actor-learner decoupling shows up here as
+behavior-policy log-probs recorded at sample time: by the time the
+learner consumes a rollout the weights have moved, and V-trace's
+clipped importance ratios (rho/c) correct the value targets. The loss
+is jit-compiled JAX; V-trace targets are computed inside the loss from
+the learner's own value predictions (single fused XLA program rather
+than a separate host pass).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner
+from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+from ray_tpu.rllib.utils import sample_batch as sb
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.vtrace_clip_rho_threshold: float = 1.0
+        self.vtrace_clip_c_threshold: float = 1.0
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        self.train_batch_size = 512
+        self.num_epochs = 1  # IMPALA is single-pass over each rollout
+        self.minibatch_size = 512
+
+    @property
+    def algo_class(self):
+        return IMPALA
+
+
+class IMPALALearner(JaxLearner):
+    def loss_fn(self, params, batch, rng):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        out = self.module.forward_train(params, batch[sb.OBS])
+        logits = out["action_dist_inputs"]
+        values = out["vf_preds"]                       # [T]
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch[sb.ACTIONS].astype(jnp.int32)
+        logp = jnp.take_along_axis(logp_all, actions[:, None],
+                                   axis=-1)[:, 0]
+        behavior_logp = batch[sb.ACTION_LOGP]
+        rewards = batch[sb.REWARDS]
+        boundary = batch["boundary"].astype(jnp.float32)  # next is new ep
+        # Host-computed bootstrap at every seam (terminal -> 0, rollout
+        # tail -> the runner's exact bootstrap, truncation/cut -> stale
+        # behavior value); NaN-free override mask.
+        next_value_override = batch["next_value_override"]
+        gamma = cfg.get("gamma", 0.99)
+
+        rho = jnp.exp(logp - behavior_logp)
+        rho_bar = jnp.minimum(
+            rho, cfg.get("vtrace_clip_rho_threshold", 1.0))
+        c_bar = jnp.minimum(rho, cfg.get("vtrace_clip_c_threshold", 1.0))
+
+        values_next = jnp.concatenate(
+            [values[1:], jnp.zeros((1,), values.dtype)])
+        # At seams the learner's values[t+1] belongs to a different
+        # episode/shard — use the host-provided bootstrap instead.
+        values_next = jnp.where(boundary > 0, next_value_override,
+                                values_next)
+        not_done = 1.0 - boundary  # scan must not leak across seams
+        deltas = rho_bar * (rewards + gamma * values_next - values)
+
+        # Backward scan: vs - V(s) accumulation.
+        def scan_fn(carry, xs):
+            delta, c, nd = xs
+            acc = delta + gamma * c * nd * carry
+            return acc, acc
+
+        _, vs_minus_v = jax.lax.scan(
+            scan_fn, jnp.zeros((), values.dtype),
+            (deltas, c_bar, not_done), reverse=True)
+        vs = jax.lax.stop_gradient(vs_minus_v + values)
+        vs_next = jnp.concatenate([vs[1:], jnp.zeros((1,), vs.dtype)])
+        vs_next = jnp.where(boundary > 0, next_value_override, vs_next)
+
+        pg_adv = jax.lax.stop_gradient(
+            rho_bar * (rewards + gamma * vs_next - values))
+        policy_loss = -(logp * pg_adv).mean()
+        vf_loss = ((values - vs) ** 2).mean()
+        probs = jax.nn.softmax(logits)
+        entropy = -(probs * logp_all).sum(-1).mean()
+        total = (policy_loss + cfg.get("vf_loss_coeff", 0.5) * vf_loss -
+                 cfg.get("entropy_coeff", 0.01) * entropy)
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": entropy,
+                       "mean_rho": rho.mean()}
+
+
+class IMPALA(Algorithm):
+    config_class = IMPALAConfig
+    learner_class = IMPALALearner
+    module_class = DiscreteMLPModule
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        pairs = self.env_runner_group.sample_with_bootstraps(
+            cfg.train_batch_size)
+        batches = []
+        for batch, boot in pairs:
+            b = SampleBatch(batch)
+            eps = np.asarray(b[sb.EPS_ID])
+            terms = np.asarray(b[sb.TERMINATEDS], bool)
+            vf = np.asarray(b.get(sb.VF_PREDS,
+                                  np.zeros(len(b))), np.float32)
+            # Seams where V-trace must not use the learner's values[t+1]:
+            # episode change mid-rollout or the rollout tail. Bootstrap:
+            # terminal -> 0; tail -> the runner's exact bootstrap value;
+            # truncation/cut -> the row's own (stale) behavior value.
+            boundary = np.zeros(len(b), np.float32)
+            boundary[:-1] = (eps[1:] != eps[:-1]).astype(np.float32)
+            boundary[-1] = 1.0
+            override = np.where(terms, 0.0, vf).astype(np.float32)
+            override[-1] = 0.0 if terms[-1] else float(boot)
+            b["boundary"] = boundary
+            b["next_value_override"] = override
+            batches.append(b)
+        train_batch = SampleBatch.concat_samples(batches)
+        if cfg.num_learners > 0:
+            # DDP learners slice the batch contiguously: cut the V-trace
+            # scan at shard edges too (stale-value bootstrap there).
+            n = cfg.num_learners
+            shard = max(1, len(train_batch) // n)
+            boundary = np.asarray(train_batch["boundary"])
+            override = np.asarray(train_batch["next_value_override"])
+            vf = np.asarray(train_batch.get(
+                sb.VF_PREDS, np.zeros(len(train_batch))), np.float32)
+            for i in range(1, n):
+                edge = i * shard - 1
+                if 0 <= edge < len(train_batch) and not boundary[edge]:
+                    boundary[edge] = 1.0
+                    override[edge] = vf[edge]
+            train_batch["boundary"] = boundary
+            train_batch["next_value_override"] = override
+        metrics = self.learner_group.update(train_batch)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return metrics

@@ -217,6 +217,20 @@ def cmd_job(args) -> None:
                   f"{info.entrypoint}")
 
 
+def cmd_serve(args) -> None:
+    _connect(args.address)
+    from ray_tpu import serve
+
+    if args.serve_cmd == "deploy":
+        handles = serve.deploy_config_file(args.config)
+        print(f"deployed applications: {sorted(handles)}")
+    elif args.serve_cmd == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ray_tpu",
                                 description=__doc__.split("\n")[0])
@@ -264,6 +278,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--limit", type=int, default=100)
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("serve", help="serve deploy/status/shutdown")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    s = ssub.add_parser("deploy")
+    s.add_argument("config", help="YAML/JSON ServeDeploySchema file")
+    s.add_argument("--address")
+    s.set_defaults(fn=cmd_serve)
+    for name in ("status", "shutdown"):
+        s = ssub.add_parser(name)
+        s.add_argument("--address")
+        s.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("job", help="job submission")
     jsub = sp.add_subparsers(dest="job_cmd", required=True)

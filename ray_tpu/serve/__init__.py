@@ -23,6 +23,9 @@ from ray_tpu.serve.deployment import Application, Deployment, deployment, \
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve._private.proxy import ServeRequest
+from ray_tpu.serve.schema import (ApplicationSchema, DeploymentSchema,
+                                  ServeDeploySchema, deploy_config_file,
+                                  deploy_from_schema)
 
 __all__ = [
     "Application",

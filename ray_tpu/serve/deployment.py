@@ -68,6 +68,53 @@ class Application:
     def deployment(self) -> Deployment:
         return self._deployment
 
+    @property
+    def deployments(self) -> list:
+        """Names of every unique deployment in the bind graph (shared
+        nodes counted once)."""
+        names = []
+        seen = set()
+
+        def walk(app: "Application"):
+            if id(app) in seen:
+                return
+            seen.add(id(app))
+            names.append(app._deployment.name)
+            for a in list(app._init_args) + \
+                    list(app._init_kwargs.values()):
+                if isinstance(a, Application):
+                    walk(a)
+
+        walk(self)
+        return names
+
+    def with_deployment_overrides(self,
+                                  overrides: dict) -> "Application":
+        """Rebuild the bind graph applying per-deployment option
+        overrides (declarative config; reference: config deployments
+        overriding code-declared options). Shared nodes stay shared —
+        build_app dedups by object identity, so a diamond graph must map
+        each original node to exactly ONE rebuilt node."""
+        rebuilt: dict = {}
+
+        def rebuild(app: "Application") -> "Application":
+            cached = rebuilt.get(id(app))
+            if cached is not None:
+                return cached
+            dep = app._deployment
+            ov = overrides.get(dep.name)
+            if ov:
+                dep = dep.options(**ov)
+            args = tuple(rebuild(a) if isinstance(a, Application) else a
+                         for a in app._init_args)
+            kwargs = {k: rebuild(v) if isinstance(v, Application) else v
+                      for k, v in app._init_kwargs.items()}
+            new = Application(dep, args, kwargs)
+            rebuilt[id(app)] = new
+            return new
+
+        return rebuild(self)
+
 
 def build_app(app: Application, app_name: str) -> List[dict]:
     """Flatten the bind graph into controller deploy payloads. The root is

@@ -1,0 +1,135 @@
+"""Serve declarative config: YAML/dict schema + build/deploy.
+
+Reference: python/ray/serve/schema.py (ServeDeploySchema: applications
+with import_path + per-deployment overrides) and the `serve deploy` /
+`serve build` CLI. An application's import_path points at a bound
+Application object (`module.sub:app`); per-deployment option overrides
+from the config are applied before serve.run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class DeploymentSchema:
+    name: str
+    num_replicas: Optional[int] = None
+    max_ongoing_requests: Optional[int] = None
+    user_config: Optional[dict] = None
+    autoscaling_config: Optional[dict] = None
+    ray_actor_options: Optional[dict] = None
+
+    def overrides(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for field in ("num_replicas", "max_ongoing_requests",
+                      "user_config", "autoscaling_config",
+                      "ray_actor_options"):
+            v = getattr(self, field)
+            if v is not None:
+                out[field] = v
+        return out
+
+
+@dataclasses.dataclass
+class ApplicationSchema:
+    import_path: str
+    name: str = "default"
+    # "/" when omitted; an EXPLICIT null in the config means handle-only
+    # (no HTTP route) — serve.run(route_prefix=None) semantics.
+    route_prefix: Optional[str] = "/"
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    deployments: List[DeploymentSchema] = dataclasses.field(
+        default_factory=list)
+
+    @staticmethod
+    def from_dict(d: dict) -> "ApplicationSchema":
+        deps = [DeploymentSchema(**dep)
+                for dep in d.get("deployments", [])]
+        return ApplicationSchema(
+            import_path=d["import_path"],
+            name=d.get("name", "default"),
+            route_prefix=d.get("route_prefix", "/"),
+            args=d.get("args", {}),
+            deployments=deps)
+
+
+@dataclasses.dataclass
+class ServeDeploySchema:
+    applications: List[ApplicationSchema]
+
+    @staticmethod
+    def from_dict(d: dict) -> "ServeDeploySchema":
+        schema = ServeDeploySchema(
+            applications=[ApplicationSchema.from_dict(a)
+                          for a in d.get("applications", [])])
+        prefixes = [a.route_prefix for a in schema.applications
+                    if a.route_prefix is not None]
+        dupes = {p for p in prefixes if prefixes.count(p) > 1}
+        if dupes:
+            raise ValueError(
+                f"route_prefix collision across applications: "
+                f"{sorted(dupes)!r} — give each app a distinct prefix "
+                "(or route_prefix: null for handle-only apps)")
+        return schema
+
+    @staticmethod
+    def from_file(path: str) -> "ServeDeploySchema":
+        with open(path) as f:
+            text = f.read()
+        try:
+            import yaml
+
+            data = yaml.safe_load(text)
+        except ImportError:
+            data = json.loads(text)
+        return ServeDeploySchema.from_dict(data)
+
+
+def _import_application(import_path: str, args: Dict[str, Any]):
+    """'pkg.module:attr' -> a bound Application. `attr` may be the app
+    itself or a builder fn taking the schema args dict."""
+    module_path, _, attr = import_path.partition(":")
+    if not attr:
+        raise ValueError(
+            f"import_path {import_path!r} must be 'module.sub:attr'")
+    module = importlib.import_module(module_path)
+    target = getattr(module, attr)
+    if hasattr(target, "deployments"):  # already a bound Application
+        return target
+    if callable(target):  # app builder fn
+        return target(args)
+    raise TypeError(
+        f"{import_path!r} is neither a bound Application nor a builder")
+
+
+def deploy_from_schema(schema: ServeDeploySchema) -> Dict[str, Any]:
+    """Run every application in the schema; returns name -> handle."""
+    from ray_tpu import serve
+
+    handles = {}
+    for app_schema in schema.applications:
+        app = _import_application(app_schema.import_path,
+                                  app_schema.args)
+        overrides = {d.name: d.overrides()
+                     for d in app_schema.deployments}
+        if overrides:
+            unknown = set(overrides) - set(app.deployments)
+            if unknown:
+                raise ValueError(
+                    f"config overrides for unknown deployments "
+                    f"{sorted(unknown)!r}; app {app_schema.name!r} has "
+                    f"{sorted(app.deployments)!r}")
+            app = app.with_deployment_overrides(overrides)
+        handles[app_schema.name] = serve.run(
+            app, name=app_schema.name,
+            route_prefix=app_schema.route_prefix)
+    return handles
+
+
+def deploy_config_file(path: str) -> Dict[str, Any]:
+    return deploy_from_schema(ServeDeploySchema.from_file(path))

@@ -216,3 +216,27 @@ def test_fault_tolerant_actor_manager(ray_start_regular):
     assert restored == [1]
     res = mgr.foreach(lambda a: a.work.remote())
     assert sorted(res.values()) == [0, 1, 2]
+
+
+def test_impala_learns_cartpole():
+    from ray_tpu.rllib.algorithms.impala import IMPALAConfig
+
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .training(train_batch_size=512, lr=5e-4,
+                        entropy_coeff=0.005)
+              .debugging(seed=2))
+    algo = config.build_algo()
+    first = None
+    best = -float("inf")
+    for i in range(30):
+        result = algo.step()
+        ret = result.get("episode_return_mean")
+        if ret == ret:  # not NaN
+            if first is None:
+                first = ret
+            best = max(best, ret)
+    assert first is not None
+    assert best > first + 15, (first, best)
+    assert result["mean_rho"] > 0.2  # importance ratios sane
+    algo.cleanup()

@@ -249,3 +249,69 @@ class TestHTTPProxy:
             routes = json.loads(resp.read())
         assert "/api" in routes
         serve.delete("http_app")
+
+
+def test_declarative_schema_deploy(ray_start_regular, tmp_path):
+    import json
+    import sys
+
+    from ray_tpu import serve
+
+    # An importable module hosting a bound app.
+    mod_dir = tmp_path / "apps"
+    mod_dir.mkdir()
+    (mod_dir / "my_serve_app.py").write_text(
+        "from ray_tpu import serve\n"
+        "\n"
+        "@serve.deployment\n"
+        "class Echo:\n"
+        "    def __init__(self, prefix='echo'):\n"
+        "        self.prefix = prefix\n"
+        "    def __call__(self, req):\n"
+        "        return f'{self.prefix}:{req}'\n"
+        "\n"
+        "app = Echo.bind()\n")
+    sys.path.insert(0, str(mod_dir))
+    try:
+        cfg = {
+            "applications": [{
+                "name": "echo_app",
+                "import_path": "my_serve_app:app",
+                "route_prefix": "/echo",
+                "deployments": [{"name": "Echo", "num_replicas": 2}],
+            }]
+        }
+        cfg_path = tmp_path / "serve_config.json"
+        cfg_path.write_text(json.dumps(cfg))
+
+        handles = serve.deploy_config_file(str(cfg_path))
+        handle = handles["echo_app"]
+        assert handle.remote("hi").result(timeout_s=30) == "echo:hi"
+        st = serve.status()
+        app_status = st["applications"]["echo_app"]
+        deps = app_status["deployments"]
+        assert deps["Echo"]["replica_states"].get("RUNNING", 0) == 2
+        serve.delete("echo_app")
+    finally:
+        sys.path.remove(str(mod_dir))
+        sys.modules.pop("my_serve_app", None)
+
+
+def test_application_overrides_graph():
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Inner:
+        pass
+
+    @serve.deployment
+    class Outer:
+        def __init__(self, inner):
+            pass
+
+    app = Outer.bind(Inner.bind())
+    assert set(app.deployments) == {"Inner", "Outer"}
+    app2 = app.with_deployment_overrides({"Inner": {"num_replicas": 3}})
+    inner_app = app2._init_args[0]
+    assert inner_app.deployment._config.num_replicas == 3
+    assert app2.deployment._config.num_replicas == 1
