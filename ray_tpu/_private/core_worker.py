@@ -662,7 +662,8 @@ class CoreWorker:
     async def _request_lease(self, spec: TaskSpec, key: tuple,
                              state: _SchedulingKeyState,
                              raylet_address: Optional[str] = None,
-                             num_spillbacks: int = 0) -> None:
+                             num_spillbacks: int = 0,
+                             lease_attempts: int = 0) -> None:
         lease_id = os.urandom(16)
         try:
             if raylet_address is None and spec.placement_group_id is not None:
@@ -692,13 +693,27 @@ class CoreWorker:
                 "num_spillbacks": num_spillbacks,
             }, timeout=self.config.worker_lease_timeout_s + 60)
         except Exception as e:
+            # A raylet dying mid-lease (e.g. a spillback target) is a
+            # transient infrastructure failure, not a task failure: retry
+            # via the local raylet, whose refreshed cluster view spills
+            # to nodes that are still alive.
+            if lease_attempts < 3:
+                logger.info(
+                    "lease via %s failed (%r); retrying via local raylet "
+                    "(attempt %d)", raylet_address, e, lease_attempts + 1)
+                await asyncio.sleep(0.2 * (lease_attempts + 1))
+                await self._request_lease(
+                    spec, key, state, raylet_address=None,
+                    num_spillbacks=0, lease_attempts=lease_attempts + 1)
+                return
             state.requests_inflight -= 1
             self._fail_queued(key, state, f"lease request failed: {e!r}")
             return
         if reply.get("spillback"):
             await self._request_lease(spec, key, state,
                                       raylet_address=reply["spillback"],
-                                      num_spillbacks=num_spillbacks + 1)
+                                      num_spillbacks=num_spillbacks + 1,
+                                      lease_attempts=lease_attempts)
             return
         state.requests_inflight -= 1
         if reply.get("error"):

@@ -87,6 +87,22 @@ class ActorClassNode(DAGNode):
             self._handle = self._actor_cls.remote(*args, **kwargs)
         return self._handle
 
+    def __getattr__(self, name: str) -> "_DagMethod":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _DagMethod(self, name)
+
+
+class _DagMethod:
+    """`Actor.bind(...).method.bind(args)` — method-call node factory."""
+
+    def __init__(self, node: "ActorClassNode", method: str):
+        self._node = node
+        self._method = method
+
+    def bind(self, *args, **kwargs) -> "ActorMethodNode":
+        return ActorMethodNode(self._node, self._method, args, kwargs)
+
 
 class ActorMethodNode(DAGNode):
     def __init__(self, handle_or_node, method: str, args: tuple,

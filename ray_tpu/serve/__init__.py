@@ -28,6 +28,11 @@ from ray_tpu.serve.schema import (ApplicationSchema, DeploymentSchema,
                                   deploy_from_schema)
 
 __all__ = [
+    "ApplicationSchema",
+    "DeploymentSchema",
+    "ServeDeploySchema",
+    "deploy_config_file",
+    "deploy_from_schema",
     "Application",
     "AutoscalingConfig",
     "Deployment",

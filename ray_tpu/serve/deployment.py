@@ -68,24 +68,38 @@ class Application:
     def deployment(self) -> Deployment:
         return self._deployment
 
+    @staticmethod
+    def _map_graph(value, on_app):
+        """One container-aware traversal shared by every bind-graph walk
+        (matches build_app's resolve(): Applications may be nested in
+        lists/tuples/dicts of init args)."""
+        if isinstance(value, Application):
+            return on_app(value)
+        if isinstance(value, (list, tuple)):
+            return type(value)(Application._map_graph(x, on_app)
+                               for x in value)
+        if isinstance(value, dict):
+            return {k: Application._map_graph(x, on_app)
+                    for k, x in value.items()}
+        return value
+
     @property
     def deployments(self) -> list:
         """Names of every unique deployment in the bind graph (shared
-        nodes counted once)."""
+        nodes counted once; container-nested bindings included)."""
         names = []
         seen = set()
 
-        def walk(app: "Application"):
-            if id(app) in seen:
-                return
-            seen.add(id(app))
-            names.append(app._deployment.name)
-            for a in list(app._init_args) + \
-                    list(app._init_kwargs.values()):
-                if isinstance(a, Application):
-                    walk(a)
+        def visit(app: "Application"):
+            if id(app) not in seen:
+                seen.add(id(app))
+                names.append(app._deployment.name)
+                for a in list(app._init_args) + \
+                        list(app._init_kwargs.values()):
+                    Application._map_graph(a, visit)
+            return app
 
-        walk(self)
+        visit(self)
         return names
 
     def with_deployment_overrides(self,
@@ -94,7 +108,9 @@ class Application:
         overrides (declarative config; reference: config deployments
         overriding code-declared options). Shared nodes stay shared —
         build_app dedups by object identity, so a diamond graph must map
-        each original node to exactly ONE rebuilt node."""
+        each original node to exactly ONE rebuilt node. Applications
+        nested inside list/tuple/dict init args are handled like
+        build_app does."""
         rebuilt: dict = {}
 
         def rebuild(app: "Application") -> "Application":
@@ -105,9 +121,9 @@ class Application:
             ov = overrides.get(dep.name)
             if ov:
                 dep = dep.options(**ov)
-            args = tuple(rebuild(a) if isinstance(a, Application) else a
+            args = tuple(Application._map_graph(a, rebuild)
                          for a in app._init_args)
-            kwargs = {k: rebuild(v) if isinstance(v, Application) else v
+            kwargs = {k: Application._map_graph(v, rebuild)
                       for k, v in app._init_kwargs.items()}
             new = Application(dep, args, kwargs)
             rebuilt[id(app)] = new

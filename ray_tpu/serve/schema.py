@@ -86,7 +86,13 @@ class ServeDeploySchema:
 
             data = yaml.safe_load(text)
         except ImportError:
-            data = json.loads(text)
+            try:
+                data = json.loads(text)
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path!r} is not valid JSON and PyYAML is not "
+                    "installed — install PyYAML for YAML configs or "
+                    "provide the config as JSON") from e
         return ServeDeploySchema.from_dict(data)
 
 
