@@ -11,6 +11,7 @@ Parity map to the reference (python/ray/train/):
 
 from ray_tpu.air import (CheckpointConfig, FailureConfig, Result, RunConfig,
                          ScalingConfig)
+from ray_tpu.train.array_checkpoint import restore_pytree, save_pytree
 from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.data_parallel_trainer import (DataParallelTrainer,
@@ -21,6 +22,8 @@ from ray_tpu.train._internal.session import (get_checkpoint, get_context,
 
 __all__ = [
     "Backend",
+    "restore_pytree",
+    "save_pytree",
     "BackendConfig",
     "Checkpoint",
     "CheckpointConfig",

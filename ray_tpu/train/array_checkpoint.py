@@ -1,0 +1,176 @@
+"""Sharded jax.Array checkpointing — orbax/tensorstore-style layout.
+
+Reference role: python/ray/train checkpoints hold torch state dicts; the
+TPU-native equivalent must persist GSPMD-sharded arrays. Design:
+
+- save: every host writes only its OWN addressable shards (no gather —
+  checkpoint bandwidth scales with hosts), one .npy per shard plus a
+  JSON index describing global shape/dtype and each shard's index
+  slices.
+- restore: `jax.make_array_from_callback` pulls exactly the slices each
+  device needs, reading only the shard files that overlap — works
+  across a DIFFERENT mesh/sharding than the one that saved (reshard on
+  restore), and across single-host/multi-host boundaries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+Pytree = Any
+
+_INDEX = "array_index.json"
+
+
+def _leaf_paths(tree: Pytree) -> List[Tuple[str, Any]]:
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for keypath, leaf in flat:
+        name = "/".join(_key_str(k) for k in keypath)
+        out.append((name, leaf))
+    return out
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)
+
+
+def _slices_to_json(index: Tuple[slice, ...], shape) -> List[List[int]]:
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def save_pytree(tree: Pytree, path: str,
+                process_index: Optional[int] = None) -> None:
+    """Write this process's addressable shards of every leaf.
+
+    Multi-host: every process calls this with the same path (shared
+    filesystem); shard files are keyed by device id so writers never
+    collide. Process 0 writes the index."""
+    import jax
+
+    process_index = jax.process_index() if process_index is None \
+        else process_index
+    data_dir = os.path.join(path, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    index: Dict[str, Any] = {"leaves": []}
+    for name, leaf in _leaf_paths(tree):
+        arr = leaf
+        safe = name.replace("/", ".")
+        dtype = getattr(arr, "dtype", None) or np.asarray(arr).dtype
+        entry = {"name": name, "shape": list(np.shape(arr)),
+                 "dtype": str(dtype), "shards": []}
+        if hasattr(arr, "addressable_shards"):
+            for shard in arr.addressable_shards:
+                fname = f"{safe}.d{shard.device.id}.npy"
+                np.save(os.path.join(data_dir, fname),
+                        np.asarray(shard.data))
+                entry["shards"].append({
+                    "file": fname,
+                    "index": _slices_to_json(shard.index, arr.shape),
+                })
+        else:
+            fname = f"{safe}.host.npy"
+            np.save(os.path.join(data_dir, fname), np.asarray(arr))
+            entry["shards"].append({
+                "file": fname,
+                "index": _slices_to_json(
+                    tuple(slice(0, d) for d in np.shape(arr)),
+                    np.shape(arr)),
+            })
+        index["leaves"].append(entry)
+    if process_index == 0:
+        tmp = os.path.join(path, _INDEX + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(index, f)
+        os.replace(tmp, os.path.join(path, _INDEX))
+
+
+def _read_region(data_dir: str, entry: dict,
+                 want: Tuple[slice, ...]) -> np.ndarray:
+    """Assemble the requested region from overlapping shard files."""
+    shape = entry["shape"]
+    want_bounds = []
+    for sl, dim in zip(want, shape):
+        start = 0 if sl.start is None else sl.start
+        stop = dim if sl.stop is None else sl.stop
+        want_bounds.append((int(start), int(stop)))
+    out_shape = [b - a for a, b in want_bounds]
+    out = np.empty(out_shape, dtype=np.dtype(entry["dtype"]))
+    filled = 0
+    for shard in entry["shards"]:
+        bounds = shard["index"]
+        # Overlap per dim.
+        inter = []
+        ok = True
+        for (wa, wb), (sa, sb) in zip(want_bounds, bounds):
+            a, b = max(wa, sa), min(wb, sb)
+            if a >= b:
+                ok = False
+                break
+            inter.append((a, b, sa, wa))
+        if not ok:
+            continue
+        data = np.load(os.path.join(data_dir, shard["file"]))
+        src = tuple(slice(a - sa, b - sa) for a, b, sa, _ in inter)
+        dst = tuple(slice(a - wa, b - wa) for a, b, _, wa in inter)
+        out[dst] = data[src]
+        filled += int(np.prod([b - a for a, b, _, _ in inter]))
+    if filled < int(np.prod(out_shape)):
+        raise ValueError(
+            f"checkpoint region {want_bounds} of {entry['name']} is "
+            "incomplete (missing shard files — all hosts' shards must be "
+            "visible at restore)")
+    return out
+
+
+def restore_pytree(template: Pytree, path: str,
+                   shardings: Optional[Pytree] = None) -> Pytree:
+    """Restore into the structure of `template`.
+
+    shardings: optional matching pytree of jax.sharding.Sharding — each
+    device reads exactly the slices it owns (resharding on restore).
+    Without shardings, leaves come back as host numpy arrays."""
+    import jax
+
+    with open(os.path.join(path, _INDEX)) as f:
+        index = json.load(f)
+    by_name = {e["name"]: e for e in index["leaves"]}
+    data_dir = os.path.join(path, "data")
+
+    flat_t, treedef = jax.tree_util.tree_flatten_with_path(template)
+    flat_s = None
+    if shardings is not None:
+        flat_s = [s for _, s in _leaf_paths(shardings)]
+    out = []
+    for i, (keypath, _leaf) in enumerate(flat_t):
+        name = "/".join(_key_str(k) for k in keypath)
+        entry = by_name.get(name)
+        if entry is None:
+            raise KeyError(f"leaf {name!r} not in checkpoint")
+        shape = tuple(entry["shape"])
+        dtype = np.dtype(entry["dtype"])
+        if flat_s is not None:
+            sharding = flat_s[i]
+            arr = jax.make_array_from_callback(
+                shape, sharding,
+                lambda idx, e=entry: _read_region(data_dir, e, idx))
+            out.append(arr)
+        else:
+            out.append(_read_region(
+                data_dir, entry, tuple(slice(0, d) for d in shape)))
+    return jax.tree_util.tree_unflatten(treedef, out)
