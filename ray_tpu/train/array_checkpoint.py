@@ -4,17 +4,26 @@ Reference role: python/ray/train checkpoints hold torch state dicts; the
 TPU-native equivalent must persist GSPMD-sharded arrays. Design:
 
 - save: every host writes only its OWN addressable shards (no gather —
-  checkpoint bandwidth scales with hosts), one .npy per shard plus a
-  JSON index describing global shape/dtype and each shard's index
-  slices.
-- restore: `jax.make_array_from_callback` pulls exactly the slices each
-  device needs, reading only the shard files that overlap — works
-  across a DIFFERENT mesh/sharding than the one that saved (reshard on
-  restore), and across single-host/multi-host boundaries.
+  checkpoint bandwidth scales with hosts), deduplicated by shard index
+  (replicated leaves are written once per unique region, not once per
+  device). Each process atomically publishes its own partial index
+  (`array_index.p<k>.json`) after its data is on disk.
+- restore: indexes from ALL processes are merged; a coverage mask
+  guarantees every element of a requested region is backed by a shard
+  file (a torn/partial checkpoint fails loudly, never returns
+  uninitialized memory). `jax.make_array_from_callback` pulls exactly
+  the slices each device needs, so a checkpoint saved under one
+  mesh/sharding restores under a different one.
+
+Durability note: a checkpoint is complete once every participating
+process has published its partial index. Callers that need an explicit
+commit point should barrier after save_pytree (e.g.
+ray_tpu.collective.barrier) and then write their own marker.
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
@@ -23,7 +32,28 @@ import numpy as np
 
 Pytree = Any
 
-_INDEX = "array_index.json"
+_INDEX_GLOB = "array_index.p*.json"
+
+
+def _dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 / fp8 etc. live in ml_dtypes, not base numpy.
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _storable(a: np.ndarray) -> np.ndarray:
+    """npy round-trips base dtypes only: exotic dtypes (bfloat16, fp8)
+    are stored bit-cast to a same-width uint; the index's logical dtype
+    restores the view on load."""
+    try:
+        np.dtype(str(a.dtype))
+        return a
+    except TypeError:
+        return a.view(np.dtype(f"uint{a.dtype.itemsize * 8}"))
 
 
 def _leaf_paths(tree: Pytree) -> List[Tuple[str, Any]]:
@@ -58,9 +88,9 @@ def save_pytree(tree: Pytree, path: str,
                 process_index: Optional[int] = None) -> None:
     """Write this process's addressable shards of every leaf.
 
-    Multi-host: every process calls this with the same path (shared
-    filesystem); shard files are keyed by device id so writers never
-    collide. Process 0 writes the index."""
+    Multi-host: every process calls this with the same (shared) path;
+    shard files are keyed by (leaf ordinal, device id) so writers never
+    collide, and each process publishes its own partial index."""
     import jax
 
     process_index = jax.process_index() if process_index is None \
@@ -68,24 +98,34 @@ def save_pytree(tree: Pytree, path: str,
     data_dir = os.path.join(path, "data")
     os.makedirs(data_dir, exist_ok=True)
     index: Dict[str, Any] = {"leaves": []}
-    for name, leaf in _leaf_paths(tree):
+    for ordinal, (name, leaf) in enumerate(_leaf_paths(tree)):
         arr = leaf
-        safe = name.replace("/", ".")
         dtype = getattr(arr, "dtype", None) or np.asarray(arr).dtype
         entry = {"name": name, "shape": list(np.shape(arr)),
                  "dtype": str(dtype), "shards": []}
+        # File names use the leaf ordinal (collision-proof: user keys may
+        # contain '/', '.', anything).
+        prefix = f"leaf{ordinal:05d}"
         if hasattr(arr, "addressable_shards"):
+            written = set()
             for shard in arr.addressable_shards:
-                fname = f"{safe}.d{shard.device.id}.npy"
+                region = tuple(
+                    tuple(b) for b in _slices_to_json(shard.index,
+                                                      arr.shape))
+                if region in written:
+                    continue  # replicated copy — one write per region
+                written.add(region)
+                fname = f"{prefix}.d{shard.device.id}.npy"
                 np.save(os.path.join(data_dir, fname),
-                        np.asarray(shard.data))
+                        _storable(np.asarray(shard.data)))
                 entry["shards"].append({
                     "file": fname,
-                    "index": _slices_to_json(shard.index, arr.shape),
+                    "index": [list(b) for b in region],
                 })
         else:
-            fname = f"{safe}.host.npy"
-            np.save(os.path.join(data_dir, fname), np.asarray(arr))
+            fname = f"{prefix}.p{process_index}.npy"
+            np.save(os.path.join(data_dir, fname),
+                    _storable(np.asarray(arr)))
             entry["shards"].append({
                 "file": fname,
                 "index": _slices_to_json(
@@ -93,16 +133,42 @@ def save_pytree(tree: Pytree, path: str,
                     np.shape(arr)),
             })
         index["leaves"].append(entry)
-    if process_index == 0:
-        tmp = os.path.join(path, _INDEX + ".tmp")
-        with open(tmp, "w") as f:
-            json.dump(index, f)
-        os.replace(tmp, os.path.join(path, _INDEX))
+    # Publish this process's partial index atomically AFTER its data.
+    final = os.path.join(path, f"array_index.p{process_index}.json")
+    tmp = final + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(index, f)
+    os.replace(tmp, final)
+
+
+def _merged_index(path: str) -> Dict[str, dict]:
+    """name -> entry with shards merged across every process's index."""
+    files = sorted(glob.glob(os.path.join(path, _INDEX_GLOB)))
+    if not files:
+        raise FileNotFoundError(
+            f"no {_INDEX_GLOB} under {path!r} — not a checkpoint")
+    merged: Dict[str, dict] = {}
+    for fname in files:
+        with open(fname) as f:
+            index = json.load(f)
+        for entry in index["leaves"]:
+            cur = merged.get(entry["name"])
+            if cur is None:
+                merged[entry["name"]] = {
+                    **entry, "shards": list(entry["shards"])}
+            else:
+                seen = {json.dumps(s["index"]) for s in cur["shards"]}
+                for s in entry["shards"]:
+                    if json.dumps(s["index"]) not in seen:
+                        cur["shards"].append(s)
+    return merged
 
 
 def _read_region(data_dir: str, entry: dict,
                  want: Tuple[slice, ...]) -> np.ndarray:
-    """Assemble the requested region from overlapping shard files."""
+    """Assemble the requested region from overlapping shard files; every
+    element must be covered (torn checkpoints fail, never return
+    uninitialized memory)."""
     shape = entry["shape"]
     want_bounds = []
     for sl, dim in zip(want, shape):
@@ -110,11 +176,10 @@ def _read_region(data_dir: str, entry: dict,
         stop = dim if sl.stop is None else sl.stop
         want_bounds.append((int(start), int(stop)))
     out_shape = [b - a for a, b in want_bounds]
-    out = np.empty(out_shape, dtype=np.dtype(entry["dtype"]))
-    filled = 0
+    out = np.empty(out_shape, dtype=_dtype(entry["dtype"]))
+    covered = np.zeros(out_shape, dtype=bool)
     for shard in entry["shards"]:
         bounds = shard["index"]
-        # Overlap per dim.
         inter = []
         ok = True
         for (wa, wb), (sa, sb) in zip(want_bounds, bounds):
@@ -125,16 +190,21 @@ def _read_region(data_dir: str, entry: dict,
             inter.append((a, b, sa, wa))
         if not ok:
             continue
-        data = np.load(os.path.join(data_dir, shard["file"]))
+        try:
+            data = np.load(os.path.join(data_dir, shard["file"]))
+        except OSError:
+            continue  # missing/torn file -> coverage check reports it
+        if data.dtype != out.dtype:
+            data = data.view(out.dtype)  # exotic dtype stored bit-cast
         src = tuple(slice(a - sa, b - sa) for a, b, sa, _ in inter)
         dst = tuple(slice(a - wa, b - wa) for a, b, _, wa in inter)
         out[dst] = data[src]
-        filled += int(np.prod([b - a for a, b, _, _ in inter]))
-    if filled < int(np.prod(out_shape)):
+        covered[dst] = True
+    if not covered.all():
         raise ValueError(
             f"checkpoint region {want_bounds} of {entry['name']} is "
-            "incomplete (missing shard files — all hosts' shards must be "
-            "visible at restore)")
+            "incomplete (missing shard files — all hosts' shards and "
+            "indexes must be visible at restore)")
     return out
 
 
@@ -142,30 +212,32 @@ def restore_pytree(template: Pytree, path: str,
                    shardings: Optional[Pytree] = None) -> Pytree:
     """Restore into the structure of `template`.
 
-    shardings: optional matching pytree of jax.sharding.Sharding — each
-    device reads exactly the slices it owns (resharding on restore).
-    Without shardings, leaves come back as host numpy arrays."""
+    shardings: optional pytree of jax.sharding.Sharding, matched to
+    template leaves BY KEYPATH (missing entries raise) — each device
+    reads exactly the slices it owns, resharding on restore. Without
+    shardings, leaves come back as host numpy arrays."""
     import jax
 
-    with open(os.path.join(path, _INDEX)) as f:
-        index = json.load(f)
-    by_name = {e["name"]: e for e in index["leaves"]}
+    by_name = _merged_index(path)
     data_dir = os.path.join(path, "data")
 
-    flat_t, treedef = jax.tree_util.tree_flatten_with_path(template)
-    flat_s = None
+    sharding_by_name: Optional[Dict[str, Any]] = None
     if shardings is not None:
-        flat_s = [s for _, s in _leaf_paths(shardings)]
+        sharding_by_name = dict(_leaf_paths(shardings))
+
+    flat_t, treedef = jax.tree_util.tree_flatten_with_path(template)
     out = []
-    for i, (keypath, _leaf) in enumerate(flat_t):
+    for keypath, _leaf in flat_t:
         name = "/".join(_key_str(k) for k in keypath)
         entry = by_name.get(name)
         if entry is None:
             raise KeyError(f"leaf {name!r} not in checkpoint")
         shape = tuple(entry["shape"])
-        dtype = np.dtype(entry["dtype"])
-        if flat_s is not None:
-            sharding = flat_s[i]
+        if sharding_by_name is not None:
+            sharding = sharding_by_name.get(name)
+            if sharding is None:
+                raise KeyError(
+                    f"shardings pytree has no entry for leaf {name!r}")
             arr = jax.make_array_from_callback(
                 shape, sharding,
                 lambda idx, e=entry: _read_region(data_dir, e, idx))
