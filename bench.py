@@ -78,9 +78,9 @@ def main():
         jax.random.PRNGKey(1), (batch_size, seq_len + 1), 0, cfg.vocab_size)
     batch = {"tokens": tokens}
 
-    # compile + warmup
+    # compile + warmup (float() forces the device sync)
     params, opt_state, metrics = step_fn(params, opt_state, batch)
-    jax.block_until_ready(metrics["loss"])
+    loss_before = float(metrics["loss"])
 
     # Two timed trials, best-of: the chip may be shared (tunnel pool) and
     # a single window under-measures steady-state throughput.
@@ -92,6 +92,14 @@ def main():
         jax.block_until_ready(metrics["loss"])
         best_dt = min(best_dt, time.perf_counter() - t0)
     dt = best_dt
+    # Execution sanity: training on a fixed batch must move the loss; a
+    # degraded remote-execution path that no-ops steps would otherwise
+    # report absurd throughput.
+    loss_after = float(metrics["loss"])
+    if loss_after == loss_before:
+        raise RuntimeError(
+            "benchmark steps did not execute (loss unchanged) — "
+            "remote TPU path degraded; rerun")
 
     tokens_per_step = batch_size * seq_len
     tokens_per_sec = tokens_per_step * steps / dt

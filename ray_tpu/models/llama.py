@@ -44,6 +44,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16          # activation dtype
     param_dtype: Any = jnp.float32     # master weights
     remat: bool = True
+    # Per-layer checkpoint policy: "full" recomputes everything (min
+    # HBM), "save_dots" keeps matmul outputs (recompute only cheap
+    # elementwise — more HBM, fewer recomputed FLOPs).
+    remat_policy: str = "full"
     attn_impl: str = "auto"            # auto|flash|reference|ring
     ring_axis: str = "sp"
 
@@ -224,7 +228,17 @@ def llama_forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
 
     layer_fn = functools.partial(_decoder_layer, positions=positions, cfg=cfg)
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        if cfg.remat_policy == "save_dots":
+            layer_fn = jax.checkpoint(
+                layer_fn,
+                policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable)
+        elif cfg.remat_policy == "full":
+            layer_fn = jax.checkpoint(layer_fn)
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r} "
+                "(expected 'full' or 'save_dots')")
 
     def scan_body(h, layer):
         return layer_fn(h, layer), None

@@ -44,6 +44,45 @@ class ServeRequest:
         return (self.body or b"").decode()
 
 
+class _RpcIngress:
+    """rpc-framing ingress beside HTTP (the reference's gRPCProxy
+    analog): `serve_call {app, deployment?, method?, payload}` routes
+    through the same DeploymentHandle data plane."""
+
+    def __init__(self, proxy: "ProxyActor"):
+        self._proxy = proxy
+
+    async def handle_serve_call(self, data, conn):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        app_name = data.get("app", "default")
+        deployment = data.get("deployment")
+        if deployment is None:
+            # Route by app name through the route table (ingress
+            # deployment of that app).
+            entry = next((e for e in
+                          self._proxy._route_table.values()
+                          if e["app_name"] == app_name), None)
+            if entry is None:
+                raise ValueError(f"no application {app_name!r}")
+            deployment = entry["deployment"]
+        handle = DeploymentHandle(deployment, app_name)
+        if data.get("method"):
+            handle = handle.options(method_name=data["method"])
+        self._proxy._num_requests += 1
+        loop = asyncio.get_running_loop()
+        response = await loop.run_in_executor(
+            None, lambda: handle.remote(data.get("payload")))
+        # Same bound as the HTTP path: a hung replica must not leak the
+        # serve task/connection forever.
+        return await asyncio.wait_for(_await_response(response),
+                                      timeout=60)
+
+
+async def _await_response(response):
+    return await response
+
+
 @ray_tpu.remote(max_concurrency=1000, lifetime="detached",
                 namespace=SERVE_NAMESPACE)
 class ProxyActor:
@@ -71,8 +110,14 @@ class ProxyActor:
 
     def status(self) -> dict:
         return {"address": f"http://{self._host}:{self._port}",
+                "rpc_port": getattr(self, "_rpc_port", 0),
                 "num_requests": self._num_requests,
                 "routes": sorted(self._route_table)}
+
+    def rpc_address(self) -> str:
+        """Address of the rpc ingress (gRPC-proxy analog)."""
+        self.ready()
+        return f"{self._host}:{self._rpc_port}"
 
     def stop_server(self) -> None:
         if self._server_loop is not None and self._stop_evt is not None:
@@ -137,9 +182,18 @@ class ProxyActor:
         await runner.setup()
         site = web.TCPSite(runner, self._host, self._port)
         await site.start()
+        # Second ingress: the framework's rpc framing (reference:
+        # gRPCProxy beside HTTPProxy, proxy.py:540) — clients call
+        # `serve_call {app, method, payload}` with msgpack payloads
+        # instead of HTTP.
+        from ray_tpu.core import rpc as _rpc
+
+        self._rpc_server = _rpc.Server(_RpcIngress(self), self._host, 0)
+        self._rpc_port = await self._rpc_server.start()
         self._ready_evt.set()
         logger.info("Serve proxy listening on %s:%d", self._host, self._port)
         await self._stop_evt.wait()
+        await self._rpc_server.close()
         await runner.cleanup()
 
     async def _handle_http(self, request):

@@ -356,3 +356,36 @@ def test_jax_model_deployment_with_batching(ray_start_regular):
     b = handle.remote([0.5] * 4).result(timeout_s=30)
     assert a == b
     serve.delete("jax_model")
+
+
+def test_rpc_ingress(ray_start_regular):
+    """The rpc-framing ingress (gRPC-proxy analog) routes serve_call
+    requests through the same data plane as HTTP."""
+    import asyncio
+
+    from ray_tpu import serve
+    from ray_tpu.core import rpc
+    from ray_tpu.core.actor import get_actor
+    from ray_tpu.serve._private.common import SERVE_NAMESPACE
+
+    @serve.deployment
+    class Upper:
+        def __call__(self, text):
+            return str(text).upper()
+
+    serve.run(Upper.bind(), name="rpc_app", route_prefix="/rpc_app")
+    proxy = get_actor("SERVE_PROXY", namespace=SERVE_NAMESPACE)
+    address = ray_tpu.get(proxy.rpc_address.remote())
+    host, port = address.rsplit(":", 1)
+
+    async def call():
+        conn = await rpc.connect(host, int(port))
+        try:
+            out = await conn.call("serve_call", {
+                "app": "rpc_app", "payload": "hello"}, timeout=30)
+            return out
+        finally:
+            await conn.close()
+
+    assert asyncio.run(call()) == "HELLO"
+    serve.delete("rpc_app")
