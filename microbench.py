@@ -1,0 +1,99 @@
+"""Core-runtime microbenchmarks (reference: python/ray/_private/ray_perf.py
++ release/microbenchmark/): task throughput, actor call latency, object
+store put/get bandwidth. Prints one JSON line per metric.
+
+Run: python microbench.py [--quick]
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+def timed(fn, n):
+    t0 = time.perf_counter()
+    fn()
+    return n / (time.perf_counter() - t0)
+
+
+def main(quick: bool = False):
+    import numpy as np
+
+    import ray_tpu
+
+    scale = 0.1 if quick else 1.0
+    ray_tpu.init(num_cpus=4)
+    results = []
+
+    # --- trivial task throughput (pipelined) ---
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    n = int(2000 * scale)
+    ray_tpu.get([noop.remote() for _ in range(20)])  # warm workers
+
+    def tasks():
+        ray_tpu.get([noop.remote() for _ in range(n)])
+
+    results.append(("tasks_per_second", timed(tasks, n), "tasks/s"))
+
+    # --- single actor call latency / throughput ---
+    @ray_tpu.remote
+    class A:
+        def m(self, x=None):
+            return x
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote())
+    n = int(2000 * scale)
+
+    def actor_sync():
+        for _ in range(n):
+            ray_tpu.get(a.m.remote())
+
+    rate = timed(actor_sync, n)
+    results.append(("actor_calls_sync_per_second", rate, "calls/s"))
+    results.append(("actor_call_latency_ms", 1000.0 / rate, "ms"))
+
+    def actor_async():
+        ray_tpu.get([a.m.remote() for _ in range(n)])
+
+    results.append(("actor_calls_pipelined_per_second",
+                    timed(actor_async, n), "calls/s"))
+
+    # --- object store bandwidth (zero-copy numpy) ---
+    mb = 64 if quick else 256
+    arr = np.random.rand(mb * 1024 * 1024 // 8)
+
+    t0 = time.perf_counter()
+    ref = ray_tpu.put(arr)
+    put_bw = mb / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    out = ray_tpu.get(ref)
+    get_bw = mb / (time.perf_counter() - t0)
+    assert out.shape == arr.shape
+    results.append(("object_store_put_mb_per_second", put_bw, "MiB/s"))
+    results.append(("object_store_get_mb_per_second", get_bw, "MiB/s"))
+
+    # --- many small objects in one get ---
+    n = int(1000 * scale)
+    refs = [ray_tpu.put(i) for i in range(n)]
+
+    def many_get():
+        ray_tpu.get(refs)
+
+    results.append(("small_objects_get_per_second", timed(many_get, n),
+                    "objects/s"))
+
+    for name, value, unit in results:
+        print(json.dumps({"metric": name, "value": round(value, 2),
+                          "unit": unit}))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main(quick="--quick" in sys.argv)

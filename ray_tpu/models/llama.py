@@ -51,6 +51,12 @@ class LlamaConfig:
     attn_impl: str = "auto"            # auto|flash|reference|ring
     ring_axis: str = "sp"
 
+    def __post_init__(self):
+        if self.remat_policy not in ("full", "save_dots"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r} "
+                "(expected 'full' or 'save_dots')")
+
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
@@ -233,12 +239,8 @@ def llama_forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
                 layer_fn,
                 policy=jax.checkpoint_policies
                 .dots_with_no_batch_dims_saveable)
-        elif cfg.remat_policy == "full":
+        else:  # "full" — validated in LlamaConfig.__post_init__
             layer_fn = jax.checkpoint(layer_fn)
-        else:
-            raise ValueError(
-                f"unknown remat_policy {cfg.remat_policy!r} "
-                "(expected 'full' or 'save_dots')")
 
     def scan_body(h, layer):
         return layer_fn(h, layer), None

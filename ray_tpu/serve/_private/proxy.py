@@ -80,6 +80,7 @@ class _RpcIngress:
 
 
 async def _await_response(response):
+    """Shared by the HTTP and rpc ingress paths."""
     return await response
 
 
@@ -95,6 +96,8 @@ class ProxyActor:
         self._stop_evt: Optional[asyncio.Event] = None
         self._server_loop: Optional[asyncio.AbstractEventLoop] = None
         self._error: Optional[str] = None
+        self._rpc_server = None
+        self._rpc_port = 0
         self._thread = threading.Thread(target=self._serve_thread,
                                         daemon=True, name="serve-proxy-http")
         self._thread.start()
@@ -110,7 +113,7 @@ class ProxyActor:
 
     def status(self) -> dict:
         return {"address": f"http://{self._host}:{self._port}",
-                "rpc_port": getattr(self, "_rpc_port", 0),
+                "rpc_port": self._rpc_port,
                 "num_requests": self._num_requests,
                 "routes": sorted(self._route_table)}
 
@@ -228,7 +231,7 @@ class ProxyActor:
             response = await asyncio.get_running_loop().run_in_executor(
                 None, self._submit, entry, serve_req)
             result = await asyncio.wait_for(
-                self._await_response(response), timeout=60)
+                _await_response(response), timeout=60)
         except Exception as e:
             logger.exception("request to %s failed", path)
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
@@ -239,10 +242,6 @@ class ProxyActor:
 
         handle = DeploymentHandle(entry["deployment"], entry["app_name"])
         return handle.remote(serve_req)
-
-    @staticmethod
-    async def _await_response(response):
-        return await response
 
     @staticmethod
     def _to_response(result):

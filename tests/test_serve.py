@@ -381,9 +381,18 @@ def test_rpc_ingress(ray_start_regular):
     async def call():
         conn = await rpc.connect(host, int(port))
         try:
-            out = await conn.call("serve_call", {
-                "app": "rpc_app", "payload": "hello"}, timeout=30)
-            return out
+            # The proxy learns routes via an async long-poll: retry
+            # briefly (same as the HTTP e2e test).
+            deadline = asyncio.get_event_loop().time() + 10
+            while True:
+                try:
+                    return await conn.call("serve_call", {
+                        "app": "rpc_app", "payload": "hello"},
+                        timeout=30)
+                except rpc.RpcError:
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
         finally:
             await conn.close()
 
