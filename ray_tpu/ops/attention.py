@@ -58,6 +58,13 @@ def mha_reference(q: jax.Array,
     if mask is not None:
         logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
+    if mask is not None:
+        # A row with NO unmasked column attends to nothing: define its
+        # output (and gradient) as zero, not softmax's accidental
+        # uniform distribution over -inf logits. Matches the Pallas
+        # kernels' semantics.
+        row_live = mask.any(-1, keepdims=True)
+        probs = jnp.where(row_live, probs, 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return out.astype(q.dtype)

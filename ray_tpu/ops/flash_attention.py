@@ -27,6 +27,27 @@ DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
 
 
+def _attn_mask(i, j, block_q, block_k, q_offset, sk_orig, causal):
+    """Single source of truth for the fwd AND bwd score mask (they must
+    agree exactly or the backward's recomputed softmax diverges)."""
+    qi = q_offset + i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    ki = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = ki < sk_orig  # zero-padded kv columns
+    if causal:
+        mask = mask & (qi >= ki)
+    return mask
+
+
+def _block_contributes(i, j, block_q, block_k, q_offset, causal):
+    """Causal block skip: kv block j contributes iff its first kv index
+    <= the global position of q block i's last row."""
+    if not causal:
+        return True
+    return j * block_k <= q_offset + i * block_q + block_q - 1
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                 sm_scale: float, causal: bool, block_q: int, block_k: int,
                 q_offset: int, sk_orig: int):
@@ -42,12 +63,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # Causal: kv block j contributes iff its first kv index <= the global
-    # position of this q block's last row.
-    should_compute = True
-    if causal:
-        should_compute = (j * block_k
-                          <= q_offset + i * block_q + block_q - 1)
+    should_compute = _block_contributes(i, j, block_q, block_k, q_offset,
+                                        causal)
 
     @pl.when(should_compute)
     def _body():
@@ -57,13 +74,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
-        qi = q_offset + i * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        ki = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = ki < sk_orig  # zero-padded kv columns
-        if causal:
-            mask = mask & (qi >= ki)
+        mask = _attn_mask(i, j, block_q, block_k, q_offset, sk_orig,
+                          causal)
         s = jnp.where(mask, s, _NEG_INF)
         m_prev = m_ref[:]                      # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -78,9 +90,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(j == nk - 1)
     def _finalize():
-        # l == 0 only for zero-padded q rows (sliced off by the caller).
+        # l == 0 for zero-padded q rows (sliced off by the caller).
+        # m == -inf marks FULLY-MASKED rows (decode with Sq > Sk): they
+        # attend to nothing and must output exactly zero — without this,
+        # p = exp(-inf - -inf) = 1 leaks uniform weights into acc.
         l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
-        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        row_live = m_ref[:] > _NEG_INF / 2
+        o_ref[0, 0] = jnp.where(row_live, acc_ref[:] / l,
+                                0.0).astype(o_ref.dtype)
+
+
+def _fwd_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                    l_ref, **kw):
+    """Forward that also writes the row logsumexp (for the Pallas
+    backward): lse = m + log(l)."""
+    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, **kw)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == nk - 1)
+    def _write_lse():
+        l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        lse_ref[0, 0] = m_ref[:] + jnp.log(l)  # [bq, 1]
 
 
 def _pad_seq(x, block):
@@ -91,7 +122,8 @@ def _pad_seq(x, block):
     return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+               with_lse=False):
     b, h, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     if h % hkv:
@@ -104,11 +136,23 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     sq_p, sk_p = qp.shape[2], kp.shape[2]
     grid = (b, h, sq_p // block_q, sk_p // block_k)
 
+    kernel_fn = _fwd_kernel_lse if with_lse else _fwd_kernel
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        kernel_fn, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k,
         q_offset=sk - sq, sk_orig=sk)
-    out = pl.pallas_call(
+    out_specs = pl.BlockSpec((1, 1, block_q, d),
+                             lambda b_, h_, i, j: (b_, h_, i, 0))
+    out_shape = jax.ShapeDtypeStruct(qp.shape, q.dtype)
+    if with_lse:
+        # [B,H,Sq,1] keeps the last-two block dims TPU-tileable
+        # ((block_q, 1) with 1 == full trailing dim).
+        out_specs = [out_specs,
+                     pl.BlockSpec((1, 1, block_q, 1),
+                                  lambda b_, h_, i, j: (b_, h_, i, 0))]
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((b, h, sq_p, 1), jnp.float32)]
+    result = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -119,9 +163,8 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_k, d),
                          lambda b_, h_, i, j, g=g: (b_, h_ // g, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda b_, h_, i, j: (b_, h_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -132,7 +175,217 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
                                  "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp)
+    if with_lse:
+        out, lse = result
+        out = out[:, :, :sq] if sq_p != sq else out
+        lse = lse[:, :, :sq] if sq_p != sq else lse
+        return out, lse
+    out = result
     return out[:, :, :sq] if sq_p != sq else out
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_ref, *, sm_scale, causal, block_q,
+                   block_k, q_offset, sk_orig):
+    """dq for one q block, accumulated over kv blocks (innermost axis).
+    ds = p * (dO v^T - delta) * scale; dq += ds k."""
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    should = _block_contributes(i, j, block_q, block_k, q_offset, causal)
+
+    @pl.when(should)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                     # [bq, 1]
+        delta = delta_ref[0, 0]                 # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        mask = _attn_mask(i, j, block_q, block_k, q_offset, sk_orig,
+                          causal)
+        s = jnp.where(mask, s, _NEG_INF)
+        # Fully-masked rows (decode with Sq > Sk, or padded rows) have
+        # lse ~ -inf: their softmax is empty, p must be 0 — not
+        # exp(-inf - -inf).
+        p = jnp.where(lse <= _NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _fin():
+        dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
+                    block_q, block_k, q_offset, sk_orig):
+    """dk/dv for one kv block (per q head — GQA groups reduced outside),
+    accumulated over q blocks (innermost axis)."""
+    j = pl.program_id(2)  # kv block
+    i = pl.program_id(3)  # q block (innermost)
+    nq = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    should = _block_contributes(i, j, block_q, block_k, q_offset, causal)
+
+    @pl.when(should)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                     # [bq, 1]
+        delta = delta_ref[0, 0]                 # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        mask = _attn_mask(i, j, block_q, block_k, q_offset, sk_orig,
+                          causal)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.where(lse <= _NEG_INF / 2, 0.0,
+                      jnp.exp(s - lse))         # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale        # [bq, bk]
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _fin():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k,
+               interpret):
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    grp = h // hkv
+    block_q = min(block_q, max(sq, 1))
+    block_k = min(block_k, max(sk, 1))
+
+    # delta = rowsum(dO * O) — cheap, fused by XLA. [B,H,Sq,1] layout
+    # keeps the Pallas row blocks TPU-tileable.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B,H,Sq,1]
+
+    qp = _pad_seq(q, block_q)
+    gp = _pad_seq(g, block_q)
+    kp, vp = _pad_seq(k, block_k), _pad_seq(v, block_k)
+    sq_p, sk_p = qp.shape[2], kp.shape[2]
+    pad_q = sq_p - sq
+    if pad_q:
+        # Padded q rows get lse=0 and delta=0. Their p is NOT zero (for
+        # unmasked columns p = exp(s-0)), but every contribution is
+        # multiplied by do=0 (gp zero-padded) and delta=0, so dk/dv/dq
+        # stay exact — do not stop zero-padding gp.
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+
+    common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+                  block_k=block_k, q_offset=sk - sq, sk_orig=sk)
+
+    # --- dq: grid (b, h, nq, nk), kv innermost (axis2=q, axis3=kv) ---
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(b, h, sq_p // block_q, sk_p // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j, g_=grp:
+                         (b_, h_ // g_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j, g_=grp:
+                         (b_, h_ // g_, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, i, j: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, gp, lse, delta)
+
+    # --- dk/dv: grid (b, h, nk, nq), q innermost (axis2=kv, axis3=q);
+    # per-q-head then group reduce (GQA) ---
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(b, h, sk_p // block_k, sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, j, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, j, i, g_=grp:
+                         (b_, h_ // g_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, j, i, g_=grp:
+                         (b_, h_ // g_, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, j, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, j, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, j, i: (b_, h_, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, j, i: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, j, i: (b_, h_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk_p, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, gp, lse, delta)
+
+    dq = dq[:, :, :sq] if sq_p != sq else dq
+    dk_h = dk_h[:, :, :sk] if sk_p != sk else dk_h
+    dv_h = dv_h[:, :, :sk] if sk_p != sk else dv_h
+    if grp > 1:
+        dk = dk_h.reshape(b, hkv, grp, sk, d).sum(axis=2).astype(k.dtype)
+        dv = dv_h.reshape(b, hkv, grp, sk, d).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -141,19 +394,16 @@ def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 
 def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                          interpret, with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret,
                    residuals, g):
-    from ray_tpu.ops.attention import mha_reference
-
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: mha_reference(
-            q_, k_, v_, causal=causal, sm_scale=sm_scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_bwd(q, k, v, out, lse, g, sm_scale, causal, block_q,
+                      block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
