@@ -10,6 +10,7 @@ from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.env.registry import register_env
 
 __all__ = [
@@ -20,6 +21,8 @@ __all__ = [
     "DQN",
     "DQNConfig",
     "IMPALA",
+    "BC",
+    "BCConfig",
     "IMPALAConfig",
     "register_env",
 ]

@@ -1,0 +1,102 @@
+"""BC — behavior cloning from offline data.
+
+Reference: rllib/algorithms/bc/ (offline RL entry point: supervised
+imitation of logged actions; MARWIL with beta=0). The offline dataset is
+either a dict of numpy arrays ({obs, actions}) or a ray_tpu.data
+Dataset with those columns; the env is used only to size the module and
+for evaluation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner
+from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+from ray_tpu.rllib.utils import sample_batch as sb
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.offline_dataset: Any = None
+        self.train_batch_size = 256
+        self.num_env_runners = 0
+
+    def offline_data(self, *, dataset=None, **kwargs) -> "BCConfig":
+        if dataset is not None:
+            self.offline_dataset = dataset
+        self._apply(kwargs)
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        # The dataset stays driver-side: shipping it in the worker/learner
+        # construction configs would pickle the whole thing into every
+        # actor for no use.
+        d = super().to_dict()
+        d.pop("offline_dataset", None)
+        return d
+
+    @property
+    def algo_class(self):
+        return BC
+
+
+class BCLearner(JaxLearner):
+    def loss_fn(self, params, batch, rng):
+        import jax
+        import jax.numpy as jnp
+
+        logits = self.module.forward_train(
+            params, batch[sb.OBS])["action_dist_inputs"]
+        logp = jax.nn.log_softmax(logits)
+        actions = batch[sb.ACTIONS].astype(jnp.int32)
+        nll = -jnp.take_along_axis(logp, actions[:, None], axis=-1)[:, 0]
+        accuracy = (jnp.argmax(logits, -1) == actions).mean()
+        return nll.mean(), {"bc_nll": nll.mean(), "accuracy": accuracy}
+
+
+class BC(Algorithm):
+    config_class = BCConfig
+    learner_class = BCLearner
+    module_class = DiscreteMLPModule
+
+    def setup(self, config) -> None:
+        super().setup(config)
+        ds = self.config.offline_dataset
+        if ds is None:
+            raise ValueError("BCConfig.offline_data(dataset=...) required")
+        if hasattr(ds, "take_all"):  # ray_tpu.data Dataset
+            rows = ds.take_all()
+            self._obs = np.stack([np.asarray(r["obs"]) for r in rows])
+            self._actions = np.asarray([r["actions"] for r in rows])
+        else:
+            self._obs = np.asarray(ds["obs"])
+            self._actions = np.asarray(ds["actions"])
+        self._rng = np.random.default_rng(self.config.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+        n = len(self._obs)
+        idx = self._rng.integers(0, n, self.config.train_batch_size)
+        batch = SampleBatch({sb.OBS: self._obs[idx].astype(np.float32),
+                             sb.ACTIONS: self._actions[idx]})
+        # No per-step weight broadcast: BC never samples from env
+        # runners (evaluate() pulls weights straight from the learners).
+        return self.learner_group.update(batch)
+
+    def step(self) -> Dict[str, Any]:
+        # No env sampling: just train + iteration bookkeeping.
+        import time
+
+        t0 = time.perf_counter()
+        results = self.training_step()
+        self._iteration += 1
+        results["training_iteration"] = self._iteration
+        results["time_this_iter_s"] = time.perf_counter() - t0
+        return results
