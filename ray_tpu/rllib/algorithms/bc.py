@@ -88,15 +88,6 @@ class BC(Algorithm):
                              sb.ACTIONS: self._actions[idx]})
         # No per-step weight broadcast: BC never samples from env
         # runners (evaluate() pulls weights straight from the learners).
+        # Iteration/timing bookkeeping comes from the base
+        # Algorithm.step (safe with the zero-env-runner local group).
         return self.learner_group.update(batch)
-
-    def step(self) -> Dict[str, Any]:
-        # No env sampling: just train + iteration bookkeeping.
-        import time
-
-        t0 = time.perf_counter()
-        results = self.training_step()
-        self._iteration += 1
-        results["training_iteration"] = self._iteration
-        results["time_this_iter_s"] = time.perf_counter() - t0
-        return results

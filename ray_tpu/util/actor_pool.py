@@ -1,0 +1,79 @@
+"""ActorPool — round-robin work distribution over a fixed actor set.
+
+Reference: python/ray/util/actor_pool.py (submit/get_next/
+get_next_unordered/map/map_unordered over idle actors)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._pending = []  # FIFO of refs (ordered mode)
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def has_next(self) -> bool:
+        return bool(self._pending)
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn maps (actor, value) -> ObjectRef."""
+        if not self._idle:
+            # Wait for any in-flight result to free an actor.
+            ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                    num_returns=1)
+            self._return_actor(ready[0])
+        actor = self._idle.pop(0)
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = actor
+        self._pending.append(ref)
+
+    def _return_actor(self, ref) -> None:
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is not None:
+            self._idle.append(actor)
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in SUBMISSION order. On timeout the result stays
+        pending and retrievable by a later call."""
+        if not self._pending:
+            raise StopIteration("no pending results")
+        ref = self._pending[0]
+        value = ray_tpu.get(ref, timeout=timeout)  # raises -> ref kept
+        self._pending.pop(0)
+        self._return_actor(ref)
+        return value
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Next COMPLETED result (any order)."""
+        if not self._pending:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(self._pending, num_returns=1,
+                                timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result within timeout")
+        ref = ready[0]
+        self._pending.remove(ref)
+        value = ray_tpu.get(ref)
+        self._return_actor(ref)
+        return value
+
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: List[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: List[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()

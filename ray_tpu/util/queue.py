@@ -1,0 +1,124 @@
+"""Distributed FIFO queue (reference: python/ray/util/queue.py — an
+actor-backed Queue with optional maxsize and blocking put/get)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._items: List[Any] = []
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def put(self, item) -> bool:
+        if self.maxsize > 0 and len(self._items) >= self.maxsize:
+            return False
+        self._items.append(item)
+        return True
+
+    def get(self):
+        if not self._items:
+            return False, None
+        return True, self._items.pop(0)
+
+    def put_batch(self, items: List[Any]) -> bool:
+        """All-or-nothing (matching the reference contract): either the
+        whole batch fits or nothing is enqueued."""
+        if self.maxsize > 0 and \
+                len(self._items) + len(items) > self.maxsize:
+            return False
+        self._items.extend(items)
+        return True
+
+    def get_batch(self, n: int):
+        """All-or-nothing: n items or nothing."""
+        if len(self._items) < n:
+            return None
+        out = self._items[:n]
+        del self._items[:n]
+        return out
+
+
+class Queue:
+    """FIFO queue shared across tasks/actors via one queue actor."""
+
+    def __init__(self, maxsize: int = 0,
+                 actor_options: Optional[dict] = None):
+        cls = ray_tpu.remote(_QueueActor)
+        if actor_options:
+            cls = cls.options(**actor_options)
+        self._actor = cls.remote(maxsize)
+        self.maxsize = maxsize
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.002
+        while True:
+            if ray_tpu.get(self._actor.put.remote(item)):
+                return
+            if not block:
+                raise Full("queue is full")
+            if deadline is not None and time.monotonic() > deadline:
+                raise Full("queue is full (timeout)")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.1)  # backoff: idle waiters must not
+            #                              hammer the queue actor
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.002
+        while True:
+            ok, item = ray_tpu.get(self._actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty("queue is empty")
+            if deadline is not None and time.monotonic() > deadline:
+                raise Empty("queue is empty (timeout)")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.1)
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        if not ray_tpu.get(self._actor.put_batch.remote(list(items))):
+            raise Full(f"batch of {len(items)} does not fit")
+
+    def get_nowait_batch(self, n: int) -> List[Any]:
+        out = ray_tpu.get(self._actor.get_batch.remote(n))
+        if out is None:
+            raise Empty(f"fewer than {n} items available")
+        return out
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self._actor)

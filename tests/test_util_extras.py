@@ -1,0 +1,89 @@
+"""Queue + ActorPool utility tests (reference: ray.util tests)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+def test_queue_fifo_cross_actor(ray_start_regular):
+    q = Queue()
+    q.put(1)
+    q.put(2)
+
+    @ray_tpu.remote
+    def consumer(q):
+        return [q.get(timeout=5), q.get(timeout=5)]
+
+    assert ray_tpu.get(consumer.remote(q)) == [1, 2]
+    q.shutdown()
+
+
+def test_queue_maxsize_and_nowait(ray_start_regular):
+    q = Queue(maxsize=2)
+    q.put_nowait("a")
+    q.put_nowait("b")
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait("c")
+    with pytest.raises(Full):
+        q.put("c", timeout=0.1)
+    assert q.get_nowait() == "a"
+    q.put_nowait("c")
+    assert q.get_nowait_batch(2) == ["b", "c"]
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_producer_consumer(ray_start_regular):
+    q = Queue(maxsize=4)
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i, timeout=20)
+        return True
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return [q.get(timeout=20) for _ in range(n)]
+
+    p = producer.remote(q, 10)  # > maxsize: backpressure path
+    out = ray_tpu.get(consumer.remote(q, 10), timeout=40)
+    assert out == list(range(10))
+    assert ray_tpu.get(p)
+    q.shutdown()
+
+
+def test_actor_pool_ordered_and_unordered(ray_start_regular):
+    @ray_tpu.remote
+    class Sq:
+        def compute(self, x):
+            import time
+
+            time.sleep(0.01 * (x % 3))
+            return x * x
+
+    pool = ActorPool([Sq.remote() for _ in range(3)])
+    out = list(pool.map(lambda a, v: a.compute.remote(v), range(8)))
+    assert out == [i * i for i in range(8)]  # submission order
+
+    out2 = sorted(pool.map_unordered(
+        lambda a, v: a.compute.remote(v), range(8)))
+    assert out2 == sorted(i * i for i in range(8))
+
+
+def test_actor_pool_reuses_actors(ray_start_regular):
+    @ray_tpu.remote
+    class W:
+        def pid(self, _):
+            import os
+
+            return os.getpid()
+
+    pool = ActorPool([W.remote() for _ in range(2)])
+    pids = set(pool.map(lambda a, v: a.pid.remote(v), range(10)))
+    assert len(pids) == 2  # all work stayed on the two pool actors
