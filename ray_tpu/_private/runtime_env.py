@@ -92,6 +92,22 @@ def package_local_dir(path: str, gcs_call) -> str:
     return uri
 
 
+def merge_runtime_envs(base: Optional[dict],
+                       override: Optional[dict]) -> Optional[dict]:
+    """Job-level env under a per-call env: per-call keys win, env_vars
+    union (per-call entries shadow job entries)."""
+    if not base:
+        return override
+    merged = dict(base)
+    if override:
+        env_vars = {**merged.get("env_vars", {}),
+                    **override.get("env_vars", {})}
+        merged.update(override)
+        if env_vars:
+            merged["env_vars"] = env_vars
+    return merged
+
+
 def prepare_runtime_env(runtime_env: Optional[dict],
                         gcs_call) -> Optional[dict]:
     """Driver-side: replace local paths with uploaded package URIs.

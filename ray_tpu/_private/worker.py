@@ -86,13 +86,55 @@ class Worker:
     def reference_counter(self):
         return self.core.reference_counter
 
-    def _prepare_env_opts(self, opts) -> dict:
-        if opts.get("runtime_env"):
-            from ray_tpu._private.runtime_env import prepare_runtime_env
+    # Job-level runtime env (init(runtime_env=...)): merged under every
+    # task/actor's own env (per-call wins on conflicts, env_vars merge).
+    # Stored in URI form (packages uploaded once at init) and published
+    # to the GCS KV so NESTED tasks — submitted from executor workers —
+    # inherit it too.
+    _job_env: Any = "unloaded"  # "unloaded" | None | dict
 
+    def _get_job_env(self) -> Optional[dict]:
+        if self._job_env == "unloaded":
+            from ray_tpu.core import serialization as ser
+
+            # Executor workers carry a nil job id; the submitting job is
+            # the one of the task currently executing.
+            job_id = self.core.job_id
+            if (job_id is None or job_id.is_nil()) and \
+                    self.core._current_task is not None:
+                job_id = self.core._current_task.job_id
+            if job_id is None or job_id.is_nil():
+                return None  # no job context (don't cache)
+            raw = self.gcs_call("kv_get", {
+                "ns": b"job_env", "key": job_id.binary()})
+            self._job_env = ser.loads(raw) if raw else None
+        return self._job_env
+
+    def set_job_runtime_env(self, env: Optional[dict]) -> None:
+        """Driver-side: prepare (upload packages) once and publish."""
+        if not env:
+            self._job_env = None
+            return
+        from ray_tpu._private.runtime_env import prepare_runtime_env
+        from ray_tpu.core import serialization as ser
+
+        prepared = prepare_runtime_env(env, self.gcs_call)
+        self._job_env = prepared
+        self.gcs_call("kv_put", {
+            "ns": b"job_env", "key": self.core.job_id.binary(),
+            "value": ser.dumps(prepared)})
+
+    def _prepare_env_opts(self, opts) -> dict:
+        from ray_tpu._private.runtime_env import (merge_runtime_envs,
+                                                  prepare_runtime_env)
+
+        env = merge_runtime_envs(self._get_job_env(),
+                                 opts.get("runtime_env"))
+        if env:
             opts = dict(opts)
-            opts["runtime_env"] = prepare_runtime_env(
-                opts["runtime_env"], self.gcs_call)
+            # Job-env packages are already URI-form; only the per-call
+            # env's local paths get packaged here.
+            opts["runtime_env"] = prepare_runtime_env(env, self.gcs_call)
         return opts
 
     def submit_task(self, descriptor, args, kwargs, opts) -> List[ObjectRef]:
@@ -143,6 +185,7 @@ def init(address: Optional[str] = None, *,
          num_tpus: Optional[float] = None,
          object_store_memory: Optional[int] = None,
          namespace: str = "default",
+         runtime_env: Optional[dict] = None,
          system_config: Optional[dict] = None,
          ignore_reinit_error: bool = False,
          _node_kwargs: Optional[dict] = None) -> "RuntimeContext":
@@ -163,6 +206,8 @@ def init(address: Optional[str] = None, *,
 
             host, _, port = address[len("ray://"):].partition(":")
             _global_worker = ClientWorker(host, int(port or 10001))
+            if runtime_env:
+                _global_worker.set_job_runtime_env(runtime_env)
             return _global_worker
         import asyncio
 
@@ -209,6 +254,7 @@ def init(address: Optional[str] = None, *,
             raise
         _global_worker = Worker(core, io_thread=io, node=node,
                                 namespace=namespace)
+        _global_worker.set_job_runtime_env(runtime_env)
         atexit.register(shutdown)
         return get_runtime_context()
 

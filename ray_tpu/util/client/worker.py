@@ -124,17 +124,38 @@ class ClientWorker:
         return [ObjectRef(ObjectID(p["object_id"]),
                           owner_address=p["owner"] or None) for p in pins]
 
+    job_runtime_env = None
+
+    def set_job_runtime_env(self, env) -> None:
+        """Client-side job env: packages (local CLIENT paths) upload
+        through the proxied KV once; merged into every submission."""
+        from ray_tpu._private.runtime_env import prepare_runtime_env
+
+        self.job_runtime_env = prepare_runtime_env(env, self.gcs_call)
+
+    def _merged_opts(self, opts) -> dict:
+        if not self.job_runtime_env:
+            return opts
+        from ray_tpu._private.runtime_env import merge_runtime_envs
+
+        opts = dict(opts)
+        opts["runtime_env"] = merge_runtime_envs(
+            self.job_runtime_env, opts.get("runtime_env"))
+        return opts
+
     def submit_task(self, descriptor, args, kwargs,
                     opts) -> List[ObjectRef]:
         pins = self._call("cl_submit_task", {
             "key": descriptor, "args": ser.dumps(args),
-            "kwargs": ser.dumps(kwargs), "opts": ser.dumps(opts)})
+            "kwargs": ser.dumps(kwargs),
+            "opts": ser.dumps(self._merged_opts(opts))})
         return self._refs_from(pins)
 
     def create_actor(self, descriptor, args, kwargs, opts) -> ActorID:
         r = self._call("cl_create_actor", {
             "key": descriptor, "args": ser.dumps(args),
-            "kwargs": ser.dumps(kwargs), "opts": ser.dumps(opts)})
+            "kwargs": ser.dumps(kwargs),
+            "opts": ser.dumps(self._merged_opts(opts))})
         return ActorID(r["actor_id"])
 
     def submit_actor_task(self, actor_id: ActorID, method: str, args,

@@ -115,3 +115,53 @@ def test_async_actor_method_sees_runtime_env(ray_start_regular):
     a = AsyncActor.remote()
     assert ray_tpu.get(a.check.remote()) == "live"
     ray_tpu.kill(a)
+
+
+def test_job_level_runtime_env(ray_start_cluster):
+    """init(runtime_env=...) applies to every task; per-task envs merge
+    over it (env_vars union, per-call keys win)."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = ray_start_cluster()
+    cluster.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=cluster.address,
+                 runtime_env={"env_vars": {"JOB_WIDE": "yes",
+                                           "SHADOWED": "job"}})
+
+    @ray_tpu.remote
+    def plain():
+        return os.environ.get("JOB_WIDE"), os.environ.get("SHADOWED")
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"SHADOWED": "task"}})
+    def overridden():
+        return os.environ.get("JOB_WIDE"), os.environ.get("SHADOWED")
+
+    assert ray_tpu.get(plain.remote()) == ("yes", "job")
+    assert ray_tpu.get(overridden.remote()) == ("yes", "task")
+
+
+def test_job_env_inherited_by_nested_tasks(ray_start_cluster):
+    """Nested tasks (submitted from inside a task) inherit the job env
+    via the GCS-published record."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = ray_start_cluster()
+    cluster.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=cluster.address,
+                 runtime_env={"env_vars": {"NESTED_JOB": "deep"}})
+
+    @ray_tpu.remote
+    def inner():
+        return os.environ.get("NESTED_JOB")
+
+    @ray_tpu.remote
+    def outer():
+        import ray_tpu as rt
+
+        return rt.get(inner.remote())
+
+    assert ray_tpu.get(outer.remote(), timeout=30) == "deep"
