@@ -90,36 +90,40 @@ class Worker:
     # task/actor's own env (per-call wins on conflicts, env_vars merge).
     # Stored in URI form (packages uploaded once at init) and published
     # to the GCS KV so NESTED tasks — submitted from executor workers —
-    # inherit it too.
-    _job_env: Any = "unloaded"  # "unloaded" | None | dict
+    # inherit it too. Cached PER JOB ID: pooled executor workers are
+    # re-leased across jobs and must not serve a stale job's env.
+    _job_envs: Optional[dict] = None
 
     def _get_job_env(self) -> Optional[dict]:
-        if self._job_env == "unloaded":
-            from ray_tpu.core import serialization as ser
+        from ray_tpu.core import serialization as ser
 
-            # Executor workers carry a nil job id; the submitting job is
-            # the one of the task currently executing.
-            job_id = self.core.job_id
-            if (job_id is None or job_id.is_nil()) and \
-                    self.core._current_task is not None:
-                job_id = self.core._current_task.job_id
-            if job_id is None or job_id.is_nil():
-                return None  # no job context (don't cache)
-            raw = self.gcs_call("kv_get", {
-                "ns": b"job_env", "key": job_id.binary()})
-            self._job_env = ser.loads(raw) if raw else None
-        return self._job_env
+        # Executor workers carry a nil job id; the submitting job is
+        # the one of the task currently executing.
+        job_id = self.core.job_id
+        if (job_id is None or job_id.is_nil()) and \
+                self.core._current_task is not None:
+            job_id = self.core._current_task.job_id
+        if job_id is None or job_id.is_nil():
+            return None  # no job context
+        if self._job_envs is None:
+            self._job_envs = {}
+        key = job_id.binary()
+        if key not in self._job_envs:
+            raw = self.gcs_call("kv_get", {"ns": b"job_env", "key": key})
+            self._job_envs[key] = ser.loads(raw) if raw else None
+        return self._job_envs[key]
 
     def set_job_runtime_env(self, env: Optional[dict]) -> None:
         """Driver-side: prepare (upload packages) once and publish."""
         if not env:
-            self._job_env = None
             return
         from ray_tpu._private.runtime_env import prepare_runtime_env
         from ray_tpu.core import serialization as ser
 
         prepared = prepare_runtime_env(env, self.gcs_call)
-        self._job_env = prepared
+        if self._job_envs is None:
+            self._job_envs = {}
+        self._job_envs[self.core.job_id.binary()] = prepared
         self.gcs_call("kv_put", {
             "ns": b"job_env", "key": self.core.job_id.binary(),
             "value": ser.dumps(prepared)})

@@ -20,7 +20,8 @@ from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, \
     HTTPOptions
 from ray_tpu.serve.deployment import Application, Deployment, deployment, \
     ingress
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (DeploymentHandle, DeploymentResponse,
+                                  DeploymentResponseGenerator)
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve._private.proxy import ServeRequest
 from ray_tpu.serve.schema import (ApplicationSchema, DeploymentSchema,
@@ -39,6 +40,7 @@ __all__ = [
     "DeploymentConfig",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
     "HTTPOptions",
     "ServeRequest",
     "batch",

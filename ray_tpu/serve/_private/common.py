@@ -90,6 +90,7 @@ class RequestMetadata:
     call_method: str = "__call__"
     multiplexed_model_id: str = ""
     is_http_request: bool = False
+    stream: bool = False
 
     def to_dict(self) -> dict:
         return {
@@ -97,6 +98,7 @@ class RequestMetadata:
             "call_method": self.call_method,
             "multiplexed_model_id": self.multiplexed_model_id,
             "is_http_request": self.is_http_request,
+            "stream": self.stream,
         }
 
     @staticmethod

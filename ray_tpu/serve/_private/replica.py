@@ -11,6 +11,7 @@ import asyncio
 import inspect
 import logging
 import time
+import uuid
 from typing import Any, Optional
 
 import ray_tpu
@@ -79,6 +80,7 @@ class ReplicaActor:
         self._total_served = 0
         self._draining = False
         self._multiplexed_model_ids: list = []
+        self._streams: dict = {}
         self._started_at = time.time()
         global _current_replica
         _current_replica = self
@@ -98,10 +100,73 @@ class ReplicaActor:
                 # to_thread (not run_in_executor) so the multiplex
                 # ContextVar propagates into the worker thread.
                 result = await asyncio.to_thread(method, *args, **kwargs)
+            if inspect.isgenerator(result) or \
+                    inspect.isasyncgen(result):
+                if not meta.stream:
+                    # Non-stream callers (plain handle / HTTP) must opt
+                    # in — otherwise the generator would leak.
+                    raise TypeError(
+                        f"{meta.call_method!r} returned a generator; "
+                        "call it with handle.options(stream=True)")
+                # Streaming (reference: streaming responses) — the
+                # generator stays replica-side; the caller drains it
+                # with stream_next() calls carrying the stream id. The
+                # request stays ONGOING (for drain/autoscaling) until
+                # the stream ends: +1 compensates the finally below.
+                stream_id = uuid.uuid4().hex
+                self._streams[stream_id] = (result,
+                                            meta.multiplexed_model_id)
+                self._num_ongoing += 1
+                return {"__serve_stream__": stream_id}
             self._total_served += 1
             return result
         finally:
             self._num_ongoing -= 1
+
+    _STREAM_END = object()
+
+    def _finish_stream(self, stream_id: str) -> None:
+        if self._streams.pop(stream_id, None) is not None:
+            self._num_ongoing -= 1
+
+    async def stream_next(self, stream_id: str):
+        """(done, chunk) — drains one item from a live stream."""
+        entry = self._streams.get(stream_id)
+        if entry is None:
+            raise ValueError(f"unknown stream {stream_id!r}")
+        gen, model_id = entry
+        if model_id:
+            # The generator BODY runs here, not in handle_request's
+            # context: restore the multiplex id for it.
+            _set_multiplex_context(model_id)
+        try:
+            if inspect.isasyncgen(gen):
+                try:
+                    chunk = await gen.__anext__()
+                except StopAsyncIteration:
+                    chunk = self._STREAM_END
+            else:
+                # StopIteration cannot cross coroutine/future boundaries
+                # — drain with a sentinel default instead.
+                chunk = await asyncio.to_thread(
+                    next, gen, self._STREAM_END)
+        except Exception:
+            self._finish_stream(stream_id)
+            raise
+        if chunk is self._STREAM_END:
+            self._finish_stream(stream_id)
+            self._total_served += 1
+            return True, None
+        return False, chunk
+
+    def cancel_stream(self, stream_id: str) -> None:
+        entry = self._streams.get(stream_id)
+        self._finish_stream(stream_id)
+        if entry is not None and hasattr(entry[0], "close"):
+            try:
+                entry[0].close()
+            except Exception:
+                pass
 
     # ----------------------------------------------------------- control path
     def get_num_ongoing_requests(self) -> int:

@@ -169,7 +169,7 @@ class Router:
         fut = worker.as_future(ref)
         fut.add_done_callback(
             lambda _f: self._scheduler.on_request_done(entry))
-        return ref, fut
+        return ref, fut, handle
 
     _MULTIPLEX_CACHE_TTL_S = 2.0
 

@@ -43,22 +43,25 @@ class DeploymentResponse:
 class DeploymentHandle:
     def __init__(self, deployment: str, app_name: str,
                  method_name: str = "__call__",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "",
+                 stream: bool = False):
         self.deployment_name = deployment
         self.app_name = app_name
         self._method_name = method_name
         self._multiplexed_model_id = multiplexed_model_id
+        self._stream = stream
         self._router = None
 
     def options(self, *, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self.app_name,
             method_name=method_name or self._method_name,
             multiplexed_model_id=(multiplexed_model_id
                                   if multiplexed_model_id is not None
-                                  else self._multiplexed_model_id))
+                                  else self._multiplexed_model_id),
+            stream=self._stream if stream is None else stream)
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
@@ -74,15 +77,81 @@ class DeploymentHandle:
                                          self.deployment_name)
         return self._router
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         meta = RequestMetadata(
             request_id=uuid.uuid4().hex,
             call_method=self._method_name,
-            multiplexed_model_id=self._multiplexed_model_id)
-        ref, fut = self._get_router().assign_request(meta, args, kwargs)
+            multiplexed_model_id=self._multiplexed_model_id,
+            stream=self._stream)
+        ref, fut, replica = self._get_router().assign_request(
+            meta, args, kwargs)
+        if self._stream:
+            return DeploymentResponseGenerator(ref, replica)
         return DeploymentResponse(ref, fut)
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self._method_name,
-                 self._multiplexed_model_id))
+                 self._multiplexed_model_id, self._stream))
+
+
+class DeploymentResponseGenerator:
+    """Iterates a streaming deployment response (reference:
+    handle.options(stream=True) -> DeploymentResponseGenerator): the
+    generator lives replica-side; each __next__ drains one chunk from
+    the SAME replica that accepted the request."""
+
+    def __init__(self, ref, replica_handle):
+        self._ref = ref
+        self._replica = replica_handle
+        self._stream_id: Optional[str] = None
+        self._done = False
+        self._single: Optional[tuple] = None
+
+    def _start(self) -> None:
+        result = ray_tpu.get(self._ref)
+        if isinstance(result, dict) and "__serve_stream__" in result:
+            self._stream_id = result["__serve_stream__"]
+        else:
+            # Non-generator result: behave as a one-chunk stream.
+            self._single = (result,)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        if self._stream_id is None and self._single is None:
+            self._start()
+        if self._single is not None:
+            self._done = True
+            return self._single[0]
+        try:
+            done, chunk = ray_tpu.get(
+                self._replica.stream_next.remote(self._stream_id))
+        except Exception:
+            # Mid-stream failure terminates the iterator: a retry would
+            # only hit 'unknown stream' on the replica.
+            self._done = True
+            raise
+        if done:
+            self._done = True
+            raise StopIteration
+        return chunk
+
+    def cancel(self) -> None:
+        if self._done:
+            return
+        if self._stream_id is None and self._single is None:
+            # The request is already in flight — resolve it so the
+            # replica-side generator can actually be closed.
+            try:
+                self._start()
+            except Exception:
+                self._done = True
+                return
+        self._done = True
+        if self._stream_id is not None:
+            ray_tpu.get(
+                self._replica.cancel_stream.remote(self._stream_id))

@@ -165,6 +165,16 @@ class ClientProxyHandler:
                                  no_restart=data.get("no_restart", True)))
         return True
 
+    async def handle_cl_set_job_env(self, data, conn) -> bool:
+        """Publish the client's job env under the proxy driver's job id
+        so NESTED tasks inherit it (note: the proxy driver is shared —
+        one job env per proxy process, last writer wins)."""
+        from ray_tpu._private.worker import global_worker
+
+        env = ser.loads(data["env"])
+        await self._offload(global_worker().set_job_runtime_env, env)
+        return True
+
     async def handle_cl_gcs_call(self, data, conn):
         from ray_tpu._private.worker import global_worker
 

@@ -128,10 +128,14 @@ class ClientWorker:
 
     def set_job_runtime_env(self, env) -> None:
         """Client-side job env: packages (local CLIENT paths) upload
-        through the proxied KV once; merged into every submission."""
+        through the proxied KV once; merged into every submission. Also
+        published server-side so NESTED tasks inherit it (shared-proxy
+        caveat documented on the server handler)."""
         from ray_tpu._private.runtime_env import prepare_runtime_env
 
         self.job_runtime_env = prepare_runtime_env(env, self.gcs_call)
+        self._call("cl_set_job_env",
+                   {"env": ser.dumps(self.job_runtime_env)})
 
     def _merged_opts(self, opts) -> dict:
         if not self.job_runtime_env:

@@ -445,3 +445,35 @@ def test_controller_crash_recovery(ray_start_regular):
     handle2 = serve.get_app_handle("recover_app")
     assert handle2.remote("b").result(timeout_s=30) == "pong:b"
     serve.delete("recover_app")
+
+
+def test_streaming_response(ray_start_regular):
+    """handle.options(stream=True): generator deployments stream chunks
+    drained from the serving replica."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Streamer:
+        def __call__(self, n):
+            for i in range(n):
+                yield f"chunk-{i}"
+
+        async def acount(self, n):
+            for i in range(n):
+                yield i * 10
+
+    handle = serve.run(Streamer.bind(), name="stream_app",
+                       route_prefix=None, _proxy=False)
+    gen = handle.options(stream=True).remote(4)
+    assert list(gen) == [f"chunk-{i}" for i in range(4)]
+
+    # Async generator method.
+    agen = handle.options(stream=True, method_name="acount").remote(3)
+    assert list(agen) == [0, 10, 20]
+
+    # Non-streaming calls still work on the same deployment's plain
+    # methods; a non-generator result through stream=True yields once.
+    single = handle.options(stream=True,
+                            method_name="__call__").remote(0)
+    assert list(single) == []
+    serve.delete("stream_app")
