@@ -25,6 +25,9 @@ from ray_tpu._private.worker import (
     kill,
     cancel,
     get_runtime_context,
+    cluster_resources,
+    available_resources,
+    nodes,
 )
 from ray_tpu._private.api import remote, method
 from ray_tpu.core.object_ref import ObjectRef
@@ -44,6 +47,9 @@ __all__ = [
     "kill",
     "cancel",
     "get_runtime_context",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
     "ObjectRef",
     "ActorHandle",
     "ActorClass",

@@ -323,6 +323,25 @@ def wait(refs, *, num_returns: int = 1, timeout: Optional[float] = None,
     return global_worker().wait(refs, num_returns, timeout, fetch_local)
 
 
+def cluster_resources() -> dict:
+    """Total resources across alive nodes (reference:
+    ray.cluster_resources)."""
+    return global_worker().gcs_call("cluster_resources")["total"]
+
+
+def available_resources() -> dict:
+    """Currently-available resources (reference:
+    ray.available_resources)."""
+    return global_worker().gcs_call("cluster_resources")["available"]
+
+
+def nodes() -> list:
+    """Node table (reference: ray.nodes)."""
+    from ray_tpu.util import state
+
+    return state.list_nodes()
+
+
 def kill(actor, *, no_restart: bool = True) -> None:
     from ray_tpu.core.actor import ActorHandle
 

@@ -289,6 +289,46 @@ class Dataset:
             return lambda: b
         return Dataset([make(b) for b in blocks])
 
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """Materialize and split into n datasets (reference:
+        Dataset.split — used to hand shards to train workers).
+
+        equal=False (default): every row lands somewhere (first shards
+        take the remainder). equal=True: all shards get exactly
+        rows//n rows — the remainder rows are DROPPED (the reference's
+        documented equalize behavior)."""
+        blocks = [ray_tpu.get(r) for r in self.iter_block_refs()]
+        total = concat_blocks(blocks)
+        rows = total.num_rows
+        base = rows // n
+        sizes = [base] * n
+        if not equal:
+            for i in builtins.range(rows - base * n):
+                sizes[i] += 1
+        out = []
+        offset = 0
+        for size in sizes:
+            piece = total.slice(offset, size)
+            out.append(Dataset([lambda b=piece: b]))
+            offset += size
+        return out
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> List["Dataset"]:
+        """(train, test) split (reference: Dataset.train_test_split)."""
+        if not 0 < test_size < 1:
+            raise ValueError("test_size must be in (0, 1)")
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        blocks = [ray_tpu.get(r) for r in ds.iter_block_refs()]
+        total = concat_blocks(blocks)
+        rows = total.num_rows
+        n_test = int(rows * test_size)
+        train = total.slice(0, rows - n_test)
+        test = total.slice(rows - n_test, n_test)
+        return [Dataset([lambda b=train: b]), Dataset([lambda b=test: b])]
+
     # ---------------- writes ----------------
     def _write_blocks(self, path: str, ext: str, write_one) -> List[str]:
         """One output file per block (reference: write_parquet et al.,

@@ -159,14 +159,21 @@ class ReplicaActor:
             return True, None
         return False, chunk
 
-    def cancel_stream(self, stream_id: str) -> None:
+    async def cancel_stream(self, stream_id: str) -> None:
         entry = self._streams.get(stream_id)
         self._finish_stream(stream_id)
-        if entry is not None and hasattr(entry[0], "close"):
-            try:
-                entry[0].close()
-            except Exception:
-                pass
+        if entry is None:
+            return
+        gen = entry[0]
+        try:
+            if inspect.isasyncgen(gen):
+                # Async generators expose aclose(), not close(); without
+                # this their finally blocks never run on cancel.
+                await gen.aclose()
+            elif hasattr(gen, "close"):
+                await asyncio.to_thread(gen.close)
+        except Exception:
+            pass
 
     # ----------------------------------------------------------- control path
     def get_num_ongoing_requests(self) -> int:

@@ -169,7 +169,21 @@ class Router:
         fut = worker.as_future(ref)
         fut.add_done_callback(
             lambda _f: self._scheduler.on_request_done(entry))
-        return ref, fut, handle
+        if meta.stream:
+            # The first reply (the stream id) completes `fut`
+            # immediately, but the replica keeps working until the
+            # stream drains: hold an extra ongoing count that the
+            # DeploymentResponseGenerator releases at stream end.
+            self._scheduler.on_request_sent(entry)
+            released = []
+
+            def release():
+                if not released:
+                    released.append(1)
+                    self._scheduler.on_request_done(entry)
+
+            return ref, fut, handle, release
+        return ref, fut, handle, None
 
     _MULTIPLEX_CACHE_TTL_S = 2.0
 

@@ -83,10 +83,10 @@ class DeploymentHandle:
             call_method=self._method_name,
             multiplexed_model_id=self._multiplexed_model_id,
             stream=self._stream)
-        ref, fut, replica = self._get_router().assign_request(
+        ref, fut, replica, release = self._get_router().assign_request(
             meta, args, kwargs)
         if self._stream:
-            return DeploymentResponseGenerator(ref, replica)
+            return DeploymentResponseGenerator(ref, replica, release)
         return DeploymentResponse(ref, fut)
 
     def __reduce__(self):
@@ -101,12 +101,21 @@ class DeploymentResponseGenerator:
     generator lives replica-side; each __next__ drains one chunk from
     the SAME replica that accepted the request."""
 
-    def __init__(self, ref, replica_handle):
+    def __init__(self, ref, replica_handle, release_cb=None):
         self._ref = ref
         self._replica = replica_handle
+        self._release_cb = release_cb
         self._stream_id: Optional[str] = None
         self._done = False
         self._single: Optional[tuple] = None
+
+    def _release(self) -> None:
+        cb, self._release_cb = self._release_cb, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
 
     def _start(self) -> None:
         result = ray_tpu.get(self._ref)
@@ -126,6 +135,7 @@ class DeploymentResponseGenerator:
             self._start()
         if self._single is not None:
             self._done = True
+            self._release()
             return self._single[0]
         try:
             done, chunk = ray_tpu.get(
@@ -134,9 +144,11 @@ class DeploymentResponseGenerator:
             # Mid-stream failure terminates the iterator: a retry would
             # only hit 'unknown stream' on the replica.
             self._done = True
+            self._release()
             raise
         if done:
             self._done = True
+            self._release()
             raise StopIteration
         return chunk
 
@@ -150,8 +162,21 @@ class DeploymentResponseGenerator:
                 self._start()
             except Exception:
                 self._done = True
+                self._release()
                 return
         self._done = True
-        if self._stream_id is not None:
-            ray_tpu.get(
-                self._replica.cancel_stream.remote(self._stream_id))
+        try:
+            if self._stream_id is not None:
+                ray_tpu.get(
+                    self._replica.cancel_stream.remote(self._stream_id))
+        finally:
+            self._release()
+
+    def __del__(self):
+        # An abandoned generator must not leak the replica-side stream
+        # (it counts as an ongoing request until drained/cancelled).
+        try:
+            if not self._done:
+                self.cancel()
+        except Exception:
+            pass

@@ -303,3 +303,34 @@ class TestPreprocessors:
         row = out.take(1)[0]
         assert list(row["features"]) == [1.0, 2.0]
         assert row["y"] == 9
+
+
+class TestSplits:
+    def test_split_no_data_loss_by_default(self, ray_start_regular):
+        from ray_tpu import data
+
+        parts = data.range(100).split(4)
+        assert [p.count() for p in parts] == [25, 25, 25, 25]
+        all_ids = sorted(r["id"] for p in parts for r in p.take_all())
+        assert all_ids == list(range(100))
+        # Remainder rows are distributed, never dropped.
+        parts = data.range(10).split(3)
+        assert sorted(p.count() for p in parts) == [3, 3, 4]
+
+    def test_split_equalize_truncates(self, ray_start_regular):
+        from ray_tpu import data
+
+        parts = data.range(10).split(3, equal=True)
+        assert [p.count() for p in parts] == [3, 3, 3]  # 1 row dropped
+
+    def test_train_test_split(self, ray_start_regular):
+        from ray_tpu import data
+
+        train, test = data.range(50).train_test_split(0.2)
+        assert train.count() == 40 and test.count() == 10
+        strain, stest = data.range(50).train_test_split(
+            0.2, shuffle=True, seed=7)
+        assert strain.count() == 40 and stest.count() == 10
+        ids = sorted(r["id"] for r in strain.take_all()) + \
+            sorted(r["id"] for r in stest.take_all())
+        assert sorted(ids) == list(range(50))
