@@ -18,6 +18,7 @@ _LOCK = threading.Lock()
 _LIBS = {
     "ray_tpu_store": ["shm_store.cpp"],
     "ray_tpu_transfer": ["shm_store.cpp", "transfer.cpp"],
+    "ray_tpu_channel": ["mutable_channel.cpp"],
 }
 
 

@@ -23,6 +23,7 @@ core_worker.h:295) and its transport layer:
 from __future__ import annotations
 
 import asyncio
+import collections
 import concurrent.futures
 import logging
 import os
@@ -63,12 +64,15 @@ class _Lease:
 
 
 class _SchedulingKeyState:
-    __slots__ = ("queue", "leases", "requests_inflight")
+    __slots__ = ("queue", "leases", "requests_inflight", "duration_ema")
 
     def __init__(self):
         self.queue: List[TaskSpec] = []
         self.leases: List[_Lease] = []
         self.requests_inflight = 0
+        # EMA of worker-reported execution time for this key; None until
+        # the first reply. Gates pipelining (see _pump_scheduling_key).
+        self.duration_ema: Optional[float] = None
 
 
 class _ActorState:
@@ -77,6 +81,9 @@ class _ActorState:
         self.conn: Optional[rpc.Connection] = None
         self.state: str = "PENDING"
         self.seqno = 0
+        # Guards seqno increments: submission happens on the caller's
+        # thread (submit_actor_task_sync), possibly several at once.
+        self.seq_lock = threading.Lock()
         self.death_cause = ""
         self.lock = asyncio.Lock()
 
@@ -137,8 +144,26 @@ class CoreWorker:
         self._local_actor_id: Optional[ActorID] = None
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="task_exec")
+        # Batched user-code dispatch (see _exec_pump): amortizes the
+        # cross-thread wake cost of run_in_executor over bursts of
+        # pipelined tasks. Direct mode for multi-threaded actors.
+        self._exec_lock = threading.Lock()
+        self._exec_queue: "collections.deque" = collections.deque()
+        self._exec_pump_running = False
+        self._exec_direct = False
+        if config.gil_switch_interval_s > 0:
+            # Single-core hosts: the default 5 ms GIL switch interval
+            # stalls the io loop whenever the executor thread holds the
+            # GIL mid-task. A sub-ms interval keeps message handling
+            # responsive (reference relies on true C++ io threads here).
+            import sys as _sys
+
+            _sys.setswitchinterval(config.gil_switch_interval_s)
         self._pending_tasks: Dict[TaskID, TaskSpec] = {}
         self._task_events: List[dict] = []
+        # Events are recorded from user threads (submit_task_sync) AND
+        # the io loop; the swap-on-flush must be atomic across them.
+        self._task_events_lock = threading.Lock()
         self._task_events_last_flush: float = 0.0
         self._borrowed_notified: set = set()
         self._should_exit = asyncio.Event()
@@ -554,11 +579,18 @@ class CoreWorker:
         await self._submit_to_lease(spec)
 
     # ------------------------------------------------------------- submission
-    async def submit_task(self, descriptor: FunctionDescriptor,
-                          args: tuple, kwargs: dict, opts: dict
-                          ) -> List[ObjectRef]:
-        spec = await self._build_spec(NORMAL_TASK, descriptor, args, kwargs,
-                                      opts)
+    def submit_task_sync(self, descriptor: FunctionDescriptor,
+                         args: tuple, kwargs: dict, opts: dict
+                         ) -> List[ObjectRef]:
+        """Submit a normal task from ANY thread without waiting for the loop.
+
+        The hot half of the reference's SubmitTask path (spec build, return
+        refs, ref bookkeeping — normal_task_submitter.cc:24) runs on the
+        caller's thread; only the lease/push pump is posted to the io loop,
+        fire-and-forget, so `.remote()` costs no cross-thread round trip.
+        Submission failures surface on get() via error-envelope returns.
+        """
+        spec = self._build_spec(NORMAL_TASK, descriptor, args, kwargs, opts)
         refs = [ObjectRef(oid, owner_address=self.address)
                 for oid in spec.return_ids()]
         for oid in spec.return_ids():
@@ -566,14 +598,27 @@ class CoreWorker:
                 oid, lineage_task=spec if self.config.lineage_enabled else None)
         self._pending_tasks[spec.task_id] = spec
         self._record_task_event(spec, "PENDING")
-        await self._submit_to_lease(spec)
+        self.loop.call_soon_threadsafe(self._enqueue_for_lease, spec)
         return refs
 
-    async def _build_spec(self, task_type: int,
-                          descriptor: FunctionDescriptor, args: tuple,
-                          kwargs: dict, opts: dict,
-                          actor_id: Optional[ActorID] = None,
-                          method: str = "", seqno: int = -1) -> TaskSpec:
+    async def submit_task(self, descriptor: FunctionDescriptor,
+                          args: tuple, kwargs: dict, opts: dict
+                          ) -> List[ObjectRef]:
+        return self.submit_task_sync(descriptor, args, kwargs, opts)
+
+    def _enqueue_for_lease(self, spec: TaskSpec) -> None:
+        key = spec.scheduling_key()
+        state = self._scheduling_keys.get(key)
+        if state is None:
+            state = self._scheduling_keys[key] = _SchedulingKeyState()
+        state.queue.append(spec)
+        self._pump_scheduling_key(key, state)
+
+    def _build_spec(self, task_type: int,
+                    descriptor: FunctionDescriptor, args: tuple,
+                    kwargs: dict, opts: dict,
+                    actor_id: Optional[ActorID] = None,
+                    method: str = "", seqno: int = -1) -> TaskSpec:
         kwarg_keys = sorted(kwargs.keys())
         wire_args = []
         for arg in list(args) + [kwargs[k] for k in kwarg_keys]:
@@ -625,39 +670,50 @@ class CoreWorker:
         )
 
     async def _submit_to_lease(self, spec: TaskSpec) -> None:
-        key = spec.scheduling_key()
-        state = self._scheduling_keys.get(key)
-        if state is None:
-            state = self._scheduling_keys[key] = _SchedulingKeyState()
-        state.queue.append(spec)
-        self._pump_scheduling_key(key, state)
+        self._enqueue_for_lease(spec)
 
     def _pump_scheduling_key(self, key: tuple,
                              state: _SchedulingKeyState) -> None:
-        # Pipeline queued tasks onto existing leases.
-        for lease in list(state.leases):
-            while state.queue and \
-                    lease.inflight < self.config.max_tasks_in_flight_per_worker:
-                spec = state.queue.pop(0)
-                lease.inflight += 1
-                asyncio.get_running_loop().create_task(
-                    self._push_task(spec, lease, key, state))
-        # Request one lease per queued task (reference: NormalTaskSubmitter
-        # keeps a pending lease request while tasks are queued; we allow a
-        # few in parallel so multi-node spread is immediate).
+        # Assign queued tasks to leases BREADTH-FIRST: one task per idle
+        # lease (strict spread semantics, matching the reference's
+        # one-in-flight `lease_entry.is_busy`, normal_task_submitter.cc:197).
+        # Tasks this key has OBSERVED to be tiny additionally pipeline up
+        # to max_tasks_in_flight_per_worker deep — tiny tasks gain nothing
+        # from spread, and pipelining removes the per-task lease round
+        # trip that dominates their throughput. Long/unknown-duration
+        # tasks never pipeline, so they spread exactly as with depth 1.
+        for lease in state.leases:
+            if state.queue and lease.inflight == 0:
+                self._assign_to_lease(state.queue.pop(0), lease, key, state)
+        depth = max(1, self.config.max_tasks_in_flight_per_worker)
+        if state.queue and depth > 1 and \
+                state.duration_ema is not None and \
+                state.duration_ema <= self.config.pipeline_task_duration_s:
+            for lease in state.leases:
+                while state.queue and lease.inflight < depth:
+                    self._assign_to_lease(state.queue.pop(0), lease, key,
+                                          state)
+        # One lease request per queued task, a few in parallel (reference:
+        # NormalTaskSubmitter keeps a pending lease request while tasks are
+        # queued) — so multi-node spread is immediate.
         while state.queue and state.requests_inflight < min(
                 len(state.queue), self.config.max_pending_lease_requests):
             state.requests_inflight += 1
             spec = state.queue[0]
-            asyncio.get_running_loop().create_task(
+            self.loop.create_task(
                 self._request_lease(spec, key, state))
         # Return leases that arrived after the queue drained (otherwise they
         # pin their resources forever).
         if not state.queue:
             for lease in [l for l in state.leases if l.inflight == 0]:
                 state.leases.remove(lease)
-                asyncio.get_running_loop().create_task(
+                self.loop.create_task(
                     self._return_lease(lease))
+
+    def _assign_to_lease(self, spec: TaskSpec, lease: "_Lease", key: tuple,
+                         state: _SchedulingKeyState) -> None:
+        lease.inflight += 1
+        self.loop.create_task(self._push_task(spec, lease, key, state))
 
     async def _request_lease(self, spec: TaskSpec, key: tuple,
                              state: _SchedulingKeyState,
@@ -743,6 +799,11 @@ class CoreWorker:
         try:
             reply = await lease.conn.call("push_task",
                                           {"task": spec.to_wire()})
+            exec_s = reply.get("exec_s")
+            if exec_s is not None:
+                state.duration_ema = (exec_s if state.duration_ema is None
+                                      else 0.7 * state.duration_ema +
+                                      0.3 * exec_s)
             # Application-level retry (reference: TaskManager retries with
             # retry_exceptions=True).
             if reply.get("status") == "error" and spec.retry_exceptions and \
@@ -829,9 +890,8 @@ class CoreWorker:
             "max_concurrency": opts.get("max_concurrency", 1),
             "max_restarts": opts.get("max_restarts", 0),
         }
-        spec = await self._build_spec(ACTOR_CREATION_TASK, descriptor, args,
-                                      kwargs, creation_opts,
-                                      actor_id=actor_id)
+        spec = self._build_spec(ACTOR_CREATION_TASK, descriptor, args,
+                                kwargs, creation_opts, actor_id=actor_id)
         r = await self.gcs.call("register_actor", {
             "actor_id": actor_id.binary(),
             "job_id": self.job_id.binary(),
@@ -871,25 +931,43 @@ class CoreWorker:
                                         name=f"actor:{actor_id.hex()[:8]}")
             return st.conn
 
-    async def submit_actor_task(self, actor_id: ActorID, method: str,
-                                args: tuple, kwargs: dict,
-                                opts: dict) -> List[ObjectRef]:
+    def submit_actor_task_sync(self, actor_id: ActorID, method: str,
+                               args: tuple, kwargs: dict,
+                               opts: dict) -> List[ObjectRef]:
+        """Submit an actor task from ANY thread without a loop round trip.
+
+        Spec build + ref bookkeeping on the caller's thread; the push task
+        is posted fire-and-forget. call_soon_threadsafe callbacks run FIFO,
+        so seqno order is preserved on the wire (reference:
+        ActorTaskSubmitter's ordered queues).
+        """
         opts = dict(opts)
         opts.setdefault("num_returns", 1)
         st = self._actors.setdefault(actor_id, _ActorState())
-        st.seqno += 1
-        spec = await self._build_spec(ACTOR_TASK, _actor_method_descriptor(
+        with st.seq_lock:
+            st.seqno += 1
+            seqno = st.seqno
+        spec = self._build_spec(ACTOR_TASK, _actor_method_descriptor(
             method), args, kwargs, opts, actor_id=actor_id, method=method,
-            seqno=st.seqno)
+            seqno=seqno)
         spec.resources = {}
         refs = [ObjectRef(oid, owner_address=self.address)
                 for oid in spec.return_ids()]
         for oid in spec.return_ids():
             self.reference_counter.add_owned_object(oid)
         self._pending_tasks[spec.task_id] = spec
-        asyncio.get_running_loop().create_task(
-            self._push_actor_task(spec, actor_id))
+        self.loop.call_soon_threadsafe(self._spawn_actor_push, spec,
+                                       actor_id)
         return refs
+
+    def _spawn_actor_push(self, spec: TaskSpec, actor_id: ActorID) -> None:
+        self.loop.create_task(self._push_actor_task(spec, actor_id))
+
+    async def submit_actor_task(self, actor_id: ActorID, method: str,
+                                args: tuple, kwargs: dict,
+                                opts: dict) -> List[ObjectRef]:
+        return self.submit_actor_task_sync(actor_id, method, args, kwargs,
+                                           opts)
 
     async def _push_actor_task(self, spec: TaskSpec, actor_id: ActorID,
                                retry: int = 1) -> None:
@@ -956,8 +1034,58 @@ class CoreWorker:
             args, kwargs = tuple(values), {}
         return args, kwargs
 
-    def _execute_user_code(self, fn: Callable, args: tuple, kwargs: dict):
-        return fn(*args, **kwargs)
+    def _execute_user_code(self, fn: Callable, args: tuple, kwargs: dict,
+                           spec: Optional[TaskSpec] = None):
+        """Runs on the executor thread. _current_task is set HERE (not on
+        the loop around awaits) so pipelined task coroutines can't stomp
+        each other's context — execution itself is serialized by the
+        single-thread executor."""
+        if spec is None:
+            return fn(*args, **kwargs)
+        prev = self._current_task
+        self._current_task = spec
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            self._current_task = prev
+
+    # --- runtime-env isolation gate -------------------------------------
+    # With pipelined task execution (max_tasks_in_flight_per_worker > 1),
+    # a task that applies a runtime_env mutates process-global state
+    # (os.environ, cwd, sys.path) across awaits. Such tasks take this gate
+    # exclusively; plain tasks take it shared. Waiting env tasks block new
+    # plain admissions so they can't be starved.
+    def _env_gate_init(self) -> None:
+        self._gate_cond = asyncio.Condition()
+        self._gate_running = 0
+        self._gate_env_active = False
+        self._gate_env_waiting = 0
+
+    async def _begin_task(self, exclusive: bool) -> None:
+        if not hasattr(self, "_gate_cond"):
+            self._env_gate_init()
+        async with self._gate_cond:
+            if exclusive:
+                self._gate_env_waiting += 1
+                try:
+                    await self._gate_cond.wait_for(
+                        lambda: self._gate_running == 0 and
+                        not self._gate_env_active)
+                finally:
+                    self._gate_env_waiting -= 1
+                self._gate_env_active = True
+            else:
+                await self._gate_cond.wait_for(
+                    lambda: not self._gate_env_active and
+                    self._gate_env_waiting == 0)
+            self._gate_running += 1
+
+    async def _end_task(self, exclusive: bool) -> None:
+        async with self._gate_cond:
+            self._gate_running -= 1
+            if exclusive:
+                self._gate_env_active = False
+            self._gate_cond.notify_all()
 
     def _sync_gcs_call(self, method: str, data=None):
         """GCS call usable from executor threads (runtime_env fetch).
@@ -987,8 +1115,49 @@ class CoreWorker:
                 None, _materialize, uri, self._sync_gcs_call)
 
     async def _run_sync(self, fn, *args):
-        return await asyncio.get_running_loop().run_in_executor(
-            self._executor, fn, *args)
+        if self._exec_direct:
+            # Multi-threaded actor pool: parallel dispatch.
+            return await asyncio.get_running_loop().run_in_executor(
+                self._executor, fn, *args)
+        fut = self.loop.create_future()
+        with self._exec_lock:
+            self._exec_queue.append((fn, args, fut))
+            start = not self._exec_pump_running
+            if start:
+                self._exec_pump_running = True
+        if start:
+            self._executor.submit(self._exec_pump)
+        return await fut
+
+    def _exec_pump(self) -> None:
+        """Runs in the executor thread: drains queued user-code calls.
+        Amortizes the executor-thread WAKE over bursts (one submit per
+        drain, not per task — ~50-80us of context switch + GIL handoff
+        each on single-core hosts). Results post back immediately after
+        each item: the next queued fn may be arbitrarily slow and must
+        not delay replies for already-finished tasks."""
+        while True:
+            with self._exec_lock:
+                item = (self._exec_queue.popleft()
+                        if self._exec_queue else None)
+                if item is None:
+                    self._exec_pump_running = False
+                    return
+            fn, args, fut = item
+            try:
+                result, err = fn(*args), None
+            except BaseException as e:  # surfaced via the task's future
+                result, err = None, e
+            self.loop.call_soon_threadsafe(self._exec_resolve_one, fut,
+                                           result, err)
+
+    def _exec_resolve_one(self, fut, result, err) -> None:
+        if fut.cancelled():
+            return
+        if err is None:
+            fut.set_result(result)
+        else:
+            fut.set_exception(err)
 
     async def _fetch_function(self, descriptor: FunctionDescriptor):
         fn = self.function_manager.get_cached(descriptor)
@@ -999,12 +1168,14 @@ class CoreWorker:
         return fn
 
     async def _execute_normal_task(self, spec: TaskSpec) -> dict:
+        # The env must be live BEFORE function unpickle and argument
+        # deserialization: shipped py_modules/working_dir code may be
+        # referenced by the pickled payloads themselves. The env mutates
+        # process-global state across awaits, so env-bearing tasks hold
+        # the gate exclusively while pipelined plain tasks share it.
+        exclusive = bool(spec.runtime_env)
+        await self._begin_task(exclusive)
         try:
-            # The env must be live BEFORE function unpickle and argument
-            # deserialization: shipped py_modules/working_dir code may be
-            # referenced by the pickled payloads themselves. Safe to span
-            # the awaits: a leased worker executes one normal task at a
-            # time (max_tasks_in_flight_per_worker).
             from ray_tpu._private.runtime_env import applied_runtime_env
 
             await self._prefetch_runtime_env(spec.runtime_env)
@@ -1012,14 +1183,27 @@ class CoreWorker:
                                      self._sync_gcs_call):
                 fn = await self._fetch_function(spec.function)
                 args, kwargs = await self._resolve_args(spec)
-                self._current_task = spec
-                result = await self._run_sync(
-                    lambda: self._execute_user_code(fn, args, kwargs))
-            return await self._store_returns(spec, result)
+                exec_box: List[float] = []
+
+                def _run_timed():
+                    t0 = time.monotonic()
+                    try:
+                        return self._execute_user_code(fn, args, kwargs,
+                                                       spec)
+                    finally:
+                        exec_box.append(time.monotonic() - t0)
+
+                result = await self._run_sync(_run_timed)
+                exec_s = exec_box[0]
+            reply = await self._store_returns(spec, result)
+            # Execution time feeds the submitter's pipelining gate
+            # (_pump_scheduling_key): only observed-tiny tasks pipeline.
+            reply["exec_s"] = exec_s
+            return reply
         except Exception as e:
             return await self._store_exception(spec, e)
         finally:
-            self._current_task = None
+            await self._end_task(exclusive)
 
     async def _execute_actor_creation(self, spec: TaskSpec) -> dict:
         try:
@@ -1045,6 +1229,7 @@ class CoreWorker:
                 self._executor = concurrent.futures.ThreadPoolExecutor(
                     max_workers=max_concurrency,
                     thread_name_prefix="actor_exec")
+                self._exec_direct = True  # parallel dispatch, no pump
             await self.gcs.call("actor_ready", {
                 "actor_id": spec.actor_id.binary(),
                 "address": self.address,
@@ -1069,6 +1254,17 @@ class CoreWorker:
                     "returns": []}
         async with actor.semaphore:
             try:
+                if spec.actor_method == "__dag_loop__":
+                    # Compiled-DAG loop install (ray_tpu/dag/compiled_dag.py):
+                    # runs on the executor thread until channel teardown.
+                    from ray_tpu.experimental.channel.exec_loop import \
+                        run_dag_loop
+
+                    (plan,), _ = await self._resolve_args(spec)
+                    self._current_task = spec
+                    result = await self._run_sync(
+                        run_dag_loop, actor.instance, plan)
+                    return await self._store_returns(spec, result)
                 method = getattr(actor.instance, spec.actor_method)
                 args, kwargs = await self._resolve_args(spec)
                 self._current_task = spec
@@ -1078,7 +1274,7 @@ class CoreWorker:
                     # Actor env was applied permanently at creation.
                     result = await self._run_sync(
                         lambda: self._execute_user_code(method, args,
-                                                        kwargs))
+                                                        kwargs, spec))
                 return await self._store_returns(spec, result)
             except Exception as e:
                 return await self._store_exception(spec, e)
@@ -1143,15 +1339,17 @@ class CoreWorker:
     def _record_task_event(self, spec: TaskSpec, state: str) -> None:
         if not self.config.task_events_enabled:
             return
-        self._task_events.append({
-            "task_id": spec.task_id.binary(),
-            "job_id": spec.job_id.binary(),
-            "name": spec.name,
-            "state": state,
-            "time": time.time(),
-            "worker_id": self.worker_id.binary(),
-            "actor_id": spec.actor_id.binary() if spec.actor_id else None,
-        })
+        with self._task_events_lock:
+            self._task_events.append({
+                "task_id": spec.task_id.binary(),
+                "job_id": spec.job_id.binary(),
+                "name": spec.name,
+                "state": state,
+                "time": time.time(),
+                "worker_id": self.worker_id.binary(),
+                "actor_id": spec.actor_id.binary() if spec.actor_id
+                else None,
+            })
         # Flush on batch size or a 1s cadence (reference: TaskEventBuffer
         # periodic flush, task_event_buffer.h:206).
         if len(self._task_events) >= 100 or \
@@ -1164,25 +1362,27 @@ class CoreWorker:
         the task-event pipeline, shows up in `ray timeline`."""
         if not self.config.task_events_enabled:
             return
-        self._task_events.append({
-            "task_id": os.urandom(8),
-            "job_id": self.job_id.binary() if self.job_id else b"",
-            "name": name,
-            "state": "PROFILE",
-            "time": start,
-            "end_time": end,
-            "worker_id": self.worker_id.binary(),
-            "actor_id": None,
-            "extra": extra or {},
-        })
+        with self._task_events_lock:
+            self._task_events.append({
+                "task_id": os.urandom(8),
+                "job_id": self.job_id.binary() if self.job_id else b"",
+                "name": name,
+                "state": "PROFILE",
+                "time": start,
+                "end_time": end,
+                "worker_id": self.worker_id.binary(),
+                "actor_id": None,
+                "extra": extra or {},
+            })
         if len(self._task_events) >= 100 or \
                 time.time() - self._task_events_last_flush > 1.0:
             self._flush_task_events()
 
     def _flush_task_events(self) -> None:
         self._task_events_last_flush = time.time()
-        events, self._task_events = self._task_events, []
-        if self.gcs and not self.gcs.closed:
+        with self._task_events_lock:
+            events, self._task_events = self._task_events, []
+        if events and self.gcs and not self.gcs.closed:
             asyncio.run_coroutine_threadsafe(
                 self._send_events(events), self.loop)
 

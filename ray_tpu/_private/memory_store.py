@@ -4,12 +4,15 @@ Equivalent of the reference's CoreWorkerMemoryStore
 (src/ray/core_worker/store_provider/memory_store/memory_store.h:43): small
 objects (< max_direct_call_object_size) live in the owner's process and are
 inlined into task replies instead of round-tripping through shared memory.
-Waiters are asyncio futures resolved on put.
+Waiters come in two flavors: asyncio futures (loop-side getters) and
+threading.Events (the synchronous fast path in worker.get, which reads the
+store directly from the user thread without an io-loop round trip).
 """
 
 from __future__ import annotations
 
 import asyncio
+import threading
 from typing import Dict, List, Optional
 
 from ray_tpu.core.ids import ObjectID
@@ -23,6 +26,11 @@ class MemoryStore:
         self._objects: Dict[ObjectID, bytes] = {}
         self._plasma_markers: set[ObjectID] = set()
         self._waiters: Dict[ObjectID, List[asyncio.Future]] = {}
+        # Cross-thread waiters (worker.get fast path). Guarded by _sync_lock;
+        # _objects itself is written only on the loop thread and read from
+        # any thread (GIL-atomic dict ops).
+        self._sync_lock = threading.Lock()
+        self._sync_waiters: Dict[ObjectID, List[threading.Event]] = {}
 
     def put(self, object_id: ObjectID, data: bytes) -> None:
         """Store serialized bytes and wake waiters. Thread-safe via loop."""
@@ -36,6 +44,11 @@ class MemoryStore:
         for fut in self._waiters.pop(object_id, []):
             if not fut.done():
                 fut.set_result(True)
+        if self._sync_waiters:
+            with self._sync_lock:
+                events = self._sync_waiters.pop(object_id, ())
+            for ev in events:
+                ev.set()
 
     def put_in_loop(self, object_id: ObjectID, data: bytes) -> None:
         """Same as put() but caller is already on the loop."""
@@ -69,6 +82,34 @@ class MemoryStore:
             lst = self._waiters.get(object_id)
             if lst and fut in lst:
                 lst.remove(fut)
+
+    def wait_ready_sync(self, object_id: ObjectID,
+                        timeout: Optional[float] = None) -> bool:
+        """Block the calling (non-loop) thread until the object lands.
+
+        Used by the synchronous get fast path: avoids two cross-thread
+        hops per get by waiting on a threading.Event set directly from
+        _put_in_loop.
+        """
+        if self.contains(object_id):
+            return True
+        ev = threading.Event()
+        with self._sync_lock:
+            self._sync_waiters.setdefault(object_id, []).append(ev)
+        try:
+            if self.contains(object_id):  # landed during registration
+                return True
+            return ev.wait(timeout)
+        finally:
+            with self._sync_lock:
+                lst = self._sync_waiters.get(object_id)
+                if lst is not None:
+                    try:
+                        lst.remove(ev)
+                    except ValueError:
+                        pass
+                    if not lst:
+                        del self._sync_waiters[object_id]
 
     def delete(self, object_id: ObjectID) -> None:
         self._objects.pop(object_id, None)

@@ -23,6 +23,7 @@ logger = logging.getLogger(__name__)
 
 _global_worker: Optional["Worker"] = None
 _init_lock = threading.Lock()
+_FALLBACK = object()  # sentinel: _get_fast defers to the loop-based path
 
 
 class Worker:
@@ -52,13 +53,69 @@ class Worker:
 
     # -- public ops --------------------------------------------------------
     def get(self, refs, timeout: Optional[float] = None):
-        single = isinstance(refs, ObjectRef)
+        from ray_tpu.dag.compiled_dag import CompiledDAGRef
+
+        single = isinstance(refs, (ObjectRef, CompiledDAGRef))
         ref_list = [refs] if single else list(refs)
+        if any(isinstance(r, CompiledDAGRef) for r in ref_list):
+            # Compiled-DAG results read their channels directly
+            # (reference: ray.get on CompiledDAGRef).
+            values = [r.get(timeout) for r in ref_list]
+            return values[0] if single else values
         for r in ref_list:
             if not isinstance(r, ObjectRef):
                 raise TypeError(f"get() takes ObjectRefs, got {type(r)}")
-        values = self._run(self.core.get_objects(ref_list, timeout))
+        values = self._get_fast(ref_list, timeout)
+        if values is _FALLBACK:
+            values = self._run(self.core.get_objects(ref_list, timeout))
         return values[0] if single else values
+
+    def _get_fast(self, ref_list, timeout: Optional[float]):
+        """Synchronous fast path: objects owned by this worker whose values
+        land in the in-process memory store (small task returns, actor call
+        replies) are read and deserialized directly on the calling thread —
+        zero io-loop round trips per get. Anything else (plasma objects,
+        borrowed refs, lost objects needing reconstruction) falls back to
+        the loop-based CoreWorker.get_objects path.
+        """
+        import time as _time
+
+        from ray_tpu.core import serialization as ser
+
+        core = self.core
+        store = core.memory_store
+        deadline = (_time.monotonic() + timeout
+                    if timeout is not None else None)
+        out = []
+        for ref in ref_list:
+            data = store.get_if_exists(ref.id)
+            while data is None:
+                if store.is_in_plasma(ref.id):
+                    return _FALLBACK
+                if not core.reference_counter.is_owned(ref.id):
+                    return _FALLBACK
+                if ref.id.task_id() not in core._pending_tasks:
+                    # Completed-but-absent (evicted / needs reconstruction)
+                    # or just landed: one cheap recheck, else slow path.
+                    data = store.get_if_exists(ref.id)
+                    if data is None:
+                        return _FALLBACK
+                    break
+                remaining = (None if deadline is None
+                             else deadline - _time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise ser.GetTimeoutError(f"get timed out on {ref}")
+                store.wait_ready_sync(
+                    ref.id, min(remaining, 1.0) if remaining else 1.0)
+                data = store.get_if_exists(ref.id)
+            value = ser.loads(data)
+            if isinstance(value, (ser.RayTaskError, ser.ActorDiedError,
+                                  ser.WorkerCrashedError,
+                                  ser.TaskCancelledError,
+                                  ser.ObjectLostError)):
+                raise value
+            out.append(value)
+        return out
 
     def put(self, value) -> ObjectRef:
         if isinstance(value, ObjectRef):
@@ -143,8 +200,8 @@ class Worker:
 
     def submit_task(self, descriptor, args, kwargs, opts) -> List[ObjectRef]:
         opts = self._prepare_env_opts(opts)
-        return self._run(
-            self.core.submit_task(descriptor, args, kwargs, opts))
+        # Caller-thread fast path: no io-loop round trip per .remote().
+        return self.core.submit_task_sync(descriptor, args, kwargs, opts)
 
     def create_actor(self, descriptor, args, kwargs, opts) -> ActorID:
         opts = self._prepare_env_opts(opts)
@@ -152,8 +209,8 @@ class Worker:
             self.core.create_actor(descriptor, args, kwargs, opts))
 
     def submit_actor_task(self, actor_id, method, args, kwargs, opts):
-        return self._run(self.core.submit_actor_task(
-            actor_id, method, args, kwargs, opts))
+        return self.core.submit_actor_task_sync(
+            actor_id, method, args, kwargs, opts)
 
     def export(self, fn):
         return self.core.function_manager.export(fn)

@@ -55,7 +55,22 @@ def main() -> None:
         await cw._should_exit.wait()
         await cw.disconnect()
 
-    asyncio.run(amain())
+    profile_dir = os.environ.get("RAY_TPU_WORKER_PROFILE")
+    if profile_dir:
+        # Debug aid: cProfile the whole worker (loop thread) and dump
+        # stats at exit — the only way to see inside spawned workers in
+        # environments without py-spy/perf.
+        import cProfile
+
+        prof = cProfile.Profile()
+        try:
+            prof.runcall(asyncio.run, amain())
+        finally:
+            os.makedirs(profile_dir, exist_ok=True)
+            prof.dump_stats(os.path.join(
+                profile_dir, f"worker_{os.getpid()}.prof"))
+    else:
+        asyncio.run(amain())
 
 
 if __name__ == "__main__":

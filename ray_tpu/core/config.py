@@ -33,9 +33,16 @@ class Config:
     # resource utilization exceeds this threshold, then spread
     # (reference: scheduler_spread_threshold, hybrid_scheduling_policy.cc).
     scheduler_spread_threshold: float = 0.5
-    # 1 = strict resource semantics (one running task per leased worker);
-    # raise for tiny-task throughput pipelining.
-    max_tasks_in_flight_per_worker: int = 1
+    # Tasks pushed concurrently to one leased worker (reference:
+    # max_tasks_in_flight_per_worker, normal_task_submitter.cc — the
+    # pipelining that makes tiny-task throughput). Execution on the worker
+    # stays serialized (single-thread executor); only queueing overlaps.
+    # Set to 1 for strict one-task-per-lease semantics.
+    max_tasks_in_flight_per_worker: int = 10
+    # Pipelining engages only for scheduling keys whose observed (worker-
+    # reported) execution time EMA is at or below this; longer tasks keep
+    # strict one-in-flight spread semantics.
+    pipeline_task_duration_s: float = 0.1
     max_pending_lease_requests: int = 8
     worker_lease_timeout_s: float = 30.0
     # --- health / failure detection ---
@@ -57,6 +64,11 @@ class Config:
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_max_message_bytes: int = 512 << 20
+    # GIL switch interval applied in every ray_tpu process (0 = leave
+    # Python's 5 ms default). Sub-ms keeps the io loop responsive while
+    # the executor thread runs user code — the Python substitute for the
+    # reference's dedicated C++ io threads. Matters most on few-core hosts.
+    gil_switch_interval_s: float = 0.001
     # --- chaos / testing (reference: src/ray/common/asio/asio_chaos.h) ---
     # "handler_name=delay_us,..." — injects latency into named control-plane
     # handlers for deterministic race amplification.

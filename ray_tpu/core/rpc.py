@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import struct
 import threading
 import time
@@ -58,6 +59,11 @@ class Connection:
     Both ends can issue requests; the handler (if any) serves incoming ones.
     """
 
+    # Cork threshold: frames accumulate in _out and flush once per loop
+    # tick (one write syscall for a burst of messages); anything larger
+    # flushes immediately and awaits transport drain for backpressure.
+    CORK_BYTES = 256 * 1024
+
     def __init__(
         self,
         reader: asyncio.StreamReader,
@@ -74,6 +80,8 @@ class Connection:
         self._closed = False
         self._read_task: Optional[asyncio.Task] = None
         self._write_lock = asyncio.Lock()
+        self._out = bytearray()
+        self._flush_scheduled = False
         self.on_close: Optional[Callable[["Connection"], None]] = None
 
     def start(self) -> None:
@@ -130,11 +138,45 @@ class Connection:
 
     async def send(self, msg) -> None:
         data = _pack(msg)
-        async with self._write_lock:
-            if self._closed:
-                raise ConnectionLost(f"connection {self.name} closed")
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        if len(data) >= self.CORK_BYTES:
+            # Large payload: flush the cork, write directly, apply
+            # transport backpressure.
+            async with self._write_lock:
+                if self._closed:
+                    raise ConnectionLost(f"connection {self.name} closed")
+                self._flush()
+                self.writer.write(data)
+                await self.writer.drain()
+            return
+        self.send_nowait(data)
+
+    def send_nowait(self, data: bytes) -> None:
+        """Queue a packed frame; flushed once per loop tick. Writes from
+        one loop iteration (e.g. a pipelined burst of task pushes or
+        replies) coalesce into a single write syscall — the dominant cost
+        on small control messages."""
+        self._out += data
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush)
+
+    def _flush(self) -> None:
+        self._flush_scheduled = False
+        if self._closed or not self._out:
+            self._out.clear()
+            return
+        data = bytes(self._out)
+        self._out.clear()
+        try:
             self.writer.write(data)
-            await self.writer.drain()
+        except Exception:
+            # Transport write failed (e.g. half-open connection): the
+            # frames are lost, so tear down NOW — pending callers get
+            # ConnectionLost instead of hanging on futures whose
+            # requests never left this process.
+            asyncio.get_running_loop().create_task(self._teardown())
 
     async def call(self, method: str, data: Any = None,
                    timeout: Optional[float] = None) -> Any:
@@ -154,6 +196,10 @@ class Connection:
     async def _teardown(self) -> None:
         if self._closed:
             return
+        try:
+            self._flush()
+        except Exception:
+            pass
         self._closed = True
         for fut in self._pending.values():
             if not fut.done():
@@ -263,7 +309,21 @@ class EventLoopThread:
 
     def _run(self) -> None:
         asyncio.set_event_loop(self.loop)
-        self.loop.run_forever()
+        profile_dir = os.environ.get("RAY_TPU_IO_PROFILE")
+        if profile_dir:
+            # Debug aid (like RAY_TPU_WORKER_PROFILE): cProfile this io
+            # loop thread, dump at loop stop.
+            import cProfile
+
+            prof = cProfile.Profile()
+            try:
+                prof.runcall(self.loop.run_forever)
+            finally:
+                os.makedirs(profile_dir, exist_ok=True)
+                prof.dump_stats(os.path.join(
+                    profile_dir, f"io_{os.getpid()}.prof"))
+        else:
+            self.loop.run_forever()
 
     def run(self, coro, timeout: Optional[float] = None):
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)

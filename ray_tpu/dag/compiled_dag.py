@@ -1,27 +1,310 @@
-"""Compiled DAGs (reference: python/ray/dag/compiled_dag_node.py:391).
+"""Compiled DAGs — static actor pipelines over mutable shm channels.
 
-Round-1 implementation: validates the DAG once and caches actor bindings so
-repeated ``execute()`` calls skip re-planning. The reference's full compiled
-path — preallocated mutable shared-memory channels and device-to-device
-channels with no per-step driver involvement — lands with the channel layer
-(ray_tpu/experimental/channel/); this class is the stable API surface for
-it.
+Reference: python/ray/dag/compiled_dag_node.py:391 (CompiledDAG: allocate
+channels, install a per-actor execution loop, drive steady-state iterations
+with zero per-step driver RPCs; channels in python/ray/experimental/channel/).
+
+Compilation:
+1. Walk the DAG (InputNode / ActorMethodNode / MultiOutputNode). Each
+   cross-process edge gets a native mutable shm channel
+   (ray_tpu/experimental/channel/); same-actor edges stay local values.
+2. Each participating actor receives one ``__dag_loop__`` task carrying its
+   plan (methods + channel bindings); the loop (exec_loop.run_dag_loop)
+   runs until teardown closes the input channels.
+3. ``execute(x)`` writes x into the input channel and returns a
+   CompiledDAGRef; ``.get()`` reads the output channels — both directly on
+   the caller's thread through shared memory, no RPCs, no event loop.
+
+Graphs with non-actor nodes (FunctionNode) fall back to eager per-call
+task submission, same API.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import logging
+from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu.dag.dag_node import DAGNode
+from ray_tpu.core import serialization as ser
+from ray_tpu.dag.dag_node import (ActorClassNode, ActorMethodNode, DAGNode,
+                                  FunctionNode, InputNode, MultiOutputNode)
+
+logger = logging.getLogger(__name__)
+
+
+class CompiledDAGRef:
+    """Result handle for one compiled-DAG execution (reference:
+    python/ray/experimental/compiled_dag_ref.py)."""
+
+    def __init__(self, dag: "CompiledDAG", index: int, output_index: int):
+        self._dag = dag
+        self._index = index
+        self._output_index = output_index
+        self._value: Any = None
+        self._fetched = False
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._fetched:
+            self._dag._fetch_until(self._index, timeout)
+            self._value = self._dag._take_result(self._index,
+                                                 self._output_index)
+            self._fetched = True
+        if isinstance(self._value, Exception):
+            raise self._value
+        return self._value
 
 
 class CompiledDAG:
-    def __init__(self, root: DAGNode, **_options):
+    def __init__(self, root: DAGNode, *, buffer_size_bytes: int = 16 << 20,
+                 submit_timeout: float = 30.0,
+                 max_inflight_executions: int = 8):
         self._root = root
-        self._actor_cache: dict = {}
+        self._buffer_size = buffer_size_bytes
+        self._timeout = submit_timeout
+        # Channel ring depth == max executions in flight before get()
+        # (reference: CompiledDAG _max_inflight_executions).
+        self._max_inflight = max(2, min(max_inflight_executions, 64))
+        self._eager = False
+        self._input_chan = None
+        self._input_path: Optional[str] = None
+        self._output_chans: List = []
+        self._all_chan_paths: List[str] = []
+        self._loop_refs: List = []
+        # Per-execution result rows, trimmed once every output is taken.
+        self._pending: Dict[int, List[Any]] = {}
+        self._taken: Dict[int, int] = {}
+        self._executions = 0
+        self._fetched_upto = 0
+        self._fetch_col = 0  # resume column for a mid-row timeout
+        self._torn_down = False
+        self._compile()
 
+    # ------------------------------------------------------------ compile
+    def _collect(self) -> Tuple[List[DAGNode], List[DAGNode]]:
+        """Post-order node list + explicit output list."""
+        order: List[DAGNode] = []
+        seen: set = set()
+        root = self._root
+        outputs = (list(root._outputs) if isinstance(root, MultiOutputNode)
+                   else [root])
+
+        def visit(node: DAGNode):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for dep in list(node._bound_args) + \
+                    list(node._bound_kwargs.values()):
+                if isinstance(dep, DAGNode):
+                    visit(dep)
+            if isinstance(node, ActorMethodNode) and \
+                    isinstance(node._target, DAGNode):
+                visit(node._target)
+            order.append(node)
+
+        for out in outputs:
+            visit(out)
+        return order, outputs
+
+    def _compile(self) -> None:
+        from ray_tpu.core.actor import ActorHandle
+
+        order, outputs = self._collect()
+        method_nodes = [n for n in order if isinstance(n, ActorMethodNode)]
+        has_input = any(isinstance(n, InputNode) for n in order)
+        if not method_nodes or not has_input or \
+                any(isinstance(n, FunctionNode) for n in order) or \
+                not all(isinstance(out, ActorMethodNode) for out in outputs):
+            # Not a pure input-driven actor pipeline (a DAG without an
+            # InputNode would free-run, decoupled from execute()): keep
+            # the eager path.
+            self._eager = True
+            return
+
+        from ray_tpu.experimental.channel import Channel
+
+        def actor_of(node: ActorMethodNode) -> ActorHandle:
+            target = node._target
+            if isinstance(target, ActorClassNode):
+                return target._execute(None, {})
+            if isinstance(target, ActorHandle):
+                return target
+            raise TypeError(
+                f"compiled DAG methods must bind to actors, got {target!r}")
+
+        node_actor: Dict[int, ActorHandle] = {
+            id(n): actor_of(n) for n in method_nodes}
+
+        # Which actors read the driver input?
+        input_consumer_actors: List[bytes] = []
+        for n in method_nodes:
+            for dep in list(n._bound_args) + \
+                    list(n._bound_kwargs.values()):
+                if isinstance(dep, InputNode):
+                    aid = node_actor[id(n)]._actor_id.binary()
+                    if aid not in input_consumer_actors:
+                        input_consumer_actors.append(aid)
+
+        plans: Dict[bytes, Dict] = {}
+        actor_handles: Dict[bytes, ActorHandle] = {}
+        for n in method_nodes:
+            handle = node_actor[id(n)]
+            aid = handle._actor_id.binary()
+            actor_handles[aid] = handle
+            plans.setdefault(aid, {"in_chans": [], "steps": [],
+                                   "out_chans": [], "consts": []})
+
+        if input_consumer_actors:
+            self._input_path = Channel.create(
+                n_readers=len(input_consumer_actors),
+                capacity=self._buffer_size,
+                n_slots=self._max_inflight)
+            self._all_chan_paths.append(self._input_path)
+            self._input_chan = Channel(self._input_path)
+            for rid, aid in enumerate(input_consumer_actors):
+                plans[aid]["in_chans"].append((self._input_path, rid))
+                plans[aid]["_input_idx"] = len(plans[aid]["in_chans"]) - 1
+
+        # Steps in topo order; cross-actor edges become channels.
+        step_index: Dict[int, Tuple[bytes, int]] = {}
+        for n in method_nodes:
+            aid = node_actor[id(n)]._actor_id.binary()
+            plan = plans[aid]
+
+            def argspec(dep):
+                if isinstance(dep, InputNode):
+                    return ("chan", plan["_input_idx"])
+                if isinstance(dep, ActorMethodNode):
+                    src_aid, src_idx = step_index[id(dep)]
+                    if src_aid == aid:
+                        return ("local", src_idx)
+                    path = Channel.create(n_readers=1,
+                                          capacity=self._buffer_size,
+                                          n_slots=self._max_inflight)
+                    self._all_chan_paths.append(path)
+                    src_plan = plans[src_aid]
+                    src_plan["out_chans"].append(path)
+                    src_plan["steps"][src_idx]["outs"].append(
+                        len(src_plan["out_chans"]) - 1)
+                    plan["in_chans"].append((path, 0))
+                    return ("chan", len(plan["in_chans"]) - 1)
+                if isinstance(dep, DAGNode):
+                    raise TypeError(f"unsupported DAG dep: {dep!r}")
+                plan["consts"].append(ser.dumps(dep))
+                return ("const", len(plan["consts"]) - 1)
+
+            step = {
+                "method": n._method,
+                "args": [argspec(a) for a in n._bound_args],
+                "kwargs": {k: argspec(v)
+                           for k, v in n._bound_kwargs.items()},
+                "outs": [],
+            }
+            plan["steps"].append(step)
+            step_index[id(n)] = (aid, len(plan["steps"]) - 1)
+
+        # Output channels (producer actor -> driver).
+        for out in outputs:
+            src_aid, src_idx = step_index[id(out)]
+            path = Channel.create(n_readers=1, capacity=self._buffer_size,
+                                  n_slots=self._max_inflight)
+            self._all_chan_paths.append(path)
+            src_plan = plans[src_aid]
+            src_plan["out_chans"].append(path)
+            src_plan["steps"][src_idx]["outs"].append(
+                len(src_plan["out_chans"]) - 1)
+            self._output_chans.append(Channel(path, reader_id=0))
+
+        from ray_tpu.core.actor import ActorMethod
+
+        for aid, plan in plans.items():
+            plan.pop("_input_idx", None)
+            # Direct ActorMethod: __getattr__ blocks dunder-prefixed names.
+            self._loop_refs.append(ActorMethod(
+                actor_handles[aid], "__dag_loop__", {}).remote(plan))
+
+    # ------------------------------------------------------------ execute
     def execute(self, input_value: Any = None):
-        return self._root._execute(input_value, {})
+        if self._eager:
+            return self._root._execute(input_value, {})
+        if self._torn_down:
+            raise RuntimeError("compiled DAG was torn down")
+        if self._executions - self._fetched_upto >= self._max_inflight:
+            raise RuntimeError(
+                f"{self._max_inflight} executions already in flight; call "
+                "get() on earlier results first (or raise "
+                "max_inflight_executions)")
+        if self._input_chan is not None:
+            self._input_chan.write(input_value, timeout=self._timeout)
+        self._executions += 1
+        refs = [CompiledDAGRef(self, self._executions - 1, i)
+                for i in range(len(self._output_chans))]
+        self._pending[self._executions - 1] = \
+            [None] * len(self._output_chans)
+        if isinstance(self._root, MultiOutputNode):
+            return refs
+        return refs[0]
+
+    def _fetch_until(self, index: int, timeout: Optional[float]) -> None:
+        from ray_tpu.experimental.channel.exec_loop import _ErrorEnvelope
+
+        while self._fetched_upto <= index:
+            row = self._pending[self._fetched_upto]
+            # Resume from _fetch_col: a mid-row timeout must not re-read
+            # channels whose value for this execution was already
+            # consumed (each read advances that channel's reader seq).
+            while self._fetch_col < len(self._output_chans):
+                chan = self._output_chans[self._fetch_col]
+                value = chan.read(timeout if timeout is not None
+                                  else self._timeout)
+                if isinstance(value, _ErrorEnvelope):
+                    value = value.error
+                row[self._fetch_col] = value
+                self._fetch_col += 1
+            self._fetched_upto += 1
+            self._fetch_col = 0
+
+    def _take_result(self, execution_index: int, output_index: int):
+        value = self._pending[execution_index][output_index]
+        taken = self._taken.get(execution_index, 0) + 1
+        if taken >= len(self._output_chans):
+            # Every output consumed: drop the row (unbounded otherwise).
+            self._pending.pop(execution_index, None)
+            self._taken.pop(execution_index, None)
+        else:
+            self._taken[execution_index] = taken
+        return value
 
     def teardown(self) -> None:
-        self._actor_cache.clear()
+        if self._torn_down or self._eager:
+            self._torn_down = True
+            return
+        self._torn_down = True
+        if self._input_chan is not None:
+            self._input_chan.close()
+        for chan in self._output_chans:
+            chan.close()
+        # Loops exit on ChannelClosed; collect so the actors free up.
+        import ray_tpu
+
+        for ref in self._loop_refs:
+            try:
+                ray_tpu.get(ref, timeout=10.0)
+            except Exception:
+                pass
+        if self._input_chan is not None:
+            self._input_chan.destroy()
+        for chan in self._output_chans:
+            chan.destroy()
+        import os
+
+        for path in self._all_chan_paths:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def __del__(self):
+        try:
+            if not getattr(self, "_torn_down", True):
+                self.teardown()
+        except Exception:
+            pass

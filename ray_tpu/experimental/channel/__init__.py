@@ -1,0 +1,23 @@
+"""Channels: the compiled-DAG data plane.
+
+Reference: python/ray/experimental/channel/ — mutable shared-memory
+channels (shared_memory_channel.py:147) and device p2p channels
+(torch_tensor_nccl_channel.py). Here: a native double-buffered shm
+channel (_native/mutable_channel.cpp) for host data, an in-process
+channel for same-process edges, and a device channel interface for
+jax.Array handoff.
+"""
+
+from ray_tpu.experimental.channel.shm_channel import (Channel,
+                                                      ChannelClosed,
+                                                      ChannelTimeout)
+from ray_tpu.experimental.channel.intra_process import IntraProcessChannel
+from ray_tpu.experimental.channel.device_channel import DeviceChannel
+
+__all__ = [
+    "Channel",
+    "ChannelClosed",
+    "ChannelTimeout",
+    "IntraProcessChannel",
+    "DeviceChannel",
+]

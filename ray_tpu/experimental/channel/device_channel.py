@@ -1,0 +1,73 @@
+"""Device channel: jax.Array handoff between compiled-DAG stages.
+
+Reference: python/ray/experimental/channel/torch_tensor_nccl_channel.py
+(NCCL p2p channels between GPU actors; _NcclGroup nccl_group.py:19).
+
+TPU redesign: separate processes cannot address one TPU chip concurrently,
+and inter-chip data movement belongs INSIDE compiled programs (XLA
+collectives over ICI — see ray_tpu.parallel), not in an eager p2p library.
+So the channel carries (dtype, shape, sharding-spec, host bytes) through
+the native shm channel and rebuilds a device array on the consumer:
+
+- same-process edge: the jax.Array object is handed over directly (no
+  copy, no device sync);
+- cross-process edge: device→host on write, host→device on read, with the
+  host hop riding the zero-copy shm segment. For staged pipelines whose
+  stages own disjoint chips this is the correct (and only) host-mediated
+  path; pipelines that need chip-to-chip bandwidth should fuse stages into
+  one sharded program (ray_tpu.parallel.pipeline) so XLA moves data over
+  ICI directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ray_tpu.experimental.channel.shm_channel import Channel
+
+
+class DeviceChannel:
+    """Channel for jax.Array values (other values pass through as-is)."""
+
+    def __init__(self, path: str, reader_id: int = 0,
+                 device: Optional[Any] = None):
+        self._chan = Channel(path, reader_id)
+        self._device = device
+
+    @classmethod
+    def create(cls, n_readers: int = 1,
+               capacity: int = Channel.DEFAULT_CAPACITY,
+               directory: Optional[str] = None,
+               n_slots: int = 8) -> str:
+        return Channel.create(n_readers, capacity, directory, n_slots)
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        mod = type(value).__module__
+        if mod.startswith("jax") or mod.startswith("jaxlib"):
+            import numpy as np
+
+            host = np.asarray(value)  # device→host once, into the segment
+            self._chan.write(("jax", host), timeout)
+        else:
+            self._chan.write(("raw", value), timeout)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        kind, payload = self._chan.read(timeout)
+        if kind == "jax":
+            import jax
+
+            return jax.device_put(payload, self._device)
+        return payload
+
+    def close(self) -> None:
+        self._chan.close()
+
+    def destroy(self) -> None:
+        self._chan.destroy()
+
+    def release(self) -> None:
+        self._chan.release()
+
+    def __reduce__(self):
+        return (type(self), (self._chan.path, self._chan.reader_id,
+                             self._device))

@@ -1,0 +1,116 @@
+"""Worker-side compiled-DAG execution loop.
+
+Reference: python/ray/dag/compiled_dag_node.py (do_exec_tasks — the
+per-actor loop that a compiled DAG installs on each participating actor).
+The loop reads its input channels, runs the actor's bound methods, and
+writes results to its output channels — no driver involvement per step.
+
+The loop runs inside the actor's executor thread (dispatched like any
+actor task); channel reads/writes block in native code with the GIL
+released, so the worker's io loop stays live for health checks and
+teardown RPCs.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List
+
+from ray_tpu.core import serialization as ser
+from ray_tpu.experimental.channel.shm_channel import Channel, ChannelClosed
+
+logger = logging.getLogger(__name__)
+
+
+class _ErrorEnvelope:
+    """Marks a value as an upstream error travelling through channels."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: Exception):
+        self.error = error
+
+    def __reduce__(self):
+        return (type(self), (self.error,))
+
+
+def run_dag_loop(instance: Any, plan: Dict) -> int:
+    """Execute the compiled plan until the input channels close.
+
+    plan = {
+      "in_chans":  [(path, reader_id), ...],
+      "steps": [{"method": str,
+                 "args": [argspec, ...],
+                 "kwargs": {name: argspec},
+                 "outs": [out_chan_index, ...]}, ...],
+      "out_chans": [path, ...],
+    }
+    argspec = ("chan", in_index) | ("const", pickled) | ("local", step_idx)
+
+    Returns the number of completed iterations.
+    """
+    in_chans = [Channel(path, reader_id)
+                for path, reader_id in plan["in_chans"]]
+    out_chans = [Channel(path) for path in plan["out_chans"]]
+    steps = plan["steps"]
+    consts = {}
+    iterations = 0
+    try:
+        while True:
+            try:
+                inputs = [c.read() for c in in_chans]
+            except ChannelClosed:
+                return iterations
+
+            def resolve(spec):
+                kind, idx = spec
+                if kind == "chan":
+                    return inputs[idx]
+                if kind == "local":
+                    return local_results[idx]
+                if idx not in consts:
+                    consts[idx] = ser.loads(plan["consts"][idx])
+                return consts[idx]
+
+            local_results: List[Any] = []
+            error = next((v for v in inputs
+                          if isinstance(v, _ErrorEnvelope)), None)
+            for step in steps:
+                if error is not None:
+                    local_results.append(error)
+                    continue
+                try:
+                    args = [resolve(a) for a in step["args"]]
+                    kwargs = {k: resolve(v)
+                              for k, v in step["kwargs"].items()}
+                    result = getattr(instance, step["method"])(*args,
+                                                               **kwargs)
+                except Exception as e:  # travels to consumers, loop lives on
+                    import traceback
+
+                    error = _ErrorEnvelope(ser.RayTaskError(
+                        step["method"], traceback.format_exc(), repr(e),
+                        cause=e if _picklable(e) else None))
+                    result = error
+                local_results.append(result)
+            for step, result in zip(steps, local_results):
+                for out_idx in step["outs"]:
+                    out_chans[out_idx].write(result)
+            iterations += 1
+    except ChannelClosed:
+        return iterations
+    finally:
+        for c in out_chans:
+            c.close()
+        for c in in_chans + out_chans:
+            c.release()
+
+
+def _picklable(e: Exception) -> bool:
+    import pickle
+
+    try:
+        pickle.dumps(e)
+        return True
+    except Exception:
+        return False

@@ -1,0 +1,54 @@
+"""In-process channel: same API as the shm channel, queue-backed.
+
+Reference: python/ray/experimental/channel/intra_process_channel.py — used
+when producer and consumer share a process (e.g. driver self-edges, tests).
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Any, Optional
+
+from ray_tpu.experimental.channel.shm_channel import (ChannelClosed,
+                                                      ChannelTimeout)
+
+_CLOSED = object()
+
+
+class IntraProcessChannel:
+    def __init__(self, maxsize: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._closed = False
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        if self._closed:
+            raise ChannelClosed("channel is closed")
+        try:
+            self._q.put(value, timeout=timeout)
+        except queue.Full:
+            raise ChannelTimeout("write timed out") from None
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        try:
+            value = self._q.get(timeout=timeout)
+        except queue.Empty:
+            if self._closed:
+                raise ChannelClosed("channel is closed") from None
+            raise ChannelTimeout("read timed out") from None
+        if value is _CLOSED:
+            self._closed = True
+            raise ChannelClosed("channel is closed")
+        return value
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._q.put_nowait(_CLOSED)
+        except queue.Full:
+            pass
+
+    def destroy(self) -> None:
+        self.close()
+
+    def release(self) -> None:
+        pass

@@ -1,0 +1,195 @@
+"""Mutable shared-memory channel (ctypes client).
+
+Reference: python/ray/experimental/channel/shared_memory_channel.py:147
+(Channel over mutable plasma objects; native side
+src/ray/core_worker/experimental_mutable_object_manager.h). Redesign: the
+channel is its own double-buffered mmap file (_native/mutable_channel.cpp)
+— no store daemon, no object IDs; writer and readers map the same file and
+synchronize on an in-segment robust mutex/condvar. Blocking calls release
+the GIL (plain ctypes), so readers/writers block their own thread without
+touching any event loop — a compiled-DAG step does zero RPCs.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import uuid
+from typing import Any, Optional
+
+from ray_tpu.core import serialization as ser
+
+_OK = 0
+_ERR_TIMEOUT = -4
+_ERR_INVALID = -5
+_ERR_CLOSED = -8
+_ERR_TOO_LARGE = -9
+
+_lib = None
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class ChannelTimeout(TimeoutError):
+    pass
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    from ray_tpu._native.build import ensure_built
+
+    lib = ctypes.CDLL(ensure_built("ray_tpu_channel"))
+    lib.chan_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                ctypes.c_uint32, ctypes.c_uint32]
+    lib.chan_create.restype = ctypes.c_int
+    lib.chan_open.argtypes = [ctypes.c_char_p]
+    lib.chan_open.restype = ctypes.c_void_p
+    lib.chan_close_handle.argtypes = [ctypes.c_void_p]
+    lib.chan_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_uint64, ctypes.c_long]
+    lib.chan_write.restype = ctypes.c_int
+    lib.chan_read_acquire.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_long]
+    lib.chan_read_acquire.restype = ctypes.c_int
+    lib.chan_read_release.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.chan_read_release.restype = ctypes.c_int
+    lib.chan_close.argtypes = [ctypes.c_void_p]
+    lib.chan_close.restype = ctypes.c_int
+    lib.chan_stats.argtypes = [ctypes.c_void_p,
+                               ctypes.POINTER(ctypes.c_uint64),
+                               ctypes.POINTER(ctypes.c_uint64),
+                               ctypes.POINTER(ctypes.c_uint32)]
+    lib.chan_stats.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+def _to_ms(timeout: Optional[float]) -> int:
+    return -1 if timeout is None else max(0, int(timeout * 1000))
+
+
+class Channel:
+    """One single-producer, N-reader mutable channel.
+
+    ``write(value)`` publishes; each reader (identified by ``reader_id``)
+    consumes values strictly in order via ``read()`` (copy + deserialize)
+    or ``begin_read()``/``end_read()`` (zero-copy window).
+    """
+
+    DEFAULT_CAPACITY = 16 << 20
+
+    def __init__(self, path: str, reader_id: int = 0):
+        self.path = path
+        self.reader_id = reader_id
+        self._h = _load().chan_open(path.encode())
+        if not self._h:
+            raise ValueError(f"cannot open channel at {path}")
+        self._reading = False
+
+    @classmethod
+    def create(cls, n_readers: int = 1,
+               capacity: int = DEFAULT_CAPACITY,
+               directory: Optional[str] = None,
+               n_slots: int = 8) -> str:
+        """Allocate a new channel segment; returns its path (shippable to
+        other processes on this node — open with Channel(path, reader_id)).
+        ``n_slots`` is the ring depth: how many published-but-unread values
+        the channel buffers before writers block (2..64)."""
+        directory = directory or ("/dev/shm" if os.path.isdir("/dev/shm")
+                                  else "/tmp")
+        path = os.path.join(directory, f"ray_tpu_chan_{uuid.uuid4().hex}")
+        rc = _load().chan_create(path.encode(), capacity, n_readers,
+                                 n_slots)
+        if rc != _OK:
+            raise RuntimeError(f"chan_create failed rc={rc}")
+        return path
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        data = ser.dumps(value)
+        self.write_bytes(data, timeout)
+
+    def write_bytes(self, data: bytes, timeout: Optional[float] = None) -> None:
+        rc = _load().chan_write(self._h, data, len(data), _to_ms(timeout))
+        if rc == _OK:
+            return
+        if rc == _ERR_CLOSED:
+            raise ChannelClosed(f"channel {self.path} is closed")
+        if rc == _ERR_TIMEOUT:
+            raise ChannelTimeout(f"write timed out on {self.path}")
+        if rc == _ERR_TOO_LARGE:
+            raise ValueError(
+                f"value of {len(data)} bytes exceeds channel capacity")
+        raise RuntimeError(f"chan_write rc={rc}")
+
+    def begin_read(self, timeout: Optional[float] = None) -> memoryview:
+        """Zero-copy read window; MUST be paired with end_read()."""
+        if self._reading:
+            raise RuntimeError("begin_read() without end_read()")
+        ptr = ctypes.POINTER(ctypes.c_uint8)()
+        length = ctypes.c_uint64()
+        rc = _load().chan_read_acquire(self._h, self.reader_id,
+                                       ctypes.byref(ptr),
+                                       ctypes.byref(length),
+                                       _to_ms(timeout))
+        if rc == _ERR_CLOSED:
+            raise ChannelClosed(f"channel {self.path} is closed")
+        if rc == _ERR_TIMEOUT:
+            raise ChannelTimeout(f"read timed out on {self.path}")
+        if rc != _OK:
+            raise RuntimeError(f"chan_read_acquire rc={rc}")
+        self._reading = True
+        return memoryview((ctypes.c_uint8 * length.value).from_address(
+            ctypes.addressof(ptr.contents))).cast("B")
+
+    def end_read(self) -> None:
+        if not self._reading:
+            return
+        self._reading = False
+        _load().chan_read_release(self._h, self.reader_id)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        """Read the next value (copies out of the window, then releases —
+        safe default; use begin_read for zero-copy)."""
+        view = self.begin_read(timeout)
+        try:
+            data = bytes(view)
+        finally:
+            self.end_read()
+        return ser.loads(data)
+
+    def close(self) -> None:
+        """Mark the channel closed (wakes all blocked peers)."""
+        if self._h:
+            _load().chan_close(self._h)
+
+    def stats(self) -> dict:
+        w = ctypes.c_uint64()
+        m = ctypes.c_uint64()
+        c = ctypes.c_uint32()
+        _load().chan_stats(self._h, ctypes.byref(w), ctypes.byref(m),
+                           ctypes.byref(c))
+        return {"write_seq": w.value, "min_read_seq": m.value,
+                "closed": bool(c.value)}
+
+    def destroy(self) -> None:
+        """Close, release the mapping, and unlink the segment file."""
+        self.close()
+        self.release()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def release(self) -> None:
+        if self._h:
+            _load().chan_close_handle(self._h)
+            self._h = None
+
+    def __reduce__(self):
+        return (type(self), (self.path, self.reader_id))
