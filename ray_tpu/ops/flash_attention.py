@@ -280,17 +280,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k,
-               interpret):
+               interpret, delta=None, grad_dtype=None):
+    """grad_dtype overrides the dq/dk/dv output dtype (ring attention
+    accumulates per-shard partials in f32); delta may be precomputed by
+    callers that invoke this once per kv shard."""
     b, h, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     grp = h // hkv
     block_q = min(block_q, max(sq, 1))
     block_k = min(block_k, max(sk, 1))
+    dq_dtype = grad_dtype or q.dtype
+    dk_dtype = grad_dtype or k.dtype
+    dv_dtype = grad_dtype or v.dtype
 
-    # delta = rowsum(dO * O) — cheap, fused by XLA. [B,H,Sq,1] layout
-    # keeps the Pallas row blocks TPU-tileable.
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # [B,H,Sq,1]
+    if delta is None:
+        # delta = rowsum(dO * O) — cheap, fused by XLA. [B,H,Sq,1] layout
+        # keeps the Pallas row blocks TPU-tileable.
+        delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)  # [B,H,Sq,1]
 
     qp = _pad_seq(q, block_q)
     gp = _pad_seq(g, block_q)
@@ -330,7 +337,7 @@ def _flash_bwd(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k,
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda b_, h_, i, j: (b_, h_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, dq_dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
@@ -366,8 +373,8 @@ def _flash_bwd(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k,
                          lambda b_, h_, j, i: (b_, h_, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, sk_p, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, sk_p, d), v.dtype),
+            jax.ShapeDtypeStruct((b, h, sk_p, d), dk_dtype),
+            jax.ShapeDtypeStruct((b, h, sk_p, d), dv_dtype),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
@@ -381,8 +388,8 @@ def _flash_bwd(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k,
     dk_h = dk_h[:, :, :sk] if sk_p != sk else dk_h
     dv_h = dv_h[:, :, :sk] if sk_p != sk else dv_h
     if grp > 1:
-        dk = dk_h.reshape(b, hkv, grp, sk, d).sum(axis=2).astype(k.dtype)
-        dv = dv_h.reshape(b, hkv, grp, sk, d).sum(axis=2).astype(v.dtype)
+        dk = dk_h.reshape(b, hkv, grp, sk, d).sum(axis=2).astype(dk_dtype)
+        dv = dv_h.reshape(b, hkv, grp, sk, d).sum(axis=2).astype(dv_dtype)
     else:
         dk, dv = dk_h, dv_h
     return dq, dk, dv

@@ -6,6 +6,17 @@ blockwise attention for its local q shard while k/v shards rotate around
 the ring via `ppermute`, overlapping compute with ICI transfer. Online
 softmax combines partial results exactly (same math as flash attention).
 
+Two implementations:
+- impl="pallas" (default): each ring step runs the Pallas flash kernel
+  (ops/flash_attention.py) on (q_local, kv_shard) — MXU matmuls, VMEM
+  tiling — and the per-shard (o, lse) pairs combine exactly in f32.
+  Because ring shards are equal-sized, every step is statically either
+  fully-past (causal=False kernel), diagonal (standard causal kernel),
+  or causally skipped — no dynamic-offset kernel variant needed. The
+  backward is a second ring pass over the Pallas backward kernels with
+  grad accumulators rotating alongside the kv shards.
+- impl="xla": the original einsum online-softmax scan (fallback/debug).
+
 `ring_attention` is SPMD-internal: call it inside `shard_map`/pjit with
 q,k,v already sharded over `axis_name` on the sequence dim.
 `ring_attention_sharded` wraps it for a given mesh.
@@ -19,6 +30,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops.flash_attention import (DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q,
+                                         _flash_bwd, _flash_fwd)
 
 _NEG_INF = -1e30
 
@@ -50,14 +64,197 @@ def _block_attn(q, k, v, m, l, acc, q_off, k_off, causal, sm_scale):
     return m_new, l_new, acc_new
 
 
+def _combine(o, lse, o_i, lse_i):
+    """Exact combination of two normalized flash partials (f32).
+
+    o = acc/l with lse = m + log(l); the merged output is
+    (acc0 + acc1) / (l0 + l1) computed in the max-lse frame."""
+    m = jnp.maximum(lse, lse_i)
+    w0 = jnp.exp(lse - m)
+    w1 = jnp.exp(lse_i - m)
+    denom = w0 + w1
+    o_c = (o * w0 + o_i.astype(jnp.float32) * w1) / denom
+    return o_c, m + jnp.log(denom)
+
+
+def _ring_pallas_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_q,
+                          block_k, interpret):
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, sq_local, d = q.shape
+    if causal and k.shape[2] != sq_local:
+        raise ValueError(
+            "causal ring attention requires equal q/kv shards "
+            f"(got Sq={sq_local}, Sk={k.shape[2]})")
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    o0 = jnp.zeros((b, h, sq_local, d), jnp.float32)
+    lse0 = jnp.full((b, h, sq_local, 1), _NEG_INF, jnp.float32)
+
+    def chunk(k_cur, v_cur, src):
+        """Flash kernel on one kv shard: statically causal=False for
+        fully-past shards, the standard causal kernel on the diagonal."""
+        def past():
+            return _flash_fwd(q, k_cur, v_cur, sm_scale, False, block_q,
+                              block_k, interpret, with_lse=True)
+
+        if not causal:
+            return past()
+
+        def diag():
+            return _flash_fwd(q, k_cur, v_cur, sm_scale, True, block_q,
+                              block_k, interpret, with_lse=True)
+
+        return jax.lax.cond(src == my_idx, diag, past)
+
+    def step(carry, t):
+        k_cur, v_cur, o, lse = carry
+        src = jax.lax.rem(my_idx - t + axis_size, axis_size)
+
+        def compute():
+            o_i, lse_i = chunk(k_cur, v_cur, src)
+            return _combine(o, lse, o_i, lse_i)
+
+        if causal:
+            o, lse = jax.lax.cond(src <= my_idx, compute,
+                                  lambda: (o, lse))
+        else:
+            o, lse = compute()
+        k_nxt, v_nxt = jax.lax.cond(
+            t < axis_size - 1,
+            lambda: (jax.lax.ppermute(k_cur, axis_name, perm),
+                     jax.lax.ppermute(v_cur, axis_name, perm)),
+            lambda: (k_cur, v_cur))
+        return (k_nxt, v_nxt, o, lse), None
+
+    (_, _, o, lse), _ = jax.lax.scan(
+        step, (k, v, o0, lse0), jnp.arange(axis_size))
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_pallas(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
+                 interpret):
+    out, _ = _ring_pallas_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                                   block_q, block_k, interpret)
+    return out
+
+
+def _ring_pallas_vjp_fwd(q, k, v, axis_name, causal, sm_scale, block_q,
+                         block_k, interpret):
+    out, lse = _ring_pallas_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                                     block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_pallas_vjp_bwd(axis_name, causal, sm_scale, block_q, block_k,
+                         interpret, residuals, g):
+    """Second ring pass: kv shards rotate together with their (dk, dv)
+    accumulators; each device adds its local contribution via the Pallas
+    backward kernels, then one final rotation delivers each accumulator
+    to its home shard."""
+    q, k, v, out, lse = residuals
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    # Loop-invariant across ring steps: hoist out of the scan. grad_dtype
+    # f32 keeps per-shard partials unquantized until the final cast.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    def chunk_bwd(k_cur, v_cur, src):
+        def past():
+            return _flash_bwd(q, k_cur, v_cur, out, lse, g, sm_scale,
+                              False, block_q, block_k, interpret,
+                              delta=delta, grad_dtype=jnp.float32)
+
+        if not causal:
+            return past()
+
+        def diag():
+            return _flash_bwd(q, k_cur, v_cur, out, lse, g, sm_scale,
+                              True, block_q, block_k, interpret,
+                              delta=delta, grad_dtype=jnp.float32)
+
+        return jax.lax.cond(src == my_idx, diag, past)
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+
+    def step(carry, t):
+        k_cur, v_cur, dk_acc, dv_acc, dq = carry
+        src = jax.lax.rem(my_idx - t + axis_size, axis_size)
+
+        def compute():
+            dq_i, dk_i, dv_i = chunk_bwd(k_cur, v_cur, src)
+            return (dq + dq_i, dk_acc + dk_i, dv_acc + dv_i)
+
+        if causal:
+            dq, dk_acc, dv_acc = jax.lax.cond(
+                src <= my_idx, compute, lambda: (dq, dk_acc, dv_acc))
+        else:
+            dq, dk_acc, dv_acc = compute()
+        k_nxt, v_nxt, dk_nxt, dv_nxt = jax.lax.cond(
+            t < axis_size - 1,
+            lambda: tuple(jax.lax.ppermute(x, axis_name, perm)
+                          for x in (k_cur, v_cur, dk_acc, dv_acc)),
+            lambda: (k_cur, v_cur, dk_acc, dv_acc))
+        return (k_nxt, v_nxt, dk_nxt, dv_nxt, dq), None
+
+    (_, _, dk_acc, dv_acc, dq), _ = jax.lax.scan(
+        step, (k, v, dk0, dv0, dq0), jnp.arange(axis_size))
+    # After size-1 rotations, device d holds shard (d+1)%size's
+    # accumulator; one more forward rotation brings each home.
+    dk = jax.lax.ppermute(dk_acc, axis_name, perm)
+    dv = jax.lax.ppermute(dv_acc, axis_name, perm)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+_ring_pallas.defvjp(_ring_pallas_vjp_fwd, _ring_pallas_vjp_bwd)
+
+
 def ring_attention(q: jax.Array,
                    k: jax.Array,
                    v: jax.Array,
                    *,
                    axis_name: str = "sp",
                    causal: bool = True,
-                   sm_scale: Optional[float] = None) -> jax.Array:
-    """Per-shard ring attention. Shapes are LOCAL: q [B,H,S/sp,D]."""
+                   sm_scale: Optional[float] = None,
+                   impl: str = "auto",
+                   block_q: int = DEFAULT_BLOCK_Q,
+                   block_k: int = DEFAULT_BLOCK_K,
+                   interpret: Optional[bool] = None) -> jax.Array:
+    """Per-shard ring attention. Shapes are LOCAL: q [B,H,S/sp,D].
+
+    impl="auto" picks the Pallas kernel on TPU and the XLA einsum scan
+    elsewhere (Pallas off-TPU would run in interpret emulation — correct
+    but far slower than XLA). Pass impl explicitly to override.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        return _ring_pallas(q, k, v, axis_name, bool(causal),
+                            float(sm_scale), int(block_q), int(block_k),
+                            bool(interpret))
+    if impl != "xla":
+        raise ValueError(f"unknown ring attention impl {impl!r}")
+    return _ring_xla(q, k, v, axis_name=axis_name, causal=causal,
+                     sm_scale=sm_scale)
+
+
+def _ring_xla(q: jax.Array,
+              k: jax.Array,
+              v: jax.Array,
+              *,
+              axis_name: str = "sp",
+              causal: bool = True,
+              sm_scale: Optional[float] = None) -> jax.Array:
+    """Plain-JAX einsum ring (differentiable via autodiff)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     axis_size = jax.lax.psum(1, axis_name)
@@ -111,11 +308,17 @@ def ring_attention_sharded(q: jax.Array,
                            *,
                            axis_name: str = "sp",
                            causal: bool = True,
-                           sm_scale: Optional[float] = None) -> jax.Array:
+                           sm_scale: Optional[float] = None,
+                           impl: str = "auto",
+                           block_q: int = DEFAULT_BLOCK_Q,
+                           block_k: int = DEFAULT_BLOCK_K,
+                           interpret: Optional[bool] = None) -> jax.Array:
     """shard_map wrapper: q,k,v are GLOBAL [B,H,S,D], sharded over seq."""
     spec = P(None, None, axis_name, None)
     fn = functools.partial(ring_attention, axis_name=axis_name,
-                           causal=causal, sm_scale=sm_scale)
+                           causal=causal, sm_scale=sm_scale, impl=impl,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret)
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)(q, k, v)

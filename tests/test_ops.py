@@ -45,20 +45,35 @@ def test_flash_grad_matches_reference():
         np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("impl", ["pallas", "xla"])
 @pytest.mark.parametrize("causal", [True, False])
-def test_ring_attention_matches_reference(causal):
+def test_ring_attention_matches_reference(causal, impl):
     from ray_tpu.ops import mha_reference
     from ray_tpu.ops.ring_attention import ring_attention_sharded
     from ray_tpu.parallel import create_mesh
 
     mesh = create_mesh({"sp": 8})
     q, k, v = _rand_qkv(b=2, h=4, hkv=4, s=256, d=32)
-    out = ring_attention_sharded(q, k, v, mesh, causal=causal)
+    out = ring_attention_sharded(q, k, v, mesh, causal=causal, impl=impl)
     ref = mha_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
 
 
-def test_ring_attention_grads():
+def test_ring_attention_gqa_matches_reference():
+    from ray_tpu.ops import mha_reference
+    from ray_tpu.ops.ring_attention import ring_attention_sharded
+    from ray_tpu.parallel import create_mesh
+
+    mesh = create_mesh({"sp": 8})
+    q, k, v = _rand_qkv(b=1, h=8, hkv=2, s=128, d=16)
+    out = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                 impl="pallas")
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["pallas", "xla"])
+def test_ring_attention_grads(impl):
     from ray_tpu.ops import mha_reference
     from ray_tpu.ops.ring_attention import ring_attention_sharded
     from ray_tpu.parallel import create_mesh
@@ -67,7 +82,7 @@ def test_ring_attention_grads():
     q, k, v = _rand_qkv(b=1, h=2, hkv=2, s=128, d=16)
 
     g1 = jax.grad(lambda q, k, v: ring_attention_sharded(
-        q, k, v, mesh, causal=True).astype(jnp.float32).sum(),
+        q, k, v, mesh, causal=True, impl=impl).astype(jnp.float32).sum(),
         argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(lambda q, k, v: mha_reference(
         q, k, v, causal=True).astype(jnp.float32).sum(),

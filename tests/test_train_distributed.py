@@ -19,13 +19,6 @@ from ray_tpu.air import RunConfig, ScalingConfig
 from ray_tpu.train import JaxConfig, JaxTrainer
 
 
-@pytest.fixture(scope="module")
-def _fresh_cluster():
-    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
-    yield ctx
-    ray_tpu.shutdown()
-
-
 def _global_expected(world_devices: int) -> float:
     x = np.arange(world_devices * 3, dtype=np.float32)
     return float((x * 2.0).sum())
@@ -62,7 +55,7 @@ def _loop_distributed(config):
     })
 
 
-def test_jax_distributed_two_process_world(_fresh_cluster, tmp_path):
+def test_jax_distributed_two_process_world(ray_start_regular, tmp_path):
     trainer = JaxTrainer(
         _loop_distributed,
         jax_config=JaxConfig(jax_distributed=True),
