@@ -2,7 +2,8 @@
 
 Parity target: the reference's rllib/ new API stack (AlgorithmConfig /
 Algorithm / EnvRunnerGroup / RLModule / Learner / LearnerGroup) with
-JAX/TPU learners and CPU env-runner actors. Algorithms: PPO, DQN.
+JAX/TPU learners and CPU env-runner actors. Algorithms: PPO (single and
+multi-agent), DQN, SAC, IMPALA, BC.
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
@@ -11,6 +12,10 @@ from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.multi_agent_ppo import (MultiAgentPPO,
+                                                      MultiAgentPPOConfig)
+from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
 from ray_tpu.rllib.env.registry import register_env
 
 __all__ = [
@@ -24,5 +29,10 @@ __all__ = [
     "BC",
     "BCConfig",
     "IMPALAConfig",
+    "SAC",
+    "SACConfig",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "MultiAgentEnv",
     "register_env",
 ]

@@ -37,7 +37,17 @@ class Algorithm(Trainable):
             raise ValueError("config.environment(env=...) is required")
         probe = make_env(self.config.env, self.config.env_config)
         obs_dim = int(probe.observation_space.shape[0])
-        num_actions = int(probe.action_space.n)
+        space = probe.action_space
+        if hasattr(space, "n"):  # Discrete
+            num_actions = int(space.n)
+        else:  # Box: num_actions is the action DIM; bounds go to the module
+            import numpy as np
+
+            num_actions = int(np.prod(space.shape))
+            model = dict(self.config.model)
+            model.setdefault("action_low", np.asarray(space.low))
+            model.setdefault("action_high", np.asarray(space.high))
+            self.config.model = model
         self.module_spec = self._make_module_spec(obs_dim, num_actions)
         cfg = self.config.to_dict()
         cfg["module_spec"] = self.module_spec
@@ -120,13 +130,16 @@ class Algorithm(Trainable):
         params = jax.tree_util.tree_map(
             jnp.asarray, self.learner_group.get_weights())
         infer = jax.jit(module.forward_inference)
+        discrete = hasattr(env.action_space, "n")
         returns = []
         for ep in range(num_episodes):
             obs, _ = env.reset(seed=10_000 + ep)
             total, done = 0.0, False
             while not done:
                 out = infer(params, obs[None])
-                obs, r, term, trunc, _ = env.step(int(out["actions"][0]))
+                action = (int(out["actions"][0]) if discrete
+                          else np.asarray(out["actions"][0]))
+                obs, r, term, trunc, _ = env.step(action)
                 total += r
                 done = term or trunc
             returns.append(total)

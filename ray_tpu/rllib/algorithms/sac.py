@@ -1,0 +1,214 @@
+"""SAC — Soft Actor-Critic for continuous control.
+
+Reference: rllib/algorithms/sac/ (SAC/SACConfig: squashed-Gaussian actor,
+twin Q critics with min-target, polyak-averaged target networks, and
+automatic entropy-temperature tuning against target_entropy=-act_dim).
+The whole update — critic TD, actor, and alpha losses with the right
+stop-gradients — is ONE jit-compiled JAX step; target params thread
+through the batch like DQN's (keeps the step pure, sync stays outside).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner
+from ray_tpu.rllib.core.rl_module import SACModule
+from ray_tpu.rllib.utils import sample_batch as sb
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.replay_buffer_capacity: int = 100_000
+        self.num_steps_sampled_before_learning_starts: int = 1_000
+        self.tau: float = 0.005  # polyak factor, every update
+        self.target_entropy: float = None  # default: -act_dim
+        self.initial_alpha: float = 1.0
+        self.rollout_fragment_length = 64
+        self.train_batch_size = 256
+        self.updates_per_step: int = 16
+        self.lr = 3e-3
+
+    @property
+    def algo_class(self):
+        return SAC
+
+
+class SACLearner(JaxLearner):
+    def __init__(self, module_spec, config):
+        super().__init__(module_spec, config)
+        import jax
+        import jax.numpy as jnp
+
+        # Targets are the critic subtrees only (actor has no target).
+        self.target_params = {
+            k: jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True),
+                                      self.params[k])
+            for k in ("q1", "q2")
+        }
+
+    def loss_fn(self, params, batch, rng):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        module = self.module
+        gamma = cfg.get("gamma", 0.99)
+        act_dim = module.act_dim
+        target_entropy = cfg.get("target_entropy")
+        if target_entropy is None:
+            target_entropy = -float(act_dim)
+        obs = batch[sb.OBS]
+        next_obs = batch[sb.NEXT_OBS]
+        actions = batch[sb.ACTIONS]
+        if actions.ndim == 1:
+            actions = actions[:, None]
+        rng_next, rng_pi = jax.random.split(rng)
+
+        alpha = jnp.exp(params["log_alpha"])
+
+        # --- critic loss: y = r + gamma (1-d) [min Q_t(s',a') - a logp'] ---
+        target = {"q1": batch["target_q1"], "q2": batch["target_q2"],
+                  "pi": params["pi"], "log_alpha": params["log_alpha"]}
+        next_a, next_logp = module.sample_action(params, next_obs, rng_next)
+        tq1, tq2 = module.q_values(target, next_obs, next_a)
+        not_done = 1.0 - batch[sb.TERMINATEDS].astype(jnp.float32)
+        y = batch[sb.REWARDS] + gamma * not_done * (
+            jnp.minimum(tq1, tq2) - alpha * next_logp)
+        y = jax.lax.stop_gradient(y)
+        q1, q2 = module.q_values(params, obs, actions)
+        critic_loss = ((q1 - y) ** 2).mean() + ((q2 - y) ** 2).mean()
+
+        # --- actor loss: E[alpha logp - min Q(s, pi(s))], critics frozen ---
+        frozen_q = jax.lax.stop_gradient(
+            {"q1": params["q1"], "q2": params["q2"]})
+        pi_a, pi_logp = module.sample_action(params, obs, rng_pi)
+        pq1, pq2 = module.q_values(
+            {**params, "q1": frozen_q["q1"], "q2": frozen_q["q2"]},
+            obs, pi_a)
+        actor_loss = (jax.lax.stop_gradient(alpha) * pi_logp -
+                      jnp.minimum(pq1, pq2)).mean()
+
+        # --- temperature loss: drive E[-logp] toward target entropy ---
+        alpha_loss = (-jnp.exp(params["log_alpha"]) *
+                      jax.lax.stop_gradient(pi_logp + target_entropy)
+                      ).mean()
+
+        total = critic_loss + actor_loss + alpha_loss
+        return total, {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "alpha_loss": alpha_loss,
+            "alpha": alpha,
+            "q1_mean": q1.mean(),
+            "entropy": -pi_logp.mean(),
+        }
+
+    def update_sac(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        batch = dict(batch)
+        batch["target_q1"] = self.target_params["q1"]
+        batch["target_q2"] = self.target_params["q2"]
+        return self.update(batch)
+
+    def _shard_batch(self, batch):
+        batch = dict(batch)
+        t1 = batch.pop("target_q1", None)
+        t2 = batch.pop("target_q2", None)
+        out = super()._shard_batch(batch)
+        if t1 is not None:
+            out["target_q1"] = t1
+            out["target_q2"] = t2
+        return out
+
+    def sync_target(self, tau: float) -> None:
+        import jax
+
+        for k in ("q1", "q2"):
+            self.target_params[k] = jax.tree_util.tree_map(
+                lambda t, p: t * (1 - tau) + p * tau,
+                self.target_params[k], self.params[k])
+
+    def get_state(self):
+        import jax
+
+        state = super().get_state()
+        state["target_params"] = jax.tree_util.tree_map(
+            np.asarray, self.target_params)
+        return state
+
+    def set_state(self, state) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.asarray, state["target_params"])
+        else:
+            self.target_params = {
+                k: jax.tree_util.tree_map(
+                    lambda x: jnp.array(x, copy=True), self.params[k])
+                for k in ("q1", "q2")
+            }
+
+
+class SAC(Algorithm):
+    config_class = SACConfig
+    learner_class = SACLearner
+    module_class = SACModule
+
+    def setup(self, config) -> None:
+        cfg = config if isinstance(config, SACConfig) else \
+            self.config_class().update_from_dict(dict(config or {}))
+        if cfg.num_learners != 0:
+            raise ValueError(
+                "SAC uses a local learner (target-net state is per-learner)")
+        super().setup(cfg)
+        self.replay = ReplayBuffer(self.config.replay_buffer_capacity,
+                                   seed=self.config.seed)
+        self._env_steps = 0
+
+    @property
+    def _learner(self) -> SACLearner:
+        return self.learner_group._local
+
+    def get_extra_state(self) -> Dict[str, Any]:
+        return {
+            "env_steps": self._env_steps,
+            "replay_cols": dict(self.replay._cols),
+            "replay_size": self.replay._size,
+            "replay_next": self.replay._next,
+        }
+
+    def set_extra_state(self, state: Dict[str, Any]) -> None:
+        if not state:
+            return
+        self._env_steps = state["env_steps"]
+        self.replay._cols = dict(state["replay_cols"])
+        self.replay._size = state["replay_size"]
+        self.replay._next = state["replay_next"]
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        rollout = self.env_runner_group.sample(cfg.rollout_fragment_length)
+        self._env_steps += len(rollout)
+        self.replay.add(rollout)
+
+        metrics: Dict[str, Any] = {"replay_size": len(self.replay),
+                                   "num_env_steps_total": self._env_steps}
+        if len(self.replay) >= \
+                cfg.num_steps_sampled_before_learning_starts:
+            for _ in range(cfg.updates_per_step):
+                batch = self.replay.sample(cfg.train_batch_size)
+                m = self._learner.update_sac(batch)
+                self._learner.sync_target(cfg.tau)
+                metrics.update(m)
+            self.env_runner_group.sync_weights(
+                self.learner_group.get_weights())
+        return metrics

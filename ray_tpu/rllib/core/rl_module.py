@@ -143,6 +143,81 @@ class QNetModule(RLModule):
         return {"actions": jnp.argmax(q, axis=-1)}
 
 
+class SACModule(RLModule):
+    """Squashed-Gaussian actor + twin Q critics for continuous control.
+
+    Reference: rllib/algorithms/sac/ (SAC RLModule: policy net emitting
+    (mu, log_std), tanh squashing onto the action bounds, two independent
+    Q networks over (obs, action)). num_actions is the ACTION DIM here;
+    model_config carries action_low/action_high bounds."""
+
+    LOG_STD_MIN = -20.0
+    LOG_STD_MAX = 2.0
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 model_config: Optional[dict] = None):
+        cfg = model_config or {}
+        self.obs_dim = obs_dim
+        self.act_dim = num_actions
+        self.hiddens = tuple(cfg.get("fcnet_hiddens", (64, 64)))
+        low = np.asarray(cfg.get("action_low", -1.0), np.float32)
+        high = np.asarray(cfg.get("action_high", 1.0), np.float32)
+        self.action_scale = (high - low) / 2.0
+        self.action_center = (high + low) / 2.0
+
+    def init_params(self, rng: jax.Array) -> Dict[str, Any]:
+        k_pi, k_q1, k_q2 = jax.random.split(rng, 3)
+        pi_sizes = (self.obs_dim,) + self.hiddens + (2 * self.act_dim,)
+        q_sizes = (self.obs_dim + self.act_dim,) + self.hiddens + (1,)
+        return {
+            "pi": _mlp_init(k_pi, pi_sizes),
+            "q1": _mlp_init(k_q1, q_sizes),
+            "q2": _mlp_init(k_q2, q_sizes),
+            # log entropy temperature, auto-tuned by the learner.
+            "log_alpha": jnp.zeros(()),
+        }
+
+    def pi_dist(self, params, obs):
+        out = _mlp_apply(params["pi"], obs)
+        mu, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+        return mu, log_std
+
+    def sample_action(self, params, obs, rng):
+        """Reparameterized tanh-squashed sample + its log-prob."""
+        mu, log_std = self.pi_dist(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(rng, mu.shape)
+        pre_tanh = mu + std * eps
+        tanh_a = jnp.tanh(pre_tanh)
+        # Gaussian logp with tanh change-of-variables correction.
+        gauss_logp = (-0.5 * ((eps) ** 2 + 2 * log_std +
+                              jnp.log(2 * jnp.pi))).sum(-1)
+        correction = jnp.log(1.0 - tanh_a ** 2 + 1e-6).sum(-1)
+        logp = gauss_logp - correction
+        action = tanh_a * self.action_scale + self.action_center
+        return action, logp
+
+    def q_values(self, params, obs, action):
+        x = jnp.concatenate([obs, action], axis=-1)
+        q1 = _mlp_apply(params["q1"], x)[..., 0]
+        q2 = _mlp_apply(params["q2"], x)[..., 0]
+        return q1, q2
+
+    def forward_train(self, params, obs):
+        mu, log_std = self.pi_dist(params, obs)
+        return {"mu": mu, "log_std": log_std}
+
+    def forward_exploration(self, params, obs, rng):
+        action, logp = self.sample_action(params, obs, rng)
+        return {"actions": action, "action_logp": logp}
+
+    def forward_inference(self, params, obs):
+        mu, _ = self.pi_dist(params, obs)
+        return {"actions": jnp.tanh(mu) * self.action_scale +
+                self.action_center}
+
+
 def params_to_numpy(params: Any) -> Any:
     """Device → host pytree (for shipping weights to env runners)."""
     return jax.tree_util.tree_map(lambda x: np.asarray(x), params)

@@ -69,12 +69,16 @@ class SingleAgentEnvRunner:
         cols: Dict[str, List[Any]] = collections.defaultdict(list)
         last_terminated = last_truncated = False
         last_next_obs = self._obs
+        discrete = hasattr(self.env.action_space, "n")
         for _ in range(num_steps):
             out = self._explore(self._obs)
-            action = int(out["actions"])
-            if epsilon > 0.0 and self._np_rng.random() < epsilon:
-                action = int(self._np_rng.integers(
-                    self.env.action_space.n))
+            if discrete:
+                action = int(out["actions"])
+                if epsilon > 0.0 and self._np_rng.random() < epsilon:
+                    action = int(self._np_rng.integers(
+                        self.env.action_space.n))
+            else:  # continuous (Box): ship the action vector as-is
+                action = np.asarray(out["actions"], np.float32)
             next_obs, reward, terminated, truncated, _ = self.env.step(
                 action)
             cols[sb.OBS].append(self._obs)

@@ -18,16 +18,17 @@ from ray_tpu.rllib.utils.sample_batch import SampleBatch
 
 
 class EnvRunnerGroup:
-    def __init__(self, config: dict):
+    def __init__(self, config: dict, runner_cls: type = None):
         self.config = config
+        runner_cls = runner_cls or SingleAgentEnvRunner
         self.num_remote = int(config.get("num_env_runners", 0))
         cpus_per_runner = config.get("num_cpus_per_env_runner", 1)
-        self._local_runner: Optional[SingleAgentEnvRunner] = None
+        self._local_runner = None
         self._manager: Optional[FaultTolerantActorManager] = None
         if self.num_remote == 0:
-            self._local_runner = SingleAgentEnvRunner(config, 0)
+            self._local_runner = runner_cls(config, 0)
         else:
-            cls = ray_tpu.remote(SingleAgentEnvRunner)
+            cls = ray_tpu.remote(runner_cls)
 
             def factory(i: int):
                 return cls.options(
@@ -78,6 +79,29 @@ class EnvRunnerGroup:
             except Exception:
                 boot = 0.0
             out.append((batch, boot))
+        if not out:
+            raise RuntimeError("all env runners failed during sample()")
+        return out
+
+    def sample_multi(self, total_steps: int) -> List[tuple]:
+        """Multi-agent variant (runner_cls=MultiAgentEnvRunner): returns
+        [(per_module_batches, per_agent_bootstraps)] per healthy runner."""
+        if self._local_runner is not None:
+            batches = self._local_runner.sample(total_steps)
+            return [(batches, self._local_runner.bootstrap_values())]
+        n = max(1, self._manager.num_healthy_actors())
+        per_runner = max(1, total_steps // n)
+        results = self._manager.foreach(
+            lambda a: a.sample.remote(per_runner))
+        out = []
+        for i, batches in results.ok:
+            try:
+                boots = ray_tpu.get(
+                    self._manager.actor(i).bootstrap_values.remote(),
+                    timeout=30.0)
+            except Exception:
+                boots = {}
+            out.append((batches, boots))
         if not out:
             raise RuntimeError("all env runners failed during sample()")
         return out

@@ -1,0 +1,97 @@
+"""Multi-agent environment protocol + a tiny built-in test env.
+
+Reference: rllib/env/multi_agent_env.py (MultiAgentEnv: dict-keyed
+reset/step — {agent_id: obs}, {agent_id: reward}, ... with "__all__" in
+the done dicts). Agents may come and go between steps; each agent maps
+to a policy module via the algorithm's policy_mapping_fn.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.env.tiny_envs import Box, Discrete, GridWorld
+
+
+class MultiAgentEnv:
+    """Protocol: subclasses define agents, observation/action spaces per
+    agent, and dict-keyed reset/step."""
+
+    agent_ids: Tuple[str, ...] = ()
+
+    def observation_space_of(self, agent_id: str):
+        raise NotImplementedError
+
+    def action_space_of(self, agent_id: str):
+        raise NotImplementedError
+
+    def reset(self, *, seed: Optional[int] = None
+              ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, Any]):
+        """Returns (obs, rewards, terminateds, truncateds, infos), each a
+        dict keyed by agent id; terminateds/truncateds also carry
+        "__all__"."""
+        raise NotImplementedError
+
+
+class TwoAgentGrid(MultiAgentEnv):
+    """Two independent GridWorld agents on separate boards, one episode
+    clock. Agent "a1"'s board is larger than "a0"'s, so the two policies
+    genuinely need different weights — a 2-policy smoke env.
+    """
+
+    agent_ids = ("a0", "a1")
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self._envs = {
+            "a0": GridWorld({"size": config.get("size_a0", 3)}),
+            "a1": GridWorld({"size": config.get("size_a1", 4)}),
+        }
+        self._terminated: Dict[str, bool] = {}
+        self._truncated: Dict[str, bool] = {}
+
+    def observation_space_of(self, agent_id: str):
+        return self._envs[agent_id].observation_space
+
+    def action_space_of(self, agent_id: str):
+        return self._envs[agent_id].action_space
+
+    def reset(self, *, seed: Optional[int] = None):
+        obs, infos = {}, {}
+        for aid, env in self._envs.items():
+            o, i = env.reset(seed=seed)
+            obs[aid] = o
+            infos[aid] = i
+        self._terminated = {aid: False for aid in self.agent_ids}
+        self._truncated = {aid: False for aid in self.agent_ids}
+        return obs, infos
+
+    def step(self, actions: Dict[str, Any]):
+        obs: Dict[str, np.ndarray] = {}
+        rewards: Dict[str, float] = {}
+        terminateds: Dict[str, bool] = {}
+        truncateds: Dict[str, bool] = {}
+        for aid, action in actions.items():
+            if self._terminated.get(aid) or self._truncated.get(aid):
+                continue
+            o, r, term, trunc, _ = self._envs[aid].step(action)
+            obs[aid] = o
+            rewards[aid] = r
+            terminateds[aid] = term
+            truncateds[aid] = trunc
+            self._terminated[aid] = term
+            self._truncated[aid] = trunc and not term
+        all_done = all(t or u for t, u in zip(self._terminated.values(),
+                                              self._truncated.values()))
+        # A natural all-agents termination is NOT a truncation: consumers
+        # use the distinction to decide final-step bootstrapping.
+        terminateds["__all__"] = all_done and \
+            all(self._terminated.values())
+        truncateds["__all__"] = all_done and \
+            not all(self._terminated.values())
+        return obs, rewards, terminateds, truncateds, {}

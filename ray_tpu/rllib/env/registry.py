@@ -17,13 +17,17 @@ def register_env(name: str, creator: Callable) -> None:
 
 
 def _builtin(name: str) -> Optional[Callable]:
-    from ray_tpu.rllib.env.tiny_envs import CartPole, GridWorld
+    from ray_tpu.rllib.env.multi_agent_env import TwoAgentGrid
+    from ray_tpu.rllib.env.tiny_envs import CartPole, GridWorld, Pendulum
 
     table = {
         "CartPole-v1": CartPole,
         "CartPole": CartPole,
         "GridWorld-v0": GridWorld,
         "GridWorld": GridWorld,
+        "Pendulum-v1": Pendulum,
+        "Pendulum": Pendulum,
+        "TwoAgentGrid": TwoAgentGrid,
     }
     return table.get(name)
 

@@ -86,6 +86,56 @@ class CartPole:
         return self._state.copy(), 1.0, terminated, truncated, {}
 
 
+class Pendulum:
+    """Classic underactuated pendulum swing-up (dynamics and reward match
+    gymnasium's Pendulum-v1: obs [cos th, sin th, thdot], torque in
+    [-2, 2], reward -(th^2 + 0.1 thdot^2 + 0.001 u^2), 200-step episodes).
+    The canonical continuous-control test task (for SAC)."""
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    MAX_STEPS = 200
+
+    observation_space = Box(-np.inf, np.inf, (3,))
+    action_space = Box(-MAX_TORQUE, MAX_TORQUE, (1,))
+
+    def __init__(self, config: Optional[dict] = None):
+        self._rng = np.random.default_rng(0)
+        self._th = 0.0
+        self._thdot = 0.0
+        self._steps = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([np.cos(self._th), np.sin(self._th),
+                         self._thdot], np.float32)
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._th = self._rng.uniform(-np.pi, np.pi)
+        self._thdot = self._rng.uniform(-1.0, 1.0)
+        self._steps = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.MAX_TORQUE, self.MAX_TORQUE))
+        th_norm = ((self._th + np.pi) % (2 * np.pi)) - np.pi
+        cost = th_norm ** 2 + 0.1 * self._thdot ** 2 + 0.001 * u ** 2
+        thdot = self._thdot + (
+            3 * self.G / (2 * self.L) * np.sin(self._th) +
+            3.0 / (self.M * self.L ** 2) * u) * self.DT
+        self._thdot = float(np.clip(thdot, -self.MAX_SPEED, self.MAX_SPEED))
+        self._th = self._th + self._thdot * self.DT
+        self._steps += 1
+        truncated = self._steps >= self.MAX_STEPS
+        return self._obs(), -float(cost), False, truncated, {}
+
+
 class GridWorld:
     """N×N grid; start top-left, goal bottom-right; -0.01/step, -0.05 for
     bumping a wall, +1 at the goal.

@@ -275,3 +275,102 @@ def test_bc_clones_expert_policy():
     # The cloned policy balances far longer than random (~20 steps).
     assert ev["evaluation"]["episode_return_mean"] > 80, ev
     algo.cleanup()
+
+
+def test_sac_solves_pendulum():
+    """SAC (continuous control): swing-up from ~-1300 (random) to a
+    near-optimal greedy policy. VERDICT round-1 item 6."""
+    from ray_tpu.rllib.algorithms.sac import SACConfig
+
+    config = (SACConfig()
+              .environment(env="Pendulum")
+              .env_runners(num_env_runners=0)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    alpha = None
+    for _ in range(300):
+        result = algo.step()
+        alpha = result.get("alpha", alpha)
+    # Entropy temperature auto-tuned down from its 1.0 init.
+    assert alpha is not None and alpha < 0.8, alpha
+    ev = algo.evaluate(num_episodes=5)
+    ret = ev["evaluation"]["episode_return_mean"]
+    # Random policy scores ~-1300; solved is > -200. Allow CI slack.
+    assert ret > -400, ev
+    algo.cleanup()
+
+
+def test_multi_agent_ppo_two_policies():
+    """Multi-agent PPO smoke: 2 agents -> 2 distinct policies on one env;
+    both learn. VERDICT round-1 item 6 (multi-agent)."""
+    from ray_tpu.rllib import MultiAgentPPOConfig
+
+    config = (MultiAgentPPOConfig()
+              .environment(env="TwoAgentGrid")
+              .env_runners(num_env_runners=0)
+              .training(train_batch_size=256, minibatch_size=64,
+                        num_epochs=4)
+              .debugging(seed=0))
+    algo = config.algo_class(config)
+    first, best = None, -1e9
+    for _ in range(30):
+        result = algo.step()
+        ret = result.get("episode_return_mean")
+        if ret is not None and np.isfinite(ret):
+            if first is None:
+                first = ret
+            best = max(best, ret)
+    # Two separate policies with different network shapes (different
+    # boards), both present in the weight dict.
+    weights = algo._get_weights()
+    assert set(weights) == {"a0", "a1"}
+    assert weights["a0"]["torso"][0]["w"].shape != \
+        weights["a1"]["torso"][0]["w"].shape
+    assert first is not None and best > first + 1.0, (first, best)
+    algo.cleanup()
+
+
+def test_multi_agent_ppo_remote_runners(ray_start_regular):
+    """Multi-agent sampling through remote env-runner actors."""
+    from ray_tpu.rllib import MultiAgentPPOConfig
+
+    config = (MultiAgentPPOConfig()
+              .environment(env="TwoAgentGrid")
+              .env_runners(num_env_runners=2)
+              .training(train_batch_size=128, minibatch_size=64,
+                        num_epochs=1)
+              .debugging(seed=0))
+    algo = config.algo_class(config)
+    result = algo.step()
+    assert "a0/steps_trained" in result
+    assert result["a0/steps_trained"] > 0
+    algo.cleanup()
+
+
+def test_multi_agent_ppo_shared_policy():
+    """Two agents mapped onto ONE shared module (equal spaces): per-agent
+    eps_ids keep GAE trajectory boundaries intact in the merged batch."""
+    from ray_tpu.rllib import MultiAgentPPOConfig
+
+    config = (MultiAgentPPOConfig()
+              .environment(env="TwoAgentGrid",
+                           env_config={"size_a0": 3, "size_a1": 3})
+              .multi_agent(policy_mapping_fn=lambda aid: "shared")
+              .env_runners(num_env_runners=0)
+              .training(train_batch_size=256, minibatch_size=64,
+                        num_epochs=4)
+              .debugging(seed=0))
+    algo = config.algo_class(config)
+    first, best = None, -1e9
+    for _ in range(25):
+        result = algo.step()
+        ret = result.get("episode_return_mean")
+        if ret is not None and np.isfinite(ret):
+            if first is None:
+                first = ret
+            best = max(best, ret)
+    assert set(algo.learners) == {"shared"}
+    # Shared module trains on both agents' steps.
+    assert result["shared/steps_trained"] >= 256
+    assert first is not None and best > first + 0.5, (first, best)
+    algo.cleanup()
