@@ -181,6 +181,8 @@ class CoreWorker:
                                     {"driver_address": self.address})
             self.job_id = JobID(r["job_id"])
             await self.gcs.call("subscribe", {"channel": "actors"})
+            if self.config.log_to_driver:
+                await self.gcs.call("subscribe", {"channel": "logs"})
         else:
             self.job_id = JobID.nil()
         if self.raylet_address:
@@ -240,6 +242,22 @@ class CoreWorker:
             self.plasma.close()
 
     async def _on_pubsub(self, method: str, data, conn) -> None:
+        if method == "publish" and data["channel"] == "logs":
+            # Worker stdout/stderr streamed to the driver console
+            # (reference: log_monitor.py:103 -> print_to_stdstream).
+            # Only this job's workers (None = unleased worker chatter).
+            import sys as _sys
+
+            msg = data["data"]
+            owner = msg.get("job_id")
+            if owner is not None and self.job_id is not None and \
+                    owner != self.job_id.binary():
+                return
+            prefix = f"(pid={msg.get('pid')}) "
+            for line in msg.get("lines", []):
+                _sys.stderr.write(prefix + line + "\n")
+            _sys.stderr.flush()
+            return
         if method == "publish" and data["channel"] == "actors":
             view = data["data"]
             aid = ActorID(view["actor_id"])

@@ -1,0 +1,140 @@
+"""Pluggable external storage for object spilling.
+
+Reference: python/ray/_private/external_storage.py:72 (ExternalStorage
+interface), :233 (FileSystemStorage), :296 (ExternalStorageSmartOpenImpl
+for cloud URIs). A TPU pod's host RAM overflow needs somewhere durable:
+the raylet spills through one of these backends, keyed by the URI scheme
+of ``object_spilling_path`` (bare paths and file:// -> local filesystem;
+any other scheme -> fsspec when available, or a registered plugin).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Type
+from urllib.parse import urlparse
+
+
+class ExternalStorage:
+    """One spill backend. URLs returned by put() are cluster-global."""
+
+    def put(self, key: str, data: bytes) -> str:
+        """Write data; returns the restore URL."""
+        raise NotImplementedError
+
+    def get(self, url: str) -> bytes:
+        raise NotImplementedError
+
+    def delete(self, url: str) -> None:
+        raise NotImplementedError
+
+
+class FileSystemStorage(ExternalStorage):
+    """Spill to a local/NFS directory (reference: FileSystemStorage)."""
+
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+
+    def put(self, key: str, data: bytes) -> str:
+        os.makedirs(self.base_dir, exist_ok=True)
+        path = os.path.join(self.base_dir, key)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # torn-write safety
+        return path
+
+    def get(self, url: str) -> bytes:
+        with open(url, "rb") as f:
+            return f.read()
+
+    def delete(self, url: str) -> None:
+        try:
+            os.unlink(url)
+        except OSError:
+            pass
+
+
+class FsspecStorage(ExternalStorage):
+    """Any fsspec-resolvable URI (s3://bucket/prefix, gs://...).
+
+    Gated on fsspec availability (hermetic images may lack it);
+    construction raises ImportError otherwise."""
+
+    def __init__(self, base_uri: str):
+        import fsspec  # noqa: F401 — availability gate
+
+        self.base_uri = base_uri.rstrip("/")
+
+    def _fs(self, uri: str):
+        import fsspec
+
+        return fsspec.core.url_to_fs(uri)
+
+    def put(self, key: str, data: bytes) -> str:
+        uri = f"{self.base_uri}/{key}"
+        fs, path = self._fs(uri)
+        with fs.open(path, "wb") as f:
+            f.write(data)
+        return uri
+
+    def get(self, url: str) -> bytes:
+        fs, path = self._fs(url)
+        with fs.open(path, "rb") as f:
+            return f.read()
+
+    def delete(self, url: str) -> None:
+        try:
+            fs, path = self._fs(url)
+            fs.rm(path)
+        except Exception:
+            pass
+
+
+_SCHEME_REGISTRY: Dict[str, Type[ExternalStorage]] = {}
+
+
+def register_storage(scheme: str, cls: Type[ExternalStorage]) -> None:
+    """Plugin hook: map a URI scheme to a storage backend class
+    (constructed with the full base URI). Tests register mock remotes."""
+    _SCHEME_REGISTRY[scheme] = cls
+
+
+def _load_env_plugins() -> None:
+    """RAY_TPU_SPILL_PLUGINS="scheme=module:ClassName,..." — lets every
+    process in the cluster (notably raylets, which are separate
+    processes) resolve custom spill schemes (reference: the
+    object_spilling_config JSON passed through ray_config)."""
+    spec = os.environ.get("RAY_TPU_SPILL_PLUGINS", "")
+    for part in spec.split(","):
+        if "=" not in part:
+            continue
+        scheme, target = part.split("=", 1)
+        scheme = scheme.strip()
+        if scheme in _SCHEME_REGISTRY:
+            continue
+        try:
+            import importlib
+
+            mod_name, _, attr = target.partition(":")
+            mod = importlib.import_module(mod_name.strip())
+            _SCHEME_REGISTRY[scheme] = getattr(mod, attr.strip())
+        except Exception:
+            pass
+
+
+def storage_for_path(path: str) -> ExternalStorage:
+    """Resolve the spill backend for a configured spilling path/URI."""
+    scheme = urlparse(path).scheme
+    if scheme in ("", "file"):
+        base = path[len("file://"):] if path.startswith("file://") else path
+        return FileSystemStorage(base)
+    if scheme not in _SCHEME_REGISTRY:
+        _load_env_plugins()
+    if scheme in _SCHEME_REGISTRY:
+        return _SCHEME_REGISTRY[scheme](path)
+    return FsspecStorage(path)
+
+
+def storage_scheme(url: str) -> str:
+    return urlparse(url).scheme

@@ -195,6 +195,11 @@ class GcsServer:
         self.subscribers.setdefault(data["channel"], set()).add(conn)
         return True
 
+    async def handle_publish_logs(self, data, conn) -> None:
+        """Raylet log monitors forward worker output here; fan out to
+        subscribed drivers (reference: log_monitor -> driver path)."""
+        await self.publish("logs", data)
+
     # ------------------------------------------------------------- KV
     async def handle_kv_put(self, data, conn) -> bool:
         overwrite = data.get("overwrite", True)

@@ -50,6 +50,9 @@ class WorkerHandle:
         self.lease_id: Optional[bytes] = None
         self.actor_id: Optional[bytes] = None
         self.job_id: Optional[bytes] = None
+        self.log_path: Optional[str] = None
+        self.log_offset: int = 0
+        self.log_partial: bytes = b""
 
 
 class LeaseRequest:
@@ -91,6 +94,7 @@ class Raylet:
         self._server: Optional[rpc.Server] = None
         self._bg: List[asyncio.Task] = []
         self._spilled_local: Dict[bytes, str] = {}
+        self._spill_backend = None
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
         self.address = ""
         self.dead = False
@@ -132,6 +136,8 @@ class Raylet:
         await self.gcs.call("subscribe", {"channel": "jobs"})
         self._bg.append(asyncio.get_event_loop().create_task(self._heartbeat_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._reap_loop()))
+        self._bg.append(asyncio.get_event_loop().create_task(
+            self._log_monitor_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._spill_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._drain_loop()))
         if self.config.memory_monitor_refresh_ms > 0:
@@ -301,8 +307,48 @@ class Raylet:
             start_new_session=True)
         logf.close()
         w = WorkerHandle(worker_id, proc.pid, proc)
+        w.log_path = log_path
         self.workers[worker_id] = w
         return w
+
+    async def _log_monitor_loop(self) -> None:
+        """Tail every worker's log file and forward new lines to the GCS
+        "logs" pubsub channel, where subscribed drivers print them
+        (reference: python/ray/_private/log_monitor.py:103 — the driver
+        sees every worker's stdout/stderr)."""
+        while not self.dead:
+            await asyncio.sleep(0.25)
+            for w in list(self.workers.values()):
+                if w.log_path is None:
+                    continue
+                try:
+                    with open(w.log_path, "rb") as f:
+                        f.seek(w.log_offset)
+                        chunk = f.read(256 * 1024)
+                except OSError:
+                    continue
+                if not chunk:
+                    continue
+                w.log_offset += len(chunk)
+                data = w.log_partial + chunk
+                lines = data.split(b"\n")
+                w.log_partial = lines.pop()  # tail w/o newline
+                text_lines = [ln.decode("utf-8", "replace")
+                              for ln in lines if ln.strip()]
+                if not text_lines or self.gcs is None or self.gcs.closed:
+                    continue
+                try:
+                    await self.gcs.notify("publish_logs", {
+                        "lines": text_lines,
+                        "pid": w.pid,
+                        "worker_id": w.worker_id.binary(),
+                        # Lets each driver filter to its own job's
+                        # workers (None while the worker is unleased).
+                        "job_id": w.job_id,
+                        "node": self.address,
+                    })
+                except Exception:
+                    pass
 
     async def handle_register_worker(self, data, conn) -> dict:
         worker_id = WorkerID(data["worker_id"])
@@ -726,9 +772,19 @@ class Raylet:
         return True
 
     # ------------------------------------------------------- spilling
+    def _spill_storage(self):
+        """Spill backend per config (reference:
+        python/ray/_private/external_storage.py:72 — filesystem, or any
+        URI-schemed backend: fsspec / registered plugin)."""
+        if self._spill_backend is None:
+            from ray_tpu._private.external_storage import storage_for_path
+
+            path = self.config.object_spilling_dir or \
+                os.path.join(self.session_dir, "spill")
+            self._spill_backend = storage_for_path(path)
+        return self._spill_backend
+
     async def _spill_loop(self) -> None:
-        spill_dir = self.config.object_spilling_dir or \
-            os.path.join(self.session_dir, "spill")
         while not self.dead:
             await asyncio.sleep(0.5)
             try:
@@ -737,13 +793,13 @@ class Raylet:
                         stats["bytes_used"] / stats["capacity"] < \
                         self.config.object_spilling_threshold:
                     continue
-                await self._spill_once(spill_dir)
+                await self._spill_once()
             except Exception:
                 logger.exception("spill loop error")
 
-    async def _spill_once(self, spill_dir: str) -> None:
-        """Spill one unreferenced sealed object to disk (reference:
-        LocalObjectManager::SpillObjects)."""
+    async def _spill_once(self) -> None:
+        """Spill one unreferenced sealed object to external storage
+        (reference: LocalObjectManager::SpillObjects)."""
         import ctypes
 
         from ray_tpu.core import shm_client as sc
@@ -765,11 +821,16 @@ class Raylet:
         buf = self.store.get(oid, timeout_ms=0)
         if buf is None:
             return
-        os.makedirs(spill_dir, exist_ok=True)
-        url = os.path.join(spill_dir, oid.hex())
-        with open(url, "wb") as f:
-            f.write(buf.data)
-        buf.release()
+        storage = self._spill_storage()
+        loop = asyncio.get_event_loop()
+        # The pinned shm view streams straight to storage (no heap copy —
+        # the node is under memory pressure right now); remote backends
+        # block on IO, so write off-loop. Release the pin after.
+        try:
+            url = await loop.run_in_executor(None, storage.put, oid.hex(),
+                                             buf.data)
+        finally:
+            buf.release()
         self.store.delete(oid)
         self._spilled_local[oid.binary()] = url
         await self.gcs.call("add_spilled_object",
@@ -780,10 +841,15 @@ class Raylet:
                     sizes[best], url)
 
     async def _restore_spilled(self, oid: ObjectID, url: str) -> bool:
+        from ray_tpu._private.external_storage import storage_for_path
+
         try:
-            with open(url, "rb") as f:
-                data = f.read()
-        except OSError:
+            # Restore via the url's own backend (the object may have been
+            # spilled by a different node with a different local config).
+            storage = storage_for_path(url)
+            loop = asyncio.get_event_loop()
+            data = await loop.run_in_executor(None, storage.get, url)
+        except Exception:
             return False
         try:
             self.store.put_bytes(oid, data)

@@ -30,15 +30,31 @@ from ray_tpu.collective.coordinator import (COORDINATOR_NAME,
 
 _local = threading.local()
 _DEFAULT_TIMEOUT_S = 120.0
+# Per-PROCESS incarnation tokens, keyed by (group, rank). Cached at module
+# level so re-initializing a group from the same process reuses the token
+# (no epoch bump): only a genuinely restarted process (fresh module state)
+# mints a new token. Without the cache, each rank's re-init would
+# invalidate every other rank's epoch forever (livelock).
+_incarnations: Dict[tuple, str] = {}
+
+
+def _incarnation(group_name: str, rank: int) -> str:
+    key = (group_name, rank)
+    if key not in _incarnations:
+        import uuid as _uuid
+
+        _incarnations[key] = _uuid.uuid4().hex
+    return _incarnations[key]
 
 
 class _GroupState:
     def __init__(self, group_name: str, rank: int, world_size: int,
-                 coordinator):
+                 coordinator, epoch: int = 0):
         self.group_name = group_name
         self.rank = rank
         self.world_size = world_size
         self.coordinator = coordinator
+        self.epoch = epoch
         self.seq = 0
 
     def next_seq(self) -> int:
@@ -89,11 +105,15 @@ def init_collective_group(world_size: int, rank: int,
     if rank < 0 or rank >= world_size:
         raise ValueError(f"rank {rank} out of range for world {world_size}")
     coordinator = _get_or_create_coordinator()
-    ray_tpu.get(coordinator.declare_group.remote(
+    # The incarnation token makes an actor RESTART visible: the
+    # coordinator bumps the group epoch so the restarted rank's reset
+    # seq counter can never match stale rendezvous state (ADVICE r1).
+    epoch = ray_tpu.get(coordinator.declare_group.remote(
         group_name, world_size,
-        {_my_actor_id_hex() or f"rank-{rank}": rank}))
+        {_my_actor_id_hex() or f"rank-{rank}": rank},
+        incarnations={rank: _incarnation(group_name, rank)}))
     _groups()[group_name] = _GroupState(group_name, rank, world_size,
-                                        coordinator)
+                                        coordinator, epoch)
 
 
 def create_collective_group(actors: List[Any], world_size: int,
@@ -130,7 +150,11 @@ def _resolve_group(group_name: str) -> _GroupState:
     if rank is None:
         raise ValueError(
             f"this process is not a member of group {group_name!r}")
-    state = _GroupState(group_name, rank, info["world_size"], coordinator)
+    epoch = ray_tpu.get(coordinator.declare_group.remote(
+        group_name, info["world_size"],
+        incarnations={rank: _incarnation(group_name, rank)}))
+    state = _GroupState(group_name, rank, info["world_size"], coordinator,
+                        epoch)
     _groups()[group_name] = state
     return state
 
@@ -166,12 +190,12 @@ def _run_op(group_name: str, op_kind: str, payload, meta: dict,
     seq = state.next_seq()
     ray_tpu.get(state.coordinator.contribute.remote(
         group_name, op_kind, seq, state.rank, state.world_size, payload,
-        meta))
+        meta, epoch=state.epoch))
     deadline = time.monotonic() + timeout_s
     delay = 0.001
     while True:
         ready, result = ray_tpu.get(state.coordinator.poll.remote(
-            group_name, op_kind, seq, state.rank))
+            group_name, op_kind, seq, state.rank, epoch=state.epoch))
         if ready:
             return result
         if time.monotonic() > deadline:
@@ -239,7 +263,8 @@ def send(tensor, dst_rank: int, group_name: str = "default",
     """P2P send (reference: nccl_collective_group.py:350)."""
     state = _resolve_group(group_name)
     ray_tpu.get(state.coordinator.p2p_send.remote(
-        group_name, state.rank, dst_rank, tag, _as_numpy(tensor)))
+        group_name, state.rank, dst_rank, tag, _as_numpy(tensor),
+        epoch=state.epoch))
 
 
 def recv(src_rank: int, group_name: str = "default", tag: int = 0,
@@ -250,7 +275,7 @@ def recv(src_rank: int, group_name: str = "default", tag: int = 0,
     delay = 0.001
     while True:
         ready, payload = ray_tpu.get(state.coordinator.p2p_recv.remote(
-            group_name, src_rank, state.rank, tag))
+            group_name, src_rank, state.rank, tag, epoch=state.epoch))
         if ready:
             return payload
         if time.monotonic() > deadline:

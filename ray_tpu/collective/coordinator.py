@@ -60,31 +60,55 @@ class CollectiveCoordinator:
     """Named actor holding group membership and op rendezvous state."""
 
     def __init__(self):
-        # group_name -> {"world_size": int, "members": {actor_id_hex: rank}}
+        # group_name -> {"world_size": int, "members": {actor_id_hex: rank},
+        #                "epoch": int, "incarnations": {rank: token}}
         self._groups: Dict[str, Dict[str, Any]] = {}
-        # (group, op_kind, seq) -> _Rendezvous
-        self._ops: Dict[Tuple[str, str, int], _Rendezvous] = {}
+        # (group, epoch, op_kind, seq) -> _Rendezvous
+        self._ops: Dict[Tuple[str, int, str, int], _Rendezvous] = {}
         # (group, src, dst, tag) -> FIFO of payloads (p2p mailbox)
         self._mailbox: Dict[Tuple[str, int, int, int], List[Any]] = {}
 
     # ---- membership ----
 
     def declare_group(self, group_name: str, world_size: int,
-                      members: Optional[Dict[str, int]] = None) -> None:
-        """Register a group (declarative driver-side setup).
+                      members: Optional[Dict[str, int]] = None,
+                      incarnations: Optional[Dict[int, str]] = None) -> int:
+        """Register a group (declarative driver-side setup); returns the
+        group EPOCH.
 
         members maps actor-id hex -> rank, used by actors that never called
         init_collective_group locally (reference: create_collective_group,
         python/ray/util/collective/collective.py:151). Declarations merge:
         each rank's init_collective_group contributes its own entry.
+
+        incarnations maps rank -> per-process token. A rank re-declaring
+        with a NEW token is a restarted actor whose local op sequence
+        reset to 0: the epoch bumps and all in-flight rendezvous state of
+        the group is dropped, so the restarted rank can never silently
+        match a stale (group, op, seq) entry — peers of the dead epoch
+        fail fast instead (ADVICE r1: stale-rendezvous hazard).
         """
         group = self._groups.setdefault(
-            group_name, {"world_size": world_size, "members": {}})
+            group_name, {"world_size": world_size, "members": {},
+                         "epoch": 0, "incarnations": {}})
         if group["world_size"] != world_size:
             raise ValueError(
                 f"group {group_name!r} redeclared with world_size "
                 f"{world_size}, was {group['world_size']}")
         group["members"].update(members or {})
+        for rank, token in (incarnations or {}).items():
+            old = group["incarnations"].get(rank)
+            if old is not None and old != token:
+                group["epoch"] += 1
+                for key in [k for k in self._ops if k[0] == group_name]:
+                    del self._ops[key]
+                # Stale p2p payloads are the same hazard as stale
+                # rendezvous: drop the group's mailbox too.
+                for key in [k for k in self._mailbox
+                            if k[0] == group_name]:
+                    del self._mailbox[key]
+            group["incarnations"][rank] = token
+        return group["epoch"]
 
     def group_info(self, group_name: str) -> Optional[Dict[str, Any]]:
         return self._groups.get(group_name)
@@ -104,10 +128,20 @@ class CollectiveCoordinator:
 
     # ---- collective rendezvous ----
 
+    def _check_epoch(self, group: str, epoch: int) -> None:
+        g = self._groups.get(group)
+        current = g["epoch"] if g else 0
+        if epoch != current:
+            raise RuntimeError(
+                f"collective group {group!r} epoch {epoch} is stale "
+                f"(current {current}): a member actor restarted — "
+                "re-init_collective_group on every rank")
+
     def contribute(self, group: str, op_kind: str, seq: int, rank: int,
                    world_size: int, payload: Any,
-                   meta: Optional[dict] = None) -> None:
-        key = (group, op_kind, seq)
+                   meta: Optional[dict] = None, epoch: int = 0) -> None:
+        self._check_epoch(group, epoch)
+        key = (group, epoch, op_kind, seq)
         rdv = self._ops.get(key)
         if rdv is None:
             rdv = self._ops[key] = _Rendezvous(world_size)
@@ -116,9 +150,10 @@ class CollectiveCoordinator:
             rdv.result = self._finalize(op_kind, rdv, meta or {})
 
     def poll(self, group: str, op_kind: str, seq: int,
-             rank: int) -> Tuple[bool, Any]:
+             rank: int, epoch: int = 0) -> Tuple[bool, Any]:
         """Returns (ready, result-for-rank); cleans up after all fetched."""
-        key = (group, op_kind, seq)
+        self._check_epoch(group, epoch)
+        key = (group, epoch, op_kind, seq)
         rdv = self._ops.get(key)
         if rdv is None or rdv.result is None:
             return False, None
@@ -159,11 +194,13 @@ class CollectiveCoordinator:
     # ---- p2p mailbox ----
 
     def p2p_send(self, group: str, src: int, dst: int, tag: int,
-                 payload: Any) -> None:
+                 payload: Any, epoch: int = 0) -> None:
+        self._check_epoch(group, epoch)
         self._mailbox.setdefault((group, src, dst, tag), []).append(payload)
 
     def p2p_recv(self, group: str, src: int, dst: int,
-                 tag: int) -> Tuple[bool, Any]:
+                 tag: int, epoch: int = 0) -> Tuple[bool, Any]:
+        self._check_epoch(group, epoch)
         key = (group, src, dst, tag)
         queue = self._mailbox.get(key)
         if queue:

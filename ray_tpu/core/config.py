@@ -75,6 +75,9 @@ class Config:
     testing_rpc_delay: str = ""
     # --- logging / observability ---
     log_dir: str = ""
+    # Stream worker stdout/stderr to the driver console via the raylet
+    # log monitor + GCS pubsub (reference: log_monitor.py).
+    log_to_driver: bool = True
     task_events_enabled: bool = True
     task_events_max_buffer: int = 10000
     metrics_report_interval_ms: int = 2000

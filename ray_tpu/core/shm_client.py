@@ -57,6 +57,13 @@ def _load():
         lib.shm_stats.argtypes = [ctypes.c_void_p] + [
             ctypes.POINTER(ctypes.c_uint64)] * 4
         lib.shm_stats.restype = ctypes.c_int
+        # Without an explicit signature ctypes would truncate the 64-bit
+        # handle to a C int — a raylet-killing segfault in the spill path.
+        lib.shm_list.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.shm_list.restype = ctypes.c_int
         _lib = lib
     return _lib
 

@@ -10,9 +10,19 @@ import threading
 class DataContext:
     target_max_block_size: int = 128 * 1024 * 1024
     max_tasks_in_flight: int = 16
+    # Global streaming-execution byte budget: completed-but-unconsumed
+    # operator outputs + running-task estimates (reference:
+    # ResourceManager object-store memory budget).
+    max_inflight_bytes: int = 256 * 1024 * 1024
+    # Fraction of the budget reserved per-op (equal split); the rest is a
+    # shared pool (reference: ReservationOpResourceAllocator).
+    reservation_ratio: float = 0.5
+    default_block_size_estimate: int = 1 * 1024 * 1024
     default_batch_format: str = "numpy"
     actor_pool_size: int = 2
     verbose_progress: bool = False
+    # Stats of the most recent streaming execution (ExecutionStats).
+    last_execution_stats: object = None
 
     _local = threading.local()
 

@@ -186,7 +186,9 @@ class Dataset:
 
     # ---------------- execution ----------------
     def iter_block_refs(self) -> Iterator[Any]:
-        return StreamingExecutor().execute(self._read_tasks, self._stages)
+        # Keep the executor so stats() reports THIS dataset's run.
+        self._last_executor = StreamingExecutor()
+        return self._last_executor.execute(self._read_tasks, self._stages)
 
     def iter_blocks(self) -> Iterator[Block]:
         for ref in self.iter_block_refs():
@@ -401,8 +403,15 @@ class Dataset:
         return block_to_numpy(concat_blocks(list(self.iter_blocks())))
 
     def stats(self) -> str:
-        return f"Dataset(read_tasks={len(self._read_tasks)}, " \
+        """Plan summary + per-operator stats of THIS dataset's most
+        recent streaming execution (reference: Dataset.stats() /
+        _internal/stats.py)."""
+        plan = f"Dataset(read_tasks={len(self._read_tasks)}, " \
                f"stages={[getattr(s, 'name', '?') for s in self._stages]})"
+        ex = getattr(self, "_last_executor", None)
+        if ex is not None and ex.last_stats is not None:
+            return plan + "\n" + ex.last_stats.summary()
+        return plan
 
     def __repr__(self) -> str:
         return self.stats()

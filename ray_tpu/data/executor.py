@@ -4,7 +4,11 @@ Reference: python/ray/data/_internal/execution/streaming_executor.py:48 —
 operators run as remote tasks over Block ObjectRefs with bounded
 in-flight tasks (backpressure); consecutive map stages are fused into one
 task (the reference's fusion optimizer rule); all-to-all stages
-materialize their input frontier then fan back out.
+materialize their input frontier then fan back out. Per-operator budgets
+and stats live in ray_tpu/data/resource_manager.py (the reference's
+ResourceManager/ReservationOpResourceAllocator); actor-pool stages scale
+between a (min, max) size with demand
+(reference: .../execution/autoscaler/).
 
 The TPU angle: this engine is deliberately host-side (CPU) — it feeds
 per-host train workers via streaming_split iterators; device transfer
@@ -16,18 +20,21 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import ray_tpu
 from ray_tpu.data.block import Block, concat_blocks
 from ray_tpu.data.context import DataContext
+from ray_tpu.data.resource_manager import (ExecutionStats, OpStats,
+                                           ResourceManager)
 
 
 @dataclasses.dataclass
 class MapStage:
     name: str
     fn: Callable[[Block], Block]          # pure block transform
-    # "tasks" or ("actors", pool_size, cls_factory)
+    # "tasks" or ("actors", size, cls_factory); size int or (min, max)
     compute: Any = "tasks"
 
 
@@ -91,15 +98,84 @@ class _MapActor:
         return fn(self._callable, block)
 
 
+def _ref_size_bytes(ref) -> Optional[int]:
+    """Best-effort serialized size of a locally-known object (inline
+    memory-store objects only — no fetch, no pin)."""
+    try:
+        from ray_tpu._private.worker import global_worker_or_none
+
+        w = global_worker_or_none()
+        if w is None:
+            return None
+        data = w.core.memory_store.get_if_exists(ref.id)
+        return len(data) if data is not None else None
+    except Exception:
+        return None
+
+
+class _OpDriver:
+    """Shared submission/backpressure logic for one operator's stream."""
+
+    def __init__(self, rm: ResourceManager, stats: OpStats,
+                 default_estimate: int):
+        self.rm = rm
+        self.stats = stats
+        self.name = stats.name
+        self._estimate = default_estimate  # EMA of observed block bytes
+        self._t0 = time.perf_counter()
+
+    def wait_for_budget(self, in_flight: collections.deque,
+                        on_head_done=None) -> Iterator:
+        """Yields completed heads until a new task may be submitted."""
+        while not self.rm.can_submit(self.name, self._estimate):
+            if not in_flight:
+                return  # idle op: liveness rule admits the next submit
+            t0 = time.perf_counter()
+            head, est = in_flight.popleft()
+            ray_tpu.wait([head], num_returns=1)
+            self.stats.time_blocked_s += time.perf_counter() - t0
+            if on_head_done is not None:
+                on_head_done(head)
+            yield self.finish(head, est)
+
+    def submitted(self, in_flight: collections.deque, ref) -> None:
+        self.rm.on_task_submitted(self.name, self._estimate)
+        in_flight.append((ref, self._estimate))
+
+    def finish(self, ref, estimate: int):
+        actual = _ref_size_bytes(ref)
+        self.rm.on_task_finished(self.name, estimate, actual)
+        held = actual if actual is not None else estimate
+        if actual is not None:
+            self._estimate = int(0.7 * self._estimate + 0.3 * actual)
+        self.stats.blocks_out += 1
+        self.stats.bytes_out += held
+        return ref, held
+
+    def consumed(self, bytes_held: int) -> None:
+        self.rm.on_output_consumed(self.name, bytes_held)
+
+    def done(self) -> None:
+        self.stats.wall_time_s = time.perf_counter() - self._t0
+
+
 class StreamingExecutor:
     def __init__(self, context: Optional[DataContext] = None):
         self.context = context or DataContext.get_current()
+        self.last_stats: Optional[ExecutionStats] = None
 
     # ------------------------------------------------------------------
     def execute(self, read_tasks: List[Callable[[], Block]],
                 stages: List[Stage]) -> Iterator[Any]:
         """Yields Block ObjectRefs in completion order (streaming)."""
+        ctx = self.context
         stages = _fuse(list(stages))
+        rm = ResourceManager(
+            max_tasks=ctx.max_tasks_in_flight * max(
+                1, 1 + sum(1 for s in stages if isinstance(s, MapStage))),
+            max_bytes=ctx.max_inflight_bytes,
+            reservation_ratio=ctx.reservation_ratio)
+        t_start = time.perf_counter()
         # Split pipeline at barriers (all-to-all) / stream-truncators.
         segments: List[Tuple[List[MapStage], Optional[Stage]]] = []
         cur: List[MapStage] = []
@@ -111,16 +187,28 @@ class StreamingExecutor:
                 cur.append(st)
         segments.append((cur, None))
 
-        source: Iterator[Any] = self._stream_source(read_tasks)
+        source: Iterator[Any] = self._stream_source(read_tasks, rm)
         for map_stages, boundary in segments:
-            source = self._stream_maps(source, map_stages)
+            for st in map_stages:
+                source = self._stream_one(source, st, rm)
             if isinstance(boundary, LimitStage):
                 source = self._stream_limit(source, boundary.n)
             elif boundary is not None:
                 blocks = [ray_tpu.get(r) for r in source]
                 out_blocks = boundary.fn(blocks)
                 source = iter([ray_tpu.put(b) for b in out_blocks])
-        return source
+
+        def finalize(src):
+            try:
+                for ref in src:
+                    yield ref
+            finally:
+                stats = ExecutionStats(
+                    rm.all_stats(), time.perf_counter() - t_start)
+                self.last_stats = stats
+                DataContext.get_current().last_execution_stats = stats
+
+        return finalize(source)
 
     @staticmethod
     def _stream_limit(source: Iterator[Any], n: int) -> Iterator[Any]:
@@ -141,63 +229,132 @@ class StreamingExecutor:
                 break
 
     # ------------------------------------------------------------------
-    def _stream_source(self, read_tasks) -> Iterator[Any]:
+    def _stream_source(self, read_tasks, rm: ResourceManager
+                       ) -> Iterator[Any]:
         # Blocks are yielded in task-SUBMISSION order (the reference's
         # default preserve_order semantics): only the head ref is waited
         # on, so later tasks still execute concurrently behind it.
+        op = _OpDriver(rm, rm.register_op("Read"),
+                       self.context.default_block_size_estimate)
         limit = self.context.max_tasks_in_flight
         pending = collections.deque(read_tasks)
         in_flight: collections.deque = collections.deque()
-        while pending or in_flight:
-            while pending and len(in_flight) < limit:
-                in_flight.append(_exec_read.remote(pending.popleft()))
-            head = in_flight.popleft()
-            ray_tpu.wait([head], num_returns=1)
-            yield head
-
-    def _stream_maps(self, source: Iterator[Any],
-                     map_stages: List[MapStage]) -> Iterator[Any]:
-        for st in map_stages:
-            source = self._stream_one(source, st)
-        return source
+        try:
+            while pending or in_flight:
+                while pending and len(in_flight) < limit:
+                    for ref, held in op.wait_for_budget(in_flight):
+                        yield ref
+                        op.consumed(held)
+                    op.submitted(in_flight,
+                                 _exec_read.remote(pending.popleft()))
+                head, est = in_flight.popleft()
+                ray_tpu.wait([head], num_returns=1)
+                ref, held = op.finish(head, est)
+                yield ref
+                op.consumed(held)
+        finally:
+            op.done()
 
     def _stream_one(self, source: Iterator[Any],
-                    stage: MapStage) -> Iterator[Any]:
+                    stage: MapStage, rm: ResourceManager) -> Iterator[Any]:
+        op = _OpDriver(rm, rm.register_op(stage.name),
+                       self.context.default_block_size_estimate)
         limit = self.context.max_tasks_in_flight
         if stage.compute == "tasks":
-            in_flight: collections.deque = collections.deque()
-            for ref in source:
-                in_flight.append(_exec_map.remote(stage.fn, ref))
-                if len(in_flight) >= limit:
-                    head = in_flight.popleft()
-                    ray_tpu.wait([head], num_returns=1)
-                    yield head
-            while in_flight:
-                head = in_flight.popleft()
-                ray_tpu.wait([head], num_returns=1)
-                yield head
-        else:
-            _, pool_size, cls_factory = stage.compute
-            actors = [_MapActor.remote(cls_factory)
-                      for _ in range(pool_size)]
             try:
-                in_flight = collections.deque()
-                i = 0
+                in_flight: collections.deque = collections.deque()
                 for ref in source:
-                    actor = actors[i % len(actors)]
-                    i += 1
-                    in_flight.append(actor.apply.remote(stage.fn, ref))
+                    for done_ref, held in op.wait_for_budget(in_flight):
+                        yield done_ref
+                        op.consumed(held)
+                    op.submitted(in_flight,
+                                 _exec_map.remote(stage.fn, ref))
                     if len(in_flight) >= limit:
-                        head = in_flight.popleft()
+                        head, est = in_flight.popleft()
                         ray_tpu.wait([head], num_returns=1)
-                        yield head
+                        out, held = op.finish(head, est)
+                        yield out
+                        op.consumed(held)
                 while in_flight:
-                    head = in_flight.popleft()
+                    head, est = in_flight.popleft()
                     ray_tpu.wait([head], num_returns=1)
-                    yield head
+                    out, held = op.finish(head, est)
+                    yield out
+                    op.consumed(held)
             finally:
-                for a in actors:
-                    try:
-                        ray_tpu.kill(a)
-                    except Exception:
-                        pass
+                op.done()
+            return
+
+        # ---- actor pool (possibly autoscaling between (min, max)) ----
+        _, size, cls_factory = stage.compute
+        if isinstance(size, (tuple, list)):
+            min_size, max_size = int(size[0]), int(size[1])
+        else:
+            min_size = max_size = int(size)
+        pool: Dict[Any, int] = {
+            _MapActor.remote(cls_factory): 0 for _ in range(min_size)}
+        op.stats.actor_pool_size = len(pool)
+
+        def least_loaded():
+            return min(pool, key=pool.get)
+
+        def maybe_autoscale(backlog: int) -> None:
+            # Scale up when every actor has >1 queued task; scale down
+            # (idle actors only) when half the pool would suffice.
+            if backlog > 2 * len(pool) and len(pool) < max_size:
+                pool[_MapActor.remote(cls_factory)] = 0
+                op.stats.actor_pool_scaleups = getattr(
+                    op.stats, "actor_pool_scaleups", 0) + 1
+            elif len(pool) > min_size and backlog < len(pool) // 2:
+                for actor, n in list(pool.items()):
+                    if n == 0 and len(pool) > min_size:
+                        del pool[actor]
+                        try:
+                            ray_tpu.kill(actor)
+                        except Exception:
+                            pass
+                        break
+            op.stats.actor_pool_size = max(
+                getattr(op.stats, "actor_pool_size", 0), len(pool))
+
+        ref_actor: Dict[int, Any] = {}  # id(ref) -> executing actor
+
+        def head_done(head) -> None:
+            a = ref_actor.pop(id(head), None)
+            if a is not None and a in pool:
+                pool[a] -= 1
+
+        try:
+            in_flight = collections.deque()
+            for ref in source:
+                for done_ref, held in op.wait_for_budget(in_flight,
+                                                         head_done):
+                    yield done_ref
+                    op.consumed(held)
+                maybe_autoscale(len(in_flight))
+                actor = least_loaded()
+                pool[actor] += 1
+                out = actor.apply.remote(stage.fn, ref)
+                ref_actor[id(out)] = actor
+                op.submitted(in_flight, out)
+                if len(in_flight) >= limit:
+                    head, est = in_flight.popleft()
+                    ray_tpu.wait([head], num_returns=1)
+                    head_done(head)
+                    out2, held = op.finish(head, est)
+                    yield out2
+                    op.consumed(held)
+            while in_flight:
+                head, est = in_flight.popleft()
+                ray_tpu.wait([head], num_returns=1)
+                head_done(head)
+                out2, held = op.finish(head, est)
+                yield out2
+                op.consumed(held)
+        finally:
+            op.done()
+            for a in pool:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass

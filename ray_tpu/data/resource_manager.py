@@ -1,0 +1,155 @@
+"""Operator resource management for the streaming executor.
+
+Reference: python/ray/data/_internal/execution/resource_manager.py:25
+(ResourceManager) and :246 (ReservationOpResourceAllocator): each
+operator gets a RESERVED share of the global task/memory budget it can
+always use, and the remainder is a SHARED pool handed out on demand.
+Reservation guarantees liveness (no operator can be starved into
+deadlock by another's runahead); the shared pool lets fast operators
+use idle capacity. Also the per-operator stats the reference keeps in
+python/ray/data/_internal/stats.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class OpStats:
+    """Per-operator execution counters (reference: OpRuntimeMetrics)."""
+
+    name: str
+    tasks_submitted: int = 0
+    tasks_finished: int = 0
+    blocks_out: int = 0
+    bytes_out: int = 0
+    rows_out: int = 0
+    wall_time_s: float = 0.0
+    time_blocked_s: float = 0.0  # waiting on the resource budget
+    peak_tasks_in_flight: int = 0
+    peak_bytes_in_flight: int = 0
+    actor_pool_size: int = 0      # actor-pool ops: peak pool size
+    actor_pool_scaleups: int = 0
+
+    def summary(self) -> str:
+        return (f"{self.name}: tasks={self.tasks_finished}"
+                f"/{self.tasks_submitted} blocks={self.blocks_out} "
+                f"rows={self.rows_out} "
+                f"bytes={self.bytes_out} wall={self.wall_time_s:.2f}s "
+                f"blocked={self.time_blocked_s:.2f}s "
+                f"peak_in_flight={self.peak_tasks_in_flight} tasks/"
+                f"{self.peak_bytes_in_flight} bytes")
+
+
+class _OpUsage:
+    __slots__ = ("tasks", "bytes", "stats")
+
+    def __init__(self, stats: OpStats):
+        self.tasks = 0
+        self.bytes = 0
+        self.stats = stats
+
+
+class ResourceManager:
+    """Global budget split between operators via reservations.
+
+    Budgets: total concurrently-running tasks and total in-flight bytes
+    (completed-but-unconsumed outputs + a running-task estimate). Each
+    registered op reserves ``reservation_ratio`` of an equal split; the
+    rest is shared first-come-first-served. An op with nothing in flight
+    may ALWAYS submit one task (liveness guarantee).
+    """
+
+    def __init__(self, max_tasks: int, max_bytes: int,
+                 reservation_ratio: float = 0.5):
+        self.max_tasks = max(1, max_tasks)
+        self.max_bytes = max(1, max_bytes)
+        self.reservation_ratio = reservation_ratio
+        self._ops: Dict[str, _OpUsage] = {}
+        self._reserved_tasks = 0
+        self._reserved_bytes = 0
+
+    # ---- registration ----
+    def register_op(self, name: str) -> OpStats:
+        base = name
+        i = 1
+        while name in self._ops:  # duplicate stage names
+            i += 1
+            name = f"{base}#{i}"
+        stats = OpStats(name=name)
+        self._ops[name] = _OpUsage(stats)
+        n = len(self._ops)
+        self._reserved_tasks = max(
+            1, int(self.max_tasks * self.reservation_ratio / n))
+        self._reserved_bytes = max(
+            1, int(self.max_bytes * self.reservation_ratio / n))
+        return stats
+
+    # ---- accounting ----
+    def _shared_in_use(self) -> tuple:
+        st = sb = 0
+        for u in self._ops.values():
+            st += max(0, u.tasks - self._reserved_tasks)
+            sb += max(0, u.bytes - self._reserved_bytes)
+        return st, sb
+
+    def can_submit(self, name: str, bytes_estimate: int = 0) -> bool:
+        u = self._ops[name]
+        if u.tasks == 0 and u.bytes == 0:
+            return True  # liveness: an idle op always gets one task
+        if u.tasks < self._reserved_tasks and \
+                u.bytes + bytes_estimate <= self._reserved_bytes:
+            return True
+        shared_tasks = self.max_tasks - \
+            self._reserved_tasks * len(self._ops)
+        shared_bytes = self.max_bytes - \
+            self._reserved_bytes * len(self._ops)
+        st, sbytes = self._shared_in_use()
+        return st < shared_tasks and sbytes + bytes_estimate <= shared_bytes
+
+    def on_task_submitted(self, name: str, bytes_estimate: int) -> None:
+        u = self._ops[name]
+        u.tasks += 1
+        u.bytes += bytes_estimate
+        u.stats.tasks_submitted += 1
+        u.stats.peak_tasks_in_flight = max(
+            u.stats.peak_tasks_in_flight, u.tasks)
+        u.stats.peak_bytes_in_flight = max(
+            u.stats.peak_bytes_in_flight, u.bytes)
+
+    def on_task_finished(self, name: str, bytes_estimate: int,
+                         bytes_actual: Optional[int]) -> None:
+        """Task done; its output stays charged (as bytes) until consumed."""
+        u = self._ops[name]
+        u.tasks -= 1
+        u.stats.tasks_finished += 1
+        if bytes_actual is not None and bytes_actual != bytes_estimate:
+            u.bytes += bytes_actual - bytes_estimate
+
+    def on_output_consumed(self, name: str, bytes_held: int) -> None:
+        u = self._ops[name]
+        u.bytes = max(0, u.bytes - bytes_held)
+
+    def all_stats(self) -> List[OpStats]:
+        return [u.stats for u in self._ops.values()]
+
+    def summary(self) -> str:
+        lines = [s.summary() for s in self.all_stats()]
+        return "\n".join(lines)
+
+
+class ExecutionStats:
+    """Stats of one streaming execution, kept for Dataset.stats()."""
+
+    def __init__(self, op_stats: List[OpStats], wall_time_s: float):
+        self.op_stats = op_stats
+        self.wall_time_s = wall_time_s
+        self.finished_at = time.time()
+
+    def summary(self) -> str:
+        lines = [f"Streaming execution: {self.wall_time_s:.2f}s total"]
+        lines += ["  " + s.summary() for s in self.op_stats]
+        return "\n".join(lines)

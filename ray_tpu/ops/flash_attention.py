@@ -1,10 +1,11 @@
-"""Pallas TPU flash attention (forward kernel + recompute backward).
+"""Pallas TPU flash attention (forward AND backward kernels).
 
 Online-softmax tiling keeps the working set in VMEM and the score matmuls
 on the MXU; the kv-block grid axis iterates fastest so the (m, l, acc)
 scratch accumulators persist across kv blocks for a fixed q block.
-Backward is flash-style recompute in plain JAX under `jax.custom_vjp`
-(XLA fuses it well; a Pallas backward is a later optimization).
+Backward is flash-style recompute in Pallas under `jax.custom_vjp`:
+_bwd_dq_kernel (kv innermost) and _bwd_dkv_kernel (q innermost) re-derive
+p from the saved row logsumexp.
 
 Semantics match `ray_tpu.ops.attention.mha_reference` exactly, including
 the kv-prefix causal offset when Sq != Sk (decode) and GQA. Sequence

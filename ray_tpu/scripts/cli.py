@@ -190,6 +190,31 @@ def cmd_memory(args) -> None:
     print(json.dumps(rows, indent=2, default=str))
 
 
+def cmd_logs(args) -> None:
+    """List or tail worker log files of the latest (or given) session
+    (reference: `ray logs` CLI, python/ray/scripts)."""
+    import glob
+
+    base = args.session or max(
+        glob.glob("/tmp/ray_tpu/session_*"), default=None,
+        key=lambda p: os.path.getmtime(p))
+    if base is None:
+        print("no ray_tpu session found under /tmp/ray_tpu")
+        return
+    log_dir = os.path.join(base, "logs")
+    files = sorted(glob.glob(os.path.join(log_dir, "*")))
+    if args.filename:
+        path = os.path.join(log_dir, args.filename)
+        with open(path, "r", errors="replace") as f:
+            content = f.readlines()
+        for line in content[-args.tail:]:
+            print(line.rstrip())
+        return
+    for path in files:
+        size = os.path.getsize(path)
+        print(f"{os.path.basename(path)}\t{size} bytes")
+
+
 def cmd_job(args) -> None:
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -278,6 +303,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--limit", type=int, default=100)
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("logs", help="list/tail session worker logs")
+    sp.add_argument("filename", nargs="?", default=None,
+                    help="log file to print (omit to list)")
+    sp.add_argument("--session", help="session dir (default: latest)")
+    sp.add_argument("--tail", type=int, default=200)
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("serve", help="serve deploy/status/shutdown")
     ssub = sp.add_subparsers(dest="serve_cmd", required=True)

@@ -75,13 +75,29 @@ class _RpcIngress:
             None, lambda: handle.remote(data.get("payload")))
         # Same bound as the HTTP path: a hung replica must not leak the
         # serve task/connection forever.
-        return await asyncio.wait_for(_await_response(response),
-                                      timeout=60)
+        try:
+            return await asyncio.wait_for(
+                _await_response(response),
+                timeout=self._proxy._request_timeout_s)
+        except asyncio.TimeoutError:
+            _cancel_response(response)
+            raise TimeoutError(
+                f"request timed out after "
+                f"{self._proxy._request_timeout_s}s")
 
 
 async def _await_response(response):
     """Shared by the HTTP and rpc ingress paths."""
     return await response
+
+
+def _cancel_response(response) -> None:
+    cancel = getattr(response, "cancel", None)
+    if cancel is not None:
+        try:
+            cancel()
+        except Exception:
+            pass
 
 
 @ray_tpu.remote(max_concurrency=1000, lifetime="detached",
@@ -90,6 +106,8 @@ class ProxyActor:
     def __init__(self, http_options: dict):
         self._host = http_options.get("host", "127.0.0.1")
         self._port = int(http_options.get("port", 8000))
+        # None = wait forever (reference: HTTPOptions.request_timeout_s).
+        self._request_timeout_s = http_options.get("request_timeout_s", 60)
         self._route_table: Dict[str, dict] = {}
         self._num_requests = 0
         self._ready_evt = threading.Event()
@@ -231,7 +249,15 @@ class ProxyActor:
             response = await asyncio.get_running_loop().run_in_executor(
                 None, self._submit, entry, serve_req)
             result = await asyncio.wait_for(
-                _await_response(response), timeout=60)
+                _await_response(response),
+                timeout=self._request_timeout_s)
+        except asyncio.TimeoutError:
+            # Release the replica slot NOW: a hung replica must not keep
+            # counting as ongoing load (ADVICE r1) or hold the client.
+            _cancel_response(response)
+            return web.Response(
+                status=504,
+                text=f"request timed out after {self._request_timeout_s}s")
         except Exception as e:
             logger.exception("request to %s failed", path)
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")

@@ -167,23 +167,33 @@ class Router:
             raise
         worker = ray_tpu.get_runtime_context()._worker
         fut = worker.as_future(ref)
-        fut.add_done_callback(
-            lambda _f: self._scheduler.on_request_done(entry))
+        # Idempotent release: fires on normal completion OR an early
+        # caller-side cancel (e.g. proxy request timeout) — never both,
+        # so a hung replica can't accumulate phantom ongoing load and a
+        # normal completion can't double-decrement.
+        released = []
+
+        def release_once():
+            if not released:
+                released.append(1)
+                self._scheduler.on_request_done(entry)
+
+        fut.add_done_callback(lambda _f: release_once())
         if meta.stream:
             # The first reply (the stream id) completes `fut`
             # immediately, but the replica keeps working until the
             # stream drains: hold an extra ongoing count that the
             # DeploymentResponseGenerator releases at stream end.
             self._scheduler.on_request_sent(entry)
-            released = []
+            released_stream = []
 
-            def release():
-                if not released:
-                    released.append(1)
+            def release_stream():
+                if not released_stream:
+                    released_stream.append(1)
                     self._scheduler.on_request_done(entry)
 
-            return ref, fut, handle, release
-        return ref, fut, handle, None
+            return ref, fut, handle, release_stream
+        return ref, fut, handle, release_once
 
     _MULTIPLEX_CACHE_TTL_S = 2.0
 

@@ -39,6 +39,8 @@ def _get_controller(create: bool = False,
                 "Serve is not running; call serve.start() or serve.run()")
     from ray_tpu.serve._private.controller import ServeController
 
+    if isinstance(http_options, dict):  # reference: serve.start accepts
+        http_options = HTTPOptions(**http_options)  # plain dicts too
     http_dict = (http_options or HTTPOptions()).to_dict()
     _controller_handle = ServeController.options(
         name=SERVE_CONTROLLER_NAME).remote(http_dict)
@@ -53,6 +55,8 @@ def start(http_options: Optional[HTTPOptions] = None, *,
     Reference: serve.start (python/ray/serve/api.py:83)."""
     if not ray_tpu.is_initialized():
         ray_tpu.init()
+    if isinstance(http_options, dict):
+        http_options = HTTPOptions(**http_options)
     controller = _get_controller(create=True, http_options=http_options)
     if proxy:
         _ensure_proxy(controller, http_options)

@@ -73,6 +73,10 @@ class HTTPOptions:
 
     host: str = "127.0.0.1"
     port: int = 8000
+    # End-to-end request bound; on expiry the client gets 504 and the
+    # replica slot is released (None = wait forever).
+    request_timeout_s: Optional[float] = 60.0
 
     def to_dict(self) -> dict:
-        return {"host": self.host, "port": self.port}
+        return {"host": self.host, "port": self.port,
+                "request_timeout_s": self.request_timeout_s}

@@ -19,12 +19,29 @@ class DeploymentResponse:
     """Future-like result of handle.remote() (reference handle.py
     DeploymentResponse)."""
 
-    def __init__(self, ref, fut):
+    def __init__(self, ref, fut, release_cb=None):
         self._ref = ref
         self._fut = fut
+        self._release_cb = release_cb
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def cancel(self) -> None:
+        """Abandon the request: release its scheduler slot immediately
+        (a hung replica must not count as ongoing load forever) and
+        best-effort cancel the task (reference: DeploymentResponse
+        .cancel())."""
+        cb, self._release_cb = self._release_cb, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
+        try:
+            ray_tpu.cancel(self._ref)
+        except Exception:
+            pass
 
     def __await__(self):
         async def _get():
@@ -87,7 +104,7 @@ class DeploymentHandle:
             meta, args, kwargs)
         if self._stream:
             return DeploymentResponseGenerator(ref, replica, release)
-        return DeploymentResponse(ref, fut)
+        return DeploymentResponse(ref, fut, release)
 
     def __reduce__(self):
         return (DeploymentHandle,

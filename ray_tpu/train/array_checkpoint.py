@@ -109,6 +109,12 @@ def save_pytree(tree: Pytree, path: str,
         if hasattr(arr, "addressable_shards"):
             written = set()
             for shard in arr.addressable_shards:
+                # Cross-host dedup of replicated regions: only the
+                # replica_id==0 holder writes (orbax convention) — else
+                # every host writes its own copy of fully-replicated
+                # leaves and checkpoint bytes scale with host count.
+                if getattr(shard, "replica_id", 0) != 0:
+                    continue
                 region = tuple(
                     tuple(b) for b in _slices_to_json(shard.index,
                                                       arr.shape))

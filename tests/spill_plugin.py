@@ -1,0 +1,37 @@
+"""Mock remote spill backend loaded into raylet processes via
+RAY_TPU_SPILL_PLUGINS (see test_spilling.py). Blobs live in a shared
+on-disk directory so the test process can inspect what the raylet wrote
+— standing in for an S3/GCS bucket."""
+
+import os
+
+from ray_tpu._private.external_storage import ExternalStorage
+
+
+class MockFsStorage(ExternalStorage):
+    def __init__(self, base_uri: str):
+        # mockfs:///abs/dir/...  -> /abs/dir
+        self.dir = "/" + base_uri.split("://", 1)[1].lstrip("/")
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, key + ".mockblob")
+
+    def put(self, key, data):
+        os.makedirs(self.dir, exist_ok=True)
+        with open(self._path(key), "wb") as f:
+            f.write(data)
+        return f"mockfs://{self.dir}/{key}"
+
+    @staticmethod
+    def _url_blob(url: str) -> str:
+        return "/" + url.split("://", 1)[1].lstrip("/") + ".mockblob"
+
+    def get(self, url):
+        with open(self._url_blob(url), "rb") as f:
+            return f.read()
+
+    def delete(self, url):
+        try:
+            os.unlink(self._url_blob(url))
+        except OSError:
+            pass

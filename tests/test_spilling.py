@@ -1,0 +1,122 @@
+"""Object spilling to external storage (VERDICT round-1 item 9).
+
+Reference test model: the spilling tests around
+python/ray/_private/external_storage.py — fill the store past the spill
+threshold, verify objects restore transparently on get(), through both
+the filesystem backend and a mocked remote-URI backend.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.external_storage import (ExternalStorage,
+                                               FileSystemStorage,
+                                               register_storage,
+                                               storage_for_path)
+
+
+class TestStorageBackends:
+    def test_filesystem_roundtrip(self, tmp_path):
+        s = FileSystemStorage(str(tmp_path))
+        url = s.put("objkey", b"payload")
+        assert os.path.exists(url)
+        assert s.get(url) == b"payload"
+        s.delete(url)
+        assert not os.path.exists(url)
+
+    def test_file_uri_resolves_to_filesystem(self, tmp_path):
+        s = storage_for_path(f"file://{tmp_path}")
+        url = s.put("k", b"x")
+        assert s.get(url) == b"x"
+
+    def test_registered_scheme_plugin(self):
+        blobs = {}
+
+        class MockRemote(ExternalStorage):
+            def __init__(self, base_uri):
+                self.base = base_uri
+
+            def put(self, key, data):
+                url = f"{self.base}/{key}"
+                blobs[url] = data
+                return url
+
+            def get(self, url):
+                return blobs[url]
+
+            def delete(self, url):
+                blobs.pop(url, None)
+
+        register_storage("mocks3", MockRemote)
+        s = storage_for_path("mocks3://bucket/spill")
+        url = s.put("obj1", b"remote-bytes")
+        assert url.startswith("mocks3://bucket/spill")
+        assert storage_for_path(url).get(url) == b"remote-bytes"
+
+
+def _spill_cluster(tmp_path, spill_path):
+    """Tiny object store + aggressive spill threshold."""
+    return ray_tpu.init(
+        num_cpus=2,
+        object_store_memory=12 * 1024 * 1024,
+        system_config={
+            "object_spilling_dir": spill_path,
+            "object_spilling_threshold": 0.5,
+        })
+
+
+@pytest.mark.parametrize("scheme", ["plain", "file"])
+def test_spill_restore_roundtrip_filesystem(tmp_path, scheme):
+    spill = str(tmp_path / "spill")
+    path = spill if scheme == "plain" else f"file://{spill}"
+    _spill_cluster(tmp_path, path)
+    try:
+        arrs = [np.random.rand(1024 * 1024 // 8) for _ in range(10)]
+        refs = [ray_tpu.put(a) for a in arrs]  # ~10MB into a 12MB store
+        import time
+
+        deadline = time.time() + 20
+        spilled = 0
+        while time.time() < deadline:
+            if os.path.isdir(spill) and os.listdir(spill):
+                spilled = len(os.listdir(spill))
+                break
+            time.sleep(0.25)
+        assert spilled > 0, "nothing spilled under pressure"
+        # Every object restores transparently, including spilled ones.
+        for a, r in zip(arrs, refs):
+            np.testing.assert_array_equal(ray_tpu.get(r), a)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_spill_restore_through_mock_remote_uri(tmp_path):
+    """Spill/restore through a registered remote-URI backend, loaded by
+    the raylet PROCESS via RAY_TPU_SPILL_PLUGINS."""
+    import time
+
+    blob_dir = tmp_path / "bucket"
+    blob_dir.mkdir()
+    os.environ["RAY_TPU_SPILL_PLUGINS"] = \
+        "mockfs=tests.spill_plugin:MockFsStorage"
+    try:
+        _spill_cluster(tmp_path, f"mockfs://{blob_dir}")
+        arrs = [np.random.rand(1024 * 1024 // 8) for _ in range(10)]
+        refs = [ray_tpu.put(a) for a in arrs]
+        deadline = time.time() + 20
+        spilled = 0
+        while time.time() < deadline:
+            blobs = list(blob_dir.glob("*.mockblob"))
+            if blobs:
+                spilled = len(blobs)
+                break
+            time.sleep(0.25)
+        assert spilled > 0, "nothing spilled to the mock remote"
+        for a, r in zip(arrs, refs):
+            np.testing.assert_array_equal(ray_tpu.get(r), a)
+    finally:
+        os.environ.pop("RAY_TPU_SPILL_PLUGINS", None)
+        ray_tpu.shutdown()
