@@ -11,7 +11,8 @@ Parity map to the reference (python/ray/tune/):
 from ray_tpu.tune import schedulers, search
 from ray_tpu.tune.result_grid import ResultGrid
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
-                                     HyperBandScheduler, MedianStoppingRule,
+                                     HyperBandForBOHB, HyperBandScheduler,
+                                     MedianStoppingRule, PB2,
                                      PopulationBasedTraining, TrialScheduler)
 from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
                                  Searcher, choice, grid_search, lograndint,
@@ -24,7 +25,8 @@ from ray_tpu.tune.tuner import (TuneConfig, Tuner, run, with_parameters,
 
 __all__ = [
     "AsyncHyperBandScheduler", "BasicVariantGenerator", "ConcurrencyLimiter",
-    "FIFOScheduler", "HyperBandScheduler", "MedianStoppingRule",
+    "FIFOScheduler", "HyperBandForBOHB", "HyperBandScheduler",
+    "MedianStoppingRule", "PB2",
     "PopulationBasedTraining", "ResultGrid", "Searcher", "Trainable",
     "TrialScheduler", "TuneConfig", "Tuner", "choice", "get_checkpoint",
     "grid_search", "lograndint", "loguniform", "qloguniform", "quniform",

@@ -28,6 +28,16 @@ class TrialScheduler:
         if getattr(self, "mode", None) is None and mode:
             self.mode = mode
 
+    def _score(self, result: Dict) -> Optional[float]:
+        """Internal maximize-normalized metric value."""
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        return float(v) if self.mode == "max" else -float(v)
+
+    def on_trial_add(self, controller, trial) -> None:
+        pass
+
     def on_trial_result(self, controller, trial, result: Dict) -> str:
         return CONTINUE
 
@@ -61,12 +71,6 @@ class AsyncHyperBandScheduler(TrialScheduler):
             self._rungs.append({lv: [] for lv in levels})
         self._trial_bracket: Dict[str, int] = {}
 
-    def _score(self, result: Dict) -> Optional[float]:
-        v = result.get(self.metric)
-        if v is None:
-            return None
-        return float(v) if self.mode == "max" else -float(v)
-
     def on_trial_result(self, controller, trial, result: Dict) -> str:
         t = result.get(self.time_attr, 0)
         if t >= self.max_t:
@@ -93,10 +97,107 @@ class AsyncHyperBandScheduler(TrialScheduler):
         return decision
 
 
-# Synchronous HyperBand shares the successive-halving math; the async
-# variant dominates it in practice (reference recommends ASHA,
-# python/ray/tune/schedulers/async_hyperband.py module docstring).
-HyperBandScheduler = AsyncHyperBandScheduler
+class HyperBandScheduler(TrialScheduler):
+    """Bracketed (synchronous-style) successive halving.
+
+    Reference: python/ray/tune/schedulers/hyperband.py — trials fill
+    brackets; at each rung boundary the bracket keeps its top
+    1/reduction_factor trials. Unlike ASHA (which cuts each trial
+    immediately against the current rung quantile), halving decisions
+    here wait until every live bracket member reports at the rung, so
+    early finishers are never killed against a half-empty rung.
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 max_t: int = 81, reduction_factor: float = 3):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        # s_max+1 bracket shapes (reference hyperband math): bracket s
+        # starts trials at r = max_t / rf^s and halves at each rung.
+        # +eps: math.log(243, 3) == 4.9999... must floor to 5, not 4.
+        self.s_max = int(math.log(max_t, reduction_factor) + 1e-9)
+        self._brackets: List[Dict] = []
+        self._trial_bracket: Dict[str, Dict] = {}
+        self._next_bracket = 0
+
+    def _new_bracket(self) -> Dict:
+        s = self.s_max - (self._next_bracket % (self.s_max + 1))
+        self._next_bracket += 1
+        r0 = max(1, int(self.max_t / (self.rf ** s)))
+        rungs = []
+        t = r0
+        while t < self.max_t:
+            rungs.append(int(t))
+            t *= self.rf
+        capacity = max(1, int(math.ceil((self.s_max + 1) / (s + 1) *
+                                        (self.rf ** s))))
+        return {"rungs": rungs, "capacity": capacity, "members": set(),
+                "results": {lv: {} for lv in rungs}, "stopped": set()}
+
+    def _bracket_of(self, trial) -> Dict:
+        b = self._trial_bracket.get(trial.trial_id)
+        if b is None:
+            if not self._brackets or \
+                    len(self._brackets[-1]["members"]) >= \
+                    self._brackets[-1]["capacity"]:
+                self._brackets.append(self._new_bracket())
+            b = self._brackets[-1]
+            b["members"].add(trial.trial_id)
+            self._trial_bracket[trial.trial_id] = b
+        return b
+
+    def on_trial_add(self, controller, trial) -> None:
+        # Join the bracket at START so rung completeness counts every
+        # concurrently-running member, not just those that reported.
+        self._bracket_of(trial)
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        b = self._bracket_of(trial)
+        if trial.trial_id in b["stopped"]:
+            return STOP
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        for level in sorted(b["rungs"], reverse=True):
+            if t < level:
+                continue
+            b["results"][level].setdefault(trial.trial_id, score)
+            live = b["members"] - b["stopped"]
+            recorded = {tid: s for tid, s in b["results"][level].items()
+                        if tid in live}
+            # The bracket may still be filling (max_concurrent below
+            # capacity): halving against a partial cohort would kill
+            # trials that are top-k of the FULL bracket. Wait until the
+            # bracket is full — or no further trials can ever join.
+            more_coming = (len(b["members"]) < b["capacity"] and
+                           controller is not None and
+                           controller.has_pending_trials())
+            if len(recorded) >= len(live) and len(recorded) > 1 and \
+                    not more_coming:
+                # Whole rung reported: halve the bracket.
+                keep = max(1, int(len(recorded) / self.rf))
+                ranked = sorted(recorded.items(), key=lambda kv: -kv[1])
+                for tid, _ in ranked[keep:]:
+                    b["stopped"].add(tid)
+            break
+        return STOP if trial.trial_id in b["stopped"] else CONTINUE
+
+    def on_trial_complete(self, controller, trial, result: Dict) -> None:
+        b = self._trial_bracket.get(trial.trial_id)
+        if b is not None:
+            b["stopped"].add(trial.trial_id)
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    """HyperBand variant paired with the TuneBOHB searcher (reference:
+    python/ray/tune/schedulers/hb_bohb.py): identical halving; the
+    model-based config proposals come from the searcher."""
 
 
 class MedianStoppingRule(TrialScheduler):
@@ -153,12 +254,6 @@ class PopulationBasedTraining(TrialScheduler):
         self._last_perturb: Dict[str, int] = {}
         self._scores: Dict[str, float] = {}
 
-    def _score(self, result: Dict) -> Optional[float]:
-        v = result.get(self.metric)
-        if v is None:
-            return None
-        return float(v) if self.mode == "max" else -float(v)
-
     def explore(self, config: Dict) -> Dict:
         import numpy as np
 
@@ -187,6 +282,7 @@ class PopulationBasedTraining(TrialScheduler):
     def on_trial_result(self, controller, trial, result: Dict) -> str:
         score = self._score(result)
         if score is not None:
+            self._record_datapoint(trial, score)
             self._scores[trial.trial_id] = score
         t = result.get(self.time_attr, 0)
         last = self._last_perturb.get(trial.trial_id, 0)
@@ -202,4 +298,99 @@ class PopulationBasedTraining(TrialScheduler):
             donor_id = self._rng.choice(top)
             if donor_id != trial.trial_id:
                 controller.exploit(trial, donor_id, self.explore)
+                self._on_exploited(trial)
         return CONTINUE
+
+    def _on_exploited(self, trial) -> None:
+        """Hook for model-based variants (PB2)."""
+
+    def _record_datapoint(self, trial, score: float) -> None:
+        """Hook for model-based variants (PB2)."""
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits: PBT whose explore step picks new
+    hyperparameters by a GP-UCB acquisition over observed
+    (hyperparams -> score improvement) data, instead of random
+    perturbation (reference: python/ray/tune/schedulers/pb2.py; the GP
+    here is a plain-numpy RBF regressor — no GPy dependency).
+
+    hyperparam_bounds: {name: (low, high)} continuous ranges.
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: int = 0):
+        super().__init__(time_attr=time_attr, metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds = hyperparam_bounds or {}
+        self._prev_score: Dict[str, float] = {}
+        self._data: List = []  # (normalized hyperparam vec, score delta)
+
+    def _normalize(self, config: Dict):
+        import numpy as np
+
+        vec = []
+        for name, (lo, hi) in self.bounds.items():
+            v = float(config.get(name, lo))
+            vec.append((v - lo) / max(hi - lo, 1e-12))
+        return np.asarray(vec)
+
+    def _record_datapoint(self, trial, score: float) -> None:
+        prev = self._prev_score.get(trial.trial_id)
+        self._prev_score[trial.trial_id] = score
+        if prev is None or not self.bounds:
+            return
+        self._data.append((self._normalize(trial.config), score - prev))
+        if len(self._data) > 512:
+            self._data.pop(0)
+
+    def _on_exploited(self, trial) -> None:
+        # The next score jump comes from the DONOR's checkpoint, not from
+        # the new hyperparameters: drop the delta baseline so that jump
+        # never enters the GP data.
+        self._prev_score.pop(trial.trial_id, None)
+
+    def explore(self, config: Dict) -> Dict:
+        """GP-UCB over score improvements (falls back to uniform sampling
+        until enough data exists)."""
+        import numpy as np
+
+        new = dict(config)
+        if not self.bounds:
+            return new
+        rng = np.random.default_rng(self._rng.randrange(2 ** 31))
+        n_cand = 128
+        cands = rng.random((n_cand, len(self.bounds)))
+        if len(self._data) >= 4:
+            X = np.stack([d[0] for d in self._data])
+            y = np.asarray([d[1] for d in self._data])
+            y = (y - y.mean()) / (y.std() + 1e-9)
+
+            def rbf(a, b, ls=0.2):
+                d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+                return np.exp(-d2 / (2 * ls * ls))
+
+            K = rbf(X, X) + 1e-3 * np.eye(len(X))
+            Ks = rbf(cands, X)
+            Kinv_y = np.linalg.solve(K, y)
+            mu = Ks @ Kinv_y
+            v = np.linalg.solve(K, Ks.T)
+            var = np.clip(1.0 - (Ks * v.T).sum(-1), 1e-9, None)
+            ucb = mu + 1.0 * np.sqrt(var)
+            best = cands[int(np.argmax(ucb))]
+        else:
+            best = cands[0]
+        for i, (name, (lo, hi)) in enumerate(self.bounds.items()):
+            value = lo + float(best[i]) * (hi - lo)
+            if isinstance(config.get(name), int):
+                value = int(round(value))
+            new[name] = value
+        if self.custom_explore_fn:
+            new = self.custom_explore_fn(new)
+        return new

@@ -1,0 +1,134 @@
+"""TuneBOHB — model-based search for the HyperBandForBOHB scheduler.
+
+Reference: python/ray/tune/search/bohb/bohb_search.py (TuneBOHB wraps the
+hpbandster KDE model). Redesign without the dependency: a TPE-style
+density-ratio sampler in plain numpy — observed configs are split into a
+good (top-gamma) and bad set per the metric, Gaussian KDEs are fit to
+both over the normalized numeric dimensions, and candidates maximizing
+good-density / bad-density are suggested. Categorical dimensions use
+smoothed frequency ratios.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class TuneBOHB(Searcher):
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 gamma: float = 0.25, min_points: int = 8,
+                 n_candidates: int = 64, seed: int = 0):
+        super().__init__(metric, mode)
+        self._space = dict(space or {})
+        self.gamma = gamma
+        self.min_points = min_points
+        self.n_candidates = n_candidates
+        self._rng = np.random.default_rng(seed)
+        self._live: Dict[str, Dict] = {}
+        self._observed: List = []  # (config, score)
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if config and not self._space:
+            self._space = {k: v for k, v in config.items()
+                           if isinstance(v, Domain)}
+        return super().set_search_properties(metric, mode, config)
+
+    # ---- encoding ----
+    def _numeric_domains(self):
+        return [(k, d) for k, d in self._space.items()
+                if isinstance(d, (Float, Integer))]
+
+    def _encode(self, config: Dict) -> np.ndarray:
+        vec = []
+        for k, d in self._numeric_domains():
+            v = float(config[k])
+            lo, hi = float(d.lower), float(d.upper)
+            if getattr(d, "log", False):
+                v, lo, hi = np.log(v), np.log(lo), np.log(hi)
+            vec.append((v - lo) / max(hi - lo, 1e-12))
+        return np.asarray(vec)
+
+    def _sample_config(self) -> Dict:
+        return {k: d.sample(self._rng) if isinstance(d, Domain) else d
+                for k, d in self._space.items()}
+
+    # ---- Searcher API ----
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if len(self._observed) < self.min_points or \
+                not self._numeric_domains():
+            cfg = self._sample_config()
+            self._live[trial_id] = cfg
+            return cfg
+        scores = np.asarray([s for _, s in self._observed])
+        order = np.argsort(-scores)  # maximize internal score
+        n_good = max(2, int(len(order) * self.gamma))
+        good = [self._observed[i][0] for i in order[:n_good]]
+        bad = [self._observed[i][0] for i in order[n_good:]] or good
+        Xg = np.stack([self._encode(c) for c in good])
+        Xb = np.stack([self._encode(c) for c in bad])
+        bw = max(0.1, 1.0 / np.sqrt(len(Xg)))
+
+        def kde(X, pts):
+            d2 = ((pts[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+            return np.exp(-d2 / (2 * bw * bw)).mean(1) + 1e-12
+
+        candidates = [self._sample_config()
+                      for _ in range(self.n_candidates)]
+        # Bias half the candidates toward the good set (TPE style):
+        # jitter around randomly-chosen good points.
+        numeric = self._numeric_domains()
+        for i in range(self.n_candidates // 2):
+            base = good[int(self._rng.integers(len(good)))]
+            cand = dict(candidates[i])
+            for k, d in numeric:
+                lo, hi = float(d.lower), float(d.upper)
+                span = hi - lo
+                v = float(base[k]) + float(self._rng.normal(0, 0.1 * span))
+                v = min(hi, max(lo, v))
+                cand[k] = int(round(v)) if isinstance(d, Integer) else v
+            candidates[i] = cand
+        pts = np.stack([self._encode(c) for c in candidates])
+        ratio = kde(Xg, pts) / kde(Xb, pts)
+        # Categorical dims: smoothed frequency ratio.
+        for k, d in self._space.items():
+            if not isinstance(d, Categorical):
+                continue
+            freq_g: Dict = {}
+            freq_b: Dict = {}
+            for c in good:
+                freq_g[c[k]] = freq_g.get(c[k], 0) + 1
+            for c in bad:
+                freq_b[c[k]] = freq_b.get(c[k], 0) + 1
+            for i, c in enumerate(candidates):
+                g = (freq_g.get(c[k], 0) + 1) / (len(good) + len(
+                    d.categories))
+                b = (freq_b.get(c[k], 0) + 1) / (len(bad) + len(
+                    d.categories))
+                ratio[i] *= g / b
+        cfg = candidates[int(np.argmax(ratio))]
+        self._live[trial_id] = cfg
+        return cfg
+
+    def _internal_score(self, result: Dict) -> Optional[float]:
+        v = result.get(self.metric) if result else None
+        if v is None:
+            return None
+        return float(v) if self.mode == "max" else -float(v)
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        # Keep only the latest score per live trial (refreshed on
+        # completion below).
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        cfg = self._live.pop(trial_id, None)
+        score = self._internal_score(result)
+        if cfg is not None and score is not None and not error:
+            self._observed.append((cfg, score))

@@ -1,0 +1,84 @@
+"""OptunaSearch — adapter to the optuna library when it is installed.
+
+Reference: python/ray/tune/search/optuna/optuna_search.py. The adapter
+interface exists unconditionally (so configs referencing it parse and
+error messages are actionable); construction raises ImportError in
+hermetic images without optuna.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class OptunaSearch(Searcher):
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 sampler=None, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        try:
+            import optuna
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires the `optuna` package, which is not "
+                "available in this environment. Use TuneBOHB "
+                "(ray_tpu.tune.search.bohb.TuneBOHB) for a built-in "
+                "model-based searcher, or install optuna.") from e
+        self._optuna = optuna
+        self._space = dict(space or {})
+        self._sampler = sampler
+        self._seed = seed
+        # Created lazily at the first suggest(): the real mode may only
+        # arrive via set_search_properties (TuneConfig(mode=...)), and the
+        # study direction is immutable after creation.
+        self._study = None
+        self._trials: Dict[str, Any] = {}
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if config and not self._space:
+            self._space = {k: v for k, v in config.items()
+                           if isinstance(v, Domain)}
+        return super().set_search_properties(metric, mode, config)
+
+    def _ensure_study(self):
+        if self._study is None:
+            optuna = self._optuna
+            direction = ("maximize" if (self.mode or "max") == "max"
+                         else "minimize")
+            self._study = optuna.create_study(
+                direction=direction,
+                sampler=self._sampler or
+                optuna.samplers.TPESampler(seed=self._seed))
+        return self._study
+
+    def _suggest_param(self, trial, name: str, domain: Domain):
+        if isinstance(domain, Float):
+            return trial.suggest_float(name, domain.lower, domain.upper,
+                                       log=bool(domain.log))
+        if isinstance(domain, Integer):
+            return trial.suggest_int(name, domain.lower, domain.upper - 1,
+                                     log=bool(domain.log))
+        if isinstance(domain, Categorical):
+            return trial.suggest_categorical(name, domain.categories)
+        raise TypeError(f"unsupported domain for optuna: {domain!r}")
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        trial = self._ensure_study().ask()
+        self._trials[trial_id] = trial
+        return {name: (self._suggest_param(trial, name, d)
+                       if isinstance(d, Domain) else d)
+                for name, d in self._space.items()}
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        trial = self._trials.pop(trial_id, None)
+        if trial is None:
+            return
+        study = self._ensure_study()
+        if error or not result or self.metric not in result:
+            study.tell(trial, state=self._optuna.trial.TrialState.FAIL)
+        else:
+            study.tell(trial, float(result[self.metric]))

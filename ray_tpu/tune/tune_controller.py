@@ -106,6 +106,10 @@ class TuneController:
         handle = self._actor_cls.options(**opts).remote(
             self.trainable_cls, trial.config, trial.trial_dir,
             restore_from=restore_from or trial.checkpoint_path)
+        if trial.status == PENDING:
+            # First start (not a PBT-exploit restart): let the scheduler
+            # register it (HyperBand bracket membership).
+            self.scheduler.on_trial_add(self, trial)
         trial.status = RUNNING
         self._actors[trial.trial_id] = handle
         ref = handle.train.remote()
@@ -125,6 +129,13 @@ class TuneController:
             pass
         self._inflight = {r: t for r, t in self._inflight.items()
                           if t.trial_id != trial.trial_id}
+
+    def has_pending_trials(self) -> bool:
+        """More trials will still start (schedulers use this to decide
+        whether a bracket/cohort can still grow)."""
+        if self._pending:
+            return True
+        return bool(self._adaptive and self._remaining_suggestions > 0)
 
     def _next_trial(self) -> Optional[Trial]:
         if self._pending:
@@ -220,6 +231,10 @@ class TuneController:
                     trial.error = str(e)
                     self.search_alg.on_trial_complete(
                         trial.trial_id, error=True)
+                    # Schedulers must drop it too (e.g. a HyperBand
+                    # bracket waiting on this member would never halve).
+                    self.scheduler.on_trial_complete(
+                        self, trial, trial.last_result or {})
                 continue
 
             # Merge so the bare {"done": True} end-of-function sentinel

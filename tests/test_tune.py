@@ -289,3 +289,151 @@ def test_logger_callbacks_write_files(ray_start_regular, tmp_path):
         csv_text = (d / "progress.csv").read_text()
         assert "score" in csv_text.splitlines()[0]
         assert (d / "params.json").exists()
+
+
+def test_hyperband_bracket_halving_unit():
+    """Synchronous-style HyperBand: halving happens only once the whole
+    rung reported, then the bottom (1 - 1/rf) are stopped."""
+    from ray_tpu.tune.experiment import Trial
+    from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+    sched = tune.HyperBandScheduler(metric="acc", mode="max", max_t=9,
+                                    reduction_factor=3)
+    trials = [Trial({}, "/tmp/x") for _ in range(3)]
+    for t in trials:  # controller registers starts via on_trial_add
+        sched.on_trial_add(None, t)
+    # All three in one bracket report at the first rung.
+    assert sched.on_trial_result(
+        None, trials[0], {"training_iteration": 1, "acc": 0.9}) == CONTINUE
+    assert sched.on_trial_result(
+        None, trials[1], {"training_iteration": 1, "acc": 0.1}) == CONTINUE
+    # trial 1 (weak) was NOT stopped early: the rung wasn't complete yet.
+    decision_last = sched.on_trial_result(
+        None, trials[2], {"training_iteration": 1, "acc": 0.5})
+    # Rung complete: keep top 1/3 (trial 0); the last reporter is cut if
+    # it isn't the best.
+    assert decision_last == STOP
+    # Weak trial gets stopped at its next report.
+    assert sched.on_trial_result(
+        None, trials[1], {"training_iteration": 2, "acc": 0.1}) == STOP
+    assert sched.on_trial_result(
+        None, trials[0], {"training_iteration": 2, "acc": 1.8}) == CONTINUE
+    # max_t bound holds.
+    assert sched.on_trial_result(
+        None, trials[0], {"training_iteration": 9, "acc": 9.0}) == STOP
+
+
+def test_hyperband_integration(tmp_path):
+    def objective(config):
+        for i in range(12):
+            tune.report({"acc": config["q"] * (i + 1)})
+
+    results = Tuner(
+        objective,
+        param_space={"q": tune.grid_search([0.1, 0.3, 0.6, 0.9])},
+        tune_config=TuneConfig(metric="acc", mode="max",
+                               scheduler=tune.HyperBandScheduler(
+                                   max_t=12, reduction_factor=2),
+                               max_concurrent_trials=4),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 4 and results.num_errors == 0
+    assert results.get_best_result().config["q"] == 0.9
+
+
+def test_pb2_gp_explore_picks_within_bounds():
+    from ray_tpu.tune.schedulers import PB2
+
+    sched = PB2(metric="acc", mode="max", perturbation_interval=2,
+                hyperparam_bounds={"lr": (0.001, 0.1)})
+    # Feed synthetic improvement data: higher lr -> bigger delta.
+    class _T:
+        def __init__(self, tid, lr):
+            self.trial_id = tid
+            self.config = {"lr": lr}
+
+    for step in range(1, 6):
+        for i, lr in enumerate([0.002, 0.05, 0.09]):
+            t = _T(f"t{i}", lr)
+            sched._record_datapoint(t, lr * step * 10)
+    new = sched.explore({"lr": 0.002})
+    assert 0.001 <= new["lr"] <= 0.1
+    # With clear upward signal the GP-UCB should not pick the bottom edge.
+    assert new["lr"] > 0.002
+
+
+def test_pb2_integration(tmp_path):
+    def objective(config):
+        import time as _t
+
+        for i in range(8):
+            tune.report({"acc": config["lr"] * (i + 1)})
+            _t.sleep(0.01)
+
+    results = Tuner(
+        objective,
+        param_space={"lr": tune.uniform(0.001, 0.1)},
+        tune_config=TuneConfig(metric="acc", mode="max",
+                               scheduler=tune.PB2(
+                                   perturbation_interval=2,
+                                   hyperparam_bounds={
+                                       "lr": (0.001, 0.1)}),
+                               num_samples=4, max_concurrent_trials=4),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 4 and results.num_errors == 0
+
+
+def test_bohb_searcher_converges_unit():
+    """TuneBOHB suggests better configs once observations accumulate."""
+    import numpy as np
+
+    from ray_tpu.tune.search.bohb import TuneBOHB
+
+    searcher = TuneBOHB(
+        space={"x": tune.uniform(0.0, 1.0)}, metric="score", mode="max",
+        min_points=8, seed=3)
+    # Objective: peak at x=0.8.
+    for i in range(30):
+        cfg = searcher.suggest(f"t{i}")
+        score = -abs(cfg["x"] - 0.8)
+        searcher.on_trial_complete(f"t{i}", {"score": score})
+    suggestions = [searcher.suggest(f"s{i}")["x"] for i in range(10)]
+    # Model-guided suggestions cluster near the optimum.
+    assert np.median(np.abs(np.asarray(suggestions) - 0.8)) < 0.25, \
+        suggestions
+
+
+def test_bohb_with_hyperband_integration(tmp_path):
+    from ray_tpu.tune.search.bohb import TuneBOHB
+
+    def objective(config):
+        for i in range(6):
+            tune.report({"acc": (1.0 - abs(config["x"] - 0.7)) * (i + 1)})
+
+    results = Tuner(
+        objective,
+        param_space={"x": tune.uniform(0.0, 1.0)},
+        tune_config=TuneConfig(
+            metric="acc", mode="max",
+            search_alg=TuneBOHB(metric="acc", mode="max", min_points=4,
+                                seed=0),
+            scheduler=tune.HyperBandForBOHB(max_t=6, reduction_factor=2),
+            num_samples=8, max_concurrent_trials=4),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 8 and results.num_errors == 0
+
+
+def test_optuna_adapter_interface_gated():
+    from ray_tpu.tune.search.optuna import OptunaSearch
+
+    try:
+        searcher = OptunaSearch(space={"x": tune.uniform(0, 1)},
+                                metric="m", mode="max")
+    except ImportError as e:
+        # Hermetic image: the adapter exists and the error is actionable.
+        assert "optuna" in str(e) and "TuneBOHB" in str(e)
+    else:  # optuna available: the adapter actually suggests
+        cfg = searcher.suggest("t0")
+        assert 0 <= cfg["x"] <= 1
