@@ -7,6 +7,8 @@ for cloudless tests). TPU-first: slice-typed node groups scale atomically.
 
 from ray_tpu.autoscaler.autoscaler import (Monitor, ResourceDemandScheduler,
                                            StandardAutoscaler)
+from ray_tpu.autoscaler.gce import (GceClient, GCETPUNodeProvider,
+                                    MockGceClient)
 from ray_tpu.autoscaler.node_provider import (FakeMultiNodeProvider,
                                               NodeProvider)
 
@@ -16,4 +18,7 @@ __all__ = [
     "ResourceDemandScheduler",
     "NodeProvider",
     "FakeMultiNodeProvider",
+    "GceClient",
+    "GCETPUNodeProvider",
+    "MockGceClient",
 ]

@@ -1,0 +1,191 @@
+"""GCE TPU-VM node provider — slice-atomic scale-up.
+
+Reference: python/ray/autoscaler/_private/gcp/node_provider.py (the GCP
+provider) + python/ray/_private/accelerators/tpu.py:381 (the
+TPU-{pod_type}-head resource that makes a whole slice schedulable as one
+unit). The GCE TPU API creates a multi-host slice as ONE resource
+(`tpu.googleapis.com/v2 nodes.create` with acceleratorType like
+"v5litepod-16"), so scale-up here issues exactly one API call per slice
+— never per-host VM creates, never a partial slice.
+
+The HTTP transport is injected (``compute_client``): production wires a
+googleapis client; tests (and hermetic images) wire MockGceClient, which
+implements the same request/response shapes.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+# chips per host by TPU generation (reference: tpu.py chip bounds)
+_CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5litepod": 4, "v5p": 4,
+                   "v6e": 4}
+
+
+def slice_hosts(accelerator_type: str) -> int:
+    """'v5litepod-16' -> 16 chips / 4 per host = 4 hosts."""
+    gen, _, chips = accelerator_type.rpartition("-")
+    per_host = _CHIPS_PER_HOST.get(gen, 4)
+    return max(1, int(chips) // per_host)
+
+
+class GceClient:
+    """Transport interface (the googleapis subset the provider needs)."""
+
+    def create_tpu_node(self, name: str, accelerator_type: str,
+                        runtime_version: str, zone: str,
+                        labels: Dict[str, str]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def list_tpu_nodes(self, zone: str) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def delete_tpu_node(self, name: str, zone: str) -> None:
+        raise NotImplementedError
+
+
+class MockGceClient(GceClient):
+    """In-memory stand-in implementing the same shapes (tests / CI)."""
+
+    def __init__(self):
+        self.nodes: Dict[str, Dict[str, Any]] = {}
+        self.create_calls: List[Dict[str, Any]] = []
+        self.delete_calls: List[str] = []
+
+    def create_tpu_node(self, name, accelerator_type, runtime_version,
+                        zone, labels):
+        self.create_calls.append({
+            "name": name, "acceleratorType": accelerator_type,
+            "runtimeVersion": runtime_version, "zone": zone,
+            "labels": dict(labels)})
+        n_hosts = slice_hosts(accelerator_type)
+        node = {
+            "name": name,
+            "acceleratorType": accelerator_type,
+            "state": "READY",
+            "labels": dict(labels),
+            "networkEndpoints": [
+                {"ipAddress": f"10.0.{len(self.nodes)}.{i}"}
+                for i in range(n_hosts)],
+        }
+        self.nodes[name] = node
+        return node
+
+    def list_tpu_nodes(self, zone):
+        return list(self.nodes.values())
+
+    def delete_tpu_node(self, name, zone):
+        self.delete_calls.append(name)
+        self.nodes.pop(name, None)
+
+
+class GCETPUNodeProvider(NodeProvider):
+    """Slices are the unit of creation/termination; provider node ids are
+    '<slice-name>/<worker-index>' so the autoscaler sees per-host nodes
+    while the cloud API sees whole slices."""
+
+    def __init__(self, provider_config: Dict[str, Any],
+                 compute_client: Optional[GceClient] = None):
+        super().__init__(provider_config)
+        self.zone = provider_config.get("zone", "us-central2-b")
+        self.runtime_version = provider_config.get(
+            "runtime_version", "tpu-ubuntu2204-base")
+        self.cluster_name = provider_config.get("cluster_name", "ray-tpu")
+        self.client = compute_client or self._default_client()
+        self._deleted: set = set()  # slices deleted this provider's life
+        self._node_cache: Dict[str, Dict[str, Any]] = {}
+        # ONE source of truth for slice size: derive slice_hosts from the
+        # accelerator type so the demand scheduler, launch batching, and
+        # create_node can never disagree (a mismatch would wedge scale-up
+        # on the slice-atomic check forever).
+        for cfg in (provider_config.get("node_types") or {}).values():
+            accel = cfg.get("accelerator_type")
+            if accel:
+                cfg["slice_hosts"] = slice_hosts(accel)
+
+    def _default_client(self) -> GceClient:
+        raise RuntimeError(
+            "no googleapis client available in this environment; pass "
+            "compute_client= (MockGceClient for tests)")
+
+    # ---- NodeProvider API ----
+    def non_terminated_nodes(self) -> List[str]:
+        out = []
+        self._node_cache = {}
+        for node in self.client.list_tpu_nodes(self.zone):
+            if node.get("state") not in ("READY", "CREATING"):
+                continue
+            if node.get("labels", {}).get("ray-cluster") != \
+                    self.cluster_name:
+                continue
+            self._node_cache[node["name"]] = node
+            # CREATING slices have no networkEndpoints yet — count their
+            # full host complement or max_workers caps undercount and
+            # duplicate slices launch during the minutes-long create.
+            n_hosts = (len(node["networkEndpoints"])
+                       if node.get("networkEndpoints")
+                       else slice_hosts(node["acceleratorType"]))
+            for i in range(n_hosts):
+                out.append(f"{node['name']}/{i}")
+        return out
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        """count is in HOSTS (the autoscaler's unit); hosts are grouped
+        into whole slices — one API call per slice."""
+        cfg = (self.provider_config.get("node_types") or {}).get(
+            node_type, {})
+        accelerator_type = cfg.get("accelerator_type")
+        if not accelerator_type:
+            raise ValueError(
+                f"node type {node_type!r} has no accelerator_type")
+        hosts_per_slice = slice_hosts(accelerator_type)
+        if count % hosts_per_slice:
+            raise ValueError(
+                f"slice-atomic violation: asked for {count} hosts of "
+                f"{accelerator_type} ({hosts_per_slice} hosts/slice) — "
+                "scale-up must be whole slices")
+        created: List[str] = []
+        for _ in range(count // hosts_per_slice):
+            name = f"{self.cluster_name}-{node_type}-{uuid.uuid4().hex[:8]}"
+            node = self.client.create_tpu_node(
+                name, accelerator_type, self.runtime_version, self.zone,
+                labels={"ray-cluster": self.cluster_name,
+                        "ray-node-type": node_type})
+            created.extend(
+                f"{name}/{i}"
+                for i in range(len(node["networkEndpoints"])))
+        return created
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        """Terminating ANY host of a slice deletes the whole slice (a
+        partial slice cannot form an ICI mesh). Idempotent across the
+        slice's host ids — the autoscaler iterates per-host."""
+        slice_name = provider_node_id.split("/", 1)[0]
+        if slice_name in self._deleted:
+            return
+        self._deleted.add(slice_name)
+        self.client.delete_tpu_node(slice_name, self.zone)
+
+    def node_tags(self, provider_node_id: str) -> Dict[str, str]:
+        slice_name = provider_node_id.split("/", 1)[0]
+        node = self._node_cache.get(slice_name)
+        if node is None:  # cache refreshed by non_terminated_nodes
+            self.non_terminated_nodes()
+            node = self._node_cache.get(slice_name)
+        if node is None:
+            return {}
+        return {
+            "node_type": node["labels"].get("ray-node-type", "?"),
+            "slice_name": slice_name,
+            "accelerator_type": node["acceleratorType"],
+        }
+
+    def shutdown(self) -> None:
+        pass

@@ -214,11 +214,10 @@ class MedianStoppingRule(TrialScheduler):
         self._means: Dict[str, List[float]] = {}
 
     def on_trial_result(self, controller, trial, result: Dict) -> str:
-        v = result.get(self.metric)
+        s = self._score(result)
         t = result.get(self.time_attr, 0)
-        if v is None or t < self.grace_period:
+        if s is None or t < self.grace_period:
             return CONTINUE
-        s = float(v) if self.mode == "max" else -float(v)
         hist = self._means.setdefault(trial.trial_id, [])
         hist.append(s)
         means = [sum(h) / len(h) for tid, h in self._means.items() if h]

@@ -56,6 +56,10 @@ class OptunaSearch(Searcher):
 
     def _suggest_param(self, trial, name: str, domain: Domain):
         if isinstance(domain, Float):
+            q = getattr(domain, "q", None)
+            if q and not domain.log:  # optuna forbids step with log
+                return trial.suggest_float(name, domain.lower,
+                                           domain.upper, step=q)
             return trial.suggest_float(name, domain.lower, domain.upper,
                                        log=bool(domain.log))
         if isinstance(domain, Integer):

@@ -135,7 +135,8 @@ class TuneController:
         whether a bracket/cohort can still grow)."""
         if self._pending:
             return True
-        return bool(self._adaptive and self._remaining_suggestions > 0)
+        return bool(self._adaptive and self._remaining_suggestions > 0
+                    and not getattr(self, "_searcher_exhausted", False))
 
     def _next_trial(self) -> Optional[Trial]:
         if self._pending:
@@ -144,6 +145,11 @@ class TuneController:
             t = Trial({}, self.experiment_dir)
             cfg = self.search_alg.suggest(t.trial_id)
             if cfg is None:
+                # Exhausted (the controller only polls within the
+                # concurrency cap, so None ≈ no more configs): stop
+                # telling schedulers more trials are coming, or a
+                # below-capacity HyperBand bracket would never halve.
+                self._searcher_exhausted = True
                 return None
             self._remaining_suggestions -= 1
             t.config = cfg

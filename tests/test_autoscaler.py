@@ -84,3 +84,70 @@ def test_autoscaler_end_to_end_scale_up(ray_start_cluster):
     assert launched.get("worker", 0) >= 1
     assert ray_tpu.get(refs, timeout=30) == ["rock", "rock"]
     provider.shutdown()
+
+
+class TestGceTpuProvider:
+    """VERDICT round-1 item 10: GCE/TPU-shaped provider, slice-atomic."""
+
+    def _provider(self):
+        from ray_tpu.autoscaler.gce import GCETPUNodeProvider, MockGceClient
+
+        client = MockGceClient()
+        provider = GCETPUNodeProvider({
+            "zone": "us-central2-b",
+            "cluster_name": "testclus",
+            "node_types": {
+                "v5e-16": {"accelerator_type": "v5litepod-16",
+                           "resources": {"TPU": 4},
+                           "slice_hosts": 4, "max_workers": 8},
+            },
+        }, compute_client=client)
+        return provider, client
+
+    def test_one_api_call_creates_whole_slice(self):
+        provider, client = self._provider()
+        ids = provider.create_node("v5e-16", count=4)  # 4 hosts = 1 slice
+        assert len(client.create_calls) == 1
+        assert client.create_calls[0]["acceleratorType"] == "v5litepod-16"
+        assert len(ids) == 4  # one provider node per host
+        assert len(provider.non_terminated_nodes()) == 4
+        assert {provider.node_tags(i)["slice_name"] for i in ids} \
+            == {ids[0].split("/")[0]}
+
+    def test_partial_slice_rejected(self):
+        provider, _ = self._provider()
+        with pytest.raises(ValueError, match="slice-atomic"):
+            provider.create_node("v5e-16", count=3)
+
+    def test_terminate_any_host_deletes_slice(self):
+        provider, client = self._provider()
+        ids = provider.create_node("v5e-16", count=4)
+        provider.terminate_node(ids[2])
+        assert len(client.delete_calls) == 1
+        assert provider.non_terminated_nodes() == []
+
+    def test_slice_pg_demand_one_slice_call(self):
+        """Demand from a SLICE placement group (4x {TPU:4} bundles) makes
+        the autoscaler issue exactly ONE cloud call for one whole slice."""
+        from ray_tpu.autoscaler import StandardAutoscaler
+
+        provider, client = self._provider()
+        autoscaler = StandardAutoscaler(
+            provider,
+            provider.provider_config["node_types"])
+        launched = autoscaler.update({
+            # SLICE PG: one bundle per host of a v5e-16 slice.
+            "pending_demands": [{"TPU": 4}] * 4,
+            "nodes": [],
+        })
+        assert launched == {"v5e-16": 4}  # 4 hosts...
+        assert len(client.create_calls) == 1  # ...via ONE slice create
+        assert len(provider.non_terminated_nodes()) == 4
+        # Re-running with capacity present launches nothing new.
+        launched2 = autoscaler.update({
+            "pending_demands": [],
+            "nodes": [{"node_id": "x", "resources_available": {"TPU": 4},
+                       "resources_total": {"TPU": 4}, "idle": False}],
+        })
+        assert launched2 == {}
+        assert len(client.create_calls) == 1
