@@ -24,16 +24,20 @@ from ray_tpu.autoscaler.node_provider import NodeProvider
 
 logger = logging.getLogger(__name__)
 
-# chips per host by TPU generation (reference: tpu.py chip bounds)
-_CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5litepod": 4, "v5p": 4,
-                   "v6e": 4}
+# acceleratorType suffix units differ by generation: v2/v3 count
+# TensorCores (8/host), v4/v5p count TensorCores (2/chip x 4 chips =
+# 8/host), v5litepod/v6e count CHIPS (4/host). Reference: tpu.py's
+# chips-per-host bounds + the TPU API acceleratorType naming.
+_SUFFIX_UNITS_PER_HOST = {"v2": 8, "v3": 8, "v4": 8, "v5p": 8,
+                          "v5litepod": 4, "v6e": 4}
 
 
 def slice_hosts(accelerator_type: str) -> int:
-    """'v5litepod-16' -> 16 chips / 4 per host = 4 hosts."""
-    gen, _, chips = accelerator_type.rpartition("-")
-    per_host = _CHIPS_PER_HOST.get(gen, 4)
-    return max(1, int(chips) // per_host)
+    """'v5litepod-16' -> 16 chips / 4 per host = 4 hosts;
+    'v4-16' -> 16 cores / 8 per host = 2 hosts."""
+    gen, _, suffix = accelerator_type.rpartition("-")
+    per_host = _SUFFIX_UNITS_PER_HOST.get(gen, 4)
+    return max(1, int(suffix) // per_host)
 
 
 class GceClient:
@@ -154,13 +158,14 @@ class GCETPUNodeProvider(NodeProvider):
         created: List[str] = []
         for _ in range(count // hosts_per_slice):
             name = f"{self.cluster_name}-{node_type}-{uuid.uuid4().hex[:8]}"
-            node = self.client.create_tpu_node(
+            self.client.create_tpu_node(
                 name, accelerator_type, self.runtime_version, self.zone,
                 labels={"ray-cluster": self.cluster_name,
                         "ray-node-type": node_type})
-            created.extend(
-                f"{name}/{i}"
-                for i in range(len(node["networkEndpoints"])))
+            # Host count from the accelerator type, NOT networkEndpoints:
+            # a real create returns CREATING with no endpoints yet.
+            created.extend(f"{name}/{i}"
+                           for i in range(hosts_per_slice))
         return created
 
     def terminate_node(self, provider_node_id: str) -> None:
@@ -170,8 +175,10 @@ class GCETPUNodeProvider(NodeProvider):
         slice_name = provider_node_id.split("/", 1)[0]
         if slice_name in self._deleted:
             return
-        self._deleted.add(slice_name)
+        # Mark deleted only on success: a transient API failure must stay
+        # retryable or the slice leaks (billed) forever.
         self.client.delete_tpu_node(slice_name, self.zone)
+        self._deleted.add(slice_name)
 
     def node_tags(self, provider_node_id: str) -> Dict[str, str]:
         slice_name = provider_node_id.split("/", 1)[0]

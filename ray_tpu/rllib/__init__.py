@@ -3,7 +3,7 @@
 Parity target: the reference's rllib/ new API stack (AlgorithmConfig /
 Algorithm / EnvRunnerGroup / RLModule / Learner / LearnerGroup) with
 JAX/TPU learners and CPU env-runner actors. Algorithms: PPO (single and
-multi-agent), DQN, SAC, IMPALA, BC.
+multi-agent), APPO, DQN, SAC, CQL, IMPALA, BC, MARWIL.
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
@@ -13,6 +13,9 @@ from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.multi_agent_ppo import (MultiAgentPPO,
                                                       MultiAgentPPOConfig)
 from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
@@ -31,6 +34,12 @@ __all__ = [
     "IMPALAConfig",
     "SAC",
     "SACConfig",
+    "APPO",
+    "APPOConfig",
+    "CQL",
+    "CQLConfig",
+    "MARWIL",
+    "MARWILConfig",
     "MultiAgentPPO",
     "MultiAgentPPOConfig",
     "MultiAgentEnv",

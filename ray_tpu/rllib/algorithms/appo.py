@@ -1,0 +1,121 @@
+"""APPO — asynchronous-proximal PPO (IMPALA architecture + PPO clipping).
+
+Reference: rllib/algorithms/appo/ (PPO's clipped surrogate computed
+against V-trace-corrected advantages from decoupled behavior policies).
+The decoupling shows up as behavior log-probs recorded at sample time —
+by the time the learner consumes a rollout the weights have moved — so
+advantages come from IMPALA's V-trace targets while the policy term uses
+PPO's clip. Sampling here is synchronous-parallel (like this repo's
+IMPALA); the off-policy correction is what carries over.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ray_tpu.rllib.algorithms.impala import (IMPALA, IMPALAConfig,
+                                             IMPALALearner)
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param: float = 0.3
+        self.num_epochs = 1
+
+    @property
+    def algo_class(self):
+        return APPO
+
+
+class APPOLearner(IMPALALearner):
+    def loss_fn(self, params, batch, rng):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        # IMPALA's loss computes V-trace targets/advantages; re-derive
+        # the pieces here to swap the policy term for the PPO surrogate.
+        total_impala, metrics = super().loss_fn(params, batch, rng)
+
+        from ray_tpu.rllib.utils import sample_batch as sb
+
+        out = self.module.forward_train(params, batch[sb.OBS])
+        logits = out["action_dist_inputs"]
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch[sb.ACTIONS].astype(jnp.int32)
+        logp = jnp.take_along_axis(logp_all, actions[:, None],
+                                   axis=-1)[:, 0]
+        behavior_logp = batch[sb.ACTION_LOGP]
+        ratio = jnp.exp(logp - behavior_logp)
+        # metrics carry the V-trace pg advantage via the IMPALA loss
+        # internals; recompute the same stop-gradient advantage cheaply:
+        # policy_loss_impala = -(logp * adv).mean()  =>  adv = -d/dlogp.
+        # Instead of differentiating, re-run the shared advantage helper.
+        adv = self._vtrace_advantages(params, batch)
+        clip = cfg.get("clip_param", 0.3)
+        surrogate = jnp.minimum(
+            ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+        ppo_policy_loss = -surrogate.mean()
+        # Replace IMPALA's policy term with the clipped surrogate
+        # (subtract the old term out of the total, add the new one; the
+        # repeated forward passes are CSE'd by XLA under jit).
+        total = total_impala - metrics["policy_loss"] + ppo_policy_loss
+        metrics = dict(metrics)
+        metrics["policy_loss"] = ppo_policy_loss
+        metrics["clip_fraction"] = (
+            jnp.abs(ratio - 1.0) > clip).astype(jnp.float32).mean()
+        return total, metrics
+
+    def _vtrace_advantages(self, params, batch):
+        """V-trace pg advantages (same math as IMPALALearner.loss_fn)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.utils import sample_batch as sb
+
+        cfg = self.config
+        out = self.module.forward_train(params, batch[sb.OBS])
+        values = out["vf_preds"]
+        logits = out["action_dist_inputs"]
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch[sb.ACTIONS].astype(jnp.int32)
+        logp = jnp.take_along_axis(logp_all, actions[:, None],
+                                   axis=-1)[:, 0]
+        rho = jnp.exp(logp - batch[sb.ACTION_LOGP])
+        rho_bar = jnp.minimum(rho,
+                              cfg.get("vtrace_clip_rho_threshold", 1.0))
+        c_bar = jnp.minimum(rho,
+                            cfg.get("vtrace_clip_c_threshold", 1.0))
+        rewards = batch[sb.REWARDS]
+        boundary = batch["boundary"].astype(jnp.float32)
+        next_value_override = batch["next_value_override"]
+        gamma = cfg.get("gamma", 0.99)
+        values_next = jnp.concatenate(
+            [values[1:], jnp.zeros((1,), values.dtype)])
+        values_next = jnp.where(boundary > 0, next_value_override,
+                                values_next)
+        not_done = 1.0 - boundary
+        deltas = rho_bar * (rewards + gamma * values_next - values)
+
+        def scan_fn(carry, xs):
+            delta, c, nd = xs
+            acc = delta + gamma * c * nd * carry
+            return acc, acc
+
+        _, vs_minus_v = jax.lax.scan(
+            scan_fn, jnp.zeros((), values.dtype),
+            (deltas, c_bar, not_done), reverse=True)
+        vs = vs_minus_v + values
+        vs_next = jnp.concatenate([vs[1:], jnp.zeros((1,), vs.dtype)])
+        vs_next = jnp.where(boundary > 0, next_value_override, vs_next)
+        adv = rho_bar * (rewards + gamma * vs_next - values)
+        return jax.lax.stop_gradient(adv)
+
+
+class APPO(IMPALA):
+    config_class = APPOConfig
+    learner_class = APPOLearner
+
+    def training_step(self) -> Dict:
+        return super().training_step()

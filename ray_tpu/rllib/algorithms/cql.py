@@ -1,0 +1,134 @@
+"""CQL — conservative Q-learning for offline continuous control.
+
+Reference: rllib/algorithms/cql/ (Kumar et al. 2020 on top of SAC: the
+critic loss adds a conservative regularizer
+alpha_prime * (logsumexp_a Q(s, a) - Q(s, a_data)) that pushes down
+Q-values of out-of-distribution actions, so the learned policy cannot
+exploit extrapolation error in the fixed dataset). The logsumexp is
+estimated from uniform + current-policy action samples, all inside the
+one jit-compiled SAC update step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.sac import SACConfig, SACLearner
+from ray_tpu.rllib.core.rl_module import SACModule
+from ray_tpu.rllib.utils import sample_batch as sb
+
+
+class CQLConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.offline_dataset: Any = None
+        self.cql_alpha: float = 1.0       # conservative penalty weight
+        self.cql_n_actions: int = 4       # samples for the logsumexp
+        self.num_env_runners = 0
+        self.updates_per_step = 8
+
+    def offline_data(self, *, dataset=None, **kwargs) -> "CQLConfig":
+        if dataset is not None:
+            self.offline_dataset = dataset
+        self._apply(kwargs)
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = super().to_dict()
+        d.pop("offline_dataset", None)
+        return d
+
+    @property
+    def algo_class(self):
+        return CQL
+
+
+class CQLLearner(SACLearner):
+    def loss_fn(self, params, batch, rng):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        module = self.module
+        sac_loss, metrics = super().loss_fn(params, batch, rng)
+
+        # --- conservative penalty on both critics ---
+        obs = batch[sb.OBS]
+        actions = batch[sb.ACTIONS]
+        if actions.ndim == 1:
+            actions = actions[:, None]
+        n = cfg.get("cql_n_actions", 4)
+        b = obs.shape[0]
+        act_dim = module.act_dim
+        rng_u, rng_pi = jax.random.split(jax.random.fold_in(rng, 7))
+        lo = module.action_center - module.action_scale
+        hi = module.action_center + module.action_scale
+        rand_a = jax.random.uniform(rng_u, (n, b, act_dim),
+                                    minval=lo, maxval=hi)
+        pi_keys = jax.random.split(rng_pi, n)
+        # Detach: the penalty regularizes the CRITICS; without the stop,
+        # minimizing logsumexp Q(s, a_pi) would train the actor to pick
+        # low-Q actions, fighting the SAC actor loss.
+        pi_a = jax.lax.stop_gradient(
+            jnp.stack([module.sample_action(params, obs, k)[0]
+                       for k in pi_keys]))
+        all_a = jnp.concatenate([rand_a, pi_a])       # [2n, B, A]
+        obs_rep = jnp.broadcast_to(obs, (2 * n,) + obs.shape)
+        q1_all, q2_all = module.q_values(
+            params, obs_rep.reshape(2 * n * b, -1),
+            all_a.reshape(2 * n * b, act_dim))
+        q1_all = q1_all.reshape(2 * n, b)
+        q2_all = q2_all.reshape(2 * n, b)
+        q1_data, q2_data = module.q_values(params, obs, actions)
+        gap1 = jax.scipy.special.logsumexp(q1_all, axis=0) - q1_data
+        gap2 = jax.scipy.special.logsumexp(q2_all, axis=0) - q2_data
+        cql_penalty = (gap1.mean() + gap2.mean())
+        alpha_prime = cfg.get("cql_alpha", 1.0)
+        total = sac_loss + alpha_prime * cql_penalty
+        metrics = dict(metrics)
+        metrics["cql_penalty"] = cql_penalty
+        metrics["conservative_gap"] = gap1.mean()
+        return total, metrics
+
+
+class CQL(Algorithm):
+    config_class = CQLConfig
+    learner_class = CQLLearner
+    module_class = SACModule
+
+    def setup(self, config) -> None:
+        cfg = config if isinstance(config, CQLConfig) else \
+            self.config_class().update_from_dict(dict(config or {}))
+        if cfg.num_learners != 0:
+            raise ValueError("CQL uses a local learner")
+        super().setup(cfg)
+        ds = self.config.offline_dataset
+        if ds is None:
+            raise ValueError("CQLConfig.offline_data(dataset=...) required")
+        self._data = {
+            sb.OBS: np.asarray(ds["obs"], np.float32),
+            sb.ACTIONS: np.asarray(ds["actions"], np.float32),
+            sb.REWARDS: np.asarray(ds["rewards"], np.float32),
+            sb.NEXT_OBS: np.asarray(ds["next_obs"], np.float32),
+            sb.TERMINATEDS: np.asarray(ds["terminateds"], bool),
+        }
+        self._rng = np.random.default_rng(self.config.seed)
+
+    @property
+    def _learner(self) -> CQLLearner:
+        return self.learner_group._local
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n = len(self._data[sb.OBS])
+        metrics: Dict[str, Any] = {}
+        for _ in range(cfg.updates_per_step):
+            idx = self._rng.integers(0, n, cfg.train_batch_size)
+            batch = {k: v[idx] for k, v in self._data.items()}
+            m = self._learner.update_sac(batch)
+            self._learner.sync_target(cfg.tau)
+            metrics.update(m)
+        return metrics
