@@ -15,6 +15,7 @@ from ray_tpu.serve.api import (
     start,
     status,
 )
+from ray_tpu.serve.asgi import HTTPResponse
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, \
     HTTPOptions
@@ -38,6 +39,7 @@ __all__ = [
     "AutoscalingConfig",
     "Deployment",
     "DeploymentConfig",
+    "HTTPResponse",
     "DeploymentHandle",
     "DeploymentResponse",
     "DeploymentResponseGenerator",

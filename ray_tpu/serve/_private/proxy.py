@@ -157,6 +157,10 @@ class ProxyActor:
                 if controller is None:
                     controller = get_actor(SERVE_CONTROLLER_NAME,
                                            namespace=SERVE_NAMESPACE)
+                    # A crash-recovered controller numbers snapshots from
+                    # scratch: a stale high-water mark would make this
+                    # long-poll wait forever (routes never update).
+                    snapshot_id = -1
                 ref = controller.listen_for_change.remote(
                     {ROUTE_TABLE_KEY: snapshot_id})
                 updates = ray_tpu.get(ref, timeout=60)
@@ -273,6 +277,11 @@ class ProxyActor:
     def _to_response(result):
         from aiohttp import web
 
+        from ray_tpu.serve.asgi import HTTPResponse
+
+        if isinstance(result, HTTPResponse):
+            return web.Response(body=result.body, status=result.status,
+                                headers=result.headers)
         if isinstance(result, (bytes, bytearray)):
             return web.Response(body=bytes(result))
         if isinstance(result, str):

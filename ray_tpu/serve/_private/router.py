@@ -19,6 +19,7 @@ import ray_tpu
 from ray_tpu.core.actor import get_actor
 from ray_tpu.serve._private.common import (RequestMetadata,
                                            RunningReplicaInfo,
+                                           SERVE_CONTROLLER_NAME,
                                            SERVE_NAMESPACE)
 
 logger = logging.getLogger(__name__)
@@ -128,12 +129,21 @@ class Router:
         key = replicas_key(self._app_name, self._deployment)
         while not self._stopped:
             try:
+                if self._controller is None:
+                    # Controller died (crash recovery spawns a NEW actor
+                    # under the same name): re-resolve, and reset the
+                    # snapshot id — the fresh incarnation numbers its
+                    # snapshots from scratch.
+                    self._controller = get_actor(
+                        SERVE_CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+                    self._snapshot_id = -1
                 ref = self._controller.listen_for_change.remote(
                     {key: self._snapshot_id})
                 updates = ray_tpu.get(ref, timeout=60)
             except Exception:
                 if self._stopped:
                     return
+                self._controller = None
                 time.sleep(1.0)
                 continue
             if key in (updates or {}):

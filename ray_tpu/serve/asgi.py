@@ -1,0 +1,112 @@
+"""ASGI ingress — run any ASGI application inside a deployment.
+
+Reference: python/ray/serve/api.py `@serve.ingress(app)` +
+_private/http_util.py (the ASGI adapter that replays the proxied request
+into the app and captures its response). Framework-agnostic: anything
+implementing the ASGI 3.0 callable protocol works — FastAPI/Starlette
+when installed, or hand-written apps in hermetic images.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+from urllib.parse import urlencode
+
+from ray_tpu.serve._private.proxy import ServeRequest
+
+
+class HTTPResponse:
+    """Structured HTTP response a deployment may return (the proxy maps
+    it to status/headers/body; plain bytes/str/json returns still work)."""
+
+    def __init__(self, body: bytes = b"", status: int = 200,
+                 headers: Optional[Dict[str, str]] = None):
+        self.body = body
+        self.status = status
+        self.headers = dict(headers or {})
+
+    def __reduce__(self):
+        return (HTTPResponse, (self.body, self.status, self.headers))
+
+
+def _scope_of(request: ServeRequest) -> Dict[str, Any]:
+    return {
+        "type": "http",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "http_version": "1.1",
+        "method": request.method,
+        "scheme": "http",
+        "path": request.path,
+        "raw_path": request.path.encode(),
+        "root_path": (request.route_prefix
+                      if request.route_prefix != "/" else ""),
+        "query_string": urlencode(request.query_params).encode(),
+        "headers": [(k.lower().encode(), v.encode())
+                    for k, v in request.headers.items()],
+        "client": ("127.0.0.1", 0),
+        "server": ("127.0.0.1", 0),
+    }
+
+
+async def run_asgi(app: Callable, request: ServeRequest) -> HTTPResponse:
+    """Replay the proxied request into `app`, capture its response."""
+    body_sent = [False]
+
+    async def receive():
+        if body_sent[0]:
+            return {"type": "http.disconnect"}
+        body_sent[0] = True
+        return {"type": "http.request", "body": request.body or b"",
+                "more_body": False}
+
+    status = [500]
+    headers: List = []
+    chunks: List[bytes] = []
+
+    async def send(message):
+        if message["type"] == "http.response.start":
+            status[0] = message["status"]
+            headers.extend(message.get("headers", []))
+        elif message["type"] == "http.response.body":
+            chunks.append(bytes(message.get("body", b"")))
+
+    await app(_scope_of(request), receive, send)
+    return HTTPResponse(
+        body=b"".join(chunks),
+        status=status[0],
+        headers={k.decode(): v.decode() for k, v in headers})
+
+
+def ingress(app: Any):
+    """Class decorator: route the deployment's HTTP traffic through an
+    ASGI app (reference: serve.ingress).
+
+    Use below @serve.deployment::
+
+        app = MyAsgiApp()          # any ASGI-3 callable
+
+        @serve.deployment
+        @serve.ingress(app)
+        class Frontend:
+            ...
+
+    The app sees the standard ASGI scope (root_path = the deployment's
+    route prefix). Decorating a class directly (``@serve.ingress`` with
+    no app) stays an identity marker for backward compatibility.
+    """
+    if isinstance(app, type):  # legacy identity-marker usage
+        return app
+
+    def decorator(cls: type) -> type:
+        class ASGIIngress(cls):
+            async def __call__(self, request: ServeRequest):
+                return await run_asgi(app, request)
+
+        ASGIIngress.__name__ = cls.__name__
+        ASGIIngress.__qualname__ = getattr(cls, "__qualname__",
+                                           cls.__name__)
+        ASGIIngress.__module__ = cls.__module__
+        ASGIIngress.__serve_asgi_app__ = app
+        return ASGIIngress
+
+    return decorator

@@ -217,8 +217,9 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
     return wrap
 
 
-def ingress(app_builder):
-    """Marker passthrough for ASGI-style ingress classes (reference:
-    serve.ingress). The TPU-native proxy speaks plain dict requests, so
-    this is an identity decorator kept for API parity."""
-    return app_builder
+def ingress(app):
+    """Route a deployment's HTTP traffic through an ASGI app (reference:
+    serve.ingress; implementation in ray_tpu.serve.asgi)."""
+    from ray_tpu.serve.asgi import ingress as _asgi_ingress
+
+    return _asgi_ingress(app)

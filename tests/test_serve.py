@@ -477,3 +477,69 @@ def test_streaming_response(ray_start_regular):
                             method_name="__call__").remote(0)
     assert list(single) == []
     serve.delete("stream_app")
+
+
+def test_asgi_ingress(ray_start_regular):
+    """@serve.ingress(app): any ASGI-3 callable serves the deployment's
+    HTTP traffic with full status/header/routing control (reference:
+    serve.ingress over FastAPI — framework-agnostic at the ASGI layer)."""
+    import urllib.error
+    import urllib.request
+
+    class TinyRouter:
+        """Hand-written ASGI app (no framework needed)."""
+
+        async def __call__(self, scope, receive, send):
+            assert scope["type"] == "http"
+            msg = await receive()
+            body = msg.get("body", b"")
+            path = scope["path"]
+            if path.endswith("/echo"):
+                status, out = 200, b"echo:" + body
+            elif path.endswith("/teapot"):
+                status, out = 418, b"short and stout"
+            else:
+                status, out = 404, b"nope"
+            await send({"type": "http.response.start", "status": status,
+                        "headers": [(b"x-router", b"tiny"),
+                                    (b"content-type", b"text/plain")]})
+            await send({"type": "http.response.body", "body": out})
+
+    @serve.deployment
+    @serve.ingress(TinyRouter())
+    class Frontend:
+        pass
+
+    serve.run(Frontend.bind(), name="asgiapp", route_prefix="/asgi")
+    # The detached proxy keeps whatever port an earlier test configured:
+    # discover it instead of assuming the default.
+    from ray_tpu.core.actor import get_actor
+    from ray_tpu.serve._private.common import SERVE_NAMESPACE
+
+    proxy = get_actor("SERVE_PROXY", namespace=SERVE_NAMESPACE)
+    base = ray_tpu.get(proxy.ready.remote()) + "/asgi"
+
+    import time as _time
+
+    deadline = _time.time() + 15
+    while True:  # the proxy learns routes via an async long-poll
+        req = urllib.request.Request(f"{base}/echo", data=b"ping",
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+                assert resp.headers["x-router"] == "tiny"
+                assert resp.read() == b"echo:ping"
+            break
+        except urllib.error.HTTPError as e:
+            if e.code != 404 or _time.time() > deadline:
+                raise
+            _time.sleep(0.2)
+
+    try:
+        urllib.request.urlopen(f"{base}/teapot", timeout=30)
+        assert False, "expected 418"
+    except urllib.error.HTTPError as e:
+        assert e.code == 418
+        assert e.read() == b"short and stout"
+    serve.delete("asgiapp")
