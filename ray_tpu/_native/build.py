@@ -26,12 +26,12 @@ def lib_path(name: str) -> str:
     return os.path.join(_BUILD_DIR, f"lib{name}.so")
 
 
-def ensure_built(name: str) -> str:
+def ensure_built(name: str, force: bool = False) -> str:
     """Compile lib<name>.so if missing or stale; return its path."""
     sources = [os.path.join(_DIR, s) for s in _LIBS[name]]
     out = lib_path(name)
     with _LOCK:
-        if os.path.exists(out):
+        if not force and os.path.exists(out):
             src_mtime = max(os.path.getmtime(s) for s in sources)
             if os.path.getmtime(out) >= src_mtime:
                 return out
@@ -42,3 +42,14 @@ def ensure_built(name: str) -> str:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, out)
     return out
+
+
+def load_lib(name: str):
+    """ensure_built + ctypes.CDLL, recompiling once if the cached .so fails
+    to load (e.g. an artifact built on a different platform/glibc)."""
+    import ctypes
+
+    try:
+        return ctypes.CDLL(ensure_built(name))
+    except OSError:
+        return ctypes.CDLL(ensure_built(name, force=True))

@@ -10,9 +10,12 @@ any other scheme -> fsspec when available, or a registered plugin).
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Dict, Type
 from urllib.parse import urlparse
+
+logger = logging.getLogger(__name__)
 
 
 class ExternalStorage:
@@ -119,8 +122,12 @@ def _load_env_plugins() -> None:
             mod_name, _, attr = target.partition(":")
             mod = importlib.import_module(mod_name.strip())
             _SCHEME_REGISTRY[scheme] = getattr(mod, attr.strip())
-        except Exception:
-            pass
+        except Exception as e:
+            # A typo here would otherwise silently fall through to
+            # FsspecStorage and nothing would spill under pressure.
+            logger.warning(
+                "spill plugin %r (%s) failed to load: %s: %s",
+                scheme, target.strip(), type(e).__name__, e)
 
 
 def storage_for_path(path: str) -> ExternalStorage:

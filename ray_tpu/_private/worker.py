@@ -59,8 +59,25 @@ class Worker:
         ref_list = [refs] if single else list(refs)
         if any(isinstance(r, CompiledDAGRef) for r in ref_list):
             # Compiled-DAG results read their channels directly
-            # (reference: ray.get on CompiledDAGRef).
-            values = [r.get(timeout) for r in ref_list]
+            # (reference: ray.get on CompiledDAGRef).  Mixed lists resolve
+            # each kind via its own path under one shared deadline; the
+            # ObjectRef subset keeps the batched fast path.
+            import time as _time
+
+            deadline = (_time.monotonic() + timeout
+                        if timeout is not None else None)
+
+            def remaining():
+                if deadline is None:
+                    return None
+                return max(deadline - _time.monotonic(), 0.001)
+
+            obj_refs = [r for r in ref_list if not isinstance(r,
+                                                              CompiledDAGRef)]
+            obj_values = iter(self.get(obj_refs, remaining())
+                              if obj_refs else ())
+            values = [r.get(remaining()) if isinstance(r, CompiledDAGRef)
+                      else next(obj_values) for r in ref_list]
             return values[0] if single else values
         for r in ref_list:
             if not isinstance(r, ObjectRef):

@@ -63,6 +63,7 @@ class Connection:
     # tick (one write syscall for a burst of messages); anything larger
     # flushes immediately and awaits transport drain for backpressure.
     CORK_BYTES = 256 * 1024
+    DRAIN_BYTES = 4 * 1024 * 1024  # small-frame backpressure high-water mark
 
     def __init__(
         self,
@@ -151,6 +152,19 @@ class Connection:
                 await self.writer.drain()
             return
         self.send_nowait(data)
+        # Sustained bursts of small frames to a slow peer must not buffer
+        # unboundedly: once the transport's write buffer crosses the
+        # high-water mark, fall back to drain()'s backpressure.
+        try:
+            buffered = self.writer.transport.get_write_buffer_size()
+        except Exception:
+            buffered = 0
+        if buffered + len(self._out) >= self.DRAIN_BYTES:
+            async with self._write_lock:
+                if self._closed:
+                    raise ConnectionLost(f"connection {self.name} closed")
+                self._flush()
+                await self.writer.drain()
 
     def send_nowait(self, data: bytes) -> None:
         """Queue a packed frame; flushed once per loop tick. Writes from

@@ -15,7 +15,7 @@ import os
 import weakref
 from typing import Optional, Tuple
 
-from ray_tpu._native.build import ensure_built
+from ray_tpu._native.build import load_lib
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.serialization import SerializedObject
 
@@ -34,7 +34,7 @@ _lib = None
 def _load():
     global _lib
     if _lib is None:
-        lib = ctypes.CDLL(ensure_built("ray_tpu_store"))
+        lib = load_lib("ray_tpu_store")
         lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                          ctypes.c_uint32]
         lib.shm_store_create.restype = ctypes.c_int

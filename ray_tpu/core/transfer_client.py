@@ -11,7 +11,7 @@ from __future__ import annotations
 import ctypes
 from typing import Optional
 
-from ray_tpu._native.build import ensure_built
+from ray_tpu._native.build import load_lib
 
 _lib = None
 
@@ -19,8 +19,7 @@ _lib = None
 def _load():
     global _lib
     if _lib is None:
-        path = ensure_built("ray_tpu_transfer")
-        lib = ctypes.CDLL(path)
+        lib = load_lib("ray_tpu_transfer")
         lib.obj_transfer_serve.argtypes = [ctypes.c_char_p,
                                            ctypes.POINTER(ctypes.c_void_p)]
         lib.obj_transfer_serve.restype = ctypes.c_int

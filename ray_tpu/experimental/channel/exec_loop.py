@@ -53,14 +53,28 @@ def run_dag_loop(instance: Any, plan: Dict) -> int:
                 for path, reader_id in plan["in_chans"]]
     out_chans = [Channel(path) for path in plan["out_chans"]]
     steps = plan["steps"]
+
+    # Reads and writes are interleaved in plan order: each input channel is
+    # read just before its earliest consuming step, and each step's outputs
+    # are written immediately after it runs.  This keeps actor-revisit DAGs
+    # (A.f1 -> B.g -> A.f2) live: A publishes f1's result before blocking on
+    # the channel that B feeds.
+    first_use: Dict[int, int] = {}
+    for si, step in enumerate(steps):
+        for spec in list(step["args"]) + list(step["kwargs"].values()):
+            if spec[0] == "chan" and spec[1] not in first_use:
+                first_use[spec[1]] = si
+    reads_at: Dict[int, List[int]] = {}
+    for ci in range(len(in_chans)):
+        reads_at.setdefault(first_use.get(ci, 0), []).append(ci)
+
     consts = {}
     iterations = 0
     try:
         while True:
-            try:
-                inputs = [c.read() for c in in_chans]
-            except ChannelClosed:
-                return iterations
+            inputs: List[Any] = [None] * len(in_chans)
+            local_results: List[Any] = []
+            error = None
 
             def resolve(spec):
                 kind, idx = spec
@@ -72,28 +86,29 @@ def run_dag_loop(instance: Any, plan: Dict) -> int:
                     consts[idx] = ser.loads(plan["consts"][idx])
                 return consts[idx]
 
-            local_results: List[Any] = []
-            error = next((v for v in inputs
-                          if isinstance(v, _ErrorEnvelope)), None)
-            for step in steps:
+            for si, step in enumerate(steps):
+                for ci in reads_at.get(si, ()):
+                    inputs[ci] = in_chans[ci].read()
+                    if error is None and isinstance(inputs[ci],
+                                                    _ErrorEnvelope):
+                        error = inputs[ci]
                 if error is not None:
-                    local_results.append(error)
-                    continue
-                try:
-                    args = [resolve(a) for a in step["args"]]
-                    kwargs = {k: resolve(v)
-                              for k, v in step["kwargs"].items()}
-                    result = getattr(instance, step["method"])(*args,
-                                                               **kwargs)
-                except Exception as e:  # travels to consumers, loop lives on
-                    import traceback
-
-                    error = _ErrorEnvelope(ser.RayTaskError(
-                        step["method"], traceback.format_exc(), repr(e),
-                        cause=e if _picklable(e) else None))
                     result = error
+                else:
+                    try:
+                        args = [resolve(a) for a in step["args"]]
+                        kwargs = {k: resolve(v)
+                                  for k, v in step["kwargs"].items()}
+                        result = getattr(instance, step["method"])(*args,
+                                                                   **kwargs)
+                    except Exception as e:  # travels on, loop lives
+                        import traceback
+
+                        error = _ErrorEnvelope(ser.RayTaskError(
+                            step["method"], traceback.format_exc(), repr(e),
+                            cause=e if _picklable(e) else None))
+                        result = error
                 local_results.append(result)
-            for step, result in zip(steps, local_results):
                 for out_idx in step["outs"]:
                     out_chans[out_idx].write(result)
             iterations += 1

@@ -40,9 +40,9 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    from ray_tpu._native.build import ensure_built
+    from ray_tpu._native.build import load_lib
 
-    lib = ctypes.CDLL(ensure_built("ray_tpu_channel"))
+    lib = load_lib("ray_tpu_channel")
     lib.chan_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                 ctypes.c_uint32, ctypes.c_uint32]
     lib.chan_create.restype = ctypes.c_int

@@ -66,3 +66,40 @@ def test_compiled_dag_repeats(ray_start_regular):
     assert ray_tpu.get(compiled.execute(0)) == 2
     assert ray_tpu.get(compiled.execute(10)) == 12
     compiled.teardown()
+
+
+def test_get_mixed_dag_and_object_refs(ray_start_regular):
+    with InputNode() as inp:
+        dag = _inc.bind(_inc.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        dag_ref = compiled.execute(0)
+        obj_ref = _inc.remote(41)
+        assert ray_tpu.get([dag_ref, obj_ref], timeout=20) == [2, 42]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_actor_revisit(ray_start_regular):
+    """A.f -> B.f -> A.f: A must publish its first result before blocking
+    on the channel B feeds (regression: the exec loop used to read all
+    input channels up front and deadlock on this shape)."""
+
+    @ray_tpu.remote
+    class Adder:
+        def __init__(self, k):
+            self.k = k
+
+        def add(self, x):
+            return x + self.k
+
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    with InputNode() as inp:
+        dag = a.add.bind(b.add.bind(a.add.bind(inp)))
+    compiled = dag.experimental_compile()
+    try:
+        assert ray_tpu.get(compiled.execute(0), timeout=20) == 12
+        assert ray_tpu.get(compiled.execute(5), timeout=20) == 17
+    finally:
+        compiled.teardown()
