@@ -30,6 +30,7 @@ from ray_tpu._private.worker import (
     nodes,
 )
 from ray_tpu._private.api import remote, method
+from ray_tpu.core.generator import ObjectRefGenerator
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.actor import ActorHandle, ActorClass
 
@@ -51,6 +52,7 @@ __all__ = [
     "available_resources",
     "nodes",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorHandle",
     "ActorClass",
     "__version__",

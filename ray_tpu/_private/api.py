@@ -34,6 +34,7 @@ class RemoteFunction:
         self._function = fn
         self._opts = opts
         self._descriptor = None
+        self._descriptor_session = None  # session token of the export
         self.__name__ = getattr(fn, "__name__", "remote_function")
         self.__doc__ = getattr(fn, "__doc__", None)
 
@@ -46,18 +47,23 @@ class RemoteFunction:
         merged = {**self._opts, **opts}
         new = RemoteFunction(self._function, merged)
         new._descriptor = self._descriptor
+        new._descriptor_session = self._descriptor_session
         return new
 
     def remote(self, *args, **kwargs):
         from ray_tpu._private.worker import global_worker
 
         worker = global_worker()
-        if self._descriptor is None:
+        # Module-level remote functions outlive clusters: re-export when
+        # the session changed (a fresh GCS has an empty function table).
+        if self._descriptor is None or \
+                self._descriptor_session != worker.core.worker_id.binary():
             self._descriptor = worker.export(self._function)
+            self._descriptor_session = worker.core.worker_id.binary()
         opts = _resolve_strategy(self._opts)
         refs = worker.submit_task(self._descriptor, args, kwargs, opts)
         num_returns = opts.get("num_returns", 1)
-        if num_returns == 1:
+        if num_returns == 1 or num_returns == "streaming":
             return refs[0]
         return refs
 

@@ -37,6 +37,8 @@ from ray_tpu.core import serialization as ser
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
                               WorkerID)
+from ray_tpu.core.generator import (STREAMING, ObjectRefGenerator,
+                                    StreamState)
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.shm_client import ShmClient, StoreFullError
 from ray_tpu.core.task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK,
@@ -160,6 +162,11 @@ class CoreWorker:
 
             _sys.setswitchinterval(config.gil_switch_interval_s)
         self._pending_tasks: Dict[TaskID, TaskSpec] = {}
+        self._streams: Dict[TaskID, StreamState] = {}
+        self._stream_cancels: set = set()  # executor-side cancel flags
+        self._stream_producing: set = set()  # tasks mid-produce-loop
+        self._stream_acked: Dict[TaskID, int] = {}  # consumer progress
+        self._stream_ack_events: Dict[TaskID, asyncio.Event] = {}
         self._task_events: List[dict] = []
         # Events are recorded from user threads (submit_task_sync) AND
         # the io loop; the swap-on-flush must be atomic across them.
@@ -491,10 +498,20 @@ class CoreWorker:
         conn = self._peer_conns.get(address)
         if conn is None or conn.closed:
             host, port = address.rsplit(":", 1)
+            # Peer conns are bidirectional: the remote end may send
+            # notifies back over them (e.g. stream_ack / cancel_stream
+            # from a streaming consumer to its producer).
             conn = await rpc.connect(host, int(port), name=f"peer:{address}",
-                                     handler=None, timeout=5.0)
+                                     handler=self._dispatch_peer,
+                                     timeout=5.0)
             self._peer_conns[address] = conn
         return conn
+
+    async def _dispatch_peer(self, method: str, data, conn):
+        fn = getattr(self, "handle_" + method, None)
+        if fn is None:
+            raise rpc.RpcError(f"no handler for {method}")
+        return await fn(data, conn)
 
     # ------------------------------------------------------------- refcount
     def _on_object_out_of_scope(self, object_id: ObjectID) -> None:
@@ -609,15 +626,20 @@ class CoreWorker:
         Submission failures surface on get() via error-envelope returns.
         """
         spec = self._build_spec(NORMAL_TASK, descriptor, args, kwargs, opts)
-        refs = [ObjectRef(oid, owner_address=self.address)
-                for oid in spec.return_ids()]
-        for oid in spec.return_ids():
-            self.reference_counter.add_owned_object(
-                oid, lineage_task=spec if self.config.lineage_enabled else None)
+        if spec.is_streaming:
+            self._streams[spec.task_id] = StreamState()
+            out: list = [ObjectRefGenerator(spec.task_id, self)]
+        else:
+            out = [ObjectRef(oid, owner_address=self.address)
+                   for oid in spec.return_ids()]
+            for oid in spec.return_ids():
+                self.reference_counter.add_owned_object(
+                    oid,
+                    lineage_task=spec if self.config.lineage_enabled else None)
         self._pending_tasks[spec.task_id] = spec
         self._record_task_event(spec, "PENDING")
         self.loop.call_soon_threadsafe(self._enqueue_for_lease, spec)
-        return refs
+        return out
 
     async def submit_task(self, descriptor: FunctionDescriptor,
                           args: tuple, kwargs: dict, opts: dict
@@ -655,6 +677,8 @@ class CoreWorker:
             else:
                 wire_args.append((ARG_VALUE, ser.dumps(arg), None))
         num_returns = opts.get("num_returns", 1)
+        if num_returns == "streaming":
+            num_returns = STREAMING
         strategy = opts.get("scheduling_strategy")
         pg_id = None
         bundle = -1
@@ -878,6 +902,11 @@ class CoreWorker:
         self._pending_tasks.pop(spec.task_id, None)
         self._record_task_event(
             spec, "FINISHED" if reply.get("status") == "ok" else "FAILED")
+        if spec.is_streaming:
+            self._finish_stream(spec.task_id,
+                                reply.get("stream_total", 0),
+                                reply.get("stream_error"))
+            return
         for oid_b, inline in reply.get("returns", []):
             oid = ObjectID(oid_b)
             if inline is None:
@@ -895,9 +924,222 @@ class CoreWorker:
         self._pending_tasks.pop(spec.task_id, None)
         self._record_task_event(spec, "FAILED")
         blob = ser.dumps(error)
+        if spec.is_streaming:
+            st = self._streams.get(spec.task_id)
+            self._finish_stream(
+                spec.task_id,
+                max(st.received) + 1 if st and st.received else 0, blob)
         for oid in spec.return_ids():
             self.memory_store.put_in_loop(oid, blob)
         self._release_task_arg_refs(spec)
+
+    # ------------------------------------------------- streaming generators
+    async def handle_stream_item(self, data, conn) -> bool:
+        """Caller-side: one yielded value reported by the executing worker
+        (reference: the streaming-generator return protocol around
+        python/ray/_raylet.pyx:277)."""
+        self._accept_stream_item(data, conn)
+        return True
+
+    def _accept_stream_item(self, item: dict, conn=None) -> None:
+        task_id = TaskID(item["task_id"])
+        st = self._streams.get(task_id)
+        if st is None or getattr(st, "released", False):
+            # Unknown or abandoned stream: tell the producer to stop and
+            # flush its backpressure window so it can't stall forever.
+            if conn is not None:
+                self._loop_notify(conn, "cancel_stream",
+                                  {"task_id": item["task_id"]})
+                self._loop_notify(conn, "stream_ack", {
+                    "task_id": item["task_id"], "consumed": 1 << 62})
+            return
+        if conn is not None:
+            st.producer_conn = conn  # ack/cancel channel back to producer
+        index = item["index"]
+        with st.cond:
+            if index in st.received:
+                # Duplicate (task retry re-ran the generator): re-ack the
+                # consumer's cursor so the FRESH producer's backpressure
+                # window reflects what was already consumed — otherwise a
+                # retry after >=bp_limit consumed items deadlocks.
+                if conn is not None:
+                    self._loop_notify(conn, "stream_ack", {
+                        "task_id": item["task_id"],
+                        "consumed": st.next_index})
+                return
+            oid = ObjectID.for_task_return(task_id, index)
+            self.reference_counter.add_owned_object(oid)
+            if item.get("data") is not None:
+                self.memory_store.put_in_loop(oid, item["data"])
+            else:
+                self.memory_store.mark_in_plasma(oid)
+            st.received.add(index)
+            # The ref is created here (loop thread) so the stream holds a
+            # live local ref until the consumer takes it or releases the
+            # generator.
+            st.ready[index] = ObjectRef(oid, owner_address=self.address)
+            st.cond.notify_all()
+
+    def _loop_notify(self, conn, method: str, data: dict) -> None:
+        """Fire-and-forget notify from the loop thread."""
+
+        async def go():
+            try:
+                await conn.notify(method, data)
+            except Exception:
+                pass
+
+        self.loop.create_task(go())
+
+    def _finish_stream(self, task_id: TaskID, total: int,
+                       error_blob: Optional[bytes]) -> None:
+        st = self._streams.get(task_id)
+        if st is None:
+            return
+        with st.cond:
+            st.total = total
+            if error_blob is not None:
+                st.error_blob = error_blob
+            st.cond.notify_all()
+        if getattr(st, "released", False):
+            # Abandoned stream's task finished: reap the state now.
+            self._streams.pop(task_id, None)
+
+    def stream_next(self, task_id: TaskID, timeout: Optional[float] = None):
+        """Blocking next-ref for ObjectRefGenerator (any thread)."""
+        st = self._streams.get(task_id)
+        if st is None:
+            raise StopIteration
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with st.cond:
+            while True:
+                i = st.next_index
+                if i in st.received:
+                    st.next_index += 1
+                    ref = st.ready.pop(i)
+                    self._send_stream_ack(st, task_id, i + 1)
+                    return ref
+                if st.total is not None and i >= st.total:
+                    if st.error_blob is not None and not st.error_raised:
+                        st.error_raised = True
+                        raise ser.loads(st.error_blob)
+                    raise StopIteration
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise ser.GetTimeoutError(
+                        f"stream item {i} of task {task_id.hex()[:8]} not "
+                        f"ready within {timeout}s")
+                st.cond.wait(min(remaining, 1.0) if remaining else 1.0)
+
+    def stream_completed(self, task_id: TaskID) -> bool:
+        st = self._streams.get(task_id)
+        if st is None:
+            return True
+        with st.cond:
+            return st.total is not None and st.next_index >= st.total and \
+                not (st.error_blob and not st.error_raised)
+
+    def _send_stream_ack(self, st: StreamState, task_id: TaskID,
+                         consumed: int) -> None:
+        """Fire-and-forget consumer-progress report to the producer — it
+        advances the producer-side backpressure window."""
+        conn = getattr(st, "producer_conn", None)
+        payload = {"task_id": task_id.binary(), "consumed": consumed}
+        if conn is None or conn.closed:
+            # Local produce loop (producer == consumer process).
+            self.loop.call_soon_threadsafe(
+                self._note_stream_ack, task_id, consumed)
+            return
+
+        async def go():
+            try:
+                await conn.notify("stream_ack", payload)
+            except Exception:
+                pass
+
+        if self.loop.is_running():
+            asyncio.run_coroutine_threadsafe(go(), self.loop)
+
+    def _note_stream_ack(self, task_id: TaskID, consumed: int) -> None:
+        if task_id not in self._stream_producing:
+            return  # late ack for a finished stream: don't grow state
+        if consumed > self._stream_acked.get(task_id, 0):
+            self._stream_acked[task_id] = consumed
+        ev = self._stream_ack_events.get(task_id)
+        if ev is not None:
+            ev.set()
+
+    async def handle_stream_ack(self, data, conn) -> bool:
+        """Producer-side: consumer progressed; open the backpressure
+        window."""
+        self._note_stream_ack(TaskID(data["task_id"]), data["consumed"])
+        return True
+
+    def release_stream(self, task_id: TaskID) -> None:
+        """Drop a generator's unconsumed item refs and tell the producer
+        to stop + flush its backpressure window (via cancel_stream_sync,
+        which routes over the producer conn or the actor connection). If
+        neither channel exists yet (normal task, no item landed), the
+        state stays marked `released` so the FIRST item report triggers
+        the cancel-back, and _finish_stream reaps it."""
+        st = self._streams.get(task_id)
+        if st is None:
+            return
+        with st.cond:
+            st.released = True
+            st.ready.clear()  # ObjectRef __del__ drops the local refs
+            st.cond.notify_all()
+        if st.total is not None:
+            self._streams.pop(task_id, None)  # already finished: reap now
+        else:
+            self.cancel_stream_sync(task_id)
+
+    def cancel_stream_sync(self, task_id: TaskID) -> None:
+        """Caller-side: ask the producer to stop yielding (cooperative).
+        Reference: ray.cancel on a streaming generator task. Routed over
+        the producer's item-report connection when one exists (any task
+        type), else the actor connection (stream not started yet)."""
+        st = self._streams.get(task_id)
+        if st is None:
+            return
+        producer_conn = getattr(st, "producer_conn", None)
+        actor_id = getattr(st, "actor_id", None)
+        payload = {"task_id": task_id.binary()}
+
+        async def go():
+            try:
+                conn = producer_conn
+                if conn is None or conn.closed:
+                    if actor_id is None:
+                        return
+                    conn = await self._actor_connection(actor_id)
+                await conn.notify("cancel_stream", payload)
+                await conn.notify("stream_ack", {
+                    "task_id": task_id.binary(), "consumed": 1 << 62})
+            except Exception:
+                pass
+
+        if self.loop.is_running():
+            asyncio.run_coroutine_threadsafe(go(), self.loop)
+
+    async def handle_cancel_stream(self, data, conn) -> bool:
+        """Executor-side: mark a streaming task as cancelled; its produce
+        loop stops at the next yield boundary. Recorded even before the
+        task starts producing (a pre-start cancel must not be lost); the
+        produce loop's finally clears it, and the set is pruned of
+        never-ran entries if it ever grows large."""
+        task_id = TaskID(data["task_id"])
+        self._stream_cancels.add(task_id)
+        ev = self._stream_ack_events.get(task_id)
+        if ev is not None:
+            ev.set()  # wake a backpressure wait so cancel is seen now
+        if len(self._stream_cancels) > 4096:
+            self._stream_cancels = {
+                t for t in self._stream_cancels
+                if t in self._stream_producing}
+        return True
 
     # ------------------------------------------------------------- actors
     async def create_actor(self, descriptor: FunctionDescriptor, args: tuple,
@@ -969,14 +1211,19 @@ class CoreWorker:
             method), args, kwargs, opts, actor_id=actor_id, method=method,
             seqno=seqno)
         spec.resources = {}
-        refs = [ObjectRef(oid, owner_address=self.address)
-                for oid in spec.return_ids()]
-        for oid in spec.return_ids():
-            self.reference_counter.add_owned_object(oid)
+        if spec.is_streaming:
+            st = self._streams[spec.task_id] = StreamState()
+            st.actor_id = actor_id  # enables cooperative stream cancel
+            out: list = [ObjectRefGenerator(spec.task_id, self)]
+        else:
+            out = [ObjectRef(oid, owner_address=self.address)
+                   for oid in spec.return_ids()]
+            for oid in spec.return_ids():
+                self.reference_counter.add_owned_object(oid)
         self._pending_tasks[spec.task_id] = spec
         self.loop.call_soon_threadsafe(self._spawn_actor_push, spec,
                                        actor_id)
-        return refs
+        return out
 
     def _spawn_actor_push(self, spec: TaskSpec, actor_id: ActorID) -> None:
         self.loop.create_task(self._push_actor_task(spec, actor_id))
@@ -1213,6 +1460,15 @@ class CoreWorker:
 
                 result = await self._run_sync(_run_timed)
                 exec_s = exec_box[0]
+                if spec.is_streaming:
+                    # The generator BODY runs during iteration, so it must
+                    # stay inside the applied env, and the produce time —
+                    # not the ~0s generator construction — is what feeds
+                    # the pipelining gate.
+                    t0 = time.monotonic()
+                    reply = await self._store_streamed_returns(spec, result)
+                    reply["exec_s"] = time.monotonic() - t0
+                    return reply
             reply = await self._store_returns(spec, result)
             # Execution time feeds the submitter's pipelining gate
             # (_pump_scheduling_key): only observed-tiny tasks pipeline.
@@ -1293,6 +1549,8 @@ class CoreWorker:
                     result = await self._run_sync(
                         lambda: self._execute_user_code(method, args,
                                                         kwargs, spec))
+                if spec.is_streaming:
+                    return await self._store_streamed_returns(spec, result)
                 return await self._store_returns(spec, result)
             except Exception as e:
                 return await self._store_exception(spec, e)
@@ -1313,25 +1571,26 @@ class CoreWorker:
         returns = []
         for i, value in enumerate(values):
             oid = ObjectID.for_task_return(spec.task_id, i)
-            sobj = ser.serialize(value)
-            if sobj.total_size <= self.config.max_direct_call_object_size or \
-                    self.plasma is None:
-                returns.append([oid.binary(), sobj.to_bytes()])
-            else:
-                stored = False
-                try:
-                    self.plasma.put_serialized(oid, sobj)
-                    stored = True
-                except StoreFullError:
-                    pass
-                if stored:
-                    await self.gcs.call("add_object_location", {
-                        "object_id": oid.binary(),
-                        "node_id": self.node_id.binary()})
-                    returns.append([oid.binary(), None])
-                else:
-                    returns.append([oid.binary(), sobj.to_bytes()])
+            returns.append([oid.binary(),
+                            await self._store_one_return(oid, value)])
         return {"status": "ok", "returns": returns}
+
+    async def _store_one_return(self, oid: ObjectID,
+                                value: Any) -> Optional[bytes]:
+        """Store one return value: small → inline bytes (returned); large →
+        local plasma + location registration (returns None)."""
+        sobj = ser.serialize(value)
+        if sobj.total_size <= self.config.max_direct_call_object_size or \
+                self.plasma is None:
+            return sobj.to_bytes()
+        try:
+            self.plasma.put_serialized(oid, sobj)
+        except StoreFullError:
+            return sobj.to_bytes()
+        await self.gcs.call("add_object_location", {
+            "object_id": oid.binary(),
+            "node_id": self.node_id.binary() if self.node_id else b""})
+        return None
 
     async def _store_exception(self, spec: TaskSpec, e: Exception) -> dict:
         tb = traceback.format_exc()
@@ -1340,9 +1599,99 @@ class CoreWorker:
                                spec.actor_method, tb, repr(e), cause=e
                                if _is_picklable(e) else None)
         blob = ser.dumps(err)
+        if spec.is_streaming:
+            return {"status": "error", "returns": [],
+                    "stream_total": 0, "stream_error": blob}
         return {"status": "error",
                 "returns": [[oid.binary(), blob]
                             for oid in spec.return_ids()]}
+
+    async def _store_streamed_returns(self, spec: TaskSpec,
+                                      result: Any) -> dict:
+        """Iterate the task's generator, reporting each yielded value to
+        the caller while the task is still running (stream_item notifies),
+        then return the completion reply carrying the produced count."""
+        caller = spec.caller_address
+        conn = None
+        if caller and caller != self.address:
+            conn = await self._peer(caller)
+
+        if hasattr(result, "__anext__"):
+            async def get_next():
+                try:
+                    return True, await result.__anext__()
+                except StopAsyncIteration:
+                    return False, None
+        elif result is None or not hasattr(result, "__next__"):
+            async def get_next():
+                raise TypeError(
+                    f"task {spec.name} declared num_returns='streaming' "
+                    f"but returned {type(result).__name__}, not a "
+                    f"generator/iterator")
+        else:
+            def _step():
+                try:
+                    return True, next(result)
+                except StopIteration:
+                    return False, None
+
+            async def get_next():
+                return await self._run_sync(_step)
+
+        task_id = spec.task_id
+        bp_limit = self.config.streaming_backpressure_num_items
+        self._stream_producing.add(task_id)
+        index = 0
+        try:
+            while True:
+                # Producer-side backpressure: pause once bp_limit items
+                # are yielded-but-unconsumed (reference:
+                # _generator_backpressure_num_objects). Consumer acks
+                # (stream_ack) advance the window; a re-check timeout
+                # guards against lost acks and observes cancellation.
+                while bp_limit > 0 and \
+                        index - self._stream_acked.get(task_id, 0) >= \
+                        bp_limit and task_id not in self._stream_cancels:
+                    ev = self._stream_ack_events.setdefault(
+                        task_id, asyncio.Event())
+                    ev.clear()
+                    try:
+                        await asyncio.wait_for(ev.wait(), timeout=1.0)
+                    except asyncio.TimeoutError:
+                        pass
+                if task_id in self._stream_cancels:
+                    close = getattr(result, "aclose", None) or \
+                        getattr(result, "close", None)
+                    if close is not None:
+                        r = close()
+                        if asyncio.iscoroutine(r):
+                            await r
+                    break
+                ok, value = await get_next()
+                if not ok:
+                    break
+                oid = ObjectID.for_task_return(task_id, index)
+                item = {"task_id": task_id.binary(), "index": index,
+                        "data": await self._store_one_return(oid, value)}
+                if conn is None:
+                    self._accept_stream_item(item)
+                else:
+                    await conn.notify("stream_item", item)
+                index += 1
+        except Exception as e:
+            tb = traceback.format_exc()
+            err = ser.RayTaskError(
+                spec.function.display() if spec.task_type != ACTOR_TASK
+                else spec.actor_method, tb, repr(e),
+                cause=e if _is_picklable(e) else None)
+            return {"status": "error", "returns": [],
+                    "stream_total": index, "stream_error": ser.dumps(err)}
+        finally:
+            self._stream_producing.discard(task_id)
+            self._stream_cancels.discard(task_id)
+            self._stream_acked.pop(task_id, None)
+            self._stream_ack_events.pop(task_id, None)
+        return {"status": "ok", "returns": [], "stream_total": index}
 
     async def handle_exit_worker(self, data, conn) -> None:
         logger.info("exit requested (force=%s)", data.get("force"))

@@ -28,7 +28,8 @@ class ActorMethod:
         worker = global_worker()
         refs = worker.submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs, self._opts)
-        if self._opts.get("num_returns", 1) == 1:
+        num_returns = self._opts.get("num_returns", 1)
+        if num_returns == 1 or num_returns == "streaming":
             return refs[0]
         return refs
 
@@ -67,6 +68,7 @@ class ActorClass:
         self._cls = cls
         self._opts = opts
         self._descriptor = None
+        self._descriptor_session = None  # session token of the export
         self.__name__ = cls.__name__
         # Collect per-method options declared with @method(...).
         self._method_opts = {
@@ -83,6 +85,7 @@ class ActorClass:
     def options(self, **opts) -> "ActorClass":
         new = ActorClass(self._cls, {**self._opts, **opts})
         new._descriptor = self._descriptor
+        new._descriptor_session = self._descriptor_session
         return new
 
     def remote(self, *args, **kwargs) -> ActorHandle:
@@ -90,8 +93,12 @@ class ActorClass:
         from ray_tpu._private.worker import global_worker
 
         worker = global_worker()
-        if self._descriptor is None:
+        # Module-level actor classes outlive clusters: re-export when the
+        # session changed (a fresh GCS has an empty function table).
+        if self._descriptor is None or \
+                self._descriptor_session != worker.core.worker_id.binary():
             self._descriptor = worker.export(self._cls)
+            self._descriptor_session = worker.core.worker_id.binary()
         opts = _resolve_strategy(self._opts)
         actor_id = worker.create_actor(self._descriptor, args, kwargs, opts)
         return ActorHandle(actor_id, self._method_opts)

@@ -43,6 +43,10 @@ class Config:
     # reported) execution time EMA is at or below this; longer tasks keep
     # strict one-in-flight spread semantics.
     pipeline_task_duration_s: float = 0.1
+    # Streaming generators: max yielded-but-unconsumed items per stream
+    # before the producer pauses (reference:
+    # _generator_backpressure_num_objects). <=0 disables.
+    streaming_backpressure_num_items: int = 8
     max_pending_lease_requests: int = 8
     worker_lease_timeout_s: float = 30.0
     # --- health / failure detection ---

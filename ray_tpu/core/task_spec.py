@@ -76,8 +76,14 @@ class TaskSpec:
     kwarg_keys: List[str] = dataclasses.field(default_factory=list)
 
     def return_ids(self) -> List[ObjectID]:
+        if self.num_returns < 0:  # streaming: returns materialize as yielded
+            return []
         return [ObjectID.for_task_return(self.task_id, i)
                 for i in range(self.num_returns)]
+
+    @property
+    def is_streaming(self) -> bool:
+        return self.num_returns < 0
 
     def to_wire(self) -> dict:
         return {

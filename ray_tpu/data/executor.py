@@ -77,9 +77,24 @@ def _fuse(stages: List[Stage]) -> List[Stage]:
     return fused
 
 
-@ray_tpu.remote
-def _exec_read(read_task) -> Block:
-    return read_task()
+@ray_tpu.remote(num_returns="streaming")
+def _exec_read(read_task, target_bytes: int):
+    """Streaming read: yields blocks as the read produces them (reference:
+    read tasks as streaming generators — the caller's first block is
+    consumable before this task finishes). Oversized blocks are split to
+    ~target_bytes chunks so downstream parallelism isn't lost."""
+    out = read_task()
+    blocks = [out] if isinstance(out, Block) else out
+    for block in blocks:
+        nbytes = block.nbytes
+        if nbytes > target_bytes and block.num_rows > 1:
+            n_chunks = min(block.num_rows,
+                           -(-nbytes // max(target_bytes, 1)))
+            rows_per = -(-block.num_rows // n_chunks)
+            for s in range(0, block.num_rows, rows_per):
+                yield block.slice(s, min(rows_per, block.num_rows - s))
+        else:
+            yield block
 
 
 @ray_tpu.remote
@@ -145,12 +160,22 @@ class _OpDriver:
     def finish(self, ref, estimate: int):
         actual = _ref_size_bytes(ref)
         self.rm.on_task_finished(self.name, estimate, actual)
+        return ref, self._account_block(actual, estimate)
+
+    def item_produced(self, ref) -> int:
+        """One streamed item landed; returns the bytes charged for it."""
+        actual = _ref_size_bytes(ref)
+        held = self._account_block(actual, self._estimate)
+        self.rm.on_output_produced(self.name, held)
+        return held
+
+    def _account_block(self, actual: Optional[int], estimate: int) -> int:
         held = actual if actual is not None else estimate
         if actual is not None:
             self._estimate = int(0.7 * self._estimate + 0.3 * actual)
         self.stats.blocks_out += 1
         self.stats.bytes_out += held
-        return ref, held
+        return held
 
     def consumed(self, bytes_held: int) -> None:
         self.rm.on_output_consumed(self.name, bytes_held)
@@ -231,25 +256,42 @@ class StreamingExecutor:
     # ------------------------------------------------------------------
     def _stream_source(self, read_tasks, rm: ResourceManager
                        ) -> Iterator[Any]:
+        # Read tasks are streaming generators: each yielded block's ref is
+        # handed downstream the moment the item report lands — the first
+        # block of a read task is consumable before the task finishes.
         # Blocks are yielded in task-SUBMISSION order (the reference's
-        # default preserve_order semantics): only the head ref is waited
-        # on, so later tasks still execute concurrently behind it.
+        # default preserve_order semantics): only the head stream is
+        # waited on, so later tasks still execute concurrently behind it.
+        # Memory bounding: byte accounting here covers consumed (head)
+        # items; runahead of the non-head streams is bounded by the
+        # producer-side backpressure window
+        # (config.streaming_backpressure_num_items per stream).
         op = _OpDriver(rm, rm.register_op("Read"),
                        self.context.default_block_size_estimate)
         limit = self.context.max_tasks_in_flight
+        target = self.context.target_max_block_size
         pending = collections.deque(read_tasks)
-        in_flight: collections.deque = collections.deque()
+        streams: collections.deque = collections.deque()
         try:
-            while pending or in_flight:
-                while pending and len(in_flight) < limit:
-                    for ref, held in op.wait_for_budget(in_flight):
-                        yield ref
-                        op.consumed(held)
-                    op.submitted(in_flight,
-                                 _exec_read.remote(pending.popleft()))
-                head, est = in_flight.popleft()
-                ray_tpu.wait([head], num_returns=1)
-                ref, held = op.finish(head, est)
+            while pending or streams:
+                while pending and len(streams) < limit and \
+                        (not streams or rm.can_submit(op.name,
+                                                      op._estimate)):
+                    rm.on_task_submitted(op.name, op._estimate)
+                    streams.append(
+                        (_exec_read.remote(pending.popleft(), target),
+                         op._estimate))
+                head, est = streams[0]
+                t0 = time.perf_counter()
+                try:
+                    ref = head.next()
+                except StopIteration:
+                    streams.popleft()
+                    rm.on_task_finished(op.name, est, 0)
+                    continue
+                finally:
+                    op.stats.time_blocked_s += time.perf_counter() - t0
+                held = op.item_produced(ref)
                 yield ref
                 op.consumed(held)
         finally:

@@ -129,6 +129,13 @@ class ResourceManager:
         if bytes_actual is not None and bytes_actual != bytes_estimate:
             u.bytes += bytes_actual - bytes_estimate
 
+    def on_output_produced(self, name: str, bytes_held: int) -> None:
+        """A streamed item landed (charged until consumed downstream)."""
+        u = self._ops[name]
+        u.bytes += bytes_held
+        u.stats.peak_bytes_in_flight = max(
+            u.stats.peak_bytes_in_flight, u.bytes)
+
     def on_output_consumed(self, name: str, bytes_held: int) -> None:
         u = self._ops[name]
         u.bytes = max(0, u.bytes - bytes_held)

@@ -11,7 +11,6 @@ import asyncio
 import inspect
 import logging
 import time
-import uuid
 from typing import Any, Optional
 
 import ray_tpu
@@ -80,7 +79,6 @@ class ReplicaActor:
         self._total_served = 0
         self._draining = False
         self._multiplexed_model_ids: list = []
-        self._streams: dict = {}
         self._started_at = time.time()
         global _current_replica
         _current_replica = self
@@ -102,22 +100,11 @@ class ReplicaActor:
                 result = await asyncio.to_thread(method, *args, **kwargs)
             if inspect.isgenerator(result) or \
                     inspect.isasyncgen(result):
-                if not meta.stream:
-                    # Non-stream callers (plain handle / HTTP) must opt
-                    # in — otherwise the generator would leak.
-                    raise TypeError(
-                        f"{meta.call_method!r} returned a generator; "
-                        "call it with handle.options(stream=True)")
-                # Streaming (reference: streaming responses) — the
-                # generator stays replica-side; the caller drains it
-                # with stream_next() calls carrying the stream id. The
-                # request stays ONGOING (for drain/autoscaling) until
-                # the stream ends: +1 compensates the finally below.
-                stream_id = uuid.uuid4().hex
-                self._streams[stream_id] = (result,
-                                            meta.multiplexed_model_id)
-                self._num_ongoing += 1
-                return {"__serve_stream__": stream_id}
+                # Non-stream callers (plain handle / HTTP) must opt
+                # in — otherwise the generator would leak.
+                raise TypeError(
+                    f"{meta.call_method!r} returned a generator; "
+                    "call it with handle.options(stream=True)")
             self._total_served += 1
             return result
         finally:
@@ -125,55 +112,44 @@ class ReplicaActor:
 
     _STREAM_END = object()
 
-    def _finish_stream(self, stream_id: str) -> None:
-        if self._streams.pop(stream_id, None) is not None:
-            self._num_ongoing -= 1
-
-    async def stream_next(self, stream_id: str):
-        """(done, chunk) — drains one item from a live stream."""
-        entry = self._streams.get(stream_id)
-        if entry is None:
-            raise ValueError(f"unknown stream {stream_id!r}")
-        gen, model_id = entry
-        if model_id:
-            # The generator BODY runs here, not in handle_request's
-            # context: restore the multiplex id for it.
-            _set_multiplex_context(model_id)
+    async def handle_request_streaming(self, request_meta: dict,
+                                       *args, **kwargs):
+        """Streaming request path: an async-generator actor method driven
+        by the core streaming-generator protocol — the router calls it
+        with num_returns="streaming", so every yielded chunk reaches the
+        caller as an ObjectRefGenerator item with no per-chunk RPC round
+        trip (reference: streaming responses over the
+        streaming-generator protocol in replica.py)."""
+        meta = RequestMetadata.from_dict(request_meta)
+        self._num_ongoing += 1
         try:
-            if inspect.isasyncgen(gen):
-                try:
-                    chunk = await gen.__anext__()
-                except StopAsyncIteration:
-                    chunk = self._STREAM_END
+            method = self._wrapper.get_method(meta.call_method)
+            if meta.multiplexed_model_id:
+                _set_multiplex_context(meta.multiplexed_model_id)
+            if inspect.isasyncgenfunction(method):
+                result = method(*args, **kwargs)
+            elif inspect.iscoroutinefunction(method):
+                result = await method(*args, **kwargs)
             else:
-                # StopIteration cannot cross coroutine/future boundaries
-                # — drain with a sentinel default instead.
-                chunk = await asyncio.to_thread(
-                    next, gen, self._STREAM_END)
-        except Exception:
-            self._finish_stream(stream_id)
-            raise
-        if chunk is self._STREAM_END:
-            self._finish_stream(stream_id)
+                result = await asyncio.to_thread(method, *args, **kwargs)
+            if inspect.isasyncgen(result):
+                async for chunk in result:
+                    yield chunk
+            elif inspect.isgenerator(result):
+                while True:
+                    # StopIteration cannot cross coroutine/future
+                    # boundaries — drain with a sentinel default.
+                    chunk = await asyncio.to_thread(
+                        next, result, self._STREAM_END)
+                    if chunk is self._STREAM_END:
+                        break
+                    yield chunk
+            else:
+                # Non-generator result through stream=True: one chunk.
+                yield result
             self._total_served += 1
-            return True, None
-        return False, chunk
-
-    async def cancel_stream(self, stream_id: str) -> None:
-        entry = self._streams.get(stream_id)
-        self._finish_stream(stream_id)
-        if entry is None:
-            return
-        gen = entry[0]
-        try:
-            if inspect.isasyncgen(gen):
-                # Async generators expose aclose(), not close(); without
-                # this their finally blocks never run on cancel.
-                await gen.aclose()
-            elif hasattr(gen, "close"):
-                await asyncio.to_thread(gen.close)
-        except Exception:
-            pass
+        finally:
+            self._num_ongoing -= 1
 
     # ----------------------------------------------------------- control path
     def get_num_ongoing_requests(self) -> int:

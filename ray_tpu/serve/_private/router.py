@@ -168,15 +168,6 @@ class Router:
             entry = self._choose_multiplexed(entry, meta)
         handle = entry.resolve()
         self._scheduler.on_request_sent(entry)
-        try:
-            ref = handle.handle_request.remote(meta.to_dict(), *args,
-                                               **kwargs)
-        except Exception:
-            self._scheduler.on_request_done(entry)
-            self._scheduler.drop_replica(entry.info.replica_id)
-            raise
-        worker = ray_tpu.get_runtime_context()._worker
-        fut = worker.as_future(ref)
         # Idempotent release: fires on normal completion OR an early
         # caller-side cancel (e.g. proxy request timeout) — never both,
         # so a hung replica can't accumulate phantom ongoing load and a
@@ -188,21 +179,29 @@ class Router:
                 released.append(1)
                 self._scheduler.on_request_done(entry)
 
-        fut.add_done_callback(lambda _f: release_once())
         if meta.stream:
-            # The first reply (the stream id) completes `fut`
-            # immediately, but the replica keeps working until the
-            # stream drains: hold an extra ongoing count that the
-            # DeploymentResponseGenerator releases at stream end.
-            self._scheduler.on_request_sent(entry)
-            released_stream = []
-
-            def release_stream():
-                if not released_stream:
-                    released_stream.append(1)
-                    self._scheduler.on_request_done(entry)
-
-            return ref, fut, handle, release_stream
+            # Streaming rides the core streaming-generator protocol:
+            # chunks arrive as ObjectRefGenerator items; the replica
+            # counts as loaded until the consumer drains/cancels.
+            try:
+                gen = handle.handle_request_streaming.options(
+                    num_returns="streaming").remote(
+                        meta.to_dict(), *args, **kwargs)
+            except Exception:
+                release_once()
+                self._scheduler.drop_replica(entry.info.replica_id)
+                raise
+            return gen, None, handle, release_once
+        try:
+            ref = handle.handle_request.remote(meta.to_dict(), *args,
+                                               **kwargs)
+        except Exception:
+            release_once()
+            self._scheduler.drop_replica(entry.info.replica_id)
+            raise
+        worker = ray_tpu.get_runtime_context()._worker
+        fut = worker.as_future(ref)
+        fut.add_done_callback(lambda _f: release_once())
         return ref, fut, handle, release_once
 
     _MULTIPLEX_CACHE_TTL_S = 2.0

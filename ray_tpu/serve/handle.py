@@ -114,17 +114,18 @@ class DeploymentHandle:
 
 class DeploymentResponseGenerator:
     """Iterates a streaming deployment response (reference:
-    handle.options(stream=True) -> DeploymentResponseGenerator): the
-    generator lives replica-side; each __next__ drains one chunk from
-    the SAME replica that accepted the request."""
+    handle.options(stream=True) -> DeploymentResponseGenerator).
 
-    def __init__(self, ref, replica_handle, release_cb=None):
-        self._ref = ref
+    Wraps the core ObjectRefGenerator of the replica's streaming actor
+    call: chunks stream to this process as they're yielded — no
+    per-chunk RPC round trip — and each __next__ resolves the next
+    chunk's value."""
+
+    def __init__(self, gen, replica_handle, release_cb=None):
+        self._gen = gen            # core ObjectRefGenerator
         self._replica = replica_handle
         self._release_cb = release_cb
-        self._stream_id: Optional[str] = None
         self._done = False
-        self._single: Optional[tuple] = None
 
     def _release(self) -> None:
         cb, self._release_cb = self._release_cb, None
@@ -134,58 +135,45 @@ class DeploymentResponseGenerator:
             except Exception:
                 pass
 
-    def _start(self) -> None:
-        result = ray_tpu.get(self._ref)
-        if isinstance(result, dict) and "__serve_stream__" in result:
-            self._stream_id = result["__serve_stream__"]
-        else:
-            # Non-generator result: behave as a one-chunk stream.
-            self._single = (result,)
-
     def __iter__(self):
         return self
 
     def __next__(self):
         if self._done:
             raise StopIteration
-        if self._stream_id is None and self._single is None:
-            self._start()
-        if self._single is not None:
-            self._done = True
-            self._release()
-            return self._single[0]
         try:
-            done, chunk = ray_tpu.get(
-                self._replica.stream_next.remote(self._stream_id))
-        except Exception:
-            # Mid-stream failure terminates the iterator: a retry would
-            # only hit 'unknown stream' on the replica.
+            ref = next(self._gen)
+        except BaseException:
+            # Stream end or mid-stream failure both terminate the
+            # iterator and release the scheduler slot.
             self._done = True
             self._release()
             raise
-        if done:
+        return ray_tpu.get(ref)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        if self._done:
+            raise StopAsyncIteration
+        try:
+            ref = await self._gen.__anext__()
+        except BaseException:
             self._done = True
             self._release()
-            raise StopIteration
-        return chunk
+            raise
+        # Large chunks live in the replica node's plasma: resolve off the
+        # event loop so other in-flight requests aren't stalled.
+        return await asyncio.get_running_loop().run_in_executor(
+            None, ray_tpu.get, ref)
 
     def cancel(self) -> None:
         if self._done:
             return
-        if self._stream_id is None and self._single is None:
-            # The request is already in flight — resolve it so the
-            # replica-side generator can actually be closed.
-            try:
-                self._start()
-            except Exception:
-                self._done = True
-                self._release()
-                return
         self._done = True
         try:
-            if self._stream_id is not None:
-                ray_tpu.get(
-                    self._replica.cancel_stream.remote(self._stream_id))
+            self._gen.cancel()
         finally:
             self._release()
 

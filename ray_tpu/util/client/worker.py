@@ -32,6 +32,13 @@ class _NoopRefCounter:
 class _CoreShim:
     """Minimal `core` surface ObjectRef construction touches."""
 
+    def __init__(self):
+        from ray_tpu.core.ids import WorkerID
+
+        # Session token for descriptor-export caching (api.py): a fresh
+        # shim per client connection means exports re-register.
+        self.worker_id = WorkerID.from_random()
+
     def register_borrow(self, object_id, owner_address) -> None:
         pass
 
@@ -43,9 +50,11 @@ class ClientWorker:
 
     mode = "client"
     reference_counter = _NoopRefCounter()
-    core = _CoreShim()
 
     def __init__(self, host: str, port: int):
+        # Per-connection shim: its worker_id doubles as the session token
+        # for descriptor-export caching.
+        self.core = _CoreShim()
         self._loop = asyncio.new_event_loop()
         self._ready = threading.Event()
         self._conn: Optional[rpc.Connection] = None

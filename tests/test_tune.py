@@ -5,6 +5,7 @@ Mirrors the reference's tune test style (python/ray/tune/tests/) — real
 trials as actors on a local cluster."""
 
 import os
+import time
 
 import pytest
 
@@ -215,6 +216,7 @@ def test_pbt_exploits_and_perturbs(tmp_path):
 def test_median_stopping(tmp_path):
     def objective(config):
         for i in range(10):
+            time.sleep(0.1)  # interleave trials so the rule can observe peers
             tune.report({"v": config["c"]})
 
     results = Tuner(
