@@ -32,7 +32,7 @@ from ray_tpu._private.worker import (
 from ray_tpu._private.api import remote, method
 from ray_tpu.core.generator import ObjectRefGenerator
 from ray_tpu.core.object_ref import ObjectRef
-from ray_tpu.core.actor import ActorHandle, ActorClass
+from ray_tpu.core.actor import ActorHandle, ActorClass, get_actor
 
 __version__ = "0.1.0"
 
@@ -55,6 +55,7 @@ __all__ = [
     "ObjectRefGenerator",
     "ActorHandle",
     "ActorClass",
+    "get_actor",
     "__version__",
 ]
 

@@ -183,6 +183,7 @@ class CoreWorker:
         ghost, gport = self.gcs_address.rsplit(":", 1)
         self.gcs = await rpc.connect(ghost, int(gport),
                                      handler=self._on_pubsub, name="->gcs")
+        self.gcs.on_close = self._on_gcs_close
         if self.mode == DRIVER:
             r = await self.gcs.call("register_job",
                                     {"driver_address": self.address})
@@ -216,6 +217,44 @@ class CoreWorker:
             self._task_event_flusher = asyncio.get_running_loop(
             ).create_task(self._task_event_flush_loop())
 
+    def _on_gcs_close(self, conn: rpc.Connection) -> None:
+        if not self._should_exit.is_set() and self.loop.is_running():
+            self.loop.create_task(self._reconnect_gcs())
+
+    async def _reconnect_gcs(self) -> None:
+        """The GCS died (head restart): reconnect, re-subscribe, and — for
+        drivers — re-attach the job so driver-disconnect semantics keep
+        working (reference: workers ride out GCS restarts; state is
+        restored from table storage)."""
+        ghost, gport = self.gcs_address.rsplit(":", 1)
+        delay = 0.5
+        while not self._should_exit.is_set():
+            conn = None
+            try:
+                conn = await rpc.connect(ghost, int(gport),
+                                         handler=self._on_pubsub,
+                                         name="->gcs")
+                if self.mode == DRIVER:
+                    await conn.call("reattach_job", {
+                        "job_id": self.job_id.binary(),
+                        "driver_address": self.address})
+                    await conn.call("subscribe", {"channel": "actors"})
+                    if self.config.log_to_driver:
+                        await conn.call("subscribe", {"channel": "logs"})
+            except Exception:
+                if conn is not None:
+                    await conn.close()
+                # Keep trying (backoff-capped) until shutdown: the head
+                # may come back minutes later, and gcs_call retries lean
+                # on this loop eventually landing a fresh connection.
+                await asyncio.sleep(delay)
+                delay = min(delay * 1.5, 5.0)
+                continue
+            conn.on_close = self._on_gcs_close
+            self.gcs = conn
+            logger.info("reconnected to restarted GCS")
+            return
+
     async def _task_event_flush_loop(self) -> None:
         """Periodic flush so trailing events (sub-batch-size bursts after
         the last task) still reach the GCS (reference: TaskEventBuffer's
@@ -226,6 +265,7 @@ class CoreWorker:
                 self._flush_task_events()
 
     async def disconnect(self) -> None:
+        self._should_exit.set()  # no GCS reconnect attempts during teardown
         flusher = getattr(self, "_task_event_flusher", None)
         if flusher is not None:
             flusher.cancel()

@@ -64,6 +64,18 @@ class NodeInfo:
             "state": self.state,
         }
 
+    @classmethod
+    def from_store(cls, node_id: NodeID, v: dict) -> "NodeInfo":
+        info = cls(node_id, {
+            "address": v["address"], "hostname": v["hostname"],
+            "store_path": v["store_path"],
+            "resources": v["resources_total"], "labels": v["labels"],
+            "slice_id": v["slice_id"],
+            "transfer_port": v["transfer_port"]})
+        info.resources_available = dict(v["resources_available"])
+        info.state = v["state"]
+        return info
+
 
 class ActorInfo:
     def __init__(self, actor_id: ActorID, data: dict):
@@ -96,6 +108,26 @@ class ActorInfo:
             "death_cause": self.death_cause,
         }
 
+    def to_store(self) -> dict:
+        v = self.view()
+        v["creation_task"] = self.creation_task
+        v["detached"] = self.detached
+        return v
+
+    @classmethod
+    def from_store(cls, actor_id: ActorID, v: dict) -> "ActorInfo":
+        info = cls(actor_id, {
+            "name": v["name"], "namespace": v["namespace"],
+            "class_name": v["class_name"],
+            "max_restarts": v["max_restarts"], "detached": v["detached"],
+            "creation_task": v["creation_task"], "job_id": v["job_id"]})
+        info.state = v["state"]
+        info.address = v["address"]
+        info.node_id = NodeID(v["node_id"]) if v.get("node_id") else None
+        info.num_restarts = v["num_restarts"]
+        info.death_cause = v["death_cause"]
+        return info
+
 
 class PlacementGroupInfo:
     def __init__(self, pg_id: PlacementGroupID, data: dict):
@@ -121,10 +153,33 @@ class PlacementGroupInfo:
             },
         }
 
+    def to_store(self) -> dict:
+        v = self.view()
+        v["job_id"] = self.job_id.binary() if self.job_id else None
+        return v
+
+    @classmethod
+    def from_store(cls, pg_id: PlacementGroupID,
+                   v: dict) -> "PlacementGroupInfo":
+        pg = cls(pg_id, {"name": v["name"], "strategy": v["strategy"],
+                         "bundles": v["bundles"], "job_id": v["job_id"]})
+        pg.state = v["state"]
+        pg.bundle_locations = {
+            int(i): NodeID(n) for i, n in v["bundle_locations"].items()}
+        if pg.state == "CREATED":
+            pg.ready_event.set()
+        return pg
+
 
 class GcsServer:
-    def __init__(self, config: Config):
+    def __init__(self, config: Config, persist_path: Optional[str] = None):
+        from ray_tpu._private.gcs_storage import GcsTableStorage
+
         self.config = config
+        # Write-through table persistence (reference: GcsTableStorage over
+        # store_client/ — Redis there, sqlite here). persist_path=None
+        # keeps the same code path on a volatile in-memory db.
+        self.storage = GcsTableStorage(persist_path)
         self.kv: Dict[Tuple[bytes, bytes], bytes] = {}
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
@@ -148,20 +203,103 @@ class GcsServer:
 
     # ------------------------------------------------------------- lifecycle
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._restore_tables()
         self._server = rpc.Server(self, host, port)
         port = await self._server.start()
         self._bg.append(asyncio.get_running_loop().create_task(
             self._health_check_loop()))
         self._bg.append(asyncio.get_running_loop().create_task(
             self._broadcast_view_loop()))
+        self.resume_restored_state()
         logger.info("GCS listening on %s:%s", host, port)
         return port
+
+    # ----------------------------------------------------------- persistence
+    def _restore_tables(self) -> None:
+        """Rebuild in-memory state from durable tables after a head
+        restart (reference: GCS recovery from Redis +
+        HandleNotifyGCSRestart — raylets re-register, actors resume)."""
+        for key, v in self.storage.load_all("kv"):
+            ns, _, k = key.partition(b"\x00")
+            self.kv[(ns, k)] = v
+        for key, v in self.storage.load_all("nodes"):
+            info = NodeInfo.from_store(NodeID(key), v)
+            # Raylets re-register over fresh connections; give them a
+            # grace period before health checks may fail them.
+            info.last_heartbeat = time.monotonic() + 5.0
+            self.nodes[info.node_id] = info
+        for key, v in self.storage.load_all("jobs"):
+            self.jobs[JobID(key)] = v
+        for key, v in self.storage.load_all("actors"):
+            info = ActorInfo.from_store(ActorID(key), v)
+            self.actors[info.actor_id] = info
+            if info.name and info.state != DEAD:
+                self.named_actors[(info.namespace, info.name)] = \
+                    info.actor_id
+        for key, v in self.storage.load_all("pgs"):
+            pg = PlacementGroupInfo.from_store(PlacementGroupID(key), v)
+            self.placement_groups[pg.pg_id] = pg
+        nj = self.storage.get("meta", b"next_job")
+        if nj is not None:
+            self._next_job = nj
+        if self.nodes or self.actors:
+            logger.info(
+                "restored GCS state: %d nodes, %d actors, %d pgs, %d jobs, "
+                "%d kv entries", len(self.nodes), len(self.actors),
+                len(self.placement_groups), len(self.jobs), len(self.kv))
+
+    def resume_restored_state(self) -> None:
+        """Kick schedulers for restored-but-unfinished work (call with the
+        loop running)."""
+        for actor in self.actors.values():
+            if actor.state in (PENDING, RESTARTING):
+                asyncio.get_running_loop().create_task(
+                    self._schedule_actor(actor))
+        for pg in self.placement_groups.values():
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        restored_jobs = [j for j, job in self.jobs.items()
+                         if job["state"] == "RUNNING"]
+        if restored_jobs:
+            asyncio.get_running_loop().create_task(
+                self._reap_unattached_jobs(restored_jobs))
+
+    async def _reap_unattached_jobs(self, job_ids: List[JobID],
+                                    grace_s: float = 30.0) -> None:
+        """Restored RUNNING jobs whose driver never reattaches are
+        finished — preserving the driver-disconnect ⇒ job-finished
+        invariant across head restarts (the driver may have died while
+        the GCS was down)."""
+        self._reattached_jobs: Set[JobID] = getattr(
+            self, "_reattached_jobs", set())
+        await asyncio.sleep(grace_s)
+        for job_id in job_ids:
+            job = self.jobs.get(job_id)
+            if job and job["state"] == "RUNNING" and \
+                    job_id not in self._reattached_jobs:
+                logger.warning("job %s never reattached after GCS "
+                               "restart; finishing it", job_id.hex()[:8])
+                await self._finish_job(job_id)
+
+    def _persist_actor(self, actor: ActorInfo) -> None:
+        self.storage.put("actors", actor.actor_id.binary(),
+                         actor.to_store())
+
+    def _persist_node(self, node: NodeInfo) -> None:
+        self.storage.put("nodes", node.node_id.binary(), node.view())
+
+    def _persist_pg(self, pg: PlacementGroupInfo) -> None:
+        self.storage.put("pgs", pg.pg_id.binary(), pg.to_store())
+
+    def _persist_job(self, job_id: JobID) -> None:
+        self.storage.put("jobs", job_id.binary(), self.jobs[job_id])
 
     async def close(self) -> None:
         for t in self._bg:
             t.cancel()
         if self._server:
             await self._server.close()
+        self.storage.close()
 
     def on_connection(self, conn: rpc.Connection) -> None:
         conn.on_close = self._on_disconnect
@@ -207,12 +345,14 @@ class GcsServer:
         if not overwrite and key in self.kv:
             return False
         self.kv[key] = data["value"]
+        self.storage.put("kv", key[0] + b"\x00" + key[1], data["value"])
         return True
 
     async def handle_kv_get(self, data, conn):
         return self.kv.get((data["ns"], data["key"]))
 
     async def handle_kv_del(self, data, conn) -> bool:
+        self.storage.delete("kv", data["ns"] + b"\x00" + data["key"])
         return self.kv.pop((data["ns"], data["key"]), None) is not None
 
     async def handle_kv_exists(self, data, conn) -> bool:
@@ -229,6 +369,17 @@ class GcsServer:
         info.conn = conn
         conn._node_id = node_id
         self.nodes[node_id] = info
+        self._persist_node(info)
+        # Reconcile restored actor records against the raylet's report:
+        # an actor this node supposedly hosts that is NOT in its live set
+        # died while the GCS was down — restart or bury it now.
+        if "live_actors" in data:
+            live = set(data["live_actors"])
+            for actor in list(self.actors.values()):
+                if actor.node_id == node_id and actor.state == ALIVE and \
+                        actor.actor_id.binary() not in live:
+                    await self._restart_or_kill_actor(
+                        actor, "worker lost during GCS downtime")
         await self.publish("nodes", info.view())
         logger.info("node %s registered at %s (resources=%s, slice=%r)",
                     node_id.hex()[:8], info.address, info.resources_total,
@@ -281,6 +432,7 @@ class GcsServer:
         if node is None or node.state == DEAD:
             return
         node.state = DEAD
+        self._persist_node(node)
         logger.warning("node %s failed: %s", node_id.hex()[:8], reason)
         await self.publish("nodes", node.view())
         # Restart or kill actors that lived there (reference:
@@ -294,6 +446,7 @@ class GcsServer:
         for pg in self.placement_groups.values():
             if node_id in pg.bundle_locations.values() and pg.state == "CREATED":
                 pg.state = "RESCHEDULING"
+                self._persist_pg(pg)
                 pg.ready_event.clear()
                 asyncio.get_event_loop().create_task(self._schedule_pg(pg))
         # Objects whose only copy was there are lost.
@@ -310,13 +463,33 @@ class GcsServer:
             "driver_address": data.get("driver_address", ""),
             "start_time": time.time(),
         }
+        self.storage.put("meta", b"next_job", self._next_job)
+        self._persist_job(job_id)
         return {"job_id": job_id.binary()}
+
+    async def handle_reattach_job(self, data, conn) -> dict:
+        """A driver reconnecting after a GCS restart re-binds its job to
+        the new connection (so driver-disconnect ⇒ job-finished still
+        holds)."""
+        job_id = JobID(data["job_id"])
+        conn._job_id = job_id
+        self._reattached_jobs = getattr(self, "_reattached_jobs", set())
+        self._reattached_jobs.add(job_id)
+        if job_id not in self.jobs:
+            self.jobs[job_id] = {
+                "state": "RUNNING",
+                "driver_address": data.get("driver_address", ""),
+                "start_time": time.time(),
+            }
+            self._persist_job(job_id)
+        return {"ok": True}
 
     async def _finish_job(self, job_id: JobID) -> None:
         job = self.jobs.get(job_id)
         if not job or job["state"] == "FINISHED":
             return
         job["state"] = "FINISHED"
+        self.storage.delete("jobs", job_id.binary())
         await self.publish("jobs", {"job_id": job_id.binary(),
                                     "state": "FINISHED"})
         # Kill non-detached actors of the job (reference:
@@ -341,6 +514,7 @@ class GcsServer:
                         "error": f"actor name {info.name!r} already taken"}
             self.named_actors[key] = actor_id
         self.actors[actor_id] = info
+        self._persist_actor(info)
         asyncio.get_running_loop().create_task(self._schedule_actor(info))
         return {"ok": True}
 
@@ -367,6 +541,7 @@ class GcsServer:
                 continue
             if reply.get("ok"):
                 actor.node_id = node.node_id
+                self._persist_actor(actor)
                 return  # worker will report actor_ready
             await asyncio.sleep(0.25)
         await self._restart_or_kill_actor(actor, "no feasible node")
@@ -412,6 +587,7 @@ class GcsServer:
         actor.state = ALIVE
         actor.address = data["address"]
         actor.node_id = NodeID(data["node_id"])
+        self._persist_actor(actor)
         await self.publish("actors", actor.view())
         return True
 
@@ -440,6 +616,7 @@ class GcsServer:
                 actor.num_restarts < actor.max_restarts):
             actor.num_restarts += 1
             actor.state = RESTARTING
+            self._persist_actor(actor)
             await self.publish("actors", actor.view())
             logger.info("restarting actor %s (%d/%s): %s",
                         actor.actor_id.hex()[:8], actor.num_restarts,
@@ -450,6 +627,10 @@ class GcsServer:
             actor.death_cause = reason
             if actor.name:
                 self.named_actors.pop((actor.namespace, actor.name), None)
+            if actor.detached:
+                self._persist_actor(actor)  # durable tombstone
+            else:
+                self.storage.delete("actors", actor.actor_id.binary())
             await self.publish("actors", actor.view())
 
     async def handle_get_actor_info(self, data, conn):
@@ -499,6 +680,7 @@ class GcsServer:
         pg_id = PlacementGroupID(data["pg_id"])
         pg = PlacementGroupInfo(pg_id, data)
         self.placement_groups[pg_id] = pg
+        self._persist_pg(pg)
         asyncio.get_running_loop().create_task(self._schedule_pg(pg))
         return {"ok": True}
 
@@ -558,6 +740,7 @@ class GcsServer:
                     pass
         pg.bundle_locations.clear()
         self.placement_groups.pop(pg.pg_id, None)
+        self.storage.delete("pgs", pg.pg_id.binary())
 
     async def _schedule_pg(self, pg: PlacementGroupInfo) -> None:
         """Two-phase bundle placement (reference:
@@ -573,11 +756,13 @@ class GcsServer:
                     if ok:
                         pg.state = "CREATED"
                         pg.bundle_locations = dict(enumerate(plan))
+                        self._persist_pg(pg)
                         pg.ready_event.set()
                         await self.publish("placement_groups", pg.view())
                         return
                 await asyncio.sleep(0.25)
             pg.state = "INFEASIBLE"
+            self._persist_pg(pg)
             pg.ready_event.set()
             await self.publish("placement_groups", pg.view())
 
@@ -902,6 +1087,7 @@ def main():  # pragma: no cover - exercised via subprocess in tests
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--config", default="{}")
+    p.add_argument("--persist-path", default="")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s GCS %(levelname)s %(message)s")
@@ -909,7 +1095,7 @@ def main():  # pragma: no cover - exercised via subprocess in tests
     async def run():
         cfg = Config.from_dict(json.loads(args.config)) if args.config != "{}" \
             else Config.from_env()
-        server = GcsServer(cfg)
+        server = GcsServer(cfg, persist_path=args.persist_path or None)
         port = await server.start(args.host, args.port)
         # Announce the bound port on stdout for the parent process.
         print(json.dumps({"port": port}), flush=True)

@@ -1,0 +1,76 @@
+"""GCS table storage — write-through persistence for the control plane.
+
+Equivalent of the reference's GcsTableStorage over a StoreClient
+(src/ray/gcs/gcs_server/gcs_table_storage.h, src/ray/gcs/store_client/):
+every mutation of a GCS table is written through to durable storage so a
+restarted GCS process recovers the cluster's control state (actors, nodes,
+jobs, placement groups, internal KV) — the reference's Redis-backed head
+fault tolerance, here on sqlite (one file under the session dir, WAL mode,
+no extra process).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Dict, Iterator, Optional, Tuple
+
+import msgpack
+
+
+class GcsTableStorage:
+    """Keyed blob tables with write-through semantics.
+
+    Values are msgpack-encoded (bytes/str/int/float/dict/list only —
+    exactly the wire types GCS state is built from).
+    """
+
+    def __init__(self, path: Optional[str]):
+        # path=None → volatile (in-memory sqlite): same code path, no
+        # durability — used when persistence is disabled.
+        self._db = sqlite3.connect(path or ":memory:",
+                                   check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS gcs_tables ("
+            " tab TEXT NOT NULL, key BLOB NOT NULL, value BLOB NOT NULL,"
+            " PRIMARY KEY (tab, key))")
+        self._db.commit()
+        self._lock = threading.Lock()
+
+    def put(self, table: str, key: bytes, value) -> None:
+        blob = msgpack.packb(value, use_bin_type=True)
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO gcs_tables (tab, key, value) "
+                "VALUES (?, ?, ?)", (table, key, blob))
+            self._db.commit()
+
+    def get(self, table: str, key: bytes):
+        with self._lock:
+            row = self._db.execute(
+                "SELECT value FROM gcs_tables WHERE tab = ? AND key = ?",
+                (table, key)).fetchone()
+        if row is None:
+            return None
+        return msgpack.unpackb(row[0], raw=False)
+
+    def delete(self, table: str, key: bytes) -> None:
+        with self._lock:
+            self._db.execute(
+                "DELETE FROM gcs_tables WHERE tab = ? AND key = ?",
+                (table, key))
+            self._db.commit()
+
+    def load_all(self, table: str) -> Iterator[Tuple[bytes, object]]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT key, value FROM gcs_tables WHERE tab = ?",
+                (table,)).fetchall()
+        for key, blob in rows:
+            yield key, msgpack.unpackb(blob, raw=False)
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()

@@ -139,11 +139,32 @@ class Node:
         self.processes.append(ProcessHandle(proc, name))
         return proc
 
-    def _start_gcs(self) -> None:
+    def _start_gcs(self, port: int = 0) -> None:
+        persist = os.path.join(self.session_dir, "gcs_tables.sqlite")
         proc = self._spawn(["ray_tpu._private.gcs_server",
-                            "--config", self.config.to_json()], "gcs")
+                            "--config", self.config.to_json(),
+                            "--port", str(port),
+                            "--persist-path", persist], "gcs")
         info = _read_json_line(proc, 30, "gcs_server")
         self.gcs_address = f"127.0.0.1:{info['port']}"
+        self._gcs_proc = proc
+
+    def restart_gcs(self) -> None:
+        """Restart a dead GCS on the SAME port: state comes back from the
+        write-through table storage, raylets and workers re-register over
+        their reconnect paths (reference: GCS fault tolerance via Redis
+        persistence + HandleNotifyGCSRestart)."""
+        port = int(self.gcs_address.rsplit(":", 1)[1])
+        self.processes = [p for p in self.processes
+                          if p.proc is not getattr(self, "_gcs_proc", None)]
+        self._start_gcs(port=port)
+
+    def kill_gcs(self) -> None:
+        """Kill the GCS process (fault-injection hook for tests)."""
+        proc = getattr(self, "_gcs_proc", None)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=5)
 
     def _start_raylet(self) -> None:
         proc = self._spawn([

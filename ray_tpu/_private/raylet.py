@@ -109,6 +109,7 @@ class Raylet:
         self.gcs = await rpc.connect(ghost, int(gport),
                                      handler=self._on_gcs_message,
                                      name="raylet->gcs")
+        self.gcs.on_close = self._on_gcs_close
         # Native object-transfer server: bulk object bytes move
         # store-to-store over raw TCP (C++ threads), Python only
         # coordinates (reference: ObjectManager's dedicated rpc service).
@@ -122,18 +123,8 @@ class Raylet:
                              "falling back to rpc chunk transfer")
             self.transfer_server = None
             transfer_port = 0
-        await self.gcs.call("register_node", {
-            "node_id": self.node_id.binary(),
-            "address": self.address,
-            "hostname": os.uname().nodename,
-            "store_path": self.store_path,
-            "resources": self.resources_total,
-            "labels": self.labels,
-            "slice_id": self.slice_id,
-            "transfer_port": transfer_port,
-        })
-        await self.gcs.call("subscribe", {"channel": "cluster_view"})
-        await self.gcs.call("subscribe", {"channel": "jobs"})
+        self._transfer_port = transfer_port
+        await self._register_with_gcs(self.gcs)
         self._bg.append(asyncio.get_event_loop().create_task(self._heartbeat_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._reap_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(
@@ -163,6 +154,57 @@ class Raylet:
             await self.gcs.close()
         if self.store:
             self.store.close()
+
+    async def _register_with_gcs(self, conn: rpc.Connection) -> None:
+        await conn.call("register_node", {
+            "node_id": self.node_id.binary(),
+            "address": self.address,
+            "hostname": os.uname().nodename,
+            "store_path": self.store_path,
+            "resources": self.resources_total,
+            "labels": self.labels,
+            "slice_id": self.slice_id,
+            "transfer_port": self._transfer_port,
+            # Live actors hosted here: a restarted GCS reconciles its
+            # restored actor table against this (an actor that died
+            # during GCS downtime must not stay ALIVE forever).
+            "live_actors": [w.actor_id for w in self.workers.values()
+                            if w.actor_id and w.state != "dead"],
+        })
+        await conn.call("subscribe", {"channel": "cluster_view"})
+        await conn.call("subscribe", {"channel": "jobs"})
+
+    def _on_gcs_close(self, conn: rpc.Connection) -> None:
+        if not self.dead:
+            asyncio.get_event_loop().create_task(self._reconnect_gcs())
+
+    async def _reconnect_gcs(self) -> None:
+        """The GCS died: reconnect and re-register under the same node id
+        once it is back (reference: raylets buffer through GCS restarts —
+        HandleNotifyGCSRestart, node_manager.h:614). Workers keep running
+        throughout; only control-plane calls stall."""
+        ghost, gport = self.gcs_address.rsplit(":", 1)
+        deadline = time.monotonic() + self.config.gcs_down_exit_s
+        while not self.dead:
+            conn = None
+            try:
+                conn = await rpc.connect(ghost, int(gport),
+                                         handler=self._on_gcs_message,
+                                         name="raylet->gcs")
+                await self._register_with_gcs(conn)
+            except Exception:
+                if conn is not None:
+                    await conn.close()
+                if time.monotonic() > deadline:
+                    logger.error("GCS unreachable for %.0fs; exiting",
+                                 self.config.gcs_down_exit_s)
+                    os._exit(1)
+                await asyncio.sleep(0.5)
+                continue
+            conn.on_close = self._on_gcs_close
+            self.gcs = conn
+            logger.info("re-registered with restarted GCS")
+            return
 
     async def _on_gcs_message(self, method: str, data, conn):
         if method == "publish":

@@ -233,7 +233,22 @@ class Worker:
         return self.core.function_manager.export(fn)
 
     def gcs_call(self, method: str, data=None, timeout: float = 30.0):
-        return self._run(self.core.gcs.call(method, data, timeout=timeout))
+        import time as _time
+
+        from ray_tpu.core.rpc import ConnectionLost
+
+        # Ride through GCS restarts: the core reconnects in the
+        # background (core_worker._reconnect_gcs); retry on the fresh
+        # connection until the deadline.
+        deadline = _time.monotonic() + timeout
+        while True:
+            try:
+                return self._run(
+                    self.core.gcs.call(method, data, timeout=timeout))
+            except (ConnectionLost, ConnectionError, OSError):
+                if _time.monotonic() > deadline:
+                    raise
+                _time.sleep(0.3)
 
 
 def global_worker() -> Worker:

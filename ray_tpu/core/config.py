@@ -47,6 +47,9 @@ class Config:
     # before the producer pauses (reference:
     # _generator_backpressure_num_objects). <=0 disables.
     streaming_backpressure_num_items: int = 8
+    # How long a raylet outlives an unreachable GCS before exiting
+    # (reference: gcs_rpc_server_reconnect_timeout_s).
+    gcs_down_exit_s: float = 60.0
     max_pending_lease_requests: int = 8
     worker_lease_timeout_s: float = 30.0
     # --- health / failure detection ---
