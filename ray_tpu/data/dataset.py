@@ -21,8 +21,8 @@ from ray_tpu.data.block import (Block, batch_to_block, block_from_items,
                                 block_to_rows, concat_blocks, format_batch,
                                 iter_block_batches)
 from ray_tpu.data.context import DataContext
-from ray_tpu.data.executor import (AllToAllStage, MapStage,
-                                   StreamingExecutor)
+from ray_tpu.data.executor import (AllToAllStage, MapStage, ShuffleStage,
+                                   StreamingExecutor, _block_rows)
 
 
 class Dataset:
@@ -114,37 +114,22 @@ class Dataset:
         return self._with(MapStage("RenameColumns", transform))
 
     # ---------------- all-to-all ----------------
+    # Built-in shuffles run as distributed two-phase exchanges
+    # (map-partition → reduce-merge over ObjectRefs); see
+    # executor.ShuffleStage. Reference:
+    # python/ray/data/_internal/planner/exchange/.
     def repartition(self, num_blocks: int) -> "Dataset":
-        def exchange(blocks: List[Block]) -> List[Block]:
-            total = concat_blocks(blocks)
-            n = total.num_rows
-            if n == 0:
-                return [total]
-            step = (n + num_blocks - 1) // num_blocks
-            return [total.slice(i, min(step, n - i))
-                    for i in builtins.range(0, n, step)]
-        return self._with(AllToAllStage(f"Repartition({num_blocks})",
-                                        exchange))
+        return self._with(ShuffleStage(f"Repartition({num_blocks})",
+                                       "repartition",
+                                       num_outputs=num_blocks))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        def exchange(blocks: List[Block]) -> List[Block]:
-            total = concat_blocks(blocks)
-            n = total.num_rows
-            rng = np.random.RandomState(seed)
-            perm = rng.permutation(n)
-            shuffled = total.take(perm)
-            k = max(1, len(blocks))
-            step = (n + k - 1) // k if n else 1
-            return [shuffled.slice(i, min(step, n - i))
-                    for i in builtins.range(0, n, step)]
-        return self._with(AllToAllStage("RandomShuffle", exchange))
+        return self._with(ShuffleStage("RandomShuffle", "shuffle",
+                                       seed=seed))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        def exchange(blocks: List[Block]) -> List[Block]:
-            total = concat_blocks(blocks)
-            order = "descending" if descending else "ascending"
-            return [total.sort_by([(key, order)])]
-        return self._with(AllToAllStage(f"Sort({key})", exchange))
+        return self._with(ShuffleStage(f"Sort({key})", "sort", key=key,
+                                       descending=descending))
 
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
@@ -285,34 +270,41 @@ class Dataset:
                 for i in builtins.range(n)]
 
     def materialize(self) -> "Dataset":
-        blocks = [ray_tpu.get(r) for r in self.iter_block_refs()]
-
-        def make(b: Block):
-            return lambda: b
-        return Dataset([make(b) for b in blocks])
+        """Execute and pin the result as block REFS: values stay in the
+        object plane; later consumers (worker-side read tasks) fetch
+        them directly — the driver never touches block bytes."""
+        refs = list(self.iter_block_refs())
+        ds = Dataset([_ref_read_task(r) for r in refs])
+        ds._pinned_refs = refs  # keep the driver-local refs alive
+        return ds
 
     def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
-        """Materialize and split into n datasets (reference:
-        Dataset.split — used to hand shards to train workers).
+        """Execute and split into n datasets (reference: Dataset.split —
+        used to hand shards to train workers). Row counting and slicing
+        happen worker-side over refs; no block lands in the driver.
 
         equal=False (default): every row lands somewhere (first shards
         take the remainder). equal=True: all shards get exactly
         rows//n rows — the remainder rows are DROPPED (the reference's
         documented equalize behavior)."""
-        blocks = [ray_tpu.get(r) for r in self.iter_block_refs()]
-        total = concat_blocks(blocks)
-        rows = total.num_rows
+        refs = list(self.iter_block_refs())
+        counts = ray_tpu.get([_block_rows.remote(r) for r in refs])
+        rows = sum(counts)
         base = rows // n
         sizes = [base] * n
         if not equal:
             for i in builtins.range(rows - base * n):
                 sizes[i] += 1
+        shards = _plan_row_ranges(refs, counts, sizes)
         out = []
-        offset = 0
-        for size in sizes:
-            piece = total.slice(offset, size)
-            out.append(Dataset([lambda b=piece: b]))
-            offset += size
+        for shard, size in zip(shards, sizes):
+            tasks = [_ref_slice_task(r, s, ln) for r, s, ln in shard]
+            if not tasks:  # empty shard: keep the dataset's schema
+                tasks = [_ref_slice_task(refs[0], 0, 0)] if refs else \
+                    [lambda: block_from_items([])]
+            ds = Dataset(tasks)
+            ds._pinned_refs = refs
+            out.append(ds)
         return out
 
     def train_test_split(self, test_size: float, *,
@@ -323,13 +315,21 @@ class Dataset:
         if not 0 < test_size < 1:
             raise ValueError("test_size must be in (0, 1)")
         ds = self.random_shuffle(seed=seed) if shuffle else self
-        blocks = [ray_tpu.get(r) for r in ds.iter_block_refs()]
-        total = concat_blocks(blocks)
-        rows = total.num_rows
+        refs = list(ds.iter_block_refs())
+        counts = ray_tpu.get([_block_rows.remote(r) for r in refs])
+        rows = sum(counts)
         n_test = int(rows * test_size)
-        train = total.slice(0, rows - n_test)
-        test = total.slice(rows - n_test, n_test)
-        return [Dataset([lambda b=train: b]), Dataset([lambda b=test: b])]
+        shards = _plan_row_ranges(refs, counts, [rows - n_test, n_test])
+        out = []
+        for shard in shards:
+            tasks = [_ref_slice_task(r, s, ln) for r, s, ln in shard]
+            if not tasks:  # empty shard: keep the dataset's schema
+                tasks = [_ref_slice_task(refs[0], 0, 0)] if refs else \
+                    [lambda: block_from_items([])]
+            piece = Dataset(tasks)
+            piece._pinned_refs = refs
+            out.append(piece)
+        return out
 
     # ---------------- writes ----------------
     def _write_blocks(self, path: str, ext: str, write_one) -> List[str]:
@@ -511,9 +511,22 @@ class DataIterator:
         return sum(b.num_rows for b in self._iter_local_blocks())
 
 
+@ray_tpu.remote
+def _partial_agg(block: Block, key: str, init, update) -> Dict[Any, Any]:
+    """Per-block partial aggregation (map side of a groupby)."""
+    df = block_to_pandas(block)
+    out: Dict[Any, Any] = {}
+    for k, group in df.groupby(key):
+        acc = out.get(k, init())
+        out[k] = update(acc, group)
+    return out
+
+
 class GroupedData:
-    """Hash aggregation: per-block partial aggs combined on the driver
-    (reference: python/ray/data/grouped_data.py)."""
+    """Hash aggregation: per-block partial aggs computed as remote tasks,
+    only the (small) per-key accumulators reach the driver for the final
+    merge (reference: python/ray/data/grouped_data.py over the exchange
+    task graph)."""
 
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
@@ -521,14 +534,13 @@ class GroupedData:
 
     def _agg(self, col: Optional[str], init, update, merge, finalize=None):
         key = self._key
+        partial_refs = [_partial_agg.remote(ref, key, init, update)
+                        for ref in self._ds.iter_block_refs()]
         partials: Dict[Any, Any] = {}
-        for block in self._ds.iter_blocks():
-            import pandas as pd
-
-            df = block_to_pandas(block)
-            for k, group in df.groupby(key):
-                acc = partials.get(k, init())
-                partials[k] = update(acc, group)
+        for part in ray_tpu.get(partial_refs):
+            for k, acc in part.items():
+                partials[k] = merge(partials[k], acc) \
+                    if k in partials else acc
         rows = []
         for k in sorted(partials, key=lambda x: (x is None, x)):
             v = partials[k]
@@ -541,27 +553,30 @@ class GroupedData:
         return self._agg(
             None, lambda: {"count()": 0},
             lambda acc, g: {"count()": acc["count()"] + len(g)},
-            None)
+            lambda a, b: {"count()": a["count()"] + b["count()"]})
 
     def sum(self, col: str) -> Dataset:
         name = f"sum({col})"
         return self._agg(
             col, lambda: {name: 0},
-            lambda acc, g: {name: acc[name] + g[col].sum()}, None)
+            lambda acc, g: {name: acc[name] + g[col].sum()},
+            lambda a, b: {name: a[name] + b[name]})
 
     def min(self, col: str) -> Dataset:
         name = f"min({col})"
         return self._agg(
             col, lambda: {name: None},
             lambda acc, g: {name: g[col].min() if acc[name] is None
-                            else min(acc[name], g[col].min())}, None)
+                            else min(acc[name], g[col].min())},
+            lambda a, b: {name: min(a[name], b[name])})
 
     def max(self, col: str) -> Dataset:
         name = f"max({col})"
         return self._agg(
             col, lambda: {name: None},
             lambda acc, g: {name: g[col].max() if acc[name] is None
-                            else max(acc[name], g[col].max())}, None)
+                            else max(acc[name], g[col].max())},
+            lambda a, b: {name: max(a[name], b[name])})
 
     def mean(self, col: str) -> Dataset:
         name = f"mean({col})"
@@ -569,12 +584,42 @@ class GroupedData:
             col, lambda: {"_s": 0.0, "_n": 0},
             lambda acc, g: {"_s": acc["_s"] + g[col].sum(),
                             "_n": acc["_n"] + len(g)},
-            None,
+            lambda a, b: {"_s": a["_s"] + b["_s"], "_n": a["_n"] + b["_n"]},
             finalize=lambda acc: {name: acc["_s"] / max(acc["_n"], 1)})
 
 
 def _name(fn) -> str:
     return getattr(fn, "__name__", repr(fn))
+
+
+def _ref_read_task(ref):
+    """Read task resolving a pinned block ref (worker-side fetch)."""
+    return lambda: ray_tpu.get(ref)
+
+
+def _ref_slice_task(ref, start: int, length: int):
+    return lambda: ray_tpu.get(ref).slice(start, length)
+
+
+def _plan_row_ranges(refs, counts: List[int],
+                     sizes: List[int]) -> List[List[tuple]]:
+    """Assign contiguous global row ranges of sizes[i] to each shard as
+    (ref, start_in_block, length) pieces."""
+    shards: List[List[tuple]] = [[] for _ in sizes]
+    block_starts = []
+    acc = 0
+    for c in counts:
+        block_starts.append(acc)
+        acc += c
+    shard_start = 0
+    for i, size in enumerate(sizes):
+        s, e = shard_start, shard_start + size
+        for ref, bs, c in zip(refs, block_starts, counts):
+            lo, hi = max(s, bs), min(e, bs + c)
+            if lo < hi:
+                shards[i].append((ref, lo - bs, hi - lo))
+        shard_start = e
+    return shards
 
 
 # ---------------------------------------------------------------------------

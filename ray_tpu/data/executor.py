@@ -40,9 +40,28 @@ class MapStage:
 
 @dataclasses.dataclass
 class AllToAllStage:
+    """Custom exchange: fn(list-of-blocks) -> list-of-blocks, executed in
+    ONE remote streaming task (blocks never land in the driver). Built-in
+    shuffles use the two-phase ShuffleStage instead."""
+
     name: str
-    # driver-side: takes materialized blocks, returns new block list
     fn: Callable[[List[Block]], List[Block]]
+
+
+@dataclasses.dataclass
+class ShuffleStage:
+    """Distributed two-phase exchange (reference: the exchange task
+    graphs in python/ray/data/_internal/planner/exchange/ —
+    sort_task_spec.py, shuffle_task_spec.py): map tasks partition each
+    input block into R parts, reduce tasks merge the r-th part of every
+    map. The driver only routes ObjectRefs."""
+
+    name: str
+    kind: str                       # "repartition" | "shuffle" | "sort"
+    num_outputs: Optional[int] = None  # None → len(input blocks)
+    key: Optional[str] = None       # sort key
+    descending: bool = False
+    seed: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -100,6 +119,96 @@ def _exec_read(read_task, target_bytes: int):
 @ray_tpu.remote
 def _exec_map(fn, block: Block) -> Block:
     return fn(block)
+
+
+@ray_tpu.remote
+def _block_rows(block: Block) -> int:
+    return block.num_rows
+
+
+@ray_tpu.remote
+def _slice_block(block: Block, start: int, length: int) -> Block:
+    return block.slice(start, length)
+
+
+@ray_tpu.remote(num_returns="streaming")
+def _exec_exchange(fn, *blocks):
+    """Custom all-to-all runs in one worker, streaming its outputs."""
+    for out in fn(list(blocks)):
+        yield out
+
+
+@ray_tpu.remote
+def _sample_keys(block: Block, key: str, k: int):
+    """Sort sampling (reference: SortTaskSpec.sample_boundaries)."""
+    import numpy as np
+
+    col = block.column(key).drop_null().to_numpy(zero_copy_only=False)
+    if len(col) == 0:
+        return np.array([])
+    idx = np.random.RandomState(0).choice(
+        len(col), size=min(k, len(col)), replace=False)
+    return col[idx]
+
+
+@ray_tpu.remote
+def _shuffle_map(block: Block, kind: str, num_reducers: int,
+                 key, boundaries, seed, map_index: int):
+    """Map side of the exchange: split one block into num_reducers parts.
+
+    boundaries: sort → key cut points; repartition → this block's global
+    row start + the global reducer row edges (order-preserving split).
+    """
+    import numpy as np
+
+    n = block.num_rows
+    if kind == "sort":
+        # Arrow sort handles nulls (placed at the end); boundary cuts are
+        # computed over the non-null prefix, so null rows land in the
+        # last partition.
+        sorted_block = block.sort_by([(key, "ascending")])
+        arr = sorted_block.column(key)
+        valid = arr.drop_null().to_numpy(zero_copy_only=False)
+        cuts = list(np.searchsorted(valid, boundaries, side="right")) \
+            if len(boundaries) else []
+        cuts += [n] * (num_reducers - 1 - len(cuts))  # degenerate samples
+        edges = [0, *cuts, n]
+        parts = [sorted_block.slice(edges[i], edges[i + 1] - edges[i])
+                 for i in range(num_reducers)]
+    elif kind == "shuffle":
+        rng = np.random.RandomState(
+            None if seed is None else (seed + 31 * map_index) % (2 ** 31))
+        assign = rng.randint(0, num_reducers, size=n)
+        parts = [block.take(np.nonzero(assign == r)[0])
+                 for r in range(num_reducers)]
+    else:  # repartition: order-preserving global-contiguous split
+        global_start, reducer_edges = boundaries
+        gs, ge = global_start, global_start + n
+        parts = []
+        for r in range(num_reducers):
+            lo = max(gs, reducer_edges[r])
+            hi = min(ge, reducer_edges[r + 1])
+            parts.append(block.slice(lo - gs, max(hi - lo, 0)))
+    return parts[0] if num_reducers == 1 else tuple(parts)
+
+
+@ray_tpu.remote
+def _shuffle_reduce(kind: str, key, descending: bool, seed,
+                    reduce_index: int, *parts):
+    """Reduce side: merge the reduce_index-th part of every map."""
+    import numpy as np
+
+    merged = concat_blocks([p for p in parts if p.num_rows]) \
+        if any(p.num_rows for p in parts) else parts[0]
+    if kind == "sort" and merged.num_rows:
+        order = "descending" if descending else "ascending"
+        merged = merged.sort_by([(key, order)])
+    elif kind == "shuffle" and merged.num_rows:
+        rng = np.random.RandomState(
+            None if seed is None else (seed + 17 * reduce_index + 7) %
+            (2 ** 31))
+        merged = merged.take(rng.permutation(merged.num_rows))
+    return merged
 
 
 @ray_tpu.remote
@@ -205,7 +314,7 @@ class StreamingExecutor:
         segments: List[Tuple[List[MapStage], Optional[Stage]]] = []
         cur: List[MapStage] = []
         for st in stages:
-            if isinstance(st, (AllToAllStage, LimitStage)):
+            if isinstance(st, (AllToAllStage, ShuffleStage, LimitStage)):
                 segments.append((cur, st))
                 cur = []
             else:
@@ -218,10 +327,13 @@ class StreamingExecutor:
                 source = self._stream_one(source, st, rm)
             if isinstance(boundary, LimitStage):
                 source = self._stream_limit(source, boundary.n)
+            elif isinstance(boundary, ShuffleStage):
+                source = self._execute_shuffle(boundary, source, rm)
             elif boundary is not None:
-                blocks = [ray_tpu.get(r) for r in source]
-                out_blocks = boundary.fn(blocks)
-                source = iter([ray_tpu.put(b) for b in out_blocks])
+                # Custom exchange: one remote streaming task; the driver
+                # only forwards refs.
+                refs = list(source)
+                source = iter(_exec_exchange.remote(boundary.fn, *refs))
 
         def finalize(src):
             try:
@@ -235,21 +347,88 @@ class StreamingExecutor:
 
         return finalize(source)
 
+    def _execute_shuffle(self, spec: ShuffleStage, source: Iterator[Any],
+                         rm: ResourceManager) -> Iterator[Any]:
+        """Two-phase distributed exchange over ObjectRefs: map-side
+        partition then reduce-side merge; no block ever lands in the
+        driver (reference: _internal/planner/exchange/)."""
+        import numpy as np
+
+        map_stats = rm.register_op(f"{spec.name}:map")
+        red_stats = rm.register_op(f"{spec.name}:reduce")
+        refs = list(source)  # barrier: all-to-all needs the full frontier
+        if not refs:
+            return iter(())
+        n_reducers = max(1, spec.num_outputs or len(refs))
+
+        if spec.kind == "sort":
+            boundaries: Any = []
+            samples = ray_tpu.get(
+                [_sample_keys.remote(r, spec.key, 32) for r in refs])
+            pool = np.sort(np.concatenate(
+                [s for s in samples if len(s)] or [np.array([])]))
+            if len(pool) and n_reducers > 1:
+                q = [len(pool) * (i + 1) // n_reducers
+                     for i in range(n_reducers - 1)]
+                boundaries = pool[np.minimum(q, len(pool) - 1)].tolist()
+            per_map_boundaries = [boundaries] * len(refs)
+        elif spec.kind == "repartition":
+            # Order-preserving split needs each map's global row offset
+            # and the global reducer edges (counts are tiny ints).
+            counts = ray_tpu.get([_block_rows.remote(r) for r in refs])
+            total = sum(counts)
+            base, rem = divmod(total, n_reducers)
+            edges = [0]
+            for r in range(n_reducers):
+                edges.append(edges[-1] + base + (1 if r < rem else 0))
+            starts = []
+            acc = 0
+            for c in counts:
+                starts.append(acc)
+                acc += c
+            per_map_boundaries = [(s, edges) for s in starts]
+        else:
+            per_map_boundaries = [None] * len(refs)
+
+        maps = []
+        for m, ref in enumerate(refs):
+            out = _shuffle_map.options(num_returns=n_reducers).remote(
+                ref, spec.kind, n_reducers, spec.key,
+                per_map_boundaries[m], spec.seed, m)
+            maps.append([out] if n_reducers == 1 else out)
+        out_refs = []
+        for r in range(n_reducers):
+            out_refs.append(_shuffle_reduce.remote(
+                spec.kind, spec.key, spec.descending, spec.seed, r,
+                *[parts[r] for parts in maps]))
+        if spec.kind == "sort" and spec.descending:
+            out_refs.reverse()
+        # Informational stats, finalized here: all tasks are already
+        # submitted and will run even if a downstream limit stops
+        # consuming the outputs early.
+        map_stats.tasks_submitted = map_stats.tasks_finished = len(maps)
+        map_stats.blocks_out = len(maps) * n_reducers
+        red_stats.tasks_submitted = red_stats.tasks_finished = n_reducers
+        red_stats.blocks_out = n_reducers
+        return iter(out_refs)
+
     @staticmethod
     def _stream_limit(source: Iterator[Any], n: int) -> Iterator[Any]:
         """Early-exit: stops consuming `source` (and thus all upstream task
-        submission) once n rows have been yielded."""
+        submission) once n rows have been yielded. Row counting and the
+        final partial slice run as remote tasks — blocks stay off the
+        driver."""
         seen = 0
         for ref in source:
             if seen >= n:
                 break
-            block = ray_tpu.get(ref)
-            take = min(block.num_rows, n - seen)
+            rows = ray_tpu.get(_block_rows.remote(ref))
+            take = min(rows, n - seen)
             seen += take
-            if take == block.num_rows:
+            if take == rows:
                 yield ref
             else:
-                yield ray_tpu.put(block.slice(0, take))
+                yield _slice_block.remote(ref, 0, take)
             if seen >= n:
                 break
 

@@ -254,3 +254,27 @@ def test_workers_exit_when_raylet_dies(ray_start_cluster):
     while time.time() < deadline and alive(worker_pid):
         time.sleep(0.3)
     assert not alive(worker_pid), "worker orphaned after raylet death"
+
+
+def test_distributed_shuffle_multi_node(ray_start_cluster):
+    """Two-phase exchange across real raylet processes."""
+    from ray_tpu import data as rd
+
+    cluster = ray_start_cluster()
+    cluster.add_node(resources={"CPU": 2})
+    cluster.add_node(resources={"CPU": 2})
+    cluster.wait_for_nodes(2)
+    ray_tpu.init(address=cluster.address)
+
+    ds = rd.range(300, parallelism=6)
+    out = ds.sort("id", descending=True).take_all()
+    assert [r["id"] for r in out] == list(range(299, -1, -1))
+
+    shuffled = rd.range(120, parallelism=4).random_shuffle(
+        seed=3).take_all()
+    ids = [r["id"] for r in shuffled]
+    assert sorted(ids) == list(range(120)) and ids != list(range(120))
+
+    parts = list(rd.range(90, parallelism=3).repartition(9).iter_blocks())
+    assert len(parts) == 9
+    assert sum(b.num_rows for b in parts) == 90
