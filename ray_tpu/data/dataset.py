@@ -119,6 +119,8 @@ class Dataset:
     # executor.ShuffleStage. Reference:
     # python/ray/data/_internal/planner/exchange/.
     def repartition(self, num_blocks: int) -> "Dataset":
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         return self._with(ShuffleStage(f"Repartition({num_blocks})",
                                        "repartition",
                                        num_outputs=num_blocks))
@@ -296,16 +298,7 @@ class Dataset:
             for i in builtins.range(rows - base * n):
                 sizes[i] += 1
         shards = _plan_row_ranges(refs, counts, sizes)
-        out = []
-        for shard, size in zip(shards, sizes):
-            tasks = [_ref_slice_task(r, s, ln) for r, s, ln in shard]
-            if not tasks:  # empty shard: keep the dataset's schema
-                tasks = [_ref_slice_task(refs[0], 0, 0)] if refs else \
-                    [lambda: block_from_items([])]
-            ds = Dataset(tasks)
-            ds._pinned_refs = refs
-            out.append(ds)
-        return out
+        return [_shard_dataset(refs, shard) for shard in shards]
 
     def train_test_split(self, test_size: float, *,
                          shuffle: bool = False,
@@ -320,16 +313,7 @@ class Dataset:
         rows = sum(counts)
         n_test = int(rows * test_size)
         shards = _plan_row_ranges(refs, counts, [rows - n_test, n_test])
-        out = []
-        for shard in shards:
-            tasks = [_ref_slice_task(r, s, ln) for r, s, ln in shard]
-            if not tasks:  # empty shard: keep the dataset's schema
-                tasks = [_ref_slice_task(refs[0], 0, 0)] if refs else \
-                    [lambda: block_from_items([])]
-            piece = Dataset(tasks)
-            piece._pinned_refs = refs
-            out.append(piece)
-        return out
+        return [_shard_dataset(refs, shard) for shard in shards]
 
     # ---------------- writes ----------------
     def _write_blocks(self, path: str, ext: str, write_one) -> List[str]:
@@ -599,6 +583,18 @@ def _ref_read_task(ref):
 
 def _ref_slice_task(ref, start: int, length: int):
     return lambda: ray_tpu.get(ref).slice(start, length)
+
+
+def _shard_dataset(refs, shard) -> "Dataset":
+    """Dataset over (ref, start, len) pieces; empty shards keep the
+    source schema via a zero-length slice of the first block."""
+    tasks = [_ref_slice_task(r, s, ln) for r, s, ln in shard]
+    if not tasks:
+        tasks = [_ref_slice_task(refs[0], 0, 0)] if refs else \
+            [lambda: block_from_items([])]
+    ds = Dataset(tasks)
+    ds._pinned_refs = refs
+    return ds
 
 
 def _plan_row_ranges(refs, counts: List[int],

@@ -163,18 +163,27 @@ def _shuffle_map(block: Block, kind: str, num_reducers: int,
 
     n = block.num_rows
     if kind == "sort":
-        # Arrow sort handles nulls (placed at the end); boundary cuts are
-        # computed over the non-null prefix, so null rows land in the
-        # last partition.
+        # Partition ascending by the sampled boundaries. Null rows are
+        # routed to whichever partition ends up LAST in the global output
+        # (ascending: the last partition; descending: partition 0, since
+        # reducer order is reversed) so nulls always sort to the end.
+        descending = boundaries[0]
+        boundaries = boundaries[1]
         sorted_block = block.sort_by([(key, "ascending")])
         arr = sorted_block.column(key)
+        n_valid = len(arr) - arr.null_count
         valid = arr.drop_null().to_numpy(zero_copy_only=False)
         cuts = list(np.searchsorted(valid, boundaries, side="right")) \
             if len(boundaries) else []
-        cuts += [n] * (num_reducers - 1 - len(cuts))  # degenerate samples
-        edges = [0, *cuts, n]
+        cuts += [n_valid] * (num_reducers - 1 - len(cuts))  # degenerate
+        edges = [0, *cuts, n_valid]
         parts = [sorted_block.slice(edges[i], edges[i + 1] - edges[i])
                  for i in range(num_reducers)]
+        if n_valid < n:
+            nulls = sorted_block.slice(n_valid, n - n_valid)
+            tail = 0 if descending else num_reducers - 1
+            parts[tail] = concat_blocks([parts[tail], nulls]) \
+                if parts[tail].num_rows else nulls
     elif kind == "shuffle":
         rng = np.random.RandomState(
             None if seed is None else (seed + 31 * map_index) % (2 ** 31))
@@ -359,7 +368,8 @@ class StreamingExecutor:
         refs = list(source)  # barrier: all-to-all needs the full frontier
         if not refs:
             return iter(())
-        n_reducers = max(1, spec.num_outputs or len(refs))
+        n_reducers = max(1, len(refs) if spec.num_outputs is None
+                         else spec.num_outputs)
 
         if spec.kind == "sort":
             boundaries: Any = []
@@ -371,7 +381,7 @@ class StreamingExecutor:
                 q = [len(pool) * (i + 1) // n_reducers
                      for i in range(n_reducers - 1)]
                 boundaries = pool[np.minimum(q, len(pool) - 1)].tolist()
-            per_map_boundaries = [boundaries] * len(refs)
+            per_map_boundaries = [(spec.descending, boundaries)] * len(refs)
         elif spec.kind == "repartition":
             # Order-preserving split needs each map's global row offset
             # and the global reducer edges (counts are tiny ints).

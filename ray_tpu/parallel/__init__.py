@@ -11,6 +11,7 @@ ray.util.collective API shape) live in `ray_tpu.collective`.
 
 from ray_tpu.parallel.mesh import (
     MeshSpec,
+    create_hybrid_mesh,
     create_mesh,
     auto_mesh,
     mesh_shape_for,
@@ -32,6 +33,7 @@ from ray_tpu.parallel.bootstrap import (
 
 __all__ = [
     "MeshSpec",
+    "create_hybrid_mesh",
     "create_mesh",
     "auto_mesh",
     "mesh_shape_for",

@@ -19,6 +19,12 @@ Mesh axis order matters on hardware: axes that carry the heaviest
 collectives (tp, sp) must map to minor / adjacent ICI dimensions, so they
 come LAST in the axis tuple (jax device order is minor-to-major locality
 in reverse order of the mesh shape tuple's last axes).
+
+Multi-slice (SURVEY §5.8 plane 3): the ``dcn`` axis is OUTERMOST — it
+spans TPU slices connected by data-center network, so only the lightest
+per-step collective (the data-parallel gradient all-reduce) crosses it;
+fsdp/tp/sp stay inside a slice on ICI. Build such meshes with
+``create_hybrid_mesh``.
 """
 
 from __future__ import annotations
@@ -29,7 +35,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-AXIS_ORDER = ("dp", "pp", "fsdp", "ep", "sp", "tp")
+AXIS_ORDER = ("dcn", "dp", "pp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,6 +47,7 @@ class MeshSpec:
         MeshSpec(dp=-1, tp=4)   # on 32 devices -> {"dp": 8, "tp": 4}
     """
 
+    dcn: int = 1
     dp: int = 1
     pp: int = 1
     fsdp: int = 1
@@ -110,6 +117,43 @@ def create_mesh(axis_sizes: Dict[str, int],
     except Exception:
         dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, names)
+
+
+def create_hybrid_mesh(axis_sizes: Dict[str, int],
+                       devices: Optional[Sequence] = None):
+    """Multi-slice mesh: the outer ``dcn`` axis spans slices (DCN links);
+    every other axis stays within one slice (ICI).
+
+    On real multi-slice TPU hardware the device→mesh layout comes from
+    ``mesh_utils.create_hybrid_device_mesh`` (keyed on each device's
+    ``slice_index``); elsewhere (CPU worlds, single-slice ICI) devices are
+    grouped contiguously so process-local devices form a slice — the
+    layout the driver's virtual multi-process worlds produce.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    num_slices = int(axis_sizes.get("dcn", 1))
+    names = tuple(a for a in AXIS_ORDER if axis_sizes.get(a, 1) >= 1)
+    ici_names = tuple(a for a in names if a != "dcn")
+    ici_shape = tuple(axis_sizes.get(a, 1) for a in ici_names)
+    if num_slices * math.prod(ici_shape) != len(devices):
+        raise ValueError(
+            f"hybrid mesh dcn={num_slices} x ici={dict(zip(ici_names, ici_shape))} "
+            f"!= {len(devices)} devices")
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if len(slice_ids) == num_slices and None not in slice_ids:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            (1, *ici_shape),
+            (num_slices, *([1] * len(ici_shape))),
+            devices=devices).reshape((num_slices, *ici_shape))
+    else:
+        dev_array = np.asarray(devices).reshape((num_slices, *ici_shape))
+    return Mesh(dev_array, ("dcn", *ici_names))
 
 
 def auto_mesh(spec: Optional[MeshSpec] = None,

@@ -21,7 +21,9 @@ LogicalAxisRules = Dict[str, Union[None, str, Tuple[str, ...]]]
 # data-ish axis; embed over fsdp (ZeRO-3 analog); heads/mlp over tp;
 # sequence over sp (ring attention); experts over ep.
 DEFAULT_RULES: LogicalAxisRules = {
-    "batch": ("dp", "fsdp"),
+    # batch splits over every data-ish axis; "dcn" is the inter-slice
+    # axis, so the only cross-slice collective is the dp grad all-reduce.
+    "batch": ("dcn", "dp", "fsdp"),
     "embed": "fsdp",
     "mlp": "tp",
     "heads": "tp",

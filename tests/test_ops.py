@@ -106,13 +106,13 @@ def test_mesh_and_sharding_rules():
     from jax.sharding import PartitionSpec as P
 
     sizes = MeshSpec(dp=-1, tp=2).resolve(8)
-    assert sizes == {"dp": 4, "pp": 1, "fsdp": 1, "ep": 1, "sp": 1,
-                     "tp": 2}
+    assert sizes == {"dcn": 1, "dp": 4, "pp": 1, "fsdp": 1, "ep": 1,
+                     "sp": 1, "tp": 2}
     mesh = create_mesh(sizes)
     assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
 
     assert spec_for("batch", "length", "embed") == \
-        P(("dp", "fsdp"), "sp", None)  # embed->fsdp already used by batch
+        P(("dcn", "dp", "fsdp"), "sp", None)  # embed->fsdp used by batch
     assert spec_for("embed", "mlp") == P("fsdp", "tp")
     s = named_sharding(mesh, "batch", None, "embed")
     assert s.mesh is not None

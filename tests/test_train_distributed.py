@@ -69,3 +69,53 @@ def test_jax_distributed_two_process_world(ray_start_regular, tmp_path):
     # Loss parity: the sharded global reduction equals the single-process
     # numpy computation over the same data.
     assert m["value"] == _global_expected(m["global_devices"])
+
+
+def _loop_multislice(config):
+    """Hybrid dcn mesh over a 2-process world: each process's local
+    devices form one 'slice'; the dcn axis crosses processes (DCN in
+    production, localhost here). Only the dp grad all-reduce rides it."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu import train
+    from ray_tpu.models import (LlamaConfig, llama_init, llama_loss,
+                                llama_param_specs)
+    from ray_tpu.models.training import make_sharded_train_step
+    from ray_tpu.parallel import create_hybrid_mesh
+
+    n_slices = jax.process_count()
+    local = jax.local_device_count()
+    tp = 2 if local % 2 == 0 else 1  # capped by the model's 4 heads
+    fsdp = local // tp
+    mesh = create_hybrid_mesh({"dcn": n_slices, "fsdp": fsdp, "tp": tp})
+    assert dict(mesh.shape)["dcn"] == n_slices
+
+    cfg = LlamaConfig.nano(dim=32, n_layers=1, n_heads=4, n_kv_heads=4,
+                           ffn_dim=64, vocab_size=128)
+    init_fn, step_fn = make_sharded_train_step(
+        lambda p, b: llama_loss(p, b, cfg), optax.sgd(1e-2), mesh,
+        llama_param_specs(cfg))
+    params, opt = init_fn(llama_init(jax.random.PRNGKey(0), cfg))
+    batch = {"tokens": jnp.zeros((n_slices * fsdp * 2, 16), jnp.int32)}
+    _, _, metrics = step_fn(params, opt, batch)
+    train.report({"loss": float(metrics["loss"]),
+                  "dcn": dict(mesh.shape)["dcn"],
+                  "processes": jax.process_count()})
+
+
+def test_multislice_dcn_mesh_two_process_world(ray_start_regular, tmp_path):
+    """VERDICT item 5: a 2-process x local-devices world exercising the
+    outer dcn mesh axis end-to-end (sharded train step compiles + runs
+    with the batch split across slices)."""
+    trainer = JaxTrainer(
+        _loop_multislice,
+        jax_config=JaxConfig(jax_distributed=True),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="multislice", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["processes"] == 2
+    assert result.metrics["dcn"] == 2
+    assert result.metrics["loss"] == result.metrics["loss"]  # finite
