@@ -54,13 +54,19 @@ def make_sharded_train_step(
 
     @functools.partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def step_fn(params, opt_state, batch):
-        batch = jax.tree_util.tree_map(
-            lambda x: jax.lax.with_sharding_constraint(x, batch_sharding),
-            batch)
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
-        return params, opt_state, metrics
+        from ray_tpu.ops.attention import spmd_mesh_scope
+
+        # Trace-time mesh announcement: kernel dispatch (Pallas flash
+        # attention) picks shard_map-wrapped forms that GSPMD can't
+        # auto-partition.
+        with spmd_mesh_scope(mesh):
+            batch = jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, batch_sharding), batch)
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+            return params, opt_state, metrics
 
     return init_fn, step_fn

@@ -4,15 +4,67 @@
 Pallas flash attention on TPU, an XLA-fused reference elsewhere (CPU
 tests run on the reference path; the Pallas kernel is also unit-tested in
 interpret mode against it).
+
+SPMD: Mosaic kernels cannot be auto-partitioned by GSPMD, so under a
+multi-device mesh the flash kernel is wrapped in a `shard_map` over the
+batch/head axes (sequence stays whole per shard — sp uses the dedicated
+ring/ulysses paths). The active mesh reaches this dispatch through a
+trace-time context (`spmd_mesh_scope`) set by make_sharded_train_step.
 """
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
+import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+_SPMD_MESH: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_spmd_mesh", default=None)
+
+
+@contextlib.contextmanager
+def spmd_mesh_scope(mesh):
+    """Announce the mesh a jitted program is being traced for, so kernel
+    dispatch can pick SPMD-safe forms. Trace-time only — no runtime
+    effect."""
+    token = _SPMD_MESH.set(mesh)
+    try:
+        yield
+    finally:
+        _SPMD_MESH.reset(token)
+
+
+def _in_manual_region() -> bool:
+    """True inside a shard_map body (axes already manual there)."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+    except Exception:
+        return False
+    if am is None or not getattr(am, "shape", None):
+        return False
+    return any("Manual" in str(t) for t in getattr(am, "axis_types", ()))
+
+
+def _flash_spmd_spec(q_shape, kv_shape, mesh):
+    """PartitionSpec over (batch, heads) for a [B,H,S,D] flash call, or
+    None when no mesh axis can be used (run unwrapped)."""
+    from jax.sharding import PartitionSpec as P
+
+    b_axes = tuple(a for a in ("dcn", "dp", "fsdp")
+                   if mesh.shape.get(a, 1) > 1)
+    if b_axes and q_shape[0] % math.prod(mesh.shape[a] for a in b_axes):
+        b_axes = ()
+    tp = mesh.shape.get("tp", 1)
+    h_axes = ("tp",) if tp > 1 and q_shape[1] % tp == 0 and \
+        kv_shape[1] % tp == 0 else ()
+    if not b_axes and not h_axes:
+        return None
+    return P(b_axes or None, h_axes or None, None, None)
 
 
 def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
@@ -83,5 +135,18 @@ def attention(q: jax.Array,
     if impl == "flash":
         from ray_tpu.ops.flash_attention import flash_attention
 
+        if sm_scale is None:
+            sm_scale = q.shape[-1] ** -0.5
+        mesh = _SPMD_MESH.get()
+        if mesh is not None and not _in_manual_region():
+            spec = _flash_spmd_spec(q.shape, k.shape, mesh)
+            if spec is not None:
+                from jax import shard_map
+
+                fn = functools.partial(flash_attention, causal=causal,
+                                       sm_scale=sm_scale)
+                return shard_map(fn, mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec, check_vma=False)(q, k, v)
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)

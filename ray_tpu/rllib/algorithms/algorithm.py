@@ -55,6 +55,14 @@ class Algorithm(Trainable):
         self.learner_group = LearnerGroup(
             self.learner_class, self.module_spec, cfg)
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        # Dedicated evaluation runner group (reference:
+        # AlgorithmConfig.evaluation() -> eval EnvRunnerGroup).
+        self.eval_env_runner_group = None
+        if self.config.evaluation_interval > 0:
+            eval_cfg = dict(cfg)
+            eval_cfg["num_env_runners"] = \
+                self.config.evaluation_num_env_runners
+            self.eval_env_runner_group = EnvRunnerGroup(eval_cfg)
         self._iteration = 0
 
     def _make_module_spec(self, obs_dim: int, num_actions: int):
@@ -74,6 +82,9 @@ class Algorithm(Trainable):
                 results["num_env_runners_restored"] = restored
         metrics = self.env_runner_group.aggregate_metrics()
         results.update(metrics)
+        if self.eval_env_runner_group is not None and \
+                self._iteration % self.config.evaluation_interval == 0:
+            results.update(self.evaluate(self.config.evaluation_duration))
         results["training_iteration"] = self._iteration
         results["time_this_iter_s"] = time.perf_counter() - t0
         return results
@@ -112,6 +123,8 @@ class Algorithm(Trainable):
     def cleanup(self) -> None:
         try:
             self.env_runner_group.stop()
+            if self.eval_env_runner_group is not None:
+                self.eval_env_runner_group.stop()
         finally:
             self.learner_group.stop()
 
@@ -120,29 +133,26 @@ class Algorithm(Trainable):
     # ---- evaluation ----
 
     def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
-        """Greedy evaluation on a fresh env."""
-        import jax
-        import jax.numpy as jnp
+        """Greedy evaluation on the dedicated eval runner group (built
+        when config.evaluation_interval > 0), else an ad-hoc local one
+        (reference: Algorithm.evaluate over evaluation env runners)."""
         import numpy as np
 
-        env = make_env(self.config.env, self.config.env_config)
-        module = self.module_spec.build()
-        params = jax.tree_util.tree_map(
-            jnp.asarray, self.learner_group.get_weights())
-        infer = jax.jit(module.forward_inference)
-        discrete = hasattr(env.action_space, "n")
-        returns = []
-        for ep in range(num_episodes):
-            obs, _ = env.reset(seed=10_000 + ep)
-            total, done = 0.0, False
-            while not done:
-                out = infer(params, obs[None])
-                action = (int(out["actions"][0]) if discrete
-                          else np.asarray(out["actions"][0]))
-                obs, r, term, trunc, _ = env.step(action)
-                total += r
-                done = term or trunc
-            returns.append(total)
+        group = self.eval_env_runner_group
+        if group is None:
+            cfg = self.config.to_dict()
+            cfg["module_spec"] = self.module_spec
+            cfg["num_env_runners"] = 0
+            group = EnvRunnerGroup(cfg)
+            try:
+                group.sync_weights(self.learner_group.get_weights())
+                returns = group.sample_episodes(num_episodes)
+            finally:
+                group.stop()
+        else:
+            group.sync_weights(self.learner_group.get_weights())
+            returns = group.sample_episodes(num_episodes)
         return {"evaluation": {
-            "episode_return_mean": float(np.mean(returns)),
-            "num_episodes": num_episodes}}
+            "episode_return_mean":
+                float(np.mean(returns)) if returns else float("nan"),
+            "num_episodes": len(returns)}}

@@ -21,6 +21,7 @@ class AlgorithmConfig:
         self.seed: int = 0
         # env runners
         self.num_env_runners: int = 0
+        self.num_envs_per_runner: int = 1  # vector-env width per runner
         self.num_cpus_per_env_runner: int = 1
         self.rollout_fragment_length: int = 200
         # training
@@ -36,6 +37,10 @@ class AlgorithmConfig:
         self.num_cpus_per_learner: int = 1
         self.num_tpus_per_learner: float = 0
         self.num_devices_per_learner: int = 1
+        # evaluation (reference: AlgorithmConfig.evaluation())
+        self.evaluation_interval: int = 0       # iterations; 0 = off
+        self.evaluation_num_env_runners: int = 0  # 0 = local eval runner
+        self.evaluation_duration: int = 5       # episodes per evaluation
         # fault tolerance
         self.restart_failed_env_runners: bool = True
 
@@ -51,6 +56,10 @@ class AlgorithmConfig:
         return self
 
     def env_runners(self, **kwargs) -> "AlgorithmConfig":
+        self._apply(kwargs)
+        return self
+
+    def evaluation(self, **kwargs) -> "AlgorithmConfig":
         self._apply(kwargs)
         return self
 

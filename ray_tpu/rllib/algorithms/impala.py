@@ -126,7 +126,15 @@ class IMPALA(Algorithm):
             boundary[:-1] = (eps[1:] != eps[:-1]).astype(np.float32)
             boundary[-1] = 1.0
             override = np.where(terms, 0.0, vf).astype(np.float32)
-            override[-1] = 0.0 if terms[-1] else float(boot)
+            if isinstance(boot, dict):
+                # Vector runners: exact per-env bootstraps keyed by the
+                # final eps_id of each env's segment.
+                for t in np.nonzero(boundary)[0]:
+                    e = int(eps[t])
+                    if not terms[t] and e in boot:
+                        override[t] = boot[e]
+            else:
+                override[-1] = 0.0 if terms[-1] else float(boot)
             b["boundary"] = boundary
             b["next_value_override"] = override
             batches.append(b)

@@ -1,8 +1,11 @@
 """SingleAgentEnvRunner — samples episodes with the current policy.
 
-Reference: rllib/env/single_agent_env_runner.py:60. Runs as a CPU actor:
-holds the env + an RLModule evaluated eagerly from host weights (jit on
-CPU backend), returns SampleBatches through the object store.
+Reference: rllib/env/single_agent_env_runner.py:60 — env runners step
+VECTOR envs: N sub-envs per runner advance per policy forward (one
+batched jit call instead of N), and the built-in CartPole runs fully
+numpy-vectorized (env/vector.py). Runs as a CPU actor: holds the env +
+an RLModule evaluated eagerly from host weights, returns SampleBatches
+through the object store.
 """
 
 from __future__ import annotations
@@ -12,32 +15,36 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ray_tpu.rllib.env.registry import make_env
+from ray_tpu.rllib.env.vector import make_vector_env
 from ray_tpu.rllib.utils import sample_batch as sb
 from ray_tpu.rllib.utils.sample_batch import SampleBatch
 
 
 class SingleAgentEnvRunner:
-    """One rollout worker. Methods are called via actor RPCs."""
+    """One rollout worker stepping a vector of envs. Methods are called
+    via actor RPCs."""
 
     def __init__(self, config: dict, worker_index: int = 0):
         import jax
 
         self.config = config
         self.worker_index = worker_index
-        self.env = make_env(config["env"], config.get("env_config"))
+        self.num_envs = max(1, int(config.get("num_envs_per_runner", 1)))
+        seed = config.get("seed", 0) * 1000 + worker_index
+        self.env = make_vector_env(config["env"],
+                                   config.get("env_config"),
+                                   self.num_envs, seed=seed)
         spec = config["module_spec"]
         self.module = spec.build()
-        self._rng = jax.random.PRNGKey(
-            config.get("seed", 0) * 1000 + worker_index)
-        self._np_rng = np.random.default_rng(
-            config.get("seed", 0) * 1000 + worker_index)
+        self._rng = jax.random.PRNGKey(seed)
+        self._np_rng = np.random.default_rng(seed)
         self.params = None
-        self._obs, _ = self.env.reset(
-            seed=config.get("seed", 0) * 1000 + worker_index)
-        self._episode_return = 0.0
-        self._episode_len = 0
-        self._eps_id = worker_index * 1_000_000
+        self.env.reset(seed=seed)
+        self._episode_return = np.zeros(self.num_envs, np.float64)
+        # Distinct eps-id ranges per (worker, sub-env).
+        self._eps_id = np.array(
+            [(worker_index * self.num_envs + i) * 1_000_000
+             for i in range(self.num_envs)], np.int64)
         self._recent_returns: collections.deque = collections.deque(
             maxlen=100)
         self._explore_fn = None
@@ -49,83 +56,145 @@ class SingleAgentEnvRunner:
     def get_weights(self):
         return self.params
 
-    def _explore(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+    def _explore_batch(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        """One policy forward over the whole env batch [N, ...]."""
         import jax
 
         if self._explore_fn is None:
             self._explore_fn = jax.jit(self.module.forward_exploration)
         self._rng, key = jax.random.split(self._rng)
-        out = self._explore_fn(self.params, obs[None, ...], key)
-        return {k: np.asarray(v)[0] for k, v in out.items()}
+        out = self._explore_fn(self.params, obs, key)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def _infer_batch(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        """Greedy (deterministic) forward for evaluation."""
+        import jax
+
+        if getattr(self, "_infer_fn", None) is None:
+            self._infer_fn = jax.jit(self.module.forward_inference)
+        out = self._infer_fn(self.params, obs)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def sample_episodes(self, num_episodes: int,
+                        explore: bool = False) -> List[float]:
+        """Run whole episodes and return their returns — the evaluation
+        path (reference: evaluation env-runner groups driven by
+        AlgorithmConfig.evaluation()). Greedy by default."""
+        assert self.params is not None, "set_weights before sample"
+        self.env.reset(seed=self.config.get("seed", 0) * 777 +
+                       self.worker_index + 10_000)
+        ep_ret = np.zeros(self.num_envs, np.float64)
+        discrete = hasattr(self.env.action_space, "n")
+        done_returns: List[float] = []
+        for _ in range(100_000):  # hard cap; envs bound episode length
+            obs = self.env.current_obs
+            out = (self._explore_batch(obs) if explore
+                   else self._infer_batch(obs))
+            actions = np.asarray(out["actions"])
+            if not discrete:
+                actions = actions.astype(np.float32)
+            _, rewards, terms, truncs = self.env.step(actions)
+            ep_ret += rewards
+            for i in np.nonzero(terms | truncs)[0]:
+                done_returns.append(float(ep_ret[i]))
+                ep_ret[i] = 0.0
+            if len(done_returns) >= num_episodes:
+                return done_returns[:num_episodes]
+        return done_returns
 
     def sample(self, num_steps: int, explore: bool = True,
                epsilon: float = 0.0) -> SampleBatch:
-        """Collect exactly num_steps transitions (episodes may span calls).
+        """Collect >= num_steps transitions (rounded up to a multiple of
+        num_envs; episodes may span calls).
 
-        epsilon > 0 overrides the sampled action with a uniform-random one
-        (for value-based algorithms; reference: EpsilonGreedy connector).
+        epsilon > 0 overrides sampled actions with uniform-random ones
+        (value-based algorithms; reference: EpsilonGreedy connector).
+        The batch is laid out env-major (env0's steps, then env1's ...)
+        so each eps_id segment is chronologically ordered for GAE.
         """
         assert self.params is not None, "set_weights before sample"
-        cols: Dict[str, List[Any]] = collections.defaultdict(list)
-        last_terminated = last_truncated = False
-        last_next_obs = self._obs
+        n_iters = -(-num_steps // self.num_envs)
         discrete = hasattr(self.env.action_space, "n")
-        for _ in range(num_steps):
-            out = self._explore(self._obs)
-            if discrete:
-                action = int(out["actions"])
-                if epsilon > 0.0 and self._np_rng.random() < epsilon:
-                    action = int(self._np_rng.integers(
-                        self.env.action_space.n))
-            else:  # continuous (Box): ship the action vector as-is
-                action = np.asarray(out["actions"], np.float32)
-            next_obs, reward, terminated, truncated, _ = self.env.step(
-                action)
-            cols[sb.OBS].append(self._obs)
-            cols[sb.NEXT_OBS].append(next_obs)
-            cols[sb.ACTIONS].append(action)
-            cols[sb.REWARDS].append(reward)
-            cols[sb.TERMINATEDS].append(terminated)
-            cols[sb.TRUNCATEDS].append(truncated)
-            cols[sb.EPS_ID].append(self._eps_id)
-            if "action_logp" in out:
-                cols[sb.ACTION_LOGP].append(out["action_logp"])
-            if "vf_preds" in out:
-                cols[sb.VF_PREDS].append(out["vf_preds"])
-            self._episode_return += reward
-            self._episode_len += 1
-            self._total_steps += 1
-            last_terminated, last_truncated = terminated, truncated
+        per_env: List[Dict[str, List[Any]]] = [
+            collections.defaultdict(list) for _ in range(self.num_envs)]
+        last_terms = np.zeros(self.num_envs, bool)
+        last_truncs = np.zeros(self.num_envs, bool)
+        last_next_obs = self.env.current_obs
+        for _ in range(n_iters):
+            obs = self.env.current_obs
+            out = self._explore_batch(obs)
+            actions = np.asarray(out["actions"])
+            if discrete and epsilon > 0.0:
+                override = self._np_rng.random(self.num_envs) < epsilon
+                actions = np.where(
+                    override,
+                    self._np_rng.integers(self.env.action_space.n,
+                                          size=self.num_envs),
+                    actions)
+            next_obs, rewards, terms, truncs = self.env.step(actions)
+            for i in range(self.num_envs):
+                cols = per_env[i]
+                cols[sb.OBS].append(obs[i])
+                cols[sb.NEXT_OBS].append(next_obs[i])
+                cols[sb.ACTIONS].append(
+                    int(actions[i]) if discrete
+                    else np.asarray(actions[i], np.float32))
+                cols[sb.REWARDS].append(float(rewards[i]))
+                cols[sb.TERMINATEDS].append(bool(terms[i]))
+                cols[sb.TRUNCATEDS].append(bool(truncs[i]))
+                cols[sb.EPS_ID].append(int(self._eps_id[i]))
+                if "action_logp" in out:
+                    cols[sb.ACTION_LOGP].append(out["action_logp"][i])
+                if "vf_preds" in out:
+                    cols[sb.VF_PREDS].append(out["vf_preds"][i])
+            self._episode_return += rewards
+            self._total_steps += self.num_envs
+            done = terms | truncs
+            for i in np.nonzero(done)[0]:
+                self._recent_returns.append(float(
+                    self._episode_return[i]))
+                self._episode_return[i] = 0.0
+                self._eps_id[i] += 1
+            last_terms, last_truncs = terms, truncs
             last_next_obs = next_obs
-            if terminated or truncated:
-                self._recent_returns.append(self._episode_return)
-                self._episode_return = 0.0
-                self._episode_len = 0
-                self._eps_id += 1
-                self._obs, _ = self.env.reset()
+        # Exact per-env bootstraps for each env's final step: terminated
+        # → 0; truncated → V(final next_obs); cut mid-episode →
+        # V(current obs). One batched forward for all envs.
+        vf_next = self._explore_batch(last_next_obs).get(
+            "vf_preds", np.zeros(self.num_envs, np.float32))
+        vf_cur = self._explore_batch(self.env.current_obs).get(
+            "vf_preds", np.zeros(self.num_envs, np.float32))
+        boots: Dict[int, float] = {}
+        for i in range(self.num_envs):
+            # The final step of env i belongs to eps_id recorded BEFORE
+            # any post-step increment.
+            final_eps = int(per_env[i][sb.EPS_ID][-1])
+            if last_terms[i]:
+                boots[final_eps] = 0.0
+            elif last_truncs[i]:
+                boots[final_eps] = float(np.asarray(vf_next)[i])
             else:
-                self._obs = next_obs
-        # Exact bootstrap for this rollout's final step (computed BEFORE
-        # the post-reset obs can leak in): terminated → 0; truncated →
-        # V(final next_obs); cut mid-episode → V(current obs).
-        if last_terminated:
-            self._end_bootstrap = 0.0
-        elif last_truncated:
-            out = self._explore(last_next_obs)
-            self._end_bootstrap = float(out.get("vf_preds", 0.0))
-        else:
-            out = self._explore(self._obs)
-            self._end_bootstrap = float(out.get("vf_preds", 0.0))
-        return SampleBatch({
-            k: np.asarray(v) for k, v in cols.items()})
+                boots[final_eps] = float(np.asarray(vf_cur)[i])
+        self._end_bootstraps = boots
+        merged: Dict[str, np.ndarray] = {}
+        for key in per_env[0]:
+            merged[key] = np.concatenate(
+                [np.asarray(per_env[i][key])
+                 for i in range(self.num_envs)])
+        return SampleBatch(merged)
 
-    def bootstrap_value(self) -> float:
-        """Value bootstrap for the last sample() rollout's final step —
-        used by GAE (see sample() for the terminated/truncated cases)."""
-        if hasattr(self, "_end_bootstrap"):
-            return self._end_bootstrap
-        out = self._explore(self._obs)
-        return float(out.get("vf_preds", 0.0))
+    def bootstrap_value(self):
+        """Per-final-episode value bootstraps of the last sample()
+        rollout ({eps_id: value}, consumed by compute_gae). Scalar-like
+        for num_envs==1 callers expecting the old contract is preserved
+        by compute_gae accepting either form."""
+        if hasattr(self, "_end_bootstraps"):
+            return self._end_bootstraps
+        out = self._explore_batch(self.env.current_obs)
+        vals = np.asarray(out.get("vf_preds",
+                                  np.zeros(self.num_envs, np.float32)))
+        return {int(self._eps_id[i]): float(vals[i])
+                for i in range(self.num_envs)}
 
     def get_metrics(self) -> Dict[str, Any]:
         returns = list(self._recent_returns)

@@ -106,6 +106,21 @@ class EnvRunnerGroup:
             raise RuntimeError("all env runners failed during sample()")
         return out
 
+    def sample_episodes(self, num_episodes: int,
+                        explore: bool = False) -> List[float]:
+        """Whole-episode returns across runners (evaluation path)."""
+        if self._local_runner is not None:
+            return self._local_runner.sample_episodes(num_episodes,
+                                                      explore=explore)
+        n = max(1, self._manager.num_healthy_actors())
+        per = -(-num_episodes // n)
+        results = self._manager.foreach(
+            lambda a: a.sample_episodes.remote(per, explore=explore))
+        out: List[float] = []
+        for _, returns in results.ok:
+            out.extend(returns)
+        return out[:num_episodes] if out else []
+
     # ---- health / metrics ----
 
     def restore_failed(self, params_fn=None) -> int:

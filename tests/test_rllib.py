@@ -101,6 +101,54 @@ def test_ppo_learns_cartpole_local():
     algo.cleanup()
 
 
+def test_ppo_vectorized_runners_learn():
+    """Vector envs per runner: same learning signal, fewer jit calls."""
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0, num_envs_per_runner=8)
+              .training(train_batch_size=1024, minibatch_size=128,
+                        num_epochs=6, lr=3e-4)
+              .debugging(seed=3))
+    algo = config.build_algo()
+    first_return, best = None, -np.inf
+    for _ in range(10):
+        result = algo.step()
+        ret = result.get("episode_return_mean", float("nan"))
+        if first_return is None and np.isfinite(ret):
+            first_return = ret
+        if np.isfinite(ret):
+            best = max(best, ret)
+    assert first_return is not None
+    assert best > first_return + 20, (first_return, best)
+    algo.cleanup()
+
+
+def test_evaluation_runner_group(ray_start_regular):
+    """AlgorithmConfig.evaluation(): a dedicated eval runner group runs
+    greedy episodes every evaluation_interval iterations."""
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0, num_envs_per_runner=4)
+              .training(train_batch_size=256, minibatch_size=64,
+                        num_epochs=2)
+              .evaluation(evaluation_interval=2, evaluation_duration=4,
+                          evaluation_num_env_runners=1)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    r1 = algo.step()   # iteration 1: no eval
+    assert "evaluation" not in r1
+    r2 = algo.step()   # iteration 2: eval fires
+    assert "evaluation" in r2
+    ev = r2["evaluation"]
+    assert ev["num_episodes"] == 4
+    assert np.isfinite(ev["episode_return_mean"])
+    algo.cleanup()
+
+
 def test_ppo_remote_env_runners(ray_start_regular):
     from ray_tpu.rllib.algorithms.ppo import PPOConfig
 
