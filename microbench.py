@@ -34,7 +34,10 @@ def main(quick: bool = False):
         return None
 
     n = int(2000 * scale)
-    ray_tpu.get([noop.remote() for _ in range(20)])  # warm workers
+    # Warm workers, leases, jit-free code paths, and the inline-exec
+    # observation window; let store pre-population settle.
+    ray_tpu.get([noop.remote() for _ in range(200)])
+    time.sleep(2.0)
 
     def tasks():
         ray_tpu.get([noop.remote() for _ in range(n)])
@@ -48,7 +51,8 @@ def main(quick: bool = False):
             return x
 
     a = A.remote()
-    ray_tpu.get(a.m.remote())
+    for _ in range(20):  # warm conn + inline-exec observation window
+        ray_tpu.get(a.m.remote())
     n = int(2000 * scale)
 
     def actor_sync():

@@ -19,10 +19,13 @@
 //
 // Build: g++ -O2 -fPIC -shared -o libray_tpu_store.so shm_store.cpp -lpthread
 
+#include <algorithm>
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
 #include <ctime>
+#include <thread>
 
 #include <fcntl.h>
 #include <pthread.h>
@@ -88,6 +91,10 @@ struct Handle {
   uint64_t map_size;
   Header* hdr;
   Slot* slots;
+  // Prefault worker (see shm_store_prefault): joined before munmap so
+  // it can never madvise a torn-down (possibly reused) mapping.
+  std::thread prefault_thread;
+  std::atomic<bool> prefault_stop{false};
 };
 
 inline uint64_t align_up(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
@@ -270,9 +277,65 @@ void* shm_store_open(const char* path) {
   return st;
 }
 
+// Pre-fault the arena in the background so first-touch page faults
+// (tmpfs page allocation + zeroing) don't sit on the first puts'
+// critical path (reference: plasma pre-populates its dlmalloc arena).
+// MADV_POPULATE_WRITE only populates page tables — safe to run
+// concurrently with writers.
+void shm_store_prefault(void* handle, uint64_t max_bytes) {
+#ifdef MADV_POPULATE_WRITE
+  Handle* st = reinterpret_cast<Handle*>(handle);
+  if (!st) return;
+  uint8_t* data = st->base;
+  // The allocator hands out low offsets first, so pre-faulting a prefix
+  // of the arena covers the hot working set without committing the
+  // whole (possibly huge) store up front.
+  uint64_t total = st->map_size;
+  if (max_bytes && max_bytes < total) total = max_bytes;
+  if (st->prefault_thread.joinable()) return;  // one per handle
+  std::atomic<bool>* stop = &st->prefault_stop;
+  st->prefault_thread = std::thread([data, total, stop]() {
+    // Two phases: a fast head (the allocator's first objects land
+    // there), then a gentle trickle for the rest so page
+    // allocation+zeroing doesn't steal memory bandwidth from
+    // foreground work right after cluster start. The stop flag is
+    // honored between chunks; shm_store_close joins before munmap.
+    const uint64_t chunk = 16ull << 20;
+    const uint64_t fast_head = std::min<uint64_t>(total, 256ull << 20);
+    for (uint64_t off = 0; off < fast_head; off += chunk) {
+      if (stop->load()) return;
+      (void)madvise(data + off, std::min(chunk, fast_head - off),
+                    MADV_POPULATE_WRITE);
+    }
+    struct timespec ts = {0, 50 * 1000 * 1000};  // 50 ms between chunks
+    for (uint64_t off = fast_head; off < total; off += chunk) {
+      if (stop->load()) return;
+      (void)madvise(data + off, std::min(chunk, total - off),
+                    MADV_POPULATE_WRITE);
+      nanosleep(&ts, nullptr);
+    }
+  });
+#else
+  (void)handle;
+  (void)max_bytes;
+#endif
+}
+
+// memcpy into a created (unsealed) object at absolute file offset `off`
+// (as returned by shm_create) + `delta`. Called via ctypes, which drops
+// the GIL for the copy — big puts neither hold the GIL nor block the
+// caller's event loop.
+void shm_store_write(void* handle, uint64_t off, uint64_t delta,
+                     const uint8_t* src, uint64_t n) {
+  Handle* st = reinterpret_cast<Handle*>(handle);
+  memcpy(st->base + off + delta, src, n);
+}
+
 void shm_store_close(void* handle) {
   Handle* st = reinterpret_cast<Handle*>(handle);
   if (!st) return;
+  st->prefault_stop.store(true);
+  if (st->prefault_thread.joinable()) st->prefault_thread.join();
   munmap(st->base, st->map_size);
   close(st->fd);
   delete st;

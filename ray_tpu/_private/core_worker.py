@@ -52,6 +52,17 @@ logger = logging.getLogger(__name__)
 
 DRIVER, WORKER = "driver", "worker"
 
+# Executor-thread marker: which task key is currently running under
+# observation (lets Worker._run flag keys that use the sync API — those
+# can never run inline on the io loop).
+_EXEC_TL = threading.local()
+
+
+class InlineUnsafeError(RuntimeError):
+    """Sync blocking API called from a task running inline on the io
+    loop — the task is retried on the executor path and its key is
+    permanently barred from inlining."""
+
 
 class _Lease:
     __slots__ = ("lease_id", "address", "conn", "inflight", "raylet_address")
@@ -153,6 +164,13 @@ class CoreWorker:
         self._exec_queue: "collections.deque" = collections.deque()
         self._exec_pump_running = False
         self._exec_direct = False
+        # Inline-on-loop gating: key -> [duration EMA, observation count].
+        # A key becomes inline-eligible only after several observed-fast
+        # executor runs during which it never touched the sync blocking
+        # API (those keys land in _exec_sync_api_keys and never inline).
+        self._exec_ema: Dict[Any, list] = {}
+        self._exec_sync_api_keys: set = set()
+        self._inline_active = False
         if config.gil_switch_interval_s > 0:
             # Single-core hosts: the default 5 ms GIL switch interval
             # stalls the io loop whenever the executor thread holds the
@@ -213,6 +231,12 @@ class CoreWorker:
                     lambda conn: self._should_exit.set()
         if self.store_path:
             self.plasma = ShmClient(self.store_path)
+            if self.mode == DRIVER:
+                # Per-process PTE prefault of the hot arena prefix (the
+                # raylet populates the tmpfs pages; this maps them into
+                # the driver, whose puts dominate). Workers skip it —
+                # they churn through leases constantly.
+                self.plasma.prefault(1 << 30)
         if self.config.task_events_enabled:
             self._task_event_flusher = asyncio.get_running_loop(
             ).create_task(self._task_event_flush_loop())
@@ -362,7 +386,11 @@ class CoreWorker:
     async def _put_plasma(self, object_id: ObjectID,
                           sobj: ser.SerializedObject) -> None:
         try:
-            self.plasma.put_serialized(object_id, sobj)
+            # Off-loop: the bulk memcpy runs on an executor thread with
+            # the GIL dropped (native shm_store_write), so a 100-MiB put
+            # doesn't stall the io loop.
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.plasma.put_serialized, object_id, sobj)
         except StoreFullError:
             # Store the bytes host-side anyway (memory store) rather than fail.
             self.memory_store.put_in_loop(object_id, sobj.to_bytes())
@@ -1419,6 +1447,52 @@ class CoreWorker:
             await loop.run_in_executor(
                 None, _materialize, uri, self._sync_gcs_call)
 
+    _INLINE_MIN_OBSERVATIONS = 3
+
+    async def _run_timed_sync(self, key, fn, *args):
+        """Run sync user code, inline on the loop when its observed
+        duration (EMA) is under the inline threshold — saving the
+        executor-thread round trip (2 GIL handoffs) that dominates
+        sub-millisecond task latency. Slow or unknown tasks keep the
+        executor path (the loop must not stall on them), as do tasks
+        ever observed calling the sync blocking API (get/put/wait can't
+        run on the loop). A task that STARTS using the sync API after
+        qualifying raises InlineUnsafeError before blocking; it is
+        retried on the executor and its key barred from inlining."""
+        threshold = self.config.inline_task_threshold_s
+        state = self._exec_ema.get(key)
+        inline = (threshold > 0 and not self._exec_direct and
+                  state is not None and
+                  state[1] >= self._INLINE_MIN_OBSERVATIONS and
+                  state[0] < threshold and
+                  key not in self._exec_sync_api_keys)
+        t0 = time.monotonic()
+        if inline:
+            self._inline_active = True
+            try:
+                result = fn(*args)
+            except InlineUnsafeError:
+                self._exec_sync_api_keys.add(key)
+                result = await self._run_sync(fn, *args)
+            finally:
+                self._inline_active = False
+        else:
+            def observed():
+                _EXEC_TL.key = key
+                try:
+                    return fn(*args)
+                finally:
+                    _EXEC_TL.key = None
+
+            result = await self._run_sync(observed)
+        dt = time.monotonic() - t0
+        if state is None:
+            self._exec_ema[key] = [dt, 1]
+        else:
+            state[0] = 0.7 * state[0] + 0.3 * dt
+            state[1] += 1
+        return result
+
     async def _run_sync(self, fn, *args):
         if self._exec_direct:
             # Multi-threaded actor pool: parallel dispatch.
@@ -1498,7 +1572,8 @@ class CoreWorker:
                     finally:
                         exec_box.append(time.monotonic() - t0)
 
-                result = await self._run_sync(_run_timed)
+                result = await self._run_timed_sync(
+                    ("f", spec.function.function_key), _run_timed)
                 exec_s = exec_box[0]
                 if spec.is_streaming:
                     # The generator BODY runs during iteration, so it must
@@ -1586,7 +1661,8 @@ class CoreWorker:
                     result = await method(*args, **kwargs)
                 else:
                     # Actor env was applied permanently at creation.
-                    result = await self._run_sync(
+                    result = await self._run_timed_sync(
+                        ("m", spec.actor_method),
                         lambda: self._execute_user_code(method, args,
                                                         kwargs, spec))
                 if spec.is_streaming:

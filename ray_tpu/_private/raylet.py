@@ -102,6 +102,9 @@ class Raylet:
     # ------------------------------------------------------------- lifecycle
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self.store = ShmClient(self.store_path)
+        # Background arena pre-population: first-touch tmpfs page faults
+        # move off the first puts' critical path.
+        self.store.prefault()
         self._server = rpc.Server(self, host, port)
         port = await self._server.start()
         self.address = f"{host}:{port}"

@@ -41,11 +41,24 @@ class Worker:
     def _run(self, coro, timeout: Optional[float] = None):
         import asyncio
 
+        from ray_tpu._private.core_worker import (_EXEC_TL,
+                                                  InlineUnsafeError)
+
+        # Executor-thread observation: a task using the sync API can
+        # never be inlined onto the io loop (see _run_timed_sync).
+        key = getattr(_EXEC_TL, "key", None)
+        if key is not None:
+            self.core._exec_sync_api_keys.add(key)
         try:
             running = asyncio.get_running_loop()
         except RuntimeError:
             running = None
         if running is self.loop:
+            if getattr(self.core, "_inline_active", False):
+                coro.close()
+                raise InlineUnsafeError(
+                    "task uses the sync blocking API; retrying on the "
+                    "executor path")
             raise RuntimeError(
                 "sync API called from the io loop; use the async variants")
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
@@ -53,8 +66,35 @@ class Worker:
 
     # -- public ops --------------------------------------------------------
     def get(self, refs, timeout: Optional[float] = None):
+        import asyncio
+
         from ray_tpu.dag.compiled_dag import CompiledDAGRef
 
+        # Observation: every get() — including the _get_fast path that
+        # never reaches _run — marks the running task key as
+        # sync-API-using, so such keys are barred from inlining BEFORE
+        # they ever qualify (no retry, no duplicated side effects).
+        from ray_tpu._private.core_worker import _EXEC_TL
+
+        obs_key = getattr(_EXEC_TL, "key", None)
+        if obs_key is not None:
+            self.core._exec_sync_api_keys.add(obs_key)
+        # get() from a task inlined on the io loop would deadlock in the
+        # fast path's blocking wait — bail to the executor retry instead
+        # (see core_worker._run_timed_sync). Unreachable for keys that
+        # used the sync API during observation; the retry re-executes
+        # from the start (at-least-once task semantics).
+        if getattr(self.core, "_inline_active", False):
+            from ray_tpu._private.core_worker import InlineUnsafeError
+
+            try:
+                on_loop = asyncio.get_running_loop() is self.loop
+            except RuntimeError:
+                on_loop = False  # not on the loop thread
+            if on_loop:
+                raise InlineUnsafeError(
+                    "task uses the sync blocking API; retrying on "
+                    "the executor path")
         single = isinstance(refs, (ObjectRef, CompiledDAGRef))
         ref_list = [refs] if single else list(refs)
         if any(isinstance(r, CompiledDAGRef) for r in ref_list):

@@ -43,6 +43,10 @@ class Config:
     # reported) execution time EMA is at or below this; longer tasks keep
     # strict one-in-flight spread semantics.
     pipeline_task_duration_s: float = 0.1
+    # Observed-fast sync methods/functions run inline on the worker's io
+    # loop (no executor-thread round trip — 2 GIL handoffs saved per
+    # call); anything slower keeps the executor path. <=0 disables.
+    inline_task_threshold_s: float = 0.002
     # Streaming generators: max yielded-but-unconsumed items per stream
     # before the producer pauses (reference:
     # _generator_backpressure_num_objects). <=0 disables.

@@ -61,7 +61,10 @@ class SerializedObject:
             size = _aligned(size) + b.nbytes
         self.total_size = size + 8 * len(buffers) + 4
 
-    def write_to(self, dest: memoryview) -> None:
+    def write_to(self, dest: memoryview, native_write=None) -> None:
+        """native_write(delta, src_addr, nbytes): GIL-dropping memcpy at
+        byte offset delta of the destination object — used for large
+        payload buffers so a 100-MiB put doesn't stall other threads."""
         _HEADER.pack_into(dest, 0, len(self.buffers), len(self.meta))
         pos = _HEADER.size
         dest[pos: pos + len(self.meta)] = self.meta
@@ -69,7 +72,17 @@ class SerializedObject:
         sizes = []
         for b in self.buffers:
             pos = _aligned(pos)
-            dest[pos: pos + b.nbytes] = b
+            if native_write is not None and b.nbytes >= 1 << 20:
+                import numpy as _np
+
+                try:
+                    src = _np.frombuffer(b, dtype=_np.uint8)
+                except ValueError:  # non-contiguous: plain copy
+                    dest[pos: pos + b.nbytes] = b
+                else:
+                    native_write(pos, src.ctypes.data, b.nbytes)
+            else:
+                dest[pos: pos + b.nbytes] = b
             sizes.append(b.nbytes)
             pos += b.nbytes
         n = len(sizes)

@@ -10,6 +10,7 @@ pin the object (shm refcount) until the last view is garbage collected.
 from __future__ import annotations
 
 import ctypes
+import functools
 import mmap
 import os
 import weakref
@@ -41,6 +42,13 @@ def _load():
         lib.shm_store_open.argtypes = [ctypes.c_char_p]
         lib.shm_store_open.restype = ctypes.c_void_p
         lib.shm_store_close.argtypes = [ctypes.c_void_p]
+        lib.shm_store_prefault.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_uint64]
+        lib.shm_store_prefault.restype = None
+        lib.shm_store_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_uint64]
+        lib.shm_store_write.restype = None
         for fn, extra in [
             ("shm_create", [ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)]),
             ("shm_seal", []),
@@ -167,7 +175,13 @@ class ShmClient:
         return self._handle.value_ptr
 
     def put_serialized(self, object_id: ObjectID, sobj: SerializedObject) -> bool:
-        """Returns False if the object already exists (idempotent put)."""
+        """Returns False if the object already exists (idempotent put).
+
+        Large payload buffers are copied by the native shm_store_write
+        (ctypes drops the GIL during the call), so big puts don't stall
+        other Python threads; small header/trailer writes go through the
+        mapped view directly.
+        """
         off = ctypes.c_uint64()
         rc = _load().shm_create(self._ptr, object_id.binary(),
                                 sobj.total_size, ctypes.byref(off))
@@ -180,7 +194,8 @@ class ShmClient:
             raise RuntimeError(f"shm_create failed: {rc}")
         try:
             dest = self._mv[off.value: off.value + sobj.total_size]
-            sobj.write_to(dest)
+            sobj.write_to(dest, native_write=functools.partial(
+                _load().shm_store_write, self._ptr, off.value))
             dest.release()
         except BaseException:
             _load().shm_abort(self._ptr, object_id.binary())
@@ -190,6 +205,11 @@ class ShmClient:
         # owned by the distributed refcounter, not this client.
         _load().shm_release(self._ptr, object_id.binary())
         return True
+
+    def prefault(self, max_bytes: int = 4 << 30) -> None:
+        """Background pre-population of (a prefix of) the arena —
+        first-touch page faults move off the first puts' critical path."""
+        _load().shm_store_prefault(self._ptr, max_bytes)
 
     def put_bytes(self, object_id: ObjectID, data: bytes) -> bool:
         off = ctypes.c_uint64()

@@ -85,6 +85,11 @@ class SingleAgentEnvRunner:
                        self.worker_index + 10_000)
         ep_ret = np.zeros(self.num_envs, np.float64)
         discrete = hasattr(self.env.action_space, "n")
+        # Per-env quota — taking the first N episodes to FINISH across
+        # parallel envs would bias the sample toward short (usually
+        # low-return) episodes.
+        quota = -(-num_episodes // self.num_envs)
+        counts = np.zeros(self.num_envs, np.int64)
         done_returns: List[float] = []
         for _ in range(100_000):  # hard cap; envs bound episode length
             obs = self.env.current_obs
@@ -96,7 +101,9 @@ class SingleAgentEnvRunner:
             _, rewards, terms, truncs = self.env.step(actions)
             ep_ret += rewards
             for i in np.nonzero(terms | truncs)[0]:
-                done_returns.append(float(ep_ret[i]))
+                if counts[i] < quota:
+                    done_returns.append(float(ep_ret[i]))
+                    counts[i] += 1
                 ep_ret[i] = 0.0
             if len(done_returns) >= num_episodes:
                 return done_returns[:num_episodes]
@@ -159,11 +166,14 @@ class SingleAgentEnvRunner:
             last_next_obs = next_obs
         # Exact per-env bootstraps for each env's final step: terminated
         # → 0; truncated → V(final next_obs); cut mid-episode →
-        # V(current obs). One batched forward for all envs.
-        vf_next = self._explore_batch(last_next_obs).get(
-            "vf_preds", np.zeros(self.num_envs, np.float32))
-        vf_cur = self._explore_batch(self.env.current_obs).get(
-            "vf_preds", np.zeros(self.num_envs, np.float32))
+        # V(current obs). Each batched forward runs only when some env
+        # actually needs that bootstrap kind.
+        zeros = np.zeros(self.num_envs, np.float32)
+        vf_next = (self._explore_batch(last_next_obs).get(
+            "vf_preds", zeros) if last_truncs.any() else zeros)
+        cut = ~(last_terms | last_truncs)
+        vf_cur = (self._explore_batch(self.env.current_obs).get(
+            "vf_preds", zeros) if cut.any() else zeros)
         boots: Dict[int, float] = {}
         for i in range(self.num_envs):
             # The final step of env i belongs to eps_id recorded BEFORE

@@ -27,8 +27,10 @@ class VectorEnv:
     VECTORIZED = True
 
     def __init__(self, make_fn: Callable[[], Any], num_envs: int,
-                 seed: int = 0):
-        self.envs = [make_fn() for _ in range(num_envs)]
+                 seed: int = 0, first_env: Optional[Any] = None):
+        self.envs = ([first_env] if first_env is not None else []) + \
+            [make_fn() for _ in range(num_envs -
+                                      (1 if first_env is not None else 0))]
         self.num_envs = num_envs
         self.observation_space = self.envs[0].observation_space
         self.action_space = self.envs[0].action_space
@@ -156,7 +158,6 @@ def make_vector_env(env: object, env_config: Optional[dict],
     probe = make_env(env, env_config)
     if getattr(probe, "VECTORIZED", False):
         return probe
-    if num_envs == 1:
-        return VectorEnv(lambda: probe, 1, seed=seed)
+    # The probe becomes sub-env 0 — expensive envs build exactly N times.
     return VectorEnv(lambda: make_env(env, env_config), num_envs,
-                     seed=seed)
+                     seed=seed, first_env=probe)

@@ -66,6 +66,7 @@ class RunningReplicaInfo:
     deployment: str
     app_name: str
     max_ongoing_requests: int = 5
+    node_id: str = ""  # hex; enables prefer-local routing
 
     def to_dict(self) -> dict:
         return {
@@ -74,6 +75,7 @@ class RunningReplicaInfo:
             "deployment": self.deployment,
             "app_name": self.app_name,
             "max_ongoing_requests": self.max_ongoing_requests,
+            "node_id": self.node_id,
         }
 
     @staticmethod

@@ -58,6 +58,7 @@ class DeploymentReplica:
         self.handle = None
         self.ready_ref = None
         self.stop_ref = None
+        self.node_id = ""  # learned when RUNNING; for locality routing
         self.last_health_check: float = time.time()
         self.health_ref = None
         self.num_ongoing: int = 0
@@ -91,6 +92,15 @@ class DeploymentReplica:
             ray_tpu.get(self.ready_ref)
             self.ready_ref = None
             self.state = ReplicaState.RUNNING
+            try:
+                from ray_tpu._private.worker import global_worker
+
+                view = global_worker().gcs_call("get_actor_info", {
+                    "actor_id": self.handle._actor_id.binary()})
+                nid = (view or {}).get("node_id")
+                self.node_id = nid.hex() if nid else ""
+            except Exception:
+                self.node_id = ""
             return True
         except Exception as e:
             logger.error("replica %s failed to start: %s", self.replica_id, e)
@@ -126,7 +136,8 @@ class DeploymentReplica:
             actor_name=self.actor_name,
             deployment=self.deployment_id.name,
             app_name=self.deployment_id.app_name,
-            max_ongoing_requests=config.max_ongoing_requests)
+            max_ongoing_requests=config.max_ongoing_requests,
+            node_id=self.node_id)
 
 
 class DeploymentState:

@@ -1,0 +1,119 @@
+"""Versioned wire schema of the Serve rpc ingress.
+
+Reference: src/ray/protobuf/serve.proto + the gRPCProxy
+(python/ray/serve/_private/proxy.py:540) — an externally-consumable,
+versioned request/response contract. The transport is the framework's
+length-prefixed msgpack framing (core/rpc.py); messages here define the
+`serve_call` method's payload, exactly as a .proto would:
+
+    frame     := u32 little-endian length | msgpack body
+    request   := [REQUEST=0, msgid:u64, "serve_call", ServeCallRequest]
+    response  := [RESPONSE=1, msgid:u64, ServeCallResponse]
+    error     := [ERROR=2, msgid:u64, message:str]
+
+Schema evolution: ``schema_version`` is carried in every message.
+Servers accept any REQUEST version <= SCHEMA_VERSION, default missing
+fields, and ignore unknown fields (msgpack maps) — so v1 clients keep
+working against newer proxies. Responses are always the v1 envelope
+(status/result/error/request_id); clients must tolerate added response
+fields in future versions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+SCHEMA_VERSION = 1
+
+# Response status codes (proto-style enum).
+STATUS_OK = 0
+STATUS_APP_ERROR = 1        # user code raised
+STATUS_NOT_FOUND = 2        # unknown app/deployment
+STATUS_TIMEOUT = 3
+STATUS_INVALID = 4          # malformed request
+
+
+@dataclass
+class ServeCallRequest:
+    """serve_call request body (map on the wire)."""
+
+    app: str = "default"
+    deployment: Optional[str] = None      # None → the app's ingress
+    method: Optional[str] = None          # None → __call__
+    payload: Any = None
+    multiplexed_model_id: str = ""
+    request_id: str = ""
+    schema_version: int = SCHEMA_VERSION
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "schema_version": self.schema_version,
+            "app": self.app,
+            "deployment": self.deployment,
+            "method": self.method,
+            "payload": self.payload,
+            "multiplexed_model_id": self.multiplexed_model_id,
+            "request_id": self.request_id,
+        }
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, Any]) -> "ServeCallRequest":
+        if not isinstance(d, dict):
+            raise SchemaError(f"request body must be a map, got "
+                              f"{type(d).__name__}")
+        version = d.get("schema_version", 1)
+        if not isinstance(version, int) or version < 1:
+            raise SchemaError(f"bad schema_version {version!r}")
+        if version > SCHEMA_VERSION:
+            raise SchemaError(
+                f"request schema_version {version} is newer than this "
+                f"server's {SCHEMA_VERSION}")
+        app = d.get("app", "default")
+        if not isinstance(app, str):
+            raise SchemaError("'app' must be a string")
+        dep = d.get("deployment")
+        if dep is not None and not isinstance(dep, str):
+            raise SchemaError("'deployment' must be a string or null")
+        method = d.get("method")
+        if method is not None and not isinstance(method, str):
+            raise SchemaError("'method' must be a string or null")
+        return cls(app=app, deployment=dep, method=method,
+                   payload=d.get("payload"),
+                   multiplexed_model_id=d.get("multiplexed_model_id", ""),
+                   request_id=d.get("request_id", ""),
+                   schema_version=version)
+
+
+@dataclass
+class ServeCallResponse:
+    """serve_call response body (map on the wire)."""
+
+    status: int = STATUS_OK
+    result: Any = None
+    error: str = ""
+    request_id: str = ""
+    schema_version: int = SCHEMA_VERSION
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "schema_version": self.schema_version,
+            "status": self.status,
+            "result": self.result,
+            "error": self.error,
+            "request_id": self.request_id,
+        }
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, Any]) -> "ServeCallResponse":
+        if not isinstance(d, dict):
+            raise SchemaError("response body must be a map")
+        return cls(status=d.get("status", STATUS_OK),
+                   result=d.get("result"),
+                   error=d.get("error", ""),
+                   request_id=d.get("request_id", ""),
+                   schema_version=d.get("schema_version", 1))
+
+
+class SchemaError(ValueError):
+    """Malformed or incompatible ingress message."""

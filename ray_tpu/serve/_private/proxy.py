@@ -46,44 +46,72 @@ class ServeRequest:
 
 class _RpcIngress:
     """rpc-framing ingress beside HTTP (the reference's gRPCProxy
-    analog): `serve_call {app, deployment?, method?, payload}` routes
-    through the same DeploymentHandle data plane."""
+    analog, proxy.py:540 + serve.proto): requests/responses follow the
+    VERSIONED contract in ingress_schema.py — an externally-consumable
+    wire API, not an internal convenience."""
 
     def __init__(self, proxy: "ProxyActor"):
         self._proxy = proxy
 
     async def handle_serve_call(self, data, conn):
+        from ray_tpu.serve._private.ingress_schema import (
+            STATUS_APP_ERROR, STATUS_NOT_FOUND, STATUS_OK, STATUS_TIMEOUT,
+            STATUS_INVALID, SchemaError, ServeCallRequest,
+            ServeCallResponse)
         from ray_tpu.serve.handle import DeploymentHandle
 
-        app_name = data.get("app", "default")
-        deployment = data.get("deployment")
+        try:
+            req = ServeCallRequest.from_wire(data)
+        except SchemaError as e:
+            return ServeCallResponse(status=STATUS_INVALID,
+                                     error=str(e)).to_wire()
+        deployment = req.deployment
         if deployment is None:
             # Route by app name through the route table (ingress
             # deployment of that app).
             entry = next((e for e in
                           self._proxy._route_table.values()
-                          if e["app_name"] == app_name), None)
+                          if e["app_name"] == req.app), None)
             if entry is None:
-                raise ValueError(f"no application {app_name!r}")
+                return ServeCallResponse(
+                    status=STATUS_NOT_FOUND,
+                    error=f"no application {req.app!r}",
+                    request_id=req.request_id).to_wire()
             deployment = entry["deployment"]
-        handle = DeploymentHandle(deployment, app_name)
-        if data.get("method"):
-            handle = handle.options(method_name=data["method"])
+        handle = DeploymentHandle(deployment, req.app)
+        if req.method:
+            handle = handle.options(method_name=req.method)
+        if req.multiplexed_model_id:
+            handle = handle.options(
+                multiplexed_model_id=req.multiplexed_model_id)
         self._proxy._num_requests += 1
         loop = asyncio.get_running_loop()
         response = await loop.run_in_executor(
-            None, lambda: handle.remote(data.get("payload")))
+            None, lambda: handle.remote(req.payload))
         # Same bound as the HTTP path: a hung replica must not leak the
-        # serve task/connection forever.
+        # serve task/connection forever; a dropped ingress connection
+        # cancels the request end-to-end.
         try:
-            return await asyncio.wait_for(
+            result = await asyncio.wait_for(
                 _await_response(response),
                 timeout=self._proxy._request_timeout_s)
         except asyncio.TimeoutError:
             _cancel_response(response)
-            raise TimeoutError(
-                f"request timed out after "
-                f"{self._proxy._request_timeout_s}s")
+            return ServeCallResponse(
+                status=STATUS_TIMEOUT,
+                error=f"request timed out after "
+                      f"{self._proxy._request_timeout_s}s",
+                request_id=req.request_id).to_wire()
+        except asyncio.CancelledError:
+            _cancel_response(response)
+            raise
+        except Exception as e:
+            return ServeCallResponse(
+                status=STATUS_APP_ERROR,
+                error=f"{type(e).__name__}: {e}",
+                request_id=req.request_id).to_wire()
+        return ServeCallResponse(status=STATUS_OK, result=result,
+                                 request_id=req.request_id).to_wire()
 
 
 async def _await_response(response):
@@ -262,6 +290,12 @@ class ProxyActor:
             return web.Response(
                 status=504,
                 text=f"request timed out after {self._request_timeout_s}s")
+        except asyncio.CancelledError:
+            # Client disconnected: aiohttp cancels the handler task —
+            # cancel the in-flight request end-to-end (release the
+            # replica slot + best-effort task cancel).
+            _cancel_response(response)
+            raise
         except Exception as e:
             logger.exception("request to %s failed", path)
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")

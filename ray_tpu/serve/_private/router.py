@@ -41,9 +41,26 @@ class _ReplicaEntry:
 
 
 class PowerOfTwoChoicesReplicaScheduler:
-    def __init__(self):
+    """Power-of-two routing with backoff, locality, and multiplexing
+    (reference: replica_scheduler/pow_2_scheduler.py —
+    choose_two_replicas_with_backoff :294):
+
+    - candidates narrow to replicas holding the request's multiplexed
+      model (when known), else to same-node replicas when at least two
+      exist (prefer-local), else all;
+    - two candidates are sampled and the less-loaded one chosen; when
+      both are saturated (ongoing >= max_ongoing_requests), the caller
+      backs off exponentially and resamples rather than piling onto a
+      loaded replica.
+    """
+
+    BACKOFF_BASE_S = 0.025
+    BACKOFF_MAX_S = 1.0
+
+    def __init__(self, local_node_id: str = ""):
         self._replicas: Dict[str, _ReplicaEntry] = {}
         self._lock = threading.Lock()
+        self._local_node_id = local_node_id
 
     def update_replicas(self, infos: List[dict]) -> None:
         with self._lock:
@@ -59,15 +76,62 @@ class PowerOfTwoChoicesReplicaScheduler:
     def num_replicas(self) -> int:
         return len(self._replicas)
 
-    def choose_replica(self) -> Optional[_ReplicaEntry]:
+    def _candidates(self, model_replica_ids: Optional[set],
+                    widen: bool = False) -> List[_ReplicaEntry]:
         with self._lock:
             entries = list(self._replicas.values())
+        if widen:
+            return entries  # narrowed pool saturated: consider everyone
+        if model_replica_ids:
+            with_model = [e for e in entries
+                          if e.info.replica_id in model_replica_ids]
+            if with_model:
+                return with_model
+        if self._local_node_id:
+            local = [e for e in entries
+                     if e.info.node_id == self._local_node_id]
+            if len(local) >= 2:
+                return local
+        return entries
+
+    def _sample_two(self, model_replica_ids: Optional[set],
+                    widen: bool = False) -> Optional[_ReplicaEntry]:
+        entries = self._candidates(model_replica_ids, widen)
         if not entries:
             return None
         if len(entries) == 1:
             return entries[0]
         a, b = random.sample(entries, 2)
         return a if a.ongoing <= b.ongoing else b
+
+    # After this many saturated rounds the preferred (model/local) pool
+    # is abandoned for the full set (reference: backoff widens
+    # candidates rather than piling onto a hot subset).
+    _WIDEN_AFTER_ROUNDS = 2
+
+    def choose_replica(self, model_replica_ids: Optional[set] = None,
+                       deadline: Optional[float] = None
+                       ) -> Optional[_ReplicaEntry]:
+        """Pick a replica; with a deadline, backs off while every sampled
+        candidate is at its max_ongoing_requests cap (widening from the
+        preferred pool to all replicas after a couple of rounds) and
+        returns the best-effort pick at the deadline (the replica queues
+        it). Without a deadline: single pass, immediate answer. None
+        only when no replicas exist."""
+        backoff = self.BACKOFF_BASE_S
+        rounds = 0
+        while True:
+            entry = self._sample_two(
+                model_replica_ids, widen=rounds >= self._WIDEN_AFTER_ROUNDS)
+            if entry is None:
+                return None
+            if entry.ongoing < entry.info.max_ongoing_requests:
+                return entry
+            if deadline is None or time.time() >= deadline:
+                return entry  # saturated everywhere: queue on the best
+            time.sleep(min(backoff, max(deadline - time.time(), 0.001)))
+            backoff = min(backoff * 2, self.BACKOFF_MAX_S)
+            rounds += 1
 
     def on_request_sent(self, entry: _ReplicaEntry) -> None:
         entry.ongoing += 1
@@ -91,7 +155,12 @@ class Router:
         self._controller = controller
         self._app_name = app_name
         self._deployment = deployment
-        self._scheduler = PowerOfTwoChoicesReplicaScheduler()
+        try:
+            local_node = ray_tpu.get_runtime_context().node_id.hex()
+        except Exception:
+            local_node = ""
+        self._scheduler = PowerOfTwoChoicesReplicaScheduler(
+            local_node_id=local_node)
         self._snapshot_id = -1
         self._stopped = False
         try:
@@ -155,7 +224,12 @@ class Router:
                        kwargs: dict, timeout_s: float = 30.0):
         """Pick a replica and submit; returns (ObjectRef, completion_cb)."""
         deadline = time.time() + timeout_s
-        entry = self._scheduler.choose_replica()
+        model_ids = None
+        if meta.multiplexed_model_id:
+            model_ids = self._multiplex_candidates(
+                meta.multiplexed_model_id)
+        entry = self._scheduler.choose_replica(model_ids,
+                                               deadline=deadline)
         while entry is None:
             if time.time() > deadline:
                 raise RuntimeError(
@@ -163,9 +237,8 @@ class Router:
                     f"{self._app_name}#{self._deployment} after "
                     f"{timeout_s:.0f}s")
             time.sleep(0.1)
-            entry = self._scheduler.choose_replica()
-        if meta.multiplexed_model_id:
-            entry = self._choose_multiplexed(entry, meta)
+            entry = self._scheduler.choose_replica(model_ids,
+                                                   deadline=deadline)
         handle = entry.resolve()
         self._scheduler.on_request_sent(entry)
         # Idempotent release: fires on normal completion OR an early
@@ -206,12 +279,13 @@ class Router:
 
     _MULTIPLEX_CACHE_TTL_S = 2.0
 
-    def _choose_multiplexed(self, fallback: _ReplicaEntry,
-                            meta: RequestMetadata) -> _ReplicaEntry:
-        """Prefer a replica that already has the model loaded (reference:
-        multiplex-aware routing in pow_2_scheduler.py). The model→replica
-        map is cached and refreshed from a background thread so the hot
-        path never blocks on the fan-out RPC."""
+    def _multiplex_candidates(self, model_id: str) -> Optional[set]:
+        """Replica-id set that already holds the model — the pow-2
+        scheduler samples among THESE, keeping load balance even within
+        the model's replicas (reference: multiplex-aware candidates in
+        pow_2_scheduler.py). The model→replica map is cached and
+        refreshed from a background thread so the hot path never blocks
+        on the fan-out RPC."""
         now = time.time()
         if now - getattr(self, "_mux_fetched_at", 0.0) > \
                 self._MULTIPLEX_CACHE_TTL_S and \
@@ -228,15 +302,8 @@ class Router:
             threading.Thread(target=_bg, daemon=True,
                              name="serve-mux-refresh").start()
         cache: Dict[str, List[str]] = getattr(self, "_mux_models", {})
-        replica_ids = cache.get(meta.multiplexed_model_id, [])
-        if replica_ids:
-            with self._scheduler._lock:
-                candidates = [self._scheduler._replicas[rid]
-                              for rid in replica_ids
-                              if rid in self._scheduler._replicas]
-            if candidates:
-                return min(candidates, key=lambda e: e.ongoing)
-        return fallback
+        ids = cache.get(model_id)
+        return set(ids) if ids else None
 
     def _refresh_multiplex_cache(self) -> None:
         with self._scheduler._lock:

@@ -3,10 +3,12 @@ from ray_tpu.tune.search.sample import (Categorical, Domain, Float, Integer,
                                         choice, grid_search, lograndint,
                                         loguniform, qloguniform, quniform,
                                         randint, randn, sample_from, uniform)
+from ray_tpu.tune.search.bayesopt import BayesOptSearch
 from ray_tpu.tune.search.bohb import TuneBOHB
 from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Searcher
 
 __all__ = [
+    "BayesOptSearch",
     "BasicVariantGenerator", "Categorical", "ConcurrencyLimiter", "Domain",
     "Float", "Integer", "Searcher", "choice", "grid_search", "lograndint",
     "loguniform", "qloguniform", "quniform", "randint", "randn",

@@ -1,0 +1,100 @@
+"""Ax searcher adapter.
+
+Reference: python/ray/tune/search/ax/ax_search.py — an adapter over
+Meta's Ax (Adaptive Experimentation) service API. The adapter converts
+the tune search space to Ax parameter definitions, pulls suggestions
+from an `AxClient`, and reports completions back. Ax is an optional
+dependency: importing this module works everywhere; constructing
+`AxSearch` without ax installed raises with install guidance (the same
+gating the Optuna adapter uses for its dependency).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+def _to_ax_parameters(space: Dict[str, Any]) -> list:
+    params = []
+    for name, dom in sorted(space.items()):
+        if isinstance(dom, Categorical):
+            params.append({"name": name, "type": "choice",
+                           "values": list(dom.categories)})
+        elif isinstance(dom, Float):
+            params.append({"name": name, "type": "range",
+                           "bounds": [dom.lower, dom.upper],
+                           "value_type": "float",
+                           "log_scale": bool(dom.log)})
+        elif isinstance(dom, Integer):
+            params.append({"name": name, "type": "range",
+                           "bounds": [dom.lower, dom.upper - 1],
+                           "value_type": "int"})
+        else:
+            raise ValueError(
+                f"AxSearch cannot express domain {dom!r} for {name!r}")
+    return params
+
+
+class AxSearch(Searcher):
+    def __init__(self,
+                 space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None,
+                 mode: str = "max",
+                 ax_client=None,
+                 **ax_kwargs):
+        try:
+            from ax.service.ax_client import AxClient
+        except ImportError as e:
+            raise ImportError(
+                "AxSearch requires the 'ax-platform' package "
+                "(pip install ax-platform); for a dependency-free "
+                "Bayesian searcher use "
+                "ray_tpu.tune.search.bayesopt.BayesOptSearch") from e
+        self._metric = metric
+        self._mode = mode
+        self._space = dict(space or {})
+        self._fixed: Dict[str, Any] = {}
+        self._client = ax_client or AxClient(**ax_kwargs)
+        self._experiment_created = False
+        self._live: Dict[str, int] = {}  # trial_id -> ax trial index
+
+    def set_search_properties(self, metric, mode, config=None) -> None:
+        self._metric = metric or self._metric
+        self._mode = mode or self._mode
+        if config and not self._space:
+            self._space = {k: v for k, v in config.items()
+                           if isinstance(v, Domain)}
+            self._fixed = {k: v for k, v in config.items()
+                           if not isinstance(v, Domain)}
+
+    def _ensure_experiment(self) -> None:
+        if not self._experiment_created:
+            self._client.create_experiment(
+                parameters=_to_ax_parameters(self._space),
+                objectives=None if self._metric is None else {
+                    self._metric: __import__(
+                        "ax.service.utils.instantiation",
+                        fromlist=["ObjectiveProperties"]
+                    ).ObjectiveProperties(minimize=self._mode == "min")})
+            self._experiment_created = True
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        self._ensure_experiment()
+        params, index = self._client.get_next_trial()
+        self._live[trial_id] = index
+        return {**self._fixed, **params}
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        index = self._live.pop(trial_id, None)
+        if index is None:
+            return
+        if error or not result or self._metric not in result:
+            self._client.abandon_trial(index)
+            return
+        self._client.complete_trial(
+            index, raw_data={self._metric: float(result[self._metric])})

@@ -1,0 +1,137 @@
+"""Bayesian-optimization searcher — native Gaussian-process UCB.
+
+Reference: python/ray/tune/search/bayesopt/bayesopt_search.py (an adapter
+over the `bayes_opt` package). This framework ships a self-contained
+implementation instead of an adapter: a small RBF-kernel GP posterior
+over the observed (config, score) pairs with an Upper-Confidence-Bound
+acquisition maximized over a random candidate pool. No extra
+dependencies; numerically robust via jittered Cholesky.
+
+Continuous (Float, incl. log-scale) and Integer dimensions are modeled
+in a normalized [0, 1] space; Categorical dimensions are one-hot
+embedded. Until `n_initial_points` observations exist, suggestions are
+random (space-filling).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class BayesOptSearch(Searcher):
+    def __init__(self,
+                 space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None,
+                 mode: str = "max",
+                 n_initial_points: int = 5,
+                 kappa: float = 2.0,
+                 n_candidates: int = 512,
+                 seed: int = 0):
+        self._space = dict(space or {})
+        self._metric = metric
+        self._mode = mode
+        self._n_init = n_initial_points
+        self._kappa = kappa
+        self._n_candidates = n_candidates
+        self._rng = np.random.default_rng(seed)
+        self._x: List[np.ndarray] = []       # embedded observations
+        self._y: List[float] = []            # scores (maximization)
+        self._live: Dict[str, np.ndarray] = {}  # trial_id -> embedding
+
+    def set_search_properties(self, metric, mode, config=None) -> None:
+        self._metric = metric or self._metric
+        self._mode = mode or self._mode
+        if config and not self._space:
+            self._space = {k: v for k, v in config.items()
+                           if isinstance(v, Domain)}
+            self._fixed = {k: v for k, v in config.items()
+                           if not isinstance(v, Domain)}
+        if not getattr(self, "_fixed", None):
+            self._fixed = {}
+
+    # ---------------------------------------------------------- embedding
+    def _dims(self) -> List[Tuple[str, Domain]]:
+        return sorted(self._space.items())
+
+    def _embed_dim(self, dom: Domain, value) -> List[float]:
+        if isinstance(dom, Categorical):
+            one_hot = [0.0] * len(dom.categories)
+            one_hot[dom.categories.index(value)] = 1.0
+            return one_hot
+        if isinstance(dom, Float):
+            lo, hi = dom.lower, dom.upper
+            if dom.log:
+                return [(math.log(value) - math.log(lo)) /
+                        (math.log(hi) - math.log(lo))]
+            return [(value - lo) / (hi - lo)]
+        if isinstance(dom, Integer):
+            return [(value - dom.lower) /
+                    max(dom.upper - dom.lower, 1)]
+        return [0.0]  # Function/unknown: uninformative
+
+    def _embed(self, config: Dict[str, Any]) -> np.ndarray:
+        out: List[float] = []
+        for k, dom in self._dims():
+            out.extend(self._embed_dim(dom, config[k]))
+        return np.asarray(out, np.float64)
+
+    def _random_config(self) -> Dict[str, Any]:
+        return {k: dom.sample(self._rng) for k, dom in self._dims()}
+
+    # ---------------------------------------------------------------- GP
+    def _gp_posterior(self, cand: np.ndarray
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """Mean/std at candidate points for a zero-mean RBF GP."""
+        x = np.stack(self._x)                      # [n, d]
+        y = np.asarray(self._y)
+        mu_y, sd_y = y.mean(), max(y.std(), 1e-9)
+        yn = (y - mu_y) / sd_y
+        ls = 0.25 * math.sqrt(max(x.shape[1], 1))  # length scale
+        noise = 1e-4
+
+        def k(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / ls ** 2)
+
+        K = k(x, x) + noise * np.eye(len(x))
+        L = np.linalg.cholesky(K + 1e-8 * np.eye(len(x)))
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        Ks = k(x, cand)                            # [n, m]
+        mean = Ks.T @ alpha
+        v = np.linalg.solve(L, Ks)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        return mean * sd_y + mu_y, np.sqrt(var) * sd_y
+
+    # ----------------------------------------------------------- Searcher
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if not self._space:
+            return dict(getattr(self, "_fixed", {}))
+        if len(self._y) < self._n_init:
+            config = self._random_config()
+        else:
+            cands = [self._random_config()
+                     for _ in range(self._n_candidates)]
+            emb = np.stack([self._embed(c) for c in cands])
+            mean, std = self._gp_posterior(emb)
+            config = cands[int(np.argmax(mean + self._kappa * std))]
+        self._live[trial_id] = self._embed(config)
+        return {**getattr(self, "_fixed", {}), **config}
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        emb = self._live.pop(trial_id, None)
+        if emb is None or error or not result or \
+                self._metric not in result:
+            return
+        score = float(result[self._metric])
+        if self._mode == "min":
+            score = -score
+        self._x.append(emb)
+        self._y.append(score)

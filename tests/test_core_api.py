@@ -115,6 +115,44 @@ class TestTasks:
 
         assert ray_tpu.get(outer.remote(5), timeout=60) == 20
 
+    def test_fast_method_using_sync_api_stays_correct(self,
+                                                      ray_start_regular):
+        """A sub-millisecond actor method that calls ray_tpu.get must
+        keep working after many calls (the inline-on-loop optimization
+        must detect sync-API use and keep such keys on the executor
+        path)."""
+        @ray_tpu.remote
+        class G:
+            def fetch(self, box):
+                # Nested (not top-level) refs are NOT auto-resolved:
+                # this really calls the sync blocking API in-task.
+                return ray_tpu.get(box[0]) + 1
+
+        g = G.remote()
+        for i in range(30):  # far past the inline observation window
+            ref = ray_tpu.put(i)
+            assert ray_tpu.get(g.fetch.remote([ref]), timeout=30) == i + 1
+
+    def test_method_starts_using_sync_api_after_qualifying(
+            self, ray_start_regular):
+        """A method that qualifies for inline execution (several fast
+        sync-API-free runs) and only THEN calls ray_tpu.get must not
+        deadlock the worker loop (regression: the inline guard was
+        swallowed by an over-broad except RuntimeError)."""
+        @ray_tpu.remote
+        class LateGetter:
+            def work(self, box=None):
+                if box is not None:
+                    return ray_tpu.get(box[0]) + 1
+                return 0
+
+        a = LateGetter.remote()
+        for _ in range(8):  # qualify for inlining (fast, no sync API)
+            assert ray_tpu.get(a.work.remote(), timeout=30) == 0
+        ref = ray_tpu.put(41)
+        assert ray_tpu.get(a.work.remote([ref]), timeout=30) == 42
+        assert ray_tpu.get(a.work.remote(), timeout=30) == 0
+
     def test_options_resources(self, ray_start_regular):
         assert ray_tpu.get(add.options(num_cpus=2).remote(3, 4)) == 7
 

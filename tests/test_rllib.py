@@ -113,13 +113,15 @@ def test_ppo_vectorized_runners_learn():
               .debugging(seed=3))
     algo = config.build_algo()
     first_return, best = None, -np.inf
-    for _ in range(10):
+    for _ in range(16):
         result = algo.step()
         ret = result.get("episode_return_mean", float("nan"))
         if first_return is None and np.isfinite(ret):
             first_return = ret
         if np.isfinite(ret):
             best = max(best, ret)
+        if first_return is not None and best > first_return + 20:
+            break  # learning signal confirmed
     assert first_return is not None
     assert best > first_return + 20, (first_return, best)
     algo.cleanup()

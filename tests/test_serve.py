@@ -378,25 +378,45 @@ def test_rpc_ingress(ray_start_regular):
     address = ray_tpu.get(proxy.rpc_address.remote())
     host, port = address.rsplit(":", 1)
 
-    async def call():
+    from ray_tpu.serve._private.ingress_schema import (
+        STATUS_INVALID, STATUS_NOT_FOUND, STATUS_OK, ServeCallRequest,
+        ServeCallResponse)
+
+    async def call(body, retry_s=10):
         conn = await rpc.connect(host, int(port))
         try:
             # The proxy learns routes via an async long-poll: retry
             # briefly (same as the HTTP e2e test).
-            deadline = asyncio.get_event_loop().time() + 10
+            deadline = asyncio.get_event_loop().time() + retry_s
             while True:
-                try:
-                    return await conn.call("serve_call", {
-                        "app": "rpc_app", "payload": "hello"},
-                        timeout=30)
-                except rpc.RpcError:
-                    if asyncio.get_event_loop().time() > deadline:
-                        raise
+                r = ServeCallResponse.from_wire(
+                    await conn.call("serve_call", body, timeout=30))
+                if r.status == STATUS_NOT_FOUND and \
+                        asyncio.get_event_loop().time() < deadline:
                     await asyncio.sleep(0.2)
+                    continue
+                return r
         finally:
             await conn.close()
 
-    assert asyncio.run(call()) == "HELLO"
+    # Versioned request via the schema helper.
+    req = ServeCallRequest(app="rpc_app", payload="hello",
+                           request_id="r-1")
+    resp = asyncio.run(call(req.to_wire()))
+    assert resp.status == STATUS_OK and resp.result == "HELLO"
+    assert resp.request_id == "r-1"
+    # Raw-map client (old/minimal) still works: unknown fields ignored,
+    # missing fields defaulted.
+    resp = asyncio.run(call({"app": "rpc_app", "payload": "x",
+                             "future_field": 1}))
+    assert resp.status == STATUS_OK and resp.result == "X"
+    # Malformed: schema_version from the future is refused cleanly.
+    resp = asyncio.run(call({"app": "rpc_app", "schema_version": 99}))
+    assert resp.status == STATUS_INVALID
+    # Unknown app.
+    resp = asyncio.run(call({"app": "nope", "schema_version": 1},
+                            retry_s=0))
+    assert resp.status == STATUS_NOT_FOUND
     serve.delete("rpc_app")
 
 
@@ -543,3 +563,70 @@ def test_asgi_ingress(ray_start_regular):
         assert e.code == 418
         assert e.read() == b"short and stout"
     serve.delete("asgiapp")
+
+
+class TestRouterScheduling:
+    """Routing unit tests with skewed queue lengths (reference:
+    pow_2_scheduler tests)."""
+
+    def _scheduler(self, n=4, local_node="", max_ongoing=5, nodes=None):
+        from ray_tpu.serve._private.router import \
+            PowerOfTwoChoicesReplicaScheduler
+
+        s = PowerOfTwoChoicesReplicaScheduler(local_node_id=local_node)
+        s.update_replicas([
+            {"replica_id": f"r{i}", "actor_name": f"a{i}",
+             "deployment": "d", "app_name": "app",
+             "max_ongoing_requests": max_ongoing,
+             "node_id": (nodes[i] if nodes else "")}
+            for i in range(n)])
+        return s
+
+    def test_pow2_prefers_less_loaded(self):
+        s = self._scheduler(2)
+        r0 = s._replicas["r0"]
+        r0.ongoing = 4  # heavily loaded vs r1=0
+        picks = [s.choose_replica().info.replica_id for _ in range(20)]
+        assert all(p == "r1" for p in picks)
+
+    def test_backoff_when_saturated_then_recovers(self):
+        import threading
+
+        s = self._scheduler(2, max_ongoing=2)
+        for e in s._replicas.values():
+            e.ongoing = 2  # all saturated
+
+        def free_one():
+            time.sleep(0.15)
+            s._replicas["r1"].ongoing = 0
+
+        t = threading.Thread(target=free_one)
+        t.start()
+        t0 = time.time()
+        entry = s.choose_replica(deadline=time.time() + 5)
+        waited = time.time() - t0
+        t.join()
+        assert entry.info.replica_id == "r1"
+        assert waited >= 0.05  # actually backed off instead of piling on
+
+    def test_saturated_everywhere_returns_at_deadline(self):
+        s = self._scheduler(2, max_ongoing=1)
+        for e in s._replicas.values():
+            e.ongoing = 1
+        t0 = time.time()
+        entry = s.choose_replica(deadline=time.time() + 0.3)
+        assert entry is not None  # queued on a best-effort pick
+        assert 0.2 <= time.time() - t0 < 2.0
+
+    def test_prefer_local_candidates(self):
+        s = self._scheduler(4, local_node="nodeA",
+                            nodes=["nodeA", "nodeA", "nodeB", "nodeB"])
+        picks = {s.choose_replica().info.replica_id for _ in range(40)}
+        assert picks <= {"r0", "r1"}  # only same-node replicas sampled
+
+    def test_multiplex_candidates_win_over_locality(self):
+        s = self._scheduler(4, local_node="nodeA",
+                            nodes=["nodeA", "nodeA", "nodeB", "nodeB"])
+        picks = {s.choose_replica({"r2", "r3"}).info.replica_id
+                 for _ in range(40)}
+        assert picks <= {"r2", "r3"}  # model placement beats locality

@@ -439,3 +439,39 @@ def test_optuna_adapter_interface_gated():
     else:  # optuna available: the adapter actually suggests
         cfg = searcher.suggest("t0")
         assert 0 <= cfg["x"] <= 1
+
+
+def test_bayesopt_search(tmp_path):
+    """Native GP-UCB Bayesian searcher: finds the optimum region of a
+    smooth 1-d objective better than chance."""
+    from ray_tpu.tune.search.bayesopt import BayesOptSearch
+
+    def objective(config):
+        x = config["x"]
+        tune.report({"score": -(x - 0.7) ** 2})
+
+    results = Tuner(
+        objective,
+        param_space={"x": tune.uniform(0.0, 1.0)},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=20,
+                               search_alg=BayesOptSearch(seed=5)),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 20
+    best = results.get_best_result()
+    assert abs(best.config["x"] - 0.7) < 0.15, best.config
+
+
+def test_ax_search_gated():
+    """AxSearch raises a helpful ImportError when ax is absent (and
+    works as an adapter when present)."""
+    from ray_tpu.tune.search.ax import AxSearch
+
+    try:
+        import ax  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="ax-platform"):
+            AxSearch(metric="m", mode="max")
+    else:
+        s = AxSearch(space={"x": tune.uniform(0, 1)}, metric="m")
+        assert s.suggest("t1") is not None
