@@ -943,9 +943,15 @@ class GcsServer:
         """Latest metric snapshots per reporting worker (reference: node
         metrics agents feeding OpenCensusProxyCollector)."""
         if data["worker_id"] in self.retired_worker_ids:
-            # Already folded into retired totals; accepting a new snapshot
-            # would double-count its cumulative counters.
-            return False
+            if all(m["kind"] == "gauge" for m in data["metrics"]):
+                # Gauge-only reporters (e.g. raylet hardware reporters
+                # that stalled through a GCS restart) can't double-count
+                # anything: un-retire and accept.
+                self.retired_worker_ids.discard(data["worker_id"])
+            else:
+                # Already folded into retired totals; accepting a new
+                # snapshot would double-count its cumulative counters.
+                return False
         self.worker_metrics[data["worker_id"]] = {
             "metrics": data["metrics"], "time": time.time()}
         return True

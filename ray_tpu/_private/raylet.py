@@ -133,6 +133,8 @@ class Raylet:
         self._bg.append(asyncio.get_event_loop().create_task(
             self._log_monitor_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._spill_loop()))
+        self._bg.append(asyncio.get_event_loop().create_task(
+            self._reporter_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._drain_loop()))
         if self.config.memory_monitor_refresh_ms > 0:
             self._bg.append(asyncio.get_event_loop().create_task(
@@ -249,6 +251,71 @@ class Raylet:
                     return
             await asyncio.sleep(
                 min(self.config.health_check_period_ms / 2, 100) / 1000)
+
+    async def _reporter_loop(self) -> None:
+        """Per-node hardware reporter (reference:
+        python/ray/dashboard/modules/reporter/ — per-node cpu/mem/device
+        stats flowing into the metrics pipeline): cpu%, memory, object
+        store usage, and TPU chip allocation as gauges tagged with this
+        node, surfaced at the dashboard's /metrics and /api/node_stats."""
+        period = 2.0
+        prev_cpu: Optional[Tuple[float, float]] = None
+        tags = {"node_id": self.node_id.hex(),
+                "hostname": os.uname().nodename}
+        while not self.dead:
+            await asyncio.sleep(period)
+            try:
+                gauges = []
+
+                def g(name, value, desc):
+                    gauges.append({"name": name, "kind": "gauge",
+                                   "value": float(value), "tags": tags,
+                                   "description": desc})
+
+                # cpu utilisation from /proc/stat deltas
+                with open("/proc/stat") as f:
+                    parts = f.readline().split()[1:]
+                vals = [float(x) for x in parts]
+                total, idle = sum(vals), vals[3] + (
+                    vals[4] if len(vals) > 4 else 0.0)
+                if prev_cpu is not None:
+                    dt, di = total - prev_cpu[0], idle - prev_cpu[1]
+                    if dt > 0:
+                        g("node.cpu_percent", 100.0 * (1 - di / dt),
+                          "node CPU utilisation")
+                prev_cpu = (total, idle)
+                mem = {}
+                with open("/proc/meminfo") as f:
+                    for line in f:
+                        k, v = line.split(":", 1)
+                        mem[k] = float(v.split()[0]) * 1024
+                g("node.mem_total_bytes", mem.get("MemTotal", 0),
+                  "node memory total")
+                g("node.mem_available_bytes", mem.get("MemAvailable", 0),
+                  "node memory available")
+                if self.store is not None:
+                    st = self.store.stats()
+                    g("node.object_store_used_bytes",
+                      st.get("bytes_used", 0), "plasma bytes used")
+                    g("node.object_store_capacity_bytes",
+                      st.get("capacity", 0), "plasma capacity")
+                    g("node.object_store_num_objects",
+                      st.get("num_objects", 0), "plasma object count")
+                tpu_total = self.resources_total.get("TPU", 0.0)
+                if tpu_total:
+                    g("node.tpu_total", tpu_total, "TPU chips on node")
+                    g("node.tpu_available",
+                      self.available.get("TPU", 0.0),
+                      "unallocated TPU chips")
+                if self.gcs and not self.gcs.closed:
+                    await self.gcs.call("report_metrics", {
+                        "worker_id": b"raylet:" + self.node_id.binary(),
+                        "metrics": gauges})
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.debug("hardware reporter tick failed",
+                             exc_info=True)
 
     async def _memory_monitor_loop(self) -> None:
         """Kill the newest leased worker when node memory crosses the

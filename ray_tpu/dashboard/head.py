@@ -5,6 +5,7 @@ endpoints, /metrics Prometheus via metrics_agent.py:244). Runs in a
 thread beside the driver or the CLI head process.
 
 Endpoints:
+  GET /                     -> single-page UI (dashboard/ui.py)
   GET /healthz              -> "success"
   GET /metrics              -> Prometheus text (user + runtime metrics)
   GET /api/cluster_status   -> nodes + resources
@@ -12,6 +13,7 @@ Endpoints:
   GET /api/actors           -> actor table
   GET /api/jobs             -> submitted jobs
   GET /api/tasks/summary    -> task state counts
+  GET /api/node_stats       -> per-node hardware gauges (reporter loop)
   GET /api/timeline         -> chrome trace JSON
 """
 
@@ -92,9 +94,33 @@ class DashboardHead:
             return asyncio.get_running_loop().run_in_executor(
                 None, fn, *args)
 
+        @routes.get("/")
+        async def index(request):
+            from ray_tpu.dashboard.ui import INDEX_HTML
+
+            return web.Response(text=INDEX_HTML,
+                                content_type="text/html")
+
         @routes.get("/healthz")
         async def healthz(request):
             return web.Response(text="success")
+
+        @routes.get("/api/node_stats")
+        async def node_stats(request):
+            """Per-node hardware gauges from the raylet reporters
+            (reference: dashboard/modules/reporter/)."""
+            data = await offload(self._gcs, "get_metrics")
+            per_node: Dict[str, Dict[str, Any]] = {}
+            for m in data or []:
+                if not m["name"].startswith("node."):
+                    continue
+                node = m.get("tags", {}).get("node_id", "?")
+                row = per_node.setdefault(node, {
+                    "node_id": node,
+                    "hostname": m.get("tags", {}).get("hostname", "")})
+                row[m["name"]] = m["value"]
+            return web.json_response(list(per_node.values()),
+                                     dumps=_dumps)
 
         @routes.get("/metrics")
         async def metrics(request):

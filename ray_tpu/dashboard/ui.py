@@ -1,0 +1,168 @@
+"""Dashboard single-page UI (reference: python/ray/dashboard/client/ —
+a React app there; a dependency-free vanilla-JS page here, served by the
+dashboard head over the same JSON endpoints)."""
+
+INDEX_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif;
+         margin: 0; background: #f6f7f9; color: #1c2733; }
+  header { background: #1c2733; color: #fff; padding: 10px 20px;
+           display: flex; align-items: baseline; gap: 16px; }
+  header h1 { font-size: 18px; margin: 0; }
+  header .sub { color: #9fb0c0; font-size: 12px; }
+  nav { display: flex; gap: 4px; padding: 8px 16px 0; }
+  nav button { border: 0; background: #e2e6ea; padding: 8px 14px;
+               border-radius: 6px 6px 0 0; cursor: pointer; font-size: 13px; }
+  nav button.active { background: #fff; font-weight: 600; }
+  main { background: #fff; margin: 0 16px 16px; padding: 16px;
+         border-radius: 0 6px 6px 6px; min-height: 400px; }
+  table { border-collapse: collapse; width: 100%; font-size: 13px; }
+  th, td { text-align: left; padding: 6px 10px;
+           border-bottom: 1px solid #e7ebef; }
+  th { color: #5a6b7b; font-weight: 600; font-size: 12px;
+       text-transform: uppercase; }
+  .pill { padding: 2px 8px; border-radius: 10px; font-size: 12px; }
+  .ALIVE, .RUNNING, .SUCCEEDED { background: #e2f5e8; color: #176639; }
+  .DEAD, .FAILED, .ERROR { background: #fdeaea; color: #8f2020; }
+  .PENDING, .RESTARTING, .STOPPED { background: #fff4de; color: #7a5b12; }
+  .cards { display: flex; gap: 12px; flex-wrap: wrap; margin-bottom: 14px; }
+  .card { background: #f2f5f8; border-radius: 8px; padding: 12px 18px;
+          min-width: 140px; }
+  .card .v { font-size: 22px; font-weight: 700; }
+  .card .k { font-size: 12px; color: #5a6b7b; }
+  #err { color: #8f2020; font-size: 12px; padding: 4px 16px; }
+</style>
+</head>
+<body>
+<header><h1>ray_tpu</h1>
+  <span class="sub">cluster dashboard &middot;
+    refreshed <span id="ts">never</span></span></header>
+<nav>
+  <button data-tab="overview" class="active">Overview</button>
+  <button data-tab="nodes">Nodes</button>
+  <button data-tab="actors">Actors</button>
+  <button data-tab="jobs">Jobs</button>
+  <button data-tab="tasks">Tasks</button>
+</nav>
+<div id="err"></div>
+<main id="content">loading…</main>
+<script>
+let tab = 'overview';
+const $ = (s) => document.querySelector(s);
+const esc = (s) => String(s).replace(/[&<>"']/g, (c) => ({
+  '&': '&amp;', '<': '&lt;', '>': '&gt;', '"': '&quot;', "'": '&#39;'
+}[c]));
+const fmtBytes = (b) => {
+  if (!b && b !== 0) return '';
+  const u = ['B','KiB','MiB','GiB','TiB']; let i = 0;
+  while (b >= 1024 && i < u.length - 1) { b /= 1024; i++; }
+  return b.toFixed(i ? 1 : 0) + ' ' + u[i];
+};
+const PILL_OK = /^[A-Z_]+$/;
+const pill = (s) => PILL_OK.test(String(s)) ?
+  `<span class="pill ${s}">${s}</span>` : esc(s);
+// Cell renderers returning plain values are HTML-escaped; only the
+// pill() helper (validated charset) emits markup.
+const cell = (v) => (typeof v === 'string' && v.startsWith('<span class="pill '))
+  ? v : esc(v ?? '');
+const table = (cols, rows) =>
+  `<table><tr>${cols.map(c => `<th>${esc(c[0])}</th>`).join('')}</tr>` +
+  rows.map(r => `<tr>${cols.map(c => `<td>${cell(c[1](r))}</td>`)
+    .join('')}</tr>`).join('') + '</table>';
+async function j(url) { const r = await fetch(url);
+  if (!r.ok) throw new Error(url + ': ' + r.status); return r.json(); }
+
+const views = {
+  async overview() {
+    const [cs, stats] = await Promise.all(
+      [j('/api/cluster_status'), j('/api/node_stats')]);
+    const res = cs.resources || {};
+    const cards = [
+      ['nodes alive', `${cs.nodes_alive}/${cs.nodes_total}`],
+      ['CPUs', `${(res.available||{}).CPU ?? '?'} / ${(res.total||{}).CPU ?? '?'}`],
+      ['TPUs', `${(res.available||{}).TPU ?? 0} / ${(res.total||{}).TPU ?? 0}`],
+    ];
+    let html = '<div class="cards">' + cards.map(([k, v]) =>
+      `<div class="card"><div class="v">${esc(v)}</div>` +
+      `<div class="k">${esc(k)}</div></div>`).join('') + '</div>';
+    html += '<h3>Per-node hardware</h3>' + table([
+      ['node', r => (r.node_id || '').slice(0, 8)],
+      ['host', r => r.hostname],
+      ['cpu %', r => r['node.cpu_percent']?.toFixed(1)],
+      ['mem avail', r => fmtBytes(r['node.mem_available_bytes'])],
+      ['store used', r => fmtBytes(r['node.object_store_used_bytes'])],
+      ['store cap', r => fmtBytes(r['node.object_store_capacity_bytes'])],
+      ['tpu free/total', r => r['node.tpu_total'] ?
+        `${r['node.tpu_available']}/${r['node.tpu_total']}` : '-'],
+    ], stats);
+    return html;
+  },
+  async nodes() {
+    const nodes = await j('/api/nodes');
+    return table([
+      ['node', r => (r.node_id || '').slice(0, 8)],
+      ['state', r => pill(r.state)],
+      ['address', r => r.address],
+      ['slice', r => r.slice_id || '-'],
+      ['cpu avail', r => (r.resources_available || {}).CPU],
+      ['tpu avail', r => (r.resources_available || {}).TPU ?? '-'],
+    ], nodes);
+  },
+  async actors() {
+    const actors = await j('/api/actors');
+    return table([
+      ['actor', r => (r.actor_id || '').slice(0, 8)],
+      ['class', r => r.class_name],
+      ['name', r => r.name || ''],
+      ['state', r => pill(r.state)],
+      ['restarts', r => r.num_restarts],
+      ['node', r => (r.node_id || '').slice(0, 8)],
+    ], actors);
+  },
+  async jobs() {
+    const jobs = await j('/api/jobs');
+    return table([
+      ['job', r => r.submission_id || r.job_id],
+      ['status', r => pill(r.status || r.state)],
+      ['entrypoint', r => r.entrypoint || ''],
+    ], jobs);
+  },
+  async tasks() {
+    const summary = await j('/api/tasks/summary');
+    const rows = Object.entries(summary).map(([name, states]) =>
+      ({name, ...states}));
+    return table([
+      ['task', r => r.name],
+      ['pending', r => r.PENDING ?? 0],
+      ['running', r => r.RUNNING ?? 0],
+      ['finished', r => r.FINISHED ?? 0],
+      ['failed', r => r.FAILED ?? 0],
+    ], rows);
+  },
+};
+
+async function refresh() {
+  try {
+    $('#content').innerHTML = await views[tab]();
+    $('#ts').textContent = new Date().toLocaleTimeString();
+    $('#err').textContent = '';
+  } catch (e) { $('#err').textContent = String(e); }
+}
+document.querySelectorAll('nav button').forEach(b =>
+  b.addEventListener('click', () => {
+    document.querySelectorAll('nav button').forEach(x =>
+      x.classList.remove('active'));
+    b.classList.add('active');
+    tab = b.dataset.tab;
+    refresh();
+  }));
+refresh();
+setInterval(refresh, 3000);
+</script>
+</body>
+</html>
+"""

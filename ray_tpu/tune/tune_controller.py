@@ -23,6 +23,17 @@ from ray_tpu.tune.search.searcher import Searcher
 from ray_tpu.tune.trainable import TrainableActor
 
 
+def _latest_checkpoint_dir(trial_dir: str) -> Optional[str]:
+    """Newest checkpoint_NNNNNN dir under a trial dir (on-disk recovery
+    of a RUNNING trial's progress after a driver crash)."""
+    try:
+        ckpts = sorted(d for d in os.listdir(trial_dir)
+                       if d.startswith("checkpoint_"))
+    except OSError:
+        return None
+    return os.path.join(trial_dir, ckpts[-1]) if ckpts else None
+
+
 class TuneController:
     def __init__(self,
                  trainable_cls: type,
@@ -38,7 +49,8 @@ class TuneController:
                  stop: Optional[Dict] = None,
                  max_failures: int = 0,
                  trial_resources: Optional[Dict[str, float]] = None,
-                 callbacks: Optional[List] = None):
+                 callbacks: Optional[List] = None,
+                 restored_trials: Optional[List[Trial]] = None):
         self.trainable_cls = trainable_cls
         self.metric, self.mode = metric, mode
         self.scheduler = scheduler or FIFOScheduler()
@@ -70,7 +82,21 @@ class TuneController:
             base_searcher = base_searcher.searcher
         self._pending: List[Trial] = []
         self._adaptive = not isinstance(base_searcher, BasicVariantGenerator)
-        if self._adaptive:
+        self._restored: List[Trial] = []
+        if restored_trials is not None:
+            # Experiment-level resume (reference: tuner.py:243
+            # Tuner.restore): finished trials keep their results;
+            # unfinished ones re-queue and resume from their latest
+            # checkpoint. No NEW samples are generated.
+            self._adaptive = False
+            self._remaining_suggestions = 0
+            for t in restored_trials:
+                if t.status in (TERMINATED, ERROR):
+                    self._restored.append(t)
+                else:
+                    t.status = PENDING
+                    self._pending.append(t)
+        elif self._adaptive:
             self._remaining_suggestions = num_samples
         else:
             for cfg in base_searcher.generate_variants(
@@ -83,10 +109,49 @@ class TuneController:
             max_concurrent_trials = min(max_concurrent_trials, limiter_cap)
         self.max_concurrent = max_concurrent_trials
 
-        self.trials: List[Trial] = list(self._pending)
+        self.trials: List[Trial] = self._restored + list(self._pending)
         self._actors: Dict[str, object] = {}        # trial_id -> handle
         self._inflight: Dict[object, Trial] = {}    # train() ref -> trial
         self._actor_cls = ray_tpu.remote(TrainableActor)
+        self._last_snapshot = 0.0
+
+    # ------------------------------------------------- experiment snapshot
+    SNAPSHOT_FILE = "experiment_state.pkl"
+
+    def save_experiment_state(self) -> None:
+        """Atomic snapshot of every trial's progress (reference:
+        tune/execution/experiment_state.py) — Tuner.restore() resumes
+        from it after a driver crash. Result histories are truncated
+        (full per-result streams already persist via the logger
+        callbacks); the snapshot cost stays flat as experiments age."""
+        import dataclasses as _dc
+
+        import cloudpickle
+
+        slim = [_dc.replace(t, results=t.results[-1:])
+                for t in self.trials]
+        path = os.path.join(self.experiment_dir, self.SNAPSHOT_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump({"trials": slim}, f)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load_experiment_state(experiment_dir: str) -> List[Trial]:
+        import cloudpickle
+
+        path = os.path.join(experiment_dir,
+                            TuneController.SNAPSHOT_FILE)
+        with open(path, "rb") as f:
+            trials = cloudpickle.load(f)["trials"]
+        for t in trials:
+            if t.status not in (TERMINATED, ERROR) and \
+                    not t.checkpoint_path:
+                # A RUNNING trial's snapshot rarely carries its newest
+                # checkpoint — recover it from the trial dir on disk so
+                # resume continues instead of restarting.
+                t.checkpoint_path = _latest_checkpoint_dir(t.trial_dir)
+        return trials
 
     # ------------------------------------------------------------------
     def _launch(self, trial: Trial, restore_from: Optional[str] = None):
@@ -285,10 +350,26 @@ class TuneController:
                     (self._adaptive and self._remaining_suggestions > 0))
 
     def run(self) -> List[Trial]:
+        import time as _time
+
         try:
             while self.step():
-                pass
+                now = _time.monotonic()
+                if now - self._last_snapshot > 1.0:
+                    self._last_snapshot = now
+                    try:
+                        self.save_experiment_state()
+                    except Exception:
+                        pass
         finally:
+            # Snapshot BEFORE flipping RUNNING -> TERMINATED: an
+            # interrupted run must leave a snapshot whose unfinished
+            # trials are still marked unfinished, or restore() would
+            # treat their partial results as final.
+            try:
+                self.save_experiment_state()
+            except Exception:
+                pass
             for trial in self.trials:
                 if trial.status == RUNNING:
                     trial.status = TERMINATED

@@ -105,6 +105,28 @@ class Tuner:
         else:
             raise TypeError(f"unsupported trainable: {trainable!r}")
 
+    @classmethod
+    def restore(cls, path: str, trainable,
+                *,
+                tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its directory
+        (reference: python/ray/tune/tuner.py:243 Tuner.restore).
+
+        `path` is the experiment dir a previous fit() used
+        (<storage_path>/<name>). Finished trials keep their results;
+        unfinished ones resume from their latest checkpoints; no new
+        trials are sampled.
+        """
+        trials = TuneController.load_experiment_state(path)
+        run_config = run_config or RunConfig()
+        run_config.name = os.path.basename(path.rstrip("/"))
+        run_config.storage_path = os.path.dirname(path.rstrip("/"))
+        tuner = cls(trainable, tune_config=tune_config,
+                    run_config=run_config)
+        tuner._restored_trials = trials
+        return tuner
+
     def fit(self) -> ResultGrid:
         import ray_tpu
 
@@ -128,7 +150,8 @@ class Tuner:
             stop=getattr(run, "stop", None),
             max_failures=failure.max_failures if failure else 0,
             trial_resources=self._resources,
-            callbacks=getattr(run, "callbacks", None))
+            callbacks=getattr(run, "callbacks", None),
+            restored_trials=getattr(self, "_restored_trials", None))
         trials = controller.run()
         return ResultGrid(trials, cfg.metric, cfg.mode)
 

@@ -113,6 +113,23 @@ def test_dashboard_endpoints(ray_start_regular):
         assert "ray_tpu_unit_dash_counter" in metrics_text
         summary = json.loads(get("/api/tasks/summary"))
         assert isinstance(summary, dict)
+        # Single-page UI served at / (reference: dashboard/client/).
+        page = get("/")
+        assert "<title>ray_tpu dashboard</title>" in page
+        assert "/api/node_stats" in page
+        # Hardware reporter gauges (raylet reporter loop, ~2s cadence).
+        deadline = time.time() + 15
+        stats = []
+        while time.time() < deadline:
+            stats = json.loads(get("/api/node_stats"))
+            if stats and "node.mem_total_bytes" in stats[0]:
+                break
+            time.sleep(0.5)
+        assert stats, "no node hardware stats reported"
+        row = stats[0]
+        assert row["node.mem_total_bytes"] > 0
+        assert row["node.object_store_capacity_bytes"] > 0
+        assert "ray_tpu_node_mem_total_bytes" in get("/metrics")
     finally:
         dash.stop()
 
