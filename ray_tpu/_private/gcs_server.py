@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -1107,6 +1108,9 @@ def main():  # pragma: no cover - exercised via subprocess in tests
         print(json.dumps({"port": port}), flush=True)
         await asyncio.Event().wait()
 
+    from ray_tpu._private.profiling_hook import maybe_enable_profiler
+
+    maybe_enable_profiler("gcs")
     try:
         asyncio.run(run())
     except KeyboardInterrupt:

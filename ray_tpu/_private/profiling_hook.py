@@ -1,0 +1,31 @@
+"""Env-gated cProfile hook for control-plane processes.
+
+RAY_TPU_PROFILE=<prefix> makes gcs_server / raylet mains dump
+<prefix>.<tag>.<pid>.prof at exit (SIGTERM-safe) — the way to see inside
+spawned control processes in environments without py-spy/perf. Workers
+use RAY_TPU_WORKER_PROFILE (worker_main.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def maybe_enable_profiler(tag: str):
+    """Start a cProfile for this process when RAY_TPU_PROFILE is set;
+    returns the profiler (or None). Dumps stats at exit, converting
+    SIGTERM into a clean exit so atexit runs."""
+    prefix = os.environ.get("RAY_TPU_PROFILE")
+    if not prefix:
+        return None
+    import atexit
+    import cProfile
+    import signal
+    import sys
+
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    profiler = cProfile.Profile()
+    profiler.enable()
+    atexit.register(lambda: profiler.dump_stats(
+        f"{prefix}.{tag}.{os.getpid()}.prof"))
+    return profiler

@@ -53,6 +53,7 @@ class WorkerHandle:
         self.log_path: Optional[str] = None
         self.log_offset: int = 0
         self.log_partial: bytes = b""
+        self.tpu = False  # spawned with the TPU plugin env
 
 
 class LeaseRequest:
@@ -96,6 +97,7 @@ class Raylet:
         self._spilled_local: Dict[bytes, str] = {}
         self._spill_backend = None
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+        self._spawn_tasks: Set[asyncio.Task] = set()
         self.address = ""
         self.dead = False
 
@@ -147,6 +149,11 @@ class Raylet:
         self.dead = True
         for t in self._bg:
             t.cancel()
+        if self._spawn_tasks:
+            # Let in-flight spawns land so their processes get a proc
+            # handle (finish_spawn terminates them when self.dead).
+            await asyncio.gather(*list(self._spawn_tasks),
+                                 return_exceptions=True)
         for w in self.workers.values():
             if w.proc and w.proc.poll() is None:
                 w.proc.terminate()
@@ -387,7 +394,7 @@ class Raylet:
             w.proc.terminate()
 
     # ------------------------------------------------------------- worker pool
-    def _spawn_worker(self) -> WorkerHandle:
+    def _spawn_worker(self, tpu: bool = False) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         import ray_tpu
@@ -396,10 +403,12 @@ class Raylet:
             os.path.abspath(ray_tpu.__file__)))
         env["PYTHONPATH"] = pkg_root + (
             ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
-        # Restore the TPU plugin hook for workers on TPU nodes (the node
-        # stripped it for control-plane processes to keep startup fast).
+        # Restore the TPU plugin hook ONLY for workers leased to
+        # TPU-requesting work: the plugin's sitecustomize imports jax at
+        # interpreter start (~2s) — paying that for every plain CPU
+        # worker serializes large actor/task storms.
         pool_ips = env.get("RAY_TPU_AXON_POOL_IPS")
-        if pool_ips and self.resources_total.get("TPU", 0) > 0:
+        if tpu and pool_ips and self.resources_total.get("TPU", 0) > 0:
             env["PALLAS_AXON_POOL_IPS"] = pool_ips
         env.update({
             "RAY_TPU_WORKER_ID": worker_id.hex(),
@@ -412,15 +421,40 @@ class Raylet:
         log_path = os.path.join(self.session_dir, "logs",
                                 f"worker-{worker_id.hex()[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
-        logf = open(log_path, "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env, stdout=logf, stderr=subprocess.STDOUT,
-            start_new_session=True)
-        logf.close()
-        w = WorkerHandle(worker_id, proc.pid, proc)
+        w = WorkerHandle(worker_id, None, None)
+        w.tpu = tpu
         w.log_path = log_path
         self.workers[worker_id] = w
+
+        # fork/exec OFF the io loop: a spawn storm (hundreds of actors
+        # created at once) must not stall heartbeats — a blocked loop
+        # gets the whole node declared dead by the GCS health checker.
+        def popen():
+            with open(log_path, "ab") as logf:
+                return subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                    env=env, stdout=logf, stderr=subprocess.STDOUT,
+                    start_new_session=True)
+
+        async def finish_spawn():
+            try:
+                proc = await asyncio.get_running_loop().run_in_executor(
+                    None, popen)
+            except Exception:
+                logger.exception("worker spawn failed")
+                # Full death path: releases the lease/resources this
+                # worker may already hold (actor leases are taken before
+                # spawn) and reports actor death to the GCS.
+                await self._on_worker_death(w)
+                return
+            w.proc = proc
+            w.pid = proc.pid
+            if self.dead and proc.poll() is None:
+                proc.terminate()  # raylet shut down mid-spawn
+
+        task = asyncio.get_event_loop().create_task(finish_spawn())
+        self._spawn_tasks.add(task)
+        task.add_done_callback(self._spawn_tasks.discard)
         return w
 
     async def _log_monitor_loop(self) -> None:
@@ -430,13 +464,20 @@ class Raylet:
         sees every worker's stdout/stderr)."""
         while not self.dead:
             await asyncio.sleep(0.25)
+            loop = asyncio.get_event_loop()
             for w in list(self.workers.values()):
                 if w.log_path is None:
                     continue
+
+                def read_chunk(path=w.log_path, off=w.log_offset):
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        return f.read(256 * 1024)
+
                 try:
-                    with open(w.log_path, "rb") as f:
-                        f.seek(w.log_offset)
-                        chunk = f.read(256 * 1024)
+                    # Off-loop: tailing hundreds of worker logs must not
+                    # add blocking file I/O to the raylet's event loop.
+                    chunk = await loop.run_in_executor(None, read_chunk)
                 except OSError:
                     continue
                 if not chunk:
@@ -481,8 +522,9 @@ class Raylet:
         return {"node_id": self.node_id.binary(), "ok": True}
 
     def _on_conn_close(self, w: WorkerHandle) -> None:
-        if w.proc is None:
-            # driver or external worker: release its leases
+        # Driver/external registrations (never pool workers — those may
+        # transiently have proc=None while their async spawn completes).
+        if w.proc is None and w.state == "driver":
             self.workers.pop(w.worker_id, None)
 
     def _pool_capacity(self) -> int:
@@ -574,14 +616,15 @@ class Raylet:
                     continue
                 if not self._can_grant_now(req):
                     continue
-                worker = self._take_idle_worker()
+                needs_tpu = req.resources.get("TPU", 0) > 0
+                worker = self._take_idle_worker(tpu=needs_tpu)
                 if worker is None:
                     n_starting = sum(1 for w in self.workers.values()
                                      if w.state == "starting")
                     n_live = sum(1 for w in self.workers.values()
                                  if w.state in ("starting", "idle", "leased"))
                     if n_live < self._pool_capacity() or n_starting == 0:
-                        self._spawn_worker()
+                        self._spawn_worker(tpu=needs_tpu)
                     break  # wait for registration
                 self.lease_queue.remove(req)
                 self._grant(req, worker)
@@ -602,12 +645,31 @@ class Raylet:
                 self.lease_queue.remove(req)
                 req.grant_fut.set_result({"spillback": target})
 
-    def _take_idle_worker(self) -> Optional[WorkerHandle]:
+    def _take_idle_worker(self, tpu: bool = False
+                          ) -> Optional[WorkerHandle]:
+        keep: List[WorkerHandle] = []
+        found = fallback = None
         while self.idle_workers:
             w = self.idle_workers.pop()
-            if w.state == "idle" and (w.proc is None or w.proc.poll() is None):
-                return w
-        return None
+            if w.state != "idle" or (w.proc is not None and
+                                     w.proc.poll() is not None):
+                continue  # dead/stale entry
+            if w.tpu == tpu:
+                found = w
+                break
+            if not tpu and w.tpu and fallback is None:
+                # CPU work runs fine on a TPU-flavored worker (its env
+                # is a superset); reuse beats spawning — and prevents
+                # unbounded pool growth under mixed workloads.
+                fallback = w
+                continue
+            keep.append(w)
+        self.idle_workers.extend(keep)
+        if found is None and fallback is not None:
+            return fallback
+        if found is not None and fallback is not None:
+            self.idle_workers.append(fallback)
+        return found
 
     def _grant(self, req: LeaseRequest, worker: WorkerHandle) -> None:
         bundle_key = None
@@ -682,7 +744,7 @@ class Raylet:
         else:
             for k, v in spec.resources.items():
                 self.available[k] = self.available.get(k, 0) - v
-        w = self._spawn_worker()
+        w = self._spawn_worker(tpu=spec.resources.get("TPU", 0) > 0)
         w.state = "actor"
         w.actor_id = data["actor_id"]
         w.job_id = spec.job_id.binary()
@@ -1027,6 +1089,9 @@ def main():  # pragma: no cover - exercised via subprocess in tests
               flush=True)
         await asyncio.Event().wait()
 
+    from ray_tpu._private.profiling_hook import maybe_enable_profiler
+
+    maybe_enable_profiler("raylet")
     try:
         asyncio.run(run())
     except KeyboardInterrupt:

@@ -57,6 +57,10 @@ def main() -> None:
 
     profile_dir = os.environ.get("RAY_TPU_WORKER_PROFILE")
     if profile_dir:
+        import signal
+        import sys as _sys
+
+        signal.signal(signal.SIGTERM, lambda *_: _sys.exit(0))
         # Debug aid: cProfile the whole worker (loop thread) and dump
         # stats at exit — the only way to see inside spawned workers in
         # environments without py-spy/perf.

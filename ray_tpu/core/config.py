@@ -58,7 +58,10 @@ class Config:
     worker_lease_timeout_s: float = 30.0
     # --- health / failure detection ---
     health_check_period_ms: int = 1000
-    health_check_failure_threshold: int = 5
+    # Generous threshold (10s): worker-spawn storms (hundreds of actors)
+    # can lag loops for seconds; the reference's defaults allow ~15s
+    # (health_check_timeout_ms + failure threshold).
+    health_check_failure_threshold: int = 10
     num_heartbeats_timeout: int = 30
     # --- workers ---
     num_workers_soft_limit: int = 0  # 0 = num_cpus
