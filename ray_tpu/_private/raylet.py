@@ -54,6 +54,7 @@ class WorkerHandle:
         self.log_offset: int = 0
         self.log_partial: bytes = b""
         self.tpu = False  # spawned with the TPU plugin env
+        self.kill_requested = False  # kill arrived before spawn landed
 
 
 class LeaseRequest:
@@ -390,6 +391,10 @@ class Raylet:
 
     async def _kill_worker(self, w: WorkerHandle, reason: str) -> None:
         logger.info("killing worker %s: %s", w.worker_id.hex()[:8], reason)
+        # If the async spawn hasn't landed yet, finish_spawn honors this
+        # flag and terminates immediately — otherwise the orphan process
+        # (and its lease/resources) would leak.
+        w.kill_requested = True
         if w.proc and w.proc.poll() is None:
             w.proc.terminate()
 
@@ -449,8 +454,8 @@ class Raylet:
                 return
             w.proc = proc
             w.pid = proc.pid
-            if self.dead and proc.poll() is None:
-                proc.terminate()  # raylet shut down mid-spawn
+            if (self.dead or w.kill_requested) and proc.poll() is None:
+                proc.terminate()  # shut down / killed mid-spawn
 
         task = asyncio.get_event_loop().create_task(finish_spawn())
         self._spawn_tasks.add(task)

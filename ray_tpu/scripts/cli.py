@@ -256,6 +256,37 @@ def cmd_serve(args) -> None:
         print("serve shut down")
 
 
+def cmd_up(args) -> None:
+    from ray_tpu.autoscaler.launcher import create_or_update_cluster
+
+    state = create_or_update_cluster(args.config)
+    print(f"cluster {state['cluster_name']} up; "
+          f"head address={state['head_address']} "
+          f"workers={len(state['workers'])}")
+
+
+def cmd_down(args) -> None:
+    from ray_tpu.autoscaler.launcher import teardown_cluster
+
+    teardown_cluster(args.config)
+    print("cluster down")
+
+
+def cmd_exec(args) -> None:
+    from ray_tpu.autoscaler.launcher import exec_on_cluster
+
+    print(exec_on_cluster(args.config, args.cmd,
+                          all_nodes=args.all_nodes), end="")
+
+
+def cmd_attach(args) -> None:
+    import subprocess as _sp
+
+    from ray_tpu.autoscaler.launcher import attach_command
+
+    raise SystemExit(_sp.call(attach_command(args.config)))
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ray_tpu",
                                 description=__doc__.split("\n")[0])
@@ -277,6 +308,25 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("stop", help="stop the local cluster")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("up", help="launch a cluster from a YAML config")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a launched cluster")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("exec", help="run a command on the cluster head")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.add_argument("cmd", help="shell command")
+    sp.add_argument("--all-nodes", action="store_true")
+    sp.set_defaults(fn=cmd_exec)
+
+    sp = sub.add_parser("attach",
+                        help="interactive shell on the cluster head")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.set_defaults(fn=cmd_attach)
 
     sp = sub.add_parser("status", help="cluster resource summary")
     sp.add_argument("--address")
