@@ -756,11 +756,23 @@ class Raylet:
         lease_id = os.urandom(16)
         w.lease_id = lease_id
         self.leases[lease_id] = (w, dict(spec.resources), bundle_key)
+        trace = os.environ.get("RAY_TPU_TRACE_STARTUP")
+        t0 = time.monotonic()
+
+        def tr(msg):
+            if trace:
+                logger.info("TRACE lease %s +%.3f %s",
+                            w.worker_id.hex()[:6], time.monotonic() - t0,
+                            msg)
+
+        tr("spawned, waiting registration")
         try:
             await asyncio.wait_for(w.registered.wait(),
                                    self.config.worker_startup_timeout_s)
+            tr("registered, pushing creation")
             await w.conn.call("push_task", {"task": data["task"]},
                               timeout=self.config.worker_startup_timeout_s)
+            tr("creation pushed + done")
         except Exception as e:
             await self._kill_worker(w, f"actor creation failed: {e}")
             return {"ok": False, "error": str(e)}

@@ -32,6 +32,17 @@ def main() -> None:
     from ray_tpu._private.core_worker import WORKER, CoreWorker
 
     async def amain():
+        import time as _time
+
+        trace = os.environ.get("RAY_TPU_TRACE_STARTUP")
+        t_start = _time.time()
+
+        def tr(msg):
+            if trace:
+                print(f"TRACE {os.getpid()} +{_time.time() - t_start:.3f} "
+                      f"{msg}", flush=True)
+
+        tr("amain begin")
         cfg_json = os.environ.get("RAY_TPU_CONFIG_JSON")
         config = Config.from_dict(json.loads(cfg_json)) if cfg_json \
             else Config.from_env()
@@ -51,7 +62,9 @@ def main() -> None:
         from ray_tpu._private import worker as worker_mod
 
         worker_mod._attach_executor_worker(cw)
+        tr("connecting")
         await cw.connect()
+        tr("connected (registered with raylet)")
         await cw._should_exit.wait()
         await cw.disconnect()
 
