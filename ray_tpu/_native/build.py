@@ -19,6 +19,7 @@ _LIBS = {
     "ray_tpu_store": ["shm_store.cpp"],
     "ray_tpu_transfer": ["shm_store.cpp", "transfer.cpp"],
     "ray_tpu_channel": ["mutable_channel.cpp"],
+    "ray_tpu_fastlane": ["fastlane.cpp"],
 }
 
 

@@ -33,6 +33,7 @@ class RemoteFunction:
         _check_opts(opts)
         self._function = fn
         self._opts = opts
+        self._resolved_opts = None  # _resolve_strategy memo (opts are frozen)
         self._descriptor = None
         self._descriptor_session = None  # session token of the export
         self.__name__ = getattr(fn, "__name__", "remote_function")
@@ -60,7 +61,9 @@ class RemoteFunction:
                 self._descriptor_session != worker.core.worker_id.binary():
             self._descriptor = worker.export(self._function)
             self._descriptor_session = worker.core.worker_id.binary()
-        opts = _resolve_strategy(self._opts)
+        opts = self._resolved_opts
+        if opts is None:
+            opts = self._resolved_opts = _resolve_strategy(self._opts)
         refs = worker.submit_task(self._descriptor, args, kwargs, opts)
         num_returns = opts.get("num_returns", 1)
         if num_returns == 1 or num_returns == "streaming":

@@ -32,6 +32,8 @@ import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+import msgpack
+
 from ray_tpu.core import rpc
 from ray_tpu.core import serialization as ser
 from ray_tpu.core.config import Config
@@ -65,15 +67,35 @@ class InlineUnsafeError(RuntimeError):
 
 
 class _Lease:
-    __slots__ = ("lease_id", "address", "conn", "inflight", "raylet_address")
+    __slots__ = ("lease_id", "address", "conn", "inflight", "raylet_address",
+                 "fast_addr")
 
     def __init__(self, lease_id: bytes, address: str, conn: rpc.Connection,
-                 raylet_address: str):
+                 raylet_address: str, fast_addr: str = ""):
         self.lease_id = lease_id
         self.address = address
         self.conn = conn
         self.inflight = 0
         self.raylet_address = raylet_address
+        self.fast_addr = fast_addr
+
+
+class _FastKey:
+    """A scheduling key in fastlane mode: one leased worker owned by a
+    native channel; submissions ride the caller's thread, replies the
+    channel's pump thread — the io loop only brokers the lease."""
+
+    __slots__ = ("key", "channel", "lease", "deact_scheduled")
+
+    def __init__(self, key: tuple, channel, lease: _Lease):
+        self.key = key
+        self.channel = channel
+        self.lease = lease
+        self.deact_scheduled = False
+
+    def submit_spec(self, spec: TaskSpec) -> bool:
+        return self.channel.submit_batched(spec.to_wire(),
+                                           ("task", spec, self.key))
 
 
 class _SchedulingKeyState:
@@ -99,6 +121,15 @@ class _ActorState:
         self.seq_lock = threading.Lock()
         self.death_cause = ""
         self.lock = asyncio.Lock()
+        # Fastlane routing (native task path): the worker's fastlane port,
+        # a FastChannel once connected, and a count of in-flight pushes on
+        # the asyncio path — the channel engages only when that count is
+        # zero, so per-caller FIFO order survives the transition.
+        self.fast_addr: str = ""
+        self.max_concurrency: int = 1
+        self.channel = None
+        self.fast_disabled = False
+        self.loop_inflight = 0
 
 
 class _LocalActor:
@@ -192,6 +223,20 @@ class CoreWorker:
         self._task_events_last_flush: float = 0.0
         self._borrowed_notified: set = set()
         self._should_exit = asyncio.Event()
+        # --- fastlane (native task path) ---
+        self.fast_address: str = ""
+        self._fl_server = None
+        self._fl_dispatchers: List[threading.Thread] = []
+        self._fast_keys: Dict[tuple, _FastKey] = {}
+        # Serializes user-code execution across the fastlane dispatcher
+        # threads, the executor pump, and the inline-on-loop path (the
+        # loop only try-acquires — it must never block on this).
+        self._exec_mutex = threading.RLock()
+        self._env_seen = False  # a scoped runtime_env task has run here
+        self._direct_inflight = 0
+        self._direct_lock = threading.Lock()
+        self._fl_coro_cache: Dict[str, bool] = {}
+        self._fl_actor_simple: Optional[bool] = None
 
     # ---------------------------------------------------------------- setup
     async def connect(self) -> None:
@@ -211,6 +256,23 @@ class CoreWorker:
                 await self.gcs.call("subscribe", {"channel": "logs"})
         else:
             self.job_id = JobID.nil()
+        if self.mode == WORKER and self.config.fastlane_enabled:
+            try:
+                from ray_tpu.core.fastlane import FastlaneServer
+
+                self._fl_server = FastlaneServer()
+                self.fast_address = f"127.0.0.1:{self._fl_server.port}"
+                for i in range(2):
+                    t = threading.Thread(
+                        target=self._fastlane_dispatch_loop,
+                        name=f"fl-dispatch-{i}", daemon=True)
+                    t.start()
+                    self._fl_dispatchers.append(t)
+            except Exception:
+                logger.exception(
+                    "fastlane server failed to start; using rpc path only")
+                self._fl_server = None
+                self.fast_address = ""
         if self.raylet_address:
             rhost, rport = self.raylet_address.rsplit(":", 1)
             self.raylet = await rpc.connect(
@@ -219,6 +281,7 @@ class CoreWorker:
             r = await self.raylet.call("register_worker", {
                 "worker_id": self.worker_id.binary(),
                 "address": self.address,
+                "fast_address": self.fast_address,
                 "pid": os.getpid(),
             })
             if self.node_id is None:
@@ -301,6 +364,18 @@ class CoreWorker:
             except Exception:
                 pass
         self._executor.shutdown(wait=False, cancel_futures=True)
+        for st in self._actors.values():
+            if st.channel is not None:
+                st.channel.close()
+        for fk in list(self._fast_keys.values()):
+            fk.channel.close()
+        self._fast_keys.clear()
+        if self._fl_server is not None:
+            self._fl_server.shutdown()
+            for t in self._fl_dispatchers:
+                t.join(timeout=0.5)
+            if all(not t.is_alive() for t in self._fl_dispatchers):
+                self._fl_server.close()  # else: leak it — process is exiting
         for conn in list(self._peer_conns.values()):
             await conn.close()
         if self._server:
@@ -336,11 +411,19 @@ class CoreWorker:
             if st is not None:
                 st.state = view["state"]
                 st.death_cause = view.get("death_cause", "")
+                st.max_concurrency = view.get("max_concurrency",
+                                              st.max_concurrency)
                 if view["state"] == "ALIVE" and view["address"] != st.address:
                     st.address = view["address"]
+                    st.fast_addr = view.get("fast_address", "")
                     if st.conn:
                         await st.conn.close()
                         st.conn = None
+                    if st.channel is not None:
+                        st.channel.close()  # restarted actor: reconnect lazily
+                        st.channel = None
+                elif view["state"] == "ALIVE":
+                    st.fast_addr = view.get("fast_address", st.fast_addr)
 
     async def _on_raylet_message(self, method: str, data, conn):
         if method == "push_task":
@@ -395,7 +478,7 @@ class CoreWorker:
             # Store the bytes host-side anyway (memory store) rather than fail.
             self.memory_store.put_in_loop(object_id, sobj.to_bytes())
             return
-        self.memory_store.mark_in_plasma(object_id)
+        self.memory_store.mark_in_plasma_in_loop(object_id)
         await self.gcs.call("add_object_location", {
             "object_id": object_id.binary(),
             "node_id": self.node_id.binary() if self.node_id else b"",
@@ -583,10 +666,14 @@ class CoreWorker:
 
     # ------------------------------------------------------------- refcount
     def _on_object_out_of_scope(self, object_id: ObjectID) -> None:
+        # Only objects that actually reached the shm store need the
+        # cluster-wide free; inline results (the overwhelmingly common
+        # case) die right here — no per-ref loop hop.
+        in_plasma = self.memory_store.is_in_plasma(object_id)
         self.memory_store.delete(object_id)
         self._pending_tasks.pop(object_id.task_id(), None)
-        if self.plasma is not None and self.raylet is not None and \
-                self.loop.is_running():
+        if in_plasma and self.plasma is not None and \
+                self.raylet is not None and self.loop.is_running():
             asyncio.run_coroutine_threadsafe(
                 self._free_everywhere(object_id), self.loop)
 
@@ -705,6 +792,13 @@ class CoreWorker:
                     oid,
                     lineage_task=spec if self.config.lineage_enabled else None)
         self._pending_tasks[spec.task_id] = spec
+        # Fastlane: a key in fast mode sends from THIS thread over the
+        # native channel — the io loop is not involved per task at all
+        # (and one RUNNING event stands in for PENDING+RUNNING).
+        fk = self._fast_keys.get(spec.scheduling_key())
+        if fk is not None and fk.submit_spec(spec):
+            self._record_task_event(spec, "RUNNING")
+            return out
         self._record_task_event(spec, "PENDING")
         self.loop.call_soon_threadsafe(self._enqueue_for_lease, spec)
         return out
@@ -747,6 +841,16 @@ class CoreWorker:
         num_returns = opts.get("num_returns", 1)
         if num_returns == "streaming":
             num_returns = STREAMING
+        res_memo_key = f"_res_memo_{task_type}"
+        resources = opts.get(res_memo_key)
+        if resources is None:
+            resources = _normalize_resources(opts, task_type)
+            try:
+                # opts may be the RemoteFunction's cached resolved dict:
+                # memoize there so repeat submissions skip the rebuild.
+                opts[res_memo_key] = resources
+            except TypeError:
+                pass
         strategy = opts.get("scheduling_strategy")
         pg_id = None
         bundle = -1
@@ -763,7 +867,7 @@ class CoreWorker:
             function=descriptor,
             args=wire_args,
             num_returns=num_returns,
-            resources=_normalize_resources(opts, task_type),
+            resources=resources,
             caller_address=self.address,
             scheduling_strategy=strategy if isinstance(strategy, dict) else None,
             placement_group_id=pg_id,
@@ -784,6 +888,32 @@ class CoreWorker:
 
     def _pump_scheduling_key(self, key: tuple,
                              state: _SchedulingKeyState) -> None:
+        # Fastlane hand-off: once a key is observed-tiny and a granted
+        # lease advertises a fastlane port, move the key into fast mode —
+        # the channel owns that lease; queued specs drain into it and new
+        # submissions bypass the loop entirely (submit_task_sync).
+        if self.config.fastlane_enabled:
+            fk = self._fast_keys.get(key)
+            if fk is None and state.duration_ema is not None and \
+                    state.duration_ema <= \
+                    self.config.pipeline_task_duration_s and \
+                    self.config.max_tasks_in_flight_per_worker > 1:
+                for lease in state.leases:
+                    if lease.fast_addr and lease.inflight == 0:
+                        fk = self._activate_fast_key(key, state, lease)
+                        break
+            if fk is not None:
+                while state.queue:
+                    if not fk.submit_spec(state.queue[0]):
+                        fk = None  # channel died mid-drain; loop flow below
+                        break
+                    state.queue.pop(0)
+                if fk is not None and not state.queue:
+                    for lease in [l for l in state.leases
+                                  if l.inflight == 0]:
+                        state.leases.remove(lease)
+                        self.loop.create_task(self._return_lease(lease))
+                    return
         # Assign queued tasks to leases BREADTH-FIRST: one task per idle
         # lease (strict spread semantics, matching the reference's
         # one-in-flight `lease_entry.is_busy`, normal_task_submitter.cc:197).
@@ -891,7 +1021,8 @@ class CoreWorker:
             self._fail_queued(key, state, f"worker connect failed: {e}")
             return
         lease = _Lease(lease_id, reply["worker_address"], conn,
-                       raylet_address)
+                       raylet_address,
+                       fast_addr=reply.get("worker_fast_address", ""))
         state.leases.append(lease)
         self._pump_scheduling_key(key, state)
 
@@ -978,9 +1109,23 @@ class CoreWorker:
         for oid_b, inline in reply.get("returns", []):
             oid = ObjectID(oid_b)
             if inline is None:
-                self.memory_store.mark_in_plasma(oid)
+                self.memory_store.mark_in_plasma_in_loop(oid)
             else:
                 self.memory_store.put_in_loop(oid, inline)
+            self._reap_if_unreferenced(oid, inline is None)
+
+    def _reap_if_unreferenced(self, oid: ObjectID, in_plasma: bool) -> None:
+        """A result landing for a ref that already went out of scope must
+        not leak: out-of-scope skipped the cluster free (no marker yet /
+        nothing stored), so the reply side finishes the job. Safe under
+        any interleaving with ObjectRef.__del__: whichever of the two
+        observes the other's write performs the free."""
+        if self.reference_counter.is_owned(oid):
+            return
+        self.memory_store.delete(oid)
+        if in_plasma and self.raylet is not None and self.loop.is_running():
+            asyncio.run_coroutine_threadsafe(
+                self._free_everywhere(oid), self.loop)
 
     def _release_task_arg_refs(self, spec: TaskSpec) -> None:
         for kind, payload, _ in spec.args:
@@ -1000,6 +1145,152 @@ class CoreWorker:
         for oid in spec.return_ids():
             self.memory_store.put_in_loop(oid, blob)
         self._release_task_arg_refs(spec)
+
+    # ------------------------------------------------- fastlane (submitter)
+    def _activate_fast_key(self, key: tuple, state: _SchedulingKeyState,
+                           lease: _Lease) -> Optional[_FastKey]:
+        from ray_tpu.core.fastlane import FastChannel
+
+        cell: list = []  # lets on_close identify WHICH channel died
+        try:
+            ch = FastChannel(
+                lease.fast_addr, self._fastlane_on_reply,
+                lambda pend, k=key, c=cell:
+                    self._fastlane_key_closed(k, pend,
+                                              c[0] if c else None))
+        except Exception:
+            lease.fast_addr = ""  # don't retry this lease
+            return None
+        cell.append(ch)
+        state.leases.remove(lease)
+        fk = _FastKey(key, ch, lease)
+        self._fast_keys[key] = fk
+        return fk
+
+    def _fastlane_on_reply(self, ctx, reply: dict) -> None:
+        """Channel pump thread: one task completed on the fast path."""
+        kind, spec, extra = ctx
+        if reply.get("status") == "error" and spec.retry_exceptions and \
+                spec.max_retries > 0:
+            spec.max_retries -= 1
+            if kind == "actor":
+                self._queue_actor_push(spec, extra)
+            else:
+                self.loop.call_soon_threadsafe(self._enqueue_for_lease, spec)
+            return
+        self._handle_task_reply_sync(spec, reply)
+        self._release_task_arg_refs(spec)
+        if kind == "task":
+            key = extra
+            state = self._scheduling_keys.get(key)
+            exec_s = reply.get("exec_s")
+            if state is not None and exec_s is not None:
+                state.duration_ema = (
+                    exec_s if state.duration_ema is None
+                    else 0.7 * state.duration_ema + 0.3 * exec_s)
+            fk = self._fast_keys.get(key)
+            if fk is not None and fk.channel.pending_count() == 0 and \
+                    not fk.deact_scheduled:
+                # Idle: linger briefly (bursty submitters reuse the
+                # channel), then give the lease back. The flag keeps a
+                # worker-keeps-pace burst (pending bouncing 0<->1) from
+                # waking the loop once per task.
+                fk.deact_scheduled = True
+                self.loop.call_soon_threadsafe(
+                    lambda: self.loop.call_later(
+                        0.25, self._maybe_deactivate_fast_key, key))
+
+    def _maybe_deactivate_fast_key(self, key: tuple) -> None:
+        fk = self._fast_keys.get(key)
+        if fk is None or fk.channel.dead:
+            return
+        fk.deact_scheduled = False
+        if fk.channel.pending_count() > 0:
+            return
+        state = self._scheduling_keys.get(key)
+        if state is not None and state.queue:
+            return
+        del self._fast_keys[key]
+
+        def finish():
+            if fk.channel.dead:
+                # Died after deactivation removed it from the dict, so
+                # on_close could not reap it — the lease is ours to return.
+                self.loop.create_task(
+                    self._return_lease(fk.lease, disconnect=True))
+                return
+            if fk.channel.pending_count() == 0:
+                fk.channel.close()
+                self.loop.create_task(self._return_lease(fk.lease))
+            elif key not in self._fast_keys:
+                # A submitter holding a stale reference slipped one in:
+                # reinstate and retry later.
+                self._fast_keys[key] = fk
+            else:
+                # A NEW fast key already took the slot: let this one's
+                # stragglers drain, then retire it.
+                self.loop.call_later(0.25, finish)
+
+        self.loop.call_later(0.05, finish)
+
+    def _fastlane_key_closed(self, key: tuple, pending: list,
+                             channel=None) -> None:
+        """Channel pump thread, connection lost: resubmit outstanding work
+        through the loop path with normal retry semantics. Pops the fast
+        key only if it still owns THIS channel — a deactivated old
+        channel's close must not reap a re-activated successor."""
+        fk = self._fast_keys.get(key)
+        if fk is not None and (channel is None or fk.channel is channel):
+            self._fast_keys.pop(key, None)
+        else:
+            fk = None  # not ours to reap; finish()/successor owns cleanup
+
+        def go():
+            if fk is not None:
+                self.loop.create_task(
+                    self._return_lease(fk.lease, disconnect=True))
+            for _kind, spec, _extra in pending:
+                if spec.max_retries > 0:
+                    spec.max_retries -= 1
+                    self._enqueue_for_lease(spec)
+                else:
+                    self._store_error_returns(spec, ser.RayTaskError(
+                        spec.function.display(),
+                        "worker died (fastlane connection lost)",
+                        "WorkerCrashedError"))
+
+        self.loop.call_soon_threadsafe(go)
+
+    def _handle_task_reply_sync(self, spec: TaskSpec, reply: dict) -> None:
+        """Thread-safe twin of _handle_task_reply (channel pump threads):
+        results land via MemoryStore.put_sync, and returns are stored
+        BEFORE the pending entry is popped so a concurrent _get_fast
+        recheck can't conclude 'lost' mid-processing."""
+        ok = reply.get("status") == "ok"
+        if spec.is_streaming:
+            self._pending_tasks.pop(spec.task_id, None)
+            self._record_task_event(spec, "FINISHED" if ok else "FAILED")
+            self._finish_stream(spec.task_id,
+                                reply.get("stream_total", 0),
+                                reply.get("stream_error"))
+            return
+        returns = reply.get("returns", [])
+        if not ok and not returns:
+            # Transport-level failure (e.g. the dispatcher could not even
+            # parse the spec): synthesize error envelopes so gets resolve.
+            blob = ser.dumps(ser.RayTaskError(
+                spec.name, reply.get("error", "task failed"),
+                reply.get("error", "task failed")))
+            returns = [[oid.binary(), blob] for oid in spec.return_ids()]
+        for oid_b, inline in returns:
+            oid = ObjectID(oid_b)
+            if inline is None:
+                self.memory_store.mark_in_plasma_sync(oid)
+            else:
+                self.memory_store.put_sync(oid, inline)
+            self._reap_if_unreferenced(oid, inline is None)
+        self._pending_tasks.pop(spec.task_id, None)
+        self._record_task_event(spec, "FINISHED" if ok else "FAILED")
 
     # ------------------------------------------------- streaming generators
     async def handle_stream_item(self, data, conn) -> bool:
@@ -1227,12 +1518,14 @@ class CoreWorker:
             "namespace": opts.get("namespace") or "default",
             "class_name": descriptor.display(),
             "max_restarts": opts.get("max_restarts", 0),
+            "max_concurrency": opts.get("max_concurrency", 1),
             "detached": bool(opts.get("lifetime") == "detached"),
             "creation_task": spec.to_wire(),
         })
         if not r.get("ok"):
             raise ValueError(r.get("error", "actor registration failed"))
-        self._actors[actor_id] = _ActorState()
+        st = self._actors.setdefault(actor_id, _ActorState())
+        st.max_concurrency = opts.get("max_concurrency", 1)
         return actor_id
 
     async def _actor_connection(self, actor_id: ActorID) -> rpc.Connection:
@@ -1249,11 +1542,14 @@ class CoreWorker:
                 raise ser.ActorDiedError(f"actor {actor_id} does not exist")
             st.state = view["state"]
             st.death_cause = view.get("death_cause", "")
+            st.max_concurrency = view.get("max_concurrency",
+                                          st.max_concurrency)
             if view["state"] != "ALIVE":
                 raise ser.ActorDiedError(
                     f"actor {actor_id.hex()[:8]} is {view['state']}: "
                     f"{st.death_cause}")
             st.address = view["address"]
+            st.fast_addr = view.get("fast_address", "")
             host, port = st.address.rsplit(":", 1)
             st.conn = await rpc.connect(host, int(port),
                                         name=f"actor:{actor_id.hex()[:8]}")
@@ -1280,8 +1576,8 @@ class CoreWorker:
             seqno=seqno)
         spec.resources = {}
         if spec.is_streaming:
-            st = self._streams[spec.task_id] = StreamState()
-            st.actor_id = actor_id  # enables cooperative stream cancel
+            stream = self._streams[spec.task_id] = StreamState()
+            stream.actor_id = actor_id  # enables cooperative stream cancel
             out: list = [ObjectRefGenerator(spec.task_id, self)]
         else:
             out = [ObjectRef(oid, owner_address=self.address)
@@ -1289,12 +1585,78 @@ class CoreWorker:
             for oid in spec.return_ids():
                 self.reference_counter.add_owned_object(oid)
         self._pending_tasks[spec.task_id] = spec
-        self.loop.call_soon_threadsafe(self._spawn_actor_push, spec,
-                                       actor_id)
+        if self._try_fastlane_actor(st, actor_id, spec):
+            return out
+        self._queue_actor_push(spec, actor_id)
         return out
 
+    def _try_fastlane_actor(self, st: _ActorState, actor_id: ActorID,
+                            spec: TaskSpec) -> bool:
+        """Route an actor task over the native channel when safe: the
+        sync round trip then costs two process hops and zero io-loop
+        wakeups. Engages only once the asyncio path has fully drained
+        (loop_inflight == 0) so per-caller order survives the switch."""
+        if not self.config.fastlane_enabled or st.fast_disabled:
+            return False
+        if spec.actor_method == "__dag_loop__":
+            # DAG actors are driven by compiled channels; pin everything
+            # to the loop path so the long-lived loop call can't gate the
+            # fastlane connection.
+            st.fast_disabled = True
+            return False
+        if st.max_concurrency != 1:
+            st.fast_disabled = True
+            return False
+        ch = st.channel
+        if ch is None or ch.dead:
+            if st.state != "ALIVE" or not st.fast_addr or \
+                    st.loop_inflight > 0:
+                return False
+            from ray_tpu.core.fastlane import FastChannel
+
+            with st.seq_lock:  # one connector
+                ch = st.channel
+                if ch is None or ch.dead:
+                    try:
+                        ch = st.channel = FastChannel(
+                            st.fast_addr, self._fastlane_on_reply,
+                            lambda pend, aid=actor_id:
+                                self._fastlane_actor_closed(aid, pend))
+                    except Exception:
+                        return False
+        if st.loop_inflight > 0:
+            return False
+        return ch.submit(
+            msgpack.packb({"task": spec.to_wire()}, use_bin_type=True),
+            ("actor", spec, actor_id))
+
+    def _fastlane_actor_closed(self, actor_id: ActorID,
+                               pending: list) -> None:
+        """Channel pump thread: actor connection lost — push outstanding
+        calls through the asyncio path (which owns reconnect/death
+        semantics), in submission order."""
+        st = self._actors.get(actor_id)
+        if st is not None:
+            st.channel = None
+        for _kind, spec, _extra in pending:
+            self._queue_actor_push(spec, actor_id)
+
+    def _queue_actor_push(self, spec: TaskSpec, actor_id: ActorID) -> None:
+        """Submit an actor task on the asyncio path (any thread)."""
+        st = self._actors.setdefault(actor_id, _ActorState())
+        with st.seq_lock:
+            st.loop_inflight += 1
+        self.loop.call_soon_threadsafe(self._spawn_actor_push, spec,
+                                       actor_id)
+
     def _spawn_actor_push(self, spec: TaskSpec, actor_id: ActorID) -> None:
-        self.loop.create_task(self._push_actor_task(spec, actor_id))
+        task = self.loop.create_task(self._push_actor_task(spec, actor_id))
+        st = self._actors.get(actor_id)
+        if st is not None:
+            def _done(_t, st=st):
+                with st.seq_lock:
+                    st.loop_inflight -= 1
+            task.add_done_callback(_done)
 
     async def submit_actor_task(self, actor_id: ActorID, method: str,
                                 args: tuple, kwargs: dict,
@@ -1351,6 +1713,203 @@ class CoreWorker:
             return await self._execute_actor_creation(spec)
         return await self._execute_normal_task(spec)
 
+    # ------------------------------------------------- fastlane (executor)
+    def _fastlane_dispatch_loop(self) -> None:
+        """Native-transport request pump (runs on a plain thread).
+
+        The C++ server (fastlane.cpp) owns accept/read/framing and
+        delivers at most one outstanding request per connection; this
+        loop executes simple tasks directly — no asyncio involvement —
+        and falls back to the loop path for everything else, preserving
+        per-caller FIFO order either way (the fallback blocks this
+        connection's gate until it completes)."""
+        from ray_tpu.core.fastlane import CLOSED
+
+        srv = self._fl_server
+        while not self._should_exit.is_set():
+            item = srv.next(500)
+            if item is None:
+                continue
+            if item is CLOSED:
+                return
+            reqid, payload = item
+            try:
+                reply = self._fastlane_handle(payload)
+                out = msgpack.packb(reply, use_bin_type=True)
+            except Exception as e:
+                logger.exception("fastlane dispatch failed")
+                out = msgpack.packb(
+                    {"status": "error",
+                     "error": f"{type(e).__name__}: {e}", "returns": []},
+                    use_bin_type=True)
+            srv.reply(reqid, out)
+
+    def _fastlane_handle(self, payload: bytes) -> dict:
+        data = msgpack.unpackb(payload, raw=False)
+        if "tasks" in data:
+            # Batched submission: execute in order (same FIFO contract as
+            # one-frame-per-task), reply once.
+            return {"replies": [self._fastlane_handle_one({"task": w})
+                                for w in data["tasks"]]}
+        return self._fastlane_handle_one(data)
+
+    def _fastlane_handle_one(self, data: dict) -> dict:
+        spec = TaskSpec.from_wire(data["task"])
+        reply = self._try_execute_direct(spec)
+        if reply is None:
+            # Not direct-eligible (streaming / async / ref args / env /
+            # concurrency>1): run the full loop path and relay its reply.
+            fut = asyncio.run_coroutine_threadsafe(
+                self.handle_push_task(data, None), self.loop)
+            reply = fut.result()
+        return reply
+
+    def _try_execute_direct(self, spec: TaskSpec) -> Optional[dict]:
+        """Execute entirely on the dispatcher thread when safe; None means
+        'fall back to the loop path' (nothing has run yet)."""
+        if spec.is_streaming or spec.runtime_env:
+            return None
+        for kind, _p, _o in spec.args:
+            if kind != ARG_VALUE:
+                return None
+        if spec.task_type == ACTOR_TASK:
+            actor = self._local_actor
+            if actor is None or actor.max_concurrency != 1:
+                return None
+            if spec.actor_method == "__dag_loop__":
+                return None
+            if self._fl_actor_simple is None:
+                self._fl_actor_simple = _all_methods_plain(actor.instance)
+            if not self._fl_actor_simple:
+                # Actors with async/generator methods keep the loop path:
+                # the semaphore there is the concurrency authority.
+                return None
+            fn = getattr(actor.instance, spec.actor_method, None)
+            if fn is None:
+                return None
+            is_actor = True
+        elif spec.task_type == NORMAL_TASK:
+            if self._env_seen:
+                return None
+            fn = self.function_manager.get_cached(spec.function)
+            if fn is None:
+                blob = self._sync_gcs_call(
+                    "kv_get", {"ns": b"fn", "key": spec.function.function_key})
+                fn = self.function_manager.load(spec.function, blob)
+            key = spec.function.function_key
+            iscoro = self._fl_coro_cache.get(key)
+            if iscoro is None:
+                import inspect
+
+                iscoro = self._fl_coro_cache[key] = (
+                    asyncio.iscoroutinefunction(fn) or
+                    inspect.isgeneratorfunction(fn) or
+                    inspect.isasyncgenfunction(fn))
+            if iscoro:
+                return None
+            is_actor = False
+        else:
+            return None
+        # Publish-then-recheck (Dekker with the GIL): a runtime_env task
+        # arriving on the loop sets _env_seen, then waits for
+        # _direct_inflight to reach zero before mutating process state.
+        with self._direct_lock:
+            self._direct_inflight += 1
+        if not is_actor and self._env_seen:
+            with self._direct_lock:
+                self._direct_inflight -= 1
+            return None
+        t0 = time.monotonic()
+        try:
+            try:
+                args, kwargs = self._resolve_args_sync(spec)
+                with self._exec_mutex:
+                    prev = self._current_task
+                    self._current_task = spec
+                    try:
+                        result = fn(*args, **kwargs)
+                    finally:
+                        self._current_task = prev
+            except Exception as e:
+                return self._store_exception_sync(spec, e)
+            reply = self._store_returns_sync(spec, result)
+            reply["exec_s"] = time.monotonic() - t0
+            return reply
+        finally:
+            with self._direct_lock:
+                self._direct_inflight -= 1
+            if getattr(self, "_gate_env_waiting", 0):
+                self.loop.call_soon_threadsafe(self._gate_kick)
+
+    def _gate_kick(self) -> None:
+        if hasattr(self, "_gate_cond"):
+            self.loop.create_task(self._gate_notify())
+
+    async def _gate_notify(self) -> None:
+        async with self._gate_cond:
+            self._gate_cond.notify_all()
+
+    def flush_fast_channels(self) -> None:
+        """Push any batched fastlane submissions to the wire; called on
+        the blocking API entry points (get/wait) so batching never delays
+        a result the caller is already waiting for."""
+        for fk in list(self._fast_keys.values()):
+            fk.channel.flush()
+
+    def _resolve_args_sync(self, spec: TaskSpec) -> Tuple[tuple, dict]:
+        values = [ser.loads(payload) for _k, payload, _o in spec.args]
+        nkw = len(spec.kwarg_keys)
+        if nkw:
+            return (tuple(values[:-nkw]),
+                    dict(zip(spec.kwarg_keys, values[-nkw:])))
+        return tuple(values), {}
+
+    def _store_returns_sync(self, spec: TaskSpec, result: Any) -> dict:
+        if spec.num_returns == 0:
+            values: List[Any] = []
+        elif spec.num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.name} declared num_returns="
+                    f"{spec.num_returns} but returned {len(values)} values")
+        returns = []
+        for i, value in enumerate(values):
+            oid = ObjectID.for_task_return(spec.task_id, i)
+            returns.append([oid.binary(),
+                            self._store_one_return_sync(oid, value)])
+        return {"status": "ok", "returns": returns}
+
+    def _store_one_return_sync(self, oid: ObjectID,
+                               value: Any) -> Optional[bytes]:
+        sobj = ser.serialize(value)
+        if sobj.total_size <= self.config.max_direct_call_object_size or \
+                self.plasma is None:
+            return sobj.to_bytes()
+        try:
+            self.plasma.put_serialized(oid, sobj)
+        except StoreFullError:
+            return sobj.to_bytes()
+        asyncio.run_coroutine_threadsafe(
+            self.gcs.call("add_object_location", {
+                "object_id": oid.binary(),
+                "node_id": self.node_id.binary() if self.node_id else b""}),
+            self.loop).result(timeout=30.0)
+        return None
+
+    def _store_exception_sync(self, spec: TaskSpec, e: Exception) -> dict:
+        tb = traceback.format_exc()
+        err = ser.RayTaskError(spec.function.display() if
+                               spec.task_type != ACTOR_TASK else
+                               spec.actor_method, tb, repr(e), cause=e
+                               if _is_picklable(e) else None)
+        blob = ser.dumps(err)
+        return {"status": "error",
+                "returns": [[oid.binary(), blob]
+                            for oid in spec.return_ids()]}
+
     async def _resolve_args(self, spec: TaskSpec) -> Tuple[tuple, dict]:
         values = []
         for kind, payload, owner in spec.args:
@@ -1401,9 +1960,14 @@ class CoreWorker:
             if exclusive:
                 self._gate_env_waiting += 1
                 try:
+                    # Also wait out fastlane direct executions: they
+                    # checked _env_seen before starting (Dekker pairing
+                    # in _try_execute_direct), so once this predicate
+                    # holds no user code can observe the env mid-apply.
                     await self._gate_cond.wait_for(
                         lambda: self._gate_running == 0 and
-                        not self._gate_env_active)
+                        not self._gate_env_active and
+                        self._direct_inflight == 0)
                 finally:
                     self._gate_env_waiting -= 1
                 self._gate_env_active = True
@@ -1467,15 +2031,26 @@ class CoreWorker:
                   state[0] < threshold and
                   key not in self._exec_sync_api_keys)
         t0 = time.monotonic()
+        if inline and not self._exec_mutex.acquire(blocking=False):
+            # A fastlane dispatcher (or the pump) is mid-execution: the
+            # loop must never block on the mutex, so take the executor
+            # path, which serializes behind it.
+            inline = False
         if inline:
             self._inline_active = True
+            retry_on_executor = False
             try:
                 result = fn(*args)
             except InlineUnsafeError:
                 self._exec_sync_api_keys.add(key)
-                result = await self._run_sync(fn, *args)
+                retry_on_executor = True
             finally:
                 self._inline_active = False
+                # Release BEFORE any await: the executor pump needs this
+                # mutex, and it runs on another thread.
+                self._exec_mutex.release()
+            if retry_on_executor:
+                result = await self._run_sync(fn, *args)
         else:
             def observed():
                 _EXEC_TL.key = key
@@ -1524,7 +2099,8 @@ class CoreWorker:
                     return
             fn, args, fut = item
             try:
-                result, err = fn(*args), None
+                with self._exec_mutex:
+                    result, err = fn(*args), None
             except BaseException as e:  # surfaced via the task's future
                 result, err = None, e
             self.loop.call_soon_threadsafe(self._exec_resolve_one, fut,
@@ -1553,6 +2129,8 @@ class CoreWorker:
         # process-global state across awaits, so env-bearing tasks hold
         # the gate exclusively while pipelined plain tasks share it.
         exclusive = bool(spec.runtime_env)
+        if exclusive:
+            self._env_seen = True  # published before the gate wait
         await self._begin_task(exclusive)
         try:
             from ray_tpu._private.runtime_env import applied_runtime_env
@@ -1622,6 +2200,7 @@ class CoreWorker:
             await self.gcs.call("actor_ready", {
                 "actor_id": spec.actor_id.binary(),
                 "address": self.address,
+                "fast_address": self.fast_address,
                 "node_id": self.node_id.binary() if self.node_id else b"",
             })
             return {"status": "ok", "returns": []}
@@ -1835,7 +2414,7 @@ class CoreWorker:
             })
         # Flush on batch size or a 1s cadence (reference: TaskEventBuffer
         # periodic flush, task_event_buffer.h:206).
-        if len(self._task_events) >= 100 or \
+        if len(self._task_events) >= self.config.task_events_batch_size or \
                 time.time() - self._task_events_last_flush > 1.0:
             self._flush_task_events()
 
@@ -1857,7 +2436,7 @@ class CoreWorker:
                 "actor_id": None,
                 "extra": extra or {},
             })
-        if len(self._task_events) >= 100 or \
+        if len(self._task_events) >= self.config.task_events_batch_size or \
                 time.time() - self._task_events_last_flush > 1.0:
             self._flush_task_events()
 
@@ -1906,6 +2485,27 @@ def _normalize_resources(opts: dict, task_type: int) -> Dict[str, float]:
 
 def _actor_method_descriptor(method: str) -> FunctionDescriptor:
     return FunctionDescriptor(module="", qualname=method, function_key=b"")
+
+
+def _all_methods_plain(instance) -> bool:
+    """True when every public method is a plain sync function (no
+    coroutine/generator methods): the precondition for fastlane direct
+    execution of a max_concurrency=1 actor — the loop-side semaphore is
+    the concurrency authority for anything fancier."""
+    import inspect
+
+    cls = type(instance)
+    for name in dir(cls):
+        if name.startswith("__"):
+            continue
+        fn = getattr(cls, name, None)
+        if fn is None or not callable(fn):
+            continue
+        if asyncio.iscoroutinefunction(fn) or \
+                inspect.isgeneratorfunction(fn) or \
+                inspect.isasyncgenfunction(fn):
+            return False
+    return True
 
 
 def _is_picklable(e: Exception) -> bool:

@@ -85,11 +85,13 @@ class ActorInfo:
         self.namespace: str = data.get("namespace") or "default"
         self.class_name: str = data.get("class_name", "")
         self.max_restarts: int = data.get("max_restarts", 0)
+        self.max_concurrency: int = data.get("max_concurrency", 1)
         self.detached: bool = data.get("detached", False)
         self.creation_task: dict = data["creation_task"]  # wire TaskSpec
         self.job_id: JobID = JobID(data["job_id"])
         self.state = PENDING
         self.address: str = ""
+        self.fast_address: str = ""  # fastlane (native task path) port
         self.node_id: Optional[NodeID] = None
         self.num_restarts = 0
         self.death_cause: str = ""
@@ -102,10 +104,12 @@ class ActorInfo:
             "class_name": self.class_name,
             "state": self.state,
             "address": self.address,
+            "fast_address": self.fast_address,
             "node_id": self.node_id.binary() if self.node_id else None,
             "job_id": self.job_id.binary(),
             "num_restarts": self.num_restarts,
             "max_restarts": self.max_restarts,
+            "max_concurrency": self.max_concurrency,
             "death_cause": self.death_cause,
         }
 
@@ -121,9 +125,11 @@ class ActorInfo:
             "name": v["name"], "namespace": v["namespace"],
             "class_name": v["class_name"],
             "max_restarts": v["max_restarts"], "detached": v["detached"],
+            "max_concurrency": v.get("max_concurrency", 1),
             "creation_task": v["creation_task"], "job_id": v["job_id"]})
         info.state = v["state"]
         info.address = v["address"]
+        info.fast_address = v.get("fast_address", "")
         info.node_id = NodeID(v["node_id"]) if v.get("node_id") else None
         info.num_restarts = v["num_restarts"]
         info.death_cause = v["death_cause"]
@@ -587,6 +593,7 @@ class GcsServer:
             return False
         actor.state = ALIVE
         actor.address = data["address"]
+        actor.fast_address = data.get("fast_address", "")
         actor.node_id = NodeID(data["node_id"])
         self._persist_actor(actor)
         await self.publish("actors", actor.view())

@@ -54,8 +54,39 @@ class MemoryStore:
         """Same as put() but caller is already on the loop."""
         self._put_in_loop(object_id, data)
 
+    def put_sync(self, object_id: ObjectID, data) -> None:
+        """Store from a non-loop thread WITHOUT a loop round trip (the
+        fastlane reply pump): dict writes are GIL-atomic, synchronous
+        waiters are woken directly, and loop-side futures (if any) are
+        woken via one call_soon_threadsafe — paid only when an async
+        getter is actually parked on this object."""
+        if data is IN_PLASMA:
+            self._plasma_markers.add(object_id)
+        else:
+            self._objects[object_id] = data
+        if self._sync_waiters:
+            with self._sync_lock:
+                events = self._sync_waiters.pop(object_id, ())
+            for ev in events:
+                ev.set()
+        if object_id in self._waiters and self._loop.is_running():
+            self._loop.call_soon_threadsafe(self._wake_async, object_id)
+
+    def _wake_async(self, object_id: ObjectID) -> None:
+        for fut in self._waiters.pop(object_id, []):
+            if not fut.done():
+                fut.set_result(True)
+
+    def mark_in_plasma_sync(self, object_id: ObjectID) -> None:
+        self.put_sync(object_id, IN_PLASMA)
+
     def mark_in_plasma(self, object_id: ObjectID) -> None:
         self._loop.call_soon_threadsafe(self._put_in_loop, object_id, IN_PLASMA)
+
+    def mark_in_plasma_in_loop(self, object_id: ObjectID) -> None:
+        """Synchronous marker set (caller on the loop): out-of-scope
+        decisions race the marker, so reply processing must not defer it."""
+        self._put_in_loop(object_id, IN_PLASMA)
 
     def get_if_exists(self, object_id: ObjectID) -> Optional[bytes]:
         return self._objects.get(object_id)
@@ -73,6 +104,13 @@ class MemoryStore:
             return True
         fut = asyncio.get_running_loop().create_future()
         self._waiters.setdefault(object_id, []).append(fut)
+        if self.contains(object_id):
+            # Landed between the check and registration: a cross-thread
+            # put_sync saw no waiter entry, so nobody will wake us.
+            lst = self._waiters.get(object_id)
+            if lst and fut in lst:
+                lst.remove(fut)
+            return True
         try:
             await asyncio.wait_for(fut, timeout)
             return True

@@ -44,6 +44,7 @@ class WorkerHandle:
         self.pid = pid
         self.proc = proc
         self.address: str = ""
+        self.fast_address: str = ""  # fastlane (native task path) port
         self.conn: Optional[rpc.Connection] = None
         self.registered = asyncio.Event()
         self.state = "starting"  # starting|idle|leased|actor|dead
@@ -517,6 +518,7 @@ class Raylet:
             w.state = "driver"
             self.workers[worker_id] = w
         w.address = data["address"]
+        w.fast_address = data.get("fast_address", "")
         w.conn = conn
         conn.on_close = lambda c, w=w: self._on_conn_close(w)
         w.registered.set()
@@ -694,6 +696,7 @@ class Raylet:
         req.grant_fut.set_result({
             "granted": True,
             "worker_address": worker.address,
+            "worker_fast_address": worker.fast_address,
             "worker_id": worker.worker_id.binary(),
         })
 

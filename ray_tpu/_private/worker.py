@@ -95,6 +95,8 @@ class Worker:
                 raise InlineUnsafeError(
                     "task uses the sync blocking API; retrying on "
                     "the executor path")
+        if self.core._fast_keys:
+            self.core.flush_fast_channels()
         single = isinstance(refs, (ObjectRef, CompiledDAGRef))
         ref_list = [refs] if single else list(refs)
         if any(isinstance(r, CompiledDAGRef) for r in ref_list):
@@ -181,6 +183,8 @@ class Worker:
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None, fetch_local: bool = True):
+        if self.core._fast_keys:
+            self.core.flush_fast_channels()
         refs = list(refs)
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds the number of refs")
@@ -243,6 +247,12 @@ class Worker:
             "value": ser.dumps(prepared)})
 
     def _prepare_env_opts(self, opts) -> dict:
+        if opts.get("runtime_env") is None and self._job_envs is not None:
+            # Hot path: job env already resolved and empty, no per-call
+            # env — nothing to merge or package.
+            key = self.core.job_id.binary() if self.core.job_id else None
+            if key in self._job_envs and not self._job_envs[key]:
+                return opts
         from ray_tpu._private.runtime_env import (merge_runtime_envs,
                                                   prepare_runtime_env)
 

@@ -78,6 +78,12 @@ class Config:
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_max_message_bytes: int = 512 << 20
+    # Native task-path fast lane (_native/fastlane.cpp): framing, reply
+    # correlation, and the submit/receive pump run in C++ threads off the
+    # asyncio loops; simple tasks execute without touching the loop at
+    # all (reference: the C++ lease/push pipeline,
+    # normal_task_submitter.cc:24, server_call.h).
+    fastlane_enabled: bool = True
     # GIL switch interval applied in every ray_tpu process (0 = leave
     # Python's 5 ms default). Sub-ms keeps the io loop responsive while
     # the executor thread runs user code — the Python substitute for the
@@ -94,6 +100,10 @@ class Config:
     log_to_driver: bool = True
     task_events_enabled: bool = True
     task_events_max_buffer: int = 10000
+    # Events per report batch: bigger batches = fewer GCS round trips on
+    # the submission hot path (reference: task_events_report_interval_ms
+    # batching in TaskEventBuffer).
+    task_events_batch_size: int = 1000
     metrics_report_interval_ms: int = 2000
     # --- session ---
     temp_dir: str = "/tmp/ray_tpu"

@@ -1,0 +1,295 @@
+"""Fastlane client/server: the native task-path transport.
+
+Python face of _native/fastlane.cpp — the C++ submit/receive pump that
+replaces the asyncio rpc layer on the task hot path (reference:
+src/ray/rpc/server_call.h, src/ray/core_worker/transport/
+normal_task_submitter.cc:24). Framing, reply correlation, and all blocking
+waits happen in native code with the GIL released; Python supplies only
+policy: what to execute, how to store results.
+
+``FastlaneServer`` is the executor side (workers): dispatcher threads pop
+requests with :meth:`next` and answer with :meth:`reply`. The native layer
+delivers at most one outstanding request per connection, preserving
+per-caller FIFO order.
+
+``FastChannel`` is the submitter side (drivers/workers submitting): sends
+ride the calling thread; one pump thread per channel correlates replies and
+invokes ``on_reply(ctx, reply_dict)`` off the event loop entirely.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+from ray_tpu._native.build import load_lib
+
+logger = logging.getLogger(__name__)
+
+CLOSED = object()  # sentinel: the underlying connection/server is gone
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = load_lib("ray_tpu_fastlane")
+        c = ctypes
+        lib.fl_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+        lib.fl_connect.restype = c.c_void_p
+        lib.fl_send.argtypes = [c.c_void_p, c.c_uint64, c.c_char_p,
+                                c.c_int64]
+        lib.fl_send.restype = c.c_int
+        lib.fl_wait_any.argtypes = [c.c_void_p, c.c_int,
+                                    c.POINTER(c.c_char_p),
+                                    c.POINTER(c.c_int64)]
+        lib.fl_wait_any.restype = c.c_int64
+        lib.fl_closed.argtypes = [c.c_void_p]
+        lib.fl_closed.restype = c.c_int
+        lib.fl_shutdown.argtypes = [c.c_void_p]
+        lib.fl_close.argtypes = [c.c_void_p]
+        lib.fl_buf_free.argtypes = [c.c_char_p]
+        lib.fl_server_create.argtypes = [c.POINTER(c.c_int)]
+        lib.fl_server_create.restype = c.c_void_p
+        lib.fl_server_next.argtypes = [c.c_void_p, c.c_int,
+                                       c.POINTER(c.c_char_p),
+                                       c.POINTER(c.c_int64)]
+        lib.fl_server_next.restype = c.c_int64
+        lib.fl_server_reply.argtypes = [c.c_void_p, c.c_uint64, c.c_char_p,
+                                        c.c_int64]
+        lib.fl_server_reply.restype = c.c_int
+        lib.fl_server_shutdown.argtypes = [c.c_void_p]
+        lib.fl_server_close.argtypes = [c.c_void_p]
+        _lib = lib
+        return lib
+
+
+def _take_buf(lib, buf, n) -> bytes:
+    data = ctypes.string_at(buf, n.value)
+    lib.fl_buf_free(buf)
+    return data
+
+
+class FastlaneServer:
+    """Executor-side request server (native accept/read pump)."""
+
+    def __init__(self):
+        self._lib = _load()
+        port = ctypes.c_int()
+        self._h = self._lib.fl_server_create(ctypes.byref(port))
+        if not self._h:
+            raise OSError("fastlane server bind failed")
+        self.port = port.value
+        self._shut = False
+        self._lock = threading.Lock()
+
+    def next(self, timeout_ms: int = 500):
+        """Pop the next request: (reqid, payload) | None on timeout |
+        CLOSED after shutdown."""
+        buf = ctypes.c_char_p()
+        n = ctypes.c_int64()
+        rid = self._lib.fl_server_next(self._h, timeout_ms,
+                                       ctypes.byref(buf), ctypes.byref(n))
+        if rid > 0:
+            return rid, _take_buf(self._lib, buf, n)
+        return CLOSED if rid < 0 else None
+
+    def reply(self, reqid: int, payload: bytes) -> None:
+        self._lib.fl_server_reply(self._h, reqid, payload, len(payload))
+
+    def shutdown(self) -> None:
+        """Wake all dispatchers (they observe CLOSED); handle stays valid."""
+        with self._lock:
+            if not self._shut:
+                self._shut = True
+                self._lib.fl_server_shutdown(self._h)
+
+    def close(self) -> None:
+        """Free the native server. Only call after every dispatcher thread
+        has exited its next() loop."""
+        with self._lock:
+            if self._h:
+                self._lib.fl_server_shutdown(self._h)
+                self._lib.fl_server_close(self._h)
+                self._h = None
+
+
+class FastChannel:
+    """Submitter-side connection + reply pump.
+
+    submit() runs on the calling thread (one native frame write); the pump
+    thread correlates replies and calls ``on_reply(ctx, reply_dict)``. On
+    connection loss the pump calls ``on_close([ctx, ...])`` with every
+    unanswered submission, in submission order, then frees the native
+    handle itself (nobody else may touch it afterwards).
+    """
+
+    def __init__(self, address: str,
+                 on_reply: Callable[[Any, dict], None],
+                 on_close: Callable[[List[Any]], None],
+                 connect_timeout_ms: int = 2000):
+        self._lib = _load()
+        host, port = address.rsplit(":", 1)
+        self._h = self._lib.fl_connect(host.encode(), int(port),
+                                       connect_timeout_ms)
+        if not self._h:
+            raise ConnectionError(f"fastlane connect to {address} failed")
+        self.address = address
+        self._on_reply = on_reply
+        self._on_close = on_close
+        self._lock = threading.Lock()
+        self._next_id = 0
+        # msgids are assigned monotonically, so sorted keys ARE submission
+        # order — no separate order list to maintain per reply.
+        self._pending: Dict[int, Any] = {}
+        self._dead = False
+        # Adaptive batching (normal-task channels): wire dicts accumulate
+        # while the executor is busy and flush as one frame — when the
+        # executor is idle they flush immediately for latency. The pump
+        # provides a 5 ms safety flush for fire-and-forget submitters.
+        self._buf: List[Tuple[dict, Any]] = []
+        self.batch_max = 32
+        self._pump = threading.Thread(target=self._pump_loop,
+                                      name=f"fl-pump:{address}", daemon=True)
+        self._pump.start()
+
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    def submit(self, payload: bytes, ctx: Any) -> bool:
+        """Send one request; ctx is handed back to on_reply/on_close.
+        Registration happens before the write so a fast reply can't race
+        the bookkeeping. Returns False if the channel is dead."""
+        with self._lock:
+            if self._dead:
+                return False
+            self._next_id += 1
+            mid = self._next_id
+            self._pending[mid] = ctx
+            if self._lib.fl_send(self._h, mid, payload, len(payload)) != 0:
+                self._pending.pop(mid, None)
+                return False
+        return True
+
+    def submit_batched(self, wire: dict, ctx: Any) -> bool:
+        """Queue one task wire dict; flushes when the batch fills or the
+        peer has nothing in flight (keep it busy / keep latency low).
+        Returns False if the channel is dead (caller takes the rpc path).
+        """
+        with self._lock:
+            if self._dead:
+                return False
+            self._buf.append((wire, ctx))
+            if len(self._buf) >= self.batch_max or not self._pending:
+                return self._flush_locked(current_ctx=ctx)
+        return True
+
+    def flush(self) -> None:
+        """Send any buffered submissions now (called on get()/wait())."""
+        with self._lock:
+            if not self._dead and self._buf:
+                self._flush_locked()
+
+    def _flush_locked(self, current_ctx: Any = None) -> bool:
+        batch = self._buf
+        self._buf = []
+        self._next_id += 1
+        mid = self._next_id
+        ctxs = [c for _w, c in batch]
+        self._pending[mid] = ("__batch__", ctxs)
+        payload = msgpack.packb({"tasks": [w for w, _c in batch]},
+                                use_bin_type=True)
+        if self._lib.fl_send(self._h, mid, payload, len(payload)) != 0:
+            self._pending.pop(mid, None)
+            # The wound channel's pump will fire on_close for _pending
+            # entries; these never made it there, so fail them here —
+            # EXCEPT the submission currently in flight: its caller sees
+            # False and resubmits it itself (handing it to on_close too
+            # would run the task twice).
+            fail = [c for c in ctxs if c is not current_ctx]
+            if fail:
+                try:
+                    self._on_close(fail)
+                except Exception:
+                    logger.exception(
+                        "fastlane on_close (flush) failed (%s)",
+                        self.address)
+            return False
+        return True
+
+    def close(self) -> None:
+        """Wound the connection; the pump thread finishes the teardown."""
+        with self._lock:
+            if not self._dead:
+                self._lib.fl_shutdown(self._h)
+
+    def _pump_loop(self) -> None:
+        lib = self._lib
+        buf = ctypes.c_char_p()
+        n = ctypes.c_int64()
+        while True:
+            timeout = 5 if self._buf else 500
+            mid = lib.fl_wait_any(self._h, timeout, ctypes.byref(buf),
+                                  ctypes.byref(n))
+            if self._buf:  # safety flush for fire-and-forget submitters
+                self.flush()
+            if mid == 0:
+                continue
+            if mid < 0:
+                break
+            payload = _take_buf(lib, buf, n)
+            with self._lock:
+                ctx = self._pending.pop(mid, None)
+            if ctx is None:
+                continue
+            try:
+                reply = msgpack.unpackb(payload, raw=False)
+                if isinstance(ctx, tuple) and len(ctx) == 2 and \
+                        ctx[0] == "__batch__":
+                    replies = reply.get("replies", [])
+                    for i, one_ctx in enumerate(ctx[1]):
+                        one = (replies[i] if i < len(replies) else
+                               {"status": "error",
+                                "error": "batch reply truncated",
+                                "returns": []})
+                        self._on_reply(one_ctx, one)
+                else:
+                    self._on_reply(ctx, reply)
+            except Exception:
+                logger.exception("fastlane reply handler failed (%s)",
+                                 self.address)
+        # Connection lost: fail everything outstanding (in submission
+        # order), then free. on_close always fires so owners can reap
+        # channel state (e.g. return the worker lease) even when idle.
+        with self._lock:
+            self._dead = True
+            pend = []
+            for m in sorted(self._pending):
+                ctx = self._pending[m]
+                if isinstance(ctx, tuple) and len(ctx) == 2 and \
+                        ctx[0] == "__batch__":
+                    pend.extend(ctx[1])
+                else:
+                    pend.append(ctx)
+            pend.extend(c for _w, c in self._buf)
+            self._buf = []
+            self._pending.clear()
+            lib.fl_close(self._h)
+            self._h = None
+        try:
+            self._on_close(pend)
+        except Exception:
+            logger.exception("fastlane on_close handler failed (%s)",
+                             self.address)
