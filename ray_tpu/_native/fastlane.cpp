@@ -473,15 +473,22 @@ void fl_server_close(void* h) {
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
   if (s->acceptor.joinable()) s->acceptor.join();
-  // Give detached conn readers a beat to drain; they hold shared_ptrs so
-  // ServerConn lifetime is safe regardless.
-  for (int i = 0; i < 100; ++i) {
+  // Wait for detached conn readers to drain (they touch s->mu / s->conns
+  // on their way out). If one is still wedged after the grace period,
+  // intentionally LEAK the server instead of freeing memory a reader may
+  // still lock — this only runs at process shutdown.
+  bool drained = false;
+  for (int i = 0; i < 500; ++i) {
     {
       std::lock_guard<std::mutex> lk(s->mu);
-      if (s->conns.empty()) break;
+      if (s->conns.empty()) {
+        drained = true;
+        break;
+      }
     }
     std::this_thread::sleep_for(std::chrono::milliseconds(2));
   }
+  if (!drained) return;
   {
     std::lock_guard<std::mutex> lk(s->mu);
     for (auto& r : s->ready) free(r.frame.data);

@@ -1165,6 +1165,12 @@ class CoreWorker:
         state.leases.remove(lease)
         fk = _FastKey(key, ch, lease)
         self._fast_keys[key] = fk
+        if ch.dead:
+            # Died between connect and install: on_close ran before the
+            # fk existed and couldn't reap it — do it now (returns the
+            # lease, unwedges the key).
+            self._fastlane_key_closed(key, [], ch)
+            return None
         return fk
 
     def _fastlane_on_reply(self, ctx, reply: dict) -> None:
@@ -1245,12 +1251,19 @@ class CoreWorker:
         else:
             fk = None  # not ours to reap; finish()/successor owns cleanup
 
+        graceful = bool(channel is not None and
+                        getattr(channel, "graceful_close", False))
+
         def go():
             if fk is not None:
                 self.loop.create_task(
-                    self._return_lease(fk.lease, disconnect=True))
+                    self._return_lease(fk.lease, disconnect=not graceful))
             for _kind, spec, _extra in pending:
-                if spec.max_retries > 0:
+                if graceful:
+                    # Deactivation raced a straggler submission: the
+                    # worker is fine — resubmit without burning a retry.
+                    self._enqueue_for_lease(spec)
+                elif spec.max_retries > 0:
                     spec.max_retries -= 1
                     self._enqueue_for_lease(spec)
                 else:
@@ -1734,7 +1747,9 @@ class CoreWorker:
                 return
             reqid, payload = item
             try:
-                reply = self._fastlane_handle(payload)
+                reply = self._fastlane_handle(reqid, payload)
+                if reply is None:
+                    continue  # deferred: a loop-path future replies later
                 out = msgpack.packb(reply, use_bin_type=True)
             except Exception as e:
                 logger.exception("fastlane dispatch failed")
@@ -1744,23 +1759,52 @@ class CoreWorker:
                     use_bin_type=True)
             srv.reply(reqid, out)
 
-    def _fastlane_handle(self, payload: bytes) -> dict:
+    def _fastlane_handle(self, reqid: int, payload: bytes) -> Optional[dict]:
         data = msgpack.unpackb(payload, raw=False)
         if "tasks" in data:
             # Batched submission: execute in order (same FIFO contract as
-            # one-frame-per-task), reply once.
-            return {"replies": [self._fastlane_handle_one({"task": w})
+            # one-frame-per-task), reply once. Fallbacks inside a batch
+            # block this dispatcher (order must hold within the batch);
+            # batches come from observed-tiny task keys, so that's rare
+            # and bounded by the batch size.
+            return {"replies": [self._fastlane_handle_one(w)
                                 for w in data["tasks"]]}
-        return self._fastlane_handle_one(data)
-
-    def _fastlane_handle_one(self, data: dict) -> dict:
         spec = TaskSpec.from_wire(data["task"])
         reply = self._try_execute_direct(spec)
+        if reply is not None:
+            return reply
+        # Not direct-eligible (streaming / async / ref args / env /
+        # concurrency>1): run the full loop path and reply from its
+        # completion callback — a minutes-long task must not park this
+        # dispatcher thread and starve other connections. The per-conn
+        # FIFO gate still holds: the native server withholds this
+        # connection's next request until the deferred reply lands.
+        fut = asyncio.run_coroutine_threadsafe(
+            self.handle_push_task(data, None), self.loop)
+        srv = self._fl_server
+
+        def _relay(f, reqid=reqid):
+            try:
+                out = msgpack.packb(f.result(), use_bin_type=True)
+            except Exception as e:
+                out = msgpack.packb(
+                    {"status": "error",
+                     "error": f"{type(e).__name__}: {e}", "returns": []},
+                    use_bin_type=True)
+            try:
+                srv.reply(reqid, out)
+            except Exception:
+                logger.exception("fastlane deferred reply failed")
+
+        fut.add_done_callback(_relay)
+        return None
+
+    def _fastlane_handle_one(self, wire: dict) -> dict:
+        spec = TaskSpec.from_wire(wire)
+        reply = self._try_execute_direct(spec)
         if reply is None:
-            # Not direct-eligible (streaming / async / ref args / env /
-            # concurrency>1): run the full loop path and relay its reply.
             fut = asyncio.run_coroutine_threadsafe(
-                self.handle_push_task(data, None), self.loop)
+                self.handle_push_task({"task": wire}, None), self.loop)
             reply = fut.result()
         return reply
 
@@ -1832,7 +1876,12 @@ class CoreWorker:
                         self._current_task = prev
             except Exception as e:
                 return self._store_exception_sync(spec, e)
-            reply = self._store_returns_sync(spec, result)
+            try:
+                reply = self._store_returns_sync(spec, result)
+            except Exception as e:
+                # Unpicklable return / arity mismatch must fail THIS task
+                # only — escaping here would poison the whole batch.
+                return self._store_exception_sync(spec, e)
             reply["exec_s"] = time.monotonic() - t0
             return reply
         finally:

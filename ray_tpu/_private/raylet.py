@@ -23,8 +23,11 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import signal
+import struct
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -56,6 +59,28 @@ class WorkerHandle:
         self.log_partial: bytes = b""
         self.tpu = False  # spawned with the TPU plugin env
         self.kill_requested = False  # kill arrived before spawn landed
+        self.forked = False  # forkserver child (tracked by pid, not proc)
+
+    def alive(self) -> bool:
+        if self.proc is not None:
+            return self.proc.poll() is None
+        if self.forked and self.pid:
+            try:
+                os.kill(self.pid, 0)
+                return True
+            except OSError:
+                return False
+        return True  # spawn still in flight / driver: liveness via conn
+
+    def terminate(self) -> None:
+        if self.proc is not None:
+            if self.proc.poll() is None:
+                self.proc.terminate()
+        elif self.forked and self.pid:
+            try:
+                os.kill(self.pid, signal.SIGTERM)
+            except OSError:
+                pass
 
 
 class LeaseRequest:
@@ -102,6 +127,11 @@ class Raylet:
         self._spawn_tasks: Set[asyncio.Task] = set()
         self.address = ""
         self.dead = False
+        # Forkserver (zygote) worker factory: one warm template process;
+        # CPU workers fork from it in ~10ms instead of a fresh
+        # interpreter + import chain (reference: worker_pool.h:359,:425).
+        self._forkserver: Optional[subprocess.Popen] = None
+        self._fork_lock = threading.Lock()  # serializes the pipe protocol
 
     # ------------------------------------------------------------- lifecycle
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
@@ -145,6 +175,7 @@ class Raylet:
                 self._memory_monitor_loop()))
         logger.info("raylet %s on %s resources=%s",
                     self.node_id.hex()[:8], self.address, self.resources_total)
+        self._maybe_refill_pool()  # prestart the standing worker pool
         return port
 
     async def close(self) -> None:
@@ -157,8 +188,9 @@ class Raylet:
             await asyncio.gather(*list(self._spawn_tasks),
                                  return_exceptions=True)
         for w in self.workers.values():
-            if w.proc and w.proc.poll() is None:
-                w.proc.terminate()
+            w.terminate()
+        if self._forkserver is not None and self._forkserver.poll() is None:
+            self._forkserver.terminate()
         if getattr(self, "transfer_server", None) is not None:
             await asyncio.get_event_loop().run_in_executor(
                 None, self.transfer_server.stop)
@@ -365,11 +397,13 @@ class Raylet:
         while not self.dead:
             await asyncio.sleep(0.2)
             for w in list(self.workers.values()):
-                if w.proc is not None and w.proc.poll() is not None and \
-                        w.state != "dead":
+                if (w.proc is not None or w.forked) and \
+                        w.state != "dead" and not w.alive():
                     await self._on_worker_death(w)
 
     async def _on_worker_death(self, w: WorkerHandle) -> None:
+        if w.state == "dead":
+            return  # reap loop and conn-close can both observe the death
         prev_state = w.state
         w.state = "dead"
         self.workers.pop(w.worker_id, None)
@@ -396,34 +430,84 @@ class Raylet:
         # flag and terminates immediately — otherwise the orphan process
         # (and its lease/resources) would leak.
         w.kill_requested = True
-        if w.proc and w.proc.poll() is None:
-            w.proc.terminate()
+        w.terminate()
 
     # ------------------------------------------------------------- worker pool
-    def _spawn_worker(self, tpu: bool = False) -> WorkerHandle:
-        worker_id = WorkerID.from_random()
-        env = dict(os.environ)
+    def _worker_env(self, worker_id: WorkerID, tpu: bool) -> dict:
+        """Per-worker environment variables (on top of the raylet's)."""
         import ray_tpu
 
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.abspath(ray_tpu.__file__)))
-        env["PYTHONPATH"] = pkg_root + (
-            ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
-        # Restore the TPU plugin hook ONLY for workers leased to
-        # TPU-requesting work: the plugin's sitecustomize imports jax at
-        # interpreter start (~2s) — paying that for every plain CPU
-        # worker serializes large actor/task storms.
-        pool_ips = env.get("RAY_TPU_AXON_POOL_IPS")
-        if tpu and pool_ips and self.resources_total.get("TPU", 0) > 0:
-            env["PALLAS_AXON_POOL_IPS"] = pool_ips
-        env.update({
+        env = {
+            "PYTHONPATH": pkg_root + (
+                ":" + os.environ["PYTHONPATH"]
+                if os.environ.get("PYTHONPATH") else ""),
             "RAY_TPU_WORKER_ID": worker_id.hex(),
             "RAY_TPU_RAYLET_ADDRESS": self.address,
             "RAY_TPU_GCS_ADDRESS": self.gcs_address,
             "RAY_TPU_NODE_ID": self.node_id.hex(),
             "RAY_TPU_STORE_PATH": self.store_path,
             "RAY_TPU_SESSION_DIR": self.session_dir,
-        })
+        }
+        # Restore the TPU plugin hook ONLY for workers leased to
+        # TPU-requesting work: the plugin's sitecustomize imports jax at
+        # interpreter start (~2s) — paying that for every plain CPU
+        # worker serializes large actor/task storms.
+        pool_ips = os.environ.get("RAY_TPU_AXON_POOL_IPS")
+        if tpu and pool_ips and self.resources_total.get("TPU", 0) > 0:
+            env["PALLAS_AXON_POOL_IPS"] = pool_ips
+        return env
+
+    def _ensure_forkserver(self) -> subprocess.Popen:
+        """Start (or restart) the warm template process. Caller holds
+        _fork_lock. Runs on an executor thread, never the loop."""
+        fs = self._forkserver
+        if fs is not None and fs.poll() is None:
+            return fs
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # template must not load jax
+        env["PYTHONPATH"] = pkg_root + (
+            ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        log_path = os.path.join(self.session_dir, "logs", "forkserver.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        logf = open(log_path, "ab")
+        fs = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.forkserver"],
+            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=logf, start_new_session=True)
+        logf.close()
+        self._forkserver = fs
+        return fs
+
+    def _fork_worker(self, extra_env: dict, log_path: str) -> int:
+        """Ask the template to fork a worker; returns the child pid.
+        Caller is on an executor thread (blocking pipe I/O)."""
+        import msgpack
+
+        header = struct.Struct("<I")
+        with self._fork_lock:
+            fs = self._ensure_forkserver()
+            req = msgpack.packb({"env": extra_env, "log_path": log_path},
+                                use_bin_type=True)
+            fs.stdin.write(header.pack(len(req)) + req)
+            fs.stdin.flush()
+            raw = fs.stdout.read(header.size)
+            if len(raw) < header.size:
+                raise RuntimeError("forkserver died mid-request")
+            (length,) = header.unpack(raw)
+            reply = msgpack.unpackb(fs.stdout.read(length), raw=False)
+        if "pid" not in reply:
+            raise RuntimeError(f"forkserver spawn failed: {reply}")
+        return reply["pid"]
+
+    def _spawn_worker(self, tpu: bool = False) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        extra_env = self._worker_env(worker_id, tpu)
         log_path = os.path.join(self.session_dir, "logs",
                                 f"worker-{worker_id.hex()[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
@@ -431,11 +515,20 @@ class Raylet:
         w.tpu = tpu
         w.log_path = log_path
         self.workers[worker_id] = w
+        # TPU workers need the jax plugin imported at interpreter start
+        # (sitecustomize), which a fork from the plugin-free template
+        # can't provide — they keep the fresh-interpreter path.
+        use_fork = self.config.forkserver_enabled and not (
+            tpu and os.environ.get("RAY_TPU_AXON_POOL_IPS") and
+            self.resources_total.get("TPU", 0) > 0)
 
-        # fork/exec OFF the io loop: a spawn storm (hundreds of actors
-        # created at once) must not stall heartbeats — a blocked loop
-        # gets the whole node declared dead by the GCS health checker.
+        # All spawn work OFF the io loop: a spawn storm (hundreds of
+        # actors created at once) must not stall heartbeats — a blocked
+        # loop gets the whole node declared dead by the GCS health
+        # checker.
         def popen():
+            env = dict(os.environ)
+            env.update(extra_env)
             with open(log_path, "ab") as logf:
                 return subprocess.Popen(
                     [sys.executable, "-m", "ray_tpu._private.worker_main"],
@@ -443,20 +536,30 @@ class Raylet:
                     start_new_session=True)
 
         async def finish_spawn():
-            try:
-                proc = await asyncio.get_running_loop().run_in_executor(
-                    None, popen)
-            except Exception:
-                logger.exception("worker spawn failed")
-                # Full death path: releases the lease/resources this
-                # worker may already hold (actor leases are taken before
-                # spawn) and reports actor death to the GCS.
-                await self._on_worker_death(w)
-                return
+            loop = asyncio.get_running_loop()
+            pid = proc = None
+            if use_fork:
+                try:
+                    pid = await loop.run_in_executor(
+                        None, self._fork_worker, extra_env, log_path)
+                except Exception:
+                    logger.exception(
+                        "forkserver spawn failed; falling back to popen")
+            if pid is None:
+                try:
+                    proc = await loop.run_in_executor(None, popen)
+                except Exception:
+                    logger.exception("worker spawn failed")
+                    # Full death path: releases the lease/resources this
+                    # worker may already hold (actor leases are taken
+                    # before spawn) and reports actor death to the GCS.
+                    await self._on_worker_death(w)
+                    return
             w.proc = proc
-            w.pid = proc.pid
-            if (self.dead or w.kill_requested) and proc.poll() is None:
-                proc.terminate()  # shut down / killed mid-spawn
+            w.pid = pid if pid is not None else proc.pid
+            w.forked = proc is None
+            if (self.dead or w.kill_requested) and w.alive():
+                w.terminate()  # shut down / killed mid-spawn
 
         task = asyncio.get_event_loop().create_task(finish_spawn())
         self._spawn_tasks.add(task)
@@ -529,10 +632,16 @@ class Raylet:
         return {"node_id": self.node_id.binary(), "ok": True}
 
     def _on_conn_close(self, w: WorkerHandle) -> None:
-        # Driver/external registrations (never pool workers — those may
-        # transiently have proc=None while their async spawn completes).
-        if w.proc is None and w.state == "driver":
+        if w.state == "driver":
             self.workers.pop(w.worker_id, None)
+            return
+        # Registered workers die with their raylet connection (the worker
+        # side exits on conn loss; the reverse direction is detected
+        # here). This is the pid-independent death signal for forked
+        # workers — the _reap_loop's os.kill(pid, 0) probe alone has a
+        # one-tick PID-reuse window (forkserver children are auto-reaped).
+        if not self.dead and w.state != "dead" and w.registered.is_set():
+            asyncio.get_event_loop().create_task(self._on_worker_death(w))
 
     def _pool_capacity(self) -> int:
         soft = self.config.num_workers_soft_limit
@@ -652,14 +761,28 @@ class Raylet:
                 self.lease_queue.remove(req)
                 req.grant_fut.set_result({"spillback": target})
 
+    def _maybe_refill_pool(self) -> None:
+        """Keep a standing pool of registered idle workers (reference:
+        WorkerPool::PrestartWorkers): actor storms and task bursts then
+        consume warm workers instead of paying process bring-up inline.
+        Actor-bound workers leave the pool permanently, so the refill is
+        what keeps storms fast beyond the first wave."""
+        if not self.config.prestart_workers or self.dead:
+            return
+        min_idle = self._pool_capacity()
+        n_idle = sum(1 for w in self.idle_workers if w.state == "idle")
+        n_starting = sum(1 for w in self.workers.values()
+                         if w.state == "starting")
+        for _ in range(max(0, min_idle - n_idle - n_starting)):
+            self._spawn_worker()
+
     def _take_idle_worker(self, tpu: bool = False
                           ) -> Optional[WorkerHandle]:
         keep: List[WorkerHandle] = []
         found = fallback = None
         while self.idle_workers:
             w = self.idle_workers.pop()
-            if w.state != "idle" or (w.proc is not None and
-                                     w.proc.poll() is not None):
+            if w.state != "idle" or not w.alive():
                 continue  # dead/stale entry
             if w.tpu == tpu:
                 found = w
@@ -719,7 +842,7 @@ class Raylet:
         worker, res, bundle_key = entry
         self._release_resources(res, bundle_key)
         if data.get("disconnect") or worker.state == "dead":
-            if worker.proc:
+            if worker.proc or worker.forked:
                 await self._kill_worker(worker, "returned with disconnect")
         elif worker.state == "leased":
             worker.state = "idle"
@@ -752,7 +875,15 @@ class Raylet:
         else:
             for k, v in spec.resources.items():
                 self.available[k] = self.available.get(k, 0) - v
-        w = self._spawn_worker(tpu=spec.resources.get("TPU", 0) > 0)
+        # Idle-worker reuse (reference: WorkerPool hands pooled workers to
+        # actor leases): an already-registered pool worker skips process
+        # startup entirely — the dominant cost of actor-creation storms.
+        needs_tpu = spec.resources.get("TPU", 0) > 0
+        w = self._take_idle_worker(tpu=needs_tpu)
+        if w is None:
+            w = self._spawn_worker(tpu=needs_tpu)
+        else:
+            self._maybe_refill_pool()  # replace the consumed pool worker
         w.state = "actor"
         w.actor_id = data["actor_id"]
         w.job_id = spec.job_id.binary()

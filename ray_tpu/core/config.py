@@ -68,6 +68,11 @@ class Config:
     worker_startup_timeout_s: float = 60.0
     prestart_workers: bool = True
     worker_register_timeout_s: float = 30.0
+    # Zygote worker factory (reference: worker_pool.h PrestartWorkers /
+    # StartWorkerProcess): fork CPU workers from a warm pre-imported
+    # template (~10ms) instead of a fresh interpreter (~0.25s, >1s under
+    # spawn storms). TPU-flavored workers always use fresh interpreters.
+    forkserver_enabled: bool = True
     # --- task retries / lineage ---
     task_max_retries: int = 3
     actor_max_restarts: int = 0

@@ -151,6 +151,7 @@ class FastChannel:
         # order — no separate order list to maintain per reply.
         self._pending: Dict[int, Any] = {}
         self._dead = False
+        self.graceful_close = False  # owner-initiated (deactivation)
         # Adaptive batching (normal-task channels): wire dicts accumulate
         # while the executor is busy and flush as one frame — when the
         # executor is idle they flush immediately for latency. The pump
@@ -230,9 +231,13 @@ class FastChannel:
         return True
 
     def close(self) -> None:
-        """Wound the connection; the pump thread finishes the teardown."""
+        """Wound the connection; the pump thread finishes the teardown.
+        Marks the close as owner-initiated so stragglers caught in the
+        window are resubmitted without burning a retry (the worker did
+        not die)."""
         with self._lock:
             if not self._dead:
+                self.graceful_close = True
                 self._lib.fl_shutdown(self._h)
 
     def _pump_loop(self) -> None:
