@@ -1,8 +1,16 @@
 """Headline benchmark: Llama train-step MFU on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-North star (BASELINE.json): >=40% MFU — vs_baseline = MFU / 40%.
+Prints ONE JSON line (last line): the flagship 551M-param config's MFU —
+comparable across rounds — with the second, largest-fits-one-chip config
+(1.55B params, bf16 params/optimizer state, remat) embedded as
+``large_*`` fields, plus trial spread so load contamination is visible.
 
+Hardening (round-3 verdict: a single capture swung 2x under co-tenant
+load): the bench quiesces on machine load before timing, runs 5 timed
+trials per config, and reports the MEDIAN (two full runs agreed to
+0.004% on a shared chip with ~50% per-trial spread).
+
+North star (BASELINE.json): >=40% MFU — vs_baseline = MFU / 40%.
 The reference publishes no training-throughput numbers (BASELINE.md), so
 this benchmark IS the baseline being established. Model sizing targets a
 single 16 GiB v5e chip; scale-out numbers come from the multi-host train
@@ -11,6 +19,7 @@ library, not this script.
 
 import json
 import os
+import statistics
 import time
 
 
@@ -21,6 +30,8 @@ PEAK_BF16_FLOPS = {
     "v5p": 459e12,
     "v6e": 918e12,
 }
+
+TRIALS = 5
 
 
 def _detect_peak() -> float:
@@ -42,31 +53,34 @@ def _detect_peak() -> float:
     return PEAK_BF16_FLOPS["v5e"]
 
 
-def main():
+def _quiesce(max_wait_s: float = 90.0, threshold: float = 1.5) -> float:
+    """Wait (bounded) for ambient host load to settle before timing: the
+    host CPU feeds the TPU, and co-tenant load halves measured MFU
+    (round-3 verdict). Returns the load at timing start."""
+    deadline = time.monotonic() + max_wait_s
+    load = 0.0
+    while time.monotonic() < deadline:
+        try:
+            load = os.getloadavg()[0]
+        except OSError:
+            return 0.0
+        if load < threshold:
+            return load
+        time.sleep(5.0)
+    return load
+
+
+def _bench_config(cfg, batch_size: int, seq_len: int, steps: int,
+                  trials: int, devices, peak: float) -> dict:
     import jax
-    import jax.numpy as jnp
     import optax
 
-    from ray_tpu.models import (LlamaConfig, llama_init, llama_loss,
-                                llama_param_specs)
-    from ray_tpu.models.training import make_sharded_train_step
+    from ray_tpu.models import llama_init, llama_loss, llama_param_specs
     from ray_tpu.models.llama import llama_flops_per_token
+    from ray_tpu.models.training import make_sharded_train_step
     from ray_tpu.parallel import create_mesh
 
-    on_tpu = jax.default_backend() == "tpu"
-    if on_tpu:
-        cfg = LlamaConfig(
-            vocab_size=32000, dim=1536, n_layers=16, n_heads=12,
-            n_kv_heads=12, ffn_dim=4096, max_seq_len=2048,
-            remat=True, attn_impl="flash")
-        batch_size, seq_len, steps = 8, 2048, 20
-    else:  # smoke mode off-TPU
-        cfg = LlamaConfig.nano()
-        batch_size, seq_len, steps = 4, 128, 3
-
-    devices = jax.devices()[:1] if on_tpu else jax.devices()
     mesh = create_mesh({"dp": len(devices)}, devices)
-
     params = llama_init(jax.random.PRNGKey(0), cfg)
     init_fn, step_fn = make_sharded_train_step(
         lambda p, b: llama_loss(p, b, cfg),
@@ -82,16 +96,14 @@ def main():
     params, opt_state, metrics = step_fn(params, opt_state, batch)
     loss_before = float(metrics["loss"])
 
-    # Two timed trials, best-of: the chip may be shared (tunnel pool) and
-    # a single window under-measures steady-state throughput.
-    best_dt = float("inf")
-    for _ in range(2 if on_tpu else 1):
+    rates = []
+    for _ in range(trials):
         t0 = time.perf_counter()
         for _ in range(steps):
             params, opt_state, metrics = step_fn(params, opt_state, batch)
         jax.block_until_ready(metrics["loss"])
-        best_dt = min(best_dt, time.perf_counter() - t0)
-    dt = best_dt
+        rates.append(batch_size * seq_len * steps /
+                     (time.perf_counter() - t0))
     # Execution sanity: training on a fixed batch must move the loss; a
     # degraded remote-execution path that no-ops steps would otherwise
     # report absurd throughput.
@@ -101,23 +113,73 @@ def main():
             "benchmark steps did not execute (loss unchanged) — "
             "remote TPU path degraded; rerun")
 
-    tokens_per_step = batch_size * seq_len
-    tokens_per_sec = tokens_per_step * steps / dt
+    tokens_per_sec = statistics.median(rates)
+    spread = ((max(rates) - min(rates)) / max(rates) * 100.0
+              if max(rates) else 0.0)
     flops_per_token = llama_flops_per_token(cfg, seq_len)
-    achieved = tokens_per_sec * flops_per_token / len(devices)
-    peak = _detect_peak()
-    mfu = achieved / peak * 100.0
-
-    print(json.dumps({
-        "metric": "llama_train_mfu_1chip",
-        "value": round(mfu, 2),
-        "unit": "%MFU",
-        "vs_baseline": round(mfu / 40.0, 4),
+    mfu = (tokens_per_sec * flops_per_token / len(devices)) / peak * 100.0
+    return {
+        "mfu": round(mfu, 2),
         "tokens_per_sec_per_chip": round(tokens_per_sec / len(devices)),
         "model_params": cfg.num_params(),
+        "trial_spread_pct": round(spread, 2),
+        "loss": loss_after,
+    }
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import LlamaConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    peak = _detect_peak()
+    load = _quiesce() if on_tpu else 0.0
+
+    if on_tpu:
+        devices = jax.devices()[:1]
+        flagship = LlamaConfig(
+            vocab_size=32000, dim=1536, n_layers=16, n_heads=12,
+            n_kv_heads=12, ffn_dim=4096, max_seq_len=2048,
+            remat=True, attn_impl="flash")
+        base = _bench_config(flagship, batch_size=8, seq_len=2048,
+                             steps=20, trials=TRIALS, devices=devices,
+                             peak=peak)
+        # Largest config that fits one 16 GiB chip (AOT-verified:
+        # 15.37 GiB with bf16 params + optimizer state, full remat —
+        # f32 AdamW for 1.55B needs 27 GiB and cannot fit).
+        large_cfg = LlamaConfig(
+            vocab_size=32000, dim=2048, n_layers=28, n_heads=16,
+            n_kv_heads=16, ffn_dim=5504, max_seq_len=2048,
+            remat=True, attn_impl="flash", param_dtype=jnp.bfloat16)
+        try:
+            large = _bench_config(large_cfg, batch_size=4, seq_len=2048,
+                                  steps=10, trials=TRIALS,
+                                  devices=devices, peak=peak)
+        except Exception as e:  # OOM headroom is ~0.4 GiB: degrade, don't die
+            large = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    else:  # smoke mode off-TPU
+        devices = jax.devices()
+        base = _bench_config(LlamaConfig.nano(), batch_size=4, seq_len=128,
+                             steps=3, trials=1, devices=devices, peak=peak)
+        large = {"skipped": "no TPU"}
+
+    out = {
+        "metric": "llama_train_mfu_1chip",
+        "value": base["mfu"],
+        "unit": "%MFU",
+        "vs_baseline": round(base["mfu"] / 40.0, 4),
+        "tokens_per_sec_per_chip": base["tokens_per_sec_per_chip"],
+        "model_params": base["model_params"],
+        "trial_spread_pct": base["trial_spread_pct"],
+        "host_load_at_start": round(load, 2),
         "backend": jax.default_backend(),
-        "loss": float(metrics["loss"]),
-    }))
+        "loss": base["loss"],
+    }
+    for k, v in large.items():
+        out[f"large_{k}"] = v
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

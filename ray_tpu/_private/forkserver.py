@@ -87,10 +87,22 @@ def main() -> None:
             os.write(out_fd, _LEN.pack(len(reply)) + reply)
             continue
         if pid == 0:
+            code = 0
             try:
                 _child_main(req)
+            except BaseException:
+                # Surface startup failures in the worker log (stderr is
+                # the log file once dup2 ran; the template's log before).
+                code = 1
+                try:
+                    import traceback
+
+                    traceback.print_exc()
+                    sys.stderr.flush()
+                except Exception:
+                    pass
             finally:
-                os._exit(0)
+                os._exit(code)
         reply = msgpack.packb({"pid": pid}, use_bin_type=True)
         os.write(out_fd, _LEN.pack(len(reply)) + reply)
 

@@ -433,16 +433,20 @@ class Raylet:
         w.terminate()
 
     # ------------------------------------------------------------- worker pool
-    def _worker_env(self, worker_id: WorkerID, tpu: bool) -> dict:
-        """Per-worker environment variables (on top of the raylet's)."""
+    @staticmethod
+    def _pkg_pythonpath() -> str:
+        """PYTHONPATH that puts this ray_tpu checkout first."""
         import ray_tpu
 
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.abspath(ray_tpu.__file__)))
+        existing = os.environ.get("PYTHONPATH")
+        return pkg_root + (":" + existing if existing else "")
+
+    def _worker_env(self, worker_id: WorkerID, tpu: bool) -> dict:
+        """Per-worker environment variables (on top of the raylet's)."""
         env = {
-            "PYTHONPATH": pkg_root + (
-                ":" + os.environ["PYTHONPATH"]
-                if os.environ.get("PYTHONPATH") else ""),
+            "PYTHONPATH": self._pkg_pythonpath(),
             "RAY_TPU_WORKER_ID": worker_id.hex(),
             "RAY_TPU_RAYLET_ADDRESS": self.address,
             "RAY_TPU_GCS_ADDRESS": self.gcs_address,
@@ -465,14 +469,9 @@ class Raylet:
         fs = self._forkserver
         if fs is not None and fs.poll() is None:
             return fs
-        import ray_tpu
-
-        pkg_root = os.path.dirname(os.path.dirname(
-            os.path.abspath(ray_tpu.__file__)))
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)  # template must not load jax
-        env["PYTHONPATH"] = pkg_root + (
-            ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env["PYTHONPATH"] = self._pkg_pythonpath()
         log_path = os.path.join(self.session_dir, "logs", "forkserver.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
         logf = open(log_path, "ab")
@@ -486,21 +485,39 @@ class Raylet:
 
     def _fork_worker(self, extra_env: dict, log_path: str) -> int:
         """Ask the template to fork a worker; returns the child pid.
-        Caller is on an executor thread (blocking pipe I/O)."""
+        Caller is on an executor thread (blocking pipe I/O). Reads are
+        select-bounded: a wedged template must fail THIS spawn (and get
+        replaced) rather than deadlock every future spawn on the lock."""
+        import select
+
         import msgpack
 
         header = struct.Struct("<I")
+
+        def read_bounded(n: int) -> bytes:
+            out = b""
+            deadline = time.monotonic() + 20.0
+            fd = fs.stdout.fileno()
+            while len(out) < n:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not select.select(
+                        [fd], [], [], remaining)[0]:
+                    fs.kill()  # wedged: replace on next _ensure
+                    raise RuntimeError("forkserver timed out; killed")
+                chunk = os.read(fd, n - len(out))
+                if not chunk:
+                    raise RuntimeError("forkserver died mid-request")
+                out += chunk
+            return out
+
         with self._fork_lock:
             fs = self._ensure_forkserver()
             req = msgpack.packb({"env": extra_env, "log_path": log_path},
                                 use_bin_type=True)
             fs.stdin.write(header.pack(len(req)) + req)
             fs.stdin.flush()
-            raw = fs.stdout.read(header.size)
-            if len(raw) < header.size:
-                raise RuntimeError("forkserver died mid-request")
-            (length,) = header.unpack(raw)
-            reply = msgpack.unpackb(fs.stdout.read(length), raw=False)
+            (length,) = header.unpack(read_bounded(header.size))
+            reply = msgpack.unpackb(read_bounded(length), raw=False)
         if "pid" not in reply:
             raise RuntimeError(f"forkserver spawn failed: {reply}")
         return reply["pid"]

@@ -103,7 +103,12 @@ class FastlaneServer:
         return CLOSED if rid < 0 else None
 
     def reply(self, reqid: int, payload: bytes) -> None:
-        self._lib.fl_server_reply(self._h, reqid, payload, len(payload))
+        # Deferred replies (loop-path fallbacks) can land after close();
+        # the lock + handle check keep them off a freed native server.
+        with self._lock:
+            if self._h:
+                self._lib.fl_server_reply(self._h, reqid, payload,
+                                          len(payload))
 
     def shutdown(self) -> None:
         """Wake all dispatchers (they observe CLOSED); handle stays valid."""

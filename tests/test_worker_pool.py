@@ -24,7 +24,8 @@ def test_actor_reuses_pool_worker():
     """An actor created while registered idle workers exist must take one
     (same pid as a prior task worker) — no fresh process. Prestart is off
     so the idle pool contains exactly the task-worn workers."""
-    ray_tpu.init(num_cpus=4, ignore_reinit_error=True,
+    ray_tpu.shutdown()  # a reused cluster would silently keep prestart ON
+    ray_tpu.init(num_cpus=4,
                  system_config={"prestart_workers": False})
 
     @ray_tpu.remote
