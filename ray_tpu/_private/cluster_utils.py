@@ -85,6 +85,62 @@ class Cluster:
         if not asyncio.run(poll()):
             raise TimeoutError(f"cluster did not reach {n} alive nodes")
 
+    def wait_for_view_converged(self, timeout: float = 15.0) -> None:
+        """Block until every raylet's cluster resource view matches the
+        GCS node table (all nodes visible, availability in agreement).
+        Deterministic replacement for sleep/retry in spillback tests:
+        scheduling decisions made after this see a converged view."""
+        import asyncio
+
+        from ray_tpu.core import rpc
+
+        async def poll():
+            ghost, gport = self.address.rsplit(":", 1)
+            gconn = await rpc.connect(ghost, int(gport))
+            rconns: dict = {}  # address -> conn, reused across poll rounds
+            deadline = time.monotonic() + timeout
+            try:
+                while time.monotonic() < deadline:
+                    nodes = await gconn.call("get_nodes")
+                    alive = {n["node_id"]: n for n in nodes
+                             if n["state"] == "ALIVE"}
+                    ok = True
+                    for n in alive.values():
+                        try:
+                            rconn = rconns.get(n["address"])
+                            if rconn is None or rconn.closed:
+                                host, port = n["address"].rsplit(":", 1)
+                                rconn = rconns[n["address"]] = \
+                                    await rpc.connect(host, int(port),
+                                                      timeout=2.0)
+                            view = await rconn.call("get_cluster_view")
+                        except Exception:
+                            ok = False
+                            break
+                        seen = {v["node_id"]: v for v in view}
+                        for nid, expect in alive.items():
+                            got = seen.get(nid)
+                            if got is None or got["resources_available"] \
+                                    != expect["resources_available"]:
+                                ok = False
+                                break
+                        if not ok:
+                            break
+                    if ok:
+                        return True
+                    await asyncio.sleep(0.05)
+                return False
+            finally:
+                for rconn in rconns.values():
+                    try:
+                        await rconn.close()
+                    except Exception:
+                        pass
+                await gconn.close()
+
+        if not asyncio.run(poll()):
+            raise TimeoutError("raylet resource views did not converge")
+
     def shutdown(self) -> None:
         for node in self.nodes:
             node.shutdown()

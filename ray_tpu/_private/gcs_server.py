@@ -399,8 +399,12 @@ class GcsServer:
         if info is None or info.state == DEAD:
             return {"ok": False}  # tells a zombie raylet to exit
         info.last_heartbeat = time.monotonic()
-        info.resources_available = data.get(
-            "resources_available", info.resources_available)
+        fresh = data.get("resources_available", info.resources_available)
+        if fresh != info.resources_available:
+            info.resources_available = fresh
+            ev = getattr(self, "_view_event", None)
+            if ev is not None:
+                ev.set()  # push the change to raylet views now
         info.pending_demands = data.get("pending_demands", [])
         return {"ok": True}
 
@@ -425,14 +429,24 @@ class GcsServer:
     async def _broadcast_view_loop(self) -> None:
         """Broadcast the cluster resource view for raylet spillback decisions
         (reference: RaySyncer resource-usage gossip,
-        src/ray/common/ray_syncer/ray_syncer.h:88). Faster cadence than
-        health checks so scheduling sees fresh availability."""
+        src/ray/common/ray_syncer/ray_syncer.h:88). Event-driven: a
+        heartbeat that CHANGES a node's availability triggers an
+        immediate (debounced) broadcast, so spillback views are fresh
+        within milliseconds of resource changes; the interval is only the
+        idle fallback (injectable via resource_broadcast_interval_ms for
+        deterministic tests)."""
+        self._view_event = asyncio.Event()
+        interval = max(self.config.resource_broadcast_interval_ms, 10) / 1000
         while True:
-            await asyncio.sleep(
-                min(self.config.health_check_period_ms, 200) / 1000)
+            self._view_event.clear()
             await self.publish("cluster_view", [
                 n.view() for n in self.nodes.values() if n.state == ALIVE
             ])
+            try:
+                await asyncio.wait_for(self._view_event.wait(), interval)
+                await asyncio.sleep(0.005)  # debounce: coalesce a burst
+            except asyncio.TimeoutError:
+                pass
 
     async def _fail_node(self, node_id: NodeID, reason: str) -> None:
         node = self.nodes.get(node_id)

@@ -272,8 +272,23 @@ class Raylet:
             if w.job_id == job_id and w.state == "leased":
                 await self._kill_worker(w, "job finished")
 
+    def _notify_resources_changed(self) -> None:
+        """Event-driven resource sync (reference: RaySyncer,
+        ray_syncer.h:88 — resource deltas push immediately instead of
+        waiting out the periodic report): wakes the heartbeat loop so
+        other raylets' spillback views refresh within milliseconds of a
+        grant/release rather than a full period later."""
+        ev = getattr(self, "_hb_event", None)
+        if ev is not None:
+            ev.set()
+
     async def _heartbeat_loop(self) -> None:
+        self._hb_event = asyncio.Event()
         while not self.dead:
+            # Clear BEFORE reading self.available: a change landing while
+            # the call is in flight re-arms the event and triggers an
+            # immediate follow-up heartbeat.
+            self._hb_event.clear()
             try:
                 r = await self.gcs.call("heartbeat", {
                     "node_id": self.node_id.binary(),
@@ -290,8 +305,13 @@ class Raylet:
             except Exception:
                 if self.dead:
                     return
-            await asyncio.sleep(
-                min(self.config.health_check_period_ms / 2, 100) / 1000)
+            await asyncio.sleep(0.01)  # min gap: bounds event-driven rate
+            try:
+                await asyncio.wait_for(
+                    self._hb_event.wait(),
+                    min(self.config.health_check_period_ms / 2, 100) / 1000)
+            except asyncio.TimeoutError:
+                pass
 
     async def _reporter_loop(self) -> None:
         """Per-node hardware reporter (reference:
@@ -667,6 +687,11 @@ class Raylet:
         return soft
 
     # ------------------------------------------------------------- leases
+    async def handle_get_cluster_view(self, data, conn) -> list:
+        """Debug/testing: this raylet's current gossip view (what its
+        spillback decisions are based on)."""
+        return self.cluster_view
+
     async def handle_request_worker_lease(self, data, conn) -> dict:
         req = LeaseRequest(data)
         if not self._feasible_ever(req):
@@ -833,6 +858,7 @@ class Raylet:
         worker.job_id = req.job_id
         worker.lease_started = time.monotonic()
         self.leases[req.lease_id] = (worker, dict(req.resources), bundle_key)
+        self._notify_resources_changed()
         req.grant_fut.set_result({
             "granted": True,
             "worker_address": worker.address,
@@ -850,6 +876,7 @@ class Raylet:
         else:
             for k, v in res.items():
                 self.available[k] = self.available.get(k, 0) + v
+        self._notify_resources_changed()
 
     async def handle_return_worker(self, data, conn) -> bool:
         lease_id = data["lease_id"]
@@ -896,6 +923,7 @@ class Raylet:
         # actor leases): an already-registered pool worker skips process
         # startup entirely — the dominant cost of actor-creation storms.
         needs_tpu = spec.resources.get("TPU", 0) > 0
+        self._notify_resources_changed()
         w = self._take_idle_worker(tpu=needs_tpu)
         if w is None:
             w = self._spawn_worker(tpu=needs_tpu)

@@ -56,6 +56,10 @@ class Config:
     gcs_down_exit_s: float = 60.0
     max_pending_lease_requests: int = 8
     worker_lease_timeout_s: float = 30.0
+    # Idle fallback cadence of the GCS cluster-view broadcast; resource
+    # CHANGES push immediately (RaySyncer-style event-driven sync).
+    # Injectable so distributed tests can pin deterministic freshness.
+    resource_broadcast_interval_ms: int = 200
     # --- health / failure detection ---
     health_check_period_ms: int = 1000
     # Generous threshold (10s): worker-spawn storms (hundreds of actors)

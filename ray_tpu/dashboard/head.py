@@ -15,6 +15,11 @@ Endpoints:
   GET /api/tasks/summary    -> task state counts
   GET /api/node_stats       -> per-node hardware gauges (reporter loop)
   GET /api/timeline         -> chrome trace JSON
+  GET /api/tasks            -> per-task latest-state rows
+  GET /api/placement_groups -> placement group table
+  GET /api/objects          -> object location table
+  GET /api/logs             -> session log file listing
+  GET /api/logs/tail?file=X&lines=N -> tail one log file
 """
 
 from __future__ import annotations
@@ -22,6 +27,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -173,6 +179,75 @@ class DashboardHead:
 
             return web.json_response(await offload(timeline),
                                      dumps=_dumps)
+
+        @routes.get("/api/tasks")
+        async def tasks_route(request):
+            from ray_tpu.util import state
+
+            return web.json_response(
+                await offload(state.list_tasks), dumps=_dumps)
+
+        @routes.get("/api/placement_groups")
+        async def pgs_route(request):
+            from ray_tpu.util import state
+
+            return web.json_response(
+                await offload(state.list_placement_groups), dumps=_dumps)
+
+        @routes.get("/api/objects")
+        async def objects_route(request):
+            from ray_tpu.util import state
+
+            return web.json_response(
+                await offload(state.list_objects), dumps=_dumps)
+
+        def _log_dir() -> str:
+            from ray_tpu._private.worker import global_worker
+
+            return os.path.join(global_worker().core.session_dir, "logs")
+
+        @routes.get("/api/logs")
+        async def logs_route(request):
+            """Session log files (reference: dashboard log module /
+            `ray logs`)."""
+            def ls():
+                d = _log_dir()
+                out = []
+                for name in sorted(os.listdir(d)) if os.path.isdir(d) \
+                        else []:
+                    try:
+                        out.append({"name": name, "size_bytes":
+                                    os.path.getsize(os.path.join(d, name))})
+                    except OSError:
+                        pass
+                return out
+
+            return web.json_response(await offload(ls), dumps=_dumps)
+
+        @routes.get("/api/logs/tail")
+        async def logs_tail(request):
+            name = os.path.basename(request.query.get("file", ""))
+            try:
+                n = int(request.query.get("lines", "200"))
+            except ValueError:
+                return web.Response(status=400, text="bad lines param")
+            n = max(1, min(n, 5000))
+
+            def tail():
+                path = os.path.join(_log_dir(), name)
+                if not os.path.isfile(path):
+                    return None
+                with open(path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - 512 * 1024))
+                    data = f.read().decode("utf-8", "replace")
+                return "\n".join(data.splitlines()[-n:])
+
+            text = await offload(tail)
+            if text is None:
+                return web.Response(status=404, text="no such log file")
+            return web.Response(text=text, content_type="text/plain")
 
         app = web.Application()
         app.add_routes(routes)

@@ -1,6 +1,13 @@
 """Dashboard single-page UI (reference: python/ray/dashboard/client/ —
 a React app there; a dependency-free vanilla-JS page here, served by the
-dashboard head over the same JSON endpoints)."""
+dashboard head over the same JSON endpoints).
+
+Coverage mirrors the reference app's modules: overview cards + per-node
+hardware (reporter), node/actor/PG/job/task tables with row drill-down
+detail panels, an in-browser task timeline rendered from the chrome-trace
+endpoint (modules/metrics + timeline), and in-browser log tailing
+(modules/log). Everything the CLI can show is reachable here.
+"""
 
 INDEX_HTML = """<!doctype html>
 <html>
@@ -14,7 +21,7 @@ INDEX_HTML = """<!doctype html>
            display: flex; align-items: baseline; gap: 16px; }
   header h1 { font-size: 18px; margin: 0; }
   header .sub { color: #9fb0c0; font-size: 12px; }
-  nav { display: flex; gap: 4px; padding: 8px 16px 0; }
+  nav { display: flex; gap: 4px; padding: 8px 16px 0; flex-wrap: wrap; }
   nav button { border: 0; background: #e2e6ea; padding: 8px 14px;
                border-radius: 6px 6px 0 0; cursor: pointer; font-size: 13px; }
   nav button.active { background: #fff; font-weight: 600; }
@@ -25,16 +32,44 @@ INDEX_HTML = """<!doctype html>
            border-bottom: 1px solid #e7ebef; }
   th { color: #5a6b7b; font-weight: 600; font-size: 12px;
        text-transform: uppercase; }
+  tr.click { cursor: pointer; }
+  tr.click:hover { background: #f2f6fa; }
   .pill { padding: 2px 8px; border-radius: 10px; font-size: 12px; }
-  .ALIVE, .RUNNING, .SUCCEEDED { background: #e2f5e8; color: #176639; }
+  .ALIVE, .RUNNING, .SUCCEEDED, .CREATED, .FINISHED
+    { background: #e2f5e8; color: #176639; }
   .DEAD, .FAILED, .ERROR { background: #fdeaea; color: #8f2020; }
-  .PENDING, .RESTARTING, .STOPPED { background: #fff4de; color: #7a5b12; }
+  .PENDING, .RESTARTING, .STOPPED, .RESCHEDULING
+    { background: #fff4de; color: #7a5b12; }
   .cards { display: flex; gap: 12px; flex-wrap: wrap; margin-bottom: 14px; }
   .card { background: #f2f5f8; border-radius: 8px; padding: 12px 18px;
           min-width: 140px; }
   .card .v { font-size: 22px; font-weight: 700; }
   .card .k { font-size: 12px; color: #5a6b7b; }
   #err { color: #8f2020; font-size: 12px; padding: 4px 16px; }
+  .detail { background: #f8fafc; border: 1px solid #e2e8f0;
+            border-radius: 8px; padding: 12px 16px; margin-bottom: 12px; }
+  .detail h3 { margin: 0 0 8px; font-size: 14px; }
+  .detail table { width: auto; }
+  .detail td { border: 0; padding: 2px 14px 2px 0; font-size: 13px;
+               vertical-align: top; }
+  .detail td:first-child { color: #5a6b7b; white-space: nowrap; }
+  .detail .close { float: right; cursor: pointer; color: #5a6b7b; }
+  pre.log { background: #101418; color: #d7e1ea; padding: 12px;
+            border-radius: 8px; font-size: 12px; overflow-x: auto;
+            max-height: 480px; overflow-y: auto; white-space: pre-wrap; }
+  /* timeline */
+  .tl-wrap { overflow-x: auto; border: 1px solid #e2e8f0;
+             border-radius: 8px; }
+  .tl { position: relative; min-height: 60px; }
+  .tl-lane-label { position: sticky; left: 0; width: 110px;
+                   font-size: 11px; color: #5a6b7b; padding: 2px 6px;
+                   background: #f8fafc; border-right: 1px solid #e2e8f0;
+                   overflow: hidden; white-space: nowrap; }
+  .tl-row { display: flex; border-bottom: 1px solid #eef2f6; }
+  .tl-track { position: relative; height: 22px; flex: 1; }
+  .tl-bar { position: absolute; top: 3px; height: 16px; border-radius: 3px;
+            min-width: 2px; opacity: .9; }
+  .tl-axis { font-size: 11px; color: #5a6b7b; padding: 4px 0 2px 116px; }
 </style>
 </head>
 <body>
@@ -45,13 +80,18 @@ INDEX_HTML = """<!doctype html>
   <button data-tab="overview" class="active">Overview</button>
   <button data-tab="nodes">Nodes</button>
   <button data-tab="actors">Actors</button>
+  <button data-tab="pgs">Placement groups</button>
   <button data-tab="jobs">Jobs</button>
   <button data-tab="tasks">Tasks</button>
+  <button data-tab="timeline">Timeline</button>
+  <button data-tab="logs">Logs</button>
 </nav>
 <div id="err"></div>
 <main id="content">loading…</main>
 <script>
 let tab = 'overview';
+let detail = null;    // currently-open drill-down row
+let logFile = null;   // currently-tailed log file
 const $ = (s) => document.querySelector(s);
 const esc = (s) => String(s).replace(/[&<>"']/g, (c) => ({
   '&': '&amp;', '<': '&lt;', '>': '&gt;', '"': '&quot;', "'": '&#39;'
@@ -69,12 +109,75 @@ const pill = (s) => PILL_OK.test(String(s)) ?
 // pill() helper (validated charset) emits markup.
 const cell = (v) => (typeof v === 'string' && v.startsWith('<span class="pill '))
   ? v : esc(v ?? '');
-const table = (cols, rows) =>
-  `<table><tr>${cols.map(c => `<th>${esc(c[0])}</th>`).join('')}</tr>` +
-  rows.map(r => `<tr>${cols.map(c => `<td>${cell(c[1](r))}</td>`)
-    .join('')}</tr>`).join('') + '</table>';
+// rows with onRow get a click handler (drill-down): rows are stashed in
+// window._rows and referenced by index — no user data inside handlers.
+const table = (cols, rows, onRow) => {
+  window._rows = rows;
+  const tr = (r, i) => onRow
+    ? `<tr class="click" onclick="${onRow}(window._rows[${i}])">` : '<tr>';
+  return `<table><tr>${cols.map(c => `<th>${esc(c[0])}</th>`).join('')}</tr>` +
+    rows.map((r, i) => tr(r, i) + cols.map(c =>
+      `<td>${cell(c[1](r))}</td>`).join('') + '</tr>').join('') +
+    '</table>';
+};
+const detailPanel = (title, obj) => {
+  if (!obj) return '';
+  const rows = Object.entries(obj).map(([k, v]) =>
+    `<tr><td>${esc(k)}</td><td>${esc(
+      typeof v === 'object' && v !== null ? JSON.stringify(v) : v ?? ''
+    )}</td></tr>`).join('');
+  return `<div class="detail"><span class="close" ` +
+    `onclick="detail=null;refresh()">✕ close</span>` +
+    `<h3>${esc(title)}</h3><table>${rows}</table></div>`;
+};
+window.showDetail = (r) => { detail = r; refresh(); };
+window.showLog = (r) => { logFile = r.name; refresh(); };
 async function j(url) { const r = await fetch(url);
   if (!r.ok) throw new Error(url + ': ' + r.status); return r.json(); }
+
+// --- timeline renderer: lanes per worker, bars per task span ---------
+const laneColor = (name) => {
+  let h = 0;
+  for (const ch of String(name)) h = (h * 31 + ch.charCodeAt(0)) >>> 0;
+  return `hsl(${h % 360} 60% 55%)`;
+};
+function renderTimeline(events) {
+  const spans = events.filter(e => e.ph === 'X' && e.dur > 0);
+  if (!spans.length) return '<p>No task events yet.</p>';
+  // reduce, not spread: >~120k args would overflow the JS call stack
+  let t0 = Infinity, t1 = -Infinity;
+  for (const e of spans) {
+    if (e.ts < t0) t0 = e.ts;
+    if (e.ts + e.dur > t1) t1 = e.ts + e.dur;
+  }
+  const total = Math.max(t1 - t0, 1);
+  const lanes = new Map();
+  for (const e of spans) {
+    const key = e.pid || '?';
+    if (!lanes.has(key)) lanes.set(key, []);
+    lanes.get(key).push(e);
+  }
+  const width = 100;  // percent
+  let html = `<div class="tl-axis">${(total / 1e6).toFixed(3)}s total ` +
+    `&middot; ${spans.length} spans &middot; ${lanes.size} workers</div>` +
+    '<div class="tl-wrap"><div class="tl">';
+  for (const [key, evs] of lanes) {
+    html += `<div class="tl-row"><div class="tl-lane-label">` +
+      `${esc(key)}</div><div class="tl-track">`;
+    for (const e of evs.slice(0, 2000)) {
+      const left = ((e.ts - t0) / total * width).toFixed(3);
+      const w = Math.max(e.dur / total * width, 0.05).toFixed(3);
+      const failed = (e.args || {}).end_state === 'FAILED';
+      const color = failed ? '#c0392b' : laneColor(e.name);
+      const tip = `${e.name}  ${(e.dur / 1000).toFixed(2)}ms` +
+        (failed ? '  FAILED' : '');
+      html += `<div class="tl-bar" title="${esc(tip)}" style="left:` +
+        `${left}%;width:${w}%;background:${color}"></div>`;
+    }
+    html += '</div></div>';
+  }
+  return html + '</div></div>';
+}
 
 const views = {
   async overview() {
@@ -103,45 +206,79 @@ const views = {
   },
   async nodes() {
     const nodes = await j('/api/nodes');
-    return table([
+    return detailPanel('Node detail', detail) + table([
       ['node', r => (r.node_id || '').slice(0, 8)],
       ['state', r => pill(r.state)],
       ['address', r => r.address],
       ['slice', r => r.slice_id || '-'],
       ['cpu avail', r => (r.resources_available || {}).CPU],
       ['tpu avail', r => (r.resources_available || {}).TPU ?? '-'],
-    ], nodes);
+    ], nodes, 'showDetail');
   },
   async actors() {
     const actors = await j('/api/actors');
-    return table([
+    return detailPanel('Actor detail', detail) + table([
       ['actor', r => (r.actor_id || '').slice(0, 8)],
       ['class', r => r.class_name],
       ['name', r => r.name || ''],
       ['state', r => pill(r.state)],
       ['restarts', r => r.num_restarts],
       ['node', r => (r.node_id || '').slice(0, 8)],
-    ], actors);
+    ], actors, 'showDetail');
+  },
+  async pgs() {
+    const pgs = await j('/api/placement_groups');
+    return detailPanel('Placement group detail', detail) + table([
+      ['pg', r => (r.pg_id || '').slice(0, 8)],
+      ['name', r => r.name || ''],
+      ['state', r => pill(r.state)],
+      ['strategy', r => r.strategy],
+      ['bundles', r => (r.bundles || []).length],
+    ], pgs, 'showDetail');
   },
   async jobs() {
     const jobs = await j('/api/jobs');
-    return table([
+    return detailPanel('Job detail', detail) + table([
       ['job', r => r.submission_id || r.job_id],
       ['status', r => pill(r.status || r.state)],
       ['entrypoint', r => r.entrypoint || ''],
-    ], jobs);
+    ], jobs, 'showDetail');
   },
   async tasks() {
-    const summary = await j('/api/tasks/summary');
-    const rows = Object.entries(summary).map(([name, states]) =>
-      ({name, ...states}));
+    const [summary, rows] = await Promise.all(
+      [j('/api/tasks/summary'), j('/api/tasks')]);
+    let html = '<div class="cards">' +
+      Object.entries(summary).map(([k, v]) =>
+        `<div class="card"><div class="v">${esc(v)}</div>` +
+        `<div class="k">${esc(k)}</div></div>`).join('') + '</div>';
+    html += detailPanel('Task detail', detail) + table([
+      ['task', r => (r.task_id || '').slice(0, 12)],
+      ['name', r => r.name],
+      ['state', r => pill(r.state)],
+      ['actor', r => r.actor_id ? String(r.actor_id).slice(0, 8) : '-'],
+      ['worker', r => (r.worker_id || '').slice(0, 8)],
+    ], rows.slice(-500).reverse(), 'showDetail');
+    return html;
+  },
+  async timeline() {
+    const events = await j('/api/timeline');
+    return renderTimeline(events);
+  },
+  async logs() {
+    if (logFile) {
+      const r = await fetch('/api/logs/tail?file=' +
+        encodeURIComponent(logFile) + '&lines=500');
+      const text = r.ok ? await r.text() : ('error: ' + r.status);
+      return `<p><a href="#" onclick="logFile=null;refresh();` +
+        `return false">&larr; all logs</a> &nbsp; <b>${esc(logFile)}` +
+        `</b> (last 500 lines, auto-refreshing)</p>` +
+        `<pre class="log">${esc(text)}</pre>`;
+    }
+    const files = await j('/api/logs');
     return table([
-      ['task', r => r.name],
-      ['pending', r => r.PENDING ?? 0],
-      ['running', r => r.RUNNING ?? 0],
-      ['finished', r => r.FINISHED ?? 0],
-      ['failed', r => r.FAILED ?? 0],
-    ], rows);
+      ['file', r => r.name],
+      ['size', r => fmtBytes(r.size_bytes)],
+    ], files, 'showLog');
   },
 };
 
@@ -158,6 +295,7 @@ document.querySelectorAll('nav button').forEach(b =>
       x.classList.remove('active'));
     b.classList.add('active');
     tab = b.dataset.tab;
+    detail = null;
     refresh();
   }));
 refresh();

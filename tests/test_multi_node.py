@@ -42,15 +42,12 @@ class TestMultiNode:
             return ray_tpu.get_runtime_context().node_id.hex()
 
         # 3 long tasks, 1 CPU each, on 3 one-CPU nodes ⇒ must spread.
-        # The spillback decision races the ~100ms resource-view gossip;
-        # under heavy machine load a burst can land 2-on-1 legally, so
-        # allow a couple of attempts — spillback must succeed promptly
-        # in at least one.
-        for attempt in range(3):
-            refs = [hold.remote(1.5) for _ in range(3)]
-            nodes = set(ray_tpu.get(refs, timeout=90))
-            if len(nodes) == 3:
-                break
+        # Resource changes push event-driven heartbeats + broadcasts
+        # (RaySyncer-style), and the converged-view wait removes the
+        # startup race — no retries needed.
+        cluster.wait_for_view_converged()
+        refs = [hold.remote(2.0) for _ in range(3)]
+        nodes = set(ray_tpu.get(refs, timeout=90))
         assert len(nodes) == 3
 
     def test_custom_resource_routing(self, ray_start_cluster):

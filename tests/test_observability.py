@@ -130,6 +130,35 @@ def test_dashboard_endpoints(ray_start_regular):
         assert row["node.mem_total_bytes"] > 0
         assert row["node.object_store_capacity_bytes"] > 0
         assert "ray_tpu_node_mem_total_bytes" in get("/metrics")
+
+        # Drill-down endpoints (VERDICT r3 item 4): every state the CLI
+        # shows is reachable through the UI's API surface.
+        @ray_tpu.remote
+        def traced():
+            return 1
+
+        ray_tpu.get([traced.remote() for _ in range(5)])
+        time.sleep(1.5)  # task events flush cadence
+        tasks = json.loads(get("/api/tasks"))
+        assert any(t.get("name", "").endswith("traced") for t in tasks)
+        tl = json.loads(get("/api/timeline"))
+        assert any(e.get("ph") == "X" for e in tl), "no timeline spans"
+        assert isinstance(json.loads(get("/api/placement_groups")), list)
+        assert isinstance(json.loads(get("/api/objects")), list)
+        logs = json.loads(get("/api/logs"))
+        assert logs, "no session log files listed"
+        tail = get("/api/logs/tail?file=" + logs[0]["name"] + "&lines=5")
+        assert isinstance(tail, str)
+        # Path traversal must be rejected (basename-only).
+        traversal_served = True
+        try:
+            get("/api/logs/tail?file=../../etc/passwd")
+        except Exception:
+            traversal_served = False
+        assert not traversal_served, "path traversal not rejected"
+        # New UI tabs present.
+        assert "Timeline" in page and "Logs" in page and \
+            "Placement groups" in page
     finally:
         dash.stop()
 
