@@ -59,6 +59,13 @@ class ClusterConfig:
     worker_start_command: str = \
         "python -m ray_tpu.scripts start --address={head_address}"
     stop_command: str = "python -m ray_tpu.scripts stop"
+    # Docker mode (reference: command_runner.py DockerCommandRunner):
+    # {"image": ..., "container_name": ..., "run_options": [...]} — node
+    # commands exec inside the container; file mounts docker-cp in.
+    docker: Dict[str, Any] = field(default_factory=dict)
+    # Per-node update retries before a node is declared failed and (for
+    # docker/provider nodes) replaced (reference: updater.py).
+    update_retries: int = 2
 
     @classmethod
     def load(cls, path: str) -> "ClusterConfig":
@@ -112,6 +119,17 @@ class LocalCommandRunner(CommandRunner):
                 continue
             os.makedirs(os.path.dirname(remote) or "/", exist_ok=True)
             if os.path.isdir(local):
+                # Delta mirror (deletes removed files) when rsync exists;
+                # plain copy otherwise (reference: updater rsync-up).
+                try:
+                    from ray_tpu.autoscaler.updater import rsync
+
+                    rsync(local.rstrip("/") + "/", remote)
+                    continue
+                except FileNotFoundError:
+                    pass
+                except Exception as e:
+                    logger.debug("rsync failed (%s); copytree fallback", e)
                 shutil.copytree(local, remote, dirs_exist_ok=True)
             else:
                 shutil.copy2(local, remote)
@@ -148,11 +166,27 @@ class SSHCommandRunner(CommandRunner):
 
     def sync_files(self, mounts: Dict[str, str]) -> None:
         for remote, local in mounts.items():
+            local = os.path.expanduser(local)
+            # rsync delta mirroring over ssh (reference: updater.py
+            # rsync up) — only changed files travel; removed files are
+            # deleted remotely. scp -r fallback when rsync is missing.
+            try:
+                from ray_tpu.autoscaler.updater import rsync
+
+                src = local.rstrip("/") + "/" if os.path.isdir(local) \
+                    else local
+                rsync(src, f"{self.user}@{self.ip}:{remote}",
+                      ssh_argv=self._ssh_base()[:-1])
+                continue
+            except FileNotFoundError:
+                pass
+            except Exception as e:
+                logger.debug("[%s] rsync failed (%s); scp fallback",
+                             self.ip, e)
             scp = ["scp", "-r"] + self.SSH_OPTS
             if self.key:
                 scp += ["-i", os.path.expanduser(self.key)]
-            scp += [os.path.expanduser(local),
-                    f"{self.user}@{self.ip}:{remote}"]
+            scp += [local, f"{self.user}@{self.ip}:{remote}"]
             proc = subprocess.run(scp, capture_output=True, text=True,
                                   timeout=600)
             if proc.returncode != 0:
@@ -160,11 +194,20 @@ class SSHCommandRunner(CommandRunner):
                     f"[{self.ip}] scp failed: {proc.stderr[-1000:]}")
 
 
-def _runner_for(config: ClusterConfig, ip: str) -> CommandRunner:
+def _runner_for(config: ClusterConfig, ip: str,
+                docker_tag: str = "") -> CommandRunner:
     ptype = config.provider.get("type", "local")
     if ptype == "local" and ip in ("127.0.0.1", "localhost"):
-        return LocalCommandRunner()
-    return SSHCommandRunner(ip, config.auth)
+        base: CommandRunner = LocalCommandRunner()
+    else:
+        base = SSHCommandRunner(ip, config.auth)
+    if config.docker.get("image"):
+        from ray_tpu.autoscaler.updater import DockerCommandRunner
+
+        return DockerCommandRunner(
+            base, config.docker,
+            docker_tag or f"{config.cluster_name}_{ip.replace('.', '_')}")
+    return base
 
 
 def _state_path(cluster_name: str) -> str:
@@ -239,19 +282,45 @@ def create_or_update_cluster(config_path: str) -> Dict[str, Any]:
     # `ray_tpu start --head` stays resident and writes the address file;
     # poll it for the gcs address (workers + state need it).
     head_address = _wait_head_address(runner)
-    workers = []
+    # Workers go through the per-node update state machine (reference:
+    # updater.py NodeUpdater): wait → sync → setup → start, with retry +
+    # replacement; `up` converges even when some nodes fail.
+    from ray_tpu.autoscaler.updater import FAILED, NodeUpdater
+
+    workers: List[str] = []
+    node_updates: List[Dict[str, Any]] = []
     for ip in config.provider.get("worker_ips", []):
         wrunner = _runner_for(config, ip)
-        wrunner.sync_files(config.file_mounts)
-        for cmd in config.setup_commands:
-            wrunner.run(cmd)
-        _start_detached(
-            wrunner,
-            config.worker_start_command.format(head_address=head_address),
-            f"worker-{ip}")
-        workers.append(ip)
+
+        def replace(ip=ip, wrunner=wrunner):
+            # Fresh state for the retry: recreate the container in docker
+            # mode (a half-set-up container is torn down), fresh runner
+            # otherwise.
+            stop = getattr(wrunner, "stop_container", None)
+            if stop is not None:
+                stop()
+            return _runner_for(config, ip)
+
+        upd = NodeUpdater(
+            ip=ip, runner=wrunner, file_mounts=config.file_mounts,
+            setup_commands=config.setup_commands,
+            start_command=config.worker_start_command.format(
+                head_address=head_address),
+            tag=f"worker-{ip}",
+            max_update_retries=config.update_retries,
+            replace_node=replace,
+            start_detached=_start_detached)
+        status = upd.update()
+        node_updates.append(upd.summary())
+        if status == FAILED:
+            logger.error("[%s] worker %s failed to update after %d "
+                         "attempts: %s", config.cluster_name, ip,
+                         upd.attempts, upd.error)
+        else:
+            workers.append(ip)
     state = {"cluster_name": config.cluster_name, "head_ip": head_ip,
              "head_address": head_address, "workers": workers,
+             "node_updates": node_updates,
              "config_path": os.path.abspath(config_path)}
     with open(_state_path(config.cluster_name), "w") as f:
         json.dump(state, f)

@@ -1,0 +1,215 @@
+"""Per-node update state machine + docker command runner.
+
+Reference: python/ray/autoscaler/_private/updater.py (NodeUpdater:
+wait-ready → rsync file mounts → setup commands → start command, with
+per-phase status tracking) and command_runner.py:DockerCommandRunner
+(commands exec inside a container on the node; files sync to the host
+then into the container).
+
+The launcher (launcher.py) drives one ``NodeUpdater`` per node; a node
+whose update FAILS after its retry budget is torn down and REPLACED with
+a fresh updater attempt (fresh container/process state) — `up` converges
+after partial failure instead of leaving a half-set-up node behind.
+"""
+
+from __future__ import annotations
+
+import logging
+import shlex
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.autoscaler.launcher import CommandRunner
+
+logger = logging.getLogger(__name__)
+
+# Node update lifecycle (reference: updater.py STATUS_*).
+WAITING = "waiting-for-ssh"
+SYNCING = "syncing-files"
+SETTING_UP = "setting-up"
+STARTING = "starting-ray"
+RUNNING = "up-to-date"
+FAILED = "update-failed"
+
+
+class DockerCommandRunner(CommandRunner):
+    """Run node commands inside a docker container (reference:
+    command_runner.py:DockerCommandRunner). Wraps a base runner (local or
+    ssh) that talks to the HOST: the container is created on first use,
+    commands `docker exec` into it, and file mounts sync host-side then
+    `docker cp` into the container."""
+
+    def __init__(self, base: CommandRunner, docker: Dict[str, Any],
+                 tag: str):
+        self.base = base
+        self.image = docker.get("image", "")
+        self.container = docker.get(
+            "container_name", f"ray_tpu_{tag}").replace("/", "_")
+        self.run_options = docker.get("run_options", [])
+        self._ensured = False
+
+    def _ensure_container(self) -> None:
+        if self._ensured:
+            return
+        probe = self.base.run(
+            f"docker inspect -f '{{{{.State.Running}}}}' "
+            f"{shlex.quote(self.container)} 2>/dev/null || echo absent"
+        ).strip()
+        if probe != "true":
+            self.base.run(
+                f"docker rm -f {shlex.quote(self.container)} "
+                f">/dev/null 2>&1 || true")
+            opts = " ".join(self.run_options)
+            self.base.run(
+                f"docker run -d --name {shlex.quote(self.container)} "
+                f"{opts} {shlex.quote(self.image)} sleep infinity")
+        self._ensured = True
+
+    def run(self, cmd: str, timeout: float = 600.0) -> str:
+        self._ensure_container()
+        return self.base.run(
+            f"docker exec {shlex.quote(self.container)} "
+            f"/bin/sh -c {shlex.quote(cmd)}", timeout=timeout)
+
+    def sync_files(self, mounts: Dict[str, str]) -> None:
+        if not mounts:
+            return
+        self._ensure_container()
+        # Host-side mirror first (rsync delta over ssh for remote nodes),
+        # then copy into the container.
+        self.base.sync_files(mounts)
+        for remote, _local in mounts.items():
+            self.base.run(
+                f"docker exec {shlex.quote(self.container)} "
+                f"mkdir -p {shlex.quote(remote)} && "
+                f"docker cp {shlex.quote(remote)}/. "
+                f"{shlex.quote(self.container)}:{shlex.quote(remote)}")
+
+    def stop_container(self) -> None:
+        try:
+            self.base.run(
+                f"docker rm -f {shlex.quote(self.container)} "
+                f">/dev/null 2>&1 || true")
+        except Exception:
+            pass
+        self._ensured = False
+
+
+@dataclass
+class NodeUpdater:
+    """Drives one node through the update lifecycle with retries and
+    replacement (reference: updater.py NodeUpdater.run)."""
+
+    ip: str
+    runner: CommandRunner
+    file_mounts: Dict[str, str]
+    setup_commands: List[str]
+    start_command: str
+    tag: str = "node"
+    max_update_retries: int = 2
+    retry_backoff_s: float = 1.0
+    # Called between failed attempts to get a FRESH node/runner (tear
+    # down the broken one, provision a replacement). Returning None keeps
+    # the current runner (plain retry).
+    replace_node: Optional[Callable[[], Optional[CommandRunner]]] = None
+    start_detached: Optional[Callable[[CommandRunner, str, str],
+                                      None]] = None
+
+    status: str = WAITING
+    error: str = ""
+    phase_times: Dict[str, float] = field(default_factory=dict)
+    attempts: int = 0
+
+    def _phase(self, status: str, fn: Callable[[], None]) -> None:
+        self.status = status
+        t0 = time.monotonic()
+        try:
+            fn()
+        finally:
+            self.phase_times[status] = round(
+                time.monotonic() - t0, 3)
+
+    def _attempt(self) -> None:
+        self._phase(WAITING, self._wait_ready)
+        self._phase(SYNCING,
+                    lambda: self.runner.sync_files(self.file_mounts))
+        self._phase(SETTING_UP, self._setup)
+        self._phase(STARTING, self._start)
+        self.status = RUNNING
+
+    def _wait_ready(self, timeout_s: float = 60.0) -> None:
+        """Wait for the node to answer a trivial command (ssh up,
+        container startable)."""
+        deadline = time.monotonic() + timeout_s
+        last = ""
+        while time.monotonic() < deadline:
+            try:
+                self.runner.run("true", timeout=15.0)
+                return
+            except Exception as e:
+                last = str(e)
+                time.sleep(2.0)
+        raise RuntimeError(f"node {self.ip} never became reachable: {last}")
+
+    def _setup(self) -> None:
+        for cmd in self.setup_commands:
+            logger.info("[%s] setup: %s", self.tag, cmd)
+            self.runner.run(cmd)
+
+    def _start(self) -> None:
+        if self.start_detached is not None:
+            self.start_detached(self.runner, self.start_command, self.tag)
+        else:
+            self.runner.run(self.start_command)
+
+    def update(self) -> str:
+        """Run the lifecycle; on failure, replace the node (if a
+        replacement hook is provided) and retry up to the budget.
+        Returns the final status (RUNNING or FAILED)."""
+        for attempt in range(self.max_update_retries + 1):
+            self.attempts = attempt + 1
+            try:
+                self._attempt()
+                self.error = ""
+                return self.status
+            except Exception as e:
+                self.error = f"{self.status}: {e}"
+                logger.warning("[%s] update attempt %d failed at %s: %s",
+                               self.tag, self.attempts, self.status, e)
+                if attempt >= self.max_update_retries:
+                    break
+                if self.replace_node is not None:
+                    try:
+                        fresh = self.replace_node()
+                        if fresh is not None:
+                            self.runner = fresh
+                    except Exception as re:
+                        logger.warning("[%s] node replacement failed: %s",
+                                       self.tag, re)
+                time.sleep(self.retry_backoff_s * (attempt + 1))
+        self.status = FAILED
+        return self.status
+
+    def summary(self) -> Dict[str, Any]:
+        return {"ip": self.ip, "status": self.status,
+                "attempts": self.attempts, "error": self.error,
+                "phase_times": self.phase_times}
+
+
+def rsync(src: str, dst: str, ssh_argv: Optional[List[str]] = None,
+          delete: bool = True, timeout: float = 600.0) -> None:
+    """Delta file mirroring via rsync (reference: updater.py rsync up);
+    falls back to the caller's copy strategy if rsync is unavailable."""
+    argv = ["rsync", "-az"]
+    if delete:
+        argv.append("--delete")
+    if ssh_argv:
+        argv += ["-e", " ".join(ssh_argv)]
+    argv += [src, dst]
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(f"rsync failed ({proc.returncode}): "
+                           f"{proc.stderr[-1000:]}")
