@@ -542,7 +542,8 @@ class Raylet:
             raise RuntimeError(f"forkserver spawn failed: {reply}")
         return reply["pid"]
 
-    def _spawn_worker(self, tpu: bool = False) -> WorkerHandle:
+    def _spawn_worker(self, tpu: bool = False,
+                      image_uri: str = "") -> WorkerHandle:
         worker_id = WorkerID.from_random()
         extra_env = self._worker_env(worker_id, tpu)
         log_path = os.path.join(self.session_dir, "logs",
@@ -552,12 +553,29 @@ class Raylet:
         w.tpu = tpu
         w.log_path = log_path
         self.workers[worker_id] = w
+        # Container hook (reference: runtime_env/image_uri.py): when the
+        # env pins an image, the worker launches through the operator's
+        # hook command — `<hook> <image_uri> <python> -m ...worker_main`
+        # (e.g. a docker-run wrapper). Recorded here in the launch path;
+        # no hook configured is a hard error surfaced to the creator.
+        container_argv: Optional[List[str]] = None
+        if image_uri:
+            hook = os.environ.get("RAY_TPU_CONTAINER_HOOK", "")
+            if not hook:
+                raise RuntimeError(
+                    f"runtime_env image_uri={image_uri!r} requires a "
+                    "container hook (set RAY_TPU_CONTAINER_HOOK to a "
+                    "wrapper command, e.g. a docker-run script)")
+            import shlex as _shlex
+
+            container_argv = _shlex.split(hook) + [image_uri]
         # TPU workers need the jax plugin imported at interpreter start
         # (sitecustomize), which a fork from the plugin-free template
-        # can't provide — they keep the fresh-interpreter path.
-        use_fork = self.config.forkserver_enabled and not (
-            tpu and os.environ.get("RAY_TPU_AXON_POOL_IPS") and
-            self.resources_total.get("TPU", 0) > 0)
+        # can't provide — they keep the fresh-interpreter path. Container
+        # workers always launch through their hook command.
+        use_fork = self.config.forkserver_enabled and not image_uri and \
+            not (tpu and os.environ.get("RAY_TPU_AXON_POOL_IPS") and
+                 self.resources_total.get("TPU", 0) > 0)
 
         # All spawn work OFF the io loop: a spawn storm (hundreds of
         # actors created at once) must not stall heartbeats — a blocked
@@ -566,9 +584,11 @@ class Raylet:
         def popen():
             env = dict(os.environ)
             env.update(extra_env)
+            argv = (container_argv or []) + [
+                sys.executable, "-m", "ray_tpu._private.worker_main"]
             with open(log_path, "ab") as logf:
                 return subprocess.Popen(
-                    [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                    argv,
                     env=env, stdout=logf, stderr=subprocess.STDOUT,
                     start_new_session=True)
 
@@ -924,9 +944,18 @@ class Raylet:
         # startup entirely — the dominant cost of actor-creation storms.
         needs_tpu = spec.resources.get("TPU", 0) > 0
         self._notify_resources_changed()
-        w = self._take_idle_worker(tpu=needs_tpu)
+        image_uri = (spec.runtime_env or {}).get("image_uri", "")
+        w = None if image_uri else self._take_idle_worker(tpu=needs_tpu)
         if w is None:
-            w = self._spawn_worker(tpu=needs_tpu)
+            try:
+                w = self._spawn_worker(tpu=needs_tpu, image_uri=image_uri)
+            except RuntimeError as e:  # e.g. image_uri without a hook
+                if spec.placement_group_id is None:
+                    self._release_resources(dict(spec.resources), None)
+                else:
+                    self._release_resources(dict(spec.resources),
+                                            bundle_key)
+                return {"ok": False, "error": str(e)}
         else:
             self._maybe_refill_pool()  # replace the consumed pool worker
         w.state = "actor"

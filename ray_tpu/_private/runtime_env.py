@@ -161,28 +161,75 @@ def _materialize(uri: str, gcs_call) -> str:
     return dest
 
 
-_pip_installed: set = set()
+_pip_site_dirs: Dict[tuple, str] = {}  # env key -> installed site dir
 
 
-def _check_pip(env: dict) -> None:
+def _pip_spec(env: dict) -> Optional[tuple]:
+    """Normalize runtime_env['pip'] to (packages tuple, index path)."""
     reqs = env.get("pip")
     if not reqs:
-        return
-    if os.environ.get("RAY_TPU_ALLOW_PIP") != "1":
+        return None
+    index = os.environ.get("RAY_TPU_PIP_INDEX", "")
+    if isinstance(reqs, dict):
+        index = reqs.get("index", index)
+        reqs = reqs.get("packages", [])
+    return tuple(sorted(map(str, reqs))), index
+
+
+def _check_pip(env: dict) -> Optional[str]:
+    """pip plugin (reference: _private/runtime_env/pip.py): builds a
+    content-addressed cached package dir per requirements set and returns
+    it for sys.path application. Installation is gated on an allowlisted
+    LOCAL index (RAY_TPU_PIP_INDEX or pip.index — `--no-index
+    --find-links` semantics; no network), unless RAY_TPU_ALLOW_PIP=1
+    explicitly opts into a live index install.
+
+    The cache key is sha1(packages + index): a second job with the same
+    requirements reuses the installed dir without invoking pip."""
+    spec = _pip_spec(env)
+    if spec is None:
+        return None
+    reqs, index = spec
+    allow_live = os.environ.get("RAY_TPU_ALLOW_PIP") == "1"
+    if not index and not allow_live:
         raise RuntimeError(
             "runtime_env['pip'] requested but this deployment is hermetic "
-            "(no package index). Set RAY_TPU_ALLOW_PIP=1 to attempt a "
-            "live `pip install`, or bake dependencies into the image.")
-    if isinstance(reqs, dict):
-        reqs = reqs.get("packages", [])
-    key = tuple(sorted(map(str, reqs)))
+            "(no package index). Provide a local index via "
+            "RAY_TPU_PIP_INDEX / pip['index'], or set RAY_TPU_ALLOW_PIP=1 "
+            "to attempt a live `pip install`.")
     with _cache_lock:
-        if key in _pip_installed:
-            return
-    subprocess.run([sys.executable, "-m", "pip", "install", *reqs],
-                   check=True)
+        cached = _pip_site_dirs.get(spec)
+        if cached and os.path.isdir(cached):
+            return cached
+    digest = hashlib.sha1(
+        repr((reqs, index)).encode()).hexdigest()[:16]
+    dest = os.path.join(_CACHE_ROOT, "pip", digest)
+    marker = os.path.join(dest, ".ray_tpu_ready")
+    if not os.path.exists(marker):
+        tmp = dest + f".tmp.{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+        cmd = [sys.executable, "-m", "pip", "install",
+               "--quiet", "--no-warn-script-location",
+               "--target", tmp, *reqs]
+        if index:
+            cmd += ["--no-index", "--find-links", index]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"pip install of {list(reqs)} failed:\n"
+                f"{proc.stderr[-2000:]}")
+        open(os.path.join(tmp, ".ray_tpu_ready"), "w").close()
+        try:
+            os.replace(tmp, dest)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            if not os.path.exists(marker):
+                raise
     with _cache_lock:
-        _pip_installed.add(key)
+        _pip_site_dirs[spec] = dest
+    return dest
 
 
 @contextlib.contextmanager
@@ -198,12 +245,15 @@ def applied_runtime_env(runtime_env: Optional[dict], gcs_call):
         raise RuntimeError(
             "runtime_env['conda'] is not supported in this deployment "
             "(hermetic image); use the baked environment or py_modules.")
-    _check_pip(runtime_env)
+    pip_dir = _check_pip(runtime_env)
 
     saved_env: Dict[str, Optional[str]] = {}
     saved_cwd = os.getcwd()
     added_paths: List[str] = []
     try:
+        if pip_dir:
+            sys.path.insert(0, pip_dir)
+            added_paths.append(pip_dir)
         for key, value in (runtime_env.get("env_vars") or {}).items():
             saved_env[key] = os.environ.get(key)
             os.environ[key] = str(value)
@@ -222,6 +272,16 @@ def applied_runtime_env(runtime_env: Optional[dict], gcs_call):
         for p in added_paths:
             with contextlib.suppress(ValueError):
                 sys.path.remove(p)
+        # Evict modules imported FROM the env's paths: workers are shared
+        # across envs here (unlike the reference's dedicated workers), so
+        # sys.modules residue would leak the env's packages into later
+        # tasks (and pin stale code across env versions).
+        if added_paths:
+            prefixes = tuple(p.rstrip(os.sep) + os.sep for p in added_paths)
+            for name, mod in list(sys.modules.items()):
+                f = getattr(mod, "__file__", None)
+                if f and f.startswith(prefixes):
+                    del sys.modules[name]
         with contextlib.suppress(OSError):
             os.chdir(saved_cwd)
         for key, old in saved_env.items():
@@ -243,7 +303,9 @@ def apply_runtime_env_permanent(runtime_env: Optional[dict],
     if runtime_env.get("conda"):
         raise RuntimeError(
             "runtime_env['conda'] is not supported in this deployment")
-    _check_pip(runtime_env)
+    pip_dir = _check_pip(runtime_env)
+    if pip_dir:
+        sys.path.insert(0, pip_dir)
     for key, value in (runtime_env.get("env_vars") or {}).items():
         os.environ[key] = str(value)
     wd_uri = runtime_env.get("working_dir")
