@@ -1,0 +1,137 @@
+"""gRPC ingress: the versioned serve_call schema on a standard transport.
+
+Reference: python/ray/serve/_private/proxy.py:540 (gRPCProxy) +
+src/ray/protobuf/serve.proto. The wire contract is the SAME versioned
+msgpack schema as the framed-rpc ingress (ingress_schema.py) carried as
+raw gRPC message bytes through grpc's generic-handler API — so any gRPC
+client in any language can call a deployment with nothing generated and
+nothing imported from ray_tpu:
+
+    channel = grpc.insecure_channel(addr)
+    call = channel.unary_unary("/rayserve.ServeAPI/Call")
+    resp = msgpack.unpackb(call(msgpack.packb({
+        "schema_version": 1, "app": "default", "payload": ...})))
+
+Methods:
+    /rayserve.ServeAPI/Call        unary-unary   one response envelope
+    /rayserve.ServeAPI/StreamCall  unary-stream  envelope per chunk, a
+                                                 final envelope carries
+                                                 {"eos": True}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from concurrent import futures
+from typing import Optional
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "rayserve.ServeAPI"
+METHOD_CALL = f"/{SERVICE}/Call"
+METHOD_STREAM = f"/{SERVICE}/StreamCall"
+
+
+class GrpcIngress:
+    """Serves the versioned schema over grpc beside the HTTP proxy.
+
+    Handlers run on grpc's thread pool and bridge onto the proxy's
+    asyncio loop (where the router lives) via run_coroutine_threadsafe.
+    """
+
+    def __init__(self, rpc_ingress, loop: asyncio.AbstractEventLoop,
+                 host: str = "127.0.0.1", port: int = 0,
+                 request_timeout_s: Optional[float] = 60.0):
+        import grpc
+
+        self._ingress = rpc_ingress
+        self._loop = loop
+        self._timeout = request_timeout_s
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8,
+                                       thread_name_prefix="serve-grpc"))
+        outer = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, call_details):
+                if call_details.method == METHOD_CALL:
+                    return grpc.unary_unary_rpc_method_handler(
+                        outer._handle_call)
+                if call_details.method == METHOD_STREAM:
+                    return grpc.unary_stream_rpc_method_handler(
+                        outer._handle_stream)
+                return None
+
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            raise OSError(f"grpc ingress failed to bind {host}:{port}")
+        self._server.start()
+        logger.info("serve grpc ingress on %s:%d", host, self.port)
+
+    # grpc generic handlers receive/return raw bytes (no serializers
+    # registered): the payload IS the msgpack schema message.
+    def _handle_call(self, request: bytes, context) -> bytes:
+        from ray_tpu.serve._private.ingress_schema import (STATUS_INVALID,
+                                                           ServeCallResponse)
+
+        try:
+            data = msgpack.unpackb(request, raw=False)
+        except Exception as e:
+            return msgpack.packb(ServeCallResponse(
+                status=STATUS_INVALID,
+                error=f"bad msgpack request: {e}").to_wire(),
+                use_bin_type=True)
+        fut = asyncio.run_coroutine_threadsafe(
+            self._ingress.handle_serve_call(data, None), self._loop)
+        reply = fut.result(timeout=(self._timeout or 0) + 30
+                           if self._timeout else None)
+        return msgpack.packb(reply, use_bin_type=True)
+
+    def _handle_stream(self, request: bytes, context):
+        from ray_tpu.serve._private.ingress_schema import (STATUS_APP_ERROR,
+                                                           STATUS_INVALID,
+                                                           STATUS_OK,
+                                                           ServeCallResponse)
+
+        def envelope(**kw) -> bytes:
+            return msgpack.packb(ServeCallResponse(**kw).to_wire(),
+                                 use_bin_type=True)
+
+        try:
+            data = msgpack.unpackb(request, raw=False)
+        except Exception as e:
+            yield envelope(status=STATUS_INVALID,
+                           error=f"bad msgpack request: {e}")
+            return
+        try:
+            gen = asyncio.run_coroutine_threadsafe(
+                self._ingress.open_serve_stream(data), self._loop
+            ).result(timeout=30.0)
+        except Exception as e:
+            yield envelope(status=STATUS_APP_ERROR,
+                           error=f"{type(e).__name__}: {e}")
+            return
+        if isinstance(gen, dict):
+            yield msgpack.packb(gen, use_bin_type=True)  # error envelope
+            return
+        request_id = data.get("request_id", "")
+        try:
+            for chunk in gen:
+                yield envelope(status=STATUS_OK, result=chunk,
+                               request_id=request_id)
+        except Exception as e:
+            yield envelope(status=STATUS_APP_ERROR,
+                           error=f"{type(e).__name__}: {e}",
+                           request_id=request_id)
+            return
+        final = ServeCallResponse(status=STATUS_OK,
+                                  request_id=request_id).to_wire()
+        final["eos"] = True
+        yield msgpack.packb(final, use_bin_type=True)
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)

@@ -113,6 +113,42 @@ class _RpcIngress:
         return ServeCallResponse(status=STATUS_OK, result=result,
                                  request_id=req.request_id).to_wire()
 
+    async def open_serve_stream(self, data):
+        """Streaming variant for the grpc ingress (unary-stream): routes
+        like handle_serve_call but opens a streaming handle call and
+        returns its DeploymentResponseGenerator (sync-iterable from the
+        grpc worker thread). Error envelopes return as dicts."""
+        from ray_tpu.serve._private.ingress_schema import (
+            STATUS_INVALID, STATUS_NOT_FOUND, SchemaError,
+            ServeCallRequest, ServeCallResponse)
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        try:
+            req = ServeCallRequest.from_wire(data)
+        except SchemaError as e:
+            return ServeCallResponse(status=STATUS_INVALID,
+                                     error=str(e)).to_wire()
+        deployment = req.deployment
+        if deployment is None:
+            entry = next((e for e in self._proxy._route_table.values()
+                          if e["app_name"] == req.app), None)
+            if entry is None:
+                return ServeCallResponse(
+                    status=STATUS_NOT_FOUND,
+                    error=f"no application {req.app!r}",
+                    request_id=req.request_id).to_wire()
+            deployment = entry["deployment"]
+        handle = DeploymentHandle(deployment, req.app).options(stream=True)
+        if req.method:
+            handle = handle.options(method_name=req.method)
+        if req.multiplexed_model_id:
+            handle = handle.options(
+                multiplexed_model_id=req.multiplexed_model_id)
+        self._proxy._num_requests += 1
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: handle.remote(req.payload))
+
 
 async def _await_response(response):
     """Shared by the HTTP and rpc ingress paths."""
@@ -144,6 +180,8 @@ class ProxyActor:
         self._error: Optional[str] = None
         self._rpc_server = None
         self._rpc_port = 0
+        self._grpc_server = None
+        self._grpc_port = 0
         self._thread = threading.Thread(target=self._serve_thread,
                                         daemon=True, name="serve-proxy-http")
         self._thread.start()
@@ -160,6 +198,7 @@ class ProxyActor:
     def status(self) -> dict:
         return {"address": f"http://{self._host}:{self._port}",
                 "rpc_port": self._rpc_port,
+                "grpc_port": getattr(self, "_grpc_port", 0),
                 "num_requests": self._num_requests,
                 "routes": sorted(self._route_table)}
 
@@ -167,6 +206,14 @@ class ProxyActor:
         """Address of the rpc ingress (gRPC-proxy analog)."""
         self.ready()
         return f"{self._host}:{self._rpc_port}"
+
+    def grpc_address(self) -> str:
+        """Address of the standard-gRPC ingress (reference: gRPCProxy)."""
+        self.ready()
+        port = getattr(self, "_grpc_port", 0)
+        if not port:
+            raise RuntimeError("grpc ingress is not available")
+        return f"{self._host}:{port}"
 
     def stop_server(self) -> None:
         if self._server_loop is not None and self._stop_evt is not None:
@@ -241,11 +288,29 @@ class ProxyActor:
         # instead of HTTP.
         from ray_tpu.core import rpc as _rpc
 
-        self._rpc_server = _rpc.Server(_RpcIngress(self), self._host, 0)
+        ingress = _RpcIngress(self)
+        self._rpc_server = _rpc.Server(ingress, self._host, 0)
         self._rpc_port = await self._rpc_server.start()
+        # Third ingress: the SAME versioned schema on standard gRPC
+        # (reference: gRPCProxy, proxy.py:540) — reachable by clients
+        # that import nothing from ray_tpu.
+        try:
+            from ray_tpu.serve._private.grpc_proxy import GrpcIngress
+
+            self._grpc_server = GrpcIngress(
+                ingress, asyncio.get_running_loop(), self._host, 0,
+                request_timeout_s=self._request_timeout_s)
+            self._grpc_port = self._grpc_server.port
+        except Exception:
+            logger.exception("grpc ingress unavailable; msgpack-framed "
+                             "rpc ingress remains")
+            self._grpc_server = None
+            self._grpc_port = 0
         self._ready_evt.set()
         logger.info("Serve proxy listening on %s:%d", self._host, self._port)
         await self._stop_evt.wait()
+        if self._grpc_server is not None:
+            self._grpc_server.stop()
         await self._rpc_server.close()
         await runner.cleanup()
 

@@ -7,6 +7,7 @@ through the real controller + replica actors on a local cluster.
 
 import asyncio
 import json
+import os
 import time
 import urllib.request
 
@@ -630,3 +631,78 @@ class TestRouterScheduling:
         picks = {s.choose_replica({"r2", "r3"}).info.replica_id
                  for _ in range(40)}
         assert picks <= {"r2", "r3"}  # model placement beats locality
+
+
+def test_grpc_ingress_external_client(ray_start_regular):
+    """VERDICT r3 item 7: the versioned serve schema on standard gRPC —
+    called by a client SCRIPT that imports nothing from ray_tpu
+    (tools/serve_grpc_client.py), plus streaming through the same
+    transport."""
+    import subprocess
+    import sys
+    import time as _time
+
+    from ray_tpu import serve
+    from ray_tpu.core.actor import get_actor
+    from ray_tpu.serve._private.common import SERVE_NAMESPACE
+
+    @serve.deployment
+    class Echoer:
+        def __call__(self, text):
+            return {"echo": str(text).upper()}
+
+        def chunks(self, n):
+            for i in range(int(n)):
+                yield {"i": i}
+
+    serve.run(Echoer.bind(), name="grpc_app", route_prefix="/grpc_app")
+    proxy = get_actor("SERVE_PROXY", namespace=SERVE_NAMESPACE)
+    address = ray_tpu.get(proxy.grpc_address.remote())
+
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "serve_grpc_client.py")
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    # Routes propagate via long-poll: retry briefly.
+    deadline = _time.time() + 20
+    while True:
+        proc = subprocess.run(
+            [sys.executable, script, address, "grpc_app", '"hi"'],
+            capture_output=True, text=True, timeout=90, env=env,
+            cwd="/tmp")
+        if proc.returncode == 0:
+            break
+        if _time.time() > deadline:
+            raise AssertionError(
+                f"grpc client failed: {proc.stdout} {proc.stderr}")
+        _time.sleep(1.0)
+    reply = json.loads(proc.stdout.strip())
+    assert reply["status"] == 0
+    assert reply["result"] == {"echo": "HI"}
+
+    # Streaming over grpc unary-stream: per-chunk envelopes + eos.
+    import grpc as _grpc
+    import msgpack as _msgpack
+
+    channel = _grpc.insecure_channel(address)
+    call = channel.unary_stream("/rayserve.ServeAPI/StreamCall")
+    req = _msgpack.packb({
+        "schema_version": 1, "app": "grpc_app", "method": "chunks",
+        "payload": 3, "request_id": "s1"}, use_bin_type=True)
+    got = []
+    for raw in call(req, timeout=60):
+        msg = _msgpack.unpackb(raw, raw=False)
+        if msg.get("eos"):
+            break
+        assert msg["status"] == 0, msg
+        got.append(msg["result"])
+    assert got == [{"i": 0}, {"i": 1}, {"i": 2}]
+
+    # Unknown app -> NOT_FOUND envelope (status 2), not a transport error.
+    call1 = channel.unary_unary("/rayserve.ServeAPI/Call")
+    bad = _msgpack.unpackb(call1(_msgpack.packb(
+        {"schema_version": 1, "app": "nope", "payload": 1},
+        use_bin_type=True), timeout=30), raw=False)
+    assert bad["status"] == 2
+    serve.shutdown()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Standalone Serve gRPC client — imports NOTHING from ray_tpu.
+
+Proof that the serve ingress rides a standard transport with a public,
+versioned contract (reference: the reference's gRPCProxy is consumable
+from generated stubs; here any grpc client + msgpack suffices — see
+ray_tpu/serve/_private/grpc_proxy.py for the method table).
+
+Usage:
+    python tools/serve_grpc_client.py <host:port> <app> <payload-json>
+    python tools/serve_grpc_client.py <host:port> <app> <payload-json> \
+        --stream
+"""
+
+import json
+import sys
+
+import grpc
+import msgpack
+
+
+def main() -> int:
+    if len(sys.argv) < 4:
+        print(__doc__)
+        return 2
+    address, app, payload_json = sys.argv[1:4]
+    stream = "--stream" in sys.argv[4:]
+    request = msgpack.packb({
+        "schema_version": 1,
+        "app": app,
+        "payload": json.loads(payload_json),
+        "request_id": "cli-1",
+    }, use_bin_type=True)
+    channel = grpc.insecure_channel(address)
+    if stream:
+        call = channel.unary_stream("/rayserve.ServeAPI/StreamCall")
+        for raw in call(request, timeout=60):
+            msg = msgpack.unpackb(raw, raw=False)
+            if msg.get("eos"):
+                break
+            if msg.get("status") != 0:
+                print(json.dumps(msg))
+                return 1
+            print(json.dumps(msg.get("result")))
+        return 0
+    call = channel.unary_unary("/rayserve.ServeAPI/Call")
+    msg = msgpack.unpackb(call(request, timeout=60), raw=False)
+    print(json.dumps(msg))
+    return 0 if msg.get("status") == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
