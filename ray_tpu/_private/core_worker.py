@@ -860,8 +860,19 @@ class CoreWorker:
 
             pg_id = PlacementGroupID(strategy["pg_id"])
             bundle = strategy.get("bundle_index", -1)
+        # Trace propagation (reference: tracing_helper.py:326 — span
+        # context rides task metadata): a task submitted from INSIDE a
+        # task/actor call inherits the caller's trace id with the caller
+        # as parent span; a driver-root submission opens a new trace.
+        task_id = self._next_task_id()
+        parent = self._current_task
+        if parent is not None and parent.trace_ctx:
+            trace_ctx = {"trace_id": parent.trace_ctx["trace_id"],
+                         "parent_span_id": parent.task_id.hex()}
+        else:
+            trace_ctx = {"trace_id": task_id.hex(), "parent_span_id": ""}
         return TaskSpec(
-            task_id=self._next_task_id(),
+            task_id=task_id,
             job_id=self.job_id,
             task_type=task_type,
             function=descriptor,
@@ -881,6 +892,7 @@ class CoreWorker:
             runtime_env=opts.get("runtime_env"),
             name=opts.get("name", descriptor.display()),
             kwarg_keys=kwarg_keys,
+            trace_ctx=trace_ctx,
         )
 
     async def _submit_to_lease(self, spec: TaskSpec) -> None:
@@ -2450,6 +2462,7 @@ class CoreWorker:
     def _record_task_event(self, spec: TaskSpec, state: str) -> None:
         if not self.config.task_events_enabled:
             return
+        tc = spec.trace_ctx or {}
         with self._task_events_lock:
             self._task_events.append({
                 "task_id": spec.task_id.binary(),
@@ -2460,6 +2473,8 @@ class CoreWorker:
                 "worker_id": self.worker_id.binary(),
                 "actor_id": spec.actor_id.binary() if spec.actor_id
                 else None,
+                "trace_id": tc.get("trace_id", ""),
+                "parent_span_id": tc.get("parent_span_id", ""),
             })
         # Flush on batch size or a 1s cadence (reference: TaskEventBuffer
         # periodic flush, task_event_buffer.h:206).

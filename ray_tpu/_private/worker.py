@@ -533,6 +533,21 @@ class RuntimeContext:
         spec = self._worker.core._current_task
         return spec.task_id if spec else None
 
+    def get_trace_id(self) -> str:
+        """Trace id of the current call chain (reference: OTel span
+        context propagated through task metadata,
+        tracing_helper.py:326). Empty outside task execution."""
+        spec = self._worker.core._current_task
+        if spec is not None and spec.trace_ctx:
+            return spec.trace_ctx.get("trace_id", "")
+        return ""
+
+    def get_parent_span_id(self) -> str:
+        spec = self._worker.core._current_task
+        if spec is not None and spec.trace_ctx:
+            return spec.trace_ctx.get("parent_span_id", "")
+        return ""
+
     def cluster_resources(self) -> dict:
         return self._worker.gcs_call("cluster_resources")
 

@@ -71,6 +71,11 @@ class TaskSpec:
     # runtime env / options
     runtime_env: Optional[dict] = None
     name: str = ""
+    # Distributed trace context (reference: tracing_helper.py:326 —
+    # span context injected into task metadata and propagated through
+    # nested submissions): {"trace_id": hex, "parent_span_id": hex}.
+    # A task's own span id IS its task id.
+    trace_ctx: Optional[dict] = None
     # keyword-argument names: args holds positional args followed by the
     # kwarg values in this order
     kwarg_keys: List[str] = dataclasses.field(default_factory=list)
@@ -107,6 +112,7 @@ class TaskSpec:
             "runtime_env": self.runtime_env,
             "name": self.name,
             "kwarg_keys": self.kwarg_keys,
+            "trace_ctx": self.trace_ctx,
         }
 
     @classmethod
@@ -132,6 +138,7 @@ class TaskSpec:
             runtime_env=w.get("runtime_env"),
             name=w.get("name", ""),
             kwarg_keys=w.get("kwarg_keys", []),
+            trace_ctx=w.get("trace_ctx"),
         )
 
     def scheduling_key(self) -> tuple:

@@ -51,7 +51,14 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
                 "dur": (ev["time"] - start["time"]) * 1e6,
                 "pid": worker[:8],
                 "tid": worker[:8],
-                "args": {"task_id": tid, "end_state": state},
+                # Distributed trace context (tracing_helper.py:326
+                # analog): nested calls share trace_id; parent_span_id
+                # is the submitting task. chrome://tracing shows these
+                # in the args pane; exporters can rebuild span trees.
+                "args": {"task_id": tid, "end_state": state,
+                         "trace_id": start.get("trace_id", ""),
+                         "parent_span_id": start.get("parent_span_id",
+                                                     "")},
             })
     if filename:
         with open(filename, "w") as f:
