@@ -37,6 +37,15 @@ class Algorithm(Trainable):
             raise ValueError("config.environment(env=...) is required")
         probe = make_env(self.config.env, self.config.env_config)
         obs_dim = int(probe.observation_space.shape[0])
+        # ConnectorV2 pipelines (reference: rllib/connectors/): an
+        # env_to_module connector may reshape observations (e.g. frame
+        # stacking) — size the module from the TRANSFORMED dim.
+        from ray_tpu.rllib.connectors.connector import build_pipeline
+
+        obs_dim = build_pipeline(
+            self.config.env_to_module_connector).observation_dim(obs_dim)
+        self.learner_connector_pipeline = build_pipeline(
+            self.config.learner_connector)
         space = probe.action_space
         if hasattr(space, "n"):  # Discrete
             num_actions = int(space.n)
@@ -70,6 +79,17 @@ class Algorithm(Trainable):
 
         return RLModuleSpec(self.module_class, obs_dim, num_actions,
                             dict(self.config.model))
+
+    def apply_learner_connector(self, batch):
+        """Run the learner ConnectorV2 pipeline over a sampled batch
+        (reference: the learner connector runs before loss computation —
+        here before advantage estimation, the same ordering the
+        reference's GeneralAdvantageEstimation connector relies on)."""
+        from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+        if not len(self.learner_connector_pipeline):
+            return batch
+        return SampleBatch(self.learner_connector_pipeline(batch))
 
     def step(self) -> Dict[str, Any]:
         t0 = time.perf_counter()

@@ -22,6 +22,12 @@ class AlgorithmConfig:
         # env runners
         self.num_env_runners: int = 0
         self.num_envs_per_runner: int = 1  # vector-env width per runner
+        # ConnectorV2 pipeline FACTORIES (reference: rllib/connectors/):
+        # callables returning a ConnectorV2, a list of them, or a
+        # ConnectorPipelineV2 — built per runner/learner process.
+        self.env_to_module_connector = None   # obs -> module inputs
+        self.module_to_env_connector = None   # module outputs -> actions
+        self.learner_connector = None         # train batch (pre-GAE)
         self.num_cpus_per_env_runner: int = 1
         self.rollout_fragment_length: int = 200
         # training

@@ -93,7 +93,8 @@ class PPO(Algorithm):
         pairs = self.env_runner_group.sample_with_bootstraps(
             cfg.train_batch_size)
         train_batch = SampleBatch.concat_samples([
-            compute_gae(batch, cfg.gamma, cfg.lambda_, bootstrap)
+            compute_gae(self.apply_learner_connector(batch),
+                        cfg.gamma, cfg.lambda_, bootstrap)
             for batch, bootstrap in pairs])
         train_batch[sb.ADVANTAGES] = standardize(
             train_batch[sb.ADVANTAGES])

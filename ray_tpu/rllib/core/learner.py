@@ -141,7 +141,11 @@ class JaxLearner:
         grads, metrics = self.compute_gradients(batch_shard)
         flat, unravel = ravel_pytree(grads)
         world = col.get_collective_group_size(group_name)
-        mean = col.allreduce(np.asarray(flat), group_name=group_name)
+        # Generous first-op timeout: peer ranks may still be jit-compiling
+        # their first compute_gradients (minutes on a contended host) —
+        # a 120s default flakes under load.
+        mean = col.allreduce(np.asarray(flat), group_name=group_name,
+                             timeout_s=600.0)
         mean = mean / world
         self.apply_gradients(unravel(mean))
         return metrics

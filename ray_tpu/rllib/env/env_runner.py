@@ -49,6 +49,35 @@ class SingleAgentEnvRunner:
             maxlen=100)
         self._explore_fn = None
         self._total_steps = 0
+        # ConnectorV2 pipelines (reference: rllib/connectors/): user
+        # env_to_module/module_to_env factories from the config, plus
+        # the default EpsilonGreedy module_to_env connector — the runner
+        # itself contains no hard-wired preprocessing.
+        from ray_tpu.rllib.connectors.connector import (EpsilonGreedy,
+                                                        build_pipeline)
+
+        self._env_to_module = build_pipeline(
+            config.get("env_to_module_connector"))
+        self._module_to_env = build_pipeline(
+            config.get("module_to_env_connector"))
+        self._module_to_env.append(EpsilonGreedy())
+        self._prev_dones = np.ones(self.num_envs, bool)  # fresh episodes
+
+    def _obs_in(self, obs: np.ndarray) -> np.ndarray:
+        """env_to_module transform for the obs the policy will act on
+        (advances connector state; resets per-env state after dones)."""
+        if not len(self._env_to_module):
+            return obs
+        return self._env_to_module({"obs": obs},
+                                   dones=self._prev_dones)["obs"]
+
+    def _obs_peek(self, obs: np.ndarray, dones: np.ndarray) -> np.ndarray:
+        """env_to_module transform WITHOUT advancing state (recording
+        next_obs / value bootstraps)."""
+        if not len(self._env_to_module):
+            return obs
+        return self._env_to_module({"obs": obs}, dones=dones,
+                                   commit=False)["obs"]
 
     def set_weights(self, params) -> None:
         self.params = params
@@ -91,14 +120,21 @@ class SingleAgentEnvRunner:
         quota = -(-num_episodes // self.num_envs)
         counts = np.zeros(self.num_envs, np.int64)
         done_returns: List[float] = []
+        self._prev_dones = np.ones(self.num_envs, bool)  # fresh episodes
         for _ in range(100_000):  # hard cap; envs bound episode length
-            obs = self.env.current_obs
+            obs = self._obs_in(self.env.current_obs)
             out = (self._explore_batch(obs) if explore
                    else self._infer_batch(obs))
+            out = self._module_to_env(
+                out, explore=explore,
+                action_space_n=(self.env.action_space.n if discrete
+                                else None),
+                rng=self._np_rng)
             actions = np.asarray(out["actions"])
             if not discrete:
                 actions = actions.astype(np.float32)
             _, rewards, terms, truncs = self.env.step(actions)
+            self._prev_dones = terms | truncs
             ep_ret += rewards
             for i in np.nonzero(terms | truncs)[0]:
                 if counts[i] < quota:
@@ -128,17 +164,19 @@ class SingleAgentEnvRunner:
         last_truncs = np.zeros(self.num_envs, bool)
         last_next_obs = self.env.current_obs
         for _ in range(n_iters):
-            obs = self.env.current_obs
+            obs = self._obs_in(self.env.current_obs)
             out = self._explore_batch(obs)
+            # module_to_env pipeline (default: EpsilonGreedy) — action
+            # post-processing lives in connectors, not the runner.
+            out = self._module_to_env(
+                out, explore=explore, epsilon=epsilon,
+                action_space_n=(self.env.action_space.n if discrete
+                                else None),
+                rng=self._np_rng)
             actions = np.asarray(out["actions"])
-            if discrete and epsilon > 0.0:
-                override = self._np_rng.random(self.num_envs) < epsilon
-                actions = np.where(
-                    override,
-                    self._np_rng.integers(self.env.action_space.n,
-                                          size=self.num_envs),
-                    actions)
-            next_obs, rewards, terms, truncs = self.env.step(actions)
+            next_obs_raw, rewards, terms, truncs = self.env.step(actions)
+            done = terms | truncs
+            next_obs = self._obs_peek(next_obs_raw, done)
             for i in range(self.num_envs):
                 cols = per_env[i]
                 cols[sb.OBS].append(obs[i])
@@ -156,23 +194,27 @@ class SingleAgentEnvRunner:
                     cols[sb.VF_PREDS].append(out["vf_preds"][i])
             self._episode_return += rewards
             self._total_steps += self.num_envs
-            done = terms | truncs
             for i in np.nonzero(done)[0]:
                 self._recent_returns.append(float(
                     self._episode_return[i]))
                 self._episode_return[i] = 0.0
                 self._eps_id[i] += 1
             last_terms, last_truncs = terms, truncs
-            last_next_obs = next_obs
+            last_next_obs = next_obs_raw
+            self._prev_dones = done
         # Exact per-env bootstraps for each env's final step: terminated
         # → 0; truncated → V(final next_obs); cut mid-episode →
         # V(current obs). Each batched forward runs only when some env
-        # actually needs that bootstrap kind.
+        # actually needs that bootstrap kind. Peek transforms: the value
+        # net sees the same connector view the next forward would.
         zeros = np.zeros(self.num_envs, np.float32)
-        vf_next = (self._explore_batch(last_next_obs).get(
+        no_dones = np.zeros(self.num_envs, bool)
+        vf_next = (self._explore_batch(
+            self._obs_peek(last_next_obs, no_dones)).get(
             "vf_preds", zeros) if last_truncs.any() else zeros)
         cut = ~(last_terms | last_truncs)
-        vf_cur = (self._explore_batch(self.env.current_obs).get(
+        vf_cur = (self._explore_batch(
+            self._obs_peek(self.env.current_obs, self._prev_dones)).get(
             "vf_preds", zeros) if cut.any() else zeros)
         boots: Dict[int, float] = {}
         for i in range(self.num_envs):
@@ -200,7 +242,8 @@ class SingleAgentEnvRunner:
         by compute_gae accepting either form."""
         if hasattr(self, "_end_bootstraps"):
             return self._end_bootstraps
-        out = self._explore_batch(self.env.current_obs)
+        out = self._explore_batch(
+            self._obs_peek(self.env.current_obs, self._prev_dones))
         vals = np.asarray(out.get("vf_preds",
                                   np.zeros(self.num_envs, np.float32)))
         return {int(self._eps_id[i]): float(vals[i])
