@@ -712,6 +712,26 @@ class Raylet:
         spillback decisions are based on)."""
         return self.cluster_view
 
+    async def handle_list_store_objects(self, data, conn) -> list:
+        """This node's shm store contents (id, size, pin count) — one
+        shard of the cluster-wide `list objects` state query (reference:
+        the per-core-worker object tables behind `ray list objects`)."""
+        import ctypes
+
+        from ray_tpu.core import shm_client as sc
+
+        lib = sc._load()
+        max_n = int(data.get("limit", 4096))
+        ids_buf = (ctypes.c_uint8 * (24 * max_n))()
+        sizes = (ctypes.c_uint64 * max_n)()
+        refs = (ctypes.c_int64 * max_n)()
+        n = lib.shm_list(self.store._ptr, ids_buf, sizes, refs, max_n)
+        return [{"object_id": bytes(ids_buf[i * 24:(i + 1) * 24]).hex(),
+                 "size_bytes": int(sizes[i]),
+                 "pins": int(refs[i]),
+                 "node_id": self.node_id.hex()}
+                for i in range(n)]
+
     async def handle_request_worker_lease(self, data, conn) -> dict:
         req = LeaseRequest(data)
         if not self._feasible_ever(req):

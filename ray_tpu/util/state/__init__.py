@@ -17,25 +17,48 @@ def _gcs(method: str, data: Optional[dict] = None):
     return global_worker().gcs_call(method, data or {})
 
 
+def _coerce_pair(have: Any, value: Any):
+    """Numeric comparison when both sides parse as numbers, else string
+    comparison (matches the reference's predicate semantics)."""
+    try:
+        return float(have), float(value)
+    except (TypeError, ValueError):
+        return str(have), str(value)
+
+
+def _match(have: Any, op: str, value: Any) -> bool:
+    if op == "=":
+        return str(have) == str(value)
+    if op == "!=":
+        return str(have) != str(value)
+    if op in ("<", "<=", ">", ">="):
+        a, b = _coerce_pair(have, value)
+        if op == "<":
+            return a < b
+        if op == "<=":
+            return a <= b
+        if op == ">":
+            return a > b
+        return a >= b
+    if op == "contains":
+        return str(value) in str(have)
+    if op == "in":
+        vals = value if isinstance(value, (list, tuple, set)) else \
+            [v.strip() for v in str(value).split(",")]
+        return str(have) in {str(v) for v in vals}
+    raise ValueError(
+        f"unsupported filter op {op!r} "
+        "(supported: = != < <= > >= contains in)")
+
+
 def _filter(rows: List[dict], filters) -> List[dict]:
-    """filters: list of (key, predicate-str, value) like the reference's
-    state API ('=' and '!=' supported)."""
+    """filters: list of (key, predicate-str, value) — the reference's
+    state API predicate set: = != < <= > >= plus contains / in."""
     if not filters:
         return rows
-    out = []
-    for row in rows:
-        keep = True
-        for key, op, value in filters:
-            have = row.get(key)
-            if op == "=":
-                keep = keep and (str(have) == str(value))
-            elif op == "!=":
-                keep = keep and (str(have) != str(value))
-            else:
-                raise ValueError(f"unsupported filter op {op!r}")
-        if keep:
-            out.append(row)
-    return out
+    return [row for row in rows
+            if all(_match(row.get(key), op, value)
+                   for key, op, value in filters)]
 
 
 def list_actors(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
@@ -76,10 +99,44 @@ def list_tasks(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
     return _filter(rows, filters)[:limit]
 
 
-def list_objects(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
-    """Objects with known locations in the GCS object directory."""
-    rows = _gcs("list_object_locations", {})
-    return _filter(rows, filters)[:limit]
+def list_objects(filters=None, limit: int = 1000,
+                 detail: bool = False) -> List[Dict[str, Any]]:
+    """Cluster-wide object listing: the GCS object directory (locations,
+    spill URLs) joined with every alive raylet's shm-store table (size,
+    pin count) — `ray list objects` over the DISTRIBUTED object tables,
+    not just the head's view. ``detail=False`` skips the per-raylet
+    sweep and returns the directory only."""
+    directory = {r["object_id"]: dict(r)
+                 for r in _gcs("list_object_locations", {})}
+    if detail:
+        import asyncio
+
+        from ray_tpu.core import rpc
+
+        async def sweep():
+            rows = []
+            for node in _gcs("get_nodes"):
+                if node.get("state") != "ALIVE":
+                    continue
+                try:
+                    host, port = node["address"].rsplit(":", 1)
+                    conn = await rpc.connect(host, int(port), timeout=2.0)
+                    try:
+                        rows.extend(await conn.call(
+                            "list_store_objects", {"limit": limit}))
+                    finally:
+                        await conn.close()
+                except Exception:
+                    continue  # node died mid-sweep: best-effort listing
+            return rows
+
+        for shard in asyncio.run(sweep()):
+            row = directory.setdefault(
+                shard["object_id"], {"object_id": shard["object_id"],
+                                     "node_ids": [shard["node_id"]]})
+            row["size_bytes"] = shard["size_bytes"]
+            row["pins"] = shard.get("pins", 0)
+    return _filter(list(directory.values()), filters)[:limit]
 
 
 def list_placement_groups(filters=None,
