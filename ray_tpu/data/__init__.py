@@ -14,7 +14,8 @@ from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import (DataIterator, Dataset, from_arrow,
                                   from_items, from_numpy, from_pandas,
                                   range, read_binary_files, read_csv,
-                                  read_json, read_numpy, read_parquet,
+                                  read_images, read_json, read_numpy,
+                                  read_parquet,
                                   read_text)
 from ray_tpu.data import preprocessors
 
@@ -31,6 +32,7 @@ __all__ = [
     "read_binary_files",
     "read_csv",
     "read_json",
+    "read_images",
     "read_numpy",
     "read_parquet",
     "read_text",

@@ -72,7 +72,8 @@ class Dataset:
     def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
         def transform(block: Block) -> Block:
             return block_from_items([fn(r) for r in block_to_rows(block)])
-        return self._with(MapStage(f"Map({_name(fn)})", transform))
+        return self._with(MapStage(f"Map({_name(fn)})", transform,
+                                   preserves_rows=True))
 
     def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
         def transform(block: Block) -> Block:
@@ -669,3 +670,11 @@ def read_binary_files(paths) -> Dataset:
 
 def read_numpy(paths, column: str = "data") -> Dataset:
     return Dataset(datasource.numpy_file_tasks(paths, column))
+
+
+def read_images(paths, *, size=None, mode: str = None,
+                include_paths: bool = False) -> Dataset:
+    """Decode images into {'image': ndarray} rows (reference:
+    read_api.py:792 read_images)."""
+    return Dataset(datasource.image_tasks(paths, size=size, mode=mode,
+                                          include_paths=include_paths))

@@ -131,6 +131,41 @@ def binary_tasks(paths) -> List[ReadTask]:
     return [make(f) for f in files]
 
 
+IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".tiff",
+                    ".webp")
+
+
+def image_tasks(paths, size=None, mode: str = None,
+                include_paths: bool = False) -> List[ReadTask]:
+    """Decode image files into {'image': HxWxC uint8 array} rows
+    (reference: read_api.py:792 read_images — PIL decode, optional
+    resize/mode conversion, optional path column). Directories expand to
+    their image files."""
+    files = [f for f in _expand_paths(paths)
+             if f.lower().endswith(IMAGE_EXTENSIONS)]
+    if not files:
+        raise ValueError(f"no image files found under {paths!r}")
+
+    def make(f: str) -> ReadTask:
+        def read() -> Block:
+            from PIL import Image
+
+            with Image.open(f) as img:
+                if mode:
+                    img = img.convert(mode)
+                if size:
+                    img = img.resize(tuple(size))
+                arr = np.asarray(img)
+            # Tensor column (fixed-size list + shape metadata): HxWxC
+            # arrays round-trip through block_to_numpy exactly.
+            cols: Dict[str, Any] = {"image": arr[None]}
+            if include_paths:
+                cols["path"] = np.array([f])
+            return block_from_numpy(cols)
+        return read
+    return [make(f) for f in files]
+
+
 def numpy_file_tasks(paths, column: str = "data") -> List[ReadTask]:
     """One block per .npy file (reference: read_numpy)."""
     files = _expand_paths(paths)

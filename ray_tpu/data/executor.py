@@ -36,6 +36,10 @@ class MapStage:
     fn: Callable[[Block], Block]          # pure block transform
     # "tasks" or ("actors", size, cls_factory); size int or (min, max)
     compute: Any = "tasks"
+    # Row-count preserving (Dataset.map and friends): enables the
+    # limit-pushdown optimizer rule (reference: logical optimizer rules
+    # beyond fusion, _internal/logical/optimizers.py).
+    preserves_rows: bool = False
 
 
 @dataclasses.dataclass
@@ -78,8 +82,56 @@ class LimitStage:
 Stage = Any  # MapStage | AllToAllStage | LimitStage
 
 
+def _push_down_limits(stages: List[Stage]) -> List[Stage]:
+    """Optimizer rule (reference: logical/optimizers.py limit pushdown):
+    a Limit hops BEFORE row-preserving map stages so the rows it would
+    discard are never transformed; adjacent limits collapse to the min.
+    Repartition is row-preserving too, but the limit must stay AFTER it
+    only if output block-count matters — rows don't change, so the limit
+    also hops over repartition-kind shuffles (not sorts: a limit after a
+    sort selects DIFFERENT rows than before it)."""
+    out: List[Stage] = []
+    for st in stages:
+        if isinstance(st, LimitStage):
+            n = st.n
+            hopped: List[Stage] = []
+            while out:
+                prev = out[-1]
+                if isinstance(prev, LimitStage):
+                    n = min(n, prev.n)
+                    out.pop()
+                elif isinstance(prev, MapStage) and prev.preserves_rows:
+                    hopped.append(out.pop())
+                elif isinstance(prev, ShuffleStage) and \
+                        prev.kind == "repartition":
+                    hopped.append(out.pop())
+                else:
+                    break
+            out.append(LimitStage(n))
+            out.extend(reversed(hopped))
+            continue
+        out.append(st)
+    return out
+
+
+def _drop_redundant_shuffles(stages: List[Stage]) -> List[Stage]:
+    """Optimizer rule: consecutive repartitions — only the last one's
+    output layout survives, so earlier ones are wasted exchanges."""
+    out: List[Stage] = []
+    for st in stages:
+        if (isinstance(st, ShuffleStage) and st.kind == "repartition" and
+                out and isinstance(out[-1], ShuffleStage) and
+                out[-1].kind == "repartition"):
+            out.pop()
+        out.append(st)
+    return out
+
+
 def _fuse(stages: List[Stage]) -> List[Stage]:
-    """Fuse runs of task-compute MapStages into single stages."""
+    """Logical optimization: limit pushdown + redundant-shuffle
+    elimination + fusion of adjacent task-compute MapStages (reference:
+    _internal/logical/optimizers.py rule chain)."""
+    stages = _drop_redundant_shuffles(_push_down_limits(stages))
     fused: List[Stage] = []
     for st in stages:
         if (isinstance(st, MapStage) and st.compute == "tasks" and fused
@@ -90,7 +142,9 @@ def _fuse(stages: List[Stage]) -> List[Stage]:
             def composed(block, f1=prev.fn, f2=st.fn):
                 return f2(f1(block))
 
-            fused.append(MapStage(f"{prev.name}->{st.name}", composed))
+            fused.append(MapStage(
+                f"{prev.name}->{st.name}", composed,
+                preserves_rows=prev.preserves_rows and st.preserves_rows))
         else:
             fused.append(st)
     return fused
