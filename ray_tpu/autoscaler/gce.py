@@ -171,8 +171,12 @@ class GCETPUNodeProvider(NodeProvider):
     def terminate_node(self, provider_node_id: str) -> None:
         """Terminating ANY host of a slice deletes the whole slice (a
         partial slice cannot form an ICI mesh). Idempotent across the
-        slice's host ids — the autoscaler iterates per-host."""
-        slice_name = provider_node_id.split("/", 1)[0]
+        slice's host ids — the autoscaler iterates per-host.
+
+        rsplit, not split: real v2 API node names are FULL resource
+        paths (projects/{p}/locations/{zone}/nodes/{id}) — only the
+        trailing /<host-index> is ours."""
+        slice_name = provider_node_id.rsplit("/", 1)[0]
         if slice_name in self._deleted:
             return
         # Mark deleted only on success: a transient API failure must stay
@@ -181,7 +185,7 @@ class GCETPUNodeProvider(NodeProvider):
         self._deleted.add(slice_name)
 
     def node_tags(self, provider_node_id: str) -> Dict[str, str]:
-        slice_name = provider_node_id.split("/", 1)[0]
+        slice_name = provider_node_id.rsplit("/", 1)[0]
         node = self._node_cache.get(slice_name)
         if node is None:  # cache refreshed by non_terminated_nodes
             self.non_terminated_nodes()
