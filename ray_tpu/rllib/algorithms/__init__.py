@@ -1,1 +1,2 @@
-"""Algorithms: PPO, DQN."""
+"""Algorithms: PPO, APPO, IMPALA, DQN, SAC, CQL, BC, MARWIL,
+multi-agent PPO, DreamerV3 (model-based)."""

@@ -1,0 +1,107 @@
+"""DreamerV3 (reference: rllib/algorithms/dreamerv3/) — world-model RL:
+RSSM + imagination-trained actor-critic."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.algorithms.dreamerv3 import (DreamerV3Config,
+                                                SequenceReplay)
+
+
+def test_sequence_replay_shapes_and_wrap():
+    rep = SequenceReplay(capacity_steps=64 * 4, num_envs=4, seed=0)
+    for t in range(100):  # wraps the ring
+        rep.add_batch({"obs": np.full((4, 3), t, np.float32),
+                       "is_first": np.zeros(4, np.float32)})
+    batch = rep.sample(8, 16)
+    assert batch["obs"].shape == (8, 16, 3)
+    # Subsequences are CONTIGUOUS time slices (off-by-one-free ring math).
+    for row in batch["obs"][:, :, 0]:
+        diffs = np.diff(row)
+        assert ((diffs == 1) | (diffs == 1 - 64)).all(), row
+
+
+def test_symlog_roundtrip():
+    from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3Learner
+
+    import jax.numpy as jnp
+
+    x = jnp.array([-100.0, -1.0, 0.0, 0.5, 10.0, 1e4])
+    y = DreamerV3Learner._symexp(DreamerV3Learner._symlog(x))
+    assert np.allclose(np.asarray(y), np.asarray(x), rtol=1e-4)
+
+
+def test_world_model_learns_dynamics():
+    """The RSSM world-model loss must drop sharply on real env data
+    (recon + reward + KL) — the core of the model-based recipe."""
+    config = (DreamerV3Config()
+              .environment("CartPole-v1")
+              .env_runners(num_envs_per_runner=4)
+              .training(env_steps_per_iteration=32,
+                        train_updates_per_iteration=2,
+                        num_steps_before_learning=200)
+              .debugging(seed=2))
+    algo = config.build_algo()
+    first = None
+    last = None
+    for _ in range(30):
+        r = algo.step()
+        if "wm_loss" in r:
+            if first is None:
+                first = r["wm_loss"]
+            last = r["wm_loss"]
+    assert first is not None, "world model never trained"
+    assert last < 0.7 * first, (first, last)
+    # Imagination head produces finite returns and entropy.
+    assert np.isfinite(r["imagined_return"])
+    assert 0.0 < r["actor_entropy"] <= np.log(2) + 1e-3
+    algo.cleanup()
+
+
+def test_dreamer_checkpoint_roundtrip(tmp_path):
+    config = (DreamerV3Config()
+              .environment("CartPole-v1")
+              .env_runners(num_envs_per_runner=2)
+              .training(env_steps_per_iteration=16,
+                        num_steps_before_learning=10_000)
+              .debugging(seed=3))
+    algo = config.build_algo()
+    algo.step()
+    algo.save_checkpoint(str(tmp_path))
+    wm_before = algo.learner.get_state()["wm"]
+
+    algo2 = config.build_algo()
+    algo2.load_checkpoint(str(tmp_path))
+    wm_after = algo2.learner.get_state()["wm"]
+    flat_a = np.concatenate([np.asarray(l["w"]).ravel()
+                             for l in wm_before["enc"]])
+    flat_b = np.concatenate([np.asarray(l["w"]).ravel()
+                             for l in wm_after["enc"]])
+    assert np.allclose(flat_a, flat_b)
+    algo.cleanup()
+    algo2.cleanup()
+
+
+@pytest.mark.slow
+def test_dreamer_learns_cartpole():
+    """Full learning signal (slow: several minutes of CPU imagination
+    training) — kept out of the default suite; the world-model test
+    above guards the components."""
+    config = (DreamerV3Config()
+              .environment("CartPole-v1")
+              .training(train_updates_per_iteration=6, actor_lr=1e-3,
+                        entropy_coeff=1e-3, imagine_horizon=15)
+              .debugging(seed=1))
+    algo = config.build_algo()
+    first = None
+    best = -np.inf
+    for _ in range(150):
+        r = algo.step()
+        ret = r.get("episode_return_mean")
+        if ret:
+            if first is None:
+                first = ret
+            best = max(best, ret)
+    assert first is not None
+    assert best > first + 15, (first, best)
+    algo.cleanup()
