@@ -196,6 +196,9 @@ class GcsServer:
         self.object_locations: Dict[bytes, Set[bytes]] = {}
         self.spilled_objects: Dict[bytes, str] = {}
         self.task_events: List[dict] = []
+        # Structured export events (reference: event.proto + the
+        # dashboard event module): bounded newest-last ring.
+        self.events: List[dict] = []
         # worker_id -> {"metrics": [...], "time": t}
         self.worker_metrics: Dict[bytes, dict] = {}
         # Counters/histograms folded in from dead workers — counter
@@ -388,6 +391,11 @@ class GcsServer:
                     await self._restart_or_kill_actor(
                         actor, "worker lost during GCS downtime")
         await self.publish("nodes", info.view())
+        self._record_event(
+            "gcs", "NODE_ADDED",
+            f"node {node_id.hex()[:8]} registered at {info.address}",
+            metadata={"node_id": node_id.hex(),
+                      "resources": info.resources_total})
         logger.info("node %s registered at %s (resources=%s, slice=%r)",
                     node_id.hex()[:8], info.address, info.resources_total,
                     info.slice_id)
@@ -454,6 +462,10 @@ class GcsServer:
             return
         node.state = DEAD
         self._persist_node(node)
+        self._record_event(
+            "gcs", "NODE_FAILED",
+            f"node {node_id.hex()[:8]} failed: {reason}",
+            severity="ERROR", metadata={"node_id": node_id.hex()})
         logger.warning("node %s failed: %s", node_id.hex()[:8], reason)
         await self.publish("nodes", node.view())
         # Restart or kill actors that lived there (reference:
@@ -510,6 +522,9 @@ class GcsServer:
         if not job or job["state"] == "FINISHED":
             return
         job["state"] = "FINISHED"
+        self._record_event("gcs", "JOB_FINISHED",
+                           f"job {job_id.hex()} finished",
+                           metadata={"job_id": job_id.hex()})
         self.storage.delete("jobs", job_id.binary())
         await self.publish("jobs", {"job_id": job_id.binary(),
                                     "state": "FINISHED"})
@@ -640,6 +655,12 @@ class GcsServer:
             actor.state = RESTARTING
             self._persist_actor(actor)
             await self.publish("actors", actor.view())
+            self._record_event(
+                "gcs", "ACTOR_RESTARTED",
+                f"actor {actor.actor_id.hex()[:8]} restarting "
+                f"({actor.num_restarts}/{actor.max_restarts}): {reason}",
+                severity="WARNING",
+                metadata={"actor_id": actor.actor_id.hex()})
             logger.info("restarting actor %s (%d/%s): %s",
                         actor.actor_id.hex()[:8], actor.num_restarts,
                         actor.max_restarts, reason)
@@ -647,6 +668,11 @@ class GcsServer:
         else:
             actor.state = DEAD
             actor.death_cause = reason
+            self._record_event(
+                "gcs", "ACTOR_DEAD",
+                f"actor {actor.actor_id.hex()[:8]} died: {reason}",
+                severity="ERROR",
+                metadata={"actor_id": actor.actor_id.hex()})
             if actor.name:
                 self.named_actors.pop((actor.namespace, actor.name), None)
             if actor.detached:
@@ -917,6 +943,28 @@ class GcsServer:
         return True
 
     # ------------------------------------------------------------- task events
+    def _record_event(self, source: str, event_type: str, message: str,
+                      severity: str = "INFO", metadata=None) -> None:
+        import time as _time
+
+        self.events.append({
+            "timestamp": _time.time(), "severity": severity,
+            "source": source, "event_type": event_type,
+            "message": message, "pid": 0, "metadata": metadata or {}})
+        if len(self.events) > 10_000:
+            del self.events[:len(self.events) - 10_000]
+
+    async def handle_report_events(self, data, conn) -> bool:
+        for ev in data.get("events", []):
+            self.events.append(ev)
+        if len(self.events) > 10_000:
+            del self.events[:len(self.events) - 10_000]
+        return True
+
+    async def handle_list_events(self, data, conn) -> list:
+        limit = data.get("limit", 1000)
+        return self.events[-limit:]
+
     async def handle_report_task_events(self, data, conn) -> bool:
         self.task_events.extend(data["events"])
         overflow = len(self.task_events) - self.config.task_events_max_buffer

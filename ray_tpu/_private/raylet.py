@@ -400,6 +400,18 @@ class Raylet:
                     "(its task will retry)", frac * 100,
                     self.config.memory_usage_threshold * 100,
                     victim.worker_id.hex()[:12])
+                try:
+                    from ray_tpu.util.events import make_event
+
+                    await self.gcs.call("report_events", {"events": [
+                        make_event("raylet", "WORKER_OOM_KILLED",
+                                   f"worker {victim.worker_id.hex()[:8]} "
+                                   f"killed at {frac:.0%} memory usage",
+                                   severity="WARNING",
+                                   metadata={"node_id":
+                                             self.node_id.hex()})]})
+                except Exception:
+                    pass
                 await self._kill_worker(
                     victim, f"node OOM: memory usage {frac:.2%}")
             except Exception:

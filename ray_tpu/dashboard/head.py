@@ -194,6 +194,14 @@ class DashboardHead:
             return web.json_response(
                 await offload(state.list_placement_groups), dumps=_dumps)
 
+        @routes.get("/api/events")
+        async def events_route(request):
+            """Structured cluster events (reference: dashboard event
+            module over event.proto exports)."""
+            return web.json_response(
+                await offload(self._gcs, "list_events", {"limit": 1000}),
+                dumps=_dumps)
+
         @routes.get("/api/objects")
         async def objects_route(request):
             from ray_tpu.util import state

@@ -84,6 +84,7 @@ INDEX_HTML = """<!doctype html>
   <button data-tab="jobs">Jobs</button>
   <button data-tab="tasks">Tasks</button>
   <button data-tab="timeline">Timeline</button>
+  <button data-tab="events">Events</button>
   <button data-tab="logs">Logs</button>
 </nav>
 <div id="err"></div>
@@ -263,6 +264,16 @@ const views = {
   async timeline() {
     const events = await j('/api/timeline');
     return renderTimeline(events);
+  },
+  async events() {
+    const evs = await j('/api/events');
+    return detailPanel('Event detail', detail) + table([
+      ['time', r => new Date(r.timestamp * 1000).toLocaleTimeString()],
+      ['severity', r => pill(r.severity)],
+      ['source', r => r.source],
+      ['type', r => r.event_type],
+      ['message', r => r.message],
+    ], evs.slice(-500).reverse(), 'showDetail');
   },
   async logs() {
     if (logFile) {

@@ -149,6 +149,12 @@ def cmd_status(args) -> None:
               f"{res['total'][key]:g}")
 
 
+def _list_events(limit=100):
+    from ray_tpu.util.events import list_events
+
+    return list_events(limit=limit)
+
+
 def cmd_list(args) -> None:
     _connect(args.address)
     from ray_tpu.util import state
@@ -160,6 +166,7 @@ def cmd_list(args) -> None:
         "objects": state.list_objects,
         "placement-groups": state.list_placement_groups,
         "jobs": state.list_jobs,
+        "events": _list_events,
     }[args.what]
     rows = fn(limit=args.limit)
     print(json.dumps(rows, indent=2, default=str))
@@ -334,7 +341,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("what", choices=["tasks", "actors", "nodes", "objects",
-                                     "placement-groups", "jobs"])
+                                     "placement-groups", "jobs", "events"])
     sp.add_argument("--limit", type=int, default=100)
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_list)

@@ -1,0 +1,73 @@
+"""Structured cluster events — the export-event framework.
+
+Reference: src/ray/util/event.h + src/ray/protobuf/event.proto + the
+dashboard event module (python/ray/dashboard/modules/event/): control-
+plane components emit severity-labeled structured events (node up/down,
+actor restarts, OOM kills, job transitions, spill activity) that
+operators read from the dashboard and `ray_tpu list events`.
+
+Emission is fire-and-forget from any process with a GCS connection; the
+GCS keeps a bounded ring (events survive the emitting process). Severity
+levels mirror the reference's proto enum.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+DEBUG = "DEBUG"
+INFO = "INFO"
+WARNING = "WARNING"
+ERROR = "ERROR"
+FATAL = "FATAL"
+
+SEVERITIES = (DEBUG, INFO, WARNING, ERROR, FATAL)
+
+
+def make_event(source: str, event_type: str, message: str,
+               severity: str = INFO,
+               metadata: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    if severity not in SEVERITIES:
+        raise ValueError(f"severity must be one of {SEVERITIES}")
+    return {
+        "timestamp": time.time(),
+        "severity": severity,
+        "source": source,          # gcs | raylet | worker | serve | ...
+        "event_type": event_type,  # e.g. NODE_ADDED, ACTOR_RESTARTED
+        "message": message,
+        "pid": os.getpid(),
+        "metadata": metadata or {},
+    }
+
+
+def emit(source: str, event_type: str, message: str,
+         severity: str = INFO,
+         metadata: Optional[Dict[str, Any]] = None) -> None:
+    """Report one event to the GCS (no-op when not connected)."""
+    from ray_tpu._private.worker import global_worker_or_none
+
+    w = global_worker_or_none()
+    if w is None or getattr(w, "core", None) is None:
+        return
+    try:
+        w.gcs_call("report_events", {
+            "events": [make_event(source, event_type, message, severity,
+                                  metadata)]})
+    except Exception:
+        logger.debug("event emission failed", exc_info=True)
+
+
+def list_events(filters=None, limit: int = 1000,
+                severity: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Query the GCS event ring (newest last)."""
+    from ray_tpu.util.state import _filter, _gcs
+
+    rows = _gcs("list_events", {"limit": limit})
+    if severity:
+        rows = [r for r in rows if r.get("severity") == severity]
+    return _filter(rows, filters)[:limit]

@@ -235,3 +235,66 @@ def test_trace_context_propagation(ray_start_regular):
     spans = [e for e in timeline()
              if e.get("args", {}).get("trace_id") == got["outer_trace"]]
     assert len(spans) >= 2, "trace ids missing from timeline args"
+
+
+def test_event_framework(ray_start_cluster):
+    """Export events (reference: event.proto + util/event.h + the
+    dashboard event module): control-plane transitions emit structured
+    severity-labeled events readable via the events API."""
+    import ray_tpu
+    from ray_tpu._private.cluster_utils import Cluster  # noqa: F401
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = ray_start_cluster()
+    n1 = cluster.add_node(resources={"CPU": 2})
+    n2 = cluster.add_node(resources={"CPU": 1})
+    cluster.wait_for_nodes(2)
+    ray_tpu.init(address=cluster.address)
+
+    from ray_tpu.util import events as ev
+
+    rows = ev.list_events()
+    assert sum(1 for r in rows if r["event_type"] == "NODE_ADDED") >= 2
+
+    # Custom emission from any connected process.
+    ev.emit("test", "CUSTOM_THING", "hello events",
+            severity=ev.WARNING, metadata={"k": 1})
+    rows = ev.list_events(severity="WARNING")
+    mine = [r for r in rows if r["event_type"] == "CUSTOM_THING"]
+    assert mine and mine[0]["message"] == "hello events"
+    assert mine[0]["metadata"] == {"k": 1}
+
+    # Actor death emits an ERROR event.
+    @ray_tpu.remote
+    class D:
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    d = D.remote()
+    try:
+        ray_tpu.get(d.die.remote(), timeout=30)
+    except Exception:
+        pass
+    deadline = time.time() + 20
+    dead = []
+    while time.time() < deadline and not dead:
+        dead = [r for r in ev.list_events(severity="ERROR")
+                if r["event_type"] == "ACTOR_DEAD"]
+        time.sleep(0.3)
+    assert dead, "actor death did not emit an event"
+
+    # Node failure emits an ERROR event.
+    cluster.remove_node(n2)
+    deadline = time.time() + 30
+    failed = []
+    while time.time() < deadline and not failed:
+        failed = [r for r in ev.list_events()
+                  if r["event_type"] == "NODE_FAILED"]
+        time.sleep(0.5)
+    assert failed
+    # Filterable through the state predicate set.
+    warns = ev.list_events(filters=[("source", "=", "test")])
+    assert all(r["source"] == "test" for r in warns)
