@@ -154,7 +154,9 @@ def image_tasks(paths, size=None, mode: str = None,
                 if mode:
                     img = img.convert(mode)
                 if size:
-                    img = img.resize(tuple(size))
+                    # API takes (height, width) like the reference's
+                    # read_images; PIL resize wants (width, height).
+                    img = img.resize((size[1], size[0]))
                 arr = np.asarray(img)
             # Tensor column (fixed-size list + shape metadata): HxWxC
             # arrays round-trip through block_to_numpy exactly.

@@ -352,6 +352,8 @@ class DreamerV3Learner:
         start = jax.lax.stop_gradient(feat.reshape(-1, feat.shape[-1]))
         wm_sg = jax.lax.stop_gradient(wm)
 
+        ret_lo_ema, ret_hi_ema = ret_stats
+
         def ac_losses(actor_p, critic_p):
             feats, acts, logps = self._imagine(wm_sg, actor_p, start, k_im)
             rew = self._symexp(self._mlp(wm_sg["rew"], feats)[..., 0])
@@ -374,11 +376,12 @@ class DreamerV3Learner:
             feats_t = feats[:-1]
             acts_t = acts[:-1]
             logps_t = logps[:-1]
-            # percentile normalization of returns (paper)
+            # Percentile return normalization (paper): scale by the EMA
+            # of the 5-95% range, not this batch's (noisier) percentiles.
             lo = jnp.percentile(rets, 5)
             hi = jnp.percentile(rets, 95)
             v_online = self._symexp(self._mlp(critic_p, feats_t)[..., 0])
-            scale = jnp.maximum(1.0, hi - lo)
+            scale = jnp.maximum(1.0, ret_hi_ema - ret_lo_ema)
             adv = (rets - v_online) / scale
             taken_logp = jnp.take_along_axis(
                 logps_t, acts_t[..., None], -1)[..., 0]

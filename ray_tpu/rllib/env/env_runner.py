@@ -176,7 +176,13 @@ class SingleAgentEnvRunner:
             actions = np.asarray(out["actions"])
             next_obs_raw, rewards, terms, truncs = self.env.step(actions)
             done = terms | truncs
-            next_obs = self._obs_peek(next_obs_raw, done)
+            # NEXT_OBS records the CONTINUING-episode view (shifted stack
+            # + final obs) even on done steps: vector envs hand back the
+            # ending episode's final obs here, and bootstrap values must
+            # see the same stack the policy would have (the truncation
+            # bootstrap below uses the identical no-dones peek).
+            next_obs = self._obs_peek(next_obs_raw,
+                                      np.zeros(self.num_envs, bool))
             for i in range(self.num_envs):
                 cols = per_env[i]
                 cols[sb.OBS].append(obs[i])

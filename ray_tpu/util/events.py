@@ -64,10 +64,13 @@ def emit(source: str, event_type: str, message: str,
 
 def list_events(filters=None, limit: int = 1000,
                 severity: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Query the GCS event ring (newest last)."""
+    """Query the GCS event ring (newest last). Filters apply over the
+    FULL ring before the limit — otherwise matching events older than
+    the newest `limit` would be silently dropped."""
     from ray_tpu.util.state import _filter, _gcs
 
-    rows = _gcs("list_events", {"limit": limit})
+    rows = _gcs("list_events", {"limit": 10_000})
     if severity:
         rows = [r for r in rows if r.get("severity") == severity]
-    return _filter(rows, filters)[:limit]
+    rows = _filter(rows, filters)
+    return rows[-limit:]
