@@ -142,9 +142,18 @@ const laneColor = (name) => {
   for (const ch of String(name)) h = (h * 31 + ch.charCodeAt(0)) >>> 0;
   return `hsl(${h % 360} 60% 55%)`;
 };
+let tlWindow = 0;  // seconds of trailing window; 0 = everything
+window.setTlWindow = (s) => { tlWindow = s; refresh(); };
 function renderTimeline(events) {
-  const spans = events.filter(e => e.ph === 'X' && e.dur > 0);
+  let spans = events.filter(e => e.ph === 'X' && e.dur > 0);
   if (!spans.length) return '<p>No task events yet.</p>';
+  if (tlWindow > 0) {
+    let tmax = -Infinity;
+    for (const e of spans) if (e.ts + e.dur > tmax) tmax = e.ts + e.dur;
+    const cut = tmax - tlWindow * 1e6;
+    spans = spans.filter(e => e.ts + e.dur >= cut);
+    if (!spans.length) return '<p>No spans in this window.</p>';
+  }
   // reduce, not spread: >~120k args would overflow the JS call stack
   let t0 = Infinity, t1 = -Infinity;
   for (const e of spans) {
@@ -159,8 +168,13 @@ function renderTimeline(events) {
     lanes.get(key).push(e);
   }
   const width = 100;  // percent
+  const winBtn = (s, label) =>
+    `<button onclick="setTlWindow(${s})" style="margin-left:6px;` +
+    `${tlWindow === s ? 'font-weight:700;' : ''}">${label}</button>`;
   let html = `<div class="tl-axis">${(total / 1e6).toFixed(3)}s total ` +
-    `&middot; ${spans.length} spans &middot; ${lanes.size} workers</div>` +
+    `&middot; ${spans.length} spans &middot; ${lanes.size} workers ` +
+    `&middot; window:${winBtn(0, 'all')}${winBtn(60, '60s')}` +
+    `${winBtn(10, '10s')}</div>` +
     '<div class="tl-wrap"><div class="tl">';
   for (const [key, evs] of lanes) {
     html += `<div class="tl-row"><div class="tl-lane-label">` +

@@ -44,7 +44,8 @@ class GrpcIngress:
 
     def __init__(self, rpc_ingress, loop: asyncio.AbstractEventLoop,
                  host: str = "127.0.0.1", port: int = 0,
-                 request_timeout_s: Optional[float] = 60.0):
+                 request_timeout_s: Optional[float] = 60.0,
+                 tls: Optional[dict] = None):
         import grpc
 
         self._ingress = rpc_ingress
@@ -66,7 +67,24 @@ class GrpcIngress:
                 return None
 
         self._server.add_generic_rpc_handlers((Handler(),))
-        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if tls and tls.get("cert_path") and tls.get("key_path"):
+            # TLS ingress (http_options["grpc_tls"]): server-side certs;
+            # optional client-cert verification via ca_path.
+            with open(tls["key_path"], "rb") as f:
+                key = f.read()
+            with open(tls["cert_path"], "rb") as f:
+                cert = f.read()
+            ca = None
+            if tls.get("ca_path"):
+                with open(tls["ca_path"], "rb") as f:
+                    ca = f.read()
+            creds = grpc.ssl_server_credentials(
+                [(key, cert)], root_certificates=ca,
+                require_client_auth=bool(ca))
+            self.port = self._server.add_secure_port(
+                f"{host}:{port}", creds)
+        else:
+            self.port = self._server.add_insecure_port(f"{host}:{port}")
         if self.port == 0:
             raise OSError(f"grpc ingress failed to bind {host}:{port}")
         self._server.start()

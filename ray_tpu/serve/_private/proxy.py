@@ -172,6 +172,9 @@ class ProxyActor:
         self._port = int(http_options.get("port", 8000))
         # None = wait forever (reference: HTTPOptions.request_timeout_s).
         self._request_timeout_s = http_options.get("request_timeout_s", 60)
+        # Optional TLS for the gRPC ingress:
+        # {"cert_path", "key_path", "ca_path"(opt, enables mTLS)}.
+        self._grpc_tls = http_options.get("grpc_tls")
         self._route_table: Dict[str, dict] = {}
         self._num_requests = 0
         self._ready_evt = threading.Event()
@@ -299,7 +302,8 @@ class ProxyActor:
 
             self._grpc_server = GrpcIngress(
                 ingress, asyncio.get_running_loop(), self._host, 0,
-                request_timeout_s=self._request_timeout_s)
+                request_timeout_s=self._request_timeout_s,
+                tls=getattr(self, "_grpc_tls", None))
             self._grpc_port = self._grpc_server.port
         except Exception:
             logger.exception("grpc ingress unavailable; msgpack-framed "

@@ -76,7 +76,11 @@ class HTTPOptions:
     # End-to-end request bound; on expiry the client gets 504 and the
     # replica slot is released (None = wait forever).
     request_timeout_s: Optional[float] = 60.0
+    # Optional TLS for the gRPC ingress:
+    # {"cert_path", "key_path", "ca_path"(opt -> mTLS)}.
+    grpc_tls: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return {"host": self.host, "port": self.port,
-                "request_timeout_s": self.request_timeout_s}
+                "request_timeout_s": self.request_timeout_s,
+                "grpc_tls": self.grpc_tls}

@@ -706,3 +706,57 @@ def test_grpc_ingress_external_client(ray_start_regular):
         use_bin_type=True), timeout=30), raw=False)
     assert bad["status"] == 2
     serve.shutdown()
+
+
+def test_grpc_ingress_tls(ray_start_regular, tmp_path):
+    """Optional TLS on the gRPC ingress (http_options['grpc_tls'])."""
+    import subprocess
+    import sys
+
+    import grpc as _grpc
+    import msgpack as _msgpack
+
+    key = tmp_path / "key.pem"
+    cert = tmp_path / "cert.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+
+    from ray_tpu import serve
+    from ray_tpu.core.actor import get_actor
+    from ray_tpu.serve._private.common import SERVE_NAMESPACE
+
+    @serve.deployment
+    class Pong:
+        def __call__(self, x):
+            return {"pong": x}
+
+    serve.shutdown()
+    serve.start(http_options={"grpc_tls": {"cert_path": str(cert),
+                                           "key_path": str(key)}})
+    serve.run(Pong.bind(), name="tls_app", route_prefix="/tls_app")
+    proxy = get_actor("SERVE_PROXY", namespace=SERVE_NAMESPACE)
+    address = ray_tpu.get(proxy.grpc_address.remote())
+
+    creds = _grpc.ssl_channel_credentials(cert.read_bytes())
+    channel = _grpc.secure_channel(address, creds)
+    call = channel.unary_unary("/rayserve.ServeAPI/Call")
+    deadline = time.time() + 20
+    while True:
+        reply = _msgpack.unpackb(call(_msgpack.packb(
+            {"schema_version": 1, "app": "tls_app", "payload": 7},
+            use_bin_type=True), timeout=30), raw=False)
+        if reply["status"] == 0 or time.time() > deadline:
+            break
+        time.sleep(0.5)
+    assert reply["status"] == 0 and reply["result"] == {"pong": 7}
+    # Plaintext against the TLS port must fail at the transport.
+    plain = _grpc.insecure_channel(address)
+    with pytest.raises(Exception):
+        plain.unary_unary("/rayserve.ServeAPI/Call")(
+            _msgpack.packb({"schema_version": 1, "app": "tls_app",
+                            "payload": 1}, use_bin_type=True), timeout=5)
+    serve.shutdown()
