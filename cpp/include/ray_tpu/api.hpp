@@ -1,0 +1,75 @@
+// ray_tpu C++ worker API.
+//
+// Reference: cpp/src/ray/api.cc (ray::Init / ray::Put / ray::Get /
+// ray::Task(...).Remote()) — a native-language client of the same
+// cluster a Python driver uses. This implementation speaks the
+// framework's actual wire protocols directly:
+//
+//   - control plane: length-prefixed msgpack rpc (core/rpc.py) to the
+//     GCS (job registration, object locations) and the raylet (worker
+//     leases), then task pushes to leased workers — the same
+//     lease/push flow CoreWorker uses.
+//   - object plane: the C++ shared-memory store (_native/shm_store.cpp)
+//     opened directly; values are written in the framework's
+//     SerializedObject container with a stdlib-pickle payload, so
+//     Python tasks read C++ puts zero-copy and vice versa.
+//   - cross-language calls: tasks name an importable Python function
+//     (module.qualname); the worker resolves it by import when no
+//     pickled definition exists in the function table (the reference's
+//     cross_language descriptor path).
+//
+// Supported value types across the boundary: nil, bool, int64, double,
+// string, bytes — the cross-language scalar set (reference:
+// python/ray/cross_language.py msgpack boundary).
+
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+
+struct Value {
+  enum Kind { NIL, BOOL, INT, FLOAT, STR, BYTES } kind = NIL;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;  // STR and BYTES payload
+
+  static Value Nil() { return Value{}; }
+  static Value Bool(bool v) {
+    Value x; x.kind = BOOL; x.b = v; return x;
+  }
+  static Value Int(int64_t v) {
+    Value x; x.kind = INT; x.i = v; return x;
+  }
+  static Value Float(double v) {
+    Value x; x.kind = FLOAT; x.f = v; return x;
+  }
+  static Value Str(std::string v) {
+    Value x; x.kind = STR; x.s = std::move(v); return x;
+  }
+  static Value Bytes(std::string v) {
+    Value x; x.kind = BYTES; x.s = std::move(v); return x;
+  }
+};
+
+// Connect to a running cluster (gcs_address "host:port"): registers a
+// job, locates this host's raylet + shm store from the GCS node table.
+void Init(const std::string& gcs_address);
+void Shutdown();
+
+// Object store: Put returns the object id (hex) registered with the
+// GCS object directory; Get reads any plain-value object (C++ or
+// Python producer) from the local store.
+std::string Put(const Value& value);
+Value Get(const std::string& object_id_hex, int timeout_ms = 10000);
+
+// Synchronous cross-language task call: leases a worker from the local
+// raylet, pushes a task naming an importable Python function, returns
+// its (plain-value) result. E.g. Call("math.hypot", {3.0, 4.0}).
+Value Call(const std::string& py_function, std::vector<Value> args);
+
+}  // namespace ray_tpu

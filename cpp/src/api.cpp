@@ -1,0 +1,660 @@
+// ray_tpu C++ worker API implementation — see include/ray_tpu/api.hpp.
+//
+// Self-contained: a minimal msgpack encoder/decoder (the subset the
+// control plane uses), a minimal stdlib-pickle encoder/decoder (the
+// plain-value subset the Python side's fast path emits), the rpc
+// framing from core/rpc.py, and the shm store C API from
+// _native/shm_store.cpp (linked in).
+
+#include "ray_tpu/api.hpp"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <map>
+#include <random>
+#include <stdexcept>
+
+// ---- shm store C API (_native/shm_store.cpp) ----
+extern "C" {
+void* shm_store_open(const char* path);
+void shm_store_close(void* h);
+int shm_create(void* h, const uint8_t* id, uint64_t size, uint64_t* offset);
+int shm_seal(void* h, const uint8_t* id);
+int shm_get(void* h, const uint8_t* id, long timeout_ms, uint64_t* offset,
+            uint64_t* size);
+int shm_release(void* h, const uint8_t* id);
+void* shm_store_base(void* h);
+}
+
+namespace ray_tpu {
+namespace {
+
+[[noreturn]] void fail(const std::string& msg) {
+  throw std::runtime_error("ray_tpu: " + msg);
+}
+
+// ------------------------------------------------------------- msgpack
+struct Msg;
+using MsgMap = std::map<std::string, Msg>;
+
+struct Msg {
+  enum Kind { NIL, BOOL, INT, FLOAT, STR, BIN, ARR, MAP } kind = NIL;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;
+  std::vector<Msg> arr;
+  std::shared_ptr<MsgMap> map;
+
+  static Msg Nil() { return Msg{}; }
+  static Msg B(bool v) { Msg m; m.kind = BOOL; m.b = v; return m; }
+  static Msg I(int64_t v) { Msg m; m.kind = INT; m.i = v; return m; }
+  static Msg F(double v) { Msg m; m.kind = FLOAT; m.f = v; return m; }
+  static Msg S(std::string v) {
+    Msg m; m.kind = STR; m.s = std::move(v); return m;
+  }
+  static Msg Bin(std::string v) {
+    Msg m; m.kind = BIN; m.s = std::move(v); return m;
+  }
+  static Msg A(std::vector<Msg> v) {
+    Msg m; m.kind = ARR; m.arr = std::move(v); return m;
+  }
+  static Msg M() {
+    Msg m; m.kind = MAP; m.map = std::make_shared<MsgMap>(); return m;
+  }
+  const Msg* get(const std::string& key) const {
+    if (kind != MAP) return nullptr;
+    auto it = map->find(key);
+    return it == map->end() ? nullptr : &it->second;
+  }
+};
+
+void pack(const Msg& m, std::string& out) {
+  auto put_be32 = [&](uint32_t v) {
+    for (int i = 3; i >= 0; --i) out.push_back(char((v >> (8 * i)) & 0xff));
+  };
+  switch (m.kind) {
+    case Msg::NIL: out.push_back('\xc0'); break;
+    case Msg::BOOL: out.push_back(m.b ? '\xc3' : '\xc2'); break;
+    case Msg::INT: {
+      int64_t v = m.i;
+      if (v >= 0 && v < 128) {
+        out.push_back(char(v));
+      } else if (v < 0 && v >= -32) {
+        out.push_back(char(v));
+      } else {
+        out.push_back('\xd3');  // int64
+        for (int i = 7; i >= 0; --i)
+          out.push_back(char((uint64_t(v) >> (8 * i)) & 0xff));
+      }
+      break;
+    }
+    case Msg::FLOAT: {
+      out.push_back('\xcb');
+      uint64_t bits;
+      memcpy(&bits, &m.f, 8);
+      for (int i = 7; i >= 0; --i)
+        out.push_back(char((bits >> (8 * i)) & 0xff));
+      break;
+    }
+    case Msg::STR: {
+      size_t n = m.s.size();
+      if (n < 32) {
+        out.push_back(char(0xa0 | n));
+      } else {
+        out.push_back('\xdb');
+        put_be32(uint32_t(n));
+      }
+      out += m.s;
+      break;
+    }
+    case Msg::BIN: {
+      out.push_back('\xc6');
+      put_be32(uint32_t(m.s.size()));
+      out += m.s;
+      break;
+    }
+    case Msg::ARR: {
+      size_t n = m.arr.size();
+      if (n < 16) {
+        out.push_back(char(0x90 | n));
+      } else {
+        out.push_back('\xdd');
+        put_be32(uint32_t(n));
+      }
+      for (const auto& e : m.arr) pack(e, out);
+      break;
+    }
+    case Msg::MAP: {
+      size_t n = m.map->size();
+      if (n < 16) {
+        out.push_back(char(0x80 | n));
+      } else {
+        out.push_back('\xdf');
+        put_be32(uint32_t(n));
+      }
+      for (const auto& kv : *m.map) {
+        pack(Msg::S(kv.first), out);
+        pack(kv.second, out);
+      }
+      break;
+    }
+  }
+}
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  uint64_t be(int n) {
+    if (p + n > end) fail("msgpack: truncated");
+    uint64_t v = 0;
+    for (int i = 0; i < n; ++i) v = (v << 8) | *p++;
+    return v;
+  }
+  std::string bytes(size_t n) {
+    if (p + n > end) fail("msgpack: truncated");
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+};
+
+Msg unpack(Reader& r) {
+  if (r.p >= r.end) fail("msgpack: empty");
+  uint8_t c = *r.p++;
+  if (c < 0x80) return Msg::I(c);
+  if (c >= 0xe0) return Msg::I(int8_t(c));
+  if ((c & 0xe0) == 0xa0) return Msg::S(r.bytes(c & 0x1f));
+  if ((c & 0xf0) == 0x90) {
+    std::vector<Msg> a;
+    for (int i = 0; i < (c & 0x0f); ++i) a.push_back(unpack(r));
+    return Msg::A(std::move(a));
+  }
+  if ((c & 0xf0) == 0x80) {
+    Msg m = Msg::M();
+    for (int i = 0; i < (c & 0x0f); ++i) {
+      Msg k = unpack(r);
+      (*m.map)[k.s] = unpack(r);
+    }
+    return m;
+  }
+  switch (c) {
+    case 0xc0: return Msg::Nil();
+    case 0xc2: return Msg::B(false);
+    case 0xc3: return Msg::B(true);
+    case 0xc4: return Msg::Bin(r.bytes(r.be(1)));
+    case 0xc5: return Msg::Bin(r.bytes(r.be(2)));
+    case 0xc6: return Msg::Bin(r.bytes(r.be(4)));
+    case 0xca: {
+      uint32_t bits = uint32_t(r.be(4));
+      float f;
+      memcpy(&f, &bits, 4);
+      return Msg::F(f);
+    }
+    case 0xcb: {
+      uint64_t bits = r.be(8);
+      double f;
+      memcpy(&f, &bits, 8);
+      return Msg::F(f);
+    }
+    case 0xcc: return Msg::I(int64_t(r.be(1)));
+    case 0xcd: return Msg::I(int64_t(r.be(2)));
+    case 0xce: return Msg::I(int64_t(r.be(4)));
+    case 0xcf: return Msg::I(int64_t(r.be(8)));
+    case 0xd0: return Msg::I(int8_t(r.be(1)));
+    case 0xd1: return Msg::I(int16_t(r.be(2)));
+    case 0xd2: return Msg::I(int32_t(r.be(4)));
+    case 0xd3: return Msg::I(int64_t(r.be(8)));
+    case 0xd9: return Msg::S(r.bytes(r.be(1)));
+    case 0xda: return Msg::S(r.bytes(r.be(2)));
+    case 0xdb: return Msg::S(r.bytes(r.be(4)));
+    case 0xdc: {
+      size_t n = r.be(2);
+      std::vector<Msg> a;
+      for (size_t i = 0; i < n; ++i) a.push_back(unpack(r));
+      return Msg::A(std::move(a));
+    }
+    case 0xdd: {
+      size_t n = r.be(4);
+      std::vector<Msg> a;
+      for (size_t i = 0; i < n; ++i) a.push_back(unpack(r));
+      return Msg::A(std::move(a));
+    }
+    case 0xde:
+    case 0xdf: {
+      size_t n = r.be(c == 0xde ? 2 : 4);
+      Msg m = Msg::M();
+      for (size_t i = 0; i < n; ++i) {
+        Msg k = unpack(r);
+        (*m.map)[k.s] = unpack(r);
+      }
+      return m;
+    }
+  }
+  fail("msgpack: unsupported tag");
+}
+
+// ------------------------------------------------------- pickle (plain)
+std::string pickle_value(const Value& v) {
+  std::string out("\x80\x04", 2);  // protocol 4
+  auto put_le32 = [&](uint32_t x) {
+    for (int i = 0; i < 4; ++i) out.push_back(char((x >> (8 * i)) & 0xff));
+  };
+  switch (v.kind) {
+    case Value::NIL: out.push_back('N'); break;
+    case Value::BOOL: out.push_back(v.b ? '\x88' : '\x89'); break;
+    case Value::INT: {
+      if (v.i >= INT32_MIN && v.i <= INT32_MAX) {
+        out.push_back('J');
+        put_le32(uint32_t(int32_t(v.i)));
+      } else {
+        out.push_back('\x8a');  // LONG1
+        out.push_back(8);
+        for (int i = 0; i < 8; ++i)
+          out.push_back(char((uint64_t(v.i) >> (8 * i)) & 0xff));
+      }
+      break;
+    }
+    case Value::FLOAT: {
+      out.push_back('G');  // BINFLOAT: big-endian double
+      uint64_t bits;
+      memcpy(&bits, &v.f, 8);
+      for (int i = 7; i >= 0; --i)
+        out.push_back(char((bits >> (8 * i)) & 0xff));
+      break;
+    }
+    case Value::STR:
+      out.push_back('X');  // BINUNICODE
+      put_le32(uint32_t(v.s.size()));
+      out += v.s;
+      break;
+    case Value::BYTES:
+      out.push_back('B');  // BINBYTES
+      put_le32(uint32_t(v.s.size()));
+      out += v.s;
+      break;
+  }
+  out.push_back('.');
+  return out;
+}
+
+Value unpickle_value(const uint8_t* p, const uint8_t* end) {
+  // Parses the plain-value subset the Python fast path emits
+  // (protocol >=2 from pickle.dumps: FRAME/MEMOIZE wrappers + one
+  // scalar opcode).
+  auto le = [&](int n) {
+    uint64_t v = 0;
+    if (p + n > end) fail("pickle: truncated");
+    for (int i = 0; i < n; ++i) v |= uint64_t(*p++) << (8 * i);
+    return v;
+  };
+  Value out;
+  bool have = false;
+  while (p < end) {
+    uint8_t c = *p++;
+    switch (c) {
+      case 0x80: p++; break;                      // PROTO n
+      case 0x95: le(8); break;                    // FRAME
+      case 0x94: break;                           // MEMOIZE
+      case 'q': p++; break;                       // BINPUT
+      case '.': return have ? out : Value::Nil();  // STOP
+      case 'N': out = Value::Nil(); have = true; break;
+      case 0x88: out = Value::Bool(true); have = true; break;
+      case 0x89: out = Value::Bool(false); have = true; break;
+      case 'J': out = Value::Int(int32_t(le(4))); have = true; break;
+      case 'K': out = Value::Int(uint8_t(le(1))); have = true; break;
+      case 'M': out = Value::Int(uint16_t(le(2))); have = true; break;
+      case 0x8a: {                                // LONG1
+        int n = int(le(1));
+        if (n > 8) fail("pickle: long too wide");
+        uint64_t v = le(n);
+        if (n && (v >> (8 * n - 1)) & 1)          // sign-extend
+          v |= ~uint64_t(0) << (8 * n);
+        out = Value::Int(int64_t(v));
+        have = true;
+        break;
+      }
+      case 'G': {                                 // BINFLOAT (big-endian)
+        uint64_t bits = 0;
+        if (p + 8 > end) fail("pickle: truncated");
+        for (int i = 0; i < 8; ++i) bits = (bits << 8) | *p++;
+        double f;
+        memcpy(&f, &bits, 8);
+        out = Value::Float(f);
+        have = true;
+        break;
+      }
+      case 'X': {                                 // BINUNICODE
+        size_t n = le(4);
+        if (p + n > end) fail("pickle: truncated");
+        out = Value::Str(std::string((const char*)p, n));
+        p += n;
+        have = true;
+        break;
+      }
+      case 0x8c: {                                // SHORT_BINUNICODE
+        size_t n = le(1);
+        out = Value::Str(std::string((const char*)p, n));
+        p += n;
+        have = true;
+        break;
+      }
+      case 'B': {                                 // BINBYTES
+        size_t n = le(4);
+        out = Value::Bytes(std::string((const char*)p, n));
+        p += n;
+        have = true;
+        break;
+      }
+      case 0xc4: {                                // SHORT_BINBYTES
+        size_t n = le(1);
+        out = Value::Bytes(std::string((const char*)p, n));
+        p += n;
+        have = true;
+        break;
+      }
+      default:
+        fail("pickle: unsupported opcode (only plain scalars cross the "
+             "C++ boundary)");
+    }
+  }
+  fail("pickle: missing STOP");
+}
+
+// SerializedObject container (core/serialization.py): zero buffers.
+std::string container_wrap(const std::string& meta) {
+  std::string out;
+  uint32_t nbuf = 0;
+  uint64_t mlen = meta.size();
+  out.append((const char*)&nbuf, 4);
+  out.append((const char*)&mlen, 8);
+  out += meta;
+  uint32_t trailer = 0;
+  out.append((const char*)&trailer, 4);
+  return out;
+}
+
+Value container_unwrap(const uint8_t* p, uint64_t size) {
+  if (size < 16) fail("container: too small");
+  uint32_t nbuf;
+  uint64_t mlen;
+  memcpy(&nbuf, p, 4);
+  memcpy(&mlen, p + 4, 8);
+  if (nbuf != 0) fail("object has tensor buffers (not a plain value)");
+  if (12 + mlen + 4 > size) fail("container: truncated");
+  return unpickle_value(p + 12, p + 12 + mlen);
+}
+
+// ------------------------------------------------------------- rpc conn
+class Rpc {
+ public:
+  Rpc(const std::string& host, int port) {
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) fail("socket()");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(uint16_t(port));
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      fail("bad address " + host);
+    if (connect(fd_, (sockaddr*)&addr, sizeof(addr)) != 0)
+      fail("connect to " + host + ":" + std::to_string(port));
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+  ~Rpc() {
+    if (fd_ >= 0) close(fd_);
+  }
+
+  Msg call(const std::string& method, const Msg& data) {
+    // frame := u32le len | msgpack [REQUEST=0, msgid, method, data]
+    std::string payload;
+    pack(Msg::A({Msg::I(0), Msg::I(++msgid_), Msg::S(method), data}),
+         payload);
+    uint32_t len = uint32_t(payload.size());
+    std::string frame((const char*)&len, 4);
+    frame += payload;
+    write_all(frame);
+    for (;;) {
+      std::string reply = read_frame();
+      Reader r{(const uint8_t*)reply.data(),
+               (const uint8_t*)reply.data() + reply.size()};
+      Msg m = unpack(r);
+      if (m.kind != Msg::ARR || m.arr.empty()) fail("rpc: bad frame");
+      int64_t kind = m.arr[0].i;
+      if (kind == 1 && m.arr[1].i == msgid_) return m.arr[2];  // RESPONSE
+      if (kind == 3 && m.arr[1].i == msgid_)                   // ERROR
+        fail("rpc error from " + method + ": " + m.arr[2].s);
+      // NOTIFY or stale response: skip.
+    }
+  }
+
+ private:
+  void write_all(const std::string& buf) {
+    size_t off = 0;
+    while (off < buf.size()) {
+      ssize_t n = ::write(fd_, buf.data() + off, buf.size() - off);
+      if (n <= 0) fail("rpc write");
+      off += size_t(n);
+    }
+  }
+  std::string read_frame() {
+    uint8_t hdr[4];
+    read_exact(hdr, 4);
+    uint32_t len;
+    memcpy(&len, hdr, 4);
+    std::string out(len, '\0');
+    read_exact((uint8_t*)out.data(), len);
+    return out;
+  }
+  void read_exact(uint8_t* p, size_t n) {
+    while (n) {
+      ssize_t r = ::read(fd_, p, n);
+      if (r <= 0) fail("rpc read (connection lost)");
+      p += r;
+      n -= size_t(r);
+    }
+  }
+  int fd_ = -1;
+  int64_t msgid_ = 0;
+};
+
+// ------------------------------------------------------------- globals
+struct State {
+  std::unique_ptr<Rpc> gcs;
+  std::unique_ptr<Rpc> raylet;
+  void* store = nullptr;
+  std::string job_id;   // 4 bytes
+  std::string node_id;  // 16 bytes
+  std::mt19937_64 rng{std::random_device{}()};
+  std::string rand_bytes(size_t n) {
+    std::string out(n, '\0');
+    for (auto& c : out) c = char(rng() & 0xff);
+    return out;
+  }
+};
+State* g = nullptr;
+
+std::pair<std::string, int> split_addr(const std::string& addr) {
+  auto pos = addr.rfind(':');
+  if (pos == std::string::npos) fail("bad address " + addr);
+  return {addr.substr(0, pos), std::stoi(addr.substr(pos + 1))};
+}
+
+std::string to_hex(const std::string& b) {
+  static const char* d = "0123456789abcdef";
+  std::string out;
+  for (unsigned char c : b) {
+    out.push_back(d[c >> 4]);
+    out.push_back(d[c & 15]);
+  }
+  return out;
+}
+
+std::string from_hex(const std::string& h) {
+  std::string out;
+  for (size_t i = 0; i + 1 < h.size(); i += 2)
+    out.push_back(char(std::stoi(h.substr(i, 2), nullptr, 16)));
+  return out;
+}
+
+}  // namespace
+
+void Init(const std::string& gcs_address) {
+  if (g) fail("Init called twice");
+  g = new State();
+  auto [ghost, gport] = split_addr(gcs_address);
+  g->gcs = std::make_unique<Rpc>(ghost, gport);
+  Msg reg = Msg::M();
+  (*reg.map)["driver_address"] = Msg::S("cpp-client");
+  Msg jr = g->gcs->call("register_job", reg);
+  const Msg* jid = jr.get("job_id");
+  if (!jid) fail("register_job gave no job id");
+  g->job_id = jid->s;
+  // Locate this host's raylet + store from the node table.
+  Msg nodes = g->gcs->call("get_nodes", Msg::Nil());
+  for (const auto& n : nodes.arr) {
+    const Msg* state = n.get("state");
+    if (!state || state->s != "ALIVE") continue;
+    g->node_id = n.get("node_id")->s;
+    auto [rhost, rport] = split_addr(n.get("address")->s);
+    g->raylet = std::make_unique<Rpc>(rhost, rport);
+    g->store = shm_store_open(n.get("store_path")->s.c_str());
+    if (!g->store) fail("shm store open failed");
+    break;
+  }
+  if (!g->raylet) fail("no ALIVE node in the GCS node table");
+}
+
+void Shutdown() {
+  if (!g) return;
+  if (g->store) shm_store_close(g->store);
+  delete g;
+  g = nullptr;
+}
+
+std::string Put(const Value& value) {
+  if (!g) fail("Init first");
+  std::string blob = container_wrap(pickle_value(value));
+  std::string oid = g->rand_bytes(20);  // fresh task-id namespace
+  oid += std::string(4, '\0');          // return index 0
+  uint64_t offset = 0;
+  if (shm_create(g->store, (const uint8_t*)oid.data(), blob.size(),
+                 &offset) != 0)
+    fail("shm create failed (store full?)");
+  memcpy((char*)shm_store_base(g->store) + offset, blob.data(),
+         blob.size());
+  if (shm_seal(g->store, (const uint8_t*)oid.data()) != 0)
+    fail("shm seal failed");
+  Msg loc = Msg::M();
+  (*loc.map)["object_id"] = Msg::Bin(oid);
+  (*loc.map)["node_id"] = Msg::Bin(g->node_id);
+  g->gcs->call("add_object_location", loc);
+  return to_hex(oid);
+}
+
+Value Get(const std::string& object_id_hex, int timeout_ms) {
+  if (!g) fail("Init first");
+  std::string oid = from_hex(object_id_hex);
+  uint64_t offset = 0, size = 0;
+  if (shm_get(g->store, (const uint8_t*)oid.data(), timeout_ms, &offset,
+              &size) != 0)
+    fail("object not found in local store: " + object_id_hex);
+  Value v = container_unwrap(
+      (const uint8_t*)shm_store_base(g->store) + offset, size);
+  shm_release(g->store, (const uint8_t*)oid.data());
+  return v;
+}
+
+Value Call(const std::string& py_function, std::vector<Value> args) {
+  if (!g) fail("Init first");
+  auto dot = py_function.rfind('.');
+  if (dot == std::string::npos)
+    fail("py_function must be module.qualname, got " + py_function);
+  std::string module = py_function.substr(0, dot);
+  std::string qualname = py_function.substr(dot + 1);
+  // 1. lease a worker from the local raylet (the CoreWorker flow).
+  std::string lease_id = g->rand_bytes(16);
+  Msg lease = Msg::M();
+  (*lease.map)["lease_id"] = Msg::Bin(lease_id);
+  Msg res = Msg::M();
+  (*res.map)["CPU"] = Msg::F(1.0);
+  (*lease.map)["resources"] = res;
+  (*lease.map)["pg_id"] = Msg::Nil();
+  (*lease.map)["pg_bundle"] = Msg::I(-1);
+  (*lease.map)["job_id"] = Msg::Bin(g->job_id);
+  (*lease.map)["num_spillbacks"] = Msg::I(0);
+  Msg grant = g->raylet->call("request_worker_lease", lease);
+  const Msg* waddr = grant.get("worker_address");
+  if (!waddr) {
+    const Msg* err = grant.get("error");
+    fail("lease failed: " + (err ? err->s : std::string("no grant")));
+  }
+  // 2. push the task spec to the leased worker.
+  std::string task_id = g->rand_bytes(16) + g->job_id;  // 20 bytes
+  Msg spec = Msg::M();
+  (*spec.map)["task_id"] = Msg::Bin(task_id);
+  (*spec.map)["job_id"] = Msg::Bin(g->job_id);
+  (*spec.map)["task_type"] = Msg::I(0);
+  (*spec.map)["function"] =
+      Msg::A({Msg::S(module), Msg::S(qualname), Msg::Bin("")});
+  std::vector<Msg> wire_args;
+  for (const auto& a : args)
+    wire_args.push_back(Msg::A({Msg::I(0),  // ARG_VALUE
+                                Msg::Bin(container_wrap(pickle_value(a))),
+                                Msg::Nil()}));
+  (*spec.map)["args"] = Msg::A(std::move(wire_args));
+  (*spec.map)["num_returns"] = Msg::I(1);
+  Msg sres = Msg::M();
+  (*sres.map)["CPU"] = Msg::F(1.0);
+  (*spec.map)["resources"] = sres;
+  (*spec.map)["caller_address"] = Msg::S("");
+  (*spec.map)["name"] = Msg::S("cpp:" + py_function);
+  auto [whost, wport] = split_addr(waddr->s);
+  Value out;
+  try {
+    Rpc worker(whost, wport);
+    Msg push = Msg::M();
+    (*push.map)["task"] = spec;
+    Msg reply = worker.call("push_task", push);
+    const Msg* status = reply.get("status");
+    const Msg* returns = reply.get("returns");
+    if (!status || status->s != "ok") {
+      std::string detail = "task failed";
+      if (returns && !returns->arr.empty()) {
+        // Error envelope: a pickled exception we can't parse — surface
+        // the status only.
+        detail = "task raised (see worker logs)";
+      }
+      const Msg* err = reply.get("error");
+      if (err) detail = err->s;
+      fail(detail);
+    }
+    if (!returns || returns->arr.empty())
+      fail("task returned nothing");
+    const Msg& inline_val = returns->arr[0].arr[1];
+    if (inline_val.kind == Msg::NIL)
+      fail("return landed in plasma (too large for the C++ boundary)");
+    out = container_unwrap((const uint8_t*)inline_val.s.data(),
+                           inline_val.s.size());
+  } catch (...) {
+    Msg ret = Msg::M();
+    (*ret.map)["lease_id"] = Msg::Bin(lease_id);
+    try {
+      g->raylet->call("return_worker", ret);
+    } catch (...) {
+    }
+    throw;
+  }
+  // 3. give the worker back.
+  Msg ret = Msg::M();
+  (*ret.map)["lease_id"] = Msg::Bin(lease_id);
+  g->raylet->call("return_worker", ret);
+  return out;
+}
+
+}  // namespace ray_tpu

@@ -497,6 +497,12 @@ int shm_contains(void* handle, const uint8_t* id) {
   return present;
 }
 
+// Base pointer of the mapped arena: offsets from shm_create/shm_get are
+// relative to this (the C++ worker API writes/reads objects directly).
+void* shm_store_base(void* handle) {
+  return reinterpret_cast<Handle*>(handle)->base;
+}
+
 int shm_stats(void* handle, uint64_t* used, uint64_t* capacity,
               uint64_t* num_objects, uint64_t* num_evictions) {
   Handle* st = reinterpret_cast<Handle*>(handle);

@@ -1852,7 +1852,8 @@ class CoreWorker:
                 blob = self._sync_gcs_call(
                     "kv_get", {"ns": b"fn", "key": spec.function.function_key})
                 fn = self.function_manager.load(spec.function, blob)
-            key = spec.function.function_key
+            key = spec.function.function_key or \
+                (spec.function.module, spec.function.qualname)
             iscoro = self._fl_coro_cache.get(key)
             if iscoro is None:
                 import inspect

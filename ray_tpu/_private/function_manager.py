@@ -52,11 +52,26 @@ class FunctionManager:
         return self.load(descriptor, blob)
 
     def get_cached(self, descriptor: FunctionDescriptor) -> Any:
+        if not descriptor.function_key:
+            # Cross-language descriptors share the empty key: caching
+            # under it would collide across functions.
+            return None
         with self._lock:
             return self._cache.get(descriptor.function_key)
 
     def load(self, descriptor: FunctionDescriptor, blob: bytes) -> Any:
         if blob is None:
+            # Cross-language path (reference: cross_language.py function
+            # descriptors): no pickled definition exists — resolve the
+            # IMPORTABLE name instead. Same trust domain as pickled
+            # functions (anything submitting tasks already runs code).
+            if not descriptor.function_key and descriptor.module:
+                import importlib
+
+                obj: Any = importlib.import_module(descriptor.module)
+                for part in descriptor.qualname.split("."):
+                    obj = getattr(obj, part)
+                return obj
             raise RuntimeError(
                 f"function {descriptor.display()} not found in GCS "
                 f"function table (key={descriptor.function_key.hex()})")

@@ -1,0 +1,76 @@
+"""C++ worker API (reference: cpp/src/ray/api.cc): a native client of
+the live cluster — object store put/get via shm, cross-language task
+calls into importable Python, and Python reading C++-written objects."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN = "/tmp/ray_tpu_cpp_demo_test"
+
+
+def _build() -> str:
+    srcs = [os.path.join(REPO, "cpp", "example", "demo.cpp"),
+            os.path.join(REPO, "cpp", "src", "api.cpp"),
+            os.path.join(REPO, "ray_tpu", "_native", "shm_store.cpp")]
+    newest = max(os.path.getmtime(s) for s in srcs)
+    if not os.path.exists(BIN) or os.path.getmtime(BIN) < newest:
+        proc = subprocess.run(
+            ["g++", "-std=c++17", "-O2", "-Wall",
+             "-I", os.path.join(REPO, "cpp", "include"),
+             "-o", BIN] + srcs + ["-lpthread"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+    return BIN
+
+
+def test_cpp_worker_api(ray_start_regular):
+    binary = _build()
+    addr = ray_tpu.get_runtime_context().gcs_address
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run([binary, addr], capture_output=True, text=True,
+                          timeout=120, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-1000:])
+    out = proc.stdout
+    assert "PUT_GET ok" in out
+    assert "CALL_HYPOT ok 5.0" in out
+    assert "CALL_LEN ok 4" in out
+    assert "BIG_INT ok" in out
+    assert "DONE" in out
+
+    # Cross-language object read: Python gets the C++ put zero-copy.
+    oid = [ln.split()[1] for ln in out.splitlines()
+           if ln.startswith("OBJECT_ID")][0]
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_ref import ObjectRef
+
+    val = ray_tpu.get(ObjectRef(ObjectID(bytes.fromhex(oid))), timeout=30)
+    assert val == "hello from c++"
+
+    # And the reverse: a Python put consumed by C++ Get is covered by
+    # the cross-language CALL results above (worker pickles, C++ reads).
+
+
+def test_cross_language_descriptor_python_side(ray_start_regular):
+    """The import-by-name descriptor path works from Python too (empty
+    function key -> importable resolution on the worker)."""
+    from ray_tpu.core.task_spec import FunctionDescriptor
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    desc = FunctionDescriptor(module="math", qualname="factorial",
+                              function_key=b"")
+    [ref] = w.core.submit_task_sync(desc, (6,), {}, {"num_returns": 1})
+    assert ray_tpu.get(ref, timeout=30) == 720
+    # Two distinct cross-language functions must not collide in caches.
+    desc2 = FunctionDescriptor(module="math", qualname="floor",
+                               function_key=b"")
+    [ref2] = w.core.submit_task_sync(desc2, (3.7,), {},
+                                     {"num_returns": 1})
+    assert ray_tpu.get(ref2, timeout=30) == 3
