@@ -313,7 +313,8 @@ Value unpickle_value(const uint8_t* p, const uint8_t* end) {
         int n = int(le(1));
         if (n > 8) fail("pickle: long too wide");
         uint64_t v = le(n);
-        if (n && (v >> (8 * n - 1)) & 1)          // sign-extend
+        // Sign-extend; n==8 is already full-width (<<64 would be UB).
+        if (n > 0 && n < 8 && (v >> (8 * n - 1)) & 1)
           v |= ~uint64_t(0) << (8 * n);
         out = Value::Int(int64_t(v));
         have = true;
