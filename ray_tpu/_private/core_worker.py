@@ -372,10 +372,14 @@ class CoreWorker:
         self._fast_keys.clear()
         if self._fl_server is not None:
             self._fl_server.shutdown()
+            # Short join: dispatchers wake from next() within ~ms of
+            # shutdown; one mid-execution user task shouldn't add
+            # seconds to every (SIGTERM'd) worker teardown — the native
+            # server is leaked in that case, and the process is exiting.
             for t in self._fl_dispatchers:
-                t.join(timeout=0.5)
+                t.join(timeout=0.1)
             if all(not t.is_alive() for t in self._fl_dispatchers):
-                self._fl_server.close()  # else: leak it — process is exiting
+                self._fl_server.close()
         for conn in list(self._peer_conns.values()):
             await conn.close()
         if self._server:
