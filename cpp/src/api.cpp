@@ -12,6 +12,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/utsname.h>
 #include <unistd.h>
 
 #include <cstring>
@@ -340,6 +341,7 @@ Value unpickle_value(const uint8_t* p, const uint8_t* end) {
       }
       case 0x8c: {                                // SHORT_BINUNICODE
         size_t n = le(1);
+        if (p + n > end) fail("pickle: truncated");
         out = Value::Str(std::string((const char*)p, n));
         p += n;
         have = true;
@@ -347,6 +349,7 @@ Value unpickle_value(const uint8_t* p, const uint8_t* end) {
       }
       case 'B': {                                 // BINBYTES
         size_t n = le(4);
+        if (p + n > end) fail("pickle: truncated");
         out = Value::Bytes(std::string((const char*)p, n));
         p += n;
         have = true;
@@ -354,6 +357,7 @@ Value unpickle_value(const uint8_t* p, const uint8_t* end) {
       }
       case 0xc4: {                                // SHORT_BINBYTES
         size_t n = le(1);
+        if (p + n > end) fail("pickle: truncated");
         out = Value::Bytes(std::string((const char*)p, n));
         p += n;
         have = true;
@@ -427,9 +431,10 @@ class Rpc {
       Msg m = unpack(r);
       if (m.kind != Msg::ARR || m.arr.empty()) fail("rpc: bad frame");
       int64_t kind = m.arr[0].i;
-      if (kind == 1 && m.arr[1].i == msgid_) return m.arr[2];  // RESPONSE
-      if (kind == 3 && m.arr[1].i == msgid_)                   // ERROR
-        fail("rpc error from " + method + ": " + m.arr[2].s);
+      if (kind == 1 && m.arr.size() >= 3 && m.arr[1].i == msgid_)
+        return m.arr[2];                                       // RESPONSE
+      if (kind == 3 && m.arr.size() >= 3 && m.arr[1].i == msgid_)
+        fail("rpc error from " + method + ": " + m.arr[2].s);  // ERROR
       // NOTIFY or stale response: skip.
     }
   }
@@ -516,19 +521,29 @@ void Init(const std::string& gcs_address) {
   const Msg* jid = jr.get("job_id");
   if (!jid) fail("register_job gave no job id");
   g->job_id = jid->s;
-  // Locate this host's raylet + store from the node table.
+  // Locate THIS HOST's raylet + store from the node table (match by
+  // hostname; Put/Get touch the local shm arena and locations must be
+  // registered under the node that actually holds them).
+  char hostbuf[256] = {0};
+  gethostname(hostbuf, sizeof(hostbuf) - 1);
   Msg nodes = g->gcs->call("get_nodes", Msg::Nil());
+  const Msg* chosen = nullptr;
   for (const auto& n : nodes.arr) {
     const Msg* state = n.get("state");
     if (!state || state->s != "ALIVE") continue;
-    g->node_id = n.get("node_id")->s;
-    auto [rhost, rport] = split_addr(n.get("address")->s);
-    g->raylet = std::make_unique<Rpc>(rhost, rport);
-    g->store = shm_store_open(n.get("store_path")->s.c_str());
-    if (!g->store) fail("shm store open failed");
-    break;
+    const Msg* hn = n.get("hostname");
+    if (hn && hn->s == hostbuf) {
+      chosen = &n;
+      break;
+    }
+    if (!chosen) chosen = &n;  // fallback: first ALIVE (single-node)
   }
-  if (!g->raylet) fail("no ALIVE node in the GCS node table");
+  if (!chosen) fail("no ALIVE node in the GCS node table");
+  g->node_id = chosen->get("node_id")->s;
+  auto [rhost, rport] = split_addr(chosen->get("address")->s);
+  g->raylet = std::make_unique<Rpc>(rhost, rport);
+  g->store = shm_store_open(chosen->get("store_path")->s.c_str());
+  if (!g->store) fail("shm store open failed (is this host in the cluster?)");
 }
 
 void Shutdown() {
@@ -635,7 +650,8 @@ Value Call(const std::string& py_function, std::vector<Value> args) {
       if (err) detail = err->s;
       fail(detail);
     }
-    if (!returns || returns->arr.empty())
+    if (!returns || returns->arr.empty() ||
+        returns->arr[0].kind != Msg::ARR || returns->arr[0].arr.size() < 2)
       fail("task returned nothing");
     const Msg& inline_val = returns->arr[0].arr[1];
     if (inline_val.kind == Msg::NIL)

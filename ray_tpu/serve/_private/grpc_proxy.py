@@ -67,6 +67,15 @@ class GrpcIngress:
                 return None
 
         self._server.add_generic_rpc_handlers((Handler(),))
+        if tls:
+            unknown = set(tls) - {"cert_path", "key_path", "ca_path"}
+            if unknown or not (tls.get("cert_path") and
+                               tls.get("key_path")):
+                # A present-but-broken TLS config must NEVER silently
+                # downgrade to plaintext.
+                raise ValueError(
+                    "grpc_tls requires cert_path and key_path "
+                    f"(got keys {sorted(tls)}; unknown: {sorted(unknown)})")
         if tls and tls.get("cert_path") and tls.get("key_path"):
             # TLS ingress (http_options["grpc_tls"]): server-side certs;
             # optional client-cert verification via ca_path.
