@@ -94,8 +94,19 @@ class _FastKey:
         self.deact_scheduled = False
 
     def submit_spec(self, spec: TaskSpec) -> bool:
-        return self.channel.submit_batched(spec.to_wire(),
-                                           ("task", spec, self.key))
+        wire = spec.to_wire()
+        if any(kind == ARG_REF for kind, _p, _o in spec.args):
+            # A dependent task must NEVER share a batch with the task
+            # producing its argument: the batch reply (which delivers
+            # the dependency's result to this driver) is only sent once
+            # EVERY task in the batch finishes — the dependent task
+            # would wait on a result its own batch withholds. Flush the
+            # buffer (upstream results travel first) and send solo.
+            self.channel.flush()
+            return self.channel.submit(
+                msgpack.packb({"task": wire}, use_bin_type=True),
+                ("task", spec, self.key))
+        return self.channel.submit_batched(wire, ("task", spec, self.key))
 
 
 class _SchedulingKeyState:

@@ -248,3 +248,23 @@ class TestRuntimeContext:
         assert ctx.node_id is not None
         res = ctx.cluster_resources()
         assert res["total"].get("CPU", 0) >= 4
+
+
+class TestFastlaneBatching:
+    def test_ref_chain_under_batching_pressure(self, ray_start_regular):
+        """Regression (round-4 deadlock): a dependent task co-batched
+        with its dependency waits on a result its own batch reply
+        withholds. Ref-bearing specs must never share a batch — this
+        hung the full suite before the fix. Keeps the fastlane busy so
+        submissions buffer, then races dependency chains through it."""
+        for _ in range(10):
+            # Saturate the channel so new submissions batch together...
+            noise = [add.remote(i, i) for i in range(64)]
+            # ...and immediately submit chains whose args are pending.
+            a = add.remote(1, 1)
+            b = add.remote(a, 1)
+            c = add.remote(b, b)
+            d = add.remote(c, a)
+            assert ray_tpu.get(d, timeout=60) == 8
+            assert ray_tpu.get(noise, timeout=60) == \
+                [2 * i for i in range(64)]
