@@ -95,13 +95,18 @@ class _FastKey:
 
     def submit_spec(self, spec: TaskSpec) -> bool:
         wire = spec.to_wire()
-        if any(kind == ARG_REF for kind, _p, _o in spec.args):
-            # A dependent task must NEVER share a batch with the task
-            # producing its argument: the batch reply (which delivers
-            # the dependency's result to this driver) is only sent once
-            # EVERY task in the batch finishes — the dependent task
-            # would wait on a result its own batch withholds. Flush the
-            # buffer (upstream results travel first) and send solo.
+        if spec.is_streaming or \
+                any(kind == ARG_REF for kind, _p, _o in spec.args):
+            # Solo frame, not batched:
+            # - A dependent task must NEVER share a batch with the task
+            #   producing its argument: the batch reply (which delivers
+            #   the dependency's result to this driver) is only sent
+            #   once EVERY task in the batch finishes — the dependent
+            #   task would wait on a result its own batch withholds.
+            # - A streaming task blocks its dispatcher until the stream
+            #   ends; co-batched tasks behind an unbounded generator
+            #   would never reply.
+            # Flush first so upstream results travel ahead.
             self.channel.flush()
             return self.channel.submit(
                 msgpack.packb({"task": wire}, use_bin_type=True),
