@@ -748,17 +748,36 @@ class Raylet:
 
     async def handle_request_worker_lease(self, data, conn) -> dict:
         req = LeaseRequest(data)
+        if os.environ.get("RAY_TPU_TRACE_LEASES"):
+            logger.info(
+                "LEASE req=%s res=%s spills=%d avail=%s queue=%d view=%s",
+                req.lease_id.hex()[:6], req.resources, req.num_spillbacks,
+                self.available, len(self.lease_queue),
+                [(n["node_id"].hex()[:6], n["resources_available"])
+                 for n in self.cluster_view])
         if not self._feasible_ever(req):
             target = self._find_spillback_target(req, require_available=False)
             if target:
                 return {"spillback": target}
             # No capable node *yet*: queue — reference semantics are that
             # infeasible tasks stay pending until resources appear.
-        # Hybrid spillback: local under pressure, someone else has room now.
-        if not self._can_grant_now(req) and req.num_spillbacks < 3:
+        # Hybrid spillback: local under pressure, someone else has room
+        # now. "Pressure" counts requests already QUEUED ahead of this
+        # one (reference: ClusterTaskManager accounts allocated AND
+        # queued demand) — without that, a burst arriving before the
+        # first grant deducts resources sees stale availability and
+        # serializes locally instead of spreading.
+        if not self._can_grant_now(req, include_queued=True) and \
+                req.num_spillbacks < 3:
             target = self._find_spillback_target(req, require_available=True)
             if target and target != self.address:
+                if os.environ.get("RAY_TPU_TRACE_LEASES"):
+                    logger.info("LEASE req=%s SPILL -> %s",
+                                req.lease_id.hex()[:6], target)
                 return {"spillback": target}
+        if os.environ.get("RAY_TPU_TRACE_LEASES"):
+            logger.info("LEASE req=%s QUEUE locally",
+                        req.lease_id.hex()[:6])
         self.lease_queue.append(req)
         self._drain_queue()
         granted = await req.grant_fut
@@ -786,12 +805,45 @@ class Raylet:
                 _fits(req.resources, pool["reserved"])
         return _fits(req.resources, self.resources_total)
 
-    def _can_grant_now(self, req: LeaseRequest) -> bool:
+    def _can_grant_now(self, req: LeaseRequest,
+                       include_queued: bool = False) -> bool:
         pool = self._bundle_pool(req)
         if req.pg_id is not None:
             return pool is not None and pool["committed"] and \
                 _fits(req.resources, pool["available"])
-        return _fits(req.resources, self.available)
+        avail = self.available
+        if include_queued:
+            queued = {}
+            for r in self.lease_queue:
+                if r is not req and not r.grant_fut.done() and \
+                        r.pg_id is None:
+                    for k, v in r.resources.items():
+                        queued[k] = queued.get(k, 0) + v
+            if queued:
+                avail = {k: v - queued.get(k, 0)
+                         for k, v in avail.items()}
+        return _fits(req.resources, avail)
+
+    def _debited_available(self, n: dict) -> dict:
+        """Node availability minus this raylet's recent spillback debits.
+
+        Spilling deducts optimistically so back-to-back decisions fan
+        out — but a cluster_view broadcast REPLACES the cached view,
+        and one captured before the spilled request landed at its
+        target resurrects the stale availability (observed: 3 held
+        tasks landing on 2 nodes). Debits live in an overlay with a
+        short TTL (long enough for the target's own grant to reach the
+        next broadcast) so they survive view refreshes."""
+        now = time.monotonic()
+        self._spill_debits = [(exp, nid, res) for exp, nid, res in
+                              getattr(self, "_spill_debits", [])
+                              if exp > now]
+        avail = dict(n["resources_available"])
+        for _exp, nid, res in self._spill_debits:
+            if nid == n["node_id"]:
+                for k, v in res.items():
+                    avail[k] = avail.get(k, 0) - v
+        return avail
 
     def _find_spillback_target(self, req: LeaseRequest,
                                require_available: bool) -> Optional[str]:
@@ -801,21 +853,18 @@ class Raylet:
         for n in self.cluster_view:
             if n["node_id"] == self.node_id.binary():
                 continue
-            pool = n["resources_available"] if require_available \
-                else n["resources_total"]
+            avail = self._debited_available(n)
+            pool = avail if require_available else n["resources_total"]
             if _fits(req.resources, pool):
-                score = sum(n["resources_available"].values())
+                score = sum(avail.values())
                 if best is None or score > best[0]:
                     best = (score, n)
         if best is None:
             return None
-        # Optimistically deduct from the cached view so concurrent queued
-        # requests fan out instead of stampeding one target (refreshed on
-        # the next cluster_view broadcast).
         if require_available:
-            avail = best[1]["resources_available"]
-            for k, v in req.resources.items():
-                avail[k] = avail.get(k, 0) - v
+            self._spill_debits.append(
+                (time.monotonic() + 2.0, best[1]["node_id"],
+                 dict(req.resources)))
         return best[1]["address"]
 
     def _drain_queue(self) -> None:
