@@ -21,11 +21,13 @@ from ray_tpu.collective.collective import (
     reducescatter,
     alltoall,
     barrier,
+    busy_section,
     send,
     recv,
 )
 
 __all__ = [
+    "busy_section",
     "ReduceOp",
     "init_collective_group",
     "create_collective_group",

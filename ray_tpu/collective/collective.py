@@ -184,6 +184,14 @@ def _as_numpy(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
+# A missing rank with a busy heartbeat fresher than this is considered
+# alive-and-working; waiters extend their deadline rather than raising.
+_BUSY_FRESH_S = 15.0
+# Hard cap on how long busy peers can extend a waiter past its timeout —
+# a wedged-but-heartbeating peer must not hang the group forever.
+_BUSY_EXTENSION_CAP_S = 3600.0
+
+
 def _run_op(group_name: str, op_kind: str, payload, meta: dict,
             timeout_s: float) -> Any:
     state = _resolve_group(group_name)
@@ -192,19 +200,89 @@ def _run_op(group_name: str, op_kind: str, payload, meta: dict,
         group_name, op_kind, seq, state.rank, state.world_size, payload,
         meta, epoch=state.epoch))
     deadline = time.monotonic() + timeout_s
+    hard_deadline = deadline + _BUSY_EXTENSION_CAP_S
     delay = 0.001
     while True:
         ready, result = ray_tpu.get(state.coordinator.poll.remote(
             group_name, op_kind, seq, state.rank, epoch=state.epoch))
         if ready:
             return result
-        if time.monotonic() > deadline:
-            raise TimeoutError(
-                f"collective {op_kind} seq={seq} timed out after "
-                f"{timeout_s}s in group {group_name!r} (rank {state.rank}); "
-                "check that all ranks issue the same ops in the same order")
+        now = time.monotonic()
+        if now > deadline:
+            # Compile-aware handshake: a peer that has not reached this
+            # op yet but is heartbeating busy_section (e.g. mid
+            # jit-compile) is alive — keep waiting. Only raise when a
+            # missing rank is silent.
+            missing = ray_tpu.get(state.coordinator.pending_ranks.remote(
+                group_name, op_kind, seq, epoch=state.epoch))
+            busy = ray_tpu.get(state.coordinator.busy_ranks.remote(
+                group_name, max_age_s=_BUSY_FRESH_S))
+            busy_missing = {r: busy[r] for r in missing if r in busy}
+            if busy_missing and now < hard_deadline:
+                deadline = now + min(timeout_s, 30.0)
+            else:
+                detail = ""
+                if busy_missing:
+                    detail = (" (busy-extension cap reached; busy: "
+                              f"{busy_missing})")
+                raise TimeoutError(
+                    f"collective {op_kind} seq={seq} timed out after "
+                    f"{timeout_s}s in group {group_name!r} "
+                    f"(rank {state.rank}, missing ranks {missing})"
+                    f"{detail}; check that all ranks issue the same ops "
+                    "in the same order")
         time.sleep(delay)
         delay = min(delay * 2, 0.05)
+
+
+class busy_section:
+    """Context manager: report this rank alive-but-busy (long local work
+    such as a first-use jit compile) so peers waiting on a collective
+    extend their timeout instead of flaking. Heartbeats from a daemon
+    thread; peers stop extending ~15 s after the last heartbeat, so a
+    crash mid-section still fails fast.
+
+    with collective.busy_section(group, reason="grad jit-compile"):
+        loss, grads = jitted_grad(...)   # may compile for minutes
+    collective.allreduce(flat, group_name=group)
+    """
+
+    def __init__(self, group_name: str = "default", reason: str = "busy",
+                 heartbeat_s: float = 5.0):
+        self.group_name = group_name
+        self.reason = reason
+        self.heartbeat_s = heartbeat_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def __enter__(self):
+        state = _resolve_group(self.group_name)
+
+        def beat():
+            while not self._stop.is_set():
+                try:
+                    ray_tpu.get(state.coordinator.busy_heartbeat.remote(
+                        self.group_name, state.rank, self.reason))
+                except Exception:
+                    pass
+                self._stop.wait(self.heartbeat_s)
+
+        self._thread = threading.Thread(target=beat, daemon=True,
+                                        name="collective-busy-heartbeat")
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        # Deliberately do NOT clear_busy here: a peer whose extended
+        # deadline fires in the window between this exit and our next
+        # contribute landing would see us missing AND not busy — a
+        # spurious timeout. The entry ages out of the _BUSY_FRESH_S
+        # freshness window on its own once heartbeats stop, which also
+        # bounds the extra wait after a crash mid-section.
+        return False
 
 
 def allreduce(tensor, group_name: str = "default",

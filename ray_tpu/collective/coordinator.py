@@ -67,6 +67,11 @@ class CollectiveCoordinator:
         self._ops: Dict[Tuple[str, int, str, int], _Rendezvous] = {}
         # (group, src, dst, tag) -> FIFO of payloads (p2p mailbox)
         self._mailbox: Dict[Tuple[str, int, int, int], List[Any]] = {}
+        # (group, rank) -> (reason, last_heartbeat time.time()) — the
+        # compile-aware handshake: a rank doing long local work (jit
+        # compile) heartbeats here; waiters extend their op timeout only
+        # while a missing rank's heartbeat stays fresh.
+        self._busy: Dict[Tuple[str, int], Tuple[str, float]] = {}
 
     # ---- membership ----
 
@@ -125,6 +130,42 @@ class CollectiveCoordinator:
             del self._ops[key]
         for key in [k for k in self._mailbox if k[0] == group_name]:
             del self._mailbox[key]
+        for key in [k for k in self._busy if k[0] == group_name]:
+            del self._busy[key]
+
+    # ---- busy handshake (compile-aware timeouts) ----
+
+    def busy_heartbeat(self, group: str, rank: int, reason: str) -> None:
+        """A rank reports it is alive but stuck in long LOCAL work (e.g.
+        a jit compile) before it can reach its next collective op."""
+        import time as _time
+
+        self._busy[(group, rank)] = (reason, _time.time())
+
+    def clear_busy(self, group: str, rank: int) -> None:
+        self._busy.pop((group, rank), None)
+
+    def busy_ranks(self, group: str,
+                   max_age_s: float = 15.0) -> Dict[int, str]:
+        """Ranks of `group` with a fresh busy heartbeat."""
+        import time as _time
+
+        now = _time.time()
+        return {rank: reason
+                for (g, rank), (reason, ts) in self._busy.items()
+                if g == group and now - ts <= max_age_s}
+
+    def pending_ranks(self, group: str, op_kind: str, seq: int,
+                      epoch: int = 0) -> List[int]:
+        """Ranks that have NOT yet contributed to (op_kind, seq)."""
+        self._check_epoch(group, epoch)
+        rdv = self._ops.get((group, epoch, op_kind, seq))
+        if rdv is None:
+            g = self._groups.get(group)
+            world = g["world_size"] if g else 0
+            return list(range(world))
+        return [r for r in range(rdv.world_size)
+                if r not in rdv.payloads]
 
     # ---- collective rendezvous ----
 

@@ -138,16 +138,34 @@ class JaxLearner:
 
         from ray_tpu import collective as col
 
-        grads, metrics = self.compute_gradients(batch_shard)
-        flat, unravel = ravel_pytree(grads)
-        world = col.get_collective_group_size(group_name)
-        # Generous first-op timeout: peer ranks may still be jit-compiling
-        # their first compute_gradients (minutes on a contended host) —
-        # a 120s default flakes under load.
-        mean = col.allreduce(np.asarray(flat), group_name=group_name,
-                             timeout_s=600.0)
-        mean = mean / world
-        self.apply_gradients(unravel(mean))
+        import contextlib
+
+        # The first step jit-compiles compute_gradients AND
+        # apply_gradients (minutes on a contended host). busy_section
+        # heartbeats the coordinator so peers waiting in allreduce extend
+        # their timeout while this rank is provably alive — no blanket
+        # 600s timeout needed. Steady-state steps skip the wrapper (no
+        # heartbeat thread / coordinator RPCs once warm); covering the
+        # whole first step also protects peers' NEXT allreduce while this
+        # rank's apply compile runs.
+        warm = getattr(self, "_ddp_warm", False)
+        ctx = contextlib.nullcontext() if warm else col.busy_section(
+            group_name, reason="first-step jit compile")
+        # Cold first step keeps a generous allreduce timeout on top of
+        # the handshake: busy_section only covers a peer that has
+        # REACHED its first step — a peer still constructing (module
+        # build, imports, first trace) under load hasn't heartbeat yet
+        # and must not trip the 120 s default. Steady state uses it.
+        timeout_s = 120.0 if warm else 600.0
+        with ctx:
+            grads, metrics = self.compute_gradients(batch_shard)
+            flat, unravel = ravel_pytree(grads)
+            world = col.get_collective_group_size(group_name)
+            mean = col.allreduce(np.asarray(flat), group_name=group_name,
+                                 timeout_s=timeout_s)
+            mean = mean / world
+            self.apply_gradients(unravel(mean))
+        self._ddp_warm = True
         return metrics
 
     # ---- state ----

@@ -102,3 +102,70 @@ def test_declarative_group(ray_start_regular):
     col.destroy_collective_group("g3")
     for a in actors:
         ray_tpu.kill(a)
+
+
+@ray_tpu.remote
+class BusyRank:
+    """Rank that does long 'local work' (simulated jit compile) inside a
+    busy_section before reaching its allreduce."""
+
+    def __init__(self, rank, world):
+        self.rank = rank
+        self.world = world
+
+    def setup(self, group):
+        col.init_collective_group(self.world, self.rank, group_name=group)
+        return True
+
+    def slow_then_allreduce(self, delay_s, group, timeout_s):
+        import time
+
+        with col.busy_section(group, reason="simulated-compile",
+                              heartbeat_s=0.2):
+            time.sleep(delay_s)
+        return col.allreduce(np.ones((2,), np.float32),
+                             group_name=group, timeout_s=timeout_s)
+
+    def fast_allreduce(self, group, timeout_s):
+        return col.allreduce(np.ones((2,), np.float32),
+                             group_name=group, timeout_s=timeout_s)
+
+    def never_allreduce(self):
+        return True
+
+
+def test_busy_section_extends_peer_timeout(ray_start_regular):
+    """Compile-aware handshake: a peer stuck in long local work but
+    heartbeating busy_section must NOT trip the waiter's short timeout."""
+    actors = [BusyRank.remote(r, 2) for r in range(2)]
+    ray_tpu.get([a.setup.remote("busyg") for a in actors])
+    # Rank 1 'compiles' for 4s; rank 0's allreduce timeout is 1.5s — it
+    # would flake without the busy extension.
+    refs = [actors[0].fast_allreduce.remote("busyg", 1.5),
+            actors[1].slow_then_allreduce.remote(4.0, "busyg", 30.0)]
+    out = ray_tpu.get(refs, timeout=60)
+    for res in out:
+        np.testing.assert_allclose(res, np.full((2,), 2.0))
+    col.destroy_collective_group("busyg")
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_silent_missing_rank_still_times_out(ray_start_regular):
+    """Without a busy heartbeat, a missing rank trips the timeout at
+    roughly the requested deadline (no blanket extension)."""
+    import time
+
+    actors = [BusyRank.remote(r, 2) for r in range(2)]
+    ray_tpu.get([a.setup.remote("silentg") for a in actors])
+    ray_tpu.get(actors[1].never_allreduce.remote())  # rank 1 never joins
+    t0 = time.monotonic()
+    with pytest.raises(Exception) as exc_info:
+        ray_tpu.get(actors[0].fast_allreduce.remote("silentg", 1.5),
+                    timeout=30)
+    elapsed = time.monotonic() - t0
+    assert "timed out" in str(exc_info.value)
+    assert elapsed < 15, elapsed
+    col.destroy_collective_group("silentg")
+    for a in actors:
+        ray_tpu.kill(a)
