@@ -10,25 +10,31 @@ Parity map to the reference (python/ray/tune/):
 
 from ray_tpu.tune import schedulers, search
 from ray_tpu.tune.result_grid import ResultGrid
-from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler,
+                                     DistributeResources, FIFOScheduler,
                                      HyperBandForBOHB, HyperBandScheduler,
                                      MedianStoppingRule, PB2,
-                                     PopulationBasedTraining, TrialScheduler)
+                                     PopulationBasedTraining,
+                                     ResourceChangingScheduler,
+                                     TrialScheduler)
 from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
                                  Searcher, choice, grid_search, lograndint,
                                  loguniform, qloguniform, quniform, randint,
                                  randn, sample_from, uniform)
-from ray_tpu.tune.trainable import (Trainable, get_checkpoint, report,
+from ray_tpu.tune.trainable import (Trainable, get_checkpoint,
+                                    get_trial_resources, report,
                                     wrap_function)
 from ray_tpu.tune.tuner import (TuneConfig, Tuner, run, with_parameters,
                                 with_resources)
 
 __all__ = [
     "AsyncHyperBandScheduler", "BasicVariantGenerator", "ConcurrencyLimiter",
-    "FIFOScheduler", "HyperBandForBOHB", "HyperBandScheduler",
-    "MedianStoppingRule", "PB2",
-    "PopulationBasedTraining", "ResultGrid", "Searcher", "Trainable",
+    "DistributeResources", "FIFOScheduler", "HyperBandForBOHB",
+    "HyperBandScheduler", "MedianStoppingRule", "PB2",
+    "PopulationBasedTraining", "ResourceChangingScheduler", "ResultGrid",
+    "Searcher", "Trainable",
     "TrialScheduler", "TuneConfig", "Tuner", "choice", "get_checkpoint",
+    "get_trial_resources",
     "grid_search", "lograndint", "loguniform", "qloguniform", "quniform",
     "randint", "randn", "report", "run", "sample_from", "schedulers",
     "search", "uniform", "with_parameters", "with_resources",

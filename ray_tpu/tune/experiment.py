@@ -29,6 +29,9 @@ class Trial:
     error: Optional[str] = None
     checkpoint_path: Optional[str] = None
     num_failures: int = 0
+    # Per-trial resource override (ResourceChangingScheduler); None means
+    # the controller's experiment-wide trial_resources apply.
+    resources: Optional[Dict[str, float]] = None
 
     @property
     def trial_dir(self) -> str:

@@ -28,8 +28,22 @@ class ResultGrid:
         ckpt = Checkpoint(trial.checkpoint_path) \
             if trial.checkpoint_path else None
         err = RuntimeError(trial.error) if trial.error else None
+        # Per-iteration history (reference: Result.metrics_dataframe from
+        # the trial's progress.csv). Nested values (sub-dicts) are
+        # dropped — the dataframe is for scalar metric curves.
+        df = None
+        if trial.results:
+            try:
+                import pandas as pd
+
+                df = pd.DataFrame(
+                    [{k: v for k, v in r.items()
+                      if not isinstance(v, (dict, list))}
+                     for r in trial.results])
+            except ImportError:
+                pass
         return Result(metrics=metrics, checkpoint=ckpt, error=err,
-                      path=trial.trial_dir)
+                      path=trial.trial_dir, metrics_dataframe=df)
 
     def __len__(self):
         return len(self._results)

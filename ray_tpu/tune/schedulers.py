@@ -307,6 +307,75 @@ class PopulationBasedTraining(TrialScheduler):
         """Hook for model-based variants (PB2)."""
 
 
+class DistributeResources:
+    """Default allocation policy for ResourceChangingScheduler: divide the
+    cluster's CPUs evenly among live trials (reference:
+    python/ray/tune/schedulers/resource_changing_scheduler.py
+    DistributeResources — bundle-free variant). Never drops a trial below
+    its base allocation."""
+
+    def __init__(self, resource: str = "CPU"):
+        self.resource = resource
+
+    def __call__(self, controller, trial, result,
+                 scheduler) -> Optional[Dict[str, float]]:
+        import ray_tpu
+
+        try:
+            total = ray_tpu.cluster_resources().get(self.resource, 0.0)
+        except Exception:
+            return None
+        live = max(1, len(controller._actors))
+        base = (controller.trial_resources or {}).get(self.resource, 1.0)
+        share = max(base, total // live)
+        cur = (trial.resources or controller.trial_resources or {}).get(
+            self.resource, 1.0)
+        if share == cur:
+            return None
+        new = dict(trial.resources or controller.trial_resources or {})
+        new[self.resource] = share
+        return new
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Wraps a base scheduler and reallocates trial resources while the
+    experiment runs (reference: python/ray/tune/schedulers/
+    resource_changing_scheduler.py). After the base scheduler's decision,
+    ``resources_allocation_function(controller, trial, result, scheduler)``
+    may return a new resource dict; a changed allocation checkpoint-pauses
+    the trial and restarts its actor with the new resources
+    (TuneController.reallocate). User code reads its current allocation
+    via ``tune.get_trial_resources()``."""
+
+    def __init__(self, base_scheduler: Optional[TrialScheduler] = None,
+                 resources_allocation_function: Optional[Callable] = None):
+        self.base = base_scheduler or FIFOScheduler()
+        self.alloc_fn = resources_allocation_function or \
+            DistributeResources()
+
+    def set_search_properties(self, metric, mode) -> None:
+        super().set_search_properties(metric, mode)
+        self.base.set_search_properties(metric, mode)
+
+    def on_trial_add(self, controller, trial) -> None:
+        self.base.on_trial_add(controller, trial)
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        decision = self.base.on_trial_result(controller, trial, result)
+        if decision == STOP:
+            return STOP
+        try:
+            new = self.alloc_fn(controller, trial, result, self)
+        except Exception:
+            new = None
+        if new and new != (trial.resources or controller.trial_resources):
+            controller.reallocate(trial, new)
+        return decision
+
+    def on_trial_complete(self, controller, trial, result: Dict) -> None:
+        self.base.on_trial_complete(controller, trial, result)
+
+
 class PB2(PopulationBasedTraining):
     """Population Based Bandits: PBT whose explore step picks new
     hyperparameters by a GP-UCB acquisition over observed

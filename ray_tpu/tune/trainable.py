@@ -49,6 +49,18 @@ def report(metrics: Dict[str, Any],
     train_session.report(metrics, checkpoint=checkpoint)
 
 
+# Set by TrainableActor at construction; read by user code through
+# get_trial_resources() (reference: tune.get_trial_resources() — exposes
+# the trial's current allocation so ResourceChangingScheduler restarts
+# can adapt worker counts mid-experiment).
+_trial_resources: Dict[str, float] = {}
+
+
+def get_trial_resources() -> Dict[str, float]:
+    """The resources the current trial's actor was launched with."""
+    return dict(_trial_resources)
+
+
 def get_checkpoint() -> Optional[Checkpoint]:
     sess = _get_session()
     if sess is not None:
@@ -177,11 +189,14 @@ class TrainableActor:
 
     def __init__(self, trainable_cls: type, config: Dict[str, Any],
                  trial_dir: str,
-                 restore_from: Optional[str] = None):
+                 restore_from: Optional[str] = None,
+                 trial_resources: Optional[Dict[str, float]] = None):
         os.makedirs(trial_dir, exist_ok=True)
         self._trial_dir = trial_dir
         self._ckpt_index = 0
         self._latest_checkpoint: Optional[str] = restore_from
+        global _trial_resources
+        _trial_resources = dict(trial_resources or {})
         restore_ckpt = Checkpoint(restore_from) if restore_from else None
         if issubclass(trainable_cls, FunctionTrainable):
             self._trainable = trainable_cls(config, checkpoint=restore_ckpt)

@@ -161,8 +161,9 @@ class TuneController:
                     cb.on_trial_start(trial)
                 except Exception:
                     pass
-        opts = {"num_cpus": self.trial_resources.get("CPU", 1.0)}
-        custom = {k: v for k, v in self.trial_resources.items()
+        res = trial.resources or self.trial_resources
+        opts = {"num_cpus": res.get("CPU", 1.0)}
+        custom = {k: v for k, v in res.items()
                   if k != "CPU"}
         if "TPU" in custom:
             opts["num_tpus"] = custom.pop("TPU")
@@ -170,7 +171,8 @@ class TuneController:
             opts["resources"] = custom
         handle = self._actor_cls.options(**opts).remote(
             self.trainable_cls, trial.config, trial.trial_dir,
-            restore_from=restore_from or trial.checkpoint_path)
+            restore_from=restore_from or trial.checkpoint_path,
+            trial_resources=dict(res))
         if trial.status == PENDING:
             # First start (not a PBT-exploit restart): let the scheduler
             # register it (HyperBand bracket membership).
@@ -253,6 +255,31 @@ class TuneController:
         new_config = explore_fn(donor.config)
         self._stop_actor(trial)
         trial.config = new_config
+        trial.checkpoint_path = ckpt
+        self._launch(trial, restore_from=ckpt)
+
+    # ------------------------------------------------------------------
+    def reallocate(self, trial: Trial,
+                   resources: Dict[str, float]) -> None:
+        """Restart a running trial with new resources, resuming from its
+        latest checkpoint (reference: resource_changing_scheduler.py —
+        the trial is paused and its placement group replaced)."""
+        trial.resources = dict(resources)
+        handle = self._actors.get(trial.trial_id)
+        if handle is None:
+            return  # not running: the next launch picks the override up
+        ckpt = None
+        try:
+            ckpt = ray_tpu.get(handle.save.remote(), timeout=60)
+        except Exception:
+            ckpt = trial.checkpoint_path
+        if not ckpt:
+            # A checkpoint-less trainable cannot be paused without
+            # losing its progress: keep it running at the old
+            # allocation (trial.resources applies to any LATER
+            # restart) rather than silently rerunning from scratch.
+            return
+        self._stop_actor(trial)
         trial.checkpoint_path = ckpt
         self._launch(trial, restore_from=ckpt)
 

@@ -527,3 +527,44 @@ def test_tuner_restore_resumes_experiment(tmp_path):
     assert by_c[1] >= 4, by_c
     assert by_c[2] == 5, by_c
     assert results.num_errors == 0
+
+
+def test_resource_changing_scheduler(tmp_path):
+    """ResourceChangingScheduler checkpoints + restarts a trial with a new
+    allocation; user code observes it via tune.get_trial_resources()
+    (reference: schedulers/resource_changing_scheduler.py)."""
+
+    def objective(config):
+        ckpt = tune.get_checkpoint()
+        start = ckpt.to_dict()["i"] + 1 if ckpt else 0
+        for i in range(start, 6):
+            tune.report(
+                {"i": i, "cpus": tune.get_trial_resources().get("CPU", 0)},
+                checkpoint=Checkpoint.from_dict({"i": i}))
+
+    def alloc(controller, trial, result, scheduler):
+        # Bump the trial to 2 CPUs once it has proven itself (iter >= 2).
+        cur = (trial.resources or controller.trial_resources or {})
+        if result.get("i", 0) >= 2 and cur.get("CPU", 1.0) < 2.0:
+            return {**cur, "CPU": 2.0}
+        return None
+
+    results = Tuner(
+        objective,
+        param_space={},
+        tune_config=TuneConfig(
+            metric="i", mode="max",
+            scheduler=tune.ResourceChangingScheduler(
+                resources_allocation_function=alloc)),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 1 and results.num_errors == 0
+    r = results[0]
+    rows = [row for row in r.metrics_dataframe.to_dict("records")]
+    # Early iterations ran at the default 1 CPU, later ones at 2 CPUs —
+    # and the restart resumed from the checkpoint (i never reset).
+    cpus_by_i = {row["i"]: row["cpus"] for row in rows}
+    assert cpus_by_i[0] == 1.0, cpus_by_i
+    assert cpus_by_i[5] == 2.0, cpus_by_i
+    seen = [row["i"] for row in rows]
+    assert seen == sorted(seen), seen
