@@ -558,11 +558,31 @@ class GcsServer:
         """GCS-driven actor placement (reference:
         GcsActorScheduler::ScheduleByGcs, gcs_actor_scheduler.cc:60)."""
         spec = TaskSpec.from_wire(actor.creation_task)
+        # Nodes that rejected this actor with a PERMANENT config error
+        # (bad runtime_env: missing container hook, unresolvable conda
+        # env, …). Node-local configuration can differ (the conda root /
+        # hook are raylet env vars), so only the answering node is
+        # excluded; the actor dies with the real message once every
+        # feasible node has permanently rejected it.
+        permanent_nodes: set = set()
+        permanent_error = ""
         for attempt in range(120):
             node = self._pick_node(spec.resources, spec.scheduling_strategy,
                                    spec.placement_group_id,
-                                   spec.placement_group_bundle_index)
+                                   spec.placement_group_bundle_index,
+                                   exclude=permanent_nodes)
             if node is None:
+                if permanent_nodes and self._pick_node(
+                        spec.resources, spec.scheduling_strategy,
+                        spec.placement_group_id,
+                        spec.placement_group_bundle_index) is not None:
+                    # Feasible nodes exist but ALL permanently rejected:
+                    # fail now with the real error, skipping the restart
+                    # policy (the config error is deterministic).
+                    await self._restart_or_kill_actor(
+                        actor, permanent_error or "actor creation rejected",
+                        permanent=True)
+                    return
                 await asyncio.sleep(0.25)  # wait for resources/nodes
                 continue
             try:
@@ -579,17 +599,24 @@ class GcsServer:
                 actor.node_id = node.node_id
                 self._persist_actor(actor)
                 return  # worker will report actor_ready
+            if reply.get("permanent"):
+                permanent_nodes.add(node.node_id)
+                permanent_error = reply.get("error", "")
+                continue  # try remaining nodes without delay
             await asyncio.sleep(0.25)
         await self._restart_or_kill_actor(actor, "no feasible node")
 
     def _pick_node(self, resources: Dict[str, float],
                    strategy: Optional[dict],
                    pg_id: Optional[PlacementGroupID] = None,
-                   bundle_index: int = -1) -> Optional[NodeInfo]:
+                   bundle_index: int = -1,
+                   exclude: Optional[set] = None) -> Optional[NodeInfo]:
         """Hybrid policy: pack onto best-utilized feasible node below the
         spread threshold, else least utilized (reference:
-        hybrid_scheduling_policy.cc)."""
-        alive = [n for n in self.nodes.values() if n.state == ALIVE]
+        hybrid_scheduling_policy.cc). `exclude` drops specific nodes
+        (permanent per-node rejections)."""
+        alive = [n for n in self.nodes.values() if n.state == ALIVE
+                 and (not exclude or n.node_id not in exclude)]
         if strategy and strategy.get("type") == "node_affinity":
             target = NodeID(strategy["node_id"])
             for n in alive:
@@ -647,8 +674,12 @@ class GcsServer:
                     actor, data.get("reason", "worker died"))
         return True
 
-    async def _restart_or_kill_actor(self, actor: ActorInfo, reason: str):
-        if actor.max_restarts != 0 and (
+    async def _restart_or_kill_actor(self, actor: ActorInfo, reason: str,
+                                     permanent: bool = False):
+        """permanent=True skips the restart policy: a deterministic
+        config error (bad runtime_env) recurs on every restart, so
+        restarting a restartable actor would hot-loop the scheduler."""
+        if not permanent and actor.max_restarts != 0 and (
                 actor.max_restarts < 0 or
                 actor.num_restarts < actor.max_restarts):
             actor.num_restarts += 1

@@ -556,17 +556,46 @@ class Raylet:
             raise RuntimeError(f"forkserver spawn failed: {reply}")
         return reply["pid"]
 
+    @staticmethod
+    def _resolve_conda_python(conda: str) -> str:
+        """Resolve a runtime_env['conda'] name/prefix to its interpreter.
+
+        Conda semantics are interpreter-swap semantics (the reference
+        wraps the worker command in `conda run`, runtime_env/conda.py):
+        the named env's python runs the worker, so its site-packages ARE
+        the environment — no sys.path games. This deployment is hermetic,
+        so envs must be PRE-BUILT: a name resolves under
+        $RAY_TPU_CONDA_ROOT/envs/<name>, a path containing '/' is used as
+        the env prefix directly. The env needs msgpack installed (worker
+        wire protocol); ray_tpu itself ships via PYTHONPATH."""
+        if os.sep in conda:
+            prefix = os.path.abspath(os.path.expanduser(conda))
+        else:
+            root = os.environ.get("RAY_TPU_CONDA_ROOT", "")
+            if not root:
+                raise RuntimeError(
+                    f"runtime_env conda={conda!r} requires "
+                    "RAY_TPU_CONDA_ROOT to point at a conda installation "
+                    "with pre-built envs (hermetic deployment: envs are "
+                    "not solved/created on the fly)")
+            prefix = os.path.join(root, "envs", conda)
+        py = os.path.join(prefix, "bin", "python")
+        if not os.path.isfile(py):
+            raise RuntimeError(
+                f"conda env {conda!r} has no interpreter at {py}; "
+                "build the env ahead of time (it must include msgpack)")
+        return py
+
     def _spawn_worker(self, tpu: bool = False,
-                      image_uri: str = "") -> WorkerHandle:
+                      image_uri: str = "",
+                      conda: str = "") -> WorkerHandle:
         worker_id = WorkerID.from_random()
         extra_env = self._worker_env(worker_id, tpu)
         log_path = os.path.join(self.session_dir, "logs",
                                 f"worker-{worker_id.hex()[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
-        w = WorkerHandle(worker_id, None, None)
-        w.tpu = tpu
-        w.log_path = log_path
-        self.workers[worker_id] = w
+        # Pre-spawn validation FIRST (a raise here must not leave a ghost
+        # WorkerHandle in self.workers):
         # Container hook (reference: runtime_env/image_uri.py): when the
         # env pins an image, the worker launches through the operator's
         # hook command — `<hook> <image_uri> <python> -m ...worker_main`
@@ -583,11 +612,20 @@ class Raylet:
             import shlex as _shlex
 
             container_argv = _shlex.split(hook) + [image_uri]
+        # Conda env = different interpreter (resolved before any process
+        # starts so a bad env fails the lease, not the worker log).
+        py_exe = self._resolve_conda_python(conda) if conda \
+            else sys.executable
+        w = WorkerHandle(worker_id, None, None)
+        w.tpu = tpu
+        w.log_path = log_path
+        self.workers[worker_id] = w
         # TPU workers need the jax plugin imported at interpreter start
         # (sitecustomize), which a fork from the plugin-free template
         # can't provide — they keep the fresh-interpreter path. Container
-        # workers always launch through their hook command.
+        # and conda workers always launch their own interpreter.
         use_fork = self.config.forkserver_enabled and not image_uri and \
+            not conda and \
             not (tpu and os.environ.get("RAY_TPU_AXON_POOL_IPS") and
                  self.resources_total.get("TPU", 0) > 0)
 
@@ -599,7 +637,7 @@ class Raylet:
             env = dict(os.environ)
             env.update(extra_env)
             argv = (container_argv or []) + [
-                sys.executable, "-m", "ray_tpu._private.worker_main"]
+                py_exe, "-m", "ray_tpu._private.worker_main"]
             with open(log_path, "ab") as logf:
                 return subprocess.Popen(
                     argv,
@@ -1027,18 +1065,37 @@ class Raylet:
         # startup entirely — the dominant cost of actor-creation storms.
         needs_tpu = spec.resources.get("TPU", 0) > 0
         self._notify_resources_changed()
-        image_uri = (spec.runtime_env or {}).get("image_uri", "")
-        w = None if image_uri else self._take_idle_worker(tpu=needs_tpu)
+        renv = spec.runtime_env or {}
+        image_uri = renv.get("image_uri", "")
+        conda_env = renv.get("conda", "")
+        if isinstance(conda_env, dict):
+            # Spec-form conda ({"dependencies": [...]}) needs a solver —
+            # not available hermetically. Named pre-built envs only.
+            # permanent: the GCS must fail the actor with THIS error, not
+            # retry into a generic "no feasible node".
+            self._release_resources(dict(spec.resources),
+                                    bundle_key)
+            return {"ok": False, "permanent": True, "error":
+                    "runtime_env conda specs (dependency lists) are not "
+                    "supported in this hermetic deployment; pre-build the "
+                    "env and pass its NAME (under RAY_TPU_CONDA_ROOT) or "
+                    "prefix path"}
+        dedicated = bool(image_uri or conda_env)
+        w = None if dedicated else self._take_idle_worker(tpu=needs_tpu)
         if w is None:
             try:
-                w = self._spawn_worker(tpu=needs_tpu, image_uri=image_uri)
-            except RuntimeError as e:  # e.g. image_uri without a hook
+                w = self._spawn_worker(tpu=needs_tpu, image_uri=image_uri,
+                                       conda=conda_env)
+            except RuntimeError as e:  # pre-spawn validation: image_uri
+                # without a hook, unresolvable conda env — permanent
+                # config errors; retrying other nodes gives the same
+                # answer, so the GCS should surface THIS message.
                 if spec.placement_group_id is None:
                     self._release_resources(dict(spec.resources), None)
                 else:
                     self._release_resources(dict(spec.resources),
                                             bundle_key)
-                return {"ok": False, "error": str(e)}
+                return {"ok": False, "permanent": True, "error": str(e)}
         else:
             self._maybe_refill_pool()  # replace the consumed pool worker
         w.state = "actor"

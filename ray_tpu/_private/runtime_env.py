@@ -7,9 +7,12 @@ contract: the driver packages local dirs into content-addressed zips in
 the GCS KV; workers materialize them once per node into a shared cache
 and apply the env (env vars, sys.path, cwd) around user-code execution.
 
-pip/conda are accepted but gated: this deployment is hermetic (no
-package index), so requirements raise unless RAY_TPU_ALLOW_PIP=1
-explicitly opts into a live `pip install`.
+pip/conda are hermetic-aware: pip installs from an allowlisted LOCAL
+index into content-addressed cached dirs (live installs gated on
+RAY_TPU_ALLOW_PIP=1); conda accepts NAMED pre-built envs, which swap the
+dedicated actor worker's interpreter at the raylet spawn path
+(RAY_TPU_CONDA_ROOT/envs/<name> or a prefix path) — spec-form conda
+(dependency solving) stays gated.
 """
 
 from __future__ import annotations
@@ -232,6 +235,31 @@ def _check_pip(env: dict) -> Optional[str]:
     return dest
 
 
+def _check_conda(runtime_env: dict, actor_worker: bool) -> None:
+    """conda plugin (reference: _private/runtime_env/conda.py): a NAMED
+    pre-built env swaps the worker interpreter — enforced at the raylet
+    spawn path (`Raylet._resolve_conda_python`), which is the only place
+    an interpreter swap can happen. On an actor worker a conda name is a
+    no-op here: this process IS the env's interpreter (dedicated lease).
+    Plain tasks run on shared pool workers (no interpreter swap
+    possible) and must reject it. Spec-form conda (dependency lists)
+    needs a solver the hermetic deployment doesn't have."""
+    conda = runtime_env.get("conda")
+    if not conda:
+        return
+    if isinstance(conda, dict):
+        raise RuntimeError(
+            "runtime_env conda specs (dependency lists) are not supported "
+            "in this hermetic deployment; pre-build the env and pass its "
+            "name (under RAY_TPU_CONDA_ROOT) or prefix path")
+    if not actor_worker:
+        raise RuntimeError(
+            "runtime_env['conda'] applies to ACTORS in this deployment "
+            "(dedicated worker processes get the env's interpreter); "
+            "plain tasks run on shared pool workers — wrap the work in "
+            "an actor or use py_modules/pip instead")
+
+
 @contextlib.contextmanager
 def applied_runtime_env(runtime_env: Optional[dict], gcs_call):
     """Worker-side: apply env vars / working_dir / py_modules around user
@@ -241,10 +269,7 @@ def applied_runtime_env(runtime_env: Optional[dict], gcs_call):
     if not runtime_env:
         yield
         return
-    if runtime_env.get("conda"):
-        raise RuntimeError(
-            "runtime_env['conda'] is not supported in this deployment "
-            "(hermetic image); use the baked environment or py_modules.")
+    _check_conda(runtime_env, actor_worker=False)
     pip_dir = _check_pip(runtime_env)
 
     saved_env: Dict[str, Optional[str]] = {}
@@ -300,9 +325,8 @@ def apply_runtime_env_permanent(runtime_env: Optional[dict],
     max_concurrency>1 (no save/restore races)."""
     if not runtime_env:
         return
-    if runtime_env.get("conda"):
-        raise RuntimeError(
-            "runtime_env['conda'] is not supported in this deployment")
+    # Only actor workers apply envs permanently (dedicated processes).
+    _check_conda(runtime_env, actor_worker=True)
     pip_dir = _check_pip(runtime_env)
     if pip_dir:
         sys.path.insert(0, pip_dir)

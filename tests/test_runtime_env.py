@@ -293,3 +293,104 @@ def test_image_uri_container_hook(tmp_path):
             ray_tpu.get(b.ok.remote(), timeout=60)
     finally:
         ray_tpu.shutdown()
+
+
+def test_conda_named_env_swaps_interpreter(tmp_path):
+    """conda plugin (reference: _private/runtime_env/conda.py): an actor
+    with a named pre-built env runs in a dedicated worker launched from
+    that env's interpreter. The fake env's python is a wrapper that marks
+    the process environment before exec'ing the real interpreter."""
+    prefix = tmp_path / "envs" / "fakeenv"
+    (prefix / "bin").mkdir(parents=True)
+    wrapper = prefix / "bin" / "python"
+    wrapper.write_text(
+        "#!/bin/sh\n"
+        "export RAY_TPU_TEST_CONDA_MARK=fakeenv\n"
+        f"exec {sys.executable} \"$@\"\n")
+    wrapper.chmod(0o755)
+
+    @ray_tpu.remote(runtime_env={"conda": str(prefix)})
+    class CondaActor:
+        def probe(self):
+            return (os.environ.get("RAY_TPU_TEST_CONDA_MARK"),
+                    sys.executable)
+
+    # Self-managed cluster: earlier tests in this module tear the
+    # module-scoped fixture's cluster down.
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        a = CondaActor.remote()
+        mark, exe = ray_tpu.get(a.probe.remote(), timeout=60)
+        assert mark == "fakeenv"
+        ray_tpu.kill(a)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_conda_name_resolution_via_root(tmp_path):
+    """Name form resolves under $RAY_TPU_CONDA_ROOT/envs/<name>. The
+    RAYLET resolves it, so the root must be in the env before init
+    (same pattern as the container-hook test)."""
+    prefix = tmp_path / "envs" / "namedenv"
+    (prefix / "bin").mkdir(parents=True)
+    wrapper = prefix / "bin" / "python"
+    wrapper.write_text(
+        "#!/bin/sh\n"
+        "export RAY_TPU_TEST_CONDA_MARK=namedenv\n"
+        f"exec {sys.executable} \"$@\"\n")
+    wrapper.chmod(0o755)
+
+    @ray_tpu.remote(runtime_env={"conda": "namedenv"})
+    class NamedCondaActor:
+        def probe(self):
+            return os.environ.get("RAY_TPU_TEST_CONDA_MARK")
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    os.environ["RAY_TPU_CONDA_ROOT"] = str(tmp_path)
+    try:
+        ray_tpu.init(num_cpus=2)
+        b = NamedCondaActor.remote()
+        assert ray_tpu.get(b.probe.remote(), timeout=60) == "namedenv"
+        ray_tpu.kill(b)
+    finally:
+        os.environ.pop("RAY_TPU_CONDA_ROOT", None)
+        ray_tpu.shutdown()
+
+
+def test_conda_gating():
+    """Spec-form conda (needs a solver) and missing envs fail with clear
+    errors; plain tasks cannot swap interpreters."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["numpy"]}})
+        class SpecConda:
+            def ping(self):
+                return 1
+
+        a = SpecConda.remote()
+        with pytest.raises(Exception, match="pre-build|solver|hermetic"):
+            ray_tpu.get(a.ping.remote(), timeout=60)
+
+        @ray_tpu.remote(runtime_env={"conda": "missing-env-name"})
+        class MissingConda:
+            def ping(self):
+                return 1
+
+        b = MissingConda.remote()
+        with pytest.raises(Exception,
+                           match="RAY_TPU_CONDA_ROOT|interpreter"):
+            ray_tpu.get(b.ping.remote(), timeout=60)
+
+        @ray_tpu.remote(runtime_env={"conda": "anything"})
+        def conda_task():
+            return 1
+
+        with pytest.raises(Exception, match="ACTORS|actor"):
+            ray_tpu.get(conda_task.remote(), timeout=60)
+    finally:
+        ray_tpu.shutdown()
