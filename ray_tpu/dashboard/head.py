@@ -18,8 +18,14 @@ Endpoints:
   GET /api/tasks            -> per-task latest-state rows
   GET /api/placement_groups -> placement group table
   GET /api/objects          -> object location table
+  GET/PUT/DELETE /api/serve/applications -> Serve REST API (status /
+      declarative deploy of a ServeDeploySchema dict / teardown)
   GET /api/logs             -> session log file listing
   GET /api/logs/tail?file=X&lines=N -> tail one log file
+  GET /api/logs/stream?file=X&offset=N&wait_s=S -> long-poll incremental
+      tail: returns {offset, data} as soon as the file grows past
+      `offset` (or after wait_s with empty data) — push-style tailing
+      without websockets
 """
 
 from __future__ import annotations
@@ -256,6 +262,103 @@ class DashboardHead:
             if text is None:
                 return web.Response(status=404, text="no such log file")
             return web.Response(text=text, content_type="text/plain")
+
+        @routes.get("/api/serve/applications")
+        async def serve_apps(request):
+            """Serve REST API (reference: dashboard serve module /
+            `serve status`): live application/deployment states."""
+            def get_status():
+                from ray_tpu import serve
+
+                return serve.status()
+
+            return web.json_response(await offload(get_status),
+                                     dumps=_dumps)
+
+        @routes.put("/api/serve/applications")
+        async def serve_deploy(request):
+            """Declarative deploy (reference: PUT /api/serve/applications
+            — `serve deploy` over REST): body is a ServeDeploySchema
+            dict; apps are (re)deployed to match it."""
+            try:
+                body = await request.json()
+            except Exception:
+                return web.Response(status=400, text="invalid JSON body")
+
+            def deploy():
+                from ray_tpu.serve.schema import (ServeDeploySchema,
+                                                  deploy_from_schema)
+
+                schema = ServeDeploySchema.from_dict(body)
+                deploy_from_schema(schema)
+                return {"deployed": [a.name for a in schema.applications]}
+
+            try:
+                return web.json_response(await offload(deploy),
+                                         dumps=_dumps)
+            except Exception as e:
+                return web.Response(status=400,
+                                    text=f"{type(e).__name__}: {e}")
+
+        @routes.delete("/api/serve/applications")
+        async def serve_teardown(request):
+            """Tear down one app (?name=X) or every app."""
+            name = request.query.get("name", "")
+
+            def teardown():
+                from ray_tpu import serve
+
+                if name:
+                    serve.delete(name)
+                else:
+                    serve.shutdown()
+                return {"deleted": name or "all"}
+
+            try:
+                return web.json_response(await offload(teardown),
+                                         dumps=_dumps)
+            except Exception as e:
+                return web.Response(status=400,
+                                    text=f"{type(e).__name__}: {e}")
+
+        @routes.get("/api/logs/stream")
+        async def logs_stream(request):
+            """Long-poll incremental tail (push-style log following —
+            reference: dashboard log module's streaming reads). The
+            client passes the offset it has consumed to; the reply
+            carries bytes from there and the new offset. offset=-1
+            means "start near the tail"."""
+            name = os.path.basename(request.query.get("file", ""))
+            path = os.path.join(_log_dir(), name)
+            try:
+                offset = int(request.query.get("offset", "-1"))
+                wait_s = min(float(request.query.get("wait_s", "25")), 55.0)
+            except ValueError:
+                return web.Response(status=400, text="bad params")
+            if not os.path.isfile(path):
+                return web.Response(status=404, text="no such log file")
+
+            def read_from(pos: int):
+                size = os.path.getsize(path)
+                if pos < 0 or size < pos:
+                    # First call — or the file was truncated/rotated
+                    # under us (size shrank past our offset): resume
+                    # near the new tail instead of stalling forever.
+                    pos = max(0, size - 64 * 1024)
+                if size <= pos:
+                    return pos, ""
+                with open(path, "rb") as f:
+                    f.seek(pos)
+                    data = f.read(512 * 1024)
+                return pos + len(data), data.decode("utf-8", "replace")
+
+            deadline = asyncio.get_running_loop().time() + wait_s
+            new_off, data = await offload(read_from, offset)
+            while not data and offset >= 0 and \
+                    asyncio.get_running_loop().time() < deadline:
+                await asyncio.sleep(0.3)
+                new_off, data = await offload(read_from, offset)
+            return web.json_response({"offset": new_off, "data": data})
 
         app = web.Application()
         app.add_routes(routes)

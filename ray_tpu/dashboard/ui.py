@@ -5,8 +5,10 @@ dashboard head over the same JSON endpoints).
 Coverage mirrors the reference app's modules: overview cards + per-node
 hardware (reporter), node/actor/PG/job/task tables with row drill-down
 detail panels, an in-browser task timeline rendered from the chrome-trace
-endpoint (modules/metrics + timeline), and in-browser log tailing
-(modules/log). Everything the CLI can show is reachable here.
+endpoint (modules/metrics + timeline) with wheel-zoom + drag-pan, and
+push-style in-browser log following over the long-poll
+/api/logs/stream endpoint (modules/log). Everything the CLI can show is
+reachable here.
 """
 
 INDEX_HTML = """<!doctype html>
@@ -132,18 +134,23 @@ const detailPanel = (title, obj) => {
     `<h3>${esc(title)}</h3><table>${rows}</table></div>`;
 };
 window.showDetail = (r) => { detail = r; refresh(); };
-window.showLog = (r) => { logFile = r.name; refresh(); };
+let forceRender = false;
+window.showLog = (r) => { logFile = r.name; forceRender = true; refresh(); };
 async function j(url) { const r = await fetch(url);
   if (!r.ok) throw new Error(url + ': ' + r.status); return r.json(); }
 
 // --- timeline renderer: lanes per worker, bars per task span ---------
+// Zoom with the mouse wheel (around the cursor), pan by dragging; view
+// state persists across the 3s auto-refresh.
 const laneColor = (name) => {
   let h = 0;
   for (const ch of String(name)) h = (h * 31 + ch.charCodeAt(0)) >>> 0;
   return `hsl(${h % 360} 60% 55%)`;
 };
 let tlWindow = 0;  // seconds of trailing window; 0 = everything
-window.setTlWindow = (s) => { tlWindow = s; refresh(); };
+let tlV0 = 0, tlV1 = 1;  // zoom view as fractions of the full range
+window.setTlWindow = (s) => { tlWindow = s; tlV0 = 0; tlV1 = 1; refresh(); };
+window.tlReset = () => { tlV0 = 0; tlV1 = 1; refresh(); };
 function renderTimeline(events) {
   let spans = events.filter(e => e.ph === 'X' && e.dur > 0);
   if (!spans.length) return '<p>No task events yet.</p>';
@@ -161,37 +168,100 @@ function renderTimeline(events) {
     if (e.ts + e.dur > t1) t1 = e.ts + e.dur;
   }
   const total = Math.max(t1 - t0, 1);
+  // visible window in event time
+  const vt0 = t0 + tlV0 * total, vt1 = t0 + tlV1 * total;
+  const vtotal = Math.max(vt1 - vt0, 1);
   const lanes = new Map();
+  let visible = 0;
   for (const e of spans) {
+    if (e.ts + e.dur < vt0 || e.ts > vt1) continue;  // cull to view
     const key = e.pid || '?';
     if (!lanes.has(key)) lanes.set(key, []);
     lanes.get(key).push(e);
+    visible++;
   }
-  const width = 100;  // percent
   const winBtn = (s, label) =>
     `<button onclick="setTlWindow(${s})" style="margin-left:6px;` +
     `${tlWindow === s ? 'font-weight:700;' : ''}">${label}</button>`;
-  let html = `<div class="tl-axis">${(total / 1e6).toFixed(3)}s total ` +
-    `&middot; ${spans.length} spans &middot; ${lanes.size} workers ` +
+  const zoomed = tlV0 > 0 || tlV1 < 1;
+  let html = `<div class="tl-axis">${(vtotal / 1e6).toFixed(3)}s shown` +
+    (zoomed ? ` of ${(total / 1e6).toFixed(3)}s` : '') +
+    ` &middot; ${visible} spans &middot; ${lanes.size} workers ` +
     `&middot; window:${winBtn(0, 'all')}${winBtn(60, '60s')}` +
-    `${winBtn(10, '10s')}</div>` +
-    '<div class="tl-wrap"><div class="tl">';
+    `${winBtn(10, '10s')}` +
+    (zoomed ? ` <button onclick="tlReset()">reset zoom</button>` : '') +
+    ` <span style="color:#9fb0c0">(wheel = zoom, drag = pan)</span>` +
+    `</div><div class="tl-wrap" id="tlwrap"><div class="tl">`;
   for (const [key, evs] of lanes) {
     html += `<div class="tl-row"><div class="tl-lane-label">` +
       `${esc(key)}</div><div class="tl-track">`;
+    // Cull-then-cap: zooming in reveals spans the cap hid at full view.
     for (const e of evs.slice(0, 2000)) {
-      const left = ((e.ts - t0) / total * width).toFixed(3);
-      const w = Math.max(e.dur / total * width, 0.05).toFixed(3);
+      const left = ((e.ts - vt0) / vtotal * 100);
+      const w = Math.max(e.dur / vtotal * 100, 0.05);
+      // Clamp the left and RIGHT edges jointly: a span starting far
+      // before the zoom window must keep its true right edge, not
+      // stretch to left+110%.
+      const l2 = Math.max(left, -5);
+      const right = Math.min(left + w, 110);
+      const w2 = Math.max(right - l2, 0.05);
       const failed = (e.args || {}).end_state === 'FAILED';
       const color = failed ? '#c0392b' : laneColor(e.name);
       const tip = `${e.name}  ${(e.dur / 1000).toFixed(2)}ms` +
         (failed ? '  FAILED' : '');
       html += `<div class="tl-bar" title="${esc(tip)}" style="left:` +
-        `${left}%;width:${w}%;background:${color}"></div>`;
+        `${l2.toFixed(3)}%;width:${w2.toFixed(3)}` +
+        `%;background:${color}"></div>`;
     }
     html += '</div></div>';
   }
   return html + '</div></div>';
+}
+let tlDragging = false;  // pauses auto-refresh while panning
+function wireTimeline() {
+  const wrap = $('#tlwrap');
+  if (!wrap) return;
+  wrap.addEventListener('wheel', (e) => {
+    e.preventDefault();
+    const track = wrap.querySelector('.tl-track');
+    if (!track) return;
+    const r = track.getBoundingClientRect();
+    const fx = Math.min(Math.max((e.clientX - r.left) / r.width, 0), 1);
+    const span = tlV1 - tlV0;
+    const factor = e.deltaY < 0 ? 0.8 : 1.25;
+    const ns = Math.min(Math.max(span * factor, 1e-4), 1);
+    const c = tlV0 + fx * span;
+    tlV0 = Math.max(0, c - fx * ns);
+    tlV1 = Math.min(1, tlV0 + ns);
+    tlV0 = Math.max(0, tlV1 - ns);
+    refresh();
+  }, { passive: false });
+  // Pan: live CSS shift during the drag (no re-render — that would
+  // destroy these listeners), commit the new view on mouseup.
+  let startX = null;
+  wrap.addEventListener('mousedown', (e) => {
+    startX = e.clientX; tlDragging = true; e.preventDefault();
+  });
+  wrap.addEventListener('mousemove', (e) => {
+    if (startX === null) return;
+    const dx = e.clientX - startX;
+    wrap.querySelectorAll('.tl-track').forEach(t =>
+      t.style.transform = `translateX(${dx}px)`);
+  });
+  const finish = (e) => {
+    if (startX === null) return;
+    const track = wrap.querySelector('.tl-track');
+    const width = track ? track.getBoundingClientRect().width : 1;
+    const frac = (e.clientX - startX) / width;
+    startX = null; tlDragging = false;
+    const span = tlV1 - tlV0;
+    let v0 = tlV0 - frac * span;
+    v0 = Math.min(Math.max(v0, 0), 1 - span);
+    tlV0 = v0; tlV1 = v0 + span;
+    refresh();
+  };
+  wrap.addEventListener('mouseup', finish);
+  wrap.addEventListener('mouseleave', finish);
 }
 
 const views = {
@@ -291,13 +361,10 @@ const views = {
   },
   async logs() {
     if (logFile) {
-      const r = await fetch('/api/logs/tail?file=' +
-        encodeURIComponent(logFile) + '&lines=500');
-      const text = r.ok ? await r.text() : ('error: ' + r.status);
-      return `<p><a href="#" onclick="logFile=null;refresh();` +
+      return `<p><a href="#" onclick="logFile=null;logGen++;refresh();` +
         `return false">&larr; all logs</a> &nbsp; <b>${esc(logFile)}` +
-        `</b> (last 500 lines, auto-refreshing)</p>` +
-        `<pre class="log">${esc(text)}</pre>`;
+        `</b> (live tail — long-poll push)</p>` +
+        `<pre class="log" id="logpre">connecting…</pre>`;
     }
     const files = await j('/api/logs');
     return table([
@@ -307,11 +374,51 @@ const views = {
   },
 };
 
+// --- push-style log following: long-poll /api/logs/stream ------------
+let logGen = 0;  // bumped whenever the tailed file changes / tab leaves
+async function followLog(file) {
+  const gen = ++logGen;
+  let offset = -1;
+  while (gen === logGen && tab === 'logs' && logFile === file) {
+    let res;
+    try {
+      const r = await fetch('/api/logs/stream?file=' +
+        encodeURIComponent(file) + '&offset=' + offset + '&wait_s=20');
+      if (!r.ok) throw new Error('stream: ' + r.status);
+      res = await r.json();
+    } catch (e) {
+      const pre = $('#logpre');
+      if (pre && gen === logGen) pre.textContent += '\\n[stream error: '
+        + e + ']';
+      await new Promise(ok => setTimeout(ok, 2000));
+      continue;
+    }
+    if (gen !== logGen) return;
+    const pre = $('#logpre');
+    if (!pre) return;
+    if (offset === -1) pre.textContent = '';
+    offset = res.offset;
+    if (res.data) {
+      const stick = pre.scrollTop + pre.clientHeight >=
+        pre.scrollHeight - 8;
+      pre.textContent = (pre.textContent + res.data).slice(-400000);
+      if (stick) pre.scrollTop = pre.scrollHeight;
+    }
+  }
+}
+
 async function refresh() {
+  // Never clobber an interactive view: mid-pan timeline or a streaming
+  // log tail (the long-poll loop updates the <pre> in place).
+  if (tlDragging) return;
+  if (!forceRender && tab === 'logs' && logFile && $('#logpre')) return;
+  forceRender = false;
   try {
     $('#content').innerHTML = await views[tab]();
     $('#ts').textContent = new Date().toLocaleTimeString();
     $('#err').textContent = '';
+    if (tab === 'timeline') wireTimeline();
+    if (tab === 'logs' && logFile) followLog(logFile);
   } catch (e) { $('#err').textContent = String(e); }
 }
 document.querySelectorAll('nav button').forEach(b =>

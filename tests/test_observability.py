@@ -159,6 +159,24 @@ def test_dashboard_endpoints(ray_start_regular):
         # New UI tabs present.
         assert "Timeline" in page and "Logs" in page and \
             "Placement groups" in page
+        # Push-style log streaming: offset=-1 seeds near the tail, and a
+        # follow-up with the returned offset long-polls (wait_s=0 -> an
+        # immediate empty reply when the file hasn't grown).
+        stream = json.loads(get("/api/logs/stream?file=" +
+                                logs[0]["name"] + "&offset=-1&wait_s=0"))
+        assert "offset" in stream and stream["offset"] >= 0
+        again = json.loads(get(
+            "/api/logs/stream?file=" + logs[0]["name"] +
+            f"&offset={stream['offset']}&wait_s=0"))
+        assert again["offset"] >= stream["offset"]
+        traversal_served = True
+        try:
+            get("/api/logs/stream?file=../../etc/passwd&offset=-1&wait_s=0")
+        except Exception:
+            traversal_served = False
+        assert not traversal_served, "stream path traversal not rejected"
+        # Zoom/pan timeline shipped in the page.
+        assert "wireTimeline" in page and "followLog" in page
     finally:
         dash.stop()
 

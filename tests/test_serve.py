@@ -760,3 +760,68 @@ def test_grpc_ingress_tls(ray_start_regular, tmp_path):
             _msgpack.packb({"schema_version": 1, "app": "tls_app",
                             "payload": 1}, use_bin_type=True), timeout=5)
     serve.shutdown()
+
+
+def test_serve_rest_api_via_dashboard(ray_start_regular, tmp_path):
+    """Serve REST API (reference: dashboard serve module — PUT/GET/DELETE
+    /api/serve/applications): declarative deploy over HTTP, status poll,
+    teardown."""
+    import socket
+    import sys
+
+    from ray_tpu.dashboard import start_dashboard
+
+    mod_dir = tmp_path / "rest_apps"
+    mod_dir.mkdir()
+    (mod_dir / "rest_serve_app.py").write_text(
+        "from ray_tpu import serve\n"
+        "\n"
+        "@serve.deployment\n"
+        "class Rev:\n"
+        "    def __call__(self, req):\n"
+        "        return str(req)[::-1]\n"
+        "\n"
+        "app = Rev.bind()\n")
+    sys.path.insert(0, str(mod_dir))
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    dash = start_dashboard(port=port)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        body = json.dumps({"applications": [{
+            "name": "rest_app",
+            "import_path": "rest_serve_app:app",
+            "route_prefix": "/rev",
+        }]}).encode()
+        req = urllib.request.Request(
+            base + "/api/serve/applications", data=body, method="PUT",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert json.loads(r.read())["deployed"] == ["rest_app"]
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    base + "/api/serve/applications", timeout=10) as r:
+                st = json.loads(r.read())
+            app = st.get("applications", {}).get("rest_app", {})
+            if app.get("status") == "RUNNING":
+                break
+            time.sleep(0.5)
+        assert app.get("status") == "RUNNING", st
+
+        handle = serve.get_app_handle("rest_app")
+        assert handle.remote("abc").result(timeout_s=30) == "cba"
+
+        req = urllib.request.Request(
+            base + "/api/serve/applications?name=rest_app",
+            method="DELETE")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read())["deleted"] == "rest_app"
+        st = serve.status()
+        assert "rest_app" not in st.get("applications", {})
+    finally:
+        sys.path.remove(str(mod_dir))
+        dash.stop()
+        serve.shutdown()
