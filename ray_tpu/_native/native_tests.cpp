@@ -1,0 +1,274 @@
+// Native-layer unit tests (SURVEY §4 test tier 1: the reference keeps
+// 111 gtest files beside src/ray; this deployment has no gtest, so this
+// is a dependency-free assert-style binary). It dlopens the SHIPPED
+// .so artifacts (not a re-compile of the sources) so the bits under
+// test are exactly the bits the Python bindings load — and so the two
+// libraries' internal helpers (align_up, lock, ...) can't collide at
+// link time.
+//
+// Driven by tests/test_native_units.py: builds via _native/build.py,
+// compiles this file, runs it, asserts exit code 0.
+
+#include <dlfcn.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,    \
+                   #cond);                                            \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+// shm_store error codes (shm_store.cpp).
+enum {
+  S_OK = 0,
+  S_EXISTS = -1,
+  S_NOT_FOUND = -2,
+  S_FULL = -3,
+  S_TIMEOUT = -4,
+  S_IN_USE = -7,
+};
+// mutable_channel error codes (mutable_channel.cpp).
+enum {
+  C_OK = 0,
+  C_TIMEOUT = -4,
+  C_INVALID = -5,
+  C_CLOSED = -8,
+  C_TOO_LARGE = -9,
+};
+constexpr int kIdSize = 24;
+
+template <typename T>
+static T sym(void* lib, const char* name) {
+  void* p = dlsym(lib, name);
+  if (!p) {
+    std::fprintf(stderr, "missing symbol %s\n", name);
+    std::abort();
+  }
+  return reinterpret_cast<T>(p);
+}
+
+static void make_id(uint8_t* id, uint8_t tag) {
+  std::memset(id, 0, kIdSize);
+  id[0] = tag;
+  id[kIdSize - 1] = tag;
+}
+
+// ----------------------------------------------------------------- store
+
+static int test_store(void* lib, const std::string& dir) {
+  auto create = sym<int (*)(const char*, uint64_t, uint32_t)>(
+      lib, "shm_store_create");
+  auto open_ = sym<void* (*)(const char*)>(lib, "shm_store_open");
+  auto close_ = sym<void (*)(void*)>(lib, "shm_store_close");
+  auto obj_create = sym<int (*)(void*, const uint8_t*, uint64_t,
+                                uint64_t*)>(lib, "shm_create");
+  auto seal = sym<int (*)(void*, const uint8_t*)>(lib, "shm_seal");
+  auto abort_ = sym<int (*)(void*, const uint8_t*)>(lib, "shm_abort");
+  auto get = sym<int (*)(void*, const uint8_t*, long, uint64_t*,
+                         uint64_t*)>(lib, "shm_get");
+  auto release = sym<int (*)(void*, const uint8_t*)>(lib, "shm_release");
+  auto del = sym<int (*)(void*, const uint8_t*)>(lib, "shm_delete");
+  auto contains = sym<int (*)(void*, const uint8_t*)>(lib, "shm_contains");
+  auto base_of = sym<void* (*)(void*)>(lib, "shm_store_base");
+  auto stats = sym<int (*)(void*, uint64_t*, uint64_t*, uint64_t*,
+                           uint64_t*)>(lib, "shm_stats");
+
+  const std::string path = dir + "/store_test.shm";
+  const uint64_t kCap = 1 << 20;  // 1 MiB
+  CHECK(create(path.c_str(), kCap, 64) == 0);
+  CHECK(create(path.c_str(), kCap, 64) < 0);  // O_EXCL: no clobber
+  void* h = open_(path.c_str());
+  CHECK(h != nullptr);
+  uint8_t* base = static_cast<uint8_t*>(base_of(h));
+  CHECK(base != nullptr);
+
+  // create -> write -> seal -> get roundtrip.
+  uint8_t id_a[kIdSize];
+  make_id(id_a, 0xA1);
+  uint64_t off = 0;
+  CHECK(obj_create(h, id_a, 100, &off) == S_OK);
+  CHECK(obj_create(h, id_a, 100, &off) == S_EXISTS);
+  CHECK(contains(h, id_a) == 0);  // unsealed: not visible to get
+  for (int i = 0; i < 100; i++) base[off + i] = static_cast<uint8_t>(i);
+  CHECK(seal(h, id_a) == S_OK);
+  CHECK(contains(h, id_a) == 1);
+  uint64_t goff = 0, gsize = 0;
+  CHECK(get(h, id_a, 0, &goff, &gsize) == S_OK);
+  CHECK(goff == off && gsize == 100);
+  for (int i = 0; i < 100; i++) CHECK(base[goff + i] == i);
+  // Pinned (creator ref + get ref): delete must refuse.
+  CHECK(del(h, id_a) == S_IN_USE);
+  CHECK(release(h, id_a) == S_OK);
+  CHECK(release(h, id_a) == S_OK);
+  CHECK(del(h, id_a) == S_OK);
+  CHECK(contains(h, id_a) == 0);
+
+  // Missing ids: non-blocking miss vs timed-out blocking get.
+  uint8_t id_b[kIdSize];
+  make_id(id_b, 0xB2);
+  CHECK(get(h, id_b, 0, &goff, &gsize) == S_NOT_FOUND);
+  CHECK(get(h, id_b, 50, &goff, &gsize) == S_TIMEOUT);
+
+  // Blocking get satisfied by a concurrent sealer.
+  std::thread producer([&]() {
+    usleep(50 * 1000);
+    uint64_t o = 0;
+    obj_create(h, id_b, 8, &o);
+    std::memcpy(base + o, "blocked!", 8);
+    seal(h, id_b);
+  });
+  CHECK(get(h, id_b, 5000, &goff, &gsize) == S_OK);
+  producer.join();
+  CHECK(gsize == 8 && std::memcmp(base + goff, "blocked!", 8) == 0);
+  CHECK(release(h, id_b) == S_OK);  // get ref; creator ref still held
+
+  // Abort an in-progress create.
+  uint8_t id_c[kIdSize];
+  make_id(id_c, 0xC3);
+  CHECK(obj_create(h, id_c, 64, &off) == S_OK);
+  CHECK(abort_(h, id_c) == S_OK);
+  CHECK(contains(h, id_c) == 0);
+
+  // LRU eviction: fill with released objects, then a create that only
+  // fits if the store evicts. An oversized request still fails cleanly.
+  for (int t = 0; t < 4; t++) {
+    uint8_t id[kIdSize];
+    make_id(id, static_cast<uint8_t>(0xD0 + t));
+    CHECK(obj_create(h, id, 200 << 10, &off) == S_OK);
+    CHECK(seal(h, id) == S_OK);
+    CHECK(release(h, id) == S_OK);
+  }
+  uint8_t id_big[kIdSize];
+  make_id(id_big, 0xEE);
+  CHECK(obj_create(h, id_big, 600 << 10, &off) == S_OK);
+  uint64_t used = 0, cap = 0, nobj = 0, nevict = 0;
+  CHECK(stats(h, &used, &cap, &nobj, &nevict) == S_OK);
+  CHECK(cap == kCap);
+  CHECK(nevict >= 1);
+  CHECK(used <= cap);
+  uint8_t id_huge[kIdSize];
+  make_id(id_huge, 0xFF);
+  CHECK(obj_create(h, id_huge, 2 * kCap, &off) == S_FULL);
+
+  close_(h);
+  return 0;
+}
+
+// --------------------------------------------------------------- channel
+
+static int test_channel(void* lib, const std::string& dir) {
+  auto create = sym<int (*)(const char*, uint64_t, uint32_t, uint32_t)>(
+      lib, "chan_create");
+  auto open_ = sym<void* (*)(const char*)>(lib, "chan_open");
+  auto close_handle = sym<void (*)(void*)>(lib, "chan_close_handle");
+  auto write = sym<int (*)(void*, const uint8_t*, uint64_t, long)>(
+      lib, "chan_write");
+  auto read_acquire = sym<int (*)(void*, uint32_t, uint8_t**, uint64_t*,
+                                  long)>(lib, "chan_read_acquire");
+  auto read_release = sym<int (*)(void*, uint32_t)>(lib,
+                                                    "chan_read_release");
+  auto chan_close = sym<int (*)(void*)>(lib, "chan_close");
+
+  const std::string path = dir + "/chan_test.shm";
+  CHECK(create(path.c_str(), 256, 2, 4) == C_OK);
+  void* h = open_(path.c_str());
+  CHECK(h != nullptr);
+
+  // Single value fans out to BOTH readers (broadcast semantics).
+  CHECK(write(h, reinterpret_cast<const uint8_t*>("hello"), 5, 100)
+        == C_OK);
+  for (uint32_t r = 0; r < 2; r++) {
+    uint8_t* ptr = nullptr;
+    uint64_t len = 0;
+    CHECK(read_acquire(h, r, &ptr, &len, 100) == C_OK);
+    CHECK(len == 5 && std::memcmp(ptr, "hello", 5) == 0);
+    CHECK(read_release(h, r) == C_OK);
+  }
+  // Reader id out of range.
+  {
+    uint8_t* ptr = nullptr;
+    uint64_t len = 0;
+    CHECK(read_acquire(h, 7, &ptr, &len, 0) == C_INVALID);
+  }
+  // Oversized payload.
+  uint8_t big[512];
+  CHECK(write(h, big, sizeof(big), 0) == C_TOO_LARGE);
+
+  // Ring backpressure: with both readers at seq 1 and depth 4, writes
+  // land up to seq 5; seq 6 must time out until a reader advances.
+  uint8_t v = 0;
+  for (int i = 0; i < 4; i++) CHECK(write(h, &v, 1, 100) == C_OK);
+  CHECK(write(h, &v, 1, 50) == C_TIMEOUT);
+  {
+    uint8_t* ptr = nullptr;
+    uint64_t len = 0;
+    CHECK(read_acquire(h, 0, &ptr, &len, 100) == C_OK);
+    CHECK(read_release(h, 0) == C_OK);
+    CHECK(read_acquire(h, 1, &ptr, &len, 100) == C_OK);
+    CHECK(read_release(h, 1) == C_OK);
+  }
+  CHECK(write(h, &v, 1, 100) == C_OK);  // slot reclaimed
+
+  // Writer blocked on a full ring unblocks when a reader drains (the
+  // compiled-DAG actor-loop handoff pattern).
+  std::thread drainer([&]() {
+    usleep(50 * 1000);
+    uint8_t* ptr = nullptr;
+    uint64_t len = 0;
+    for (uint32_t r = 0; r < 2; r++) {
+      while (read_acquire(h, r, &ptr, &len, 0) == C_OK)
+        read_release(h, r);
+    }
+  });
+  CHECK(write(h, &v, 1, 5000) == C_OK);
+  drainer.join();
+
+  // Close: pending writes fail, drained readers see ERR_CLOSED.
+  CHECK(chan_close(h) == C_OK);
+  CHECK(write(h, &v, 1, 100) == C_CLOSED);
+  {
+    uint8_t* ptr = nullptr;
+    uint64_t len = 0;
+    int rc = read_acquire(h, 0, &ptr, &len, 100);
+    while (rc == C_OK) {
+      read_release(h, 0);
+      rc = read_acquire(h, 0, &ptr, &len, 100);
+    }
+    CHECK(rc == C_CLOSED);
+  }
+  close_handle(h);
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <libstore.so> <libchannel.so> <workdir>\n",
+                 argv[0]);
+    return 2;
+  }
+  void* store_lib = dlopen(argv[1], RTLD_NOW | RTLD_LOCAL);
+  if (!store_lib) {
+    std::fprintf(stderr, "dlopen %s: %s\n", argv[1], dlerror());
+    return 2;
+  }
+  void* chan_lib = dlopen(argv[2], RTLD_NOW | RTLD_LOCAL);
+  if (!chan_lib) {
+    std::fprintf(stderr, "dlopen %s: %s\n", argv[2], dlerror());
+    return 2;
+  }
+  const std::string dir = argv[3];
+  if (test_store(store_lib, dir) != 0) return 1;
+  if (test_channel(chan_lib, dir) != 0) return 1;
+  std::printf("NATIVE TESTS PASSED\n");
+  return 0;
+}

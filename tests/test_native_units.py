@@ -1,0 +1,45 @@
+"""Native-layer unit tests (SURVEY §4 tier 1).
+
+The reference tests its C++ components with colocated gtest binaries
+(src/ray/object_manager/plasma tests, *_test.cc). Here the equivalent
+tier is `_native/native_tests.cpp`: a dependency-free assert binary that
+dlopens the SHIPPED .so artifacts (the exact bits the ctypes bindings
+load) and exercises the store and channel C APIs directly — create/seal/
+get/release/delete lifecycle, blocking gets, robust-mutex LRU eviction,
+ring backpressure, broadcast reads, close semantics.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_NATIVE = os.path.join(os.path.dirname(_DIR), "ray_tpu", "_native")
+
+
+@pytest.fixture(scope="module")
+def test_binary(tmp_path_factory):
+    from ray_tpu._native import build
+
+    store_so = build.ensure_built("ray_tpu_store")
+    chan_so = build.ensure_built("ray_tpu_channel")
+    out = str(tmp_path_factory.mktemp("native") / "native_tests")
+    src = os.path.join(_NATIVE, "native_tests.cpp")
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-Wall", "-o", out, src,
+         "-ldl", "-lpthread"],
+        check=True, capture_output=True, text=True)
+    return out, store_so, chan_so
+
+
+def test_native_store_and_channel_units(test_binary, tmp_path):
+    binary, store_so, chan_so = test_binary
+    proc = subprocess.run(
+        [binary, store_so, chan_so, str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (
+        f"native tests failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}")
+    assert "NATIVE TESTS PASSED" in proc.stdout
