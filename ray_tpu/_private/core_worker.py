@@ -408,11 +408,30 @@ class CoreWorker:
             await self.raylet.close()
         if self.gcs:
             await self.gcs.close()
-        # Deliberately do NOT munmap/free the shm store here: executor
-        # and fastlane dispatcher threads may still be mid-user-code
-        # (shutdown(wait=False), bounded joins) and a call into a freed
-        # store handle segfaults the process (observed at 400-actor
-        # kill scale). The mapping dies with the process.
+        # The shm mapping may only be freed when no thread can still
+        # call into it: executor / fastlane dispatcher threads mid-user-
+        # code would segfault on a freed handle (observed at 400-actor
+        # kill scale). Workers are exiting anyway — leak the mapping
+        # there. DRIVERS are long-lived (pytest runs dozens of
+        # init/shutdown cycles in one process), so close when every
+        # worker thread is verifiably quiesced within a bounded join.
+        if self.plasma is not None and self.mode == DRIVER:
+            threads = list(self._fl_dispatchers) + \
+                list(getattr(self._executor, "_threads", []))
+            # Plasma puts also run on the LOOP's default executor
+            # (_put_plasma -> run_in_executor(None, ...)): those threads
+            # must quiesce too or an in-flight put races the close.
+            default_exec = getattr(self.loop, "_default_executor", None)
+            if default_exec is not None:
+                threads += list(getattr(default_exec, "_threads", []))
+            for t in threads:
+                t.join(timeout=0.2)
+            if all(not t.is_alive() for t in threads):
+                try:
+                    self.plasma.close()
+                except Exception:
+                    pass
+                self.plasma = None
 
     async def _on_pubsub(self, method: str, data, conn) -> None:
         if method == "publish" and data["channel"] == "logs":
