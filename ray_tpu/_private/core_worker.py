@@ -420,12 +420,19 @@ class CoreWorker:
                 list(getattr(self._executor, "_threads", []))
             # Plasma puts also run on the LOOP's default executor
             # (_put_plasma -> run_in_executor(None, ...)): those threads
-            # must quiesce too or an in-flight put races the close.
+            # must quiesce too or an in-flight put races the close. They
+            # idle on the work queue until shutdown — signal it NOW
+            # (idle threads wake and exit; a mid-put thread finishes its
+            # item first), else the quiesce check below can never pass.
             default_exec = getattr(self.loop, "_default_executor", None)
             if default_exec is not None:
+                default_exec.shutdown(wait=False)
                 threads += list(getattr(default_exec, "_threads", []))
+            # One shared deadline: per-thread timeouts would stack and
+            # block the loop for 0.2s x thread count.
+            deadline = time.monotonic() + 0.25
             for t in threads:
-                t.join(timeout=0.2)
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
             if all(not t.is_alive() for t in threads):
                 try:
                     self.plasma.close()

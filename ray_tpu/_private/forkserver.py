@@ -72,6 +72,24 @@ def main() -> None:
 
     from ray_tpu._private import worker_main  # noqa: F401  (warms imports)
 
+    # Modules the worker only pulls in lazily AFTER fork (profiled in a
+    # 16-actor storm: concurrent.futures.thread via the first
+    # ThreadPoolExecutor, queue via it, fastlane inside connect()) —
+    # import them here so forks inherit the bytecode. Also dlopen the
+    # native libs: .so mappings survive fork, saving two dlopens per
+    # worker. No threads are created (fork safety); fl_server_create is
+    # NOT called here.
+    import concurrent.futures.thread  # noqa: F401
+    import queue  # noqa: F401
+
+    from ray_tpu.core import fastlane, shm_client
+
+    try:
+        fastlane._load()
+        shm_client._load()
+    except Exception:
+        pass  # workers fall back to loading on demand
+
     in_fd = 0
     out_fd = 1
     while True:

@@ -959,6 +959,23 @@ class Raylet:
         for _ in range(max(0, min_idle - n_idle - n_starting)):
             self._spawn_worker()
 
+    def _schedule_pool_refill(self, delay: float = 0.25) -> None:
+        """Debounced refill for the storm path: replacement spawns must
+        not compete with the storm's own worker bring-ups for CPU (a
+        16-actor storm otherwise pays 32 process starts up front). Each
+        consumed pool worker pushes the timer out; the pool refills in
+        one batch once leases go quiet for `delay`."""
+        handle = getattr(self, "_refill_handle", None)
+        if handle is not None:
+            handle.cancel()
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._maybe_refill_pool()
+            return
+        self._refill_handle = loop.call_later(
+            delay, self._maybe_refill_pool)
+
     def _take_idle_worker(self, tpu: bool = False
                           ) -> Optional[WorkerHandle]:
         keep: List[WorkerHandle] = []
@@ -1097,7 +1114,9 @@ class Raylet:
                                             bundle_key)
                 return {"ok": False, "permanent": True, "error": str(e)}
         else:
-            self._maybe_refill_pool()  # replace the consumed pool worker
+            # Replace the consumed pool worker once the storm quiets
+            # (debounced — replacements off the storm's critical path).
+            self._schedule_pool_refill()
         w.state = "actor"
         w.actor_id = data["actor_id"]
         w.job_id = spec.job_id.binary()
