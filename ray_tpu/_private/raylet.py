@@ -960,11 +960,19 @@ class Raylet:
             self._spawn_worker()
 
     def _schedule_pool_refill(self, delay: float = 0.25) -> None:
-        """Debounced refill for the storm path: replacement spawns must
-        not compete with the storm's own worker bring-ups for CPU (a
-        16-actor storm otherwise pays 32 process starts up front). Each
-        consumed pool worker pushes the timer out; the pool refills in
-        one batch once leases go quiet for `delay`."""
+        """Refill after a consumed pool worker — debounced ONLY while a
+        storm is in flight: replacement spawns must not compete with the
+        storm's own worker bring-ups for CPU (a 16-actor storm otherwise
+        pays 32 process starts up front), but steady sub-`delay` actor
+        creation must not starve the refill either (each consumption
+        re-arming the timer would drain the pool and force cold inline
+        spawns). Heuristic: spawns already in flight = storm = debounce;
+        quiet pool = refill immediately."""
+        n_starting = sum(1 for w in self.workers.values()
+                         if w.state == "starting")
+        if n_starting == 0:
+            self._maybe_refill_pool()
+            return
         handle = getattr(self, "_refill_handle", None)
         if handle is not None:
             handle.cancel()

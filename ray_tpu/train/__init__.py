@@ -7,6 +7,8 @@ Parity map to the reference (python/ray/train/):
 - report/get_checkpoint/get_context <- _internal/session.py:403,754
 - Checkpoint                        <- _checkpoint.py:56
 - ScalingConfig/RunConfig/...       <- ray.air.config (re-exported)
+- huggingface (prepare_trainer, RayTrainReportCallback, flax_train_step)
+                                    <- huggingface/transformers/
 """
 
 from ray_tpu.air import (CheckpointConfig, FailureConfig, Result, RunConfig,

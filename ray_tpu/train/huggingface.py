@@ -1,0 +1,180 @@
+"""HuggingFace Transformers integration.
+
+Reference: python/ray/train/huggingface/transformers/ —
+``RayTrainReportCallback`` (bridges transformers.Trainer logs/saves into
+ray.train.report) and ``prepare_trainer`` (injects the callback +
+distributed plumbing). TPU-native differences:
+
+- The torch path is unchanged in spirit: a ``transformers.TrainerCallback``
+  that forwards each HF log to :func:`ray_tpu.train.report`, attaching the
+  just-saved HF checkpoint directory as a ray_tpu Checkpoint. Runs under
+  :class:`ray_tpu.train.torch.TorchTrainer` (gloo/CPU here).
+- The flagship path is Flax-on-TPU: ``flax_train_step`` builds a jitted
+  GSPMD train step for any HF Flax model (``Flax*ForCausalLM`` etc.)
+  directly from ``model.__call__`` — no DDP/accelerate wrapper layer, the
+  mesh sharding IS the distribution strategy. Run it inside a
+  ``JaxTrainer`` train loop.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+
+def _transformers():
+    import transformers
+
+    return transformers
+
+
+# --------------------------------------------------------------------------
+# torch Trainer bridge (reference: RayTrainReportCallback)
+# --------------------------------------------------------------------------
+
+def RayTrainReportCallback():
+    """Build the transformers→ray_tpu reporting callback.
+
+    Factory (not a module-level class) so importing this module never
+    hard-requires transformers. Each ``on_log`` reports the HF metrics;
+    if a checkpoint was saved since the last report it ships with the
+    metrics (reference: _transformers_utils.py RayTrainReportCallback —
+    same save-then-report ordering so the checkpoint matches the step).
+    """
+    transformers = _transformers()
+
+    from ray_tpu import train
+
+    class _Callback(transformers.TrainerCallback):
+        def __init__(self):
+            self._pending_ckpt_dir: Optional[str] = None
+
+        def on_save(self, args, state, control, **kwargs):
+            # Snapshot the HF checkpoint into a private dir NOW:
+            # save_total_limit rotation may delete the original before
+            # the (queued) report is persisted by the driver, and a
+            # by-reference path would then fail the whole run.
+            import shutil
+            import tempfile
+
+            src = os.path.join(args.output_dir,
+                               f"checkpoint-{state.global_step}")
+            if os.path.isdir(src):
+                dst = tempfile.mkdtemp(prefix="ray_tpu_hf_ckpt_")
+                snap = os.path.join(dst, os.path.basename(src))
+                shutil.copytree(src, snap)
+                self._pending_ckpt_dir = snap
+            return control
+
+        def on_log(self, args, state, control, logs=None, **kwargs):
+            metrics = dict(logs or {})
+            metrics["step"] = state.global_step
+            metrics["epoch"] = float(state.epoch or 0)
+            ckpt = None
+            if self._pending_ckpt_dir and \
+                    os.path.isdir(self._pending_ckpt_dir):
+                ckpt = train.Checkpoint(self._pending_ckpt_dir)
+                self._pending_ckpt_dir = None
+            train.report(metrics, checkpoint=ckpt)
+            return control
+
+    return _Callback()
+
+
+def prepare_trainer(trainer):
+    """Attach the ray_tpu reporting callback to a transformers.Trainer
+    (idempotent). Reference: huggingface/transformers/prepare_trainer."""
+    transformers = _transformers()
+    has_ours = any(
+        type(cb).__name__ == "_Callback" and
+        type(cb).__qualname__.startswith("RayTrainReportCallback")
+        for cb in trainer.callback_handler.callbacks)
+    if not has_ours:
+        trainer.add_callback(RayTrainReportCallback())
+    # transformers' own printing is redundant under a train session.
+    trainer.remove_callback(transformers.PrinterCallback)
+    return trainer
+
+
+# --------------------------------------------------------------------------
+# Flax-on-TPU path (flagship): jitted GSPMD step for any HF Flax model
+# --------------------------------------------------------------------------
+
+def flax_causal_lm_loss(model) -> Callable:
+    """Next-token cross-entropy loss closed over an HF Flax causal-LM.
+
+    Works with any ``Flax*ForCausalLM``/``Flax*LMHeadModel``: the batch is
+    ``{"input_ids": [B, S+1]}``; logits come from ``model(inputs,
+    params=params, train=False)`` — the functional entry point every
+    FlaxPreTrainedModel exposes. NOTE: the step is deterministic —
+    dropout is DISABLED (train=False; the fixed loss_fn(params, batch)
+    signature carries no rng). Zero the *_pdrop fields in the config if
+    you need parity with a dropout-regularized HF Trainer run."""
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        tokens = batch["input_ids"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        # model.__call__ with an explicit params= override is the
+        # functional entry point every FlaxPreTrainedModel exposes
+        # (handles attention_mask/position_id defaults per arch).
+        out = model(inputs, params=params, train=False)
+        logits = out.logits if hasattr(out, "logits") else out[0]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), targets).mean()
+
+    return loss_fn
+
+
+def flax_train_step(model, optimizer, mesh=None,
+                    param_specs: Any = None,
+                    loss_fn: Optional[Callable] = None):
+    """(init_fn, step_fn) for fine-tuning an HF Flax model under GSPMD.
+
+    Defaults: fully-replicated params on a 1-axis dp mesh of all visible
+    devices — pass a mesh + param_specs for fsdp/tp layouts. The step is
+    the same donated, jitted train step the native models use
+    (ray_tpu.models.training.make_sharded_train_step), so HF models get
+    the identical TPU execution path."""
+    import jax
+
+    from ray_tpu.models.training import make_sharded_train_step
+    from ray_tpu.parallel import create_mesh
+
+    if mesh is None:
+        mesh = create_mesh({"dp": len(jax.devices())}, jax.devices())
+    if param_specs is None:
+        from jax.sharding import PartitionSpec
+
+        param_specs = jax.tree_util.tree_map(
+            lambda _: PartitionSpec(), model.params)
+    return make_sharded_train_step(
+        loss_fn or flax_causal_lm_loss(model), optimizer, mesh,
+        param_specs)
+
+
+def save_flax_checkpoint(model, params, directory: str) -> str:
+    """Persist HF config + params as a reloadable directory checkpoint."""
+    os.makedirs(directory, exist_ok=True)
+    model.config.save_pretrained(directory)
+    from ray_tpu.train.array_checkpoint import save_pytree
+
+    save_pytree(params, os.path.join(directory, "flax_params"))
+    return directory
+
+
+def load_flax_checkpoint(model_cls, directory: str):
+    """Rebuild (model, params) from :func:`save_flax_checkpoint`."""
+    transformers = _transformers()
+    config = transformers.AutoConfig.from_pretrained(directory)
+    model = model_cls(config, seed=0)
+    from ray_tpu.train.array_checkpoint import restore_pytree
+
+    params = restore_pytree(model.params,
+                            os.path.join(directory, "flax_params"))
+    # Bind the restored weights as the model's own: bare model(inputs)
+    # (the normal HF calling convention) must NOT silently run the
+    # constructor's random init.
+    model.params = params
+    return model, params
