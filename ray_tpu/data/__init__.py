@@ -16,7 +16,7 @@ from ray_tpu.data.dataset import (DataIterator, Dataset, from_arrow,
                                   range, read_binary_files, read_csv,
                                   read_images, read_json, read_numpy,
                                   read_parquet,
-                                  read_text)
+                                  read_text, read_tfrecords)
 from ray_tpu.data import preprocessors
 
 __all__ = [
@@ -36,4 +36,5 @@ __all__ = [
     "read_numpy",
     "read_parquet",
     "read_text",
+    "read_tfrecords",
 ]

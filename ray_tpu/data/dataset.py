@@ -357,6 +357,21 @@ class Dataset:
 
         return self._write_blocks(path, "npy", one)
 
+    def write_tfrecords(self, path: str) -> List[str]:
+        """tf.train.Example records, one file per block (reference:
+        Dataset.write_tfrecords). Gated on tensorflow."""
+        def one(block: Block, out: str):
+            import tensorflow as tf
+
+            from ray_tpu.data.block import block_to_rows
+
+            with tf.io.TFRecordWriter(out) as w:
+                for row in block_to_rows(block):
+                    w.write(datasource.row_to_tf_example(
+                        row).SerializeToString())
+
+        return self._write_blocks(path, "tfrecords", one)
+
     # ---------------- consumption ----------------
     def take(self, n: int = 20) -> List[Dict[str, Any]]:
         out: List[Dict[str, Any]] = []
@@ -678,3 +693,9 @@ def read_images(paths, *, size=None, mode: str = None,
     read_api.py:792 read_images)."""
     return Dataset(datasource.image_tasks(paths, size=size, mode=mode,
                                           include_paths=include_paths))
+
+
+def read_tfrecords(paths) -> Dataset:
+    """Parse tf.train.Example TFRecord files into column rows
+    (reference: read_api.py read_tfrecords). Gated on tensorflow."""
+    return Dataset(datasource.tfrecord_tasks(paths))

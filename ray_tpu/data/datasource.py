@@ -178,3 +178,95 @@ def numpy_file_tasks(paths, column: str = "data") -> List[ReadTask]:
             return block_from_numpy({column: arr})
         return read
     return [make(f) for f in files]
+
+
+def tfrecord_tasks(paths) -> List[ReadTask]:
+    """Parse TFRecord files of tf.train.Example into arrow blocks
+    (reference: read_api.py read_tfrecords /
+    _internal/datasource/tfrecords_datasource.py). Feature decoding
+    follows the reference: bytes_list/float_list/int64_list; a feature
+    with exactly one value becomes a scalar column, several values a
+    list column. Gated on tensorflow (the wire format's Example proto
+    lives there)."""
+    files = _expand_paths(paths)
+
+    def make(f: str) -> ReadTask:
+        def read() -> Block:
+            try:
+                import tensorflow as tf
+            except ImportError as e:
+                raise ImportError(
+                    "read_tfrecords requires tensorflow for the "
+                    "tf.train.Example wire format") from e
+            import pyarrow as pa
+
+            columns: Dict[str, list] = {}
+            rows = 0
+            for raw in tf.data.TFRecordDataset([f]):
+                ex = tf.train.Example()
+                ex.ParseFromString(bytes(raw.numpy()))
+                rows += 1
+                for name, feat in ex.features.feature.items():
+                    kind = feat.WhichOneof("kind")
+                    if kind == "bytes_list":
+                        vals = list(feat.bytes_list.value)
+                    elif kind == "float_list":
+                        vals = list(feat.float_list.value)
+                    elif kind == "int64_list":
+                        vals = list(feat.int64_list.value)
+                    else:
+                        vals = []
+                    col = columns.setdefault(name, [None] * (rows - 1))
+                    col.append(vals)
+                for name, col in columns.items():
+                    if len(col) < rows:
+                        col.append(None)  # feature absent in this record
+            # Column shape is decided PER COLUMN over the whole FILE:
+            # unwrapping only single-value rows would mix scalars and
+            # lists in one column (ArrowInvalid) when lengths vary.
+            # (The Example wire format drops the scalar/list
+            # distinction, so a file whose every value has length 1
+            # necessarily reads back as scalars — same ambiguity as the
+            # reference's tfrecords datasource.)
+            out = {}
+            for name, col in columns.items():
+                if all(v is None or len(v) == 1 for v in col):
+                    out[name] = [None if v is None else v[0] for v in col]
+                else:
+                    out[name] = col
+            return pa.table(out)
+        return read
+    return [make(f) for f in files]
+
+
+def row_to_tf_example(row: Dict[str, Any]):
+    """One dataset row -> tf.train.Example (write_tfrecords helper)."""
+    import tensorflow as tf
+
+    feats = {}
+    for name, value in row.items():
+        if isinstance(value, (list, tuple, np.ndarray)):
+            vals = [v for v in value if v is not None]
+        elif value is None:
+            vals = []  # nullable column -> empty feature
+        else:
+            vals = [value]
+        if not vals:
+            feats[name] = tf.train.Feature()
+        elif isinstance(vals[0], bytes):
+            feats[name] = tf.train.Feature(
+                bytes_list=tf.train.BytesList(value=vals))
+        elif isinstance(vals[0], str):
+            feats[name] = tf.train.Feature(
+                bytes_list=tf.train.BytesList(
+                    value=[v.encode() for v in vals]))
+        elif isinstance(vals[0], (int, np.integer, bool, np.bool_)):
+            feats[name] = tf.train.Feature(
+                int64_list=tf.train.Int64List(
+                    value=[int(v) for v in vals]))
+        else:
+            feats[name] = tf.train.Feature(
+                float_list=tf.train.FloatList(
+                    value=[float(v) for v in vals]))
+    return tf.train.Example(
+        features=tf.train.Features(feature=feats))

@@ -48,6 +48,11 @@ def RayTrainReportCallback():
     class _Callback(transformers.TrainerCallback):
         def __init__(self):
             self._pending_ckpt_dir: Optional[str] = None
+            # Snapshot dirs, oldest first. Older entries have been
+            # reported and (with the session's shallow report queue)
+            # persisted by the driver; keeping the latest two bounds
+            # disk use at ~2 model copies instead of one per save.
+            self._snapshots: list = []
 
         def on_save(self, args, state, control, **kwargs):
             # Snapshot the HF checkpoint into a private dir NOW:
@@ -64,6 +69,10 @@ def RayTrainReportCallback():
                 snap = os.path.join(dst, os.path.basename(src))
                 shutil.copytree(src, snap)
                 self._pending_ckpt_dir = snap
+                self._snapshots.append(dst)
+                while len(self._snapshots) > 2:
+                    shutil.rmtree(self._snapshots.pop(0),
+                                  ignore_errors=True)
             return control
 
         def on_log(self, args, state, control, logs=None, **kwargs):
