@@ -109,9 +109,14 @@ const PILL_OK = /^[A-Z_]+$/;
 const pill = (s) => PILL_OK.test(String(s)) ?
   `<span class="pill ${s}">${s}</span>` : esc(s);
 // Cell renderers returning plain values are HTML-escaped; only the
-// pill() helper (validated charset) emits markup.
-const cell = (v) => (typeof v === 'string' && v.startsWith('<span class="pill '))
-  ? v : esc(v ?? '');
+// pill() helper (validated charset) and sparkline() (a TAGGED object
+// wrapping numeric-only SVG built in-page — a string prefix check
+// would let user-chosen names smuggle markup) emit raw HTML.
+const cell = (v) => {
+  if (v && typeof v === 'object' && v.__svg) return v.__svg;
+  return (typeof v === 'string' && v.startsWith('<span class="pill '))
+    ? v : esc(v ?? '');
+};
 // rows with onRow get a click handler (drill-down): rows are stashed in
 // window._rows and referenced by index — no user data inside handlers.
 const table = (cols, rows, onRow) => {
@@ -264,11 +269,43 @@ function wireTimeline() {
   wrap.addEventListener('mouseleave', finish);
 }
 
+// --- metric history + sparklines (client-side time series; the
+// reference embeds Grafana panels — here each refresh appends the
+// node gauges to an in-page ring so trends render without a TSDB) ----
+const METRIC_HISTORY = 120;  // samples (~6 min at the 3s refresh)
+const metricHist = new Map();  // key -> [values]
+function recordMetric(key, value) {
+  if (typeof value !== 'number' || !isFinite(value)) return;
+  let h = metricHist.get(key);
+  if (!h) { h = []; metricHist.set(key, h); }
+  h.push(value);
+  if (h.length > METRIC_HISTORY) h.shift();
+}
+function sparkline(key, width = 120, height = 26) {
+  const h = metricHist.get(key) || [];
+  if (h.length < 2) return '';
+  let min = Math.min(...h), max = Math.max(...h);
+  if (max === min) { max += 1; }
+  const pts = h.map((v, i) =>
+    `${(i / (h.length - 1) * width).toFixed(1)},` +
+    `${(height - 2 - (v - min) / (max - min) * (height - 4)).toFixed(1)}`
+  ).join(' ');
+  return { __svg: `<svg width="${width}" height="${height}" ` +
+    `style="vertical-align:middle"><polyline points="${pts}" ` +
+    `fill="none" stroke="#4a7dba" stroke-width="1.5"/></svg>` };
+}
+
 const views = {
   async overview() {
     const [cs, stats] = await Promise.all(
       [j('/api/cluster_status'), j('/api/node_stats')]);
     const res = cs.resources || {};
+    for (const row of stats) {
+      recordMetric(row.node_id + ':cpu', row['node.cpu_percent']);
+      recordMetric(row.node_id + ':mem', row['node.mem_available_bytes']);
+      recordMetric(row.node_id + ':store',
+                   row['node.object_store_used_bytes']);
+    }
     const cards = [
       ['nodes alive', `${cs.nodes_alive}/${cs.nodes_total}`],
       ['CPUs', `${(res.available||{}).CPU ?? '?'} / ${(res.total||{}).CPU ?? '?'}`],
@@ -281,8 +318,11 @@ const views = {
       ['node', r => (r.node_id || '').slice(0, 8)],
       ['host', r => r.hostname],
       ['cpu %', r => r['node.cpu_percent']?.toFixed(1)],
+      ['cpu trend', r => sparkline(r.node_id + ':cpu')],
       ['mem avail', r => fmtBytes(r['node.mem_available_bytes'])],
+      ['mem trend', r => sparkline(r.node_id + ':mem')],
       ['store used', r => fmtBytes(r['node.object_store_used_bytes'])],
+      ['store trend', r => sparkline(r.node_id + ':store')],
       ['store cap', r => fmtBytes(r['node.object_store_capacity_bytes'])],
       ['tpu free/total', r => r['node.tpu_total'] ?
         `${r['node.tpu_available']}/${r['node.tpu_total']}` : '-'],

@@ -12,7 +12,8 @@ batches straight onto a mesh sharding.
 
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import (DataIterator, Dataset, from_arrow,
-                                  from_items, from_numpy, from_pandas,
+                                  from_huggingface, from_items,
+                                  from_numpy, from_pandas, from_torch,
                                   range, read_binary_files, read_csv,
                                   read_images, read_json, read_numpy,
                                   read_parquet,
@@ -24,9 +25,11 @@ __all__ = [
     "DataIterator",
     "Dataset",
     "from_arrow",
+    "from_huggingface",
     "from_items",
     "from_numpy",
     "from_pandas",
+    "from_torch",
     "preprocessors",
     "range",
     "read_binary_files",

@@ -699,3 +699,44 @@ def read_tfrecords(paths) -> Dataset:
     """Parse tf.train.Example TFRecord files into column rows
     (reference: read_api.py read_tfrecords). Gated on tensorflow."""
     return Dataset(datasource.tfrecord_tasks(paths))
+
+
+def from_huggingface(hf_dataset, *, parallelism: int = 8) -> Dataset:
+    """HuggingFace ``datasets.Dataset`` -> Dataset (reference:
+    read_api.py from_huggingface). Zero-copy: hf datasets are
+    arrow-backed; the underlying table is sliced into blocks."""
+    # select/filter/shuffle leave an indices mapping over the ORIGINAL
+    # backing table — reading .data raw would silently return
+    # pre-filter rows. Materialize the view first.
+    if getattr(hf_dataset, "_indices", None) is not None:
+        hf_dataset = hf_dataset.flatten_indices()
+    data = getattr(hf_dataset, "data", None)
+    table = getattr(data, "table", data)
+    if table is None or not hasattr(table, "num_rows"):
+        raise TypeError(
+            f"expected a datasets.Dataset (arrow-backed); got "
+            f"{type(hf_dataset).__name__}")
+    table = table.combine_chunks()
+    n = table.num_rows
+    k = max(1, min(parallelism, n or 1))
+    step = (n + k - 1) // k if n else 1
+
+    def make(off):
+        return lambda: table.slice(off, step)
+
+    return Dataset([make(off) for off in
+                    builtins.range(0, max(n, 1), step)])
+
+
+def from_torch(torch_dataset) -> Dataset:
+    """Map-style torch Dataset -> row Dataset (reference: read_api.py
+    from_torch — each item becomes a row; tensor items land under
+    'item')."""
+    items = []
+    for i in builtins.range(len(torch_dataset)):
+        item = torch_dataset[i]
+        if not isinstance(item, dict):
+            item = {"item": item}
+        items.append({k: (v.numpy() if hasattr(v, "numpy") else v)
+                      for k, v in item.items()})
+    return from_items(items)

@@ -217,7 +217,12 @@ def tfrecord_tasks(paths) -> List[ReadTask]:
                     else:
                         vals = []
                     col = columns.setdefault(name, [None] * (rows - 1))
-                    col.append(vals)
+                    # Empty feature = null (the wire format cannot
+                    # distinguish an empty list from a missing value;
+                    # write_tfrecords emits empty features for None) —
+                    # keeping [] here would force the whole column to
+                    # list type and break scalar unwrapping.
+                    col.append(vals if vals else None)
                 for name, col in columns.items():
                     if len(col) < rows:
                         col.append(None)  # feature absent in this record

@@ -48,11 +48,14 @@ def RayTrainReportCallback():
     class _Callback(transformers.TrainerCallback):
         def __init__(self):
             self._pending_ckpt_dir: Optional[str] = None
-            # Snapshot dirs, oldest first. Older entries have been
-            # reported and (with the session's shallow report queue)
-            # persisted by the driver; keeping the latest two bounds
-            # disk use at ~2 model copies instead of one per save.
+            # Snapshot dirs, oldest first. A snapshot may only be
+            # deleted once the driver has persisted its report — the
+            # session queues up to 8 undrained reports
+            # (_TrainSession Semaphore(8)), so retention must exceed
+            # that depth or a still-queued checkpoint's dir could be
+            # pruned before the driver copies it.
             self._snapshots: list = []
+            self._max_snapshots = 9
 
         def on_save(self, args, state, control, **kwargs):
             # Snapshot the HF checkpoint into a private dir NOW:
@@ -70,7 +73,7 @@ def RayTrainReportCallback():
                 shutil.copytree(src, snap)
                 self._pending_ckpt_dir = snap
                 self._snapshots.append(dst)
-                while len(self._snapshots) > 2:
+                while len(self._snapshots) > self._max_snapshots:
                     shutil.rmtree(self._snapshots.pop(0),
                                   ignore_errors=True)
             return control

@@ -628,3 +628,49 @@ def test_tfrecords_roundtrip(ray_start_regular, tmp_path):
     assert list(got[0]["vec"]) == [7]
     assert list(got[1]["vec"]) == [1, 2, 3]
     assert got[2]["vec"] in (None, [], [None])
+
+
+def test_from_huggingface(ray_start_regular):
+    """HF datasets.Dataset -> ray_tpu Dataset, zero-copy arrow path
+    (reference: read_api.py from_huggingface)."""
+    hf_datasets = pytest.importorskip("datasets")
+    from ray_tpu import data
+
+    hf_ds = hf_datasets.Dataset.from_dict(
+        {"x": list(__import__('builtins').range(10)),
+         "label": [f"l{i}" for i in __import__('builtins').range(10)]})
+    ds = data.from_huggingface(hf_ds, parallelism=3)
+    rows = sorted(ds.take_all(), key=lambda r: r["x"])
+    assert [r["x"] for r in rows] == list(__import__('builtins').range(10))
+    assert rows[3]["label"] == "l3"
+    assert ds.count() == 10
+
+    # A select/filter view keeps an indices mapping over the full
+    # backing table — conversion must materialize it, not leak the
+    # pre-filter rows.
+    view = hf_ds.select([1, 4, 7])
+    got = sorted(r["x"] for r in
+                 data.from_huggingface(view).take_all())
+    assert got == [1, 4, 7]
+
+    with pytest.raises(TypeError, match="arrow-backed"):
+        data.from_huggingface([1, 2, 3])
+
+
+def test_from_torch(ray_start_regular):
+    """Map-style torch Dataset -> rows (reference: from_torch)."""
+    torch = pytest.importorskip("torch")
+
+    from ray_tpu import data
+
+    class TDS(torch.utils.data.Dataset):
+        def __len__(self):
+            return 6
+
+        def __getitem__(self, i):
+            return {"t": torch.full((2,), i), "i": i}
+
+    ds = data.from_torch(TDS())
+    rows = sorted(ds.take_all(), key=lambda r: r["i"])
+    assert len(rows) == 6
+    np.testing.assert_array_equal(rows[4]["t"], np.full((2,), 4))

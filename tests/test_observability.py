@@ -175,8 +175,9 @@ def test_dashboard_endpoints(ray_start_regular):
         except Exception:
             traversal_served = False
         assert not traversal_served, "stream path traversal not rejected"
-        # Zoom/pan timeline shipped in the page.
+        # Zoom/pan timeline + metric sparklines shipped in the page.
         assert "wireTimeline" in page and "followLog" in page
+        assert "sparkline" in page and "recordMetric" in page
     finally:
         dash.stop()
 
