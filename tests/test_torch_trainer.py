@@ -50,3 +50,48 @@ def test_torch_trainer_ddp_two_workers(ray_start_regular):
     result = trainer.fit()
     assert result.metrics["loss"] >= 0.0
     assert result.metrics["ddp_in_sync"] is True
+
+
+def test_accelerate_inside_torch_trainer(ray_start_regular):
+    """HF Accelerate rides the process group TorchTrainer sets up
+    (reference: train/tests/test_torch_accelerate.py — Ray supplies
+    placement + rendezvous; Accelerator discovers the live group)."""
+    pytest.importorskip("accelerate")
+
+    def loop(config):
+        import torch
+        import torch.distributed as dist
+        import torch.nn as nn
+        from accelerate import Accelerator
+
+        acc = Accelerator(cpu=True)
+        assert acc.num_processes == dist.get_world_size() == 2
+        assert acc.process_index == dist.get_rank()
+
+        torch.manual_seed(0)
+        model = nn.Linear(4, 1)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        model, opt = acc.prepare(model, opt)
+        torch.manual_seed(7 + acc.process_index)
+        for _ in range(3):
+            x = torch.randn(8, 4)
+            y = x.sum(dim=1, keepdim=True)
+            loss = ((model(x) - y) ** 2).mean()
+            opt.zero_grad()
+            acc.backward(loss)
+            opt.step()
+        # accelerate's DDP wrap keeps ranks in sync like raw DDP.
+        w = acc.unwrap_model(model).weight.detach().clone()
+        gathered = [torch.zeros_like(w) for _ in range(2)]
+        dist.all_gather(gathered, w)
+        train.report({
+            "in_sync": bool(torch.allclose(gathered[0], gathered[1])),
+            "loss": float(loss)})
+
+    result = TorchTrainer(
+        loop,
+        torch_config=TorchConfig(backend="gloo"),
+        scaling_config=ScalingConfig(num_workers=2),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["in_sync"] is True
