@@ -957,8 +957,7 @@ class GcsServer:
             locs.discard(data["node_id"])
         return True
 
-    async def handle_get_object_locations(self, data, conn) -> dict:
-        oid = data["object_id"]
+    def _object_location_view(self, oid: bytes) -> dict:
         return {
             "nodes": [
                 self.nodes[NodeID(n)].view()
@@ -968,6 +967,14 @@ class GcsServer:
             ],
             "spilled_url": self.spilled_objects.get(oid),
         }
+
+    async def handle_get_object_locations(self, data, conn) -> dict:
+        """Single oid ('object_id') or batch ('object_ids' -> 'batch'
+        list, one entry per oid in order) — N refs cost one RPC."""
+        if "object_ids" in data:
+            return {"batch": [self._object_location_view(o)
+                              for o in data["object_ids"]]}
+        return self._object_location_view(data["object_id"])
 
     async def handle_add_spilled_object(self, data, conn) -> bool:
         self.spilled_objects[data["object_id"]] = data["url"]

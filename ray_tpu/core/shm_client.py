@@ -243,6 +243,19 @@ class ShmClient:
     def contains(self, object_id: ObjectID) -> bool:
         return bool(_load().shm_contains(self._ptr, object_id.binary()))
 
+    def object_size(self, object_id: ObjectID) -> Optional[int]:
+        """Size of a locally-resident sealed object (None if absent).
+        Pins briefly via shm_get(timeout=0) + release."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        lib = _load()
+        rc = lib.shm_get(self._ptr, object_id.binary(), 0,
+                         ctypes.byref(off), ctypes.byref(size))
+        if rc != OK:
+            return None
+        lib.shm_release(self._ptr, object_id.binary())
+        return size.value
+
     def delete(self, object_id: ObjectID) -> bool:
         return _load().shm_delete(self._ptr, object_id.binary()) == OK
 

@@ -1,0 +1,7 @@
+"""ray_tpu.experimental — misc APIs mirroring python/ray/experimental/:
+locations (get_object_locations), tqdm_ray (distributed progress bars),
+channel (compiled-graph channels)."""
+
+from ray_tpu.experimental.locations import get_object_locations
+
+__all__ = ["get_object_locations"]

@@ -1,0 +1,46 @@
+"""Object locations API.
+
+Reference: python/ray/experimental/locations.py
+(``ray.experimental.get_object_locations`` — per-ref node ids + size
+from the owner's object directory). Here the GCS object directory
+(gcs_server handle_get_object_locations) is the source of truth; the
+local shm store supplies the size when the object is resident on this
+node, and spilled objects report their external-storage URL.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+
+def get_object_locations(obj_refs: List[Any],
+                         timeout_ms: int = -1) -> Dict[Any, dict]:
+    """{ref: {"node_ids": [hex], "object_size": int|None,
+    "spilled_url": str|None, "did_spill": bool}} for each ref.
+
+    One batched GCS round-trip regardless of len(obj_refs);
+    timeout_ms < 0 means the default RPC timeout."""
+    from ray_tpu._private.worker import global_worker
+
+    worker = global_worker()
+    oids = [ref.id.binary() if hasattr(ref.id, "binary") else ref.id
+            for ref in obj_refs]
+    kwargs = {}
+    if timeout_ms >= 0:
+        kwargs["timeout"] = max(timeout_ms / 1000.0, 0.001)
+    reply = worker.gcs_call("get_object_locations",
+                            {"object_ids": oids}, **kwargs)
+    plasma = getattr(worker.core, "plasma", None)  # None in client mode
+    out: Dict[Any, dict] = {}
+    for ref, info in zip(obj_refs, reply["batch"]):
+        nodes = [n["node_id"].hex() if isinstance(n["node_id"], bytes)
+                 else str(n["node_id"]) for n in info.get("nodes", [])]
+        size = plasma.object_size(ref.id) if plasma is not None else None
+        spilled = info.get("spilled_url")
+        out[ref] = {
+            "node_ids": nodes,
+            "object_size": size,
+            "spilled_url": spilled,
+            "did_spill": spilled is not None,
+        }
+    return out

@@ -1,0 +1,95 @@
+"""Distributed progress bars.
+
+Reference: python/ray/experimental/tqdm_ray.py — a tqdm-compatible bar
+whose updates flow from workers to the driver instead of fighting over
+the worker's (invisible) terminal. TPU-native simplification: updates
+ride the EXISTING worker-log streaming plane (worker stdout → raylet
+log monitor → GCS pubsub → driver console), as throttled single-line
+progress records — no extra channel, and bars from any number of
+workers interleave as ordinary prefixed driver lines.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any, Iterable, Optional
+
+# At most one line per bar per this interval (plus first/last update):
+# progress is chatty, the log plane is shared.
+_MIN_INTERVAL_S = 0.5
+
+
+class tqdm:  # noqa: N801  (tqdm-compatible name)
+    """Subset-compatible with tqdm.tqdm: iterable wrapping, update(),
+    set_description(), close(); total/desc/position kwargs accepted."""
+
+    def __init__(self, iterable: Optional[Iterable] = None, *,
+                 desc: str = "", total: Optional[int] = None,
+                 position: int = 0, **_ignored: Any):
+        self._iterable = iterable
+        self.desc = desc
+        if total is None and iterable is not None:
+            try:
+                total = len(iterable)  # type: ignore[arg-type]
+            except TypeError:
+                total = None
+        self.total = total
+        self.n = 0
+        self._last_print = 0.0
+        self._closed = False
+        self._emit(force=True)
+
+    # ---- tqdm API subset ----
+    def update(self, n: int = 1) -> None:
+        self.n += n
+        self._emit()
+
+    def set_description(self, desc: str, refresh: bool = True) -> None:
+        self.desc = desc
+        if refresh:
+            self._emit()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._emit(force=True)
+
+    def __iter__(self):
+        if self._iterable is None:
+            raise TypeError("tqdm bar created without an iterable")
+        try:
+            for item in self._iterable:
+                yield item
+                self.update(1)
+        finally:
+            self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ---- emission ----
+    def _emit(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_print < _MIN_INTERVAL_S:
+            return
+        self._last_print = now
+        total = f"/{self.total}" if self.total is not None else ""
+        desc = f"{self.desc}: " if self.desc else ""
+        state = " done" if self._closed else ""
+        # Plain stdout: on a worker this streams to the driver console
+        # via the log monitor; on the driver it prints directly.
+        print(f"[tqdm_ray pid={os.getpid()}] {desc}{self.n}{total}{state}",
+              flush=True)
+
+
+def safe_print(*args: Any, **kwargs: Any) -> None:
+    """Reference-compat shim (tqdm_ray.safe_print): plain print — bars
+    here are ordinary log lines, so prints never corrupt them."""
+    kwargs.setdefault("file", sys.stdout)
+    print(*args, **kwargs)
