@@ -28,7 +28,6 @@ from ray_tpu.collective.coordinator import (COORDINATOR_NAME,
                                             COORDINATOR_NAMESPACE,
                                             CollectiveCoordinator, ReduceOp)
 
-_local = threading.local()
 _DEFAULT_TIMEOUT_S = 120.0
 # Per-PROCESS incarnation tokens, keyed by (group, rank). Cached at module
 # level so re-initializing a group from the same process reuses the token
@@ -56,16 +55,25 @@ class _GroupState:
         self.coordinator = coordinator
         self.epoch = epoch
         self.seq = 0
+        self._seq_lock = threading.Lock()
 
     def next_seq(self) -> int:
-        self.seq += 1
-        return self.seq
+        with self._seq_lock:
+            self.seq += 1
+            return self.seq
+
+
+# PROCESS-global, not thread-local: a rank has ONE logical op sequence
+# (NCCL launch-order discipline) regardless of which thread issues the
+# op. Thread-local state broke on reused actor workers — setup() on one
+# dispatcher thread and the first collective on another saw different
+# _GroupStates, so one rank's seq counter silently diverged from its
+# peers' (observed as a barrier timing out with mismatched seq).
+_process_groups: Dict[str, _GroupState] = {}
 
 
 def _groups() -> Dict[str, _GroupState]:
-    if not hasattr(_local, "groups"):
-        _local.groups = {}
-    return _local.groups
+    return _process_groups
 
 
 def _get_or_create_coordinator():
@@ -155,8 +163,10 @@ def _resolve_group(group_name: str) -> _GroupState:
         incarnations={rank: _incarnation(group_name, rank)}))
     state = _GroupState(group_name, rank, info["world_size"], coordinator,
                         epoch)
-    _groups()[group_name] = state
-    return state
+    # setdefault, not assignment: two threads racing a rank's first op
+    # must converge on ONE state (one seq counter) — a private instance
+    # per thread would re-split the sequence this module just unified.
+    return _groups().setdefault(group_name, state)
 
 
 def is_group_initialized(group_name: str = "default") -> bool:

@@ -357,6 +357,65 @@ class Dataset:
 
         return self._write_blocks(path, "npy", one)
 
+    def to_tf(self, feature_columns: Union[str, List[str]],
+              label_columns: Union[str, List[str]], *,
+              batch_size: int = 1) -> "Any":
+        """tf.data.Dataset of (features, labels) batches (reference:
+        Dataset.to_tf). Signature is inferred from the first batch;
+        single-column sides yield bare tensors, multi-column sides
+        dicts. Gated on tensorflow."""
+        import tensorflow as tf
+
+        feats = [feature_columns] if isinstance(feature_columns, str) \
+            else list(feature_columns)
+        labels = [label_columns] if isinstance(label_columns, str) \
+            else list(label_columns)
+
+        def pick(batch, cols, single):
+            if single:
+                return batch[cols[0]]
+            return {c: batch[c] for c in cols}
+
+        single_f = isinstance(feature_columns, str)
+        single_l = isinstance(label_columns, str)
+
+        # Signature probe: one batch is computed (and discarded — every
+        # tf epoch re-runs the pipeline via from_generator anyway); the
+        # probe iterator is closed so the streaming executor unwinds
+        # now instead of at GC.
+        probe = iter(self.iter_batches(batch_size=batch_size))
+        try:
+            first = next(probe)
+        except StopIteration:
+            raise ValueError(
+                "to_tf on an empty dataset: cannot infer the tf output "
+                "signature from zero batches") from None
+        finally:
+            close = getattr(probe, "close", None)
+            if close is not None:
+                close()
+
+        def spec(arr):
+            a = np.asarray(arr)
+            return tf.TensorSpec(shape=(None,) + a.shape[1:],
+                                 dtype=tf.as_dtype(a.dtype))
+
+        def side_spec(cols, single):
+            if single:
+                return spec(first[cols[0]])
+            return {c: spec(first[c]) for c in cols}
+
+        signature = (side_spec(feats, single_f),
+                     side_spec(labels, single_l))
+
+        def gen():
+            for batch in self.iter_batches(batch_size=batch_size):
+                yield (pick(batch, feats, single_f),
+                       pick(batch, labels, single_l))
+
+        return tf.data.Dataset.from_generator(
+            gen, output_signature=signature)
+
     def write_tfrecords(self, path: str) -> List[str]:
         """tf.train.Example records, one file per block (reference:
         Dataset.write_tfrecords). Gated on tensorflow."""

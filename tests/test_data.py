@@ -674,3 +674,29 @@ def test_from_torch(ray_start_regular):
     rows = sorted(ds.take_all(), key=lambda r: r["i"])
     assert len(rows) == 6
     np.testing.assert_array_equal(rows[4]["t"], np.full((2,), 4))
+
+
+def test_to_tf(ray_start_regular):
+    """Dataset.to_tf yields (features, labels) tf batches (reference:
+    Dataset.to_tf). Gated on tensorflow."""
+    tf = pytest.importorskip("tensorflow")
+    from ray_tpu import data
+
+    ds = data.from_numpy({"x": np.arange(20, dtype=np.float32)
+                          .reshape(10, 2),
+                          "y": np.arange(10, dtype=np.int64)})
+    tfds = ds.to_tf("x", "y", batch_size=4)
+    xs, ys = [], []
+    for fx, fy in tfds:
+        assert fx.shape[1] == 2 and fx.dtype == tf.float32
+        xs.append(fx.numpy())
+        ys.append(fy.numpy())
+    allx = np.concatenate(xs)
+    assert allx.shape == (10, 2)
+    np.testing.assert_array_equal(np.sort(np.concatenate(ys)),
+                                  np.arange(10))
+
+    # multi-column sides come back as dicts
+    tfds2 = ds.to_tf(["x"], ["y"], batch_size=10)
+    f, l = next(iter(tfds2))
+    assert set(f.keys()) == {"x"} and set(l.keys()) == {"y"}
