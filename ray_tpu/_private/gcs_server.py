@@ -252,6 +252,9 @@ class GcsServer:
         nj = self.storage.get("meta", b"next_job")
         if nj is not None:
             self._next_job = nj
+        rr = self.storage.get("meta", b"requested_resources")
+        if rr:
+            self._requested_resources = rr
         if self.nodes or self.actors:
             logger.info(
                 "restored GCS state: %d nodes, %d actors, %d pgs, %d jobs, "
@@ -1137,7 +1140,27 @@ class GcsServer:
         for pg in self.placement_groups.values():
             if pg.state in ("PENDING", "RESCHEDULING"):
                 demands.extend(pg.bundles)
-        return {"pending_demands": demands, "nodes": nodes}
+        # Standing capacity requests (reference: sdk.request_resources →
+        # GcsAutoscalerStateManager cluster_resource_constraints) ride
+        # SEPARATELY from task demand: they are a floor over TOTAL
+        # capacity (a busy cluster already at the floor must not
+        # over-scale), which the autoscaler packs against
+        # resources_total, not resources_available.
+        return {"pending_demands": demands, "nodes": nodes,
+                "requested_bundles":
+                    list(getattr(self, "_requested_resources", []))}
+
+    async def handle_request_resources(self, data, conn) -> bool:
+        """Set (REPLACE) the cluster's standing resource request
+        (reference: ray.autoscaler.sdk.request_resources — each call
+        overrides the previous; an empty list clears it). Persisted:
+        a capacity floor must survive the head restarts it is often
+        there to ride out."""
+        bundles = data.get("bundles") or []
+        self._requested_resources = [dict(b) for b in bundles]
+        self.storage.put("meta", b"requested_resources",
+                         self._requested_resources)
+        return True
 
     # ------------------------------------------------------------- state API
     async def handle_list_object_locations(self, data, conn) -> list:

@@ -11,8 +11,10 @@ from ray_tpu.autoscaler.gce import (GceClient, GCETPUNodeProvider,
                                     MockGceClient)
 from ray_tpu.autoscaler.node_provider import (FakeMultiNodeProvider,
                                               NodeProvider)
+from ray_tpu.autoscaler.sdk import request_resources
 
 __all__ = [
+    "request_resources",
     "Monitor",
     "StandardAutoscaler",
     "ResourceDemandScheduler",

@@ -117,8 +117,19 @@ class StandardAutoscaler:
         node types launched this round."""
         demands = state.get("pending_demands", [])
         free = [n["resources_available"] for n in state.get("nodes", [])]
-        to_launch = self.scheduler.get_nodes_to_launch(
-            demands, free, self._current_counts())
+        counts = self._current_counts()
+        to_launch = dict(self.scheduler.get_nodes_to_launch(
+            demands, free, counts))
+        # Standing capacity requests (sdk.request_resources) are a floor
+        # over TOTAL capacity: pack them against resources_total so a
+        # busy-but-big-enough cluster doesn't over-scale past the floor.
+        requested = state.get("requested_bundles", [])
+        if requested:
+            total = [dict(n["resources_total"])
+                     for n in state.get("nodes", [])]
+            for t, c in self.scheduler.get_nodes_to_launch(
+                    requested, total, counts).items():
+                to_launch[t] = max(to_launch.get(t, 0), c)
         for type_name, count in to_launch.items():
             # Cap the launch batch in whole slice groups — a truncated
             # group would be a partial slice that can't form an ICI mesh.
@@ -133,7 +144,14 @@ class StandardAutoscaler:
 
     def _terminate_idle(self, state: Dict[str, Any]) -> None:
         """Scale down provider nodes idle past the timeout (reference:
-        StandardAutoscaler idle node termination)."""
+        StandardAutoscaler idle node termination). A standing
+        request_resources floor suppresses scale-down (the requested
+        capacity stays warm) — and must also RESET idle timers, or a
+        node could be terminated the instant the request clears using a
+        timestamp from before it was placed."""
+        if state.get("pending_demands") or state.get("requested_bundles"):
+            self._idle_since.clear()
+            return
         if not state.get("pending_demands"):
             now = time.monotonic()
             # Map provider nodes to GCS nodes via node_type resources —

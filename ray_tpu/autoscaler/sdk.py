@@ -1,0 +1,31 @@
+"""Autoscaler SDK.
+
+Reference: python/ray/autoscaler/sdk.py — ``request_resources`` lets an
+application pin a capacity floor independent of current load: the
+autoscaler scales up until the requested bundles COULD be placed and
+keeps that capacity warm (idle scale-down is suppressed while a request
+stands). Each call replaces the previous request; an empty call clears
+it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def request_resources(num_cpus: Optional[int] = None,
+                      bundles: Optional[List[Dict[str, float]]] = None
+                      ) -> None:
+    """Pin a standing capacity request with the GCS.
+
+    num_cpus=N is shorthand for N one-CPU bundles (reference
+    semantics: a TOTAL the cluster must be able to place, not per
+    node). Pass neither to clear the request."""
+    from ray_tpu._private.worker import global_worker
+
+    req: List[Dict[str, float]] = []
+    if num_cpus:
+        req.extend({"CPU": 1.0} for _ in range(int(num_cpus)))
+    if bundles:
+        req.extend(dict(b) for b in bundles)
+    global_worker().gcs_call("request_resources", {"bundles": req})

@@ -151,3 +151,54 @@ class TestGceTpuProvider:
         })
         assert launched2 == {}
         assert len(client.create_calls) == 1
+
+
+def test_request_resources_capacity_floor(ray_start_cluster):
+    """sdk.request_resources pins capacity independent of load
+    (reference: python/ray/autoscaler/sdk.py): the autoscaler launches
+    until the bundles could be placed, holds the capacity warm while
+    the request stands, and resumes scale-down once cleared."""
+    cluster = ray_start_cluster()
+    cluster.add_node(resources={"CPU": 1})
+    ray_tpu.init(address=cluster.address)
+
+    from ray_tpu.autoscaler.sdk import request_resources
+
+    provider = FakeMultiNodeProvider({
+        "gcs_address": cluster.address,
+        "node_types": {"worker": {"resources": {"CPU": 2},
+                                  "max_workers": 4}},
+    })
+    monitor = Monitor(provider, provider.provider_config["node_types"],
+                      idle_timeout_s=3600.0)
+
+    # No tasks at all — the standing request alone must drive scale-up
+    # beyond the head's 1 CPU (5 CPUs total -> 2 worker nodes).
+    request_resources(num_cpus=5)
+    deadline = time.time() + 30
+    while time.time() < deadline and \
+            len(provider.non_terminated_nodes()) < 2:
+        monitor.run_once()
+        time.sleep(0.5)
+    assert len(provider.non_terminated_nodes()) >= 2
+
+    # While the request stands: satisfied bundles pack against TOTAL
+    # capacity, so further reconciles launch NOTHING new — but the
+    # standing request stays visible, holding the capacity warm.
+    n_before = len(provider.non_terminated_nodes())
+    for _ in range(3):
+        assert monitor.run_once() == {}
+    assert len(provider.non_terminated_nodes()) == n_before
+    state = monitor._fetch_state()
+    assert state["requested_bundles"], "standing request missing"
+
+    # Clearing the request empties it again.
+    request_resources()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        state = monitor._fetch_state()
+        if not state["requested_bundles"]:
+            break
+        time.sleep(0.25)
+    assert not state["requested_bundles"]
+    provider.shutdown()
