@@ -144,32 +144,41 @@ class StandardAutoscaler:
 
     def _terminate_idle(self, state: Dict[str, Any]) -> None:
         """Scale down provider nodes idle past the timeout (reference:
-        StandardAutoscaler idle node termination). A standing
-        request_resources floor suppresses scale-down (the requested
-        capacity stays warm) — and must also RESET idle timers, or a
-        node could be terminated the instant the request clears using a
-        timestamp from before it was placed."""
-        if state.get("pending_demands") or state.get("requested_bundles"):
+        StandardAutoscaler idle node termination). Task demand resets
+        idle timers. A standing request_resources floor keeps ONLY the
+        capacity the floor needs warm — nodes beyond it still scale
+        down (a 1-CPU floor must not pin 100 idle workers forever)."""
+        if state.get("pending_demands"):
             self._idle_since.clear()
             return
-        if not state.get("pending_demands"):
-            now = time.monotonic()
-            # Map provider nodes to GCS nodes via node_type resources —
-            # the fake provider owns its nodes, so just track idleness of
-            # the whole provider fleet conservatively: only terminate when
-            # the cluster reports every provider-launched node idle.
-            idle_flags = {n["node_id"]: n["idle"]
-                          for n in state.get("nodes", [])}
-            all_idle = all(idle_flags.values()) if idle_flags else False
-            for pid in self.provider.non_terminated_nodes():
-                if not all_idle:
-                    self._idle_since.pop(pid, None)
-                    continue
-                since = self._idle_since.setdefault(pid, now)
-                if now - since > self.idle_timeout_s:
-                    logger.info("terminating idle node %s", pid)
-                    self.provider.terminate_node(pid)
-                    self._idle_since.pop(pid, None)
+        # Per-type node counts the standing floor requires when packed
+        # onto fresh nodes of that type.
+        keep_floor: Dict[str, int] = {}
+        if state.get("requested_bundles"):
+            keep_floor = dict(self.scheduler.get_nodes_to_launch(
+                state["requested_bundles"], [], {}))
+        now = time.monotonic()
+        # Map provider nodes to GCS nodes via node_type resources —
+        # the fake provider owns its nodes, so just track idleness of
+        # the whole provider fleet conservatively: only terminate when
+        # the cluster reports every provider-launched node idle.
+        idle_flags = {n["node_id"]: n["idle"]
+                      for n in state.get("nodes", [])}
+        all_idle = all(idle_flags.values()) if idle_flags else False
+        for pid in self.provider.non_terminated_nodes():
+            if not all_idle:
+                self._idle_since.pop(pid, None)
+                continue
+            node_type = self.provider.node_tags(pid).get("node_type", "?")
+            if keep_floor.get(node_type, 0) > 0:
+                keep_floor[node_type] -= 1  # held warm by the floor
+                self._idle_since.pop(pid, None)
+                continue
+            since = self._idle_since.setdefault(pid, now)
+            if now - since > self.idle_timeout_s:
+                logger.info("terminating idle node %s", pid)
+                self.provider.terminate_node(pid)
+                self._idle_since.pop(pid, None)
 
 
 class Monitor:

@@ -1,0 +1,51 @@
+"""joblib backend over the actor runtime.
+
+Reference: python/ray/util/joblib/ — ``register_ray()`` registers a
+joblib parallel backend whose pool is the cluster-wide
+:class:`ray_tpu.util.multiprocessing.Pool`, so scikit-learn et al.
+(`with joblib.parallel_backend("ray_tpu"): ...`) fan out across the
+cluster unchanged.
+"""
+
+from __future__ import annotations
+
+
+def register_ray() -> None:
+    """Register the 'ray_tpu' joblib backend (idempotent)."""
+    from joblib import register_parallel_backend
+    from joblib._parallel_backends import MultiprocessingBackend
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    class RayTpuBackend(MultiprocessingBackend):
+        # Same trick as the reference's RayBackend: reuse joblib's
+        # multiprocessing plumbing, swapping in the actor Pool.
+        supports_timeout = True
+
+        def effective_n_jobs(self, n_jobs):
+            import ray_tpu
+
+            if n_jobs == -1:
+                # Connect NOW if needed — resolving -1 to a single job
+                # on a cluster Pool() would join anyway silently
+                # serializes the workload.
+                if not ray_tpu.is_initialized():
+                    ray_tpu.init()
+                return max(1, int(
+                    ray_tpu.cluster_resources().get("CPU", 1)))
+            return max(1, int(n_jobs or 1))
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None,
+                      require=None, **_memmapping_args):
+            n_jobs = self.effective_n_jobs(n_jobs)
+            self._pool = Pool(processes=n_jobs)
+            self.parallel = parallel
+            return n_jobs
+
+        def terminate(self):
+            pool = getattr(self, "_pool", None)
+            if pool is not None:
+                pool.terminate()
+                self._pool = None
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -1,0 +1,241 @@
+"""multiprocessing.Pool API over the actor runtime.
+
+Reference: python/ray/util/multiprocessing/pool.py — a drop-in
+``Pool`` whose "processes" are actors, so pool workers survive across
+``map`` calls (warm imports, initializer state) and can span the whole
+cluster rather than one machine. Supported surface: apply/apply_async,
+map/map_async, starmap/starmap_async, imap/imap_unordered (chunked),
+initializer/initargs, close/terminate/join, context manager.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class _PoolWorker:
+    """One pool 'process': runs chunks of calls sequentially."""
+
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run_chunk(self, func, chunk, star: bool) -> List[Any]:
+        if star:
+            return [func(*args) for args in chunk]
+        return [func(item) for item in chunk]
+
+    def run_one(self, func, args, kwds):
+        return func(*args, **(kwds or {}))
+
+
+class AsyncResult:
+    """multiprocessing.pool.AsyncResult subset over ObjectRefs."""
+
+    def __init__(self, refs: List[Any], single: bool,
+                 callback: Optional[Callable] = None,
+                 error_callback: Optional[Callable] = None):
+        self._refs = refs
+        self._single = single
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+        def finish():
+            try:
+                chunks = ray_tpu.get(self._refs)
+                if single:
+                    self._result = chunks[0]
+                else:
+                    self._result = list(
+                        itertools.chain.from_iterable(chunks))
+            except BaseException as e:  # surfaced from get()
+                self._error = e
+                if error_callback is not None:
+                    try:
+                        error_callback(e)
+                    except Exception:
+                        pass
+            else:
+                # Outside the except scope: a buggy SUCCESS callback
+                # must not masquerade as a task failure (the results
+                # are computed and must stay retrievable).
+                if callback is not None:
+                    try:
+                        callback(self._result)
+                    except Exception:
+                        pass
+            finally:
+                self._done.set()
+
+        threading.Thread(target=finish, daemon=True,
+                         name="pool-async-result").start()
+
+    def ready(self) -> bool:
+        return self._done.is_set()
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
+        return self._error is None
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        self._done.wait(timeout)
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            # Stdlib-compatible: multiprocessing.TimeoutError is a
+            # ProcessError subclass DISTINCT from builtin TimeoutError;
+            # ported `except multiprocessing.TimeoutError` must fire.
+            import multiprocessing as _mp
+
+            raise _mp.TimeoutError("result not ready within timeout")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = (), **_ignored: Any):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            cpus = ray_tpu.cluster_resources().get("CPU", 1)
+            processes = max(1, int(cpus))
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._n = processes
+        cls = ray_tpu.remote(_PoolWorker)
+        self._workers = [cls.remote(initializer, tuple(initargs))
+                         for _ in range(processes)]
+        self._rr = 0
+        self._closed = False
+
+    # ---- internals ----
+    def _next_worker(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+        w = self._workers[self._rr % self._n]
+        self._rr += 1
+        return w
+
+    def _chunk(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            # multiprocessing's heuristic: ~4 chunks per worker.
+            chunksize = max(1, len(items) // (self._n * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)], chunksize
+
+    def _submit_chunks(self, func, iterable, chunksize, star):
+        chunks, _ = self._chunk(iterable, chunksize)
+        return [self._next_worker().run_chunk.remote(func, c, star)
+                for c in chunks]
+
+    # ---- apply ----
+    def apply(self, func, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func, args: tuple = (),
+                    kwds: Optional[dict] = None,
+                    callback=None, error_callback=None) -> AsyncResult:
+        ref = self._next_worker().run_one.remote(func, tuple(args),
+                                                 kwds or {})
+        return AsyncResult([ref], single=True, callback=callback,
+                           error_callback=error_callback)
+
+    # ---- map family ----
+    def map(self, func, iterable, chunksize: Optional[int] = None):
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(self, func, iterable, chunksize: Optional[int] = None,
+                  callback=None, error_callback=None) -> AsyncResult:
+        refs = self._submit_chunks(func, iterable, chunksize, star=False)
+        return AsyncResult(refs, single=False, callback=callback,
+                           error_callback=error_callback)
+
+    def starmap(self, func, iterable, chunksize: Optional[int] = None):
+        return self.starmap_async(func, iterable, chunksize).get()
+
+    def starmap_async(self, func, iterable,
+                      chunksize: Optional[int] = None,
+                      callback=None, error_callback=None) -> AsyncResult:
+        refs = self._submit_chunks(func, iterable, chunksize, star=True)
+        return AsyncResult(refs, single=False, callback=callback,
+                           error_callback=error_callback)
+
+    def _lazy_chunks(self, iterable: Iterable,
+                     chunksize: Optional[int]):
+        """Chunk WITHOUT materializing the iterable: imap over an
+        infinite/huge generator must stream (stdlib contract)."""
+        if chunksize is None:
+            chunksize = 1  # stdlib imap default
+        it = iter(iterable)
+        while True:
+            chunk = list(itertools.islice(it, chunksize))
+            if not chunk:
+                return
+            yield chunk
+
+    def imap(self, func, iterable, chunksize: Optional[int] = None):
+        """Ordered lazy iterator: at most ~2 chunks per worker in
+        flight; pulls more from the source as results drain."""
+        window = self._n * 2
+        chunks = self._lazy_chunks(iterable, chunksize)
+        inflight: List[Any] = []
+        for chunk in chunks:
+            inflight.append(self._next_worker().run_chunk.remote(
+                func, chunk, False))
+            if len(inflight) >= window:
+                for item in ray_tpu.get(inflight.pop(0)):
+                    yield item
+        while inflight:
+            for item in ray_tpu.get(inflight.pop(0)):
+                yield item
+
+    def imap_unordered(self, func, iterable,
+                       chunksize: Optional[int] = None):
+        window = self._n * 2
+        chunks = self._lazy_chunks(iterable, chunksize)
+        inflight: List[Any] = []
+        for chunk in chunks:
+            inflight.append(self._next_worker().run_chunk.remote(
+                func, chunk, False))
+            if len(inflight) >= window:
+                done, inflight = ray_tpu.wait(inflight, num_returns=1)
+                for item in ray_tpu.get(done[0]):
+                    yield item
+        while inflight:
+            done, inflight = ray_tpu.wait(inflight, num_returns=1)
+            for item in ray_tpu.get(done[0]):
+                yield item
+
+    # ---- lifecycle ----
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("join() before close()")
+        # Actors are synchronous: outstanding chunks resolve via their
+        # refs; nothing further to wait on pool-side.
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()

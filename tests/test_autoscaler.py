@@ -202,3 +202,39 @@ def test_request_resources_capacity_floor(ray_start_cluster):
         time.sleep(0.25)
     assert not state["requested_bundles"]
     provider.shutdown()
+
+
+def test_request_resources_floor_releases_excess(ray_start_cluster):
+    """A small floor must NOT pin a large idle fleet: nodes beyond the
+    floor still scale down after the idle timeout."""
+    cluster = ray_start_cluster()
+    cluster.add_node(resources={"CPU": 1})
+    ray_tpu.init(address=cluster.address)
+
+    from ray_tpu.autoscaler.sdk import request_resources
+
+    provider = FakeMultiNodeProvider({
+        "gcs_address": cluster.address,
+        "node_types": {"worker": {"resources": {"CPU": 2},
+                                  "max_workers": 4}},
+    })
+    monitor = Monitor(provider, provider.provider_config["node_types"],
+                      idle_timeout_s=0.5)
+    # Scale to 3 workers via a large floor, then shrink the floor to 1
+    # worker's worth: two nodes must terminate, one stays warm.
+    request_resources(num_cpus=6)
+    deadline = time.time() + 30
+    while time.time() < deadline and \
+            len(provider.non_terminated_nodes()) < 3:
+        monitor.run_once()
+        time.sleep(0.3)
+    assert len(provider.non_terminated_nodes()) == 3
+
+    request_resources(num_cpus=2)
+    deadline = time.time() + 30
+    while time.time() < deadline and \
+            len(provider.non_terminated_nodes()) > 1:
+        monitor.run_once()
+        time.sleep(0.3)
+    assert len(provider.non_terminated_nodes()) == 1
+    provider.shutdown()

@@ -131,3 +131,79 @@ def test_tqdm_ray_streams_to_driver(ray_start_regular, capfd):
         time.sleep(0.25)
     assert "tqdm_ray" in seen and "crunch: 3/3 done" in seen and \
         "loop: 3/3 done" in seen, seen[-2000:]
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    """multiprocessing.Pool API over actors (reference:
+    util/multiprocessing/pool.py)."""
+    from ray_tpu.util.multiprocessing import Pool
+
+    def init_marker(v):
+        import os
+
+        os.environ["POOL_INIT"] = str(v)
+
+    def square(x):
+        return x * x
+
+    def initialized_pid(x):
+        import os
+
+        return (os.environ.get("POOL_INIT"), os.getpid(), x)
+
+    def add(a, b):
+        return a + b
+
+    with Pool(processes=3, initializer=init_marker,
+              initargs=(7,)) as pool:
+        assert pool.map(square, range(10)) == [x * x for x in range(10)]
+        assert pool.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(add, (5, 6)) == 11
+        r = pool.apply_async(square, (9,))
+        assert r.get(timeout=30) == 81 and r.successful()
+        assert sorted(pool.imap_unordered(square, range(6))) == \
+            [x * x for x in range(6)]
+        assert list(pool.imap(square, range(6))) == \
+            [x * x for x in range(6)]
+        # initializer ran in every pool worker; work spread over >1 pid.
+        rows = pool.map(initialized_pid, range(12), chunksize=1)
+        assert all(r[0] == "7" for r in rows)
+        assert len({r[1] for r in rows}) > 1
+        # errors propagate through get()
+        with pytest.raises(Exception, match="ZeroDivisionError|division"):
+            pool.apply(lambda x: 1 // x, (0,))
+
+
+def test_joblib_backend(ray_start_regular):
+    """joblib parallel_backend('ray_tpu') fans out over the cluster
+    (reference: util/joblib/)."""
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    import math
+
+    with joblib.parallel_backend("ray_tpu", n_jobs=3):
+        out = joblib.Parallel()(
+            joblib.delayed(math.factorial)(i) for i in range(8))
+    assert out == [math.factorial(i) for i in range(8)]
+
+
+def test_pool_imap_is_lazy(ray_start_regular):
+    """imap must stream from unbounded iterables (stdlib contract) —
+    an eager list() would hang forever here."""
+    import itertools
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    def ident(x):
+        return x
+
+    with Pool(processes=2) as pool:
+        it = pool.imap(ident, itertools.count(), chunksize=2)
+        assert [next(it) for _ in range(10)] == list(range(10))
+        import multiprocessing as mp
+
+        r = pool.apply_async(__import__("time").sleep, (5,))
+        with pytest.raises(mp.TimeoutError):
+            r.get(timeout=0.1)
