@@ -140,6 +140,15 @@ class DashboardHead:
             return web.Response(text=_prometheus_text(data or []),
                                 content_type="text/plain")
 
+        @routes.get("/api/metrics_json")
+        async def metrics_json(request):
+            """Raw metric samples for the UI's Metrics tab (reference:
+            the Grafana panels in dashboard/modules/metrics — here the
+            page itself keeps the history ring)."""
+            return web.json_response(
+                await offload(self._gcs, "get_metrics") or [],
+                dumps=_dumps)
+
         @routes.get("/api/cluster_status")
         async def cluster_status(request):
             res = await offload(self._gcs, "cluster_resources")

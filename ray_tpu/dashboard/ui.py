@@ -86,6 +86,7 @@ INDEX_HTML = """<!doctype html>
   <button data-tab="jobs">Jobs</button>
   <button data-tab="tasks">Tasks</button>
   <button data-tab="timeline">Timeline</button>
+  <button data-tab="metrics">Metrics</button>
   <button data-tab="events">Events</button>
   <button data-tab="logs">Logs</button>
 </nav>
@@ -154,6 +155,7 @@ const laneColor = (name) => {
 };
 let tlWindow = 0;  // seconds of trailing window; 0 = everything
 let tlV0 = 0, tlV1 = 1;  // zoom view as fractions of the full range
+let metricSel = '';      // Metrics tab: currently-charted metric key
 window.setTlWindow = (s) => { tlWindow = s; tlV0 = 0; tlV1 = 1; refresh(); };
 window.tlReset = () => { tlV0 = 0; tlV1 = 1; refresh(); };
 function renderTimeline(events) {
@@ -389,6 +391,34 @@ const views = {
     const events = await j('/api/timeline');
     return renderTimeline(events);
   },
+  async metrics() {
+    // Metric explorer (reference: the Grafana panels in the dashboard
+    // metrics module): every runtime/user metric accumulates history
+    // client-side; pick one to chart it large.
+    const samples = await j('/api/metrics_json');
+    for (const m of samples) {
+      const tags = Object.entries(m.tags || {}).sort()
+        .map(([k, v]) => `${k}=${v}`).join(',');
+      recordMetric('m:' + m.name + (tags ? `{${tags}}` : ''), m.value);
+    }
+    const keys = [...metricHist.keys()].filter(k => k.startsWith('m:'))
+      .sort();
+    if (!keys.length) return '<p>No metrics reported yet.</p>';
+    if (!keys.includes(metricSel)) metricSel = keys[0];
+    const opts = keys.map(k =>
+      `<option value="${esc(k)}"${k === metricSel ? ' selected' : ''}>` +
+      `${esc(k.slice(2))}</option>`).join('');
+    const h = metricHist.get(metricSel) || [];
+    const last = h.length ? h[h.length - 1] : NaN;
+    const min = h.length ? Math.min(...h) : NaN;
+    const max = h.length ? Math.max(...h) : NaN;
+    const chart = sparkline(metricSel, 860, 180);
+    return `<p><select id="metricsel" onchange="metricSel=this.value;` +
+      `forceRender=true;refresh()">${opts}</select> &nbsp; last=${esc(last)} ` +
+      `min=${esc(min)} max=${esc(max)} (${h.length} samples)</p>` +
+      `<div>${chart && chart.__svg ? chart.__svg :
+             'collecting samples…'}</div>`;
+  },
   async events() {
     const evs = await j('/api/events');
     return detailPanel('Event detail', detail) + table([
@@ -452,6 +482,9 @@ async function refresh() {
   // log tail (the long-poll loop updates the <pre> in place).
   if (tlDragging) return;
   if (!forceRender && tab === 'logs' && logFile && $('#logpre')) return;
+  // Don't rebuild the Metrics tab while its dropdown is open.
+  if (document.activeElement && document.activeElement.id === 'metricsel'
+      && !forceRender) return;
   forceRender = false;
   try {
     $('#content').innerHTML = await views[tab]();

@@ -175,9 +175,12 @@ def test_dashboard_endpoints(ray_start_regular):
         except Exception:
             traversal_served = False
         assert not traversal_served, "stream path traversal not rejected"
-        # Zoom/pan timeline + metric sparklines shipped in the page.
+        # Zoom/pan timeline + metric sparklines + explorer tab shipped.
         assert "wireTimeline" in page and "followLog" in page
         assert "sparkline" in page and "recordMetric" in page
+        assert 'data-tab="metrics"' in page
+        mj = json.loads(get("/api/metrics_json"))
+        assert any(m.get("name") == "unit_dash_counter" for m in mj), mj
     finally:
         dash.stop()
 
