@@ -1169,6 +1169,21 @@ class GcsServer:
                  "spilled_url": self.spilled_objects.get(oid)}
                 for oid, locs in self.object_locations.items()]
 
+    async def handle_list_named_actors(self, data, conn) -> list:
+        """Live named actors (reference: ray.util.list_named_actors /
+        GcsActorManager::ListNamedActors). Optionally one namespace."""
+        ns = data.get("namespace")
+        out = []
+        for (namespace, name), aid in self.named_actors.items():
+            if ns is not None and namespace != ns:
+                continue
+            info = self.actors.get(aid)
+            if info is None or info.state == DEAD:
+                continue
+            out.append({"name": name, "namespace": namespace,
+                        "actor_id": aid.binary().hex()})
+        return out
+
     async def handle_list_placement_groups(self, data, conn) -> list:
         return [pg.view() for pg in self.placement_groups.values()]
 

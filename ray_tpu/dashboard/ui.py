@@ -414,7 +414,8 @@ const views = {
     const max = h.length ? Math.max(...h) : NaN;
     const chart = sparkline(metricSel, 860, 180);
     return `<p><select id="metricsel" onchange="metricSel=this.value;` +
-      `forceRender=true;refresh()">${opts}</select> &nbsp; last=${esc(last)} ` +
+      `this.blur();forceRender=true;refresh()">${opts}</select>` +
+      ` &nbsp; last=${esc(last)} ` +
       `min=${esc(min)} max=${esc(max)} (${h.length} samples)</p>` +
       `<div>${chart && chart.__svg ? chart.__svg :
              'collecting samples…'}</div>`;

@@ -1,1 +1,32 @@
-"""ray_tpu.util — state API, timeline, collective re-exports."""
+"""ray_tpu.util — state API, timeline, pools, debugging helpers."""
+
+from typing import List, Optional
+
+
+def list_named_actors(all_namespaces: bool = False,
+                      namespace: Optional[str] = None) -> List:
+    """Live named actors (reference: ray.util.list_named_actors).
+
+    Default: the CALLER's namespace. all_namespaces=True returns
+    [{name, namespace, actor_id}] dicts for every namespace (reference
+    shape); otherwise a list of name strings."""
+    from ray_tpu._private.worker import global_worker
+
+    worker = global_worker()
+    if not all_namespaces and namespace is None:
+        namespace = getattr(worker, "namespace", None) or "default"
+    rows = worker.gcs_call(
+        "list_named_actors",
+        {} if all_namespaces else {"namespace": namespace})
+    if all_namespaces:
+        return rows
+    return [r["name"] for r in rows]
+
+
+def inspect_serializability(obj, name: str = "<object>", print_file=None):
+    from ray_tpu.util.check_serialize import inspect_serializability as f
+
+    return f(obj, name, print_file)
+
+
+__all__ = ["inspect_serializability", "list_named_actors"]

@@ -1,0 +1,109 @@
+"""Serializability debugging.
+
+Reference: python/ray/util/check_serialize.py
+(``inspect_serializability`` — when a task argument or captured closure
+fails to pickle, walk the object graph and point at the actual
+offending members instead of the opaque top-level error).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Set, Tuple
+
+
+class FailureTuple:
+    """One unserializable leaf: the object, its name, and its parent."""
+
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return f"FailureTuple(obj={self.obj!r}, name={self.name!r})"
+
+
+def _serializable(obj: Any) -> bool:
+    from ray_tpu.core import serialization
+
+    try:
+        serialization.serialize(obj).to_bytes()
+        return True
+    except Exception:
+        return False
+
+
+def _inspect(obj: Any, name: str, parent: Any, depth: int,
+             failures: Set[int], found: list, printer,
+             visited: Set[int]) -> bool:
+    """Returns True if obj serializes. Descends into closures, defaults,
+    __dict__ members, and containers of an unserializable obj to find
+    leaves. `visited` breaks cycles (a.other=b; b.other=a is exactly the
+    kind of object users debug here)."""
+    if id(obj) in visited:
+        return False  # already being inspected up-stack (cycle)
+    if _serializable(obj):
+        return True
+    visited.add(id(obj))
+    printer(f"{'  ' * depth}✗ {name}: "
+            f"{type(obj).__name__} is not serializable")
+    found_before = len(found)
+    # function closures, dragged-in globals, and defaults
+    if inspect.isfunction(obj):
+        closure = inspect.getclosurevars(obj)
+        for src in (closure.nonlocals, closure.globals):
+            for sub_name, sub in src.items():
+                _inspect(sub, f"{name}.<closure>.{sub_name}", obj,
+                         depth + 1, failures, found, printer, visited)
+        for i, sub in enumerate(obj.__defaults__ or ()):
+            _inspect(sub, f"{name}.<default#{i}>", obj, depth + 1,
+                     failures, found, printer, visited)
+    # object attributes
+    elif hasattr(obj, "__dict__") and isinstance(obj.__dict__, dict):
+        for sub_name, sub in list(obj.__dict__.items()):
+            _inspect(sub, f"{name}.{sub_name}", obj, depth + 1,
+                     failures, found, printer, visited)
+    # containers (dict keys too — a bad KEY is as fatal as a value)
+    elif isinstance(obj, (list, tuple, set)):
+        for i, sub in enumerate(obj):
+            _inspect(sub, f"{name}[{i}]", obj, depth + 1, failures,
+                     found, printer, visited)
+    elif isinstance(obj, dict):
+        for i, k in enumerate(obj):
+            _inspect(k, f"{name}.<key#{i}>", obj, depth + 1, failures,
+                     found, printer, visited)
+        for k, sub in obj.items():
+            try:
+                label = f"{name}[{k!r}]"
+            except Exception:
+                label = f"{name}[<key>]"
+            _inspect(sub, label, obj, depth + 1, failures, found,
+                     printer, visited)
+    if len(found) == found_before and id(obj) not in failures:
+        # No deeper offender surfaced: THIS object is the leaf (also
+        # covers "descended but every child serialized" — e.g. the
+        # unpicklability lives in the object itself).
+        failures.add(id(obj))
+        found.append(FailureTuple(obj, name, parent))
+    return False
+
+
+def inspect_serializability(obj: Any, name: str = "<object>",
+                            print_file=None
+                            ) -> Tuple[bool, Set[FailureTuple]]:
+    """Check `obj` for serializability; on failure print a tree down to
+    the offending members and return (ok, failures)."""
+    lines = []
+
+    def printer(s):
+        lines.append(s)
+
+    found: list = []
+    ok = _inspect(obj, name, None, 0, set(), found, printer, set())
+    if not ok:
+        header = (f"Checking serializability of {name} "
+                  f"({type(obj).__name__})")
+        text = "\n".join([header] + lines)
+        print(text, file=print_file)
+    return ok, set(found)

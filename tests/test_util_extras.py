@@ -207,3 +207,102 @@ def test_pool_imap_is_lazy(ray_start_regular):
         r = pool.apply_async(__import__("time").sleep, (5,))
         with pytest.raises(mp.TimeoutError):
             r.get(timeout=0.1)
+
+
+def test_list_named_actors(ray_start_regular):
+    """reference: ray.util.list_named_actors."""
+    from ray_tpu.util import list_named_actors
+
+    @ray_tpu.remote
+    class Named:
+        def ping(self):
+            return 1
+
+    a = Named.options(name="lister_a").remote()
+    b = Named.options(name="lister_b", namespace="otherns").remote()
+    ray_tpu.get([a.ping.remote(), b.ping.remote()])
+    names = list_named_actors()
+    assert "lister_a" in names and "lister_b" not in names
+    rows = list_named_actors(all_namespaces=True)
+    pairs = {(r["namespace"], r["name"]) for r in rows}
+    assert ("default", "lister_a") in pairs
+    assert ("otherns", "lister_b") in pairs
+    ray_tpu.kill(a)
+    ray_tpu.kill(b)
+    import time
+
+    deadline = time.time() + 10
+    while time.time() < deadline and "lister_a" in list_named_actors():
+        time.sleep(0.2)
+    assert "lister_a" not in list_named_actors()
+
+
+def test_inspect_serializability(ray_start_regular):
+    """reference: ray.util.check_serialize.inspect_serializability —
+    points at the actual unpicklable member."""
+    import io
+    import threading
+
+    from ray_tpu.util import inspect_serializability
+
+    ok, failures = inspect_serializability(lambda x: x + 1)
+    assert ok and not failures
+
+    lock = threading.Lock()
+
+    class Holder:
+        def __init__(self):
+            self.fine = 42
+            self.bad = lock
+
+    buf = io.StringIO()
+    ok, failures = inspect_serializability(Holder(), name="holder",
+                                           print_file=buf)
+    assert not ok
+    assert any(f.obj is lock for f in failures), failures
+    assert "holder.bad" in buf.getvalue()
+
+    def closure_over_lock():
+        return lock
+
+    ok, failures = inspect_serializability(closure_over_lock,
+                                           print_file=io.StringIO())
+    assert not ok and any(f.obj is lock for f in failures)
+
+
+def test_inspect_serializability_cycles_and_keys(ray_start_regular):
+    """Cyclic graphs must not recurse forever; bad dict KEYS and
+    function defaults are located too."""
+    import io
+    import threading
+
+    from ray_tpu.util import inspect_serializability
+
+    class Node:
+        pass
+
+    a, b = Node(), Node()
+    a.other, b.other = b, a
+    a.lock = threading.Lock()
+    ok, failures = inspect_serializability(a, print_file=io.StringIO())
+    assert not ok
+    assert any(isinstance(f.obj, type(a.lock)) for f in failures)
+
+    class BadKey:
+        __hash__ = object.__hash__
+
+        def __reduce__(self):
+            raise TypeError("nope")
+
+    ok, failures = inspect_serializability({BadKey(): 1},
+                                           print_file=io.StringIO())
+    assert not ok and failures, "dict-key offender must be located"
+
+    lock = threading.Lock()
+
+    def with_bad_default(x=lock):
+        return x
+
+    ok, failures = inspect_serializability(with_bad_default,
+                                           print_file=io.StringIO())
+    assert not ok and any(f.obj is lock for f in failures)
