@@ -26,7 +26,13 @@ def test_200_actors(stress_cluster):
     ~3.5/s to ~9/s sustained on this one-core host."""
     from concurrent.futures import ThreadPoolExecutor
 
-    @ray_tpu.remote(num_cpus=0)
+    # max_restarts: at load-200+ (400 runnable processes on one core)
+    # an occasional worker misses its raylet heartbeat window and
+    # suicides mid-bring-up. The envelope claim is EVENTUAL aliveness
+    # of 400 actors — the reference's 40k-actor benchmark likewise
+    # rides its restart machinery — not zero worker crashes under a
+    # 400x oversubscribed core.
+    @ray_tpu.remote(num_cpus=0, max_restarts=2)
     class Tiny:
         def pid(self):
             import os
@@ -57,6 +63,18 @@ def test_200_actors(stress_cluster):
 
 def test_10k_queued_tasks(stress_cluster):
     """Reference envelope row: 1M tasks queued on one node (1/50)."""
+    from ray_tpu._private.worker import global_worker
+
+    # Settle barrier: the 400-actor storm before this test tears down
+    # asynchronously; 400 dying workers sharing the core would eat the
+    # throughput budget. Wait until the actor table drains.
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        views = global_worker().gcs_call("list_actors")
+        if not any(v["state"] in ("ALIVE", "RESTARTING") for v in views):
+            break
+        time.sleep(1.0)
+    time.sleep(3.0)  # let killed worker processes actually exit
 
     @ray_tpu.remote
     def unit(i):
