@@ -1,0 +1,77 @@
+"""XGBoostTrainer / LightGBMTrainer — gated GBDT trainers.
+
+Reference: python/ray/train/xgboost/xgboost_trainer.py and
+python/ray/train/lightgbm/lightgbm_trainer.py (GBDTTrainer base in
+python/ray/train/gbdt_trainer.py). The reference delegates distributed
+boosting to the external xgboost_ray / lightgbm_ray packages; neither
+xgboost nor lightgbm ships in this image, so these trainers are
+import-gated: constructing one without the library raises an informative
+ImportError. When the library IS present, the fit runs the estimator's
+sklearn-compatible API inside one train worker on the same
+session/report/checkpoint infra as SklearnTrainer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.air import Result, RunConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.sklearn_trainer import SklearnTrainer
+
+__all__ = ["XGBoostTrainer", "LightGBMTrainer"]
+
+
+class _GBDTTrainer:
+    _module: str = ""
+    _estimator_attr: str = ""
+    _classifier_attr: str = ""
+
+    def __init__(self, *,
+                 datasets: Dict[str, Any],
+                 label_column: Optional[str] = None,
+                 params: Optional[Dict[str, Any]] = None,
+                 num_boost_round: int = 10,
+                 run_config: Optional[RunConfig] = None):
+        import importlib
+
+        try:
+            mod = importlib.import_module(self._module)
+        except ImportError as e:
+            raise ImportError(
+                f"{type(self).__name__} requires the '{self._module}' "
+                f"package, which is not installed in this environment. "
+                f"Install it (e.g. `pip install {self._module}`) to use "
+                f"this trainer; SklearnTrainer and JaxTrainer are "
+                f"available without it.") from e
+
+        params = dict(params or {})
+        params.setdefault("n_estimators", num_boost_round)
+        # objective picks the estimator flavor (reference passes the
+        # objective straight to the native train() API).
+        objective = str(params.get("objective", ""))
+        attr = self._classifier_attr if objective.startswith(
+            ("binary", "multi")) else self._estimator_attr
+        estimator = getattr(mod, attr)(**params)
+        self._inner = SklearnTrainer(
+            estimator=estimator, datasets=datasets,
+            label_column=label_column, run_config=run_config)
+
+    def fit(self) -> Result:
+        return self._inner.fit()
+
+    @staticmethod
+    def get_model(checkpoint: Checkpoint):
+        return SklearnTrainer.get_model(checkpoint)
+
+
+class XGBoostTrainer(_GBDTTrainer):
+    _module = "xgboost"
+    _estimator_attr = "XGBRegressor"
+    _classifier_attr = "XGBClassifier"
+
+
+class LightGBMTrainer(_GBDTTrainer):
+    _module = "lightgbm"
+    _estimator_attr = "LGBMRegressor"
+    _classifier_attr = "LGBMClassifier"

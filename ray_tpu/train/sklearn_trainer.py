@@ -1,0 +1,143 @@
+"""SklearnTrainer — single-worker scikit-learn fit on the train infra.
+
+Reference: python/ray/train/sklearn/sklearn_trainer.py (`SklearnTrainer`:
+fits an estimator in one remote worker, optionally cross-validating with
+a joblib parallel backend over Ray, reports scores, and checkpoints the
+pickled estimator). Same shape here: the fit runs inside one
+RayTrainWorker actor via DataParallelTrainer(num_workers=1), CV
+parallelism rides `ray_tpu.util.joblib.register_ray()`.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Callable, Dict, Optional, Union
+
+from ray_tpu.air import Result, RunConfig, ScalingConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+MODEL_FILENAME = "model.pkl"
+
+
+def _resolve_xy(data: Any, label_column: Optional[str]):
+    """Dataset | pandas.DataFrame | (X, y) | X  →  (X, y|None)."""
+    import numpy as np
+
+    if isinstance(data, tuple) and len(data) == 2:
+        return data
+    if hasattr(data, "to_pandas"):  # ray_tpu.data.Dataset
+        data = data.to_pandas()
+    if hasattr(data, "drop"):  # pandas DataFrame
+        if label_column is not None:
+            y = data[label_column].to_numpy()
+            X = data.drop(columns=[label_column]).to_numpy()
+            return X, y
+        return data.to_numpy(), None
+    return np.asarray(data), None
+
+
+def _sklearn_fit_loop(config: Dict[str, Any]) -> None:
+    from ray_tpu import train
+
+    estimator = config["estimator"]
+    label_column = config.get("label_column")
+    params = config.get("params") or {}
+    scoring = config.get("scoring")
+    cv = config.get("cv")
+    parallelize_cv = config.get("parallelize_cv", False)
+    datasets = config.get("_datasets") or {}
+
+    if params:
+        estimator = estimator.set_params(**params)
+
+    X_train, y_train = _resolve_xy(datasets["train"], label_column)
+
+    start = time.perf_counter()
+    estimator.fit(X_train, y_train)
+    metrics: Dict[str, Any] = {
+        "fit_time": time.perf_counter() - start}
+
+    def _score(X, y) -> float:
+        if callable(scoring):
+            return float(scoring(estimator, X, y))
+        if isinstance(scoring, str):
+            from sklearn.metrics import check_scoring
+
+            return float(check_scoring(estimator, scoring)(estimator, X, y))
+        return float(estimator.score(X, y))
+
+    for name, data in datasets.items():
+        if name == "train":
+            continue
+        X, y = _resolve_xy(data, label_column)
+        metrics[f"{name}_score"] = _score(X, y)
+
+    if cv:
+        from sklearn.model_selection import cross_validate
+
+        cv_scoring = scoring if isinstance(scoring, str) or \
+            callable(scoring) else None
+        if parallelize_cv:
+            import joblib
+
+            from ray_tpu.util.joblib import register_ray
+
+            register_ray()
+            with joblib.parallel_backend("ray_tpu"):
+                cv_res = cross_validate(estimator, X_train, y_train,
+                                        cv=cv, n_jobs=cv,
+                                        scoring=cv_scoring)
+        else:
+            cv_res = cross_validate(estimator, X_train, y_train, cv=cv,
+                                    scoring=cv_scoring)
+        scores = cv_res["test_score"]
+        metrics["cv_test_score_mean"] = float(scores.mean())
+        metrics["cv_test_score_std"] = float(scores.std())
+
+    d = tempfile.mkdtemp(prefix="sklearn_ckpt_")
+    with open(os.path.join(d, MODEL_FILENAME), "wb") as f:
+        pickle.dump(estimator, f)
+    train.report(metrics, checkpoint=Checkpoint.from_directory(d))
+
+
+class SklearnTrainer:
+    def __init__(self, *,
+                 estimator: Any,
+                 datasets: Dict[str, Any],
+                 label_column: Optional[str] = None,
+                 params: Optional[Dict[str, Any]] = None,
+                 scoring: Optional[Union[str, Callable]] = None,
+                 cv: Optional[int] = None,
+                 parallelize_cv: bool = False,
+                 run_config: Optional[RunConfig] = None):
+        if "train" not in datasets:
+            raise ValueError("datasets must contain a 'train' key")
+        self._inner = DataParallelTrainer(
+            _sklearn_fit_loop,
+            train_loop_config={
+                "estimator": estimator,
+                "label_column": label_column,
+                "params": params,
+                "scoring": scoring,
+                "cv": cv,
+                "parallelize_cv": parallelize_cv,
+            },
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=run_config,
+            datasets=datasets,
+        )
+
+    def fit(self) -> Result:
+        return self._inner.fit()
+
+    @staticmethod
+    def get_model(checkpoint: Checkpoint):
+        """Unpickle the fitted estimator from a SklearnTrainer checkpoint
+        (reference: train/sklearn/sklearn_checkpoint.py `get_model`)."""
+        d = checkpoint.to_directory()
+        with open(os.path.join(d, MODEL_FILENAME), "rb") as f:
+            return pickle.load(f)

@@ -1,0 +1,84 @@
+"""SklearnTrainer + gated GBDT trainer tests.
+
+Reference test model: python/ray/train/tests/test_sklearn_trainer.py —
+estimator fit in a remote worker, valid-set scores reported, model
+round-trips through the checkpoint; GBDT trainers gate on their libs.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ray_tpu
+from ray_tpu.train.sklearn_trainer import SklearnTrainer
+
+sklearn = pytest.importorskip("sklearn")
+
+
+def _toy_frame(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    df = pd.DataFrame(X, columns=[f"f{i}" for i in range(4)])
+    df["label"] = y
+    return df
+
+
+def test_sklearn_trainer_fit_score_checkpoint(ray_start_regular):
+    from sklearn.linear_model import LogisticRegression
+
+    df = _toy_frame()
+    train_ds = ray_tpu.data.from_pandas(df.iloc[:100])
+    valid_ds = ray_tpu.data.from_pandas(df.iloc[100:])
+
+    trainer = SklearnTrainer(
+        estimator=LogisticRegression(max_iter=200),
+        datasets={"train": train_ds, "valid": valid_ds},
+        label_column="label",
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["fit_time"] > 0
+    assert result.metrics["valid_score"] > 0.7  # separable toy data
+
+    model = SklearnTrainer.get_model(result.checkpoint)
+    X_valid = df.iloc[100:].drop(columns=["label"]).to_numpy()
+    preds = model.predict(X_valid)
+    assert preds.shape == (20,)
+
+
+def test_sklearn_trainer_cv_parallel(ray_start_regular):
+    """cross_validate fans out over the ray_tpu joblib backend from
+    inside the train worker (nested tasks)."""
+    from sklearn.tree import DecisionTreeClassifier
+
+    df = _toy_frame(n=60, seed=1)
+
+    trainer = SklearnTrainer(
+        estimator=DecisionTreeClassifier(max_depth=3),
+        datasets={"train": (df.drop(columns=["label"]).to_numpy(),
+                            df["label"].to_numpy())},
+        cv=2,
+        parallelize_cv=True,
+    )
+    result = trainer.fit()
+    assert 0.0 <= result.metrics["cv_test_score_mean"] <= 1.0
+    assert "cv_test_score_std" in result.metrics
+
+
+def test_gbdt_trainers_gate_with_informative_error():
+    from ray_tpu.train.gbdt import LightGBMTrainer, XGBoostTrainer
+
+    exercised = 0
+    for cls, lib in ((XGBoostTrainer, "xgboost"),
+                     (LightGBMTrainer, "lightgbm")):
+        try:
+            __import__(lib)
+            continue  # installed: this lib's gate can't be exercised
+        except ImportError:
+            pass
+        with pytest.raises(ImportError, match=lib):
+            cls(datasets={"train": (np.zeros((4, 2)), np.zeros(4))})
+        exercised += 1
+    if exercised == 0:
+        pytest.skip("both GBDT libs installed; gating not exercised")
