@@ -241,6 +241,43 @@ class SingleAgentEnvRunner:
                  for i in range(self.num_envs)])
         return SampleBatch(merged)
 
+    def evaluate_perturbations(self, base_params, seeds: List[int],
+                               stdev: float, episodes_per: int = 1
+                               ) -> List[tuple]:
+        """ES/ARS worker op (reference: rllib_contrib ES/ARS workers):
+        for each noise seed, evaluate the antithetic pair
+        theta ± stdev * eps(seed) and return (seed, ret_plus, ret_minus).
+
+        Noise ships as SEEDS, not vectors — each side regenerates the
+        same eps from the seed (the classic shared-noise-table trick,
+        cheap on DCN). Episode returns are recorded into the runner's
+        recent-returns window so standard metrics aggregation reflects
+        the perturbation sweep.
+        """
+        import jax.numpy as jnp
+        from jax.flatten_util import ravel_pytree
+
+        flat, unravel = ravel_pytree(base_params)
+        flat = np.asarray(flat, np.float32)
+        saved = self.params
+        out = []
+        try:
+            for seed in seeds:
+                eps = np.random.default_rng(int(seed)).standard_normal(
+                    flat.shape[0]).astype(np.float32)
+                pair = []
+                for sign in (1.0, -1.0):
+                    self.params = unravel(
+                        jnp.asarray(flat + sign * stdev * eps))
+                    rets = self.sample_episodes(episodes_per)
+                    for r in rets:
+                        self._recent_returns.append(float(r))
+                    pair.append(float(np.mean(rets)) if rets else 0.0)
+                out.append((int(seed), pair[0], pair[1]))
+        finally:
+            self.params = saved
+        return out
+
     def bootstrap_value(self):
         """Per-final-episode value bootstraps of the last sample()
         rollout ({eps_id: value}, consumed by compute_gae). Scalar-like

@@ -121,6 +121,35 @@ class EnvRunnerGroup:
             out.extend(returns)
         return out[:num_episodes] if out else []
 
+    def evaluate_perturbations(self, params, seeds: List[int],
+                               stdev: float,
+                               episodes_per: int = 1) -> List[tuple]:
+        """ES/ARS fan-out: shard the seed list round-robin over healthy
+        runners; each evaluates its antithetic pairs. Failed runners'
+        shards are dropped for the iteration (gradient-free updates
+        tolerate missing directions)."""
+        if self._local_runner is not None:
+            return self._local_runner.evaluate_perturbations(
+                params, list(seeds), stdev, episodes_per)
+        ids = self._manager.healthy_actor_ids()
+        if not ids:
+            raise RuntimeError("no healthy env runners")
+        shards: Dict[int, List[int]] = {i: [] for i in ids}
+        for k, s in enumerate(seeds):
+            shards[ids[k % len(ids)]].append(int(s))
+        ref = ray_tpu.put(params)
+        results = self._manager.foreach_sharded(
+            lambda a, shard: a.evaluate_perturbations.remote(
+                ref, shard, stdev, episodes_per),
+            {i: shard for i, shard in shards.items() if shard})
+        out: List[tuple] = []
+        for _, pairs in results.ok:
+            out.extend(pairs)
+        if not out:
+            raise RuntimeError(
+                "all env runners failed during evaluate_perturbations()")
+        return out
+
     # ---- health / metrics ----
 
     def restore_failed(self, params_fn=None) -> int:

@@ -70,6 +70,31 @@ class FaultTolerantActorManager:
                 results.errors.append((i, e))
         return results
 
+    def foreach_sharded(self, fn: Callable[[Any, Any], Any],
+                        shards: Dict[int, Any], *,
+                        timeout_s: Optional[float] = None
+                        ) -> RemoteCallResults:
+        """Per-actor-args variant of foreach: fn(actor, shard) -> ref,
+        called once per (actor_id, shard) pair; same error isolation
+        and unhealthy-marking semantics."""
+        refs = {}
+        results = RemoteCallResults()
+        for i, shard in shards.items():
+            if not self._healthy.get(i, False):
+                continue
+            try:
+                refs[i] = fn(self._actors[i], shard)
+            except Exception as e:
+                self._mark_unhealthy(i, e)
+                results.errors.append((i, e))
+        for i, ref in refs.items():
+            try:
+                results.ok.append((i, ray_tpu.get(ref, timeout=timeout_s)))
+            except Exception as e:
+                self._mark_unhealthy(i, e)
+                results.errors.append((i, e))
+        return results
+
     def _mark_unhealthy(self, actor_id: int, error: Exception) -> None:
         logger.warning("actor %d failed: %s", actor_id, error)
         self._healthy[actor_id] = False
