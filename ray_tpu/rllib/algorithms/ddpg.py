@@ -1,0 +1,241 @@
+"""DDPG / TD3 — deterministic-policy continuous control.
+
+Reference: rllib_contrib ddpg (Deep Deterministic Policy Gradient:
+deterministic actor, Q critic, polyak targets, Gaussian exploration
+noise) and td3 (TD3 = DDPG + clipped double-Q, target policy smoothing,
+delayed policy updates — Fujimoto et al. 2018).
+
+Architecture mirrors SAC here: the whole update is ONE jit-compiled JAX
+step; target params thread through the batch so the step stays pure and
+polyak sync happens outside the jit. TD3's policy delay is implemented
+by an `update_actor` flag multiplied into the actor loss term: on
+critic-only steps the actor's gradient contribution is exactly zero
+(the shared Adam state still ticks, a documented deviation from
+separate per-network optimizers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner
+from ray_tpu.rllib.core.rl_module import DDPGModule
+from ray_tpu.rllib.utils import sample_batch as sb
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+
+class DDPGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.replay_buffer_capacity: int = 100_000
+        self.num_steps_sampled_before_learning_starts: int = 1_000
+        self.tau: float = 0.005
+        self.exploration_noise: float = 0.1   # of the action half-range
+        self.twin_q: bool = False
+        self.target_noise: float = 0.0        # TD3 smoothing (off)
+        self.target_noise_clip: float = 0.5
+        self.policy_delay: int = 1
+        self.rollout_fragment_length = 64
+        self.train_batch_size = 256
+        self.updates_per_step: int = 16
+        self.lr = 3e-3
+
+    @property
+    def algo_class(self):
+        return DDPG
+
+
+class TD3Config(DDPGConfig):
+    def __init__(self):
+        super().__init__()
+        self.twin_q = True
+        self.target_noise = 0.2
+        self.policy_delay = 2
+
+    @property
+    def algo_class(self):
+        return TD3
+
+
+class DDPGLearner(JaxLearner):
+    def __init__(self, module_spec, config):
+        super().__init__(module_spec, config)
+        import jax
+        import jax.numpy as jnp
+
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: jnp.array(x, copy=True), self.params)
+        self._update_count = 0
+
+    def loss_fn(self, params, batch, rng):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        module = self.module
+        gamma = cfg.get("gamma", 0.99)
+        twin_q = cfg.get("twin_q", False)
+        target_noise = cfg.get("target_noise", 0.0)
+        noise_clip = cfg.get("target_noise_clip", 0.5)
+
+        obs = batch[sb.OBS]
+        next_obs = batch[sb.NEXT_OBS]
+        actions = batch[sb.ACTIONS]
+        if actions.ndim == 1:
+            actions = actions[:, None]
+        target = batch["target_params"]
+
+        # --- critic target: y = r + gamma (1-d) Q_t(s', mu_t(s') + eps) ---
+        next_a = module.action(target, next_obs)
+        if target_noise > 0.0:
+            eps = jnp.clip(
+                jax.random.normal(rng, next_a.shape) * target_noise *
+                module.action_scale,
+                -noise_clip * module.action_scale,
+                noise_clip * module.action_scale)
+            low = module.action_center - module.action_scale
+            high = module.action_center + module.action_scale
+            next_a = jnp.clip(next_a + eps, low, high)
+        tq1, tq2 = module.q_values(target, next_obs, next_a)
+        tq = jnp.minimum(tq1, tq2) if twin_q else tq1
+        not_done = 1.0 - batch[sb.TERMINATEDS].astype(jnp.float32)
+        y = jax.lax.stop_gradient(
+            batch[sb.REWARDS] + gamma * not_done * tq)
+
+        q1, q2 = module.q_values(params, obs, actions)
+        critic_loss = ((q1 - y) ** 2).mean()
+        if twin_q:
+            critic_loss = critic_loss + ((q2 - y) ** 2).mean()
+
+        # --- actor: maximize Q1(s, mu(s)) with critics frozen ---
+        frozen = jax.lax.stop_gradient(
+            {"q1": params["q1"], "q2": params["q2"]})
+        pi_a = module.action(params, obs)
+        pq1, _ = module.q_values({**params, **frozen}, obs, pi_a)
+        actor_loss = -pq1.mean() * batch["update_actor"]
+
+        total = critic_loss + actor_loss
+        return total, {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "q1_mean": q1.mean(),
+            "td_target_mean": y.mean(),
+        }
+
+    def update_ddpg(self, batch: Dict[str, np.ndarray]
+                    ) -> Dict[str, float]:
+        self._update_count += 1
+        delay = int(self.config.get("policy_delay", 1))
+        batch = dict(batch)
+        batch["target_params"] = self.target_params
+        batch["update_actor"] = np.float32(
+            1.0 if self._update_count % delay == 0 else 0.0)
+        return self.update(batch)
+
+    def _shard_batch(self, batch):
+        batch = dict(batch)
+        target = batch.pop("target_params", None)
+        flag = batch.pop("update_actor", None)
+        out = super()._shard_batch(batch)
+        if target is not None:
+            out["target_params"] = target
+        if flag is not None:
+            out["update_actor"] = flag
+        return out
+
+    def sync_target(self, tau: float) -> None:
+        import jax
+
+        self.target_params = jax.tree_util.tree_map(
+            lambda t, p: t * (1 - tau) + p * tau,
+            self.target_params, self.params)
+
+    def get_state(self):
+        import jax
+
+        state = super().get_state()
+        state["target_params"] = jax.tree_util.tree_map(
+            np.asarray, self.target_params)
+        state["update_count"] = self._update_count
+        return state
+
+    def set_state(self, state) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.asarray, state["target_params"])
+        else:
+            self.target_params = jax.tree_util.tree_map(
+                lambda x: jnp.array(x, copy=True), self.params)
+        self._update_count = state.get("update_count", 0)
+
+
+class DDPG(Algorithm):
+    config_class = DDPGConfig
+    learner_class = DDPGLearner
+    module_class = DDPGModule
+
+    def setup(self, config) -> None:
+        cfg = config if isinstance(config, DDPGConfig) else \
+            self.config_class().update_from_dict(dict(config or {}))
+        if cfg.num_learners != 0:
+            raise ValueError("DDPG/TD3 use a local learner "
+                             "(target-net state is per-learner)")
+        # The runner's exploration noise comes from the module config.
+        model = dict(cfg.model)
+        model.setdefault("exploration_noise", cfg.exploration_noise)
+        cfg.model = model
+        super().setup(cfg)
+        self.replay = ReplayBuffer(self.config.replay_buffer_capacity,
+                                   seed=self.config.seed)
+        self._env_steps = 0
+
+    @property
+    def _learner(self) -> DDPGLearner:
+        return self.learner_group._local
+
+    def get_extra_state(self) -> Dict[str, Any]:
+        return {
+            "env_steps": self._env_steps,
+            "replay_cols": dict(self.replay._cols),
+            "replay_size": self.replay._size,
+            "replay_next": self.replay._next,
+        }
+
+    def set_extra_state(self, state: Dict[str, Any]) -> None:
+        if not state:
+            return
+        self._env_steps = state["env_steps"]
+        self.replay._cols = dict(state["replay_cols"])
+        self.replay._size = state["replay_size"]
+        self.replay._next = state["replay_next"]
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        rollout = self.env_runner_group.sample(cfg.rollout_fragment_length)
+        self._env_steps += len(rollout)
+        self.replay.add(rollout)
+
+        metrics: Dict[str, Any] = {"replay_size": len(self.replay),
+                                   "num_env_steps_total": self._env_steps}
+        if len(self.replay) >= \
+                cfg.num_steps_sampled_before_learning_starts:
+            for _ in range(cfg.updates_per_step):
+                batch = self.replay.sample(cfg.train_batch_size)
+                m = self._learner.update_ddpg(batch)
+                self._learner.sync_target(cfg.tau)
+                metrics.update(m)
+            self.env_runner_group.sync_weights(
+                self.learner_group.get_weights())
+        return metrics
+
+
+class TD3(DDPG):
+    config_class = TD3Config

@@ -218,6 +218,63 @@ class SACModule(RLModule):
                 self.action_center}
 
 
+class DDPGModule(RLModule):
+    """Deterministic tanh actor + twin Q critics (DDPG/TD3).
+
+    Reference: rllib_contrib ddpg/td3 models (deterministic policy
+    network, Q networks over (obs, action)). Twin critics are always
+    present in the params; DDPG uses q1 only, TD3 takes the min.
+    Exploration = Gaussian action noise scaled by `exploration_noise`
+    (fraction of the action half-range)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 model_config: Optional[dict] = None):
+        cfg = model_config or {}
+        self.obs_dim = obs_dim
+        self.act_dim = num_actions
+        self.hiddens = tuple(cfg.get("fcnet_hiddens", (64, 64)))
+        low = np.asarray(cfg.get("action_low", -1.0), np.float32)
+        high = np.asarray(cfg.get("action_high", 1.0), np.float32)
+        self.action_scale = (high - low) / 2.0
+        self.action_center = (high + low) / 2.0
+        self.exploration_noise = float(cfg.get("exploration_noise", 0.1))
+
+    def init_params(self, rng: jax.Array) -> Dict[str, Any]:
+        k_pi, k_q1, k_q2 = jax.random.split(rng, 3)
+        pi_sizes = (self.obs_dim,) + self.hiddens + (self.act_dim,)
+        q_sizes = (self.obs_dim + self.act_dim,) + self.hiddens + (1,)
+        return {
+            "pi": _mlp_init(k_pi, pi_sizes),
+            "q1": _mlp_init(k_q1, q_sizes),
+            "q2": _mlp_init(k_q2, q_sizes),
+        }
+
+    def action(self, params, obs):
+        """Deterministic policy action, squashed onto the bounds."""
+        raw = _mlp_apply(params["pi"], obs)
+        return jnp.tanh(raw) * self.action_scale + self.action_center
+
+    def q_values(self, params, obs, action):
+        x = jnp.concatenate([obs, action], axis=-1)
+        q1 = _mlp_apply(params["q1"], x)[..., 0]
+        q2 = _mlp_apply(params["q2"], x)[..., 0]
+        return q1, q2
+
+    def forward_train(self, params, obs):
+        return {"actions": self.action(params, obs)}
+
+    def forward_exploration(self, params, obs, rng):
+        a = self.action(params, obs)
+        noise = jax.random.normal(rng, a.shape) * \
+            self.exploration_noise * self.action_scale
+        low = self.action_center - self.action_scale
+        high = self.action_center + self.action_scale
+        return {"actions": jnp.clip(a + noise, low, high)}
+
+    def forward_inference(self, params, obs):
+        return {"actions": self.action(params, obs)}
+
+
 def params_to_numpy(params: Any) -> Any:
     """Device → host pytree (for shipping weights to env runners)."""
     return jax.tree_util.tree_map(lambda x: np.asarray(x), params)

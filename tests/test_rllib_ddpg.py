@@ -1,0 +1,83 @@
+"""DDPG / TD3 tests.
+
+Reference test model: rllib_contrib ddpg/td3 CI — Pendulum learning runs
+plus state round-trips. Budgets mirror test_rllib.py's SAC test.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.algorithms.ddpg import (DDPG, DDPGConfig, TD3,
+                                           TD3Config)
+
+
+def test_td3_solves_pendulum():
+    """TD3 swing-up: random ~-1300 → greedy better than -300 (probe runs
+    reach ~-45 by iteration 225)."""
+    config = (TD3Config()
+              .environment(env="Pendulum")
+              .env_runners(num_env_runners=0)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    for _ in range(300):
+        result = algo.step()
+    assert np.isfinite(result["critic_loss"])
+    ev = algo.evaluate(num_episodes=5)
+    ret = ev["evaluation"]["episode_return_mean"]
+    assert ret > -300, ev
+    algo.cleanup()
+
+
+def test_ddpg_improves_pendulum():
+    """DDPG (no twin-Q, no smoothing, delay 1): clear improvement over
+    the random baseline within a short budget."""
+    config = (DDPGConfig()
+              .environment(env="Pendulum")
+              .env_runners(num_env_runners=0)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    for _ in range(150):
+        result = algo.step()
+    assert np.isfinite(result["critic_loss"])
+    ev = algo.evaluate(num_episodes=5)
+    assert ev["evaluation"]["episode_return_mean"] > -900, ev
+    algo.cleanup()
+
+
+def test_td3_config_defaults_and_checkpoint(tmp_path):
+    """TD3 = DDPG + twin-Q + target smoothing + policy delay; learner
+    state (targets + update counter) round-trips through checkpoints."""
+    cfg = TD3Config()
+    assert cfg.twin_q and cfg.target_noise > 0 and cfg.policy_delay == 2
+    assert DDPGConfig().twin_q is False
+
+    import os
+
+    from jax.flatten_util import ravel_pytree
+
+    config = (TD3Config()
+              .environment(env="Pendulum")
+              .env_runners(num_env_runners=0)
+              .training(num_steps_sampled_before_learning_starts=64,
+                        updates_per_step=2, train_batch_size=32)
+              .debugging(seed=1))
+    algo = config.build_algo()
+    for _ in range(3):
+        algo.training_step()
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(ckpt, exist_ok=True)
+    algo.save_checkpoint(ckpt)
+    flat_t, _ = ravel_pytree(algo._learner.target_params)
+    count = algo._learner._update_count
+    assert count == 6  # 3 steps x 2 updates
+    algo.cleanup()
+
+    algo2 = config.copy().build_algo()
+    algo2.load_checkpoint(ckpt)
+    flat_t2, _ = ravel_pytree(algo2._learner.target_params)
+    np.testing.assert_allclose(np.asarray(flat_t), np.asarray(flat_t2))
+    assert algo2._learner._update_count == count
+    # Restored algo keeps training (replay restored too).
+    m = algo2.training_step()
+    assert m["replay_size"] > 0
+    algo2.cleanup()
