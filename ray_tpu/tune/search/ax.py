@@ -53,6 +53,7 @@ class AxSearch(Searcher):
                 "(pip install ax-platform); for a dependency-free "
                 "Bayesian searcher use "
                 "ray_tpu.tune.search.bayesopt.BayesOptSearch") from e
+        super().__init__(metric, mode)
         self._metric = metric
         self._mode = mode
         self._space = dict(space or {})

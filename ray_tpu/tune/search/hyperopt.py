@@ -1,0 +1,132 @@
+"""HyperOpt searcher adapter (gated).
+
+Reference: python/ray/tune/search/hyperopt/hyperopt_search.py — an
+adapter over hyperopt's TPE: the tune search space converts to `hp.*`
+expressions, suggestions come from `tpe.suggest` against a live
+`Trials` book, and completions are written back as hyperopt results.
+hyperopt is an optional dependency: importing this module always works;
+constructing `HyperOptSearch` without it raises with install guidance.
+The in-tree, dependency-free TPE lives in
+ray_tpu.tune.search.optuna.TuneTPE.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+def _to_hyperopt_space(space: Dict[str, Any]):
+    from hyperopt import hp
+
+    out = {}
+    for name, dom in sorted(space.items()):
+        if isinstance(dom, Categorical):
+            out[name] = hp.choice(name, list(dom.categories))
+        elif isinstance(dom, Float):
+            if dom.log:
+                import numpy as np
+
+                out[name] = hp.loguniform(name, np.log(dom.lower),
+                                          np.log(dom.upper))
+            else:
+                out[name] = hp.uniform(name, dom.lower, dom.upper)
+        elif isinstance(dom, Integer):
+            out[name] = hp.uniformint(name, dom.lower, dom.upper - 1)
+        else:
+            raise ValueError(
+                f"HyperOptSearch cannot express domain {dom!r} "
+                f"for {name!r}")
+    return out
+
+
+class HyperOptSearch(Searcher):
+    def __init__(self,
+                 space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None,
+                 mode: str = "max",
+                 n_initial_points: int = 20,
+                 random_state_seed: Optional[int] = None):
+        try:
+            import hyperopt  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "HyperOptSearch requires the 'hyperopt' package "
+                "(pip install hyperopt); for a dependency-free TPE "
+                "searcher use ray_tpu.tune.search.optuna.TuneTPE") from e
+        import functools
+
+        import numpy as np
+        from hyperopt import tpe
+
+        super().__init__(metric, mode)
+        self._metric = metric
+        self._mode = mode
+        self._space = dict(space or {})
+        self._fixed: Dict[str, Any] = {}
+        self._suggest_fn = functools.partial(
+            tpe.suggest, n_startup_jobs=n_initial_points)
+        self._rng = np.random.default_rng(random_state_seed)
+        self._trials = None       # hyperopt.Trials, lazily created
+        self._domain = None
+        self._hp_space = None     # cached hp.* expression graph
+        self._live: Dict[str, int] = {}  # trial_id -> hyperopt tid
+
+    def set_search_properties(self, metric, mode, config=None) -> None:
+        self._metric = metric or self._metric
+        self._mode = mode or self._mode
+        if config and not self._space:
+            self._space = {k: v for k, v in config.items()
+                           if isinstance(v, Domain)}
+            self._fixed = {k: v for k, v in config.items()
+                           if not isinstance(v, Domain)}
+
+    def _ensure_book(self) -> None:
+        import hyperopt
+
+        if self._trials is None:
+            self._trials = hyperopt.Trials()
+            self._hp_space = _to_hyperopt_space(self._space)
+            self._domain = hyperopt.Domain(lambda spc: spc,
+                                           self._hp_space)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        import hyperopt
+
+        self._ensure_book()
+        new_ids = self._trials.new_trial_ids(1)
+        self._trials.refresh()
+        seed = int(self._rng.integers(2 ** 31 - 1))
+        new_trials = self._suggest_fn(new_ids, self._domain, self._trials,
+                                      seed)
+        self._trials.insert_trial_docs(new_trials)
+        self._trials.refresh()
+        tid = new_trials[0]["tid"]
+        self._live[trial_id] = tid
+        vals = {k: v[0] for k, v in
+                new_trials[0]["misc"]["vals"].items() if v}
+        config = hyperopt.space_eval(self._hp_space, vals)
+        return {**self._fixed, **config}
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        import hyperopt
+
+        tid = self._live.pop(trial_id, None)
+        if tid is None or self._trials is None:
+            return
+        for doc in self._trials.trials:
+            if doc["tid"] != tid:
+                continue
+            if error or not result or self._metric not in result:
+                doc["state"] = hyperopt.JOB_STATE_ERROR
+            else:
+                value = float(result[self._metric])
+                loss = -value if self._mode == "max" else value
+                doc["state"] = hyperopt.JOB_STATE_DONE
+                doc["result"] = {"loss": loss, "status": "ok"}
+            break
+        self._trials.refresh()

@@ -1,0 +1,104 @@
+"""Nevergrad searcher adapter (gated).
+
+Reference: python/ray/tune/search/nevergrad/nevergrad_search.py — an
+ask/tell adapter over Meta's nevergrad optimizers. The tune search space
+converts to an `ng.p.Dict` parametrization; `suggest` asks the
+optimizer for a candidate, `on_trial_complete` tells the loss back.
+nevergrad is an optional dependency: importing this module always
+works; constructing `NevergradSearch` without it raises with install
+guidance.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+def _to_nevergrad_parametrization(space: Dict[str, Any]):
+    import nevergrad as ng
+
+    params = {}
+    for name, dom in sorted(space.items()):
+        if isinstance(dom, Categorical):
+            params[name] = ng.p.Choice(list(dom.categories))
+        elif isinstance(dom, Float):
+            if dom.log:
+                params[name] = ng.p.Log(lower=dom.lower, upper=dom.upper)
+            else:
+                params[name] = ng.p.Scalar(lower=dom.lower,
+                                           upper=dom.upper)
+        elif isinstance(dom, Integer):
+            params[name] = ng.p.Scalar(
+                lower=dom.lower, upper=dom.upper - 1
+            ).set_integer_casting()
+        else:
+            raise ValueError(
+                f"NevergradSearch cannot express domain {dom!r} "
+                f"for {name!r}")
+    return ng.p.Dict(**params)
+
+
+class NevergradSearch(Searcher):
+    def __init__(self,
+                 space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None,
+                 mode: str = "max",
+                 optimizer: str = "NGOpt",
+                 budget: int = 100):
+        try:
+            import nevergrad  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "NevergradSearch requires the 'nevergrad' package "
+                "(pip install nevergrad); dependency-free alternatives: "
+                "BasicVariantGenerator (random/grid) or BayesOptSearch "
+                "(GP-UCB)") from e
+        super().__init__(metric, mode)
+        self._metric = metric
+        self._mode = mode
+        self._space = dict(space or {})
+        self._fixed: Dict[str, Any] = {}
+        self._optimizer_name = optimizer
+        self._budget = budget
+        self._opt = None
+        self._live: Dict[str, Any] = {}  # trial_id -> candidate
+
+    def set_search_properties(self, metric, mode, config=None) -> None:
+        self._metric = metric or self._metric
+        self._mode = mode or self._mode
+        if config and not self._space:
+            self._space = {k: v for k, v in config.items()
+                           if isinstance(v, Domain)}
+            self._fixed = {k: v for k, v in config.items()
+                           if not isinstance(v, Domain)}
+
+    def _ensure_optimizer(self) -> None:
+        import nevergrad as ng
+
+        if self._opt is None:
+            cls = ng.optimizers.registry[self._optimizer_name]
+            self._opt = cls(
+                parametrization=_to_nevergrad_parametrization(
+                    self._space),
+                budget=self._budget)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        self._ensure_optimizer()
+        candidate = self._opt.ask()
+        self._live[trial_id] = candidate
+        return {**self._fixed, **dict(candidate.value)}
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        candidate = self._live.pop(trial_id, None)
+        if candidate is None or self._opt is None:
+            return
+        if error or not result or self._metric not in result:
+            return  # dropped candidates simply never get told
+        value = float(result[self._metric])
+        loss = -value if self._mode == "max" else value
+        self._opt.tell(candidate, loss)

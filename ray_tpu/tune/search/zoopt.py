@@ -1,0 +1,108 @@
+"""ZOOpt searcher adapter (gated).
+
+Reference: python/ray/tune/search/zoopt/zoopt_search.py — an adapter
+over ZOOpt's SRacos (sequential randomized coordinate shrinking), which
+supports an ask/tell flow through `SRacosTune.suggest`/`complete`. The
+tune search space converts to a `zoopt.Dimension2` spec. zoopt is an
+optional dependency: importing this module always works; constructing
+`ZOOptSearch` without it raises with install guidance.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+def _to_zoopt_dim(space: Dict[str, Any]):
+    from zoopt import ValueType
+
+    names, dims = [], []
+    for name, dom in sorted(space.items()):
+        names.append(name)
+        if isinstance(dom, Categorical):
+            dims.append((ValueType.GRID, list(dom.categories)))
+        elif isinstance(dom, Float):
+            dims.append((ValueType.CONTINUOUS, [dom.lower, dom.upper],
+                         1e-10))
+        elif isinstance(dom, Integer):
+            dims.append((ValueType.DISCRETE, [dom.lower, dom.upper - 1],
+                         False))
+        else:
+            raise ValueError(
+                f"ZOOptSearch cannot express domain {dom!r} for {name!r}")
+    return names, dims
+
+
+class ZOOptSearch(Searcher):
+    def __init__(self,
+                 space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None,
+                 mode: str = "max",
+                 budget: int = 100,
+                 parallel_num: int = 1):
+        try:
+            import zoopt  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "ZOOptSearch requires the 'zoopt' package "
+                "(pip install zoopt); dependency-free alternatives: "
+                "BasicVariantGenerator (random/grid) or BayesOptSearch "
+                "(GP-UCB)") from e
+        super().__init__(metric, mode)
+        self._metric = metric
+        self._mode = mode
+        self._space = dict(space or {})
+        self._fixed: Dict[str, Any] = {}
+        self._budget = budget
+        self._parallel_num = parallel_num
+        self._core = None      # SRacosTune
+        self._names = None
+        self._live: Dict[str, Any] = {}  # trial_id -> zoopt Solution
+
+    def set_search_properties(self, metric, mode, config=None) -> None:
+        self._metric = metric or self._metric
+        self._mode = mode or self._mode
+        if config and not self._space:
+            self._space = {k: v for k, v in config.items()
+                           if isinstance(v, Domain)}
+            self._fixed = {k: v for k, v in config.items()
+                           if not isinstance(v, Domain)}
+
+    def _ensure_core(self) -> None:
+        if self._core is not None:
+            return
+        from zoopt import Dimension2, Parameter
+        from zoopt.algos.opt_algorithms.racos.sracos import SRacosTune
+
+        self._names, dims = _to_zoopt_dim(self._space)
+        # Call shape per the reference adapter (zoopt_search.py):
+        # SRacosTune(dimension=..., parameter=..., parallel_num=...).
+        self._core = SRacosTune(
+            dimension=Dimension2(dims),
+            parameter=Parameter(budget=self._budget),
+            parallel_num=self._parallel_num)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        self._ensure_core()
+        solution = self._core.suggest()
+        if solution is None:
+            return None
+        self._live[trial_id] = solution
+        values = solution.get_x()
+        return {**self._fixed, **dict(zip(self._names, values))}
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        solution = self._live.pop(trial_id, None)
+        if solution is None or self._core is None:
+            return
+        if error or not result or self._metric not in result:
+            return
+        value = float(result[self._metric])
+        # SRacos minimizes.
+        self._core.complete(solution,
+                            -value if self._mode == "max" else value)

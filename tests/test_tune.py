@@ -477,6 +477,35 @@ def test_ax_search_gated():
         assert s.suggest("t1") is not None
 
 
+def test_hyperopt_nevergrad_zoopt_gated():
+    """The HyperOpt/Nevergrad/ZOOpt adapters exist, import cleanly, and
+    gate with actionable ImportErrors when their libs are absent (or
+    actually suggest when present)."""
+    from ray_tpu.tune.search.hyperopt import HyperOptSearch
+    from ray_tpu.tune.search.nevergrad import NevergradSearch
+    from ray_tpu.tune.search.zoopt import ZOOptSearch
+
+    for cls, lib in ((HyperOptSearch, "hyperopt"),
+                     (NevergradSearch, "nevergrad"),
+                     (ZOOptSearch, "zoopt")):
+        try:
+            __import__(lib)
+        except ImportError:
+            with pytest.raises(ImportError, match=lib):
+                cls(space={"x": tune.uniform(0, 1)},
+                    metric="m", mode="max")
+        else:
+            from ray_tpu.tune.search import ConcurrencyLimiter
+
+            s = cls(space={"x": tune.uniform(0, 1)},
+                    metric="m", mode="max")
+            # Searcher base init ran: ConcurrencyLimiter wraps cleanly.
+            limited = ConcurrencyLimiter(s, max_concurrent=2)
+            assert limited.metric == "m"
+            cfg = s.suggest("t0")
+            assert cfg is None or 0 <= cfg["x"] <= 1
+
+
 def test_tuner_restore_resumes_experiment(tmp_path):
     """Experiment-level snapshot/resume (reference tuner.py:243
     Tuner.restore): finished trials keep results, unfinished trials
