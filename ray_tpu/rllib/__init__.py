@@ -3,7 +3,8 @@
 Parity target: the reference's rllib/ new API stack (AlgorithmConfig /
 Algorithm / EnvRunnerGroup / RLModule / Learner / LearnerGroup) with
 JAX/TPU learners and CPU env-runner actors. Algorithms: PPO (single and
-multi-agent), APPO, DQN, SAC, CQL, IMPALA, BC, MARWIL.
+multi-agent), APPO, DQN, SAC, CQL, IMPALA, BC, MARWIL, DDPG, TD3,
+DreamerV3 (model-based), ES, ARS (evolution).
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
@@ -16,6 +17,10 @@ from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
+from ray_tpu.rllib.algorithms.ddpg import (DDPG, DDPGConfig, TD3,
+                                           TD3Config)
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
+from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.algorithms.multi_agent_ppo import (MultiAgentPPO,
                                                       MultiAgentPPOConfig)
 from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
@@ -40,6 +45,16 @@ __all__ = [
     "CQLConfig",
     "MARWIL",
     "MARWILConfig",
+    "DDPG",
+    "DDPGConfig",
+    "TD3",
+    "TD3Config",
+    "DreamerV3",
+    "DreamerV3Config",
+    "ES",
+    "ESConfig",
+    "ARS",
+    "ARSConfig",
     "MultiAgentPPO",
     "MultiAgentPPOConfig",
     "MultiAgentEnv",

@@ -20,12 +20,11 @@ from typing import Any, Dict
 
 import numpy as np
 
-from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.off_policy import OffPolicyAlgorithm
 from ray_tpu.rllib.core.learner import JaxLearner
 from ray_tpu.rllib.core.rl_module import DDPGModule
 from ray_tpu.rllib.utils import sample_batch as sb
-from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
 
 
 class DDPGConfig(AlgorithmConfig):
@@ -177,64 +176,22 @@ class DDPGLearner(JaxLearner):
         self._update_count = state.get("update_count", 0)
 
 
-class DDPG(Algorithm):
+class DDPG(OffPolicyAlgorithm):
     config_class = DDPGConfig
     learner_class = DDPGLearner
     module_class = DDPGModule
 
     def setup(self, config) -> None:
-        cfg = config if isinstance(config, DDPGConfig) else \
+        cfg = config if isinstance(config, self.config_class) else \
             self.config_class().update_from_dict(dict(config or {}))
-        if cfg.num_learners != 0:
-            raise ValueError("DDPG/TD3 use a local learner "
-                             "(target-net state is per-learner)")
         # The runner's exploration noise comes from the module config.
         model = dict(cfg.model)
         model.setdefault("exploration_noise", cfg.exploration_noise)
         cfg.model = model
         super().setup(cfg)
-        self.replay = ReplayBuffer(self.config.replay_buffer_capacity,
-                                   seed=self.config.seed)
-        self._env_steps = 0
 
-    @property
-    def _learner(self) -> DDPGLearner:
-        return self.learner_group._local
-
-    def get_extra_state(self) -> Dict[str, Any]:
-        return {
-            "env_steps": self._env_steps,
-            "replay_cols": dict(self.replay._cols),
-            "replay_size": self.replay._size,
-            "replay_next": self.replay._next,
-        }
-
-    def set_extra_state(self, state: Dict[str, Any]) -> None:
-        if not state:
-            return
-        self._env_steps = state["env_steps"]
-        self.replay._cols = dict(state["replay_cols"])
-        self.replay._size = state["replay_size"]
-        self.replay._next = state["replay_next"]
-
-    def training_step(self) -> Dict[str, Any]:
-        cfg = self.config
-        rollout = self.env_runner_group.sample(cfg.rollout_fragment_length)
-        self._env_steps += len(rollout)
-        self.replay.add(rollout)
-
-        metrics: Dict[str, Any] = {"replay_size": len(self.replay),
-                                   "num_env_steps_total": self._env_steps}
-        if len(self.replay) >= \
-                cfg.num_steps_sampled_before_learning_starts:
-            for _ in range(cfg.updates_per_step):
-                batch = self.replay.sample(cfg.train_batch_size)
-                m = self._learner.update_ddpg(batch)
-                self._learner.sync_target(cfg.tau)
-                metrics.update(m)
-            self.env_runner_group.sync_weights(
-                self.learner_group.get_weights())
-        return metrics
+    def _update_once(self, batch) -> Dict[str, float]:
+        return self._learner.update_ddpg(batch)
 
 
 class TD3(DDPG):

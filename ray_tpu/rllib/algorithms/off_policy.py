@@ -1,0 +1,71 @@
+"""OffPolicyAlgorithm — shared scaffolding for replay-buffer algorithms.
+
+Reference: the common structure of rllib's SAC/DDPG/TD3 (and DQN)
+Algorithm classes: a LOCAL learner holding polyak-averaged target nets,
+a driver-side replay buffer checkpointed with the algorithm, and a
+training step of rollout → replay → K updates → target sync → weight
+broadcast. Subclasses supply `_update_once` (one learner update from a
+sampled batch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+
+class OffPolicyAlgorithm(Algorithm):
+    def setup(self, config) -> None:
+        cfg = config if isinstance(config, self.config_class) else \
+            self.config_class().update_from_dict(dict(config or {}))
+        if cfg.num_learners != 0:
+            raise ValueError(
+                f"{type(self).__name__} uses a local learner "
+                "(target-net state is per-learner)")
+        super().setup(cfg)
+        self.replay = ReplayBuffer(self.config.replay_buffer_capacity,
+                                   seed=self.config.seed)
+        self._env_steps = 0
+
+    @property
+    def _learner(self):
+        return self.learner_group._local
+
+    def get_extra_state(self) -> Dict[str, Any]:
+        return {
+            "env_steps": self._env_steps,
+            "replay_cols": dict(self.replay._cols),
+            "replay_size": self.replay._size,
+            "replay_next": self.replay._next,
+        }
+
+    def set_extra_state(self, state: Dict[str, Any]) -> None:
+        if not state:
+            return
+        self._env_steps = state["env_steps"]
+        self.replay._cols = dict(state["replay_cols"])
+        self.replay._size = state["replay_size"]
+        self.replay._next = state["replay_next"]
+
+    def _update_once(self, batch) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        rollout = self.env_runner_group.sample(cfg.rollout_fragment_length)
+        self._env_steps += len(rollout)
+        self.replay.add(rollout)
+
+        metrics: Dict[str, Any] = {"replay_size": len(self.replay),
+                                   "num_env_steps_total": self._env_steps}
+        if len(self.replay) >= \
+                cfg.num_steps_sampled_before_learning_starts:
+            for _ in range(cfg.updates_per_step):
+                batch = self.replay.sample(cfg.train_batch_size)
+                metrics.update(self._update_once(batch))
+                self._learner.sync_target(cfg.tau)
+            self.env_runner_group.sync_weights(
+                self.learner_group.get_weights())
+        return metrics
