@@ -108,6 +108,35 @@ def main(quick: bool = False):
     results.append(("small_objects_get_per_second",
                     timed_median(many_get, n), "objects/s"))
 
+    # --- actor creation storm (warm pool) ---
+    # Reference envelope row: actor creation throughput (BASELINE.md
+    # 40k-actor scale / release scalability suite). A fresh cluster
+    # sized to the storm keeps the prestart pool warm for all N, so the
+    # metric isolates the creation pipeline (pipelined GCS registration
+    # + lease + creation push + first call), not process cold start.
+    ray_tpu.shutdown()
+    storm_n = 4 if quick else 16
+    ray_tpu.init(num_cpus=storm_n)
+
+    @ray_tpu.remote
+    class S:
+        def m(self, x=None):
+            return x
+
+    time.sleep(2.0 if quick else 8.0)  # prestart pool fill
+
+    storms = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        batch = [S.remote() for _ in range(storm_n)]
+        ray_tpu.get([b.m.remote(1) for b in batch], timeout=120)
+        storms.append(storm_n / (time.perf_counter() - t0))
+        for b in batch:
+            ray_tpu.kill(b)
+        time.sleep(1.0 if quick else 4.0)  # pool refill between trials
+    results.append(("actor_creation_storm_per_second",
+                    statistics.median(storms), "actors/s"))
+
     for name, value, unit in results:
         print(json.dumps({"metric": name, "value": round(value, 2),
                           "unit": unit}))

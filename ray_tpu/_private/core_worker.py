@@ -146,6 +146,10 @@ class _ActorState:
         self.channel = None
         self.fast_disabled = False
         self.loop_inflight = 0
+        # Async registration (anonymous actors register fire-and-forget;
+        # the first connection waits for the GCS ack to land).
+        self.register_done: Optional[asyncio.Event] = None
+        self.register_error: Optional[BaseException] = None
 
 
 class _LocalActor:
@@ -256,8 +260,20 @@ class CoreWorker:
 
     # ---------------------------------------------------------------- setup
     async def connect(self) -> None:
+        import time as _time
+
+        _t0 = _time.perf_counter()
+        _trace = os.environ.get("RAY_TPU_TRACE_STARTUP")
+
+        def _tr(msg):
+            if _trace:
+                print(f"CTRACE {os.getpid()} "
+                      f"+{_time.perf_counter() - _t0:.3f} {msg}",
+                      flush=True)
+
         self._server = rpc.Server(self, "127.0.0.1", 0)
         port = await self._server.start()
+        _tr("rpc server up")
         self.address = f"127.0.0.1:{port}"
         ghost, gport = self.gcs_address.rsplit(":", 1)
         # Generous first-connect budget: under spawn storms the control
@@ -266,6 +282,7 @@ class CoreWorker:
             ghost, int(gport), handler=self._on_pubsub, name="->gcs",
             timeout=self.config.worker_register_timeout_s)
         self.gcs.on_close = self._on_gcs_close
+        _tr("gcs connected")
         if self.mode == DRIVER:
             r = await self.gcs.call("register_job",
                                     {"driver_address": self.address})
@@ -292,6 +309,7 @@ class CoreWorker:
                     "fastlane server failed to start; using rpc path only")
                 self._fl_server = None
                 self.fast_address = ""
+            _tr("fastlane up")
         if self.raylet_address:
             rhost, rport = self.raylet_address.rsplit(":", 1)
             self.raylet = await rpc.connect(
@@ -306,6 +324,7 @@ class CoreWorker:
             })
             if self.node_id is None:
                 self.node_id = NodeID(r["node_id"])
+            _tr("raylet registered")
             if self.mode == WORKER:
                 # A worker whose raylet dies must exit, not linger as an
                 # orphan (reference: workers poll the raylet socket and
@@ -323,6 +342,7 @@ class CoreWorker:
         if self.config.task_events_enabled:
             self._task_event_flusher = asyncio.get_running_loop(
             ).create_task(self._task_event_flush_loop())
+        _tr("connect done")
 
     def _on_gcs_close(self, conn: rpc.Connection) -> None:
         if not self._should_exit.is_set() and self.loop.is_running():
@@ -1579,8 +1599,9 @@ class CoreWorker:
         return True
 
     # ------------------------------------------------------------- actors
-    async def create_actor(self, descriptor: FunctionDescriptor, args: tuple,
-                           kwargs: dict, opts: dict) -> ActorID:
+    def _actor_register_payload(self, descriptor: FunctionDescriptor,
+                                args: tuple, kwargs: dict,
+                                opts: dict) -> tuple:
         actor_id = ActorID.of(self.job_id)
         creation_opts = dict(opts)
         creation_opts["actor_creation_spec"] = {
@@ -1589,7 +1610,7 @@ class CoreWorker:
         }
         spec = self._build_spec(ACTOR_CREATION_TASK, descriptor, args,
                                 kwargs, creation_opts, actor_id=actor_id)
-        r = await self.gcs.call("register_actor", {
+        return actor_id, {
             "actor_id": actor_id.binary(),
             "job_id": self.job_id.binary(),
             "name": opts.get("name") or "",
@@ -1599,12 +1620,66 @@ class CoreWorker:
             "max_concurrency": opts.get("max_concurrency", 1),
             "detached": bool(opts.get("lifetime") == "detached"),
             "creation_task": spec.to_wire(),
-        })
+        }
+
+    async def create_actor(self, descriptor: FunctionDescriptor, args: tuple,
+                           kwargs: dict, opts: dict) -> ActorID:
+        """Synchronous-registration path (named/detached actors: name
+        conflicts must raise at .remote() time, reference semantics)."""
+        actor_id, payload = self._actor_register_payload(
+            descriptor, args, kwargs, opts)
+        r = await self.gcs.call("register_actor", payload)
         if not r.get("ok"):
             raise ValueError(r.get("error", "actor registration failed"))
         st = self._actors.setdefault(actor_id, _ActorState())
         st.max_concurrency = opts.get("max_concurrency", 1)
         return actor_id
+
+    def create_actor_sync(self, descriptor: FunctionDescriptor, args: tuple,
+                          kwargs: dict, opts: dict) -> ActorID:
+        """Caller-thread actor creation for ANONYMOUS actors: id
+        assignment + spec build here, GCS registration fired on the loop
+        WITHOUT waiting for the ack (reference: actor registration is
+        asynchronous in the C++ core worker's creation pipeline —
+        gcs_actor_manager.cc processes registrations off the caller's
+        critical path). The first connection to the actor awaits the ack
+        via st.register_done, so registration failures surface on first
+        use. Under a creation storm this removes one GCS round trip per
+        actor from the driver's submit loop (~20 ms each on a contended
+        host: 32-actor storm submit 724 ms → ~30 ms)."""
+        actor_id, payload = self._actor_register_payload(
+            descriptor, args, kwargs, opts)
+        st = self._actors.setdefault(actor_id, _ActorState())
+        st.max_concurrency = opts.get("max_concurrency", 1)
+        # Created on the caller thread BEFORE the handle escapes: the
+        # first _actor_connection must find the event (wait_actor_alive
+        # answers None for not-yet-registered actors). Safe off-loop in
+        # 3.10+: asyncio.Event binds to a loop only on first await.
+        st.register_done = asyncio.Event()
+        self.loop.call_soon_threadsafe(
+            lambda: self.loop.create_task(
+                self._register_actor_bg(actor_id, payload)))
+        return actor_id
+
+    async def _register_actor_bg(self, actor_id: ActorID,
+                                 payload: dict) -> None:
+        st = self._actors[actor_id]
+        try:
+            r = await self.gcs.call("register_actor", payload)
+            if not r.get("ok"):
+                st.register_error = ValueError(
+                    r.get("error", "actor registration failed"))
+        except asyncio.CancelledError:
+            # Loop teardown racing a late create: store a plain error
+            # (CancelledError must not later escape unrelated tasks via
+            # _actor_connection) and let the cancellation propagate.
+            st.register_error = RuntimeError(
+                "actor registration cancelled (shutdown)")
+            st.register_done.set()
+            raise
+        except Exception as e:
+            st.register_error = e
+        st.register_done.set()
 
     async def _actor_connection(self, actor_id: ActorID) -> rpc.Connection:
         st = self._actors.get(actor_id)
@@ -1614,6 +1689,13 @@ class CoreWorker:
             if st.conn is not None and not st.conn.closed and \
                     st.state == "ALIVE":
                 return st.conn
+            if st.register_done is not None:
+                # Fire-and-forget registration (create_actor_sync): the
+                # GCS ack must land before wait_actor_alive means
+                # anything; registration failures surface here.
+                await st.register_done.wait()
+                if st.register_error is not None:
+                    raise st.register_error
             view = await self.gcs.call("wait_actor_alive", {
                 "actor_id": actor_id.binary(), "timeout": 60.0}, timeout=65.0)
             if view is None:
@@ -1779,6 +1861,12 @@ class CoreWorker:
 
     async def kill_actor(self, actor_id: ActorID,
                          no_restart: bool = True) -> None:
+        st = self._actors.get(actor_id)
+        if st is not None and st.register_done is not None:
+            # Pipelined registration may not have landed yet; killing
+            # before the GCS knows the actor would silently no-op and
+            # leak the actor when registration lands moments later.
+            await st.register_done.wait()
         await self.gcs.call("kill_actor", {
             "actor_id": actor_id.binary(), "no_restart": no_restart})
 
@@ -2312,12 +2400,19 @@ class CoreWorker:
                     max_workers=max_concurrency,
                     thread_name_prefix="actor_exec")
                 self._exec_direct = True  # parallel dispatch, no pump
-            await self.gcs.call("actor_ready", {
+            accepted = await self.gcs.call("actor_ready", {
                 "actor_id": spec.actor_id.binary(),
                 "address": self.address,
                 "fast_address": self.fast_address,
                 "node_id": self.node_id.binary() if self.node_id else b"",
             })
+            if not accepted:
+                # The actor was killed while its creation was in flight:
+                # this dedicated worker must not linger holding the
+                # lease — exit; the raylet reclaims on conn close.
+                logger.info("actor %s was killed before ready; exiting",
+                            spec.actor_id.hex()[:8])
+                self._should_exit.set()
             return {"status": "ok", "returns": []}
         except Exception as e:
             tb = traceback.format_exc()

@@ -190,6 +190,9 @@ class GcsServer:
         self.kv: Dict[Tuple[bytes, bytes], bytes] = {}
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
+        # kill() for ids the GCS hasn't seen yet (cross-process kill
+        # racing a pipelined registration) — see handle_kill_actor.
+        self._kill_tombstones: set = set()
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self.jobs: Dict[JobID, dict] = {}
@@ -546,6 +549,16 @@ class GcsServer:
     async def handle_register_actor(self, data, conn) -> dict:
         actor_id = ActorID(data["actor_id"])
         info = ActorInfo(actor_id, data)
+        if actor_id in self._kill_tombstones:
+            # kill() from ANOTHER process raced the driver's pipelined
+            # registration and reached the GCS first: honor it — the
+            # actor is born DEAD and never scheduled.
+            self._kill_tombstones.discard(actor_id)
+            info.state = DEAD
+            info.death_cause = "killed via kill() before registration"
+            self.actors[actor_id] = info
+            self._persist_actor(info)
+            return {"ok": True}
         if info.name:
             key = (info.namespace, info.name)
             if key in self.named_actors:
@@ -570,6 +583,10 @@ class GcsServer:
         permanent_nodes: set = set()
         permanent_error = ""
         for attempt in range(120):
+            if actor.state == DEAD:
+                # kill() won the race against placement: stop before
+                # leasing a worker / running the user's __init__.
+                return
             node = self._pick_node(spec.resources, spec.scheduling_strategy,
                                    spec.placement_group_id,
                                    spec.placement_group_bundle_index,
@@ -599,6 +616,10 @@ class GcsServer:
                 await asyncio.sleep(0.25)
                 continue
             if reply.get("ok"):
+                if actor.state == DEAD:
+                    # Killed while the lease was in flight: the worker
+                    # will be refused at actor_ready and exit.
+                    return
                 actor.node_id = node.node_id
                 self._persist_actor(actor)
                 return  # worker will report actor_ready
@@ -649,6 +670,11 @@ class GcsServer:
     async def handle_actor_ready(self, data, conn) -> bool:
         actor = self.actors.get(ActorID(data["actor_id"]))
         if actor is None:
+            return False
+        if actor.state == DEAD:
+            # kill() landed while the creation task was in flight (the
+            # pipelined-registration window widens this race): do NOT
+            # resurrect — tell the worker so it exits with its lease.
             return False
         actor.state = ALIVE
         actor.address = data["address"]
@@ -741,6 +767,14 @@ class GcsServer:
     async def handle_kill_actor(self, data, conn) -> bool:
         actor = self.actors.get(ActorID(data["actor_id"]))
         if actor is None:
+            # Unknown id: possibly a pipelined registration still in
+            # flight from another process's handle. Tombstone it so the
+            # registration (if it ever lands) is born DEAD instead of
+            # leaking a running actor. Bounded: stale tombstones (ids
+            # that never register) are pruned FIFO.
+            self._kill_tombstones.add(ActorID(data["actor_id"]))
+            while len(self._kill_tombstones) > 10_000:
+                self._kill_tombstones.pop()
             return False
         actor.max_restarts = 0 if data.get("no_restart", True) else actor.max_restarts
         if actor.state == ALIVE and actor.address:

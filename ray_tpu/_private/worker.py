@@ -272,8 +272,13 @@ class Worker:
 
     def create_actor(self, descriptor, args, kwargs, opts) -> ActorID:
         opts = self._prepare_env_opts(opts)
-        return self._run(
-            self.core.create_actor(descriptor, args, kwargs, opts))
+        if opts.get("name") or opts.get("lifetime") == "detached":
+            # Named/detached: registration stays synchronous so name
+            # conflicts raise at .remote() (reference semantics).
+            return self._run(
+                self.core.create_actor(descriptor, args, kwargs, opts))
+        # Anonymous: caller-thread fast path, registration pipelined.
+        return self.core.create_actor_sync(descriptor, args, kwargs, opts)
 
     def submit_actor_task(self, actor_id, method, args, kwargs, opts):
         return self.core.submit_actor_task_sync(

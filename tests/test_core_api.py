@@ -198,6 +198,64 @@ class TestActors:
         h = get_actor("shared_counter")
         assert ray_tpu.get(h.get.remote()) == 7
 
+    def test_anonymous_creation_is_pipelined(self, ray_start_regular):
+        """Anonymous actor registration is fire-and-forget: submitting a
+        burst returns in caller-thread time (no per-actor GCS round
+        trip), and every handle still resolves (reference: async actor
+        registration in the core worker's creation pipeline)."""
+        t0 = time.perf_counter()
+        actors = [Counter.remote(i) for i in range(8)]
+        submit_s = time.perf_counter() - t0
+        # Sync registration cost ~20ms/actor under load; the pipelined
+        # path is pure local work. Generous bound for CI noise.
+        assert submit_s < 0.5, f"submission took {submit_s:.3f}s"
+        assert ray_tpu.get([a.get.remote() for a in actors],
+                           timeout=60) == list(range(8))
+
+    def test_kill_during_creation(self, ray_start_regular):
+        """kill() racing the in-flight creation must win: the GCS never
+        resurrects a DEAD actor on actor_ready, and the dedicated worker
+        exits instead of lingering ALIVE (regression for the pipelined-
+        registration window)."""
+        c = Counter.remote(0)
+        ray_tpu.kill(c)
+        time.sleep(1.0)
+        with pytest.raises(ActorDiedError):
+            ray_tpu.get(c.inc.remote(), timeout=15)
+
+    def test_cross_process_kill_tombstone(self, ray_start_regular):
+        """A kill() that reaches the GCS before the (pipelined)
+        registration lands leaves a tombstone: the registration is born
+        DEAD and never scheduled (GCS-level check of the cross-process
+        race no single-process test can time)."""
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.core.ids import ActorID, JobID
+
+        w = global_worker()
+        actor_id = ActorID.of(JobID.nil())
+        assert w.gcs_call("kill_actor",
+                          {"actor_id": actor_id.binary()}) is False
+        r = w.gcs_call("register_actor", {
+            "actor_id": actor_id.binary(),
+            "job_id": JobID.nil().binary(),
+            "name": "", "namespace": "default",
+            "class_name": "Ghost", "max_restarts": 0,
+            "max_concurrency": 1, "detached": False,
+            "creation_task": {},
+        })
+        assert r["ok"]
+        info = w.gcs_call("wait_actor_alive",
+                          {"actor_id": actor_id.binary(), "timeout": 2.0})
+        assert info["state"] == "DEAD"
+        assert "before registration" in info.get("death_cause", "")
+
+    def test_named_conflict_raises_at_remote(self, ray_start_regular):
+        """Named actors keep SYNCHRONOUS registration: a duplicate name
+        raises at .remote() time, not at first call."""
+        Counter.options(name="conflict_counter").remote(0)
+        with pytest.raises(ValueError):
+            Counter.options(name="conflict_counter").remote(1)
+
     def test_actor_error(self, ray_start_regular):
         @ray_tpu.remote
         class Fragile:
