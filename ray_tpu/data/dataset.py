@@ -570,6 +570,19 @@ class DataIterator:
         return sum(b.num_rows for b in self._iter_local_blocks())
 
 
+def _welford_merge(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge two (n, mean, M2) moment sets (Chan et al.)."""
+    n = a["_n"] + b["_n"]
+    if n == 0:
+        return {"_n": 0, "_m": 0.0, "_m2": 0.0}
+    delta = b["_m"] - a["_m"]
+    return {
+        "_n": n,
+        "_m": a["_m"] + delta * b["_n"] / n,
+        "_m2": a["_m2"] + b["_m2"] + delta * delta * a["_n"] * b["_n"] / n,
+    }
+
+
 @ray_tpu.remote
 def _partial_agg(block: Block, key: str, init, update) -> Dict[Any, Any]:
     """Per-block partial aggregation (map side of a groupby)."""
@@ -645,6 +658,57 @@ class GroupedData:
                             "_n": acc["_n"] + len(g)},
             lambda a, b: {"_s": a["_s"] + b["_s"], "_n": a["_n"] + b["_n"]},
             finalize=lambda acc: {name: acc["_s"] / max(acc["_n"], 1)})
+
+    def std(self, col: str, ddof: int = 1) -> Dataset:
+        """Sample std per group via mergeable Welford (n, mean, M2)
+        moments — numerically stable for large-mean data (reference:
+        data/aggregate.py Std uses the same merge). n <= ddof yields
+        None (pandas/numpy return NaN there)."""
+        import math
+
+        name = f"std({col})"
+
+        def upd(acc, g):
+            # Chan et al. parallel update with the group's own moments.
+            n_b = int(len(g))
+            if n_b == 0:
+                return acc
+            mean_b = float(g[col].mean())
+            m2_b = float(((g[col] - mean_b) ** 2).sum())
+            return _welford_merge(acc, {"_n": n_b, "_m": mean_b,
+                                        "_m2": m2_b})
+
+        return self._agg(
+            col, lambda: {"_n": 0, "_m": 0.0, "_m2": 0.0},
+            upd, _welford_merge,
+            finalize=lambda acc: {name: math.sqrt(
+                acc["_m2"] / (acc["_n"] - ddof))
+                if acc["_n"] > ddof else None})
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        """Apply fn to each COMPLETE group (fn: pandas.DataFrame ->
+        DataFrame | dict of columns). Groups are made partition-complete
+        by a distributed hash exchange, then fn runs inside partition
+        tasks — whole groups never land in the driver (reference:
+        grouped_data.py map_groups over the exchange task graph)."""
+        key = self._key
+        ds = self._ds._with(ShuffleStage(
+            f"HashGroups({key})", "hash", key=key))
+
+        def apply(df):
+            import pandas as pd
+
+            outs = []
+            for _, g in df.groupby(key, sort=True, dropna=False):
+                r = fn(g)
+                if not isinstance(r, pd.DataFrame):
+                    r = pd.DataFrame(r)
+                outs.append(r)
+            return pd.concat(outs, ignore_index=True) if outs \
+                else df.iloc[0:0]
+
+        return ds.map_batches(apply, batch_format="pandas",
+                              batch_size=None)
 
 
 def _name(fn) -> str:

@@ -244,6 +244,16 @@ def _shuffle_map(block: Block, kind: str, num_reducers: int,
         assign = rng.randint(0, num_reducers, size=n)
         parts = [block.take(np.nonzero(assign == r)[0])
                  for r in range(num_reducers)]
+    elif kind == "hash":
+        # Group-complete partitioning: every row of a key lands on the
+        # same reducer (map_groups). pandas' hash is process-stable.
+        import pandas as pd
+
+        col = block.column(key).to_pandas()
+        assign = (pd.util.hash_pandas_object(col, index=False)
+                  .to_numpy() % num_reducers).astype(np.int64)
+        parts = [block.take(np.nonzero(assign == r)[0])
+                 for r in range(num_reducers)]
     else:  # repartition: order-preserving global-contiguous split
         global_start, reducer_edges = boundaries
         gs, ge = global_start, global_start + n

@@ -88,6 +88,36 @@ def test_groupby():
     assert means[0] == pytest.approx(np.mean([0, 3, 6, 9]))
 
 
+def test_groupby_std_and_map_groups():
+    ds = rd.from_items([{"k": i % 3, "v": float(i)} for i in range(12)],
+                       parallelism=4)
+    stds = {r["k"]: r["std(v)"] for r in ds.groupby("k").std("v").take_all()}
+    for k in (0, 1, 2):
+        expect = np.std([i for i in range(12) if i % 3 == k], ddof=1)
+        assert stds[k] == pytest.approx(expect)
+
+    # Welford stability: large-mean values must not cancel.
+    big = rd.from_items([{"k": 0, "v": 1e8}, {"k": 0, "v": 1e8 + 1}],
+                        parallelism=2)
+    out = big.groupby("k").std("v").take_all()
+    assert out[0]["std(v)"] == pytest.approx(np.std([1e8, 1e8 + 1],
+                                                    ddof=1))
+    # Singleton group with ddof=1: undefined → None, not 0.
+    single = rd.from_items([{"k": 9, "v": 5.0}])
+    assert single.groupby("k").std("v").take_all()[0]["std(v)"] is None
+
+    # map_groups: every group arrives COMPLETE at the UDF (4 rows per
+    # key here even though rows are spread over 4 input blocks).
+    def summarize(g):
+        return {"k": [int(g["k"].iloc[0])], "n": [len(g)],
+                "vsum": [float(g["v"].sum())]}
+
+    rows = ds.groupby("k").map_groups(summarize).take_all()
+    got = {r["k"]: (r["n"], r["vsum"]) for r in rows}
+    assert got == {0: (4, 0.0 + 3 + 6 + 9), 1: (4, 1.0 + 4 + 7 + 10),
+                   2: (4, 2.0 + 5 + 8 + 11)}
+
+
 def test_limit_union_zip():
     assert rd.range(100).limit(7).count() == 7
     u = rd.range(10).union(rd.range(5))
