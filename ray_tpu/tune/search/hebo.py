@@ -1,0 +1,102 @@
+"""HEBO searcher adapter (gated).
+
+Reference: python/ray/tune/search/hebo/hebo_search.py — an ask/tell
+adapter over Huawei Noah's Ark HEBO (Heteroscedastic Evolutionary
+Bayesian Optimization). The tune search space converts to a HEBO
+DesignSpace; `suggest` asks for a candidate DataFrame row,
+`on_trial_complete` observes the loss back. hebo is an optional
+dependency: importing this module always works; constructing
+`HEBOSearch` without it raises with install guidance.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+def _to_hebo_space(space: Dict[str, Any]) -> list:
+    specs = []
+    for name, dom in sorted(space.items()):
+        if isinstance(dom, Categorical):
+            specs.append({"name": name, "type": "cat",
+                          "categories": list(dom.categories)})
+        elif isinstance(dom, Float):
+            specs.append({"name": name,
+                          "type": "pow" if dom.log else "num",
+                          "lb": dom.lower, "ub": dom.upper})
+        elif isinstance(dom, Integer):
+            specs.append({"name": name, "type": "int",
+                          "lb": dom.lower, "ub": dom.upper - 1})
+        else:
+            raise ValueError(
+                f"HEBOSearch cannot express domain {dom!r} for {name!r}")
+    return specs
+
+
+class HEBOSearch(Searcher):
+    def __init__(self,
+                 space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None,
+                 mode: str = "max",
+                 random_state_seed: Optional[int] = None):
+        try:
+            import hebo  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "HEBOSearch requires the 'hebo' package "
+                "(pip install HEBO); for a dependency-free Bayesian "
+                "searcher use "
+                "ray_tpu.tune.search.bayesopt.BayesOptSearch") from e
+        super().__init__(metric, mode)
+        self._metric = metric
+        self._mode = mode
+        self._space = dict(space or {})
+        self._fixed: Dict[str, Any] = {}
+        self._seed = random_state_seed
+        self._opt = None
+        self._live: Dict[str, Any] = {}  # trial_id -> candidate row
+
+    def set_search_properties(self, metric, mode, config=None) -> None:
+        self._metric = metric or self._metric
+        self._mode = mode or self._mode
+        if config and not self._space:
+            self._space = {k: v for k, v in config.items()
+                           if isinstance(v, Domain)}
+            self._fixed = {k: v for k, v in config.items()
+                           if not isinstance(v, Domain)}
+
+    def _ensure_optimizer(self) -> None:
+        if self._opt is not None:
+            return
+        from hebo.design_space.design_space import DesignSpace
+        from hebo.optimizers.hebo import HEBO
+
+        ds = DesignSpace().parse(_to_hebo_space(self._space))
+        kwargs = {}
+        if self._seed is not None:
+            kwargs["scramble_seed"] = self._seed
+        self._opt = HEBO(ds, **kwargs)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        self._ensure_optimizer()
+        candidate = self._opt.suggest(n_suggestions=1)
+        self._live[trial_id] = candidate
+        row = candidate.iloc[0].to_dict()
+        return {**self._fixed, **row}
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        import numpy as np
+
+        candidate = self._live.pop(trial_id, None)
+        if candidate is None or self._opt is None:
+            return
+        if error or not result or self._metric not in result:
+            return
+        value = float(result[self._metric])
+        loss = -value if self._mode == "max" else value
+        self._opt.observe(candidate, np.array([[loss]]))

@@ -481,13 +481,15 @@ def test_hyperopt_nevergrad_zoopt_gated():
     """The HyperOpt/Nevergrad/ZOOpt adapters exist, import cleanly, and
     gate with actionable ImportErrors when their libs are absent (or
     actually suggest when present)."""
+    from ray_tpu.tune.search.hebo import HEBOSearch
     from ray_tpu.tune.search.hyperopt import HyperOptSearch
     from ray_tpu.tune.search.nevergrad import NevergradSearch
     from ray_tpu.tune.search.zoopt import ZOOptSearch
 
     for cls, lib in ((HyperOptSearch, "hyperopt"),
                      (NevergradSearch, "nevergrad"),
-                     (ZOOptSearch, "zoopt")):
+                     (ZOOptSearch, "zoopt"),
+                     (HEBOSearch, "hebo")):
         try:
             __import__(lib)
         except ImportError:
