@@ -1867,6 +1867,11 @@ class CoreWorker:
             # before the GCS knows the actor would silently no-op and
             # leak the actor when registration lands moments later.
             await st.register_done.wait()
+            if st.register_error is not None:
+                # Registration never happened: nothing to kill, and a
+                # GCS call would only park a garbage tombstone. Surface
+                # the real failure instead of a silent no-op.
+                raise st.register_error
         await self.gcs.call("kill_actor", {
             "actor_id": actor_id.binary(), "no_restart": no_restart})
 

@@ -192,7 +192,8 @@ class GcsServer:
         self.actors: Dict[ActorID, ActorInfo] = {}
         # kill() for ids the GCS hasn't seen yet (cross-process kill
         # racing a pipelined registration) — see handle_kill_actor.
-        self._kill_tombstones: set = set()
+        # Insertion-ordered dict: pruning evicts oldest-first.
+        self._kill_tombstones: Dict[ActorID, bool] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self.jobs: Dict[JobID, dict] = {}
@@ -553,7 +554,7 @@ class GcsServer:
             # kill() from ANOTHER process raced the driver's pipelined
             # registration and reached the GCS first: honor it — the
             # actor is born DEAD and never scheduled.
-            self._kill_tombstones.discard(actor_id)
+            self._kill_tombstones.pop(actor_id, None)
             info.state = DEAD
             info.death_cause = "killed via kill() before registration"
             self.actors[actor_id] = info
@@ -751,14 +752,21 @@ class GcsServer:
         return actor.view() if actor else None
 
     async def handle_wait_actor_alive(self, data, conn):
-        """Block until the actor is ALIVE or DEAD (bounded by client timeout)."""
+        """Block until the actor is ALIVE or DEAD (bounded by client
+        timeout). Unknown ids get a short existence grace: with
+        pipelined registration, a handle can cross processes and reach
+        here BEFORE the creator's fire-and-forget register_actor lands —
+        only after the grace does "unknown" mean "does not exist"."""
         actor_id = ActorID(data["actor_id"])
-        deadline = time.monotonic() + data.get("timeout", 60.0)
+        now = time.monotonic()
+        deadline = now + data.get("timeout", 60.0)
+        exist_grace = min(now + 2.0, deadline)
         while time.monotonic() < deadline:
             actor = self.actors.get(actor_id)
             if actor is None:
-                return None
-            if actor.state in (ALIVE, DEAD):
+                if time.monotonic() >= exist_grace:
+                    return None
+            elif actor.state in (ALIVE, DEAD):
                 return actor.view()
             await asyncio.sleep(0.02)
         actor = self.actors.get(actor_id)
@@ -771,10 +779,12 @@ class GcsServer:
             # flight from another process's handle. Tombstone it so the
             # registration (if it ever lands) is born DEAD instead of
             # leaking a running actor. Bounded: stale tombstones (ids
-            # that never register) are pruned FIFO.
-            self._kill_tombstones.add(ActorID(data["actor_id"]))
+            # that never register) are pruned oldest-first (dict
+            # preserves insertion order).
+            self._kill_tombstones[ActorID(data["actor_id"])] = True
             while len(self._kill_tombstones) > 10_000:
-                self._kill_tombstones.pop()
+                del self._kill_tombstones[
+                    next(iter(self._kill_tombstones))]
             return False
         actor.max_restarts = 0 if data.get("no_restart", True) else actor.max_restarts
         if actor.state == ALIVE and actor.address:

@@ -212,6 +212,22 @@ class TestActors:
         assert ray_tpu.get([a.get.remote() for a in actors],
                            timeout=60) == list(range(8))
 
+    def test_handle_passed_before_registration_lands(self,
+                                                     ray_start_regular):
+        """An anonymous handle shipped into a task IMMEDIATELY after
+        .remote() must resolve on the receiving worker even though the
+        pipelined registration may not have reached the GCS yet (the
+        GCS grants unknown ids a short existence grace in
+        wait_actor_alive)."""
+        @ray_tpu.remote
+        def poke_now(h):
+            return ray_tpu.get(h.inc.remote())
+
+        for _ in range(5):
+            c = Counter.remote(0)
+            # No barrier between creation and handle shipping.
+            assert ray_tpu.get(poke_now.remote(c), timeout=60) == 1
+
     def test_kill_during_creation(self, ray_start_regular):
         """kill() racing the in-flight creation must win: the GCS never
         resurrects a DEAD actor on actor_ready, and the dedicated worker
