@@ -205,7 +205,18 @@ update_retries: 2
                          "~/.ray_tpu/cluster-launcher_partial.json")):
         if os.path.exists(leftover):
             os.remove(leftover)
-    time.sleep(0.5)
+    # Wait for any pkill'd head to actually EXIT (under full-suite load
+    # SIGTERM handling can take seconds; a lingering process makes `up`
+    # conclude a foreign head is running and raise).
+    for _ in range(40):
+        probe = subprocess.run(
+            ["pgrep", "-f", "ray_tpu[.]scripts start --head"],
+            capture_output=True)
+        if probe.returncode != 0:
+            break
+        time.sleep(0.5)
+    if os.path.exists("/tmp/ray_tpu/cluster_address"):
+        os.remove("/tmp/ray_tpu/cluster_address")
     env_backup = os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     try:
         state = create_or_update_cluster(str(cfg))

@@ -44,10 +44,14 @@ class TestMultiNode:
         # 3 long tasks, 1 CPU each, on 3 one-CPU nodes ⇒ must spread.
         # Resource changes push event-driven heartbeats + broadcasts
         # (RaySyncer-style), and the converged-view wait removes the
-        # startup race — no retries needed.
+        # startup race — no retries needed. The hold must comfortably
+        # exceed worst-case scheduling latency under full-suite ambient
+        # load (stress tier runs nearby): with a 2.0s hold, task 1
+        # could FINISH before task 3's lease was even considered,
+        # legitimately re-packing instead of spreading.
         cluster.wait_for_view_converged()
-        refs = [hold.remote(2.0) for _ in range(3)]
-        nodes = set(ray_tpu.get(refs, timeout=90))
+        refs = [hold.remote(6.0) for _ in range(3)]
+        nodes = set(ray_tpu.get(refs, timeout=120))
         assert len(nodes) == 3
 
     def test_custom_resource_routing(self, ray_start_cluster):
