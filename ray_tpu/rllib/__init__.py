@@ -3,7 +3,7 @@
 Parity target: the reference's rllib/ new API stack (AlgorithmConfig /
 Algorithm / EnvRunnerGroup / RLModule / Learner / LearnerGroup) with
 JAX/TPU learners and CPU env-runner actors. Algorithms: PPO (single and
-multi-agent), APPO, DQN, SAC, CQL, IMPALA, BC, MARWIL, DDPG, TD3,
+multi-agent), APPO, DQN, SAC, CQL, IMPALA, BC, MARWIL, DDPG, TD3, A2C,
 DreamerV3 (model-based), ES, ARS (evolution).
 """
 
@@ -20,6 +20,7 @@ from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ddpg import (DDPG, DDPGConfig, TD3,
                                            TD3Config)
 from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
+from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.algorithms.multi_agent_ppo import (MultiAgentPPO,
                                                       MultiAgentPPOConfig)
@@ -45,6 +46,8 @@ __all__ = [
     "CQLConfig",
     "MARWIL",
     "MARWILConfig",
+    "A2C",
+    "A2CConfig",
     "DDPG",
     "DDPGConfig",
     "TD3",

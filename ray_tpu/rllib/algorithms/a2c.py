@@ -1,0 +1,93 @@
+"""A2C — synchronous advantage actor-critic.
+
+Reference: rllib_contrib a2c (A2C = synchronous A3C: parallel env
+runners sample a short on-policy fragment, one combined gradient step
+on the n-step-advantage policy loss + value loss + entropy bonus; no
+surrogate clipping, no minibatch epochs — the simple on-policy
+baseline PPO refines).
+
+Reuses the PPO plumbing (GAE from the same rollout machinery) with a
+single-epoch, whole-batch vanilla policy-gradient update in one jitted
+step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner
+from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+from ray_tpu.rllib.utils import sample_batch as sb
+from ray_tpu.rllib.utils.postprocessing import compute_gae, standardize
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lambda_: float = 1.0          # pure n-step returns
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        self.train_batch_size = 512
+        self.lr = 1e-3
+
+    @property
+    def algo_class(self):
+        return A2C
+
+
+class A2CLearner(JaxLearner):
+    def loss_fn(self, params, batch, rng):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        out = self.module.forward_train(params, batch[sb.OBS])
+        logits = out["action_dist_inputs"]
+        values = out["vf_preds"]
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch[sb.ACTIONS].astype(jnp.int32)
+        logp = jnp.take_along_axis(
+            logp_all, actions[:, None], axis=-1)[:, 0]
+
+        adv = batch[sb.ADVANTAGES]
+        pg_loss = -(logp * adv).mean()
+        vf_loss = ((values - batch[sb.VALUE_TARGETS]) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+
+        total = pg_loss + cfg.get("vf_loss_coeff", 0.5) * vf_loss \
+            - cfg.get("entropy_coeff", 0.01) * entropy
+        return total, {
+            "policy_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+
+class A2C(Algorithm):
+    config_class = A2CConfig
+    learner_class = A2CLearner
+    module_class = DiscreteMLPModule
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        pieces = self.env_runner_group.sample_with_bootstraps(
+            cfg.train_batch_size)
+        batches = []
+        for batch, boot in pieces:
+            batch = self.apply_learner_connector(batch)
+            batch = compute_gae(batch, gamma=cfg.gamma,
+                                lambda_=cfg.lambda_, bootstrap_value=boot)
+            batches.append(batch)
+        train_batch = SampleBatch.concat_samples(batches)
+        train_batch[sb.ADVANTAGES] = standardize(
+            train_batch[sb.ADVANTAGES])
+        # ONE whole-batch step per iteration (the A2C/PPO difference).
+        metrics = self.learner_group.update(train_batch)
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights())
+        return dict(metrics)

@@ -327,6 +327,29 @@ def test_bc_clones_expert_policy():
     algo.cleanup()
 
 
+def test_a2c_learns_cartpole():
+    """A2C (the simple on-policy baseline PPO refines): CartPole return
+    climbs well above the random baseline (~20) within a short budget
+    (probe: ~120 by iteration 40)."""
+    from ray_tpu.rllib.algorithms.a2c import A2CConfig
+
+    config = (A2CConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0, num_envs_per_runner=4)
+              .training(train_batch_size=512, lr=1e-3,
+                        entropy_coeff=0.01)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    best = -1.0
+    for _ in range(40):
+        r = algo.step()
+        ret = r.get("episode_return_mean")
+        if ret is not None and np.isfinite(ret):
+            best = max(best, ret)
+    assert best > 60.0, best
+    algo.cleanup()
+
+
 def test_sac_solves_pendulum():
     """SAC (continuous control): swing-up from ~-1300 (random) to a
     near-optimal greedy policy. VERDICT round-1 item 6."""
