@@ -1,0 +1,35 @@
+"""Rotating checkpoint-snapshot dirs for report callbacks.
+
+`train.report(checkpoint=...)` is queued and persisted asynchronously by
+the driver's poll loop, so a callback must not delete a snapshot dir
+inline after reporting — instead it keeps a bounded FIFO of snapshot
+dirs and prunes the oldest once the bound is exceeded. The bound must
+EXCEED the session's undrained-report queue depth (_TrainSession
+Semaphore(8)): a still-queued checkpoint's dir must never be pruned
+before the driver copies it. Shared by the TF/Lightning/HF report
+callbacks.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+from typing import List
+
+
+class RotatingSnapshots:
+    def __init__(self, max_snapshots: int = 9):
+        self._dirs: List[str] = []
+        self._max = max_snapshots
+
+    def make(self, prefix: str) -> str:
+        """Create and track a fresh snapshot dir."""
+        return self.track(tempfile.mkdtemp(prefix=prefix))
+
+    def track(self, path: str) -> str:
+        """Track an externally created dir; prune oldest beyond the
+        bound."""
+        self._dirs.append(path)
+        while len(self._dirs) > self._max:
+            shutil.rmtree(self._dirs.pop(0), ignore_errors=True)
+        return path

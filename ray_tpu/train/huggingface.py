@@ -45,17 +45,14 @@ def RayTrainReportCallback():
 
     from ray_tpu import train
 
+    from ray_tpu.train._internal.snapshots import RotatingSnapshots
+
     class _Callback(transformers.TrainerCallback):
         def __init__(self):
             self._pending_ckpt_dir: Optional[str] = None
-            # Snapshot dirs, oldest first. A snapshot may only be
-            # deleted once the driver has persisted its report — the
-            # session queues up to 8 undrained reports
-            # (_TrainSession Semaphore(8)), so retention must exceed
-            # that depth or a still-queued checkpoint's dir could be
-            # pruned before the driver copies it.
-            self._snapshots: list = []
-            self._max_snapshots = 9
+            # Bounded snapshot retention (see RotatingSnapshots: the
+            # bound exceeds the session's undrained-report depth).
+            self._snapshots = RotatingSnapshots()
 
         def on_save(self, args, state, control, **kwargs):
             # Snapshot the HF checkpoint into a private dir NOW:
@@ -63,19 +60,14 @@ def RayTrainReportCallback():
             # the (queued) report is persisted by the driver, and a
             # by-reference path would then fail the whole run.
             import shutil
-            import tempfile
 
             src = os.path.join(args.output_dir,
                                f"checkpoint-{state.global_step}")
             if os.path.isdir(src):
-                dst = tempfile.mkdtemp(prefix="ray_tpu_hf_ckpt_")
+                dst = self._snapshots.make("ray_tpu_hf_ckpt_")
                 snap = os.path.join(dst, os.path.basename(src))
                 shutil.copytree(src, snap)
                 self._pending_ckpt_dir = snap
-                self._snapshots.append(dst)
-                while len(self._snapshots) > self._max_snapshots:
-                    shutil.rmtree(self._snapshots.pop(0),
-                                  ignore_errors=True)
             return control
 
         def on_log(self, args, state, control, logs=None, **kwargs):

@@ -1,0 +1,135 @@
+"""PyTorch Lightning integration (gated).
+
+Reference: python/ray/train/lightning/ — `prepare_trainer`,
+`RayDDPStrategy`, `RayLightningEnvironment`, `RayTrainReportCallback`:
+Lightning's trainer runs inside a TorchTrainer worker, discovers the
+Ray-provided process group/world instead of launching its own, and
+streams epoch metrics + checkpoints through `train.report`.
+
+Lightning is an optional dependency (not in this image): importing this
+module always works; each factory raises an informative ImportError
+without it. With it, the returned objects plug into
+`lightning.Trainer(strategy=RayDDPStrategy(), plugins=[
+RayLightningEnvironment()], callbacks=[RayTrainReportCallback()])`
+inside a `TorchTrainer` train loop.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "prepare_trainer",
+    "RayDDPStrategy",
+    "RayLightningEnvironment",
+    "RayTrainReportCallback",
+]
+
+_INSTALL_MSG = (
+    "requires the 'lightning' (or 'pytorch_lightning') package, which is "
+    "not installed in this environment; TorchTrainer runs plain torch "
+    "loops without it, and the TPU path is JaxTrainer")
+
+
+def _import_lightning():
+    try:
+        import lightning.pytorch as pl
+        return pl
+    except ImportError:
+        pass
+    try:
+        import pytorch_lightning as pl
+        return pl
+    except ImportError as e:
+        raise ImportError(f"ray_tpu.train.lightning {_INSTALL_MSG}") from e
+
+
+def RayDDPStrategy(**kwargs):
+    """DDP strategy that joins the process group the TorchTrainer
+    backend already created instead of spawning its own launcher
+    (reference: train/lightning/_lightning_utils.py RayDDPStrategy)."""
+    _import_lightning()
+    try:
+        from lightning.pytorch.strategies import DDPStrategy
+    except ImportError:
+        from pytorch_lightning.strategies import DDPStrategy
+
+    class _RayDDPStrategy(DDPStrategy):
+        def setup_environment(self):
+            # torch.distributed is already initialized by _TorchBackend;
+            # Lightning must not re-init or tear it down.
+            import torch.distributed as dist
+
+            assert dist.is_initialized(), \
+                "RayDDPStrategy requires a live TorchTrainer process group"
+            super().setup_environment()
+
+    kwargs.setdefault("process_group_backend", "gloo")
+    return _RayDDPStrategy(**kwargs)
+
+
+def RayLightningEnvironment():
+    """ClusterEnvironment describing the TorchTrainer worker gang
+    (reference: RayLightningEnvironment)."""
+    pl = _import_lightning()  # noqa: F841  (gate)
+    try:
+        from lightning.pytorch.plugins.environments import (
+            LightningEnvironment)
+    except ImportError:
+        from pytorch_lightning.plugins.environments import (
+            LightningEnvironment)
+
+    from ray_tpu import train
+
+    class _RayEnv(LightningEnvironment):
+        def world_size(self) -> int:
+            return train.get_context().get_world_size()
+
+        def global_rank(self) -> int:
+            return train.get_context().get_world_rank()
+
+        def local_rank(self) -> int:
+            return train.get_context().get_local_rank()
+
+        @property
+        def creates_processes_externally(self) -> bool:
+            return True  # the WorkerGroup did
+
+    return _RayEnv()
+
+
+def RayTrainReportCallback(checkpoint_every_n_epochs: int = 1):
+    """Stream Lightning's logged metrics + a checkpoint through
+    train.report at epoch end (reference: RayTrainReportCallback)."""
+    pl = _import_lightning()
+
+    from ray_tpu import train
+
+    from ray_tpu.train._internal.snapshots import RotatingSnapshots
+
+    class _Callback(pl.Callback):
+        def __init__(self):
+            super().__init__()
+            self._snapshots = RotatingSnapshots()
+
+        def on_train_epoch_end(self, trainer, pl_module):
+            metrics = {k: float(v) for k, v in
+                       trainer.callback_metrics.items()}
+            metrics["epoch"] = trainer.current_epoch
+            ckpt = None
+            if train.get_context().get_world_rank() == 0 and \
+                    trainer.current_epoch % checkpoint_every_n_epochs == 0:
+                d = self._snapshots.make("lightning_ckpt_")
+                trainer.save_checkpoint(
+                    os.path.join(d, "checkpoint.ckpt"))
+                ckpt = train.Checkpoint.from_directory(d)
+            train.report(metrics, checkpoint=ckpt)
+
+    return _Callback()
+
+
+def prepare_trainer(trainer):
+    """Validate a lightning.Trainer for running under TorchTrainer
+    (reference: train/lightning/prepare_trainer)."""
+    _import_lightning()
+    return trainer

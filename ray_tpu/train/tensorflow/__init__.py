@@ -100,12 +100,10 @@ def ReportCheckpointCallback(checkpoint_on: Optional[str] = "epoch_end",
     checkpoint_on: "epoch_end" (every epoch), "train_end" (once, at the
     end), or None (metrics only).
     """
-    import shutil
-    import tempfile
-
     import tensorflow as tf
 
     from ray_tpu import train
+    from ray_tpu.train._internal.snapshots import RotatingSnapshots
 
     if checkpoint_on not in ("epoch_end", "train_end", None):
         raise ValueError(
@@ -114,26 +112,19 @@ def ReportCheckpointCallback(checkpoint_on: Optional[str] = "epoch_end",
 
     class _Callback(tf.keras.callbacks.Callback):
         # Reports are queued and persisted asynchronously by the driver
-        # poll, so snapshot dirs rotate with a bound above the queue
-        # depth instead of being deleted inline (same pattern as the HF
-        # callback in ray_tpu/train/huggingface.py).
-        _max_snapshots = 4
-
+        # poll, so snapshot dirs rotate (RotatingSnapshots) instead of
+        # being deleted inline.
         def __init__(self):
             super().__init__()
-            self._snapshots: List[str] = []
+            self._snapshots = RotatingSnapshots()
 
         def _save_checkpoint(self):
             if train.get_context().get_world_rank() != 0:
                 return None
-            d = tempfile.mkdtemp(prefix="keras_ckpt_")
+            d = self._snapshots.make("keras_ckpt_")
             # Keras 3 requires the .weights.h5 suffix.
             self.model.save_weights(
                 os.path.join(d, "model.weights.h5"))
-            self._snapshots.append(d)
-            while len(self._snapshots) > self._max_snapshots:
-                shutil.rmtree(self._snapshots.pop(0),
-                              ignore_errors=True)
             return train.Checkpoint.from_directory(d)
 
         def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None):

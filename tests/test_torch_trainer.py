@@ -95,3 +95,30 @@ def test_accelerate_inside_torch_trainer(ray_start_regular):
     ).fit()
     assert result.error is None
     assert result.metrics["in_sync"] is True
+
+
+def test_lightning_integration_gated():
+    """The Lightning helpers import cleanly and gate with actionable
+    ImportErrors when lightning is absent (reference:
+    train/lightning/_lightning_utils.py factories)."""
+    from ray_tpu.train import lightning as L
+
+    # Probe mirrors the module's own gate (_import_lightning): the
+    # 'lightning' distribution counts only if lightning.pytorch exists.
+    try:
+        import lightning.pytorch  # noqa: F401
+        has = True
+    except ImportError:
+        try:
+            import pytorch_lightning  # noqa: F401
+            has = True
+        except ImportError:
+            has = False
+    if has:
+        assert L.prepare_trainer(object()) is not None
+        return
+    for factory in (L.RayDDPStrategy, L.RayLightningEnvironment,
+                    L.RayTrainReportCallback, L.prepare_trainer):
+        with pytest.raises(ImportError, match="lightning"):
+            factory() if factory is not L.prepare_trainer \
+                else factory(None)
