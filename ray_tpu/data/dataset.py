@@ -446,6 +446,68 @@ class Dataset:
     def count(self) -> int:
         return sum(b.num_rows for b in self.iter_blocks())
 
+    # Global aggregations (reference: Dataset.sum/min/max/mean/std/
+    # unique over AggregateFns): per-block moments computed as remote
+    # tasks, only tiny accumulators reach the driver.
+    def _column_stats(self, col: str) -> Dict[str, Any]:
+        # One fan-out computes every stat; memoized so min+max+mean+std
+        # on the same dataset pay the remote pass once.
+        cache = getattr(self, "_stats_cache", None)
+        if cache is None:
+            cache = self._stats_cache = {}
+        if col in cache:
+            return cache[col]
+        parts = ray_tpu.get([_block_stats.remote(ref, col)
+                             for ref in self.iter_block_refs()])
+        acc = {"_n": 0, "_m": 0.0, "_m2": 0.0, "sum": None,
+               "min": None, "max": None}
+        for p in parts:
+            if p["_n"] == 0:
+                continue
+            acc.update(_welford_merge(acc, p))
+            if p["sum"] is not None:
+                acc["sum"] = p["sum"] if acc["sum"] is None \
+                    else acc["sum"] + p["sum"]
+            acc["min"] = p["min"] if acc["min"] is None \
+                else min(acc["min"], p["min"])
+            acc["max"] = p["max"] if acc["max"] is None \
+                else max(acc["max"], p["max"])
+        cache[col] = acc
+        return acc
+
+    def sum(self, col: str):
+        return self._column_stats(col)["sum"]
+
+    def min(self, col: str):
+        return self._column_stats(col)["min"]
+
+    def max(self, col: str):
+        return self._column_stats(col)["max"]
+
+    def mean(self, col: str):
+        acc = self._column_stats(col)
+        # sum None ⇔ non-numeric (or empty): moments are meaningless.
+        return acc["_m"] if acc["_n"] and acc["sum"] is not None else None
+
+    def std(self, col: str, ddof: int = 1):
+        import math
+
+        acc = self._column_stats(col)
+        if acc["_n"] <= ddof or acc["sum"] is None:
+            return None
+        return math.sqrt(acc["_m2"] / (acc["_n"] - ddof))
+
+    def unique(self, col: str) -> List[Any]:
+        """Distinct values of a column (reference: Dataset.unique) —
+        per-block uniques as remote tasks, set-merged in the driver."""
+        parts = ray_tpu.get([_block_unique.remote(ref, col)
+                             for ref in self.iter_block_refs()])
+        seen: Dict[Any, None] = {}
+        for vals in parts:
+            for v in vals:
+                seen.setdefault(v, None)
+        return list(seen)
+
     def schema(self):
         for b in self.iter_blocks():
             return b.schema
@@ -581,6 +643,43 @@ def _welford_merge(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
         "_m": a["_m"] + delta * b["_n"] / n,
         "_m2": a["_m2"] + b["_m2"] + delta * delta * a["_n"] * b["_n"] / n,
     }
+
+
+@ray_tpu.remote
+def _block_stats(block: Block, col: str) -> Dict[str, Any]:
+    """Per-block column moments for the global aggregations. Null rows
+    are excluded from every statistic (pandas skipna semantics); _n is
+    the NON-NULL count so the Welford merge stays consistent. Moments
+    and sum are computed only for numeric dtypes (min/max are defined
+    for any orderable column, e.g. strings); int sums keep their exact
+    Python-int value (no float coercion)."""
+    import pandas as pd
+
+    def _py(v):
+        return v.item() if hasattr(v, "item") else v
+
+    s = block_to_pandas(block)[col].dropna()
+    n = int(len(s))
+    out: Dict[str, Any] = {"_n": n, "_m": 0.0, "_m2": 0.0,
+                           "sum": None, "min": None, "max": None}
+    if n == 0:
+        return out
+    out["min"] = _py(s.min())
+    out["max"] = _py(s.max())
+    if pd.api.types.is_numeric_dtype(s):
+        mean = float(s.mean())
+        out["_m"] = mean
+        out["_m2"] = float(((s - mean) ** 2).sum())
+        out["sum"] = _py(s.sum())
+    return out
+
+
+@ray_tpu.remote
+def _block_unique(block: Block, col: str) -> List[Any]:
+    import pandas as pd
+
+    vals = pd.unique(block_to_pandas(block)[col])
+    return [v.item() if hasattr(v, "item") else v for v in vals]
 
 
 @ray_tpu.remote

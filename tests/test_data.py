@@ -118,6 +118,37 @@ def test_groupby_std_and_map_groups():
                    2: (4, 2.0 + 5 + 8 + 11)}
 
 
+def test_global_aggregations_and_unique():
+    vals = [float(i) for i in range(40)]
+    ds = rd.from_items([{"v": v, "k": int(v) % 4} for v in vals],
+                       parallelism=5)
+    assert ds.sum("v") == pytest.approx(sum(vals))
+    assert ds.min("v") == 0.0 and ds.max("v") == 39.0
+    assert ds.mean("v") == pytest.approx(np.mean(vals))
+    assert ds.std("v") == pytest.approx(np.std(vals, ddof=1))
+    assert sorted(ds.unique("k")) == [0, 1, 2, 3]
+    # Welford stability at large means, matching the groupby path.
+    big = rd.from_items([{"v": 1e8}, {"v": 1e8 + 1}], parallelism=2)
+    assert big.std("v") == pytest.approx(np.std([1e8, 1e8 + 1], ddof=1))
+    assert rd.from_items([{"v": 1.0}]).std("v") is None
+
+    # Nulls are skipped (pandas skipna semantics across blocks).
+    nn = rd.from_items([{"v": 1.0}, {"v": None}, {"v": 3.0}],
+                       parallelism=2)
+    assert nn.mean("v") == pytest.approx(2.0)
+    assert nn.sum("v") == pytest.approx(4.0)
+    # Strings: min/max ordered, mean/std/sum undefined → None.
+    names = rd.from_items([{"s": x} for x in ["pear", "apple", "zig"]],
+                          parallelism=2)
+    assert names.min("s") == "apple" and names.max("s") == "zig"
+    assert names.mean("s") is None and names.std("s") is None
+    assert names.sum("s") is None
+    # Exact int sums (no float coercion) near 2**60.
+    big_ints = rd.from_items([{"i": 2 ** 60 + 1}, {"i": 2 ** 60 + 3}],
+                             parallelism=2)
+    assert big_ints.sum("i") == 2 ** 61 + 4
+
+
 def test_limit_union_zip():
     assert rd.range(100).limit(7).count() == 7
     u = rd.range(10).union(rd.range(5))
