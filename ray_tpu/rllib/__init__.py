@@ -3,8 +3,9 @@
 Parity target: the reference's rllib/ new API stack (AlgorithmConfig /
 Algorithm / EnvRunnerGroup / RLModule / Learner / LearnerGroup) with
 JAX/TPU learners and CPU env-runner actors. Algorithms: PPO (single and
-multi-agent), APPO, DQN, SAC, CQL, IMPALA, BC, MARWIL, DDPG, TD3, A2C,
-DreamerV3 (model-based), ES, ARS (evolution).
+multi-agent), APPO, DQN, SAC, CQL, IMPALA, BC, MARWIL, DDPG, TD3, A2C, QMIX
+(cooperative multi-agent value decomposition), DreamerV3 (model-based),
+ES, ARS (evolution).
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
@@ -22,6 +23,7 @@ from ray_tpu.rllib.algorithms.ddpg import (DDPG, DDPGConfig, TD3,
 from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
+from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig
 from ray_tpu.rllib.algorithms.multi_agent_ppo import (MultiAgentPPO,
                                                       MultiAgentPPOConfig)
 from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
@@ -58,6 +60,8 @@ __all__ = [
     "ESConfig",
     "ARS",
     "ARSConfig",
+    "QMIX",
+    "QMIXConfig",
     "MultiAgentPPO",
     "MultiAgentPPOConfig",
     "MultiAgentEnv",

@@ -1,0 +1,361 @@
+"""QMIX — cooperative multi-agent Q-learning with monotonic mixing.
+
+Reference: rllib_contrib qmix (Rashid et al. 2018: per-agent utility
+networks Q_i(o_i, a_i) combined by a MIXING network whose weights are
+produced by hypernetworks conditioned on the GLOBAL state, constrained
+non-negative so argmax_a Q_tot decomposes into per-agent argmaxes —
+centralized training, decentralized execution).
+
+TPU-first shape: agent nets + hypernet mixer + target TD are ONE
+jit-compiled step over a batch of joint transitions (target params
+thread through the batch, polyak sync outside the jit — the SAC/DDPG
+pattern). Agents share one utility net with an agent-id one-hot input
+(the standard parameter-sharing trick). Rollouts are a local env loop
+inside training_step: joint transitions (all agents' obs/actions + the
+team reward) must stay joint, which the per-module env-runner batches
+deliberately do not preserve.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.env.registry import make_env
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+from ray_tpu.tune.trainable import Trainable
+
+
+class QMIXConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.mixing_embed_dim: int = 32
+        self.hypernet_hidden: int = 64
+        self.agent_hidden: tuple = (64,)
+        self.replay_buffer_capacity: int = 50_000
+        self.num_steps_sampled_before_learning_starts: int = 200
+        self.epsilon_start: float = 1.0
+        self.epsilon_end: float = 0.05
+        self.epsilon_decay_steps: int = 2_000
+        self.tau: float = 0.01
+        self.rollout_fragment_length = 64
+        self.train_batch_size = 128
+        self.updates_per_step: int = 8
+        self.lr = 5e-3
+
+    @property
+    def algo_class(self):
+        return QMIX
+
+
+def _mlp_init(rng, sizes):
+    import jax
+    import jax.numpy as jnp
+
+    params = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for k, (fi, fo) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(k, (fi, fo)) * jnp.sqrt(2.0 / fi)
+        params.append({"w": w, "b": jnp.zeros((fo,))})
+    return params
+
+
+def _mlp(params, x, final_act=False):
+    import jax.numpy as jnp
+
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or final_act:
+            x = jnp.tanh(x)
+    return x
+
+
+class QMIX(Trainable):
+    config_class = QMIXConfig
+
+    def setup(self, config) -> None:
+        import jax
+        import optax
+
+        self.config = config if isinstance(config, QMIXConfig) else \
+            QMIXConfig().update_from_dict(dict(config or {}))
+        cfg = self.config
+        self.env = make_env(cfg.env, cfg.env_config)
+        self.agents = list(self.env.agent_ids)
+        self.n_agents = len(self.agents)
+        self.obs_dim = int(
+            self.env.observation_space_of(self.agents[0]).shape[0])
+        self.n_actions = int(self.env.action_space_of(self.agents[0]).n)
+        self.state_dim = self.obs_dim * self.n_agents  # global state
+
+        rng = jax.random.PRNGKey(cfg.seed)
+        k_agent, k_w1, k_b1, k_w2, k_b2 = jax.random.split(rng, 5)
+        embed = cfg.mixing_embed_dim
+        hyper = cfg.hypernet_hidden
+        self.params = {
+            # Shared utility net over [obs ++ agent one-hot].
+            "agent": _mlp_init(k_agent,
+                               (self.obs_dim + self.n_agents,
+                                *cfg.agent_hidden, self.n_actions)),
+            # Hypernetworks: state -> mixer weights (abs() at use).
+            "hyper_w1": _mlp_init(k_w1, (self.state_dim, hyper,
+                                         self.n_agents * embed)),
+            "hyper_b1": _mlp_init(k_b1, (self.state_dim, embed)),
+            "hyper_w2": _mlp_init(k_w2, (self.state_dim, hyper, embed)),
+            "hyper_b2": _mlp_init(k_b2, (self.state_dim, embed, 1)),
+        }
+        import jax.numpy as jnp
+
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: jnp.array(x, copy=True), self.params)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adam(cfg.lr))
+        self.opt_state = self.optimizer.init(self.params)
+        self._step_fn = None
+        self._act_fn = None
+        self._replay = ReplayBuffer(cfg.replay_buffer_capacity,
+                                    seed=cfg.seed)
+        self._explore_rng = np.random.default_rng(cfg.seed)
+        self._env_steps = 0
+        self._iteration = 0
+        self._recent_team_returns: list = []
+        self._obs, _ = self.env.reset(seed=cfg.seed)
+        self._episode_return = 0.0
+
+    # ---- policy ----
+
+    def _agent_qs(self, params, obs_stack):
+        """obs_stack [A, obs_dim] -> per-agent Q values [A, n_actions]."""
+        import jax.numpy as jnp
+
+        eye = jnp.eye(self.n_agents)
+        x = jnp.concatenate([obs_stack, eye], axis=-1)
+        return _mlp(params["agent"], x)
+
+    def _mix(self, params, agent_q, state):
+        """Monotonic mixer: agent_q [B, A], state [B, S] -> Q_tot [B]."""
+        import jax.numpy as jnp
+
+        embed = self.config.mixing_embed_dim
+        w1 = jnp.abs(_mlp(params["hyper_w1"], state)).reshape(
+            -1, self.n_agents, embed)
+        b1 = _mlp(params["hyper_b1"], state)
+        import jax
+
+        hidden = jax.nn.elu(
+            jnp.einsum("ba,bae->be", agent_q, w1) + b1)
+        w2 = jnp.abs(_mlp(params["hyper_w2"], state))
+        b2 = _mlp(params["hyper_b2"], state)[..., 0]
+        return jnp.einsum("be,be->b", hidden, w2) + b2
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end -
+                                           cfg.epsilon_start)
+
+    def _act(self, obs: Dict[str, np.ndarray], epsilon: float
+             ) -> Dict[str, int]:
+        import jax
+
+        if self._act_fn is None:
+            self._act_fn = jax.jit(
+                lambda p, o: self._agent_qs(p, o).argmax(-1))
+        stack = np.stack([obs[a] for a in self.agents])
+        greedy = np.asarray(self._act_fn(self.params, stack))
+        out = {}
+        rng = self._explore_rng
+        for i, a in enumerate(self.agents):
+            if rng.random() < epsilon:
+                out[a] = int(rng.integers(self.n_actions))
+            else:
+                out[a] = int(greedy[i])
+        return out
+
+    # ---- learning ----
+
+    def _loss(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        target = batch["target_params"]
+        B = batch["obs"].shape[0]
+
+        def q_taken(p, obs, actions):
+            qs = jax.vmap(lambda o: self._agent_qs(p, o))(obs)  # [B,A,N]
+            return jnp.take_along_axis(
+                qs, actions[..., None], axis=-1)[..., 0]       # [B,A]
+
+        q = q_taken(params, batch["obs"], batch["actions"])
+        q_tot = self._mix(params, q, batch["obs"].reshape(B, -1))
+
+        next_qs = jax.vmap(
+            lambda o: self._agent_qs(target, o))(batch["next_obs"])
+        next_max = next_qs.max(-1)                             # [B,A]
+        next_tot = self._mix(target, next_max,
+                             batch["next_obs"].reshape(B, -1))
+        y = jax.lax.stop_gradient(
+            batch["rewards"] + cfg.gamma *
+            (1.0 - batch["dones"]) * next_tot)
+        loss = ((q_tot - y) ** 2).mean()
+        return loss, {"td_loss": loss, "q_tot_mean": q_tot.mean()}
+
+    def _update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+
+        if self._step_fn is None:
+            def step(params, opt_state, batch):
+                (loss, metrics), grads = jax.value_and_grad(
+                    self._loss, has_aux=True)(params, batch)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                import optax
+
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, metrics
+
+            self._step_fn = jax.jit(step)
+        batch = dict(batch)
+        batch["target_params"] = self.target_params
+        self.params, self.opt_state, metrics = self._step_fn(
+            self.params, self.opt_state, batch)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def _sync_target(self, tau: float) -> None:
+        import jax
+
+        self.target_params = jax.tree_util.tree_map(
+            lambda t, p: t * (1 - tau) + p * tau,
+            self.target_params, self.params)
+
+    # ---- Trainable ----
+
+    def step(self) -> Dict[str, Any]:
+        cfg = self.config
+        eps = self._epsilon()
+        frag: Dict[str, list] = {k: [] for k in
+                                 ("obs", "actions", "rewards",
+                                  "next_obs", "dones")}
+        for _ in range(cfg.rollout_fragment_length):
+            actions = self._act(self._obs, eps)
+            nxt, rewards, terms, truncs, _ = self.env.step(actions)
+            team = float(rewards[self.agents[0]])
+            done = bool(terms.get("__all__") or truncs.get("__all__"))
+            frag["obs"].append(
+                np.stack([self._obs[a] for a in self.agents]))
+            frag["actions"].append(
+                np.array([actions[a] for a in self.agents], np.int32))
+            frag["rewards"].append(np.float32(team))
+            frag["next_obs"].append(
+                np.stack([nxt[a] for a in self.agents]))
+            frag["dones"].append(
+                np.float32(terms.get("__all__", False)))
+            self._episode_return += team
+            self._env_steps += 1
+            if done:
+                self._recent_team_returns.append(self._episode_return)
+                self._recent_team_returns = \
+                    self._recent_team_returns[-100:]
+                self._episode_return = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nxt
+        self._replay.add(SampleBatch(
+            {k: np.stack(v) for k, v in frag.items()}))
+
+        metrics: Dict[str, Any] = {
+            "epsilon": eps,
+            "num_env_steps_total": self._env_steps,
+            "replay_size": len(self._replay),
+            "episode_return_mean":
+                float(np.mean(self._recent_team_returns))
+                if self._recent_team_returns else float("nan"),
+        }
+        if len(self._replay) >= \
+                cfg.num_steps_sampled_before_learning_starts:
+            for _ in range(cfg.updates_per_step):
+                batch = dict(self._replay.sample(cfg.train_batch_size))
+                metrics.update(self._update(batch))
+                self._sync_target(cfg.tau)
+        self._iteration += 1
+        metrics["training_iteration"] = self._iteration
+        return metrics
+
+    def save_checkpoint(self, checkpoint_dir: str) -> str:
+        import os
+        import pickle
+
+        import jax
+
+        state = {
+            "params": jax.tree_util.tree_map(np.asarray, self.params),
+            "target_params": jax.tree_util.tree_map(
+                np.asarray, self.target_params),
+            # Optimizer moments + replay contents: a resumed trial IS
+            # the paused trial (repo convention: JaxLearner.get_state /
+            # OffPolicyAlgorithm.get_extra_state).
+            "opt_state": jax.tree_util.tree_map(
+                np.asarray, self.opt_state),
+            "replay_cols": dict(self._replay._cols),
+            "replay_size": self._replay._size,
+            "replay_next": self._replay._next,
+            "env_steps": self._env_steps,
+            "iteration": self._iteration,
+        }
+        with open(os.path.join(checkpoint_dir, "qmix_state.pkl"),
+                  "wb") as f:
+            pickle.dump(state, f)
+        return checkpoint_dir
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        import os
+        import pickle
+
+        import jax.numpy as jnp
+        import jax
+
+        with open(os.path.join(checkpoint_dir, "qmix_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        self.params = jax.tree_util.tree_map(jnp.asarray, state["params"])
+        self.target_params = jax.tree_util.tree_map(
+            jnp.asarray, state["target_params"])
+        if "opt_state" in state:
+            self.opt_state = jax.tree_util.tree_map(
+                jnp.asarray, state["opt_state"])
+        else:
+            self.opt_state = self.optimizer.init(self.params)
+        self._replay._cols = dict(state.get("replay_cols", {}))
+        self._replay._size = state.get("replay_size", 0)
+        self._replay._next = state.get("replay_next", 0)
+        self._env_steps = state["env_steps"]
+        self._iteration = state["iteration"]
+        self._step_fn = None
+        self._act_fn = None
+
+    def cleanup(self) -> None:
+        pass
+
+    stop = cleanup
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
+        """Greedy (decentralized-execution) evaluation."""
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = self.env.reset(seed=10_000 + ep)
+            total, done = 0.0, False
+            while not done:
+                actions = self._act(obs, epsilon=0.0)
+                obs, rewards, terms, truncs, _ = self.env.step(actions)
+                total += float(rewards[self.agents[0]])
+                done = bool(terms.get("__all__") or
+                            truncs.get("__all__"))
+            returns.append(total)
+        return {"evaluation": {
+            "episode_return_mean": float(np.mean(returns)),
+            "num_episodes": num_episodes}}

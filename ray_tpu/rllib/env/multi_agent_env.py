@@ -38,6 +38,61 @@ class MultiAgentEnv:
         raise NotImplementedError
 
 
+class CoopPress(MultiAgentEnv):
+    """Cooperative coordination task (QMIX testbed): each step both
+    agents observe a context bit and must JOINTLY act — both matching
+    the context pays +1, both pressing the other button +0.3, any
+    mismatch 0. The reward is a single TEAM reward (shared), so
+    credit assignment needs centralized value decomposition.
+    """
+
+    agent_ids = ("a0", "a1")
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self.episode_len = int(config.get("episode_len", 8))
+        self._rng = np.random.default_rng(config.get("seed", 0))
+        self._ctx = 0
+        self._t = 0
+
+    def observation_space_of(self, agent_id: str):
+        return Box(0.0, 1.0, (2,))
+
+    def action_space_of(self, agent_id: str):
+        return Discrete(2)
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        o = np.zeros(2, np.float32)
+        o[self._ctx] = 1.0
+        return {a: o.copy() for a in self.agent_ids}
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._ctx = int(self._rng.integers(2))
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, actions: Dict[str, Any]):
+        a0, a1 = int(actions["a0"]), int(actions["a1"])
+        if a0 == a1 == self._ctx:
+            team = 1.0
+        elif a0 == a1:
+            team = 0.3
+        else:
+            team = 0.0
+        self._t += 1
+        self._ctx = int(self._rng.integers(2))
+        done = self._t >= self.episode_len
+        obs = self._obs()
+        rewards = {a: team for a in self.agent_ids}
+        terms = {a: False for a in self.agent_ids}
+        terms["__all__"] = False
+        truncs = {a: done for a in self.agent_ids}
+        truncs["__all__"] = done
+        return obs, rewards, terms, truncs, {}
+
+
 class TwoAgentGrid(MultiAgentEnv):
     """Two independent GridWorld agents on separate boards, one episode
     clock. Agent "a1"'s board is larger than "a0"'s, so the two policies

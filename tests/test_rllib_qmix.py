@@ -1,0 +1,86 @@
+"""QMIX tests.
+
+Reference test model: rllib_contrib qmix CI — a cooperative task the
+monotonic mixer must solve with a shared team reward, plus structural
+checks (monotonicity) and checkpoint round-trips.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig
+from ray_tpu.rllib.env.multi_agent_env import CoopPress
+
+
+def test_qmix_solves_coop_press():
+    """Both agents must jointly follow the context bit; optimal team
+    return is 8.0/episode (probe: greedy eval reaches 8.0 by ~iter 15,
+    random joint play scores ~2.6)."""
+    cfg = (QMIXConfig()
+           .environment(CoopPress, env_config={"episode_len": 8})
+           .debugging(seed=0))
+    algo = cfg.build_algo()
+    for _ in range(40):
+        result = algo.step()
+    assert np.isfinite(result["td_loss"])
+    ev = algo.evaluate(num_episodes=10)
+    assert ev["evaluation"]["episode_return_mean"] > 6.5, ev
+
+
+def test_qmix_mixer_is_monotonic():
+    """Raising any single agent's utility must never lower Q_tot (the
+    abs-hypernet weight constraint — the property that makes per-agent
+    argmax = joint argmax)."""
+    import jax.numpy as jnp
+
+    cfg = (QMIXConfig()
+           .environment(CoopPress)
+           .debugging(seed=1))
+    algo = cfg.build_algo()
+    rng = np.random.default_rng(0)
+    state = jnp.asarray(rng.normal(size=(16, algo.state_dim)),
+                        jnp.float32)
+    q = jnp.asarray(rng.normal(size=(16, algo.n_agents)), jnp.float32)
+    base = np.asarray(algo._mix(algo.params, q, state))
+    for i in range(algo.n_agents):
+        bumped = q.at[:, i].add(1.0)
+        up = np.asarray(algo._mix(algo.params, bumped, state))
+        assert (up >= base - 1e-5).all()
+
+
+def test_qmix_checkpoint_roundtrip(tmp_path):
+    import os
+
+    from jax.flatten_util import ravel_pytree
+
+    cfg = (QMIXConfig()
+           .environment(CoopPress)
+           .training(num_steps_sampled_before_learning_starts=64,
+                     updates_per_step=2, train_batch_size=32)
+           .debugging(seed=2))
+    algo = cfg.build_algo()
+    for _ in range(3):
+        algo.step()
+    d = str(tmp_path / "ckpt")
+    os.makedirs(d, exist_ok=True)
+    algo.save_checkpoint(d)
+    flat, _ = ravel_pytree(algo.params)
+    steps = algo._env_steps
+
+    replay_len = len(algo._replay)
+    flat_opt, _ = ravel_pytree(algo.opt_state)
+
+    algo2 = cfg.copy().build_algo()
+    algo2.load_checkpoint(d)
+    flat2, _ = ravel_pytree(algo2.params)
+    np.testing.assert_allclose(np.asarray(flat), np.asarray(flat2))
+    assert algo2._env_steps == steps
+    # Optimizer moments + replay restored: the resumed trial IS the
+    # paused trial.
+    flat_opt2, _ = ravel_pytree(algo2.opt_state)
+    np.testing.assert_allclose(np.asarray(flat_opt),
+                               np.asarray(flat_opt2))
+    assert len(algo2._replay) == replay_len > 0
+    # Restored algo keeps training and acting.
+    r = algo2.step()
+    assert r["num_env_steps_total"] > steps
