@@ -14,6 +14,13 @@ from ray_tpu.models.llama import (
     llama_param_specs,
 )
 from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_forward
+from ray_tpu.models.vit import (
+    ViTConfig,
+    vit_init,
+    vit_forward,
+    vit_loss,
+    vit_param_specs,
+)
 from ray_tpu.models.moe import (
     MoeConfig,
     moe_init,
@@ -28,6 +35,11 @@ __all__ = [
     "llama_forward",
     "llama_loss",
     "llama_param_specs",
+    "ViTConfig",
+    "vit_init",
+    "vit_forward",
+    "vit_loss",
+    "vit_param_specs",
     "MLPConfig",
     "mlp_init",
     "mlp_forward",

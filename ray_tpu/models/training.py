@@ -43,8 +43,15 @@ def make_sharded_train_step(
     param_shardings = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), param_specs,
         is_leaf=lambda x: isinstance(x, P))
-    batch_sharding = NamedSharding(
-        mesh, logical_to_mesh(batch_logical, rules))
+
+    def _batch_sharding_for(x: jax.Array) -> NamedSharding:
+        # Rank-adaptive: batch_logical truncated/None-padded to each
+        # leaf's rank (labels are rank-1, tokens rank-2, images rank-4
+        # — all shard their leading batch axis, trailing axes
+        # replicate unless batch_logical names them).
+        logical = tuple(batch_logical[:x.ndim]) + \
+            (None,) * max(0, x.ndim - len(batch_logical))
+        return NamedSharding(mesh, logical_to_mesh(logical, rules))
 
     def init_fn(params):
         params = jax.tree_util.tree_map(
@@ -62,7 +69,7 @@ def make_sharded_train_step(
         with spmd_mesh_scope(mesh):
             batch = jax.tree_util.tree_map(
                 lambda x: jax.lax.with_sharding_constraint(
-                    x, batch_sharding), batch)
+                    x, _batch_sharding_for(x)), batch)
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)

@@ -1,0 +1,200 @@
+"""Vision Transformer (ViT) classifier, TPU-first.
+
+Same design language as the Llama family (models/llama.py): pure
+functional params, scanned encoder layers (`lax.scan` — O(1) compile in
+depth), logical-axis trees driving GSPMD sharding over the dp/fsdp/tp
+mesh, bf16 activations / f32 master params, per-layer remat. Patchify
+is a reshape (no conv): [B,H,W,C] → [B, N, p*p*C] → linear embed, so
+the whole forward is MXU matmuls.
+
+Reference capability: the reference trains vision models through Ray
+Train as opaque torch modules (python/ray/train/torch/); here the
+vision family is a first-class GSPMD citizen sharing
+`make_sharded_train_step` with the LM flagship.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops import attention
+from ray_tpu.parallel.sharding import LogicalAxisRules, logical_to_mesh
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 32
+    patch_size: int = 4
+    channels: int = 3
+    dim: int = 192
+    n_layers: int = 6
+    n_heads: int = 6
+    ffn_dim: int = 768
+    num_classes: int = 10
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError("patch_size must divide image_size")
+        if self.dim % self.n_heads:
+            raise ValueError("n_heads must divide dim")
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    def num_params(self) -> int:
+        # Mirrors vit_init exactly: per layer 4 LN vectors (4d), qkv
+        # (3d^2) + out (d^2) projections, MLP w_in/b_in/w_out/b_out
+        # (2df + f + d); top level patch_embed (no bias), cls, pos,
+        # final LN pair, bias-free head.
+        d, f = self.dim, self.ffn_dim
+        per_layer = 4 * d * d + 2 * d * f + 5 * d + f
+        return (self.patch_dim * d + d + (self.n_patches + 1) * d +
+                self.n_layers * per_layer + 2 * d +
+                d * self.num_classes)
+
+
+def _layer_shapes(cfg: ViTConfig) -> Dict[str, tuple]:
+    d, f = cfg.dim, cfg.ffn_dim
+    return {
+        # name: (shape, logical axes, fan_in or None-for-scale/bias)
+        "ln1_scale": ((d,), ("embed",), None),
+        "ln1_bias": ((d,), ("embed",), 0),
+        "wqkv": ((d, 3 * d), ("embed", "qkv"), d),
+        "wo": ((d, d), ("heads", "embed"), d),
+        "ln2_scale": ((d,), ("embed",), None),
+        "ln2_bias": ((d,), ("embed",), 0),
+        "w_in": ((d, f), ("embed", "mlp"), d),
+        "b_in": ((f,), ("mlp",), 0),
+        "w_out": ((f, d), ("mlp", "embed"), f),
+        "b_out": ((d,), ("embed",), 0),
+    }
+
+
+def vit_init(rng: jax.Array, cfg: ViTConfig) -> Params:
+    shapes = _layer_shapes(cfg)
+    keys = jax.random.split(rng, len(shapes) + 4)
+    layers = {}
+    for i, (name, (shape, _, fan_in)) in enumerate(shapes.items()):
+        full = (cfg.n_layers,) + shape
+        if fan_in is None:
+            layers[name] = jnp.ones(full, cfg.param_dtype)
+        elif fan_in == 0:
+            layers[name] = jnp.zeros(full, cfg.param_dtype)
+        else:
+            layers[name] = (jax.random.normal(keys[i], full) *
+                            fan_in ** -0.5).astype(cfg.param_dtype)
+    return {
+        "patch_embed": (jax.random.normal(
+            keys[-4], (cfg.patch_dim, cfg.dim)) *
+            cfg.patch_dim ** -0.5).astype(cfg.param_dtype),
+        "cls_token": jnp.zeros((cfg.dim,), cfg.param_dtype),
+        "pos_embed": (jax.random.normal(
+            keys[-3], (cfg.n_patches + 1, cfg.dim)) * 0.02
+            ).astype(cfg.param_dtype),
+        "layers": layers,
+        "final_ln_scale": jnp.ones((cfg.dim,), cfg.param_dtype),
+        "final_ln_bias": jnp.zeros((cfg.dim,), cfg.param_dtype),
+        "head": (jax.random.normal(
+            keys[-1], (cfg.dim, cfg.num_classes)) * cfg.dim ** -0.5
+            ).astype(cfg.param_dtype),
+    }
+
+
+def vit_logical_specs(cfg: ViTConfig) -> Params:
+    layer_specs = {name: ("layers",) + logical
+                   for name, (_, logical, _f) in _layer_shapes(cfg).items()}
+    return {
+        "patch_embed": (None, "embed"),
+        "cls_token": ("embed",),
+        "pos_embed": (None, "embed"),
+        "layers": layer_specs,
+        "final_ln_scale": ("embed",),
+        "final_ln_bias": ("embed",),
+        "head": ("embed", "vocab"),   # classes shard like the LM head
+    }
+
+
+def vit_param_specs(cfg: ViTConfig,
+                    rules: Optional[LogicalAxisRules] = None) -> Params:
+    return jax.tree_util.tree_map(
+        lambda logical: logical_to_mesh(logical, rules),
+        vit_logical_specs(cfg),
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(e, (str, type(None))) for e in x))
+
+
+def _layernorm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = ((x32 - mean) ** 2).mean(-1, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return out.astype(x.dtype) * scale.astype(x.dtype) + \
+        bias.astype(x.dtype)
+
+
+def _encoder_layer(cfg: ViTConfig, x: jax.Array,
+                   layer: Dict[str, jax.Array]) -> jax.Array:
+    B, N, d = x.shape
+    h, hd = cfg.n_heads, cfg.dim // cfg.n_heads
+    y = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"], cfg.norm_eps)
+    qkv = (y @ layer["wqkv"].astype(y.dtype)).reshape(B, N, 3, h, hd)
+    q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+    att = attention(q, k, v, causal=False)          # [B, h, N, hd]
+    att = att.transpose(0, 2, 1, 3).reshape(B, N, d)
+    x = x + att @ layer["wo"].astype(att.dtype)
+    y = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"], cfg.norm_eps)
+    y = jax.nn.gelu(y @ layer["w_in"].astype(y.dtype) +
+                    layer["b_in"].astype(y.dtype))
+    return x + (y @ layer["w_out"].astype(y.dtype) +
+                layer["b_out"].astype(y.dtype))
+
+
+def vit_forward(params: Params, images: jax.Array,
+                cfg: ViTConfig) -> jax.Array:
+    """images [B, H, W, C] → class logits [B, num_classes] (f32)."""
+    B = images.shape[0]
+    p = cfg.patch_size
+    g = cfg.image_size // p
+    x = images.astype(cfg.dtype).reshape(B, g, p, g, p, cfg.channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, g * g, cfg.patch_dim)
+    x = x @ params["patch_embed"].astype(cfg.dtype)
+    cls = jnp.broadcast_to(params["cls_token"].astype(cfg.dtype),
+                           (B, 1, cfg.dim))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(cfg.dtype)
+
+    def body(carry, layer):
+        fn = _encoder_layer
+        if cfg.remat:
+            fn = jax.checkpoint(fn, static_argnums=(0,))
+        return fn(cfg, carry, layer), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _layernorm(x[:, 0], params["final_ln_scale"],
+                   params["final_ln_bias"], cfg.norm_eps)
+    return (x @ params["head"].astype(x.dtype)).astype(jnp.float32)
+
+
+def vit_loss(params: Params, batch: Dict[str, jax.Array],
+             cfg: ViTConfig) -> jax.Array:
+    """Softmax cross-entropy on {'images': [B,H,W,C], 'labels': [B]}."""
+    logits = vit_forward(params, batch["images"], cfg)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(
+        logp, batch["labels"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return nll.mean()

@@ -120,3 +120,42 @@ def test_mlp():
         params = _o.apply_updates(params, updates)
         loss0 = loss0 if loss0 is not None else float(loss)
     assert float(loss) < loss0
+
+
+def test_vit_forward_and_sharded_training():
+    """ViT family: patchify-as-reshape forward shapes, GSPMD-sharded
+    train step on the 8-device mesh, loss decreases, params sharded."""
+    import optax
+
+    from ray_tpu.models import (ViTConfig, vit_init, vit_loss,
+                                vit_param_specs)
+    from ray_tpu.models.vit import vit_forward
+    from ray_tpu.models.training import make_sharded_train_step
+    from ray_tpu.parallel import MeshSpec, create_mesh
+
+    cfg = ViTConfig(image_size=8, patch_size=4, dim=32, n_layers=2,
+                    n_heads=4, ffn_dim=64, num_classes=10,
+                    dtype=jax.numpy.float32)
+    params = vit_init(jax.random.PRNGKey(0), cfg)
+    imgs = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 8, 3))
+    logits = vit_forward(params, imgs, cfg)
+    assert logits.shape == (4, 10)
+
+    mesh = create_mesh(MeshSpec(dp=2, fsdp=2, tp=2).resolve(8))
+    init_fn, step_fn = make_sharded_train_step(
+        lambda p, b: vit_loss(p, b, cfg),
+        optax.adamw(3e-3), mesh, vit_param_specs(cfg))
+    params, opt_state = init_fn(params)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 10)
+    batch = {"images": jax.random.normal(jax.random.PRNGKey(3),
+                                         (8, 8, 8, 3)),
+             "labels": labels}
+    losses = []
+    for _ in range(8):
+        params, opt_state, metrics = step_fn(params, opt_state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+    # qkv projection ACTUALLY partitioned: the addressable shard is
+    # half-sized on both matrix dims ([layers, d/fsdp, 3d/tp]).
+    shard = params["layers"]["wqkv"].addressable_shards[0].data
+    assert shard.shape == (2, 32 // 2, 3 * 32 // 2), shard.shape
