@@ -23,6 +23,8 @@ from typing import Any, Dict
 import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import _mlp_apply as _mlp
+from ray_tpu.rllib.core.rl_module import _mlp_init
 from ray_tpu.rllib.env.registry import make_env
 from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
 from ray_tpu.rllib.utils.sample_batch import SampleBatch
@@ -51,26 +53,6 @@ class QMIXConfig(AlgorithmConfig):
         return QMIX
 
 
-def _mlp_init(rng, sizes):
-    import jax
-    import jax.numpy as jnp
-
-    params = []
-    keys = jax.random.split(rng, len(sizes) - 1)
-    for k, (fi, fo) in zip(keys, zip(sizes[:-1], sizes[1:])):
-        w = jax.random.normal(k, (fi, fo)) * jnp.sqrt(2.0 / fi)
-        params.append({"w": w, "b": jnp.zeros((fo,))})
-    return params
-
-
-def _mlp(params, x, final_act=False):
-    import jax.numpy as jnp
-
-    for i, layer in enumerate(params):
-        x = x @ layer["w"] + layer["b"]
-        if i < len(params) - 1 or final_act:
-            x = jnp.tanh(x)
-    return x
 
 
 class QMIX(Trainable):
@@ -286,6 +268,17 @@ class QMIX(Trainable):
         metrics["training_iteration"] = self._iteration
         return metrics
 
+    def _compact_replay(self) -> Dict[str, np.ndarray]:
+        """Filled replay rows, oldest-first (unwraps the ring)."""
+        buf = self._replay
+        if buf._size == 0:
+            return {}
+        if buf._size < buf.capacity:
+            idx = np.arange(buf._size)
+        else:
+            idx = (buf._next + np.arange(buf.capacity)) % buf.capacity
+        return {k: v[idx] for k, v in buf._cols.items()}
+
     def save_checkpoint(self, checkpoint_dir: str) -> str:
         import os
         import pickle
@@ -296,14 +289,15 @@ class QMIX(Trainable):
             "params": jax.tree_util.tree_map(np.asarray, self.params),
             "target_params": jax.tree_util.tree_map(
                 np.asarray, self.target_params),
-            # Optimizer moments + replay contents: a resumed trial IS
-            # the paused trial (repo convention: JaxLearner.get_state /
-            # OffPolicyAlgorithm.get_extra_state).
+            # Optimizer moments + replay contents: the learning state
+            # resumes where it paused (repo convention:
+            # JaxLearner.get_state / OffPolicyAlgorithm). Replay is
+            # stored COMPACT (filled rows in ring order) — a
+            # capacity-sized dump would pickle mostly zeros.
             "opt_state": jax.tree_util.tree_map(
                 np.asarray, self.opt_state),
-            "replay_cols": dict(self._replay._cols),
-            "replay_size": self._replay._size,
-            "replay_next": self._replay._next,
+            "replay_rows": self._compact_replay(),
+            "recent_team_returns": list(self._recent_team_returns),
             "env_steps": self._env_steps,
             "iteration": self._iteration,
         }
@@ -330,9 +324,14 @@ class QMIX(Trainable):
                 jnp.asarray, state["opt_state"])
         else:
             self.opt_state = self.optimizer.init(self.params)
-        self._replay._cols = dict(state.get("replay_cols", {}))
-        self._replay._size = state.get("replay_size", 0)
-        self._replay._next = state.get("replay_next", 0)
+        rows = state.get("replay_rows")
+        if rows:
+            self._replay = ReplayBuffer(
+                self.config.replay_buffer_capacity,
+                seed=self.config.seed)
+            self._replay.add(SampleBatch(rows))
+        self._recent_team_returns = list(
+            state.get("recent_team_returns", []))
         self._env_steps = state["env_steps"]
         self._iteration = state["iteration"]
         self._step_fn = None
@@ -344,14 +343,18 @@ class QMIX(Trainable):
     stop = cleanup
 
     def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
-        """Greedy (decentralized-execution) evaluation."""
+        """Greedy (decentralized-execution) evaluation on a FRESH env
+        instance — the training env's episode state (self._obs, clock)
+        must not be disturbed mid-rollout (repo convention:
+        Algorithm.evaluate uses dedicated eval runners)."""
+        env = make_env(self.config.env, self.config.env_config)
         returns = []
         for ep in range(num_episodes):
-            obs, _ = self.env.reset(seed=10_000 + ep)
+            obs, _ = env.reset(seed=10_000 + ep)
             total, done = 0.0, False
             while not done:
                 actions = self._act(obs, epsilon=0.0)
-                obs, rewards, terms, truncs, _ = self.env.step(actions)
+                obs, rewards, terms, truncs, _ = env.step(actions)
                 total += float(rewards[self.agents[0]])
                 done = bool(terms.get("__all__") or
                             truncs.get("__all__"))
