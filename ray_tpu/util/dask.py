@@ -1,0 +1,132 @@
+"""Dask-on-ray_tpu scheduler.
+
+Reference: python/ray/util/dask/ (`ray_dask_get`: a dask scheduler that
+executes each task-graph node as a Ray task, so independent nodes run
+in parallel across the cluster and intermediate results live in the
+object store instead of the driver).
+
+The dask graph spec is plain data (a dict of key -> computation, where
+a computation is a literal, a key, a task tuple `(callable, *args)`, or
+a list of computations), so the scheduler is implemented and tested
+against raw graphs without importing dask; `enable_dask_on_ray()` wires
+it as the default scheduler when dask IS installed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, List
+
+import ray_tpu
+
+__all__ = ["ray_dask_get", "enable_dask_on_ray", "disable_dask_on_ray"]
+
+
+def _ishashable(x: Any) -> bool:
+    try:
+        hash(x)
+        return True
+    except TypeError:
+        return False
+
+
+def _istask(x: Any) -> bool:
+    return isinstance(x, tuple) and len(x) > 0 and callable(x[0])
+
+
+@ray_tpu.remote
+def _exec_node(fn, spec, *flat):
+    """One graph node as a cluster task. Args arrive as (spec, flat
+    refs): the runtime resolves only TOP-LEVEL ObjectRef arguments, so
+    refs nested inside list computations ride `flat` and the spec
+    rebuilds the original (possibly nested) argument structure."""
+    def dec(s):
+        if isinstance(s, list):
+            return [dec(e) for e in s]
+        tag, v = s
+        return flat[v] if tag == "r" else v
+
+    return fn(*[dec(s) for s in spec])
+
+
+def _pack(args: List[Any]):
+    flat: List[Any] = []
+
+    def enc(a):
+        if isinstance(a, ray_tpu.ObjectRef):
+            flat.append(a)
+            return ("r", len(flat) - 1)
+        if isinstance(a, list):
+            return [enc(e) for e in a]
+        return ("l", a)
+
+    return [enc(a) for a in args], flat
+
+
+def _build(key: Hashable, dsk: Dict, refs: Dict[Hashable, Any],
+           building: set) -> Any:
+    """Resolve `key` to an ObjectRef (task nodes) or a literal,
+    submitting at most once per key."""
+    if key in refs:
+        return refs[key]
+    if key in building:
+        raise ValueError(f"cycle detected in dask graph at {key!r}")
+    building.add(key)
+    refs[key] = _resolve(dsk[key], dsk, refs, building)
+    building.discard(key)
+    return refs[key]
+
+
+def _resolve(comp: Any, dsk: Dict, refs: Dict[Hashable, Any],
+             building: set) -> Any:
+    if _istask(comp):
+        fn = comp[0]
+        args = [_resolve(a, dsk, refs, building) for a in comp[1:]]
+        spec, flat = _pack(args)
+        return _exec_node.remote(fn, spec, *flat)
+    if _ishashable(comp) and comp in dsk:
+        return _build(comp, dsk, refs, building)
+    if isinstance(comp, list):
+        return [_resolve(c, dsk, refs, building) for c in comp]
+    return comp
+
+
+def ray_dask_get(dsk: Dict, keys: Any, **kwargs) -> Any:
+    """Dask scheduler entry point: execute `dsk` on the cluster and
+    return the computed values for `keys` (which mirrors dask's
+    possibly-nested key lists)."""
+    refs: Dict[Hashable, Any] = {}
+    building: set = set()
+
+    def materialize(v):
+        if isinstance(v, ray_tpu.ObjectRef):
+            return ray_tpu.get(v)
+        if isinstance(v, list):
+            return [materialize(e) for e in v]
+        return v
+
+    def out(k):
+        if isinstance(k, list):
+            return [out(e) for e in k]
+        return materialize(_build(k, dsk, refs, building))
+
+    return out(keys)
+
+
+def enable_dask_on_ray() -> None:
+    """Make ray_dask_get dask's default scheduler (requires dask)."""
+    try:
+        import dask
+    except ImportError as e:
+        raise ImportError(
+            "enable_dask_on_ray requires the 'dask' package "
+            "(pip install dask); ray_dask_get itself runs raw dask-spec "
+            "graphs without it") from e
+    dask.config.set(scheduler=ray_dask_get)
+
+
+def disable_dask_on_ray() -> None:
+    try:
+        import dask
+    except ImportError:
+        return
+    dask.config.set(scheduler=None)

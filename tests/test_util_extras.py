@@ -306,3 +306,43 @@ def test_inspect_serializability_cycles_and_keys(ray_start_regular):
     ok, failures = inspect_serializability(with_bad_default,
                                            print_file=io.StringIO())
     assert not ok and any(f.obj is lock for f in failures)
+
+
+def test_ray_dask_get_scheduler(ray_start_regular):
+    """Dask-spec graphs execute as cluster tasks (reference:
+    python/ray/util/dask ray_dask_get). Tested against raw graphs —
+    the dask graph format is plain dicts/tuples, no dask needed."""
+    from operator import add, mul
+
+    from ray_tpu.util.dask import enable_dask_on_ray, ray_dask_get
+
+    dsk = {
+        "a": 1,
+        "b": (add, "a", 2),              # 3
+        "c": (mul, "b", "b"),            # 9
+        "d": (sum, ["a", "b", "c"]),     # refs nested inside a list
+        "e": (add, (add, "a", "a"), 5),  # inline nested task: 7
+    }
+    assert ray_dask_get(dsk, "c") == 9
+    assert ray_dask_get(dsk, ["c", "d", "e"]) == [9, 13, 7]
+    assert ray_dask_get(dsk, [["a", "b"], "c"]) == [[1, 3], 9]
+
+    # Cycle detection fails fast instead of hanging.
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get({"x": (add, "y", 1), "y": (add, "x", 1)}, "x")
+
+    # enable_dask_on_ray gates on dask (absent in this image) or wires
+    # (and here restores) the config when present.
+    try:
+        import dask  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="dask"):
+            enable_dask_on_ray()
+    else:
+        from ray_tpu.util.dask import disable_dask_on_ray
+
+        try:
+            enable_dask_on_ray()
+            assert dask.config.get("scheduler") is ray_dask_get
+        finally:
+            disable_dask_on_ray()
