@@ -18,7 +18,7 @@ deliberately do not preserve.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -53,6 +53,83 @@ class QMIXConfig(AlgorithmConfig):
         return QMIX
 
 
+
+
+def _make_agent_qs(n_agents: int):
+    """Standalone per-agent utility forward ([A, obs] -> [A, actions]):
+    shared net + agent-id one-hot. Module-level so rollout workers can
+    receive it pickled."""
+    def agent_qs(params, obs_stack):
+        import jax.numpy as jnp
+
+        eye = jnp.eye(n_agents)
+        x = jnp.concatenate([obs_stack, eye], axis=-1)
+        return _mlp(params["agent"], x)
+
+    return agent_qs
+
+
+class QMIXRolloutWorker:
+    """Remote joint-episode collector: steps a private env copy with
+    epsilon-greedy actions from shipped params and returns JOINT
+    transition columns (all agents' obs/actions + the team reward) —
+    the jointness the per-module multi-agent runner batches discard."""
+
+    def __init__(self, config: dict, worker_index: int):
+        import jax
+
+        self.config = config
+        self.env = make_env(config["env"], config.get("env_config"))
+        self.agents = list(self.env.agent_ids)
+        self.n_agents = len(self.agents)
+        self.n_actions = int(self.env.action_space_of(self.agents[0]).n)
+        seed = config.get("seed", 0) * 1000 + worker_index
+        self._rng = np.random.default_rng(seed)
+        self._act_fn = None
+        self._agent_qs = config["agent_qs_fn"]
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+
+    def collect(self, params, n_steps: int, epsilon: float):
+        import jax
+
+        if self._act_fn is None:
+            self._act_fn = jax.jit(
+                lambda p, o: self._agent_qs(p, o).argmax(-1))
+        cols: Dict[str, list] = {k: [] for k in
+                                 ("obs", "actions", "rewards",
+                                  "next_obs", "dones")}
+        episode_returns: list = []
+        for _ in range(n_steps):
+            stack = np.stack([self._obs[a] for a in self.agents])
+            greedy = np.asarray(self._act_fn(params, stack))
+            actions = {}
+            for i, a in enumerate(self.agents):
+                actions[a] = int(self._rng.integers(self.n_actions)) \
+                    if self._rng.random() < epsilon else int(greedy[i])
+            nxt, rewards, terms, truncs, _ = self.env.step(actions)
+            team = float(rewards[self.agents[0]])
+            done = bool(terms.get("__all__") or truncs.get("__all__"))
+            cols["obs"].append(stack)
+            cols["actions"].append(
+                np.array([actions[a] for a in self.agents], np.int32))
+            cols["rewards"].append(np.float32(team))
+            cols["next_obs"].append(
+                np.stack([nxt[a] for a in self.agents]))
+            cols["dones"].append(
+                np.float32(terms.get("__all__", False)))
+            self._episode_return += team
+            if done:
+                episode_returns.append(self._episode_return)
+                self._episode_return = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nxt
+        return ({k: np.stack(v) for k, v in cols.items()},
+                episode_returns)
+
+    def ping(self) -> bool:
+        return True
 
 
 class QMIX(Trainable):
@@ -102,6 +179,31 @@ class QMIX(Trainable):
         self._replay = ReplayBuffer(cfg.replay_buffer_capacity,
                                     seed=cfg.seed)
         self._explore_rng = np.random.default_rng(cfg.seed)
+        # Distributed joint rollouts (num_env_runners > 0): remote
+        # collectors return joint transition columns; the driver keeps
+        # only learning. Env stepping then parallelizes like the other
+        # algorithms' runner groups.
+        self._worker_manager = None
+        if cfg.num_env_runners > 0:
+            import ray_tpu
+            from ray_tpu.rllib.utils.actor_manager import \
+                FaultTolerantActorManager
+
+            worker_cfg = {
+                "env": cfg.env, "env_config": cfg.env_config,
+                "seed": cfg.seed,
+                "agent_qs_fn": _make_agent_qs(self.n_agents),
+            }
+            cls = ray_tpu.remote(QMIXRolloutWorker)
+
+            def factory(i: int):
+                return cls.options(
+                    num_cpus=cfg.num_cpus_per_env_runner,
+                    max_restarts=1).remote(worker_cfg, i + 1)
+
+            self._worker_manager = FaultTolerantActorManager(
+                [factory(i) for i in range(cfg.num_env_runners)],
+                factory)
         self._env_steps = 0
         self._iteration = 0
         self._recent_team_returns: list = []
@@ -112,11 +214,9 @@ class QMIX(Trainable):
 
     def _agent_qs(self, params, obs_stack):
         """obs_stack [A, obs_dim] -> per-agent Q values [A, n_actions]."""
-        import jax.numpy as jnp
-
-        eye = jnp.eye(self.n_agents)
-        x = jnp.concatenate([obs_stack, eye], axis=-1)
-        return _mlp(params["agent"], x)
+        if getattr(self, "_agent_qs_fn", None) is None:
+            self._agent_qs_fn = _make_agent_qs(self.n_agents)
+        return self._agent_qs_fn(params, obs_stack)
 
     def _mix(self, params, agent_q, state):
         """Monotonic mixer: agent_q [B, A], state [B, S] -> Q_tot [B]."""
@@ -220,6 +320,8 @@ class QMIX(Trainable):
     def step(self) -> Dict[str, Any]:
         cfg = self.config
         eps = self._epsilon()
+        if self._worker_manager is not None:
+            return self._training_step_distributed(eps)
         frag: Dict[str, list] = {k: [] for k in
                                  ("obs", "actions", "rewards",
                                   "next_obs", "dones")}
@@ -249,7 +351,13 @@ class QMIX(Trainable):
                 self._obs = nxt
         self._replay.add(SampleBatch(
             {k: np.stack(v) for k, v in frag.items()}))
+        return self._learn_and_finish(eps)
 
+    def _learn_and_finish(self, eps: float,
+                          extra: Optional[Dict[str, Any]] = None
+                          ) -> Dict[str, Any]:
+        """Shared tail of both rollout paths: metrics + the learn loop."""
+        cfg = self.config
         metrics: Dict[str, Any] = {
             "epsilon": eps,
             "num_env_steps_total": self._env_steps,
@@ -258,6 +366,7 @@ class QMIX(Trainable):
                 float(np.mean(self._recent_team_returns))
                 if self._recent_team_returns else float("nan"),
         }
+        metrics.update(extra or {})
         if len(self._replay) >= \
                 cfg.num_steps_sampled_before_learning_starts:
             for _ in range(cfg.updates_per_step):
@@ -267,6 +376,36 @@ class QMIX(Trainable):
         self._iteration += 1
         metrics["training_iteration"] = self._iteration
         return metrics
+
+    def _training_step_distributed(self, eps: float) -> Dict[str, Any]:
+        import jax
+
+        import ray_tpu
+
+        cfg = self.config
+        mgr = self._worker_manager
+        mgr.probe_unhealthy()  # restore dead collectors (params ship
+        # per call, so restored workers need no extra state sync)
+        ids = mgr.healthy_actor_ids()
+        if not ids:
+            raise RuntimeError("all QMIX rollout workers are dead")
+        # Exact split: frag steps total, remainder spread (+1 each to
+        # the first frag%n workers); workers with 0 steps are skipped.
+        frag, n = cfg.rollout_fragment_length, len(ids)
+        shards = {wid: frag // n + (1 if k < frag % n else 0)
+                  for k, wid in enumerate(ids)}
+        params_ref = ray_tpu.put(
+            jax.tree_util.tree_map(np.asarray, self.params))
+        results = mgr.foreach_sharded(
+            lambda a, steps: a.collect.remote(params_ref, steps, eps),
+            {wid: s for wid, s in shards.items() if s > 0})
+        for _, (cols, episode_returns) in results.ok:
+            self._replay.add(SampleBatch(cols))
+            self._env_steps += len(cols["rewards"])
+            self._recent_team_returns.extend(episode_returns)
+        self._recent_team_returns = self._recent_team_returns[-100:]
+        return self._learn_and_finish(
+            eps, {"num_env_runners": mgr.num_healthy_actors()})
 
     def _compact_replay(self) -> Dict[str, np.ndarray]:
         """Filled replay rows, oldest-first (unwraps the ring)."""
@@ -338,7 +477,15 @@ class QMIX(Trainable):
         self._act_fn = None
 
     def cleanup(self) -> None:
-        pass
+        if self._worker_manager is not None:
+            import ray_tpu
+
+            for i in list(self._worker_manager._actors):
+                try:
+                    ray_tpu.kill(self._worker_manager.actor(i))
+                except Exception:
+                    pass
+            self._worker_manager = None
 
     stop = cleanup
 

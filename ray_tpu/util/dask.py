@@ -62,31 +62,65 @@ def _pack(args: List[Any]):
     return [enc(a) for a in args], flat
 
 
-def _build(key: Hashable, dsk: Dict, refs: Dict[Hashable, Any],
-           building: set) -> Any:
-    """Resolve `key` to an ObjectRef (task nodes) or a literal,
-    submitting at most once per key."""
-    if key in refs:
-        return refs[key]
-    if key in building:
-        raise ValueError(f"cycle detected in dask graph at {key!r}")
-    building.add(key)
-    refs[key] = _resolve(dsk[key], dsk, refs, building)
-    building.discard(key)
-    return refs[key]
+def _key_deps(comp: Any, dsk: Dict) -> List[Hashable]:
+    """Keys of `dsk` referenced by a computation (iterative walk of the
+    nested task/list structure — structural nesting is shallow; KEY
+    chains, which can be thousands deep, never recurse here)."""
+    deps: List[Hashable] = []
+    stack = [comp]
+    while stack:
+        c = stack.pop()
+        if _istask(c):
+            stack.extend(c[1:])
+        elif isinstance(c, list):
+            stack.extend(c)
+        elif _ishashable(c) and c in dsk:
+            deps.append(c)
+    return deps
 
 
-def _resolve(comp: Any, dsk: Dict, refs: Dict[Hashable, Any],
-             building: set) -> Any:
+def _toposort(dsk: Dict, wanted: List[Hashable]) -> List[Hashable]:
+    """Dependency-first key order for the needed subgraph; raises on
+    cycles. Iterative DFS — no Python recursion on key chains."""
+    order: List[Hashable] = []
+    state: Dict[Hashable, int] = {}  # 1 = visiting, 2 = done
+    for root in wanted:
+        stack = [(root, False)]
+        while stack:
+            key, processed = stack.pop()
+            if processed:
+                state[key] = 2
+                order.append(key)
+                continue
+            st = state.get(key)
+            if st == 2:
+                continue
+            if st == 1:
+                raise ValueError(
+                    f"cycle detected in dask graph at {key!r}")
+            state[key] = 1
+            stack.append((key, True))
+            for dep in _key_deps(dsk[key], dsk):
+                if state.get(dep) != 2:
+                    if state.get(dep) == 1:
+                        raise ValueError(
+                            f"cycle detected in dask graph at {dep!r}")
+                    stack.append((dep, False))
+    return order
+
+
+def _resolve(comp: Any, dsk: Dict, refs: Dict[Hashable, Any]) -> Any:
+    """Computation -> ObjectRef/literal. Every referenced KEY is already
+    in `refs` (topo order); recursion only follows structural nesting."""
     if _istask(comp):
         fn = comp[0]
-        args = [_resolve(a, dsk, refs, building) for a in comp[1:]]
+        args = [_resolve(a, dsk, refs) for a in comp[1:]]
         spec, flat = _pack(args)
         return _exec_node.remote(fn, spec, *flat)
     if _ishashable(comp) and comp in dsk:
-        return _build(comp, dsk, refs, building)
+        return refs[comp]
     if isinstance(comp, list):
-        return [_resolve(c, dsk, refs, building) for c in comp]
+        return [_resolve(c, dsk, refs) for c in comp]
     return comp
 
 
@@ -94,22 +128,46 @@ def ray_dask_get(dsk: Dict, keys: Any, **kwargs) -> Any:
     """Dask scheduler entry point: execute `dsk` on the cluster and
     return the computed values for `keys` (which mirrors dask's
     possibly-nested key lists)."""
-    refs: Dict[Hashable, Any] = {}
-    building: set = set()
+    wanted: List[Hashable] = []
 
-    def materialize(v):
-        if isinstance(v, ray_tpu.ObjectRef):
-            return ray_tpu.get(v)
-        if isinstance(v, list):
-            return [materialize(e) for e in v]
-        return v
-
-    def out(k):
+    def collect(k):
         if isinstance(k, list):
-            return [out(e) for e in k]
-        return materialize(_build(k, dsk, refs, building))
+            for e in k:
+                collect(e)
+        else:
+            wanted.append(k)
 
-    return out(keys)
+    collect(keys)
+    refs: Dict[Hashable, Any] = {}
+    for key in _toposort(dsk, wanted):
+        refs[key] = _resolve(dsk[key], dsk, refs)
+
+    # One batched get for every output ref, then rebuild the nesting.
+    flat_refs: List[Any] = []
+
+    def index(v):
+        if isinstance(v, ray_tpu.ObjectRef):
+            flat_refs.append(v)
+            return ("r", len(flat_refs) - 1)
+        if isinstance(v, list):
+            return [index(e) for e in v]
+        return ("l", v)
+
+    def shape(k):
+        if isinstance(k, list):
+            return [shape(e) for e in k]
+        return index(refs[k])
+
+    spec = shape(keys)
+    values = ray_tpu.get(flat_refs) if flat_refs else []
+
+    def rebuild(s):
+        if isinstance(s, list):
+            return [rebuild(e) for e in s]
+        tag, v = s
+        return values[v] if tag == "r" else v
+
+    return rebuild(spec)
 
 
 def enable_dask_on_ray() -> None:

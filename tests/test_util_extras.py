@@ -346,3 +346,19 @@ def test_ray_dask_get_scheduler(ray_start_regular):
             assert dask.config.get("scheduler") is ray_dask_get
         finally:
             disable_dask_on_ray()
+
+
+def test_ray_dask_get_deep_chain(ray_start_regular):
+    """A 3000-link linear key chain must not hit the recursion limit
+    (iterative topo resolution)."""
+    from operator import add
+
+    from ray_tpu.util.dask import ray_dask_get
+
+    n = 3000
+    # String keys: integer keys with integer values would alias
+    # (dask treats any hashable equal to a key as a reference).
+    dsk = {"k0": 0}
+    for i in range(1, n):
+        dsk[f"k{i}"] = (add, f"k{i - 1}", 1)
+    assert ray_dask_get(dsk, f"k{n - 1}") == n - 1
