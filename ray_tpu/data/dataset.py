@@ -443,6 +443,43 @@ class Dataset:
     def take_all(self) -> List[Dict[str, Any]]:
         return list(self.iter_rows())
 
+    def random_sample(self, fraction: float,
+                      *, seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: Dataset.random_sample).
+        Seeded runs are deterministic without coordination: each
+        block's rng derives from (seed, a hash of the block's CONTENT),
+        so distinct blocks draw independent masks (equal-sized blocks
+        must not share one — that would correlate the sample across
+        the dataset)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1]: {fraction}")
+
+        def transform(block: Block) -> Block:
+            if seed is None:
+                rng = np.random.default_rng()
+            else:
+                import pandas as pd
+
+                content = int(pd.util.hash_pandas_object(
+                    block_to_pandas(block), index=False).sum()) \
+                    & 0x7FFFFFFFFFFFFFFF
+                rng = np.random.default_rng((seed, content))
+            keep = np.nonzero(
+                rng.random(block.num_rows) < fraction)[0]
+            return block.take(keep)
+
+        return self._with(MapStage(f"RandomSample({fraction})",
+                                   transform))
+
+    def take_batch(self, batch_size: int = 20,
+                   *, batch_format: str = "numpy"):
+        """First up-to-batch_size rows as ONE batch (reference:
+        Dataset.take_batch)."""
+        for batch in self.limit(batch_size).iter_batches(
+                batch_size=batch_size, batch_format=batch_format):
+            return batch
+        raise ValueError("dataset is empty")
+
     def count(self) -> int:
         return sum(b.num_rows for b in self.iter_blocks())
 

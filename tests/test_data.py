@@ -118,6 +118,34 @@ def test_groupby_std_and_map_groups():
                    2: (4, 2.0 + 5 + 8 + 11)}
 
 
+def test_random_sample_and_take_batch():
+    ds = rd.range(1000, parallelism=4)
+    ids1 = sorted(r["id"] for r in
+                  ds.random_sample(0.2, seed=7).take_all())
+    assert 120 < len(ids1) < 280, len(ids1)
+    # Deterministic under a seed: the exact same ROWS, not just count.
+    ids2 = sorted(r["id"] for r in
+                  ds.random_sample(0.2, seed=7).take_all())
+    assert ids1 == ids2
+    # Blocks draw INDEPENDENT masks: block 0's kept offsets must not
+    # repeat as block 1's (equal-sized blocks of 250 here).
+    sel = set(ids1)
+    off0 = {i for i in range(250) if i in sel}
+    off1 = {i - 250 for i in range(250, 500) if i in sel}
+    assert off0 != off1
+    assert ds.random_sample(0.0).count() == 0
+    assert ds.random_sample(1.0).count() == 1000
+
+    batch = rd.range(100).take_batch(10)
+    assert len(batch["id"]) == 10
+    import pandas as pd
+
+    df = rd.range(5).take_batch(50, batch_format="pandas")
+    assert isinstance(df, pd.DataFrame) and len(df) == 5
+    with pytest.raises(ValueError, match="empty"):
+        rd.from_items([]).take_batch(3)
+
+
 def test_global_aggregations_and_unique():
     vals = [float(i) for i in range(40)]
     ds = rd.from_items([{"v": v, "k": int(v) % 4} for v in vals],
