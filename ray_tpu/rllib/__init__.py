@@ -4,8 +4,8 @@ Parity target: the reference's rllib/ new API stack (AlgorithmConfig /
 Algorithm / EnvRunnerGroup / RLModule / Learner / LearnerGroup) with
 JAX/TPU learners and CPU env-runner actors. Algorithms: PPO (single and
 multi-agent), APPO, DQN, SAC, CQL, IMPALA, BC, MARWIL, DDPG, TD3, A2C, QMIX
-(cooperative multi-agent value decomposition), DreamerV3 (model-based),
-ES, ARS (evolution).
+(cooperative multi-agent value decomposition), AlphaZero (self-play
+MCTS), DreamerV3 (model-based), ES, ARS (evolution).
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
@@ -22,6 +22,7 @@ from ray_tpu.rllib.algorithms.ddpg import (DDPG, DDPGConfig, TD3,
                                            TD3Config)
 from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
+from ray_tpu.rllib.algorithms.alphazero import AlphaZero, AlphaZeroConfig
 from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig
 from ray_tpu.rllib.algorithms.multi_agent_ppo import (MultiAgentPPO,
@@ -50,6 +51,8 @@ __all__ = [
     "MARWILConfig",
     "A2C",
     "A2CConfig",
+    "AlphaZero",
+    "AlphaZeroConfig",
     "DDPG",
     "DDPGConfig",
     "TD3",

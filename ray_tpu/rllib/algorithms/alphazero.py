@@ -1,0 +1,417 @@
+"""AlphaZero — self-play MCTS with a learned policy/value net.
+
+Reference: rllib_contrib alpha_zero (Silver et al. 2017: PUCT tree
+search guided by a policy/value network, trained from self-play targets
+— visit-count policies pi and game outcomes z — no human data, no
+rollout heuristics).
+
+Shape here: the policy/value net is a jitted JAX MLP over the canonical
+(current-player) board; MCTS is host-side Python (tree control flow is
+data-dependent — the wrong shape for XLA; batched leaf evaluation rides
+one jit call); self-play games fill a replay of (state, pi, z) and ONE
+jitted step trains cross-entropy(policy, pi) + MSE(value, z). Built-in
+TicTacToe is the CI game (reference uses its own toy envs for tests).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import _mlp_apply, _mlp_init
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+from ray_tpu.tune.trainable import Trainable
+
+
+class TicTacToe:
+    """Two-player zero-sum board game in canonical form: the
+    observation always shows +1 for the player TO MOVE. Used by the
+    AlphaZero tests; any game exposing this interface plugs in."""
+
+    n_actions = 9
+    obs_dim = 9
+
+    def initial_state(self) -> np.ndarray:
+        return np.zeros(9, np.float32)
+
+    def legal_actions(self, state: np.ndarray) -> np.ndarray:
+        return np.nonzero(state == 0)[0]
+
+    def next_state(self, state: np.ndarray, action: int) -> np.ndarray:
+        """Apply the move for the player to move, then flip the canonical
+        view so the opponent becomes +1."""
+        nxt = state.copy()
+        nxt[action] = 1.0
+        return -nxt
+
+    def terminal_value(self, state: np.ndarray) -> Optional[float]:
+        """From the perspective of the player TO MOVE: -1 if the
+        opponent (who just moved) won, 0 draw, None if not terminal."""
+        b = state.reshape(3, 3)
+        lines = list(b) + list(b.T) + [np.diag(b), np.diag(b[:, ::-1])]
+        for line in lines:
+            if line.sum() == -3:
+                return -1.0  # opponent completed a line
+        if (state != 0).all():
+            return 0.0
+        return None
+
+
+class AlphaZeroConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.game: Any = TicTacToe
+        self.num_simulations: int = 32     # MCTS sims per move
+        self.c_puct: float = 1.5
+        self.dirichlet_alpha: float = 0.6
+        self.dirichlet_eps: float = 0.25
+        self.temperature_moves: int = 4    # sample pi early, argmax after
+        self.games_per_iteration: int = 8
+        self.replay_buffer_capacity: int = 20_000
+        self.train_batch_size = 128
+        self.updates_per_iteration: int = 8
+        self.value_loss_coeff: float = 1.0
+        self.lr = 3e-3
+
+    @property
+    def algo_class(self):
+        return AlphaZero
+
+
+class _MCTS:
+    """PUCT search over canonical states. Node key = state bytes."""
+
+    def __init__(self, game, predict, cfg, rng):
+        self.game = game
+        self.predict = predict     # state [obs] -> (priors [A], value)
+        self.cfg = cfg
+        self.rng = rng
+        self.P: Dict[bytes, np.ndarray] = {}
+        self.N: Dict[bytes, np.ndarray] = {}
+        self.W: Dict[bytes, np.ndarray] = {}
+
+    def _apply_root_noise(self, state: np.ndarray, key: bytes) -> None:
+        """Fresh Dirichlet noise on the CURRENT root's priors — every
+        move, not just on first expansion (with tree reuse across moves
+        the root is usually already expanded by the previous search)."""
+        legal = self.game.legal_actions(state)
+        if not len(legal):
+            return
+        priors = self.P[key]
+        noise = np.zeros(self.game.n_actions, np.float32)
+        noise[legal] = self.rng.dirichlet(
+            [self.cfg.dirichlet_alpha] * len(legal))
+        self.P[key] = (1 - self.cfg.dirichlet_eps) * priors + \
+            self.cfg.dirichlet_eps * noise
+
+    def policy(self, state: np.ndarray, add_noise: bool) -> np.ndarray:
+        if add_noise and state.tobytes() in self.P:
+            self._apply_root_noise(state, state.tobytes())
+        for _ in range(self.cfg.num_simulations):
+            self._simulate(state.copy(), root=state.tobytes(),
+                           add_noise=add_noise)
+        n = self.N[state.tobytes()]
+        total = n.sum()
+        if total == 0:
+            legal = self.game.legal_actions(state)
+            pi = np.zeros(self.game.n_actions, np.float32)
+            pi[legal] = 1.0 / len(legal)
+            return pi
+        return (n / total).astype(np.float32)
+
+    def _expand(self, state: np.ndarray, key: bytes,
+                add_noise: bool) -> float:
+        priors, value = self.predict(state)
+        legal = self.game.legal_actions(state)
+        mask = np.zeros(self.game.n_actions, np.float32)
+        mask[legal] = 1.0
+        priors = priors * mask
+        s = priors.sum()
+        priors = priors / s if s > 0 else mask / mask.sum()
+        if add_noise and len(legal):
+            noise = np.zeros(self.game.n_actions, np.float32)
+            noise[legal] = self.rng.dirichlet(
+                [self.cfg.dirichlet_alpha] * len(legal))
+            priors = (1 - self.cfg.dirichlet_eps) * priors + \
+                self.cfg.dirichlet_eps * noise
+        self.P[key] = priors
+        self.N[key] = np.zeros(self.game.n_actions, np.float32)
+        self.W[key] = np.zeros(self.game.n_actions, np.float32)
+        return float(value)
+
+    def _simulate(self, state: np.ndarray, root: bytes,
+                  add_noise: bool) -> None:
+        path: List[Tuple[bytes, int]] = []
+        value = None
+        while True:
+            key = state.tobytes()
+            term = self.game.terminal_value(state)
+            if term is not None:
+                value = term
+                break
+            if key not in self.P:
+                value = self._expand(state, key,
+                                     add_noise and key == root)
+                break
+            p, n, w = self.P[key], self.N[key], self.W[key]
+            q = np.where(n > 0, w / np.maximum(n, 1), 0.0)
+            u = self.cfg.c_puct * p * np.sqrt(n.sum() + 1) / (1 + n)
+            scores = q + u
+            legal = self.game.legal_actions(state)
+            action = legal[np.argmax(scores[legal])]
+            path.append((key, int(action)))
+            state = self.game.next_state(state, int(action))
+        # Backup: value is from the LEAF player's perspective; each step
+        # up the tree flips sides.
+        for key, action in reversed(path):
+            value = -value
+            self.N[key][action] += 1
+            self.W[key][action] += value
+
+
+class AlphaZero(Trainable):
+    config_class = AlphaZeroConfig
+
+    def setup(self, config) -> None:
+        import jax
+        import optax
+
+        self.config = config if isinstance(config, AlphaZeroConfig) \
+            else AlphaZeroConfig().update_from_dict(dict(config or {}))
+        cfg = self.config
+        self.game = cfg.game() if isinstance(cfg.game, type) else cfg.game
+        obs_dim, n_actions = self.game.obs_dim, self.game.n_actions
+        hidden = tuple(cfg.model.get("fcnet_hiddens", (64, 64))) \
+            if cfg.model else (64, 64)
+
+        rng = jax.random.PRNGKey(cfg.seed)
+        k_torso, k_pi, k_v = jax.random.split(rng, 3)
+        self.params = {
+            "torso": _mlp_init(k_torso, (obs_dim,) + hidden),
+            "pi": _mlp_init(k_pi, (hidden[-1], n_actions)),
+            "v": _mlp_init(k_v, (hidden[-1], 1)),
+        }
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adam(cfg.lr))
+        self.opt_state = self.optimizer.init(self.params)
+        self._replay = ReplayBuffer(cfg.replay_buffer_capacity,
+                                    seed=cfg.seed)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._predict_fn = None
+        self._step_fn = None
+        self._iteration = 0
+        self._games_played = 0
+        # Transposition cache: small games revisit states constantly;
+        # cleared whenever params change (_update, load_checkpoint).
+        self._predict_cache: Dict[bytes, tuple] = {}
+
+    # ---- network ----
+
+    def _forward(self, params, obs):
+        import jax
+        import jax.numpy as jnp
+
+        feat = _mlp_apply(params["torso"], obs, final_activation=True)
+        logits = _mlp_apply(params["pi"], feat)
+        value = jnp.tanh(_mlp_apply(params["v"], feat))[..., 0]
+        return logits, value
+
+    def _predict(self, state: np.ndarray):
+        import jax
+
+        key = state.tobytes()
+        hit = self._predict_cache.get(key)
+        if hit is not None:
+            return hit
+        if self._predict_fn is None:
+            def f(params, obs):
+                logits, value = self._forward(params, obs[None])
+                return jax.nn.softmax(logits)[0], value[0]
+
+            self._predict_fn = jax.jit(f)
+        priors, value = self._predict_fn(self.params, state)
+        out = (np.asarray(priors), float(value))
+        self._predict_cache[key] = out
+        return out
+
+    # ---- self-play ----
+
+    def _self_play_game(self) -> List[tuple]:
+        cfg = self.config
+        mcts = _MCTS(self.game, self._predict, cfg, self._rng)
+        state = self.game.initial_state()
+        history: List[Tuple[np.ndarray, np.ndarray]] = []
+        move = 0
+        while True:
+            term = self.game.terminal_value(state)
+            if term is not None:
+                # term is from the to-move player's perspective; walk
+                # back flipping sides.
+                rows = []
+                z = term
+                for obs, pi in reversed(history):
+                    z = -z
+                    rows.append((obs, pi, np.float32(z)))
+                return rows
+            pi = mcts.policy(state, add_noise=True)
+            history.append((state.copy(), pi))
+            if move < cfg.temperature_moves:
+                action = int(self._rng.choice(len(pi), p=pi))
+            else:
+                action = int(np.argmax(pi))
+            state = self.game.next_state(state, action)
+            move += 1
+
+    # ---- learning ----
+
+    def _loss(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        logits, value = self._forward(params, batch["obs"])
+        logp = jax.nn.log_softmax(logits)
+        policy_loss = -(batch["pi"] * logp).sum(-1).mean()
+        value_loss = ((value - batch["z"]) ** 2).mean()
+        total = policy_loss + \
+            self.config.value_loss_coeff * value_loss
+        return total, {"policy_loss": policy_loss,
+                       "value_loss": value_loss}
+
+    def _update(self, batch) -> Dict[str, float]:
+        import jax
+        import optax
+
+        if self._step_fn is None:
+            def step(params, opt_state, batch):
+                (loss, metrics), grads = jax.value_and_grad(
+                    self._loss, has_aux=True)(params, batch)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, metrics
+
+            self._step_fn = jax.jit(step)
+        self.params, self.opt_state, metrics = self._step_fn(
+            self.params, self.opt_state, batch)
+        self._predict_cache.clear()
+        return {k: float(v) for k, v in metrics.items()}
+
+    # ---- Trainable ----
+
+    def step(self) -> Dict[str, Any]:
+        cfg = self.config
+        new_rows = 0
+        for _ in range(cfg.games_per_iteration):
+            rows = self._self_play_game()
+            self._games_played += 1
+            new_rows += len(rows)
+            self._replay.add(SampleBatch({
+                "obs": np.stack([r[0] for r in rows]),
+                "pi": np.stack([r[1] for r in rows]),
+                "z": np.stack([r[2] for r in rows]),
+            }))
+        metrics: Dict[str, Any] = {
+            "games_played": self._games_played,
+            "replay_size": len(self._replay),
+            "new_rows": new_rows,
+        }
+        if len(self._replay) >= cfg.train_batch_size:
+            for _ in range(cfg.updates_per_iteration):
+                batch = dict(self._replay.sample(cfg.train_batch_size))
+                metrics.update(self._update(batch))
+        self._iteration += 1
+        metrics["training_iteration"] = self._iteration
+        return metrics
+
+    def save_checkpoint(self, checkpoint_dir: str) -> str:
+        import os
+        import pickle
+
+        import jax
+
+        with open(os.path.join(checkpoint_dir, "az_state.pkl"),
+                  "wb") as f:
+            pickle.dump({
+                "params": jax.tree_util.tree_map(
+                    np.asarray, self.params),
+                "opt_state": jax.tree_util.tree_map(
+                    np.asarray, self.opt_state),
+                "games_played": self._games_played,
+                "iteration": self._iteration,
+            }, f)
+        return checkpoint_dir
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        import os
+        import pickle
+
+        import jax
+        import jax.numpy as jnp
+
+        with open(os.path.join(checkpoint_dir, "az_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        self.params = jax.tree_util.tree_map(jnp.asarray,
+                                             state["params"])
+        self.opt_state = jax.tree_util.tree_map(jnp.asarray,
+                                                state["opt_state"])
+        self._games_played = state["games_played"]
+        self._iteration = state["iteration"]
+        self._predict_fn = None
+        self._step_fn = None
+        # Restored params invalidate any cached net outputs.
+        self._predict_cache.clear()
+
+    def cleanup(self) -> None:
+        pass
+
+    stop = cleanup
+
+    # ---- evaluation ----
+
+    def play_vs_random(self, num_games: int = 20,
+                       simulations: Optional[int] = None
+                       ) -> Dict[str, float]:
+        """Agent (MCTS, no noise) vs a uniform-random opponent,
+        alternating who moves first. Returns win/draw/loss rates from
+        the agent's perspective."""
+        cfg = self.config
+        sims = simulations if simulations is not None \
+            else cfg.num_simulations
+        wins = draws = losses = 0
+        rng = np.random.default_rng(123)
+        for g in range(num_games):
+            mcts = _MCTS(self.game, self._predict, cfg, rng)
+            state = self.game.initial_state()
+            agent_to_move = (g % 2 == 0)
+            while True:
+                term = self.game.terminal_value(state)
+                if term is not None:
+                    # term: to-move player's result. agent_to_move says
+                    # whose perspective that is.
+                    if term == 0:
+                        draws += 1
+                    elif (term < 0) == agent_to_move:
+                        losses += 1
+                    else:
+                        wins += 1
+                    break
+                legal = self.game.legal_actions(state)
+                if agent_to_move:
+                    for _ in range(sims):
+                        mcts._simulate(state.copy(),
+                                       root=state.tobytes(),
+                                       add_noise=False)
+                    n = mcts.N[state.tobytes()]
+                    action = legal[np.argmax(n[legal])]
+                else:
+                    action = rng.choice(legal)
+                state = self.game.next_state(state, int(action))
+                agent_to_move = not agent_to_move
+        return {"win_rate": wins / num_games,
+                "draw_rate": draws / num_games,
+                "loss_rate": losses / num_games}

@@ -1,0 +1,76 @@
+"""AlphaZero tests.
+
+Reference test model: rllib_contrib alpha_zero CI — self-play learning
+on a toy game plus component checks (game rules, MCTS backup,
+checkpoint round-trip).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.algorithms.alphazero import (AlphaZero,
+                                                AlphaZeroConfig,
+                                                TicTacToe)
+
+
+def test_tictactoe_canonical_rules():
+    g = TicTacToe()
+    s = g.initial_state()
+    assert g.terminal_value(s) is None
+    assert len(g.legal_actions(s)) == 9
+    # X takes 0,1,2 (top row): after X's last move the canonical view
+    # flips, and the player to move sees the opponent's -3 line.
+    s = g.next_state(s, 0)   # X plays 0 -> O to move
+    s = g.next_state(s, 4)   # O plays 4 -> X to move
+    s = g.next_state(s, 1)
+    s = g.next_state(s, 5)
+    s = g.next_state(s, 2)   # X completes the row
+    assert g.terminal_value(s) == -1.0  # to-move player (O) lost
+    # Draw: full board, no line.
+    draw = np.array([1, 1, -1, -1, -1, 1, 1, 1, -1], np.float32)
+    assert g.terminal_value(draw) == 0.0
+
+
+def test_alphazero_learns_tictactoe():
+    """25 iterations of self-play: full-strength play nearly stops
+    losing to random (probe: loss 20% -> 3%), and the NET itself
+    improves (low-simulation play, where priors dominate search,
+    loses materially less than untrained)."""
+    algo = AlphaZeroConfig().debugging(seed=0).build_algo()
+    pre_net = algo.play_vs_random(30, simulations=4)
+    for _ in range(25):
+        result = algo.step()
+    assert np.isfinite(result["policy_loss"])
+    post_full = algo.play_vs_random(30)
+    assert post_full["loss_rate"] <= 0.15, post_full
+    assert post_full["win_rate"] >= 0.75, post_full
+    post_net = algo.play_vs_random(30, simulations=4)
+    assert post_net["loss_rate"] < pre_net["loss_rate"] - 0.1, \
+        (pre_net, post_net)
+
+
+def test_alphazero_checkpoint_roundtrip(tmp_path):
+    import os
+
+    from jax.flatten_util import ravel_pytree
+
+    cfg = (AlphaZeroConfig()
+           .training(games_per_iteration=2, updates_per_iteration=2,
+                     train_batch_size=16)
+           .debugging(seed=1))
+    algo = cfg.build_algo()
+    for _ in range(3):
+        algo.step()
+    d = str(tmp_path / "ckpt")
+    os.makedirs(d, exist_ok=True)
+    algo.save_checkpoint(d)
+    flat, _ = ravel_pytree(algo.params)
+    games = algo._games_played
+
+    algo2 = cfg.copy().build_algo()
+    algo2.load_checkpoint(d)
+    flat2, _ = ravel_pytree(algo2.params)
+    np.testing.assert_allclose(np.asarray(flat), np.asarray(flat2))
+    assert algo2._games_played == games
+    r = algo2.step()
+    assert r["games_played"] == games + 2
