@@ -172,6 +172,104 @@ class _MCTS:
             self.W[key][action] += value
 
 
+def _az_forward(params, obs):
+    """Policy/value net forward — module-level so self-play workers can
+    receive it pickled."""
+    import jax.numpy as jnp
+
+    feat = _mlp_apply(params["torso"], obs, final_activation=True)
+    logits = _mlp_apply(params["pi"], feat)
+    value = jnp.tanh(_mlp_apply(params["v"], feat))[..., 0]
+    return logits, value
+
+
+class _NetPredictor:
+    """jit + softmax + transposition cache around a forward fn. Shared
+    by the driver and the remote self-play workers so inference
+    semantics can't drift between the local and distributed paths."""
+
+    def __init__(self, forward_fn):
+        self._forward = forward_fn
+        self._fn = None
+        self._cache: Dict[bytes, tuple] = {}
+        self._params = None
+
+    def set_params(self, params) -> None:
+        self._params = params
+        self._cache.clear()
+
+    def __call__(self, state: np.ndarray):
+        import jax
+
+        key = state.tobytes()
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        if self._fn is None:
+            def f(params, obs):
+                logits, value = self._forward(params, obs[None])
+                return jax.nn.softmax(logits)[0], value[0]
+
+            self._fn = jax.jit(f)
+        priors, value = self._fn(self._params, state)
+        out = (np.asarray(priors), float(value))
+        self._cache[key] = out
+        return out
+
+
+def _play_one_game(game, predict, cfg, rng) -> List[tuple]:
+    """One self-play game: MCTS policies as targets, outcome z walked
+    back with per-move sign flips. THE self-play rules — used by both
+    the driver loop and the remote workers."""
+    mcts = _MCTS(game, predict, cfg, rng)
+    state = game.initial_state()
+    history: List[Tuple[np.ndarray, np.ndarray]] = []
+    rows: List[tuple] = []
+    move = 0
+    while True:
+        term = game.terminal_value(state)
+        if term is not None:
+            z = term
+            for obs, pi in reversed(history):
+                z = -z
+                rows.append((obs, pi, np.float32(z)))
+            return rows
+        pi = mcts.policy(state, add_noise=True)
+        history.append((state.copy(), pi))
+        if move < cfg.temperature_moves:
+            action = int(rng.choice(len(pi), p=pi))
+        else:
+            action = int(np.argmax(pi))
+        state = game.next_state(state, action)
+        move += 1
+
+
+class AlphaZeroSelfPlayWorker:
+    """Remote self-play worker: plays whole games with shipped params
+    (own MCTS + jitted net) and returns (obs, pi, z) rows. Games are
+    independent, so self-play parallelizes perfectly."""
+
+    def __init__(self, config: dict, worker_index: int):
+        cfg = AlphaZeroConfig().update_from_dict(config)
+        self.cfg = cfg
+        self.game = cfg.game() if isinstance(cfg.game, type) else cfg.game
+        self._rng = np.random.default_rng(cfg.seed * 1000 + worker_index)
+        self._predictor = _NetPredictor(config["forward_fn"])
+
+    def play(self, params, num_games: int) -> tuple:
+        self._predictor.set_params(params)
+        all_rows: List[tuple] = []
+        for _ in range(num_games):
+            all_rows.extend(_play_one_game(
+                self.game, self._predictor, self.cfg, self._rng))
+        return (np.stack([r[0] for r in all_rows]),
+                np.stack([r[1] for r in all_rows]),
+                np.stack([r[2] for r in all_rows]), num_games)
+
+    def ping(self) -> bool:
+        return True
+
+
 class AlphaZero(Trainable):
     config_class = AlphaZeroConfig
 
@@ -201,70 +299,45 @@ class AlphaZero(Trainable):
         self._replay = ReplayBuffer(cfg.replay_buffer_capacity,
                                     seed=cfg.seed)
         self._rng = np.random.default_rng(cfg.seed)
-        self._predict_fn = None
         self._step_fn = None
         self._iteration = 0
         self._games_played = 0
-        # Transposition cache: small games revisit states constantly;
-        # cleared whenever params change (_update, load_checkpoint).
-        self._predict_cache: Dict[bytes, tuple] = {}
+        # Shared inference wrapper (jit + softmax + transposition
+        # cache); set_params clears the cache on every params change.
+        self._predictor = _NetPredictor(_az_forward)
+        self._predictor.set_params(self.params)
+        # Distributed self-play (num_env_runners > 0): games are
+        # independent, so whole games fan out to remote workers that
+        # get fresh params each iteration (QMIX-collector pattern).
+        self._worker_manager = None
+        if cfg.num_env_runners > 0:
+            import ray_tpu
+            from ray_tpu.rllib.utils.actor_manager import \
+                FaultTolerantActorManager
+
+            worker_cfg = dict(cfg.to_dict())
+            worker_cfg["forward_fn"] = _az_forward
+            cls = ray_tpu.remote(AlphaZeroSelfPlayWorker)
+
+            def factory(i: int):
+                return cls.options(
+                    num_cpus=cfg.num_cpus_per_env_runner,
+                    max_restarts=1).remote(worker_cfg, i + 1)
+
+            self._worker_manager = FaultTolerantActorManager(
+                [factory(i) for i in range(cfg.num_env_runners)],
+                factory)
 
     # ---- network ----
 
     def _forward(self, params, obs):
-        import jax
-        import jax.numpy as jnp
-
-        feat = _mlp_apply(params["torso"], obs, final_activation=True)
-        logits = _mlp_apply(params["pi"], feat)
-        value = jnp.tanh(_mlp_apply(params["v"], feat))[..., 0]
-        return logits, value
-
-    def _predict(self, state: np.ndarray):
-        import jax
-
-        key = state.tobytes()
-        hit = self._predict_cache.get(key)
-        if hit is not None:
-            return hit
-        if self._predict_fn is None:
-            def f(params, obs):
-                logits, value = self._forward(params, obs[None])
-                return jax.nn.softmax(logits)[0], value[0]
-
-            self._predict_fn = jax.jit(f)
-        priors, value = self._predict_fn(self.params, state)
-        out = (np.asarray(priors), float(value))
-        self._predict_cache[key] = out
-        return out
+        return _az_forward(params, obs)
 
     # ---- self-play ----
 
     def _self_play_game(self) -> List[tuple]:
-        cfg = self.config
-        mcts = _MCTS(self.game, self._predict, cfg, self._rng)
-        state = self.game.initial_state()
-        history: List[Tuple[np.ndarray, np.ndarray]] = []
-        move = 0
-        while True:
-            term = self.game.terminal_value(state)
-            if term is not None:
-                # term is from the to-move player's perspective; walk
-                # back flipping sides.
-                rows = []
-                z = term
-                for obs, pi in reversed(history):
-                    z = -z
-                    rows.append((obs, pi, np.float32(z)))
-                return rows
-            pi = mcts.policy(state, add_noise=True)
-            history.append((state.copy(), pi))
-            if move < cfg.temperature_moves:
-                action = int(self._rng.choice(len(pi), p=pi))
-            else:
-                action = int(np.argmax(pi))
-            state = self.game.next_state(state, action)
-            move += 1
+        return _play_one_game(self.game, self._predictor, self.config,
+                              self._rng)
 
     # ---- learning ----
 
@@ -297,7 +370,7 @@ class AlphaZero(Trainable):
             self._step_fn = jax.jit(step)
         self.params, self.opt_state, metrics = self._step_fn(
             self.params, self.opt_state, batch)
-        self._predict_cache.clear()
+        self._predictor.set_params(self.params)
         return {k: float(v) for k, v in metrics.items()}
 
     # ---- Trainable ----
@@ -305,20 +378,26 @@ class AlphaZero(Trainable):
     def step(self) -> Dict[str, Any]:
         cfg = self.config
         new_rows = 0
-        for _ in range(cfg.games_per_iteration):
-            rows = self._self_play_game()
-            self._games_played += 1
-            new_rows += len(rows)
-            self._replay.add(SampleBatch({
-                "obs": np.stack([r[0] for r in rows]),
-                "pi": np.stack([r[1] for r in rows]),
-                "z": np.stack([r[2] for r in rows]),
-            }))
+        if self._worker_manager is not None:
+            new_rows = self._distributed_self_play()
+        else:
+            for _ in range(cfg.games_per_iteration):
+                rows = self._self_play_game()
+                self._games_played += 1
+                new_rows += len(rows)
+                self._replay.add(SampleBatch({
+                    "obs": np.stack([r[0] for r in rows]),
+                    "pi": np.stack([r[1] for r in rows]),
+                    "z": np.stack([r[2] for r in rows]),
+                }))
         metrics: Dict[str, Any] = {
             "games_played": self._games_played,
             "replay_size": len(self._replay),
             "new_rows": new_rows,
         }
+        if self._worker_manager is not None:
+            metrics["num_self_play_workers"] = \
+                self._worker_manager.num_healthy_actors()
         if len(self._replay) >= cfg.train_batch_size:
             for _ in range(cfg.updates_per_iteration):
                 batch = dict(self._replay.sample(cfg.train_batch_size))
@@ -326,6 +405,33 @@ class AlphaZero(Trainable):
         self._iteration += 1
         metrics["training_iteration"] = self._iteration
         return metrics
+
+    def _distributed_self_play(self) -> int:
+        import jax
+
+        import ray_tpu
+
+        cfg = self.config
+        mgr = self._worker_manager
+        mgr.probe_unhealthy()
+        ids = mgr.healthy_actor_ids()
+        if not ids:
+            raise RuntimeError("all self-play workers are dead")
+        total, n = cfg.games_per_iteration, len(ids)
+        shards = {wid: total // n + (1 if k < total % n else 0)
+                  for k, wid in enumerate(ids)}
+        params_ref = ray_tpu.put(
+            jax.tree_util.tree_map(np.asarray, self.params))
+        results = mgr.foreach_sharded(
+            lambda a, games: a.play.remote(params_ref, games),
+            {wid: g for wid, g in shards.items() if g > 0})
+        new_rows = 0
+        for _, (obs, pi, z, games) in results.ok:
+            self._replay.add(SampleBatch(
+                {"obs": obs, "pi": pi, "z": z}))
+            new_rows += len(z)
+            self._games_played += games
+        return new_rows
 
     def save_checkpoint(self, checkpoint_dir: str) -> str:
         import os
@@ -361,13 +467,14 @@ class AlphaZero(Trainable):
                                                 state["opt_state"])
         self._games_played = state["games_played"]
         self._iteration = state["iteration"]
-        self._predict_fn = None
         self._step_fn = None
         # Restored params invalidate any cached net outputs.
-        self._predict_cache.clear()
+        self._predictor.set_params(self.params)
 
     def cleanup(self) -> None:
-        pass
+        if self._worker_manager is not None:
+            self._worker_manager.shutdown()
+            self._worker_manager = None
 
     stop = cleanup
 
@@ -385,7 +492,7 @@ class AlphaZero(Trainable):
         wins = draws = losses = 0
         rng = np.random.default_rng(123)
         for g in range(num_games):
-            mcts = _MCTS(self.game, self._predict, cfg, rng)
+            mcts = _MCTS(self.game, self._predictor, cfg, rng)
             state = self.game.initial_state()
             agent_to_move = (g % 2 == 0)
             while True:

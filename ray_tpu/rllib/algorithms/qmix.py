@@ -478,13 +478,7 @@ class QMIX(Trainable):
 
     def cleanup(self) -> None:
         if self._worker_manager is not None:
-            import ray_tpu
-
-            for i in list(self._worker_manager._actors):
-                try:
-                    ray_tpu.kill(self._worker_manager.actor(i))
-                except Exception:
-                    pass
+            self._worker_manager.shutdown()
             self._worker_manager = None
 
     stop = cleanup

@@ -99,6 +99,16 @@ class FaultTolerantActorManager:
         logger.warning("actor %d failed: %s", actor_id, error)
         self._healthy[actor_id] = False
 
+    def shutdown(self) -> None:
+        """Kill every managed actor (best-effort) and drop the set."""
+        for i in list(self._actors):
+            try:
+                ray_tpu.kill(self._actors[i])
+            except Exception:
+                pass
+        self._actors.clear()
+        self._healthy.clear()
+
     def probe_unhealthy(self) -> List[int]:
         """Ping unhealthy actors; recreate dead ones via the factory.
         Returns ids restored this call (caller re-syncs their state)."""

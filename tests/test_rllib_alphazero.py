@@ -49,6 +49,25 @@ def test_alphazero_learns_tictactoe():
         (pre_net, post_net)
 
 
+def test_alphazero_distributed_self_play(ray_start_regular):
+    """num_env_runners > 0: whole self-play games fan out to remote
+    workers; learning still reaches near-unbeatable full-strength
+    play."""
+    cfg = (AlphaZeroConfig()
+           .env_runners(num_env_runners=2)
+           .debugging(seed=0))
+    algo = cfg.build_algo()
+    try:
+        for _ in range(20):
+            result = algo.step()
+        assert result["num_self_play_workers"] == 2
+        assert result["games_played"] == 20 * 8
+        ev = algo.play_vs_random(20)
+        assert ev["loss_rate"] <= 0.2, ev
+    finally:
+        algo.cleanup()
+
+
 def test_alphazero_checkpoint_roundtrip(tmp_path):
     import os
 
