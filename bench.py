@@ -32,6 +32,8 @@ PEAK_BF16_FLOPS = {
 }
 
 TRIALS = 5
+MAX_TRIALS = 7          # extend past TRIALS while spread stays high
+SPREAD_TARGET_PCT = 20.0
 
 
 def _detect_peak() -> float:
@@ -53,21 +55,25 @@ def _detect_peak() -> float:
     return PEAK_BF16_FLOPS["v5e"]
 
 
-def _quiesce(max_wait_s: float = 90.0, threshold: float = 1.5) -> float:
+def _quiesce(max_wait_s: float = 90.0, threshold: float = 1.5) -> dict:
     """Wait (bounded) for ambient host load to settle before timing: the
     host CPU feeds the TPU, and co-tenant load halves measured MFU
-    (round-3 verdict). Returns the load at timing start."""
-    deadline = time.monotonic() + max_wait_s
-    load = 0.0
-    while time.monotonic() < deadline:
-        try:
-            load = os.getloadavg()[0]
-        except OSError:
-            return 0.0
-        if load < threshold:
-            return load
+    (round-3 verdict). Returns what the gate saw (initial/final load,
+    seconds waited, whether it gave up) so round verdicts can tell a
+    quiet run from a contaminated one."""
+    t0 = time.monotonic()
+    deadline = t0 + max_wait_s
+    try:
+        first = load = os.getloadavg()[0]
+    except OSError:
+        return {"load": 0.0, "load_initial": 0.0, "waited_s": 0.0,
+                "settled": True}
+    while load >= threshold and time.monotonic() < deadline:
         time.sleep(5.0)
-    return load
+        load = os.getloadavg()[0]
+    return {"load": load, "load_initial": first,
+            "waited_s": round(time.monotonic() - t0, 1),
+            "settled": load < threshold}
 
 
 def _bench_config(cfg, batch_size: int, seq_len: int, steps: int,
@@ -96,14 +102,25 @@ def _bench_config(cfg, batch_size: int, seq_len: int, steps: int,
     params, opt_state, metrics = step_fn(params, opt_state, batch)
     loss_before = float(metrics["loss"])
 
-    rates = []
-    for _ in range(trials):
+    def one_trial():
+        nonlocal params, opt_state, metrics
         t0 = time.perf_counter()
         for _ in range(steps):
             params, opt_state, metrics = step_fn(params, opt_state, batch)
         jax.block_until_ready(metrics["loss"])
-        rates.append(batch_size * seq_len * steps /
-                     (time.perf_counter() - t0))
+        return batch_size * seq_len * steps / (time.perf_counter() - t0)
+
+    def spread_pct(rs):
+        return ((max(rs) - min(rs)) / max(rs) * 100.0) if max(rs) else 0.0
+
+    rates = [one_trial() for _ in range(trials)]
+    # Adaptive extension (round-4 verdict: 38-48% spread made round
+    # medians robust only by luck): while the spread stays above target
+    # and the budget allows, take more trials — the median over more
+    # samples is what gets reported either way.
+    while (trials > 1 and len(rates) < MAX_TRIALS
+           and spread_pct(rates) > SPREAD_TARGET_PCT):
+        rates.append(one_trial())
     # Execution sanity: training on a fixed batch must move the loss; a
     # degraded remote-execution path that no-ops steps would otherwise
     # report absurd throughput.
@@ -114,15 +131,14 @@ def _bench_config(cfg, batch_size: int, seq_len: int, steps: int,
             "remote TPU path degraded; rerun")
 
     tokens_per_sec = statistics.median(rates)
-    spread = ((max(rates) - min(rates)) / max(rates) * 100.0
-              if max(rates) else 0.0)
     flops_per_token = llama_flops_per_token(cfg, seq_len)
     mfu = (tokens_per_sec * flops_per_token / len(devices)) / peak * 100.0
     return {
         "mfu": round(mfu, 2),
         "tokens_per_sec_per_chip": round(tokens_per_sec / len(devices)),
         "model_params": cfg.num_params(),
-        "trial_spread_pct": round(spread, 2),
+        "trial_spread_pct": round(spread_pct(rates), 2),
+        "trials_taken": len(rates),
         "loss": loss_after,
     }
 
@@ -135,7 +151,8 @@ def main():
 
     on_tpu = jax.default_backend() == "tpu"
     peak = _detect_peak()
-    load = _quiesce() if on_tpu else 0.0
+    gate = _quiesce() if on_tpu else {"load": 0.0, "load_initial": 0.0,
+                                      "waited_s": 0.0, "settled": True}
 
     if on_tpu:
         devices = jax.devices()[:1]
@@ -173,7 +190,9 @@ def main():
         "tokens_per_sec_per_chip": base["tokens_per_sec_per_chip"],
         "model_params": base["model_params"],
         "trial_spread_pct": base["trial_spread_pct"],
-        "host_load_at_start": round(load, 2),
+        "trials_taken": base.get("trials_taken", 1),
+        "host_load_at_start": round(gate["load"], 2),
+        "load_gate": gate,
         "backend": jax.default_backend(),
         "loss": base["loss"],
     }

@@ -1696,8 +1696,14 @@ class CoreWorker:
                 await st.register_done.wait()
                 if st.register_error is not None:
                     raise st.register_error
+            # maybe_pending: a handle this worker did NOT register
+            # (deserialized from another process) can race the
+            # creator's fire-and-forget registration — ask the GCS for
+            # a short existence grace. Locally registered handles just
+            # awaited the ack above, so unknown means nonexistent.
             view = await self.gcs.call("wait_actor_alive", {
-                "actor_id": actor_id.binary(), "timeout": 60.0}, timeout=65.0)
+                "actor_id": actor_id.binary(), "timeout": 60.0,
+                "maybe_pending": st.register_done is None}, timeout=65.0)
             if view is None:
                 raise ser.ActorDiedError(f"actor {actor_id} does not exist")
             st.state = view["state"]
@@ -2381,23 +2387,38 @@ class CoreWorker:
             await self._end_task(exclusive)
 
     async def _execute_actor_creation(self, spec: TaskSpec) -> dict:
+        _trace = os.environ.get("RAY_TPU_TRACE_STARTUP")
+        _t0 = time.monotonic()
+
+        def _tr(msg):
+            if _trace:
+                print(f"CRTRACE {os.getpid()} +{time.monotonic()-_t0:.3f}"
+                      f" {msg}", flush=True)
+
         try:
             # Actor workers are dedicated to their actor: apply the env
             # permanently (visible to sync AND async methods, no
             # save/restore races under max_concurrency>1) — and BEFORE
             # unpickling, whose payloads may reference shipped modules.
+            # Module-level import would also work, but the fork template
+            # pre-imports runtime_env (forkserver.py) so this lazy form
+            # stays free while keeping driver-side import light.
             from ray_tpu._private.runtime_env import \
                 apply_runtime_env_permanent
 
             await self._prefetch_runtime_env(spec.runtime_env)
             apply_runtime_env_permanent(spec.runtime_env,
                                         self._sync_gcs_call)
+            _tr("env applied")
             cls = await self._fetch_function(spec.function)
+            _tr("function fetched")
             args, kwargs = await self._resolve_args(spec)
+            _tr("args resolved")
             creation = spec.actor_creation_spec or {}
             max_concurrency = creation.get("max_concurrency", 1)
             instance = await self._run_sync(
                 lambda: self._execute_user_code(cls, args, kwargs))
+            _tr("user init done")
             self._local_actor = _LocalActor(instance, max_concurrency)
             self._local_actor_id = spec.actor_id
             if max_concurrency > 1:
@@ -2411,6 +2432,7 @@ class CoreWorker:
                 "fast_address": self.fast_address,
                 "node_id": self.node_id.binary() if self.node_id else b"",
             })
+            _tr("actor_ready acked")
             if not accepted:
                 # The actor was killed while its creation was in flight:
                 # this dedicated worker must not linger holding the

@@ -194,6 +194,10 @@ class GcsServer:
         # racing a pipelined registration) — see handle_kill_actor.
         # Insertion-ordered dict: pruning evicts oldest-first.
         self._kill_tombstones: Dict[ActorID, bool] = {}
+        # wait_actor_alive wakeups: one Event per actor id with waiters,
+        # fired (and dropped) on every state-affecting transition so
+        # waiters re-check instead of polling on a 20 ms timer.
+        self._actor_waiters: Dict[ActorID, asyncio.Event] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self.jobs: Dict[JobID, dict] = {}
@@ -301,6 +305,13 @@ class GcsServer:
     def _persist_actor(self, actor: ActorInfo) -> None:
         self.storage.put("actors", actor.actor_id.binary(),
                          actor.to_store())
+
+    def _wake_actor_waiters(self, actor_id: ActorID) -> None:
+        """Wake every wait_actor_alive blocked on this id; the event is
+        single-use (waiters still unsatisfied re-arm a fresh one)."""
+        ev = self._actor_waiters.pop(actor_id, None)
+        if ev is not None:
+            ev.set()
 
     def _persist_node(self, node: NodeInfo) -> None:
         self.storage.put("nodes", node.node_id.binary(), node.view())
@@ -559,6 +570,7 @@ class GcsServer:
             info.death_cause = "killed via kill() before registration"
             self.actors[actor_id] = info
             self._persist_actor(info)
+            self._wake_actor_waiters(actor_id)
             return {"ok": True}
         if info.name:
             key = (info.namespace, info.name)
@@ -568,6 +580,7 @@ class GcsServer:
             self.named_actors[key] = actor_id
         self.actors[actor_id] = info
         self._persist_actor(info)
+        self._wake_actor_waiters(actor_id)  # id now known: grace-waiters re-check
         asyncio.get_running_loop().create_task(self._schedule_actor(info))
         return {"ok": True}
 
@@ -682,6 +695,7 @@ class GcsServer:
         actor.fast_address = data.get("fast_address", "")
         actor.node_id = NodeID(data["node_id"])
         self._persist_actor(actor)
+        self._wake_actor_waiters(actor.actor_id)
         await self.publish("actors", actor.view())
         return True
 
@@ -740,6 +754,7 @@ class GcsServer:
                 self._persist_actor(actor)  # durable tombstone
             else:
                 self.storage.delete("actors", actor.actor_id.binary())
+            self._wake_actor_waiters(actor.actor_id)
             await self.publish("actors", actor.view())
 
     async def handle_get_actor_info(self, data, conn):
@@ -753,24 +768,47 @@ class GcsServer:
 
     async def handle_wait_actor_alive(self, data, conn):
         """Block until the actor is ALIVE or DEAD (bounded by client
-        timeout). Unknown ids get a short existence grace: with
-        pipelined registration, a handle can cross processes and reach
-        here BEFORE the creator's fire-and-forget register_actor lands —
-        only after the grace does "unknown" mean "does not exist"."""
+        timeout). Unknown ids get a short existence grace ONLY when the
+        caller flags the registration as possibly in flight
+        (maybe_pending): with pipelined registration, a handle can cross
+        processes and reach here BEFORE the creator's fire-and-forget
+        register_actor lands — only after the grace does "unknown" mean
+        "does not exist". Callers that registered the actor themselves
+        (and so already awaited the ack) get an immediate None for
+        unknown ids; long-dead actors hit their durable DEAD tombstone
+        and return immediately either way."""
         actor_id = ActorID(data["actor_id"])
         now = time.monotonic()
         deadline = now + data.get("timeout", 60.0)
-        exist_grace = min(now + 2.0, deadline)
-        while time.monotonic() < deadline:
+        grace = 2.0 if data.get("maybe_pending") else 0.0
+        exist_grace = min(now + grace, deadline)
+        while True:
             actor = self.actors.get(actor_id)
+            now = time.monotonic()
             if actor is None:
-                if time.monotonic() >= exist_grace:
+                if now >= exist_grace:
+                    # Nonexistent id: wake (and drop) any co-waiters so
+                    # the event doesn't leak for ids that never register.
+                    self._wake_actor_waiters(actor_id)
                     return None
+                wait_until = exist_grace
             elif actor.state in (ALIVE, DEAD):
                 return actor.view()
-            await asyncio.sleep(0.02)
-        actor = self.actors.get(actor_id)
-        return actor.view() if actor else None
+            elif now >= deadline:
+                return actor.view()
+            else:
+                wait_until = deadline
+            # Event-driven: transitions fire _wake_actor_waiters, so the
+            # answer lands one loop turn after actor_ready instead of on
+            # a polling tick.
+            ev = self._actor_waiters.get(actor_id)
+            if ev is None:
+                ev = self._actor_waiters[actor_id] = asyncio.Event()
+            try:
+                await asyncio.wait_for(
+                    ev.wait(), max(wait_until - time.monotonic(), 0.001))
+            except asyncio.TimeoutError:
+                pass
 
     async def handle_kill_actor(self, data, conn) -> bool:
         actor = self.actors.get(ActorID(data["actor_id"]))

@@ -1,0 +1,16 @@
+"""Experiment-tracking integrations.
+
+Reference: python/ray/air/integrations/ (mlflow.py, wandb.py, comet.py).
+The in-tree default is the dependency-free local tracker
+(``tracking.py``); the mlflow/wandb adapters are gated on their
+packages, same pattern as the Tune searcher matrix.
+"""
+
+from ray_tpu.air.integrations.tracking import (TrackingLoggerCallback,
+                                               list_runs, setup_tracking)
+
+__all__ = [
+    "TrackingLoggerCallback",
+    "setup_tracking",
+    "list_runs",
+]

@@ -447,29 +447,22 @@ class Dataset:
                       *, seed: Optional[int] = None) -> "Dataset":
         """Bernoulli row sample (reference: Dataset.random_sample).
         Seeded runs are deterministic without coordination: each
-        block's rng derives from (seed, a hash of the block's CONTENT),
-        so distinct blocks draw independent masks (equal-sized blocks
-        must not share one — that would correlate the sample across
-        the dataset)."""
+        block's rng derives from (seed, the block's stage ordinal), so
+        every block — including blocks with identical content — draws
+        an independent mask (content-derived seeds would correlate the
+        sample across duplicate blocks)."""
         if not 0.0 <= fraction <= 1.0:
             raise ValueError(f"fraction must be in [0, 1]: {fraction}")
 
-        def transform(block: Block) -> Block:
-            if seed is None:
-                rng = np.random.default_rng()
-            else:
-                import pandas as pd
-
-                content = int(pd.util.hash_pandas_object(
-                    block_to_pandas(block), index=False).sum()) \
-                    & 0x7FFFFFFFFFFFFFFF
-                rng = np.random.default_rng((seed, content))
+        def transform(block: Block, idx: int) -> Block:
+            rng = np.random.default_rng(
+                None if seed is None else (seed, idx))
             keep = np.nonzero(
                 rng.random(block.num_rows) < fraction)[0]
             return block.take(keep)
 
         return self._with(MapStage(f"RandomSample({fraction})",
-                                   transform))
+                                   transform, wants_index=True))
 
     def take_batch(self, batch_size: int = 20,
                    *, batch_format: str = "numpy"):
@@ -496,24 +489,34 @@ class Dataset:
             return cache[col]
         parts = ray_tpu.get([_block_stats.remote(ref, col)
                              for ref in self.iter_block_refs()])
-        acc = {"_n": 0, "_m": 0.0, "_m2": 0.0, "sum": None,
+        acc = {"_n": 0, "_m": 0.0, "_m2": 0.0, "_mn": 0, "sum": None,
                "min": None, "max": None}
         for p in parts:
             if p["_n"] == 0:
                 continue
+            acc["_mn"] += p.get("_mn", 0)
             acc.update(_welford_merge(acc, p))
             if p["sum"] is not None:
                 acc["sum"] = p["sum"] if acc["sum"] is None \
                     else acc["sum"] + p["sum"]
-            acc["min"] = p["min"] if acc["min"] is None \
-                else min(acc["min"], p["min"])
-            acc["max"] = p["max"] if acc["max"] is None \
-                else max(acc["max"], p["max"])
+            try:
+                acc["min"] = p["min"] if acc["min"] is None \
+                    else min(acc["min"], p["min"])
+                acc["max"] = p["max"] if acc["max"] is None \
+                    else max(acc["max"], p["max"])
+            except TypeError:
+                # Cross-block incomparable types (numeric vs object):
+                # the column has no global order — min/max undefined.
+                acc["min"] = acc["max"] = None
         cache[col] = acc
         return acc
 
     def sum(self, col: str):
-        return self._column_stats(col)["sum"]
+        acc = self._column_stats(col)
+        # Mixed numeric/object blocks: a sum over just the numeric
+        # subset would be silently wrong — report None like a fully
+        # non-numeric column.
+        return acc["sum"] if acc["_mn"] == acc["_n"] else None
 
     def min(self, col: str):
         return self._column_stats(col)["min"]
@@ -523,14 +526,18 @@ class Dataset:
 
     def mean(self, col: str):
         acc = self._column_stats(col)
-        # sum None ⇔ non-numeric (or empty): moments are meaningless.
-        return acc["_m"] if acc["_n"] and acc["sum"] is not None else None
+        # sum None ⇔ non-numeric (or empty); _mn < _n ⇔ some blocks
+        # were object-typed and contributed zero moments: both make the
+        # merged mean meaningless.
+        return acc["_m"] if acc["_n"] and acc["sum"] is not None \
+            and acc["_mn"] == acc["_n"] else None
 
     def std(self, col: str, ddof: int = 1):
         import math
 
         acc = self._column_stats(col)
-        if acc["_n"] <= ddof or acc["sum"] is None:
+        if acc["_n"] <= ddof or acc["sum"] is None \
+                or acc["_mn"] != acc["_n"]:
             return None
         return math.sqrt(acc["_m2"] / (acc["_n"] - ddof))
 
@@ -697,7 +704,11 @@ def _block_stats(block: Block, col: str) -> Dict[str, Any]:
 
     s = block_to_pandas(block)[col].dropna()
     n = int(len(s))
-    out: Dict[str, Any] = {"_n": n, "_m": 0.0, "_m2": 0.0,
+    # _mn = rows that contributed MOMENTS (numeric blocks only). The
+    # driver compares it against _n: when a column is numeric in some
+    # blocks and object-typed in others, mean/std/sum over just the
+    # numeric subset would be silently wrong, so they become None.
+    out: Dict[str, Any] = {"_n": n, "_m": 0.0, "_m2": 0.0, "_mn": 0,
                            "sum": None, "min": None, "max": None}
     if n == 0:
         return out
@@ -708,6 +719,7 @@ def _block_stats(block: Block, col: str) -> Dict[str, Any]:
         out["_m"] = mean
         out["_m2"] = float(((s - mean) ** 2).sum())
         out["sum"] = _py(s.sum())
+        out["_mn"] = n
     return out
 
 

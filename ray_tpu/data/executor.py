@@ -40,6 +40,11 @@ class MapStage:
     # limit-pushdown optimizer rule (reference: logical optimizer rules
     # beyond fusion, _internal/logical/optimizers.py).
     preserves_rows: bool = False
+    # fn is called as fn(block, ordinal) with the block's 0-based input
+    # ordinal within this stage — deterministic per execution, which
+    # lets seeded per-block RNG (random_sample) draw independent streams
+    # without coordination or content hashing.
+    wants_index: bool = False
 
 
 @dataclasses.dataclass
@@ -139,12 +144,15 @@ def _fuse(stages: List[Stage]) -> List[Stage]:
                 and fused[-1].compute == "tasks"):
             prev = fused.pop()
 
-            def composed(block, f1=prev.fn, f2=st.fn):
-                return f2(f1(block))
+            def composed(block, idx=None, f1=prev.fn, f2=st.fn,
+                         w1=prev.wants_index, w2=st.wants_index):
+                mid = f1(block, idx) if w1 else f1(block)
+                return f2(mid, idx) if w2 else f2(mid)
 
             fused.append(MapStage(
                 f"{prev.name}->{st.name}", composed,
-                preserves_rows=prev.preserves_rows and st.preserves_rows))
+                preserves_rows=prev.preserves_rows and st.preserves_rows,
+                wants_index=prev.wants_index or st.wants_index))
         else:
             fused.append(st)
     return fused
@@ -173,6 +181,11 @@ def _exec_read(read_task, target_bytes: int):
 @ray_tpu.remote
 def _exec_map(fn, block: Block) -> Block:
     return fn(block)
+
+
+@ray_tpu.remote
+def _exec_map_idx(fn, block: Block, idx: int) -> Block:
+    return fn(block, idx)
 
 
 @ray_tpu.remote
@@ -293,6 +306,9 @@ class _MapActor:
 
     def apply(self, fn, block: Block) -> Block:
         return fn(self._callable, block)
+
+    def apply_idx(self, fn, block: Block, idx: int) -> Block:
+        return fn(self._callable, block, idx)
 
 
 def _ref_size_bytes(ref) -> Optional[int]:
@@ -558,12 +574,15 @@ class StreamingExecutor:
         if stage.compute == "tasks":
             try:
                 in_flight: collections.deque = collections.deque()
-                for ref in source:
+                for i, ref in enumerate(source):
                     for done_ref, held in op.wait_for_budget(in_flight):
                         yield done_ref
                         op.consumed(held)
-                    op.submitted(in_flight,
-                                 _exec_map.remote(stage.fn, ref))
+                    op.submitted(
+                        in_flight,
+                        _exec_map_idx.remote(stage.fn, ref, i)
+                        if stage.wants_index
+                        else _exec_map.remote(stage.fn, ref))
                     if len(in_flight) >= limit:
                         head, est = in_flight.popleft()
                         ray_tpu.wait([head], num_returns=1)
@@ -621,7 +640,7 @@ class StreamingExecutor:
 
         try:
             in_flight = collections.deque()
-            for ref in source:
+            for i, ref in enumerate(source):
                 for done_ref, held in op.wait_for_budget(in_flight,
                                                          head_done):
                     yield done_ref
@@ -629,7 +648,9 @@ class StreamingExecutor:
                 maybe_autoscale(len(in_flight))
                 actor = least_loaded()
                 pool[actor] += 1
-                out = actor.apply.remote(stage.fn, ref)
+                out = (actor.apply_idx.remote(stage.fn, ref, i)
+                       if stage.wants_index
+                       else actor.apply.remote(stage.fn, ref))
                 ref_actor[id(out)] = actor
                 op.submitted(in_flight, out)
                 if len(in_flight) >= limit:

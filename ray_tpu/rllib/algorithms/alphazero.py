@@ -188,6 +188,11 @@ class _NetPredictor:
     by the driver and the remote self-play workers so inference
     semantics can't drift between the local and distributed paths."""
 
+    # FIFO eviction bound: TicTacToe never gets near it, but any game
+    # exposing the documented interface can plug in, and a long
+    # self-play run must not accumulate one entry per distinct state.
+    CACHE_MAX = 100_000
+
     def __init__(self, forward_fn):
         self._forward = forward_fn
         self._fn = None
@@ -213,6 +218,8 @@ class _NetPredictor:
             self._fn = jax.jit(f)
         priors, value = self._fn(self._params, state)
         out = (np.asarray(priors), float(value))
+        while len(self._cache) >= self.CACHE_MAX:
+            del self._cache[next(iter(self._cache))]
         self._cache[key] = out
         return out
 

@@ -170,8 +170,12 @@ def ray_dask_get(dsk: Dict, keys: Any, **kwargs) -> Any:
     return rebuild(spec)
 
 
+_prior_scheduler: list = []  # stack of schedulers replaced by enable
+
+
 def enable_dask_on_ray() -> None:
-    """Make ray_dask_get dask's default scheduler (requires dask)."""
+    """Make ray_dask_get dask's default scheduler (requires dask).
+    Remembers the scheduler it replaced so disable restores it."""
     try:
         import dask
     except ImportError as e:
@@ -179,12 +183,16 @@ def enable_dask_on_ray() -> None:
             "enable_dask_on_ray requires the 'dask' package "
             "(pip install dask); ray_dask_get itself runs raw dask-spec "
             "graphs without it") from e
+    _prior_scheduler.append(dask.config.get("scheduler", None))
     dask.config.set(scheduler=ray_dask_get)
 
 
 def disable_dask_on_ray() -> None:
+    """Restore the scheduler that enable_dask_on_ray replaced (not a
+    blanket None, which would clobber a user-configured scheduler)."""
     try:
         import dask
     except ImportError:
         return
-    dask.config.set(scheduler=None)
+    prior = _prior_scheduler.pop() if _prior_scheduler else None
+    dask.config.set(scheduler=prior)

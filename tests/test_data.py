@@ -136,6 +136,21 @@ def test_random_sample_and_take_batch():
     assert ds.random_sample(0.0).count() == 0
     assert ds.random_sample(1.0).count() == 1000
 
+    # Blocks with IDENTICAL content draw independent masks too (the
+    # seed mixes the block ordinal, not a content hash): if the two
+    # copies shared a mask every kept id would appear exactly twice.
+    import collections as _c
+    half = [{"id": i} for i in range(200)]
+    dup = rd.from_items(half, parallelism=1).union(
+        rd.from_items(half, parallelism=1))
+    counts = _c.Counter(
+        r["id"] for r in dup.random_sample(0.4, seed=11).take_all())
+    assert any(v == 1 for v in counts.values()), counts
+    # ...and stays deterministic under the seed.
+    counts2 = _c.Counter(
+        r["id"] for r in dup.random_sample(0.4, seed=11).take_all())
+    assert counts == counts2
+
     batch = rd.range(100).take_batch(10)
     assert len(batch["id"]) == 10
     import pandas as pd
@@ -175,6 +190,16 @@ def test_global_aggregations_and_unique():
     big_ints = rd.from_items([{"i": 2 ** 60 + 1}, {"i": 2 ** 60 + 3}],
                              parallelism=2)
     assert big_ints.sum("i") == 2 ** 61 + 4
+    # Mixed per-block dtypes: column numeric in one block, object in
+    # the other. Moments from the object block are missing — a partial
+    # mean/std/sum would be silently wrong, so all three are None, and
+    # min/max (incomparable across blocks) are None too.
+    mixed = rd.from_items([{"m": 1.0}, {"m": 2.0}]).union(
+        rd.from_items([{"m": "oops"}, {"m": "nah"}]))
+    assert mixed.mean("m") is None
+    assert mixed.std("m") is None
+    assert mixed.sum("m") is None
+    assert mixed.min("m") is None and mixed.max("m") is None
 
 
 def test_limit_union_zip():

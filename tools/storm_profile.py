@@ -1,0 +1,68 @@
+"""Actor-creation storm phase profiler.
+
+Breaks a cold N-actor storm into driver-observable phases so the
+per-actor cost can be attributed (registration ack, ALIVE wait, first
+call). Run: python tools/storm_profile.py [N]
+"""
+import sys
+import time
+
+import ray_tpu
+
+
+def main(n: int = 64) -> None:
+    ray_tpu.init(num_cpus=n)
+
+    @ray_tpu.remote
+    class S:
+        def m(self, x=None):
+            return x
+
+    time.sleep(8.0)  # prestart pool fill
+
+    from ray_tpu.util.state import list_actors
+
+    for trial in range(3):
+        t0 = time.perf_counter()
+        batch = [S.remote() for _ in range(n)]
+        t_submit = time.perf_counter()
+        # Phase: creation pipeline (register -> lease -> __init__ ->
+        # actor_ready), observed via the state API.
+        want = {b._actor_id.hex() for b in batch}
+        while True:
+            alive = {a["actor_id"] for a in list_actors(limit=10_000)
+                     if a["state"] == "ALIVE"}
+            if want <= alive:
+                break
+            time.sleep(0.003)
+        t_alive = time.perf_counter()
+        refs = [b.m.remote(1) for b in batch]
+        ray_tpu.get(refs, timeout=180)
+        t_done = time.perf_counter()
+        total = t_done - t0
+        print(f"trial {trial}: n={n} total={total*1e3:.1f}ms "
+              f"({n/total:.1f}/s) submit={1e3*(t_submit-t0):.1f}ms "
+              f"alive_wait={1e3*(t_alive-t_submit):.1f}ms "
+              f"first_call={1e3*(t_done-t_alive):.1f}ms")
+        for b in batch:
+            ray_tpu.kill(b)
+        time.sleep(4.0)
+
+    import glob
+    import os
+
+    from ray_tpu._private import worker as _w
+
+    sess = getattr(_w.global_worker().node, "session_dir", None)
+    if sess:
+        for f in glob.glob(os.path.join(sess, "logs", "raylet*.err")):
+            with open(f) as fh:
+                lines = [ln for ln in fh if "TRACE lease" in ln]
+            print(f"--- {f}: {len(lines)} lease trace lines")
+            for ln in lines[-30:]:
+                print(ln.rstrip())
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 64)
