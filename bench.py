@@ -70,7 +70,10 @@ def _quiesce(max_wait_s: float = 90.0, threshold: float = 1.5) -> dict:
                 "settled": True}
     while load >= threshold and time.monotonic() < deadline:
         time.sleep(5.0)
-        load = os.getloadavg()[0]
+        try:
+            load = os.getloadavg()[0]
+        except OSError:
+            break
     return {"load": load, "load_initial": first,
             "waited_s": round(time.monotonic() - t0, 1),
             "settled": load < threshold}

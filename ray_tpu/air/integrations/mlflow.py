@@ -1,0 +1,130 @@
+"""MLflow integration, gated on the ``mlflow`` package.
+
+Reference: python/ray/air/integrations/mlflow.py:32 (``setup_mlflow``)
+and :193 (``MLflowLoggerCallback``). Same two entry points, redesigned
+over this framework's Tune callback seam; the dependency-free local
+tracker (``tracking.py``) is the in-tree default when mlflow is absent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.air.integrations.tracking import (_NoopModule,
+                                               _train_world_rank)
+from ray_tpu.tune.logger import LoggerCallback, _flatten
+
+
+def _import_mlflow():
+    try:
+        import mlflow
+    except ImportError as e:
+        raise ImportError(
+            "mlflow is not installed. `pip install mlflow`, or use the "
+            "dependency-free in-tree tracker: "
+            "ray_tpu.air.integrations.setup_tracking / "
+            "TrackingLoggerCallback") from e
+    return mlflow
+
+
+def setup_mlflow(config: Optional[Dict[str, Any]] = None,
+                 *,
+                 tracking_uri: Optional[str] = None,
+                 registry_uri: Optional[str] = None,
+                 experiment_id: Optional[str] = None,
+                 experiment_name: Optional[str] = None,
+                 run_name: Optional[str] = None,
+                 create_experiment_if_not_exists: bool = True,
+                 tags: Optional[Dict[str, Any]] = None,
+                 rank_zero_only: bool = True):
+    """Initialize an mlflow session inside a trainable / train loop and
+    return the configured ``mlflow`` module (reference contract:
+    air/integrations/mlflow.py:32). Under Ray Train, non-rank-zero
+    workers receive a no-op module so logging is not duplicated."""
+    if rank_zero_only:
+        rank = _train_world_rank()
+        if rank is not None and rank != 0:
+            return _NoopModule()
+    mlflow = _import_mlflow()
+    if tracking_uri:
+        mlflow.set_tracking_uri(tracking_uri)
+    if registry_uri and hasattr(mlflow, "set_registry_uri"):
+        mlflow.set_registry_uri(registry_uri)
+    if experiment_id is not None:
+        mlflow.set_experiment(experiment_id=experiment_id)
+    elif experiment_name is not None:
+        exp = mlflow.get_experiment_by_name(experiment_name)
+        if exp is None and create_experiment_if_not_exists:
+            mlflow.create_experiment(experiment_name)
+        mlflow.set_experiment(experiment_name)
+    run = mlflow.start_run(run_name=run_name, nested=True)
+    if tags:
+        mlflow.set_tags(tags)
+    if config:
+        params = {k: v for k, v in _flatten(config).items()}
+        if params:
+            mlflow.log_params(params)
+    return mlflow
+
+
+class MLflowLoggerCallback(LoggerCallback):
+    """Tune callback: one mlflow run per trial (reference:
+    air/integrations/mlflow.py:193). Uses the low-level
+    ``MlflowClient`` API with explicit run ids — the fluent
+    ``start_run`` stack is process-global and interleaves when the
+    controller runs many trials concurrently. Import is checked at
+    construction so a missing dependency fails at Tuner build time,
+    not mid-run."""
+
+    def __init__(self, tracking_uri: Optional[str] = None,
+                 registry_uri: Optional[str] = None,
+                 experiment_name: Optional[str] = None,
+                 tags: Optional[Dict[str, Any]] = None,
+                 save_artifact: bool = False):
+        super().__init__()
+        mlflow = _import_mlflow()
+        self._client = mlflow.tracking.MlflowClient(
+            tracking_uri=tracking_uri, registry_uri=registry_uri)
+        self._tags = dict(tags or {})
+        self._save_artifact = save_artifact
+        self._run_ids: Dict[str, str] = {}
+        name = experiment_name or "ray_tpu"
+        exp = self._client.get_experiment_by_name(name)
+        self._experiment_id = (exp.experiment_id if exp is not None
+                               else self._client.create_experiment(name))
+
+    def on_trial_start(self, trial) -> None:
+        tags = dict(self._tags)
+        tags["trial_id"] = trial.trial_id
+        tags["mlflow.runName"] = f"trial_{trial.trial_id}"
+        run = self._client.create_run(self._experiment_id, tags=tags)
+        run_id = run.info.run_id
+        self._run_ids[trial.trial_id] = run_id
+        for k, v in _flatten(trial.config).items():
+            self._client.log_param(run_id, k, v)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        if trial.trial_id not in self._run_ids:
+            self.on_trial_start(trial)
+        run_id = self._run_ids[trial.trial_id]
+        step = int(result.get("training_iteration", 0) or 0)
+        for k, v in _flatten(result).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self._client.log_metric(run_id, k, float(v), step=step)
+
+    def on_trial_complete(self, trial) -> None:
+        run_id = self._run_ids.pop(trial.trial_id, None)
+        if run_id is None:
+            return
+        if self._save_artifact and getattr(trial, "checkpoint_path", None):
+            try:
+                self._client.log_artifacts(run_id, trial.checkpoint_path)
+            except Exception:
+                pass
+        self._client.set_terminated(
+            run_id, "FAILED" if trial.error else "FINISHED")
+
+    def on_experiment_end(self, trials: List) -> None:
+        for run_id in self._run_ids.values():
+            self._client.set_terminated(run_id, "FINISHED")
+        self._run_ids.clear()

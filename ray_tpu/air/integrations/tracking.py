@@ -1,0 +1,308 @@
+"""Dependency-free local experiment tracker — the in-tree default.
+
+Reference: the role of python/ray/air/integrations/mlflow.py:32
+(``setup_mlflow``) / wandb.py:453 (``WandbLoggerCallback``) — but
+instead of an external tracking server this backend is a plain
+directory tree, so every deployment gets durable run history with zero
+dependencies:
+
+    <root>/<experiment>/<run_id>/
+        meta.json       {run_name, experiment, start/end time, status}
+        params.json     flat params dict
+        metrics.jsonl   one JSON line per log_metrics() call (+ step/ts)
+        tags.json       user tags
+
+Two entry points, mirroring the reference's split:
+- ``TrackingLoggerCallback`` — a Tune logger callback: one run per
+  trial, params from trial.config, metrics from every reported result.
+- ``setup_tracking()`` — imperative API for use INSIDE a training
+  function (rank-zero gated under Train), returning a ``Run``.
+
+``list_runs()`` + the ``ray_tpu runs`` CLI read the tree back.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.logger import LoggerCallback, _flatten
+
+_DEFAULT_ROOT = os.path.join("~", "ray_tpu_results", "tracking")
+
+
+def _root(root: Optional[str]) -> str:
+    return os.path.expanduser(
+        root or os.environ.get("RAY_TPU_TRACKING_ROOT", _DEFAULT_ROOT))
+
+
+def _jsonable(v: Any) -> Any:
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+class Run:
+    """One tracked run (analog of an mlflow run / wandb run object)."""
+
+    def __init__(self, root: str, experiment: str, run_id: str,
+                 run_name: str, resumed: bool = False):
+        self.experiment = experiment
+        self.run_id = run_id
+        self.run_name = run_name
+        self.dir = os.path.join(root, experiment, run_id)
+        os.makedirs(self.dir, exist_ok=True)
+        self._step = 0
+        if not resumed or not os.path.exists(self._p("meta.json")):
+            self._write("meta.json", {
+                "run_id": run_id, "run_name": run_name,
+                "experiment": experiment, "status": "RUNNING",
+                "start_time": time.time(), "end_time": None,
+            })
+
+    def _p(self, name: str) -> str:
+        return os.path.join(self.dir, name)
+
+    def _write(self, name: str, obj: dict) -> None:
+        tmp = self._p(name + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1, default=str)
+        os.replace(tmp, self._p(name))
+
+    def _read(self, name: str) -> dict:
+        try:
+            with open(self._p(name)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    # ---------------- logging API ----------------
+    def log_params(self, params: Dict[str, Any]) -> None:
+        merged = self._read("params.json")
+        merged.update({k: _jsonable(v)
+                       for k, v in _flatten(params).items()})
+        self._write("params.json", merged)
+
+    def log_metrics(self, metrics: Dict[str, Any],
+                    step: Optional[int] = None) -> None:
+        if step is None:
+            step = self._step
+        self._step = step + 1
+        row = {"step": step, "ts": time.time()}
+        for k, v in _flatten(metrics).items():
+            if isinstance(v, bool):
+                continue
+            row[k] = v if isinstance(v, (int, float, str)) else _jsonable(v)
+        with open(self._p("metrics.jsonl"), "a") as f:
+            f.write(json.dumps(row, default=str) + "\n")
+
+    def set_tags(self, tags: Dict[str, Any]) -> None:
+        merged = self._read("tags.json")
+        merged.update({k: _jsonable(v) for k, v in tags.items()})
+        self._write("tags.json", merged)
+
+    def finish(self, status: str = "FINISHED") -> None:
+        meta = self._read("meta.json")
+        meta["status"] = status
+        meta["end_time"] = time.time()
+        self._write("meta.json", meta)
+
+
+class _NoopModule:
+    """Swallows any attribute/call chain — handed to non-rank-zero
+    Train workers by the gated integrations (reference: _NoopModule in
+    air/integrations/mlflow.py) so logging isn't duplicated across a
+    worker gang."""
+
+    def __getattr__(self, name):
+        return self
+
+    def __call__(self, *a, **kw):
+        return self
+
+
+class _NoopRun:
+    """Returned to non-rank-zero Train workers: logging must not be
+    duplicated across a worker gang (reference: rank_zero_only)."""
+
+    dir = None
+    run_id = None
+
+    def log_params(self, params) -> None:
+        pass
+
+    def log_metrics(self, metrics, step=None) -> None:
+        pass
+
+    def set_tags(self, tags) -> None:
+        pass
+
+    def finish(self, status: str = "FINISHED") -> None:
+        pass
+
+
+def _train_world_rank() -> Optional[int]:
+    """Rank inside a Train worker gang, or None outside one."""
+    try:
+        from ray_tpu.train._internal.session import get_context
+
+        ctx = get_context()
+        if ctx is None:
+            return None
+        return ctx.get_world_rank()
+    except Exception:
+        return None
+
+
+def setup_tracking(config: Optional[Dict[str, Any]] = None,
+                   *,
+                   experiment_name: str = "default",
+                   run_name: Optional[str] = None,
+                   run_id: Optional[str] = None,
+                   tracking_root: Optional[str] = None,
+                   tags: Optional[Dict[str, Any]] = None,
+                   rank_zero_only: bool = True):
+    """Open (or resume) a tracked run from inside a training function.
+
+    Mirrors the reference's ``setup_mlflow`` contract
+    (air/integrations/mlflow.py:32): the ``config`` dict is logged as
+    run params; under Ray Train only the rank-zero worker gets a real
+    run (others receive a no-op) unless ``rank_zero_only=False``.
+    Passing the same ``run_id`` resumes (appends to) an existing run —
+    the restore path after trial preemption.
+    """
+    if rank_zero_only:
+        rank = _train_world_rank()
+        if rank is not None and rank != 0:
+            return _NoopRun()
+    resumed = run_id is not None
+    rid = run_id or uuid.uuid4().hex[:10]
+    run = Run(_root(tracking_root), experiment_name, rid,
+              run_name or rid, resumed=resumed)
+    if tags:
+        run.set_tags(tags)
+    if config:
+        run.log_params(config)
+    return run
+
+
+class TrackingLoggerCallback(LoggerCallback):
+    """Tune callback: one local tracked run per trial.
+
+    Params come from ``trial.config`` at start; every reported result
+    appends a metrics line; completion stamps the final status.
+    """
+
+    def __init__(self, experiment_name: str = "default",
+                 tracking_root: Optional[str] = None,
+                 tags: Optional[Dict[str, Any]] = None):
+        super().__init__()
+        self._experiment = experiment_name
+        self._tracking_root = tracking_root
+        self._tags = dict(tags or {})
+        self._runs: Dict[str, Run] = {}
+
+    def _run_for(self, trial) -> Run:
+        run = self._runs.get(trial.trial_id)
+        if run is None:
+            run = Run(_root(self._tracking_root), self._experiment,
+                      trial.trial_id, f"trial_{trial.trial_id}",
+                      resumed=True)
+            meta = run._read("meta.json")
+            if meta.get("status") != "RUNNING":
+                meta.update({"status": "RUNNING",
+                             "run_id": trial.trial_id,
+                             "run_name": f"trial_{trial.trial_id}",
+                             "experiment": self._experiment})
+                meta.setdefault("start_time", time.time())
+                run._write("meta.json", meta)
+            if self._tags:
+                run.set_tags(self._tags)
+            self._runs[trial.trial_id] = run
+        return run
+
+    def on_trial_start(self, trial) -> None:
+        self._run_for(trial).log_params(trial.config)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        run = self._run_for(trial)
+        step = result.get("training_iteration")
+        run.log_metrics(result, step=step)
+
+    def on_trial_complete(self, trial) -> None:
+        run = self._runs.pop(trial.trial_id, None)
+        if run is not None:
+            run.finish("ERRORED" if trial.error else "FINISHED")
+
+    def on_experiment_end(self, trials: List) -> None:
+        for run in self._runs.values():
+            run.finish()
+        self._runs.clear()
+
+
+# ---------------------------------------------------------------- read side
+def list_runs(tracking_root: Optional[str] = None,
+              experiment: Optional[str] = None) -> List[Dict[str, Any]]:
+    """All runs (newest first): meta + params + last metrics line."""
+    root = _root(tracking_root)
+    out: List[Dict[str, Any]] = []
+    if not os.path.isdir(root):
+        return out
+    exps = [experiment] if experiment else sorted(os.listdir(root))
+    for exp in exps:
+        exp_dir = os.path.join(root, exp)
+        if not os.path.isdir(exp_dir):
+            continue
+        for rid in sorted(os.listdir(exp_dir)):
+            rdir = os.path.join(exp_dir, rid)
+            if not os.path.isdir(rdir):
+                continue
+            entry: Dict[str, Any] = {"experiment": exp, "run_id": rid}
+            try:
+                with open(os.path.join(rdir, "meta.json")) as f:
+                    entry.update(json.load(f))
+            except (OSError, ValueError):
+                entry["status"] = "UNKNOWN"
+            try:
+                with open(os.path.join(rdir, "params.json")) as f:
+                    entry["params"] = json.load(f)
+            except (OSError, ValueError):
+                entry["params"] = {}
+            last = None
+            n = 0
+            try:
+                with open(os.path.join(rdir, "metrics.jsonl")) as f:
+                    for line in f:
+                        if line.strip():
+                            last = line
+                            n += 1
+            except OSError:
+                pass
+            entry["num_metric_rows"] = n
+            entry["last_metrics"] = json.loads(last) if last else {}
+            out.append(entry)
+    out.sort(key=lambda e: e.get("start_time") or 0, reverse=True)
+    return out
+
+
+def format_runs(runs: List[Dict[str, Any]]) -> str:
+    """CLI rendering for ``ray_tpu runs``."""
+    if not runs:
+        return "no tracked runs"
+    lines = [f"{'EXPERIMENT':<16} {'RUN':<12} {'STATUS':<9} "
+             f"{'ROWS':>5}  LAST_METRICS"]
+    for r in runs:
+        last = {k: v for k, v in r["last_metrics"].items()
+                if k not in ("ts",) and isinstance(v, (int, float))}
+        brief = ", ".join(f"{k}={v:.4g}" if isinstance(v, float)
+                          else f"{k}={v}"
+                          for k, v in list(last.items())[:4])
+        lines.append(f"{r['experiment']:<16.16} {r['run_id']:<12.12} "
+                     f"{r.get('status', '?'):<9.9} "
+                     f"{r['num_metric_rows']:>5}  {brief}")
+    return "\n".join(lines)

@@ -1,0 +1,135 @@
+"""Weights & Biases integration, gated on the ``wandb`` package.
+
+Reference: python/ray/air/integrations/wandb.py:63 (``setup_wandb``)
+and :453 (``WandbLoggerCallback``). Redesigned over this framework's
+Tune callback seam: the reference fans each trial's logging through a
+dedicated logging actor; here the controller is already a single
+process with per-trial callbacks, so runs are plain ``wandb.init``
+handles kept per trial id.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.air.integrations.tracking import (_NoopModule,
+                                               _train_world_rank)
+from ray_tpu.tune.logger import LoggerCallback, _flatten
+
+WANDB_ENV_VAR = "WANDB_API_KEY"
+WANDB_MODE_ENV_VAR = "WANDB_MODE"
+
+
+def _import_wandb():
+    try:
+        import wandb
+    except ImportError as e:
+        raise ImportError(
+            "wandb is not installed. `pip install wandb`, or use the "
+            "dependency-free in-tree tracker: "
+            "ray_tpu.air.integrations.setup_tracking / "
+            "TrackingLoggerCallback") from e
+    return wandb
+
+
+def setup_wandb(config: Optional[Dict[str, Any]] = None,
+                *,
+                api_key: Optional[str] = None,
+                project: Optional[str] = None,
+                group: Optional[str] = None,
+                name: Optional[str] = None,
+                mode: Optional[str] = None,
+                rank_zero_only: bool = True,
+                **init_kwargs):
+    """Initialize wandb inside a trainable / train loop and return the
+    run handle (reference contract: air/integrations/wandb.py:63).
+    Under Ray Train, non-rank-zero workers receive a no-op handle."""
+    if rank_zero_only:
+        rank = _train_world_rank()
+        if rank is not None and rank != 0:
+            return _NoopModule()
+    wandb = _import_wandb()
+    if api_key:
+        os.environ[WANDB_ENV_VAR] = api_key
+    if mode:
+        os.environ[WANDB_MODE_ENV_VAR] = mode
+    return wandb.init(project=project or "ray_tpu", group=group,
+                      name=name, config=dict(config or {}),
+                      **init_kwargs)
+
+
+class WandbLoggerCallback(LoggerCallback):
+    """Tune callback: one wandb run per trial (reference:
+    air/integrations/wandb.py:453). Construction checks the import and
+    credentials; each trial's run is created lazily on first event."""
+
+    def __init__(self, project: Optional[str] = None,
+                 group: Optional[str] = None,
+                 api_key: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 excludes: Optional[List[str]] = None,
+                 log_config: bool = True,
+                 upload_checkpoints: bool = False,
+                 **init_kwargs):
+        super().__init__()
+        self._wandb = _import_wandb()
+        if api_key:
+            os.environ[WANDB_ENV_VAR] = api_key
+        if mode:
+            os.environ[WANDB_MODE_ENV_VAR] = mode
+        self._project = project or "ray_tpu"
+        self._group = group
+        self._excludes = set(excludes or [])
+        self._log_config = log_config
+        self._upload_checkpoints = upload_checkpoints
+        self._init_kwargs = init_kwargs
+        self._runs: Dict[str, Any] = {}
+
+    def _run_for(self, trial):
+        run = self._runs.get(trial.trial_id)
+        if run is None:
+            # reinit="create_new": concurrent trials each need their own
+            # live run handle. Plain reinit=True FINISHES the previously
+            # active run, so trial B's lazy init would kill trial A's
+            # run mid-experiment (we log through the returned handle,
+            # never the global wandb.log, so create_new is sufficient).
+            run = self._wandb.init(
+                project=self._project, group=self._group,
+                name=f"trial_{trial.trial_id}", id=trial.trial_id,
+                config=dict(trial.config) if self._log_config else None,
+                reinit="create_new", resume="allow", **self._init_kwargs)
+            self._runs[trial.trial_id] = run
+        return run
+
+    def on_trial_start(self, trial) -> None:
+        self._run_for(trial)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        run = self._run_for(trial)
+        step = result.get("training_iteration")
+        metrics = {k: v for k, v in _flatten(result).items()
+                   if k not in self._excludes
+                   and isinstance(v, (int, float, str))
+                   and not isinstance(v, bool)}
+        run.log(metrics, step=int(step) if step is not None else None)
+
+    def on_trial_complete(self, trial) -> None:
+        run = self._runs.pop(trial.trial_id, None)
+        if run is None:
+            return
+        if self._upload_checkpoints and getattr(trial, "checkpoint_path",
+                                                None):
+            try:
+                art = self._wandb.Artifact(
+                    f"checkpoint_{trial.trial_id}", type="model")
+                art.add_dir(trial.checkpoint_path)
+                run.log_artifact(art)
+            except Exception:
+                pass
+        run.finish(exit_code=1 if trial.error else 0)
+
+    def on_experiment_end(self, trials: List) -> None:
+        for run in self._runs.values():
+            run.finish()
+        self._runs.clear()

@@ -491,6 +491,7 @@ class Dataset:
                              for ref in self.iter_block_refs()])
         acc = {"_n": 0, "_m": 0.0, "_m2": 0.0, "_mn": 0, "sum": None,
                "min": None, "max": None}
+        unordered = False  # sticky: one incomparable pair poisons min/max
         for p in parts:
             if p["_n"] == 0:
                 continue
@@ -499,6 +500,8 @@ class Dataset:
             if p["sum"] is not None:
                 acc["sum"] = p["sum"] if acc["sum"] is None \
                     else acc["sum"] + p["sum"]
+            if unordered:
+                continue
             try:
                 acc["min"] = p["min"] if acc["min"] is None \
                     else min(acc["min"], p["min"])
@@ -506,7 +509,9 @@ class Dataset:
                     else max(acc["max"], p["max"])
             except TypeError:
                 # Cross-block incomparable types (numeric vs object):
-                # the column has no global order — min/max undefined.
+                # the column has no global order — min/max undefined,
+                # and a later comparable block must NOT re-seed them.
+                unordered = True
                 acc["min"] = acc["max"] = None
         cache[col] = acc
         return acc

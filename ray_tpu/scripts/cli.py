@@ -294,6 +294,13 @@ def cmd_attach(args) -> None:
     raise SystemExit(_sp.call(attach_command(args.config)))
 
 
+def cmd_runs(args) -> None:
+    from ray_tpu.air.integrations.tracking import format_runs, list_runs
+
+    print(format_runs(list_runs(tracking_root=args.root,
+                                experiment=args.experiment)))
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ray_tpu",
                                 description=__doc__.split("\n")[0])
@@ -360,6 +367,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--limit", type=int, default=100)
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("runs",
+                        help="list locally tracked experiment runs")
+    sp.add_argument("--root", default=None,
+                    help="tracking root (default: RAY_TPU_TRACKING_ROOT"
+                         " or ~/ray_tpu_results/tracking)")
+    sp.add_argument("--experiment", default=None)
+    sp.set_defaults(fn=cmd_runs)
 
     sp = sub.add_parser("logs", help="list/tail session worker logs")
     sp.add_argument("filename", nargs="?", default=None,

@@ -200,6 +200,12 @@ def test_global_aggregations_and_unique():
     assert mixed.std("m") is None
     assert mixed.sum("m") is None
     assert mixed.min("m") is None and mixed.max("m") is None
+    # Sticky across block order: a comparable block AFTER the type
+    # clash must not re-seed min/max with its local extrema.
+    sandwich = rd.from_items([{"m": 1.0}]).union(
+        rd.from_items([{"m": "oops"}])).union(
+        rd.from_items([{"m": 5.0}, {"m": 9.0}]))
+    assert sandwich.min("m") is None and sandwich.max("m") is None
 
 
 def test_limit_union_zip():

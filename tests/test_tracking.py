@@ -1,0 +1,284 @@
+"""Experiment-tracking integrations.
+
+Covers the dependency-free local tracker end-to-end through a Tune run
+(reference role: python/ray/air/integrations/mlflow.py:32,:193 and
+wandb.py:63,:453), the mlflow/wandb adapters against fake modules
+injected into sys.modules (same pattern as the gated searcher matrix),
+and the import gates when the packages are absent.
+"""
+
+import json
+import os
+import sys
+import types
+
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+from ray_tpu.tune import TuneConfig, Tuner
+
+
+def _objective(config):
+    for i in range(3):
+        tune.report({"acc": 0.5 + 0.1 * i + config["x"], "iter": i})
+
+
+# --------------------------------------------------------------- local tracker
+def test_local_tracker_through_tune(tmp_path):
+    from ray_tpu.air.integrations import TrackingLoggerCallback, list_runs
+
+    root = str(tmp_path / "tracking")
+    results = Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([0.0, 0.1])},
+        tune_config=TuneConfig(metric="acc", mode="max"),
+        run_config=RunConfig(
+            storage_path=str(tmp_path / "exp"),
+            callbacks=[TrackingLoggerCallback(
+                experiment_name="exp1", tracking_root=root,
+                tags={"suite": "ci"})]),
+    ).fit()
+    assert len(results) == 2 and results.num_errors == 0
+
+    runs = list_runs(tracking_root=root)
+    assert len(runs) == 2
+    for run in runs:
+        assert run["experiment"] == "exp1"
+        assert run["status"] == "FINISHED"
+        assert run["params"]["x"] in (0.0, 0.1)
+        # 3 user reports (+ the function-API {"done": True} sentinel).
+        rdir = os.path.join(root, "exp1", run["run_id"])
+        rows = [json.loads(ln) for ln in
+                open(os.path.join(rdir, "metrics.jsonl"))]
+        accs = [r["acc"] for r in rows if "acc" in r]
+        assert len(accs) == 3
+        assert accs[-1] == pytest.approx(0.7 + run["params"]["x"])
+        assert json.load(open(os.path.join(rdir, "tags.json"))) == {
+            "suite": "ci"}
+
+    # CLI rendering works on the same tree.
+    from ray_tpu.air.integrations.tracking import format_runs
+
+    text = format_runs(runs)
+    assert "exp1" in text and "FINISHED" in text
+
+
+def test_setup_tracking_imperative_and_resume(tmp_path):
+    from ray_tpu.air.integrations import setup_tracking
+
+    root = str(tmp_path)
+    run = setup_tracking({"lr": 3e-4}, experiment_name="imp",
+                         run_name="r0", tracking_root=root)
+    run.log_metrics({"loss": 1.0}, step=0)
+    run.log_metrics({"loss": 0.5}, step=1)
+    run.set_tags({"phase": "a"})
+    run.finish()
+
+    # Resume by run_id appends instead of truncating.
+    run2 = setup_tracking(experiment_name="imp", run_id=run.run_id,
+                          tracking_root=root)
+    run2.log_metrics({"loss": 0.25}, step=2)
+    run2.finish()
+
+    from ray_tpu.air.integrations import list_runs
+
+    runs = list_runs(tracking_root=root, experiment="imp")
+    assert len(runs) == 1
+    assert runs[0]["num_metric_rows"] == 3
+    assert runs[0]["last_metrics"]["loss"] == 0.25
+    assert runs[0]["params"] == {"lr": 3e-4}
+
+
+# ------------------------------------------------------------- fake mlflow
+class _FakeMlflowRunInfo:
+    def __init__(self, run_id):
+        self.run_id = run_id
+
+
+class _FakeMlflowRun:
+    def __init__(self, run_id):
+        self.info = _FakeMlflowRunInfo(run_id)
+
+
+class _FakeMlflowClient:
+    store = None  # set per-test
+
+    def __init__(self, tracking_uri=None, registry_uri=None):
+        self.store["init"] = {"tracking_uri": tracking_uri}
+
+    def get_experiment_by_name(self, name):
+        return None
+
+    def create_experiment(self, name):
+        self.store["experiment"] = name
+        return "exp-1"
+
+    def create_run(self, experiment_id, tags=None):
+        rid = f"run-{len(self.store['runs'])}"
+        self.store["runs"][rid] = {"experiment_id": experiment_id,
+                                   "tags": dict(tags or {}),
+                                   "params": {}, "metrics": [],
+                                   "status": "RUNNING"}
+        return _FakeMlflowRun(rid)
+
+    def log_param(self, run_id, k, v):
+        self.store["runs"][run_id]["params"][k] = v
+
+    def log_metric(self, run_id, k, v, step=0):
+        self.store["runs"][run_id]["metrics"].append((k, v, step))
+
+    def log_artifacts(self, run_id, path):
+        self.store["runs"][run_id]["artifacts"] = path
+
+    def set_terminated(self, run_id, status):
+        self.store["runs"][run_id]["status"] = status
+
+
+@pytest.fixture
+def fake_mlflow(monkeypatch):
+    store = {"runs": {}}
+    _FakeMlflowClient.store = store
+    mod = types.ModuleType("mlflow")
+    tracking = types.ModuleType("mlflow.tracking")
+    tracking.MlflowClient = _FakeMlflowClient
+    mod.tracking = tracking
+    monkeypatch.setitem(sys.modules, "mlflow", mod)
+    monkeypatch.setitem(sys.modules, "mlflow.tracking", tracking)
+    yield store
+
+
+def test_mlflow_logger_callback(tmp_path, fake_mlflow):
+    from ray_tpu.air.integrations.mlflow import MLflowLoggerCallback
+
+    results = Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([0.0, 0.1])},
+        tune_config=TuneConfig(metric="acc", mode="max"),
+        run_config=RunConfig(
+            storage_path=str(tmp_path),
+            callbacks=[MLflowLoggerCallback(experiment_name="mlexp",
+                                            tags={"team": "tpu"})]),
+    ).fit()
+    assert len(results) == 2 and results.num_errors == 0
+    assert fake_mlflow["experiment"] == "mlexp"
+    runs = fake_mlflow["runs"]
+    assert len(runs) == 2
+    for rec in runs.values():
+        assert rec["status"] == "FINISHED"
+        assert rec["tags"]["team"] == "tpu"
+        assert rec["params"]["x"] in (0.0, 0.1)
+        accs = [m for m in rec["metrics"] if m[0] == "acc"]
+        assert len(accs) == 3
+        # Steps carried through from training_iteration (1-based).
+        assert [s for (_, _, s) in accs] == sorted(
+            s for (_, _, s) in accs)
+
+
+def test_setup_mlflow_fluent(fake_mlflow, monkeypatch):
+    mod = sys.modules["mlflow"]
+    calls = {}
+    mod.set_tracking_uri = lambda uri: calls.setdefault("uri", uri)
+    mod.get_experiment_by_name = lambda name: None
+    mod.create_experiment = lambda name: calls.setdefault("exp", name)
+    mod.set_experiment = lambda *a, **kw: None
+    mod.start_run = lambda run_name=None, nested=False: calls.setdefault(
+        "run_name", run_name)
+    mod.set_tags = lambda tags: calls.setdefault("tags", tags)
+    mod.log_params = lambda params: calls.setdefault("params", params)
+
+    from ray_tpu.air.integrations.mlflow import setup_mlflow
+
+    out = setup_mlflow({"lr": 0.1, "nested": {"a": 1}},
+                       tracking_uri="file:///tmp/x",
+                       experiment_name="e2", run_name="r2",
+                       tags={"k": "v"})
+    assert out is mod
+    assert calls["uri"] == "file:///tmp/x"
+    assert calls["exp"] == "e2"
+    assert calls["run_name"] == "r2"
+    assert calls["params"] == {"lr": 0.1, "nested/a": 1}
+
+
+# ------------------------------------------------------------- fake wandb
+class _FakeWandbRun:
+    def __init__(self, store, **kw):
+        self.kw = kw
+        self.logged = []
+        self.finished = None
+        store.append(self)
+
+    def log(self, metrics, step=None):
+        self.logged.append((dict(metrics), step))
+
+    def finish(self, exit_code=0):
+        self.finished = exit_code
+
+
+@pytest.fixture
+def fake_wandb(monkeypatch):
+    runs = []
+    mod = types.ModuleType("wandb")
+    mod.init = lambda **kw: _FakeWandbRun(runs, **kw)
+    monkeypatch.setitem(sys.modules, "wandb", mod)
+    yield runs
+
+
+def test_wandb_logger_callback(tmp_path, fake_wandb):
+    from ray_tpu.air.integrations.wandb import WandbLoggerCallback
+
+    results = Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([0.0, 0.1])},
+        tune_config=TuneConfig(metric="acc", mode="max"),
+        run_config=RunConfig(
+            storage_path=str(tmp_path),
+            callbacks=[WandbLoggerCallback(project="proj",
+                                           group="grp")]),
+    ).fit()
+    assert len(results) == 2 and results.num_errors == 0
+    assert len(fake_wandb) == 2
+    for run in fake_wandb:
+        assert run.kw["project"] == "proj" and run.kw["group"] == "grp"
+        assert run.finished == 0
+        accs = [m for m, _ in run.logged if "acc" in m]
+        assert len(accs) == 3
+        assert run.kw["config"]["x"] in (0.0, 0.1)
+
+
+def test_setup_wandb_imperative(fake_wandb):
+    from ray_tpu.air.integrations.wandb import setup_wandb
+
+    run = setup_wandb({"lr": 0.1}, project="p2", name="n2",
+                      mode="offline")
+    assert run.kw["project"] == "p2" and run.kw["name"] == "n2"
+    assert run.kw["config"] == {"lr": 0.1}
+    assert os.environ.get("WANDB_MODE") == "offline"
+
+
+# ---------------------------------------------------------------- gating
+def test_adapters_gate_without_packages():
+    """Hermetic image: imports succeed, construction raises actionable
+    ImportErrors pointing at the in-tree tracker."""
+    for name in ("mlflow", "wandb"):
+        if name in sys.modules:
+            pytest.skip(f"{name} installed/injected in this process")
+    from ray_tpu.air.integrations.mlflow import (MLflowLoggerCallback,
+                                                 setup_mlflow)
+    from ray_tpu.air.integrations.wandb import (WandbLoggerCallback,
+                                                setup_wandb)
+
+    try:
+        import mlflow  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="mlflow"):
+            MLflowLoggerCallback()
+        with pytest.raises(ImportError, match="setup_tracking"):
+            setup_mlflow({})
+    try:
+        import wandb  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="wandb"):
+            WandbLoggerCallback()
+        with pytest.raises(ImportError, match="setup_tracking"):
+            setup_wandb({})

@@ -29,11 +29,16 @@ def main(n: int = 64) -> None:
         # Phase: creation pipeline (register -> lease -> __init__ ->
         # actor_ready), observed via the state API.
         want = {b._actor_id.hex() for b in batch}
+        deadline = time.perf_counter() + 180.0
         while True:
             alive = {a["actor_id"] for a in list_actors(limit=10_000)
                      if a["state"] == "ALIVE"}
             if want <= alive:
                 break
+            if time.perf_counter() > deadline:
+                raise RuntimeError(
+                    f"storm stalled: {len(want - alive)} actors never "
+                    f"reached ALIVE: {sorted(want - alive)[:5]}...")
             time.sleep(0.003)
         t_alive = time.perf_counter()
         refs = [b.m.remote(1) for b in batch]
