@@ -12,12 +12,14 @@ batches straight onto a mesh sharding.
 
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import (DataIterator, Dataset, from_arrow,
-                                  from_huggingface, from_items,
-                                  from_numpy, from_pandas, from_torch,
-                                  range, read_binary_files, read_csv,
+                                  from_dask, from_huggingface,
+                                  from_items, from_numpy, from_pandas,
+                                  from_torch, range, read_avro,
+                                  read_binary_files, read_csv,
                                   read_images, read_json, read_numpy,
-                                  read_parquet,
-                                  read_text, read_tfrecords)
+                                  read_parquet, read_parquet_bulk,
+                                  read_sql, read_text, read_tfrecords,
+                                  read_webdataset)
 from ray_tpu.data import preprocessors
 
 __all__ = [
@@ -25,6 +27,7 @@ __all__ = [
     "DataIterator",
     "Dataset",
     "from_arrow",
+    "from_dask",
     "from_huggingface",
     "from_items",
     "from_numpy",
@@ -32,12 +35,16 @@ __all__ = [
     "from_torch",
     "preprocessors",
     "range",
+    "read_avro",
     "read_binary_files",
     "read_csv",
     "read_json",
     "read_images",
     "read_numpy",
     "read_parquet",
+    "read_parquet_bulk",
+    "read_sql",
     "read_text",
     "read_tfrecords",
+    "read_webdataset",
 ]

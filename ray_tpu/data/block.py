@@ -67,7 +67,25 @@ def block_slice(block: Block, start: int, end: int) -> Block:
 
 
 def block_to_rows(block: Block) -> List[Dict[str, Any]]:
-    return block.to_pylist()
+    import json
+
+    rows = block.to_pylist()
+    # Tensor columns (fixed-size list + shape metadata) flatten in
+    # to_pylist; restore each row's element to its real ndarray shape so
+    # row-level consumers (take/iter_rows/write_webdataset) see tensors,
+    # not flat lists.
+    shapes = {}
+    for field in block.schema:
+        meta = field.metadata or {}
+        if b"tensor_shape" in meta:
+            shapes[field.name] = tuple(json.loads(meta[b"tensor_shape"]))
+    if shapes:
+        for row in rows:
+            for name, shape in shapes.items():
+                v = row.get(name)
+                if v is not None:
+                    row[name] = np.asarray(v).reshape(shape)
+    return rows
 
 
 def block_to_numpy(block: Block) -> Dict[str, np.ndarray]:

@@ -17,8 +17,9 @@ import numpy as np
 import ray_tpu
 from ray_tpu.data import datasource
 from ray_tpu.data.block import (Block, batch_to_block, block_from_items,
-                                block_to_numpy, block_to_pandas,
-                                block_to_rows, concat_blocks, format_batch,
+                                block_from_pandas, block_to_numpy,
+                                block_to_pandas, block_to_rows,
+                                concat_blocks, format_batch,
                                 iter_block_batches)
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.executor import (AllToAllStage, MapStage, ShuffleStage,
@@ -330,7 +331,46 @@ class Dataset:
             written.append(out)
         return written
 
-    def write_parquet(self, path: str) -> List[str]:
+    def _write_partitioned(self, path: str, ext: str, write_df,
+                           partition_cols: List[str]) -> List[str]:
+        """Hive layout: <path>/k1=v1/k2=v2/block_i_j.<ext> (reference:
+        write_parquet's partition_cols / datasource/partitioning.py).
+        Partition columns are dropped from the file payload — the path
+        carries them, and the hive reader restores them."""
+        import os
+
+        written: List[str] = []
+        for i, block in enumerate(self.iter_blocks()):
+            df = block_to_pandas(block)
+            missing = [c for c in partition_cols if c not in df.columns]
+            if missing:
+                raise ValueError(f"partition_cols not in block: {missing}")
+            for j, (vals, group) in enumerate(
+                    df.groupby(partition_cols, sort=True, dropna=False)):
+                if not isinstance(vals, tuple):
+                    vals = (vals,)
+                sub = os.path.join(path, *(
+                    f"{k}={v}" for k, v in zip(partition_cols, vals)))
+                os.makedirs(sub, exist_ok=True)
+                out = os.path.join(sub, f"block_{i:05d}_{j:03d}.{ext}")
+                write_df(group.drop(columns=partition_cols), out)
+                written.append(out)
+        return written
+
+    def write_parquet(self, path: str,
+                      partition_cols: Optional[List[str]] = None
+                      ) -> List[str]:
+        if partition_cols:
+            def one_df(df, out):
+                import pyarrow as pa
+                import pyarrow.parquet as pq
+
+                pq.write_table(pa.Table.from_pandas(
+                    df, preserve_index=False), out)
+
+            return self._write_partitioned(path, "parquet", one_df,
+                                           partition_cols)
+
         def one(block: Block, out: str):
             import pyarrow.parquet as pq
 
@@ -338,11 +378,69 @@ class Dataset:
 
         return self._write_blocks(path, "parquet", one)
 
-    def write_csv(self, path: str) -> List[str]:
+    def write_csv(self, path: str,
+                  partition_cols: Optional[List[str]] = None
+                  ) -> List[str]:
+        if partition_cols:
+            return self._write_partitioned(
+                path, "csv", lambda df, out: df.to_csv(out, index=False),
+                partition_cols)
+
         def one(block: Block, out: str):
             block_to_pandas(block).to_csv(out, index=False)
 
         return self._write_blocks(path, "csv", one)
+
+    def write_webdataset(self, path: str) -> List[str]:
+        """One tar shard per block; each row becomes the members
+        ``<key>.<column>`` with type-directed encoding (str -> utf-8,
+        int -> cls text, dict -> json, bytes raw, ndarray -> npy) —
+        the inverse of read_webdataset (reference: write_webdataset)."""
+        def one(block: Block, out: str):
+            import io
+            import json as jsonlib
+            import tarfile
+
+            from ray_tpu.data.block import block_to_rows
+
+            # Tensor columns (FixedSizeList + tensor_shape metadata)
+            # come out of block_to_rows as FLAT lists; restore their
+            # ndarray form so they encode as .npy, not json.
+            shapes: Dict[str, tuple] = {}
+            for field in getattr(block, "schema", []) or []:
+                meta = field.metadata or {}
+                if b"tensor_shape" in meta:
+                    shapes[field.name] = tuple(
+                        jsonlib.loads(meta[b"tensor_shape"]))
+
+            def encode(value) -> bytes:
+                if isinstance(value, bytes):
+                    return value
+                if isinstance(value, str):
+                    return value.encode("utf-8")
+                if isinstance(value, (bool, int, np.integer)):
+                    return str(int(value)).encode("utf-8")
+                if isinstance(value, np.ndarray):
+                    buf = io.BytesIO()
+                    np.save(buf, value)
+                    return buf.getvalue()
+                return jsonlib.dumps(value, default=str).encode("utf-8")
+
+            with tarfile.open(out, "w") as tar:
+                for idx, row in enumerate(block_to_rows(block)):
+                    key = str(row.get("__key__", f"{idx:08d}"))
+                    for col, value in row.items():
+                        if col == "__key__" or value is None:
+                            continue
+                        if col in shapes and isinstance(value, list):
+                            value = np.asarray(value).reshape(
+                                shapes[col])
+                        data = encode(value)
+                        info = tarfile.TarInfo(f"{key}.{col}")
+                        info.size = len(data)
+                        tar.addfile(info, io.BytesIO(data))
+
+        return self._write_blocks(path, "tar", one)
 
     def write_json(self, path: str) -> List[str]:
         def one(block: Block, out: str):
@@ -939,16 +1037,73 @@ def from_arrow(table) -> Dataset:
     return Dataset([lambda: table])
 
 
-def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 partitioning: Optional[str] = None) -> Dataset:
+    """partitioning="hive": key=value path segments under the base dir
+    become columns (reference: read_parquet's Partitioning("hive")
+    default, datasource/partitioning.py)."""
+    if partitioning == "hive":
+        return Dataset(datasource.with_hive_partitions(
+            lambda f: datasource.parquet_tasks([f], columns)[0], paths))
     return Dataset(datasource.parquet_tasks(paths, columns))
 
 
-def read_csv(paths, **kwargs) -> Dataset:
+def read_parquet_bulk(paths, *, columns: Optional[List[str]] = None
+                      ) -> Dataset:
+    """Exactly one read task per GIVEN file path — no directory/glob
+    expansion, no metadata prefetch (reference: read_api.py:944
+    read_parquet_bulk, the many-small-files fast path)."""
+    files = [paths] if isinstance(paths, str) else list(paths)
+    if not files:
+        raise ValueError("read_parquet_bulk requires file paths")
+
+    def make(f):
+        def read():
+            import pyarrow.parquet as pq
+
+            return pq.read_table(f, columns=columns)
+        return read
+
+    return Dataset([make(f) for f in files])
+
+
+def read_csv(paths, *, partitioning: Optional[str] = None,
+             **kwargs) -> Dataset:
+    if partitioning == "hive":
+        return Dataset(datasource.with_hive_partitions(
+            lambda f: datasource.csv_tasks([f], **kwargs)[0], paths))
     return Dataset(datasource.csv_tasks(paths, **kwargs))
 
 
-def read_json(paths) -> Dataset:
+def read_json(paths, *, partitioning: Optional[str] = None) -> Dataset:
+    if partitioning == "hive":
+        return Dataset(datasource.with_hive_partitions(
+            lambda f: datasource.json_tasks([f])[0], paths))
     return Dataset(datasource.json_tasks(paths))
+
+
+def read_sql(sql: str, connection_factory, *, parallelism: int = 1,
+             shard_column: Optional[str] = None) -> Dataset:
+    """Query any DB-API connection into a Dataset (reference:
+    read_api.py:2067 read_sql). Each read task calls
+    ``connection_factory()`` inside the worker; with ``shard_column``
+    (integer) and ``parallelism`` > 1 the query is MOD-sharded."""
+    return Dataset(datasource.sql_tasks(sql, connection_factory,
+                                        parallelism=parallelism,
+                                        shard_column=shard_column))
+
+
+def read_webdataset(paths, *, decode: bool = True) -> Dataset:
+    """WebDataset tar shards -> one row per sample, columns named by
+    member extension (reference: read_api.py:1860 read_webdataset).
+    stdlib tarfile — needs no webdataset package."""
+    return Dataset(datasource.webdataset_tasks(paths, decode=decode))
+
+
+def read_avro(paths) -> Dataset:
+    """Avro container files (reference: read_api.py:1492 read_avro).
+    Gated on fastavro."""
+    return Dataset(datasource.avro_tasks(paths))
 
 
 def read_text(paths) -> Dataset:
@@ -1016,3 +1171,25 @@ def from_torch(torch_dataset) -> Dataset:
         items.append({k: (v.numpy() if hasattr(v, "numpy") else v)
                       for k, v in item.items()})
     return from_items(items)
+
+
+def from_dask(ddf) -> Dataset:
+    """Dask DataFrame -> Dataset, one block per dask partition
+    (reference: read_api.py:2311 from_dask). Partitions are computed
+    THROUGH the cluster via the dask-on-ray scheduler
+    (util/dask.py ray_dask_get), not dask's local threads. Gated on
+    dask."""
+    try:
+        import dask
+    except ImportError as e:
+        raise ImportError(
+            "from_dask requires the 'dask' package "
+            "(pip install 'dask[dataframe]')") from e
+    from ray_tpu.util.dask import ray_dask_get
+
+    parts = ddf.to_delayed()
+    if not parts:
+        return from_items([])
+    dfs = dask.compute(*parts, scheduler=ray_dask_get)
+    tasks = [(lambda d=df: block_from_pandas(d)) for df in dfs]
+    return Dataset(tasks)
