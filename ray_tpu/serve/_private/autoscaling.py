@@ -38,7 +38,10 @@ class AutoscalingState:
     def desired_replicas(self, current: int) -> int:
         cfg = self.config
         avg = self._avg_ongoing()
-        raw = math.ceil(avg / max(cfg.target_ongoing_requests, 1e-9))
+        target = (cfg.target_custom_metric
+                  if getattr(cfg, "target_custom_metric", None)
+                  is not None else cfg.target_ongoing_requests)
+        raw = math.ceil(avg / max(target, 1e-9))
         if raw > current and cfg.upscaling_factor:
             raw = min(raw, math.ceil(current * cfg.upscaling_factor) or 1)
         if raw < current and cfg.downscaling_factor:

@@ -92,8 +92,11 @@ class ServeController:
                     cfg.autoscaling_config)
             a = self._autoscaling[did]
             a.config = cfg.autoscaling_config
-            state.collect_autoscaling_stats()
-            a.record(state.total_ongoing_requests())
+            use_custom = getattr(cfg.autoscaling_config,
+                                 "target_custom_metric", None) is not None
+            state.collect_autoscaling_stats(custom=use_custom)
+            a.record(state.total_custom_metric() if use_custom
+                     else state.total_ongoing_requests())
             desired = a.desired_replicas(state.target_num_replicas)
             if desired != state.target_num_replicas:
                 logger.info("autoscaling %s: %d -> %d replicas", did,

@@ -62,6 +62,7 @@ class DeploymentReplica:
         self.last_health_check: float = time.time()
         self.health_ref = None
         self.num_ongoing: int = 0
+        self.custom_metric = None  # user autoscaling metric (polled)
 
     def start(self, serialized_def: bytes, init_args_blob: bytes,
               config: DeploymentConfig) -> None:
@@ -276,8 +277,29 @@ class DeploymentState:
                     r.begin_stop(0)
         self._broadcast_running()
 
-    def collect_autoscaling_stats(self) -> None:
-        """Refresh per-replica ongoing-request counts (best effort)."""
+    def collect_autoscaling_stats(self, custom: bool = False) -> None:
+        """Refresh per-replica ongoing-request counts (best effort);
+        with custom=True also pull the user-recorded autoscaling
+        metric (serve.metrics.record_autoscaling_metric)."""
+        if custom:
+            crefs, creps = [], []
+            for r in self.replicas:
+                if r.state == ReplicaState.RUNNING and r.handle is not None:
+                    try:
+                        crefs.append(
+                            r.handle.get_autoscaling_metric.remote())
+                        creps.append(r)
+                    except Exception:
+                        pass
+            if crefs:
+                cdone, _ = ray_tpu.wait(crefs, num_returns=len(crefs),
+                                        timeout=2.0)
+                for r, ref in zip(creps, crefs):
+                    if ref in cdone:
+                        try:
+                            r.custom_metric = ray_tpu.get(ref)
+                        except Exception:
+                            pass
         refs, reps = [], []
         for r in self.replicas:
             if r.state == ReplicaState.RUNNING and r.handle is not None:
@@ -298,6 +320,13 @@ class DeploymentState:
 
     def total_ongoing_requests(self) -> float:
         return float(sum(r.num_ongoing for r in self.replicas
+                         if r.state == ReplicaState.RUNNING))
+
+    def total_custom_metric(self) -> float:
+        """Sum of the replicas' user-recorded autoscaling values
+        (replicas that never recorded count as 0)."""
+        return float(sum(getattr(r, "custom_metric", None) or 0.0
+                         for r in self.replicas
                          if r.state == ReplicaState.RUNNING))
 
     # ------------------------------------------------------------- queries

@@ -77,17 +77,51 @@ class ReplicaActor:
             self._wrapper.reconfigure(user_config)
         self._num_ongoing = 0
         self._total_served = 0
+        self._total_errors = 0
         self._draining = False
         self._multiplexed_model_ids: list = []
         self._started_at = time.time()
-        global _current_replica
-        _current_replica = self
+        # Replica-side custom autoscaling metric
+        # (serve.metrics.record_autoscaling_metric); polled by the
+        # controller when the deployment declares target_custom_metric.
+        self._custom_autoscaling_metric: Optional[float] = None
+        # Set on the CANONICAL module (not `global`): this class ships
+        # to the worker pickled by value, so its methods' __globals__
+        # are a reconstructed namespace — a bare `global` write would
+        # land there and user code importing the module (serve.metrics
+        # context tags) would still see None.
+        import ray_tpu.serve._private.replica as _rmod
+
+        _rmod._current_replica = self
+        # Built-in per-deployment metrics (reference: serve/metrics.py
+        # request counter / error counter / processing latency): flow
+        # through the metrics pipeline to the dashboard /metrics.
+        from ray_tpu.util import metrics as um
+
+        tags = {"deployment": deployment, "replica": replica_id,
+                "application": app_name}
+        keys = tuple(tags)
+        self._m_requests = um.Counter(
+            "serve_deployment_request_counter",
+            "requests served per deployment replica",
+            tag_keys=keys).set_default_tags(tags)
+        self._m_errors = um.Counter(
+            "serve_deployment_error_counter",
+            "user-code errors per deployment replica",
+            tag_keys=keys).set_default_tags(tags)
+        self._m_latency = um.Histogram(
+            "serve_deployment_processing_latency_ms",
+            "request processing latency (ms)",
+            boundaries=[1, 2, 5, 10, 20, 50, 100, 200, 500, 1000,
+                        2000, 5000],
+            tag_keys=keys).set_default_tags(tags)
 
     # ------------------------------------------------------------- data path
     async def handle_request(self, request_meta: dict, *args, **kwargs):
         """Execute one request (reference replica.py handle_request)."""
         meta = RequestMetadata.from_dict(request_meta)
         self._num_ongoing += 1
+        t0 = time.perf_counter()
         try:
             method = self._wrapper.get_method(meta.call_method)
             if meta.multiplexed_model_id:
@@ -107,8 +141,15 @@ class ReplicaActor:
                     "call it with handle.options(stream=True)")
             self._total_served += 1
             return result
+        except Exception:
+            self._total_errors += 1
+            self._m_errors.inc()
+            raise
         finally:
             self._num_ongoing -= 1
+            self._m_requests.inc()
+            self._m_latency.observe(
+                (time.perf_counter() - t0) * 1000.0)
 
     _STREAM_END = object()
 
@@ -122,6 +163,7 @@ class ReplicaActor:
         streaming-generator protocol in replica.py)."""
         meta = RequestMetadata.from_dict(request_meta)
         self._num_ongoing += 1
+        t0 = time.perf_counter()
         try:
             method = self._wrapper.get_method(meta.call_method)
             if meta.multiplexed_model_id:
@@ -148,12 +190,24 @@ class ReplicaActor:
                 # Non-generator result through stream=True: one chunk.
                 yield result
             self._total_served += 1
+        except Exception:
+            self._total_errors += 1
+            self._m_errors.inc()
+            raise
         finally:
             self._num_ongoing -= 1
+            self._m_requests.inc()
+            self._m_latency.observe(
+                (time.perf_counter() - t0) * 1000.0)
 
     # ----------------------------------------------------------- control path
     def get_num_ongoing_requests(self) -> int:
         return self._num_ongoing
+
+    def get_autoscaling_metric(self) -> Optional[float]:
+        """The user-recorded custom autoscaling value (None when the
+        replica never called record_autoscaling_metric)."""
+        return self._custom_autoscaling_metric
 
     def get_metadata(self) -> dict:
         return {
@@ -162,6 +216,7 @@ class ReplicaActor:
             "app_name": self._app_name,
             "num_ongoing": self._num_ongoing,
             "total_served": self._total_served,
+            "total_errors": self._total_errors,
             "started_at": self._started_at,
             "multiplexed_model_ids": list(self._multiplexed_model_ids),
         }
@@ -196,6 +251,12 @@ import contextvars
 _multiplex_context: contextvars.ContextVar = contextvars.ContextVar(
     "serve_multiplexed_model_id", default="")
 _current_replica = None  # the ReplicaActor instance living in this process
+
+
+def get_current_replica():
+    """The ReplicaActor living in this process (None outside one) —
+    the serve metrics API reads its identity tags from here."""
+    return _current_replica
 
 
 def _set_multiplex_context(model_id: str) -> None:

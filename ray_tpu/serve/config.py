@@ -25,6 +25,10 @@ class AutoscalingConfig:
     downscaling_factor: Optional[float] = None
     metrics_interval_s: float = 1.0
     look_back_period_s: float = 10.0
+    # When set, scale on the replicas' user-recorded custom metric
+    # (serve.metrics.record_autoscaling_metric) instead of ongoing
+    # requests: desired = ceil(sum(custom) / target_custom_metric).
+    target_custom_metric: Optional[float] = None
 
     def to_dict(self) -> dict:
         return {k: getattr(self, k) for k in self.__dataclass_fields__}

@@ -1,0 +1,111 @@
+"""Serve metrics API: context-tagged Counter/Gauge/Histogram + the
+autoscaling custom-metric hook.
+
+Reference: python/ray/serve/metrics.py:69 (Counter/Gauge/Histogram that
+auto-inject the serve replica context tags so user metrics are
+per-deployment/replica without manual tagging) and :190 (histogram
+variant). The replica's BUILT-IN request/error/latency metrics live in
+_private/replica.py; this module is the user-facing seam.
+
+``record_autoscaling_metric(value)`` publishes a per-replica scalar the
+controller scales on when the deployment's AutoscalingConfig sets
+``target_custom_metric`` (reference:
+python/ray/serve/_private/autoscaling_policy.py's metric plumbing).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from ray_tpu.util import metrics as _um
+
+SERVE_TAG_KEYS = ("deployment", "replica", "application")
+
+
+def _context_tags() -> Dict[str, str]:
+    from ray_tpu.serve._private.replica import get_current_replica
+
+    rep = get_current_replica()
+    if rep is None:
+        return {}
+    return {"deployment": rep._deployment, "replica": rep._replica_id,
+            "application": rep._app_name}
+
+
+class _ServeTagged:
+    """Mixin: serve context tags are appended to tag_keys and injected
+    as defaults at construction (inside a replica) or lazily on first
+    record (constructed at import time, before the replica exists)."""
+
+    def _init_serve_tags(self):
+        ctx = _context_tags()
+        if ctx:
+            merged = dict(self._default_tags)
+            merged.update(ctx)
+            self._default_tags = merged
+            self._ctx_bound = True
+        else:
+            self._ctx_bound = False
+
+    def _bind_ctx(self):
+        if not self._ctx_bound:
+            ctx = _context_tags()
+            if ctx:
+                merged = dict(self._default_tags)
+                merged.update(ctx)
+                self._default_tags = merged
+                self._ctx_bound = True
+
+
+class Counter(_ServeTagged, _um.Counter):
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        super().__init__(name, description,
+                         tuple(tag_keys or ()) + SERVE_TAG_KEYS)
+        self._init_serve_tags()
+
+    def inc(self, value: float = 1.0, tags=None) -> None:
+        self._bind_ctx()
+        super().inc(value, tags)
+
+
+class Gauge(_ServeTagged, _um.Gauge):
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        super().__init__(name, description,
+                         tuple(tag_keys or ()) + SERVE_TAG_KEYS)
+        self._init_serve_tags()
+
+    def set(self, value: float, tags=None) -> None:
+        self._bind_ctx()
+        super().set(value, tags)
+
+
+class Histogram(_ServeTagged, _um.Histogram):
+    def __init__(self, name: str, description: str = "",
+                 boundaries=None,
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        super().__init__(name, description,
+                         boundaries=boundaries,
+                         tag_keys=tuple(tag_keys or ()) + SERVE_TAG_KEYS)
+        self._init_serve_tags()
+
+    def observe(self, value: float, tags=None) -> None:
+        self._bind_ctx()
+        super().observe(value, tags)
+
+
+def record_autoscaling_metric(value: float) -> None:
+    """Publish this replica's current value of the deployment's custom
+    autoscaling metric. The controller averages the per-replica values
+    it polls and scales toward ``target_custom_metric`` when the
+    deployment's AutoscalingConfig declares one. Must be called inside
+    a replica."""
+    from ray_tpu.serve._private.replica import get_current_replica
+
+    rep = get_current_replica()
+    if rep is None:
+        raise RuntimeError(
+            "record_autoscaling_metric must be called inside a serve "
+            "replica")
+    rep._custom_autoscaling_metric = float(value)

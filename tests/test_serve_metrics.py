@@ -1,0 +1,201 @@
+"""Serve per-deployment metrics + custom-metric autoscaling.
+
+Reference: python/ray/serve/metrics.py:69,:190 (context-tagged user
+metrics + built-in request/error/latency series) and
+python/ray/serve/_private/autoscaling_policy.py (policy input plumbing).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_instance():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(proxy=False)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _gcs_metrics():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().gcs_call("get_metrics")
+
+
+def _series(rows, name):
+    return [r for r in rows if r["name"] == name]
+
+
+def test_builtin_request_error_latency_metrics(serve_instance):
+    @serve.deployment
+    class Api:
+        def __call__(self, x):
+            if x < 0:
+                raise ValueError("negative")
+            return x * 2
+
+    handle = serve.run(Api.bind(), name="mx", route_prefix=None,
+                       _proxy=False)
+    oks = [handle.remote(i).result(timeout_s=15) for i in range(5)]
+    assert oks == [0, 2, 4, 6, 8]
+    for _ in range(2):
+        with pytest.raises(Exception):
+            handle.remote(-1).result(timeout_s=15)
+
+    # Built-in series reach the GCS metrics table with deployment tags
+    # (the dashboard /metrics endpoint renders this same table).
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        rows = _gcs_metrics()
+        reqs = [r for r in _series(rows,
+                                   "serve_deployment_request_counter")
+                if r["tags"].get("deployment") == "Api"]
+        errs = [r for r in _series(rows,
+                                   "serve_deployment_error_counter")
+                if r["tags"].get("deployment") == "Api"]
+        lat = [r for r in _series(
+            rows, "serve_deployment_processing_latency_ms")
+            if r["tags"].get("deployment") == "Api"]
+        if (sum(r["value"] for r in reqs) >= 7
+                and sum(r["value"] for r in errs) >= 2 and lat):
+            break
+        time.sleep(0.5)
+    assert sum(r["value"] for r in reqs) >= 7  # 5 ok + 2 errors
+    assert sum(r["value"] for r in errs) >= 2
+    assert lat and lat[0]["count"] >= 7
+    assert lat[0]["tags"]["application"] == "mx"
+    assert lat[0]["tags"]["replica"]
+    serve.delete("mx")
+
+
+def test_user_metrics_get_serve_context_tags(serve_instance):
+    @serve.deployment
+    class Counting:
+        def __init__(self):
+            self.hits = serve.metrics.Counter(
+                "my_user_hits", description="user metric",
+                tag_keys=("kind",))
+
+        def __call__(self, x):
+            self.hits.inc(tags={"kind": "call"})
+            return x
+
+    handle = serve.run(Counting.bind(), name="um", route_prefix=None,
+                       _proxy=False)
+    for i in range(3):
+        handle.remote(i).result(timeout_s=15)
+
+    deadline = time.time() + 20
+    rows = []
+    while time.time() < deadline:
+        rows = [r for r in _gcs_metrics() if r["name"] == "my_user_hits"]
+        if rows and sum(r["value"] for r in rows) >= 3:
+            break
+        time.sleep(0.5)
+    assert rows, "user metric never reached the GCS"
+    r = rows[0]
+    # Serve context tags injected without the user naming them.
+    assert r["tags"]["deployment"] == "Counting"
+    assert r["tags"]["application"] == "um"
+    assert r["tags"]["kind"] == "call"
+    serve.delete("um")
+
+
+def test_dashboard_metrics_endpoint_exposes_serve_series(serve_instance):
+    import socket
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard
+
+    @serve.deployment
+    class Ping:
+        def __call__(self, x):
+            return "pong"
+
+    handle = serve.run(Ping.bind(), name="scrape", route_prefix=None,
+                       _proxy=False)
+    for _ in range(4):
+        handle.remote(1).result(timeout_s=15)
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    dash = start_dashboard(port=port)
+    try:
+        deadline = time.time() + 20
+        text = ""
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                text = r.read().decode()
+            if ('serve_deployment_request_counter' in text
+                    and 'deployment="Ping"' in text):
+                break
+            time.sleep(0.5)
+        assert 'serve_deployment_request_counter' in text
+        assert 'deployment="Ping"' in text
+        assert 'serve_deployment_processing_latency_ms' in text
+    finally:
+        dash.stop()
+        serve.delete("scrape")
+
+
+def test_autoscale_on_custom_metric(serve_instance):
+    """A deployment declaring target_custom_metric scales on the value
+    its replicas record via serve.metrics.record_autoscaling_metric,
+    not on ongoing requests."""
+
+    @serve.deployment(
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3, target_custom_metric=10.0,
+            upscale_delay_s=0.1, downscale_delay_s=60.0,
+            look_back_period_s=2.0))
+    class Queueish:
+        def __call__(self, depth):
+            # e.g. a replica-local queue depth the user scales on
+            serve.metrics.record_autoscaling_metric(float(depth))
+            return depth
+
+    handle = serve.run(Queueish.bind(), name="customscale",
+                       route_prefix=None, _proxy=False)
+    # Report a load of 25 per replica: desired = ceil(25/10) = 3.
+    handle.remote(25.0).result(timeout_s=15)
+    deadline = time.time() + 30
+    n = 1
+    while time.time() < deadline:
+        st = serve.status()
+        dep = st["applications"]["customscale"]["deployments"]["Queueish"]
+        n = dep.get("replica_states", {}).get("RUNNING", 0)
+        if n >= 2:
+            break
+        time.sleep(0.5)
+    assert n >= 2, f"never scaled up on custom metric (running={n})"
+    serve.delete("customscale")
+
+
+def test_custom_metric_policy_unit():
+    """Policy math: the custom target replaces target_ongoing_requests."""
+    from ray_tpu.serve._private.autoscaling import AutoscalingState
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    cfg = AutoscalingConfig(min_replicas=1, max_replicas=10,
+                            target_ongoing_requests=2,
+                            target_custom_metric=50.0,
+                            upscale_delay_s=0, downscale_delay_s=0)
+    st = AutoscalingState(cfg)
+    st.record(200.0)  # sum of custom metric over replicas
+    st.desired_replicas(1)
+    time.sleep(0.01)
+    st.record(200.0)
+    assert st.desired_replicas(1) == 4  # ceil(200/50), NOT ceil(200/2)
+
+
+def test_record_autoscaling_metric_outside_replica():
+    with pytest.raises(RuntimeError, match="inside a serve replica"):
+        serve.metrics.record_autoscaling_metric(1.0)
