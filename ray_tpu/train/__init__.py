@@ -19,6 +19,8 @@ from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.data_parallel_trainer import (DataParallelTrainer,
                                                  JaxTrainer)
 from ray_tpu.train.jax_backend import JaxConfig
+from ray_tpu.train.predictor import (BatchPredictor, JaxPredictor,
+                                     Predictor, SklearnPredictor)
 from ray_tpu.train._internal.session import (get_checkpoint, get_context,
                                              report)
 
@@ -27,7 +29,11 @@ __all__ = [
     "restore_pytree",
     "save_pytree",
     "BackendConfig",
+    "BatchPredictor",
     "Checkpoint",
+    "JaxPredictor",
+    "Predictor",
+    "SklearnPredictor",
     "CheckpointConfig",
     "DataParallelTrainer",
     "FailureConfig",

@@ -1,0 +1,143 @@
+"""Predictor seam: checkpoint -> distributed batch inference.
+
+Reference: python/ray/train/predictor.py:40 (Predictor.from_checkpoint
++ predict) and train/batch_predictor.py (checkpoint fanned over
+Dataset.map_batches, model loaded once per pool actor).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu import train
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train import (BatchPredictor, Checkpoint, JaxPredictor,
+                           JaxTrainer, SklearnPredictor)
+from ray_tpu.train.jax_backend import JaxConfig
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _linear_apply(params, batch):
+    # Top-level so it pickles by reference into pool actors.
+    return batch["x"] @ params["w"] + params["b"]
+
+
+def _train_linear(config):
+    """One gradient-descent fit of y = x @ w + b on synthetic data."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 3).astype(np.float32)
+    true_w = np.array([[2.0], [-1.0], [0.5]], np.float32)
+    y = X @ true_w + 0.25
+
+    params = {"w": jnp.zeros((3, 1)), "b": jnp.zeros(())}
+
+    def loss(p, xb, yb):
+        return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
+
+    grad = jax.jit(jax.grad(loss))
+    for _ in range(200):
+        g = grad(params, X, y)
+        params = jax.tree_util.tree_map(
+            lambda p, gg: p - 0.1 * gg, params, g)
+    if train.get_context().get_world_rank() == 0:
+        train.report(
+            {"loss": float(loss(params, X, y))},
+            checkpoint=Checkpoint.from_dict(
+                {"params": jax.tree_util.tree_map(np.asarray, params)}))
+    else:
+        train.report({"loss": 0.0})
+
+
+def test_train_checkpoint_batch_predict(tmp_path):
+    """End-to-end: JaxTrainer fit -> checkpoint -> BatchPredictor over a
+    Dataset with an actor pool; predictions match the held-out truth."""
+    trainer = JaxTrainer(
+        _train_linear,
+        jax_config=JaxConfig(jax_distributed=False),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="lin", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["loss"] < 1e-2
+    assert result.checkpoint is not None
+
+    # Distributed inference: 2-actor pool, model loaded once per actor.
+    rng = np.random.RandomState(1)
+    Xte = rng.randn(64, 3).astype(np.float32)
+    ds = rd.from_numpy({"x": Xte, "row": np.arange(64)})
+    bp = BatchPredictor.from_checkpoint(
+        result.checkpoint, JaxPredictor, apply_fn=_linear_apply)
+    out = bp.predict(ds, batch_size=16, concurrency=2,
+                     feature_columns=["x"], keep_columns=["row"])
+    got = out.to_numpy()
+    order = np.argsort(got["row"])
+    preds = got["predictions"].reshape(64, -1)[order]
+    want = Xte @ np.array([[2.0], [-1.0], [0.5]], np.float32) + 0.25
+    np.testing.assert_allclose(preds, want, atol=0.05)
+
+
+def test_jax_predictor_direct():
+    ckpt = Checkpoint.from_dict(
+        {"params": {"w": np.eye(2, dtype=np.float32),
+                    "b": np.float32(1.0)}})
+    p = JaxPredictor.from_checkpoint(ckpt, apply_fn=_linear_apply)
+    out = p.predict({"x": np.array([[1.0, 2.0]], np.float32)})
+    np.testing.assert_allclose(out["predictions"], [[2.0, 3.0]])
+
+
+def test_jax_predictor_sharded_array_checkpoint(tmp_path):
+    """Sharded array checkpoints restore through the template path."""
+    import jax.numpy as jnp
+
+    from ray_tpu.train.array_checkpoint import save_pytree
+
+    params = {"w": np.arange(6, dtype=np.float32).reshape(3, 2),
+              "b": np.zeros(2, np.float32)}
+    d = str(tmp_path / "ajc")
+    save_pytree(params, d)
+    template = {"w": jnp.zeros((3, 2)), "b": jnp.zeros(2)}
+    p = JaxPredictor.from_checkpoint(
+        Checkpoint.from_directory(d), apply_fn=_linear_apply,
+        template=template)
+    out = p.predict({"x": np.ones((1, 3), np.float32)})
+    np.testing.assert_allclose(out["predictions"],
+                               params["w"].sum(0)[None])
+
+
+def test_sklearn_predictor(tmp_path):
+    sklearn = pytest.importorskip("sklearn")  # noqa: F841
+    import pickle
+
+    from sklearn.linear_model import LinearRegression
+
+    from ray_tpu.train.sklearn_trainer import MODEL_FILENAME
+
+    X = np.random.RandomState(0).randn(50, 2)
+    y = X @ [1.0, 2.0] + 3.0
+    est = LinearRegression().fit(X, y)
+    d = tmp_path / "skl"
+    d.mkdir()
+    with open(d / MODEL_FILENAME, "wb") as f:
+        pickle.dump(est, f)
+
+    ds = rd.from_numpy({"a": X[:, 0], "b": X[:, 1]})
+    bp = BatchPredictor.from_checkpoint(
+        Checkpoint.from_directory(str(d)), SklearnPredictor)
+    out = bp.predict(ds, batch_size=25, concurrency=2).to_numpy()
+    np.testing.assert_allclose(np.sort(out["predictions"]),
+                               np.sort(y), atol=1e-6)
+
+
+def test_predictor_abstract():
+    with pytest.raises(NotImplementedError):
+        train.Predictor().predict({})
