@@ -26,6 +26,10 @@ Endpoints:
       tail: returns {offset, data} as soon as the file grows past
       `offset` (or after wait_s with empty data) — push-style tailing
       without websockets
+  GET /api/grafana_dashboard -> Grafana dashboard JSON generated from
+      the live metric registry (dashboard/metrics_module.py)
+  GET /api/prometheus_scrape_config -> prometheus.yml text targeting
+      this head's /metrics
 """
 
 from __future__ import annotations
@@ -139,6 +143,27 @@ class DashboardHead:
             data = await offload(self._gcs, "get_metrics")
             return web.Response(text=_prometheus_text(data or []),
                                 content_type="text/plain")
+
+        @routes.get("/api/grafana_dashboard")
+        async def grafana_dashboard_route(request):
+            """Grafana dashboard JSON generated from the LIVE registry
+            (reference: dashboard/modules/metrics/metrics_head.py:68) —
+            panels can only reference series /metrics actually exports."""
+            from ray_tpu.dashboard.metrics_module import grafana_dashboard
+
+            data = await offload(self._gcs, "get_metrics")
+            return web.json_response(grafana_dashboard(data or []),
+                                     dumps=_dumps)
+
+        @routes.get("/api/prometheus_scrape_config")
+        async def prometheus_scrape_route(request):
+            from ray_tpu.dashboard.metrics_module import \
+                prometheus_scrape_config
+
+            return web.Response(
+                text=prometheus_scrape_config(
+                    f"{self.host}:{self.port}"),
+                content_type="text/plain")
 
         @routes.get("/api/metrics_json")
         async def metrics_json(request):

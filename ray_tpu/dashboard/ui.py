@@ -86,6 +86,7 @@ INDEX_HTML = """<!doctype html>
   <button data-tab="jobs">Jobs</button>
   <button data-tab="tasks">Tasks</button>
   <button data-tab="timeline">Timeline</button>
+  <button data-tab="serve">Serve</button>
   <button data-tab="metrics">Metrics</button>
   <button data-tab="events">Events</button>
   <button data-tab="logs">Logs</button>
@@ -390,6 +391,48 @@ const views = {
   async timeline() {
     const events = await j('/api/timeline');
     return renderTimeline(events);
+  },
+  async serve() {
+    // Serve panel: app/deployment states + per-deployment request /
+    // error / latency series from the metrics registry.
+    const [st, samples] = await Promise.all(
+      [j('/api/serve/applications'), j('/api/metrics_json')]);
+    const byDep = {};
+    for (const m of samples) {
+      const dep = (m.tags || {}).deployment;
+      if (!dep || !m.name.startsWith('serve_deployment_')) continue;
+      const row = byDep[dep] = byDep[dep] ||
+        {requests: 0, errors: 0, latency: null};
+      if (m.name === 'serve_deployment_request_counter')
+        row.requests += m.value;
+      else if (m.name === 'serve_deployment_error_counter')
+        row.errors += m.value;
+      else if (m.name === 'serve_deployment_processing_latency_ms' &&
+               m.count) row.latency = (m.sum / m.count);
+    }
+    const apps = st.applications || {};
+    if (!Object.keys(apps).length)
+      return '<p>no serve applications</p>';
+    let html = '';
+    for (const [app, info] of Object.entries(apps)) {
+      html += `<h3>${esc(app)} ${pill(info.status)} ` +
+        `<span style="font-weight:normal">${esc(info.route_prefix ?? '')}` +
+        `</span></h3>`;
+      const deps = Object.entries(info.deployments || {}).map(
+        ([dn, di]) => ({name: dn, ...di, ...(byDep[dn] || {})}));
+      html += table([
+        ['deployment', r => r.name],
+        ['status', r => pill(r.status)],
+        ['replicas', r => Object.entries(r.replica_states || {})
+          .map(([s, n]) => `${s}:${n}`).join(' ') || '-'],
+        ['requests', r => r.requests ?? 0],
+        ['errors', r => r.errors ?? 0],
+        ['avg latency', r => r.latency != null ?
+          r.latency.toFixed(1) + ' ms' : '-'],
+        ['message', r => r.message || ''],
+      ], deps);
+    }
+    return html;
   },
   async metrics() {
     // Metric explorer (reference: the Grafana panels in the dashboard
