@@ -1,3 +1,5 @@
-"""Algorithms: PPO, APPO, IMPALA, DQN, SAC, CQL, BC, MARWIL,
-multi-agent PPO, DreamerV3 (model-based), DDPG, TD3 (deterministic
-continuous control), ES, ARS (gradient-free evolution), A2C, QMIX (monotonic mixing), AlphaZero (self-play MCTS)."""
+"""Algorithms: PPO, APPO, IMPALA, DQN, Apex-DQN (distributed
+prioritized replay), SAC, CQL, BC, MARWIL, multi-agent PPO, DreamerV3
+(model-based), DDPG, TD3 (deterministic continuous control), ES, ARS
+(gradient-free evolution), A2C, QMIX (monotonic mixing), AlphaZero
+(self-play MCTS)."""

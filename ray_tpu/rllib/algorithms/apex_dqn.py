@@ -1,0 +1,212 @@
+"""Apex-DQN — distributed prioritized experience replay.
+
+Reference: the Ape-X architecture (Horgan et al., ICLR 2018) as shipped
+in rllib_contrib/apex_dqn (ApexDQN over
+rllib/utils/replay_buffers/): decoupled actors — many env runners feed
+SHARDED prioritized replay buffer actors; a central learner samples
+round-robin across shards and pushes TD priorities back to the owning
+shard. This is the algorithm that exercises the actor runtime itself
+(replay shards are plain actors under the FaultTolerantActorManager):
+a killed shard is detected on its next RPC, replaced from the factory
+(empty), and training continues on the surviving experience.
+
+Simplifications vs the paper, recorded: exploration uses the shared
+DQN epsilon schedule rather than per-runner epsilon ladders, and the
+learner is the central local learner (target-net state is
+per-learner, matching DQN here).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.utils.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+
+class ReplayShardActor:
+    """One shard of the distributed prioritized replay buffer."""
+
+    def __init__(self, capacity: int, alpha: float, beta: float,
+                 seed: int):
+        self.buffer = PrioritizedReplayBuffer(capacity, alpha=alpha,
+                                              beta=beta, seed=seed)
+
+    def add(self, cols: Dict[str, np.ndarray]) -> int:
+        self.buffer.add(SampleBatch(cols))
+        return len(self.buffer)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        batch = self.buffer.sample(batch_size)
+        return dict(batch.items())
+
+    def update_priorities(self, idx: np.ndarray,
+                          td_errors: np.ndarray) -> bool:
+        self.buffer.update_priorities(np.asarray(idx),
+                                      np.asarray(td_errors))
+        return True
+
+    def size(self) -> int:
+        return len(self.buffer)
+
+    def ping(self) -> str:
+        return "pong"  # FaultTolerantActorManager health probe
+
+
+class ApexDQNConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.prioritized_replay = True  # Ape-X is PER by definition
+        self.num_replay_shards: int = 2
+        self.replay_shard_capacity: int = 25_000
+        self.per_alpha: float = 0.6
+        self.per_beta: float = 0.4
+        # Distributed sampling is the point: default to remote runners.
+        self.num_env_runners = 2
+
+    @property
+    def algo_class(self):
+        return ApexDQN
+
+
+class ApexDQN(DQN):
+    config_class = ApexDQNConfig
+
+    def setup(self, config) -> None:
+        super().setup(config)
+        cfg = self.config
+        # The local single-process buffer DQN.setup built is unused —
+        # replace it with the shard fleet.
+        self.replay = None
+        remote_cls = ray_tpu.remote(ReplayShardActor)
+
+        def factory(i: int):
+            return remote_cls.options(max_restarts=0).remote(
+                cfg.replay_shard_capacity, cfg.per_alpha, cfg.per_beta,
+                (cfg.seed or 0) + i)
+
+        shards = [factory(i) for i in range(cfg.num_replay_shards)]
+        self.replay_shards = FaultTolerantActorManager(shards, factory)
+        self._next_shard = 0  # round-robin cursor (adds and samples)
+        self._pending_adds: List[Any] = []
+
+    # DQN's replay-dependent state helpers don't apply to shard actors;
+    # checkpoint/restore carries the learner + counters only (replay is
+    # reconstructible experience, the reference drops it too).
+    def get_extra_state(self) -> Dict[str, Any]:
+        return {"env_steps": self._env_steps,
+                "last_target_sync": self._last_target_sync}
+
+    def set_extra_state(self, state: Dict[str, Any]) -> None:
+        if not state:
+            return
+        self._env_steps = state["env_steps"]
+        self._last_target_sync = state["last_target_sync"]
+
+    # ----------------------------------------------------------- internals
+    def _rr_shard_ids(self) -> List[int]:
+        """Healthy shard ids starting at the round-robin cursor."""
+        ids = self.replay_shards.healthy_actor_ids()
+        if not ids:
+            # Every shard died between probes: replace the whole fleet
+            # (empty) rather than deadlocking.
+            self.replay_shards.probe_unhealthy()
+            ids = self.replay_shards.healthy_actor_ids()
+        k = self._next_shard % max(len(ids), 1)
+        return ids[k:] + ids[:k]
+
+    def _total_replay_size(self) -> int:
+        res = self.replay_shards.foreach(lambda a: a.size.remote(),
+                                         timeout_s=10.0)
+        return sum(v for _, v in res.ok)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        rollout = self.env_runner_group.sample(
+            cfg.rollout_fragment_length, epsilon=self._epsilon())
+        self._env_steps += len(rollout)
+
+        # Scatter: this step's experience goes to the next shard
+        # (round-robin at rollout granularity). Fire-and-forget with a
+        # bounded in-flight window — the learner must not stall on
+        # replay ingestion (the Ape-X decoupling).
+        ids = self._rr_shard_ids()
+        if ids:
+            shard = self.replay_shards.actor(ids[0])
+            self._next_shard += 1
+            try:
+                self._pending_adds.append(
+                    shard.add.remote(dict(rollout.items())))
+            except Exception:
+                self.replay_shards._mark_unhealthy(
+                    ids[0], RuntimeError("add failed"))
+        if len(self._pending_adds) > 2 * cfg.num_replay_shards:
+            drain, self._pending_adds = (
+                self._pending_adds[:-cfg.num_replay_shards],
+                self._pending_adds[-cfg.num_replay_shards:])
+            try:
+                ray_tpu.wait(drain, num_returns=len(drain), timeout=10.0)
+            except Exception:
+                pass
+
+        # Replace killed shards (they come back EMPTY; priorities and
+        # contents are experience, not state — regenerated by sampling).
+        restored = self.replay_shards.probe_unhealthy()
+
+        metrics: Dict[str, float] = {
+            "epsilon": self._epsilon(),
+            "replay_shards_healthy":
+                self.replay_shards.num_healthy_actors(),
+            "replay_shards_restored": len(restored),
+        }
+        total = self._total_replay_size()
+        metrics["replay_size"] = total
+        if total >= cfg.num_steps_sampled_before_learning_starts:
+            for _ in range(cfg.updates_per_step):
+                got = None
+                for sid in self._rr_shard_ids():
+                    shard = self.replay_shards.actor(sid)
+                    try:
+                        size = ray_tpu.get(shard.size.remote(),
+                                           timeout=10.0)
+                        if size < cfg.train_batch_size:
+                            continue
+                        got = (sid, shard, ray_tpu.get(
+                            shard.sample.remote(cfg.train_batch_size),
+                            timeout=10.0))
+                        break
+                    except Exception as e:
+                        # Shard died mid-loop (the FT path under test):
+                        # mark it and try the next one.
+                        self.replay_shards._mark_unhealthy(sid, e)
+                self._next_shard += 1
+                if got is None:
+                    break  # no shard has a full batch yet
+                sid, shard, batch = got
+                m = self._learner.update_dqn(batch)
+                td_abs = m.pop("td_abs", None)
+                if td_abs is not None and "batch_indexes" in batch:
+                    try:
+                        shard.update_priorities.remote(
+                            batch["batch_indexes"], td_abs)
+                    except Exception as e:
+                        self.replay_shards._mark_unhealthy(sid, e)
+                metrics.update(m)
+            if self._env_steps - self._last_target_sync >= \
+                    cfg.target_network_update_freq:
+                self._learner.sync_target(cfg.tau)
+                self._last_target_sync = self._env_steps
+            self.env_runner_group.sync_weights(
+                self.learner_group.get_weights())
+        return metrics
+
+    def cleanup(self) -> None:
+        try:
+            self.replay_shards.shutdown()
+        finally:
+            super().cleanup()

@@ -1,0 +1,88 @@
+"""Apex-DQN: distributed prioritized replay over shard actors.
+
+Reference: rllib_contrib/apex_dqn (Ape-X architecture) +
+rllib/utils/replay_buffers/. Done-lines (round-5 verdict #8): learns
+in-suite with >=2 replay shards; survives a replay-actor kill.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ctx = ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _config(**training_overrides):
+    from ray_tpu.rllib.algorithms.apex_dqn import ApexDQNConfig
+
+    kw = dict(train_batch_size=64, lr=5e-4, gamma=0.95,
+              num_steps_sampled_before_learning_starts=200,
+              target_network_update_freq=100,
+              epsilon_decay_steps=1500,
+              rollout_fragment_length=100,
+              num_replay_shards=2,
+              replay_shard_capacity=10_000)
+    kw.update(training_overrides)
+    return (ApexDQNConfig()
+            .environment("GridWorld-v0", env_config={"size": 3})
+            .training(**kw)
+            .env_runners(num_env_runners=2)
+            .debugging(seed=1))
+
+
+def test_apex_dqn_learns_with_sharded_replay():
+    algo = _config().build_algo()
+    try:
+        for _ in range(40):
+            result = algo.step()
+        # Both shards stayed healthy and hold experience.
+        assert result["replay_shards_healthy"] == 2
+        assert result["replay_size"] >= 200
+        ret = result.get("episode_return_mean", float("nan"))
+        assert np.isfinite(ret) and ret > 0.3, result
+        eval_result = algo.evaluate(num_episodes=3)
+        assert eval_result["evaluation"]["episode_return_mean"] > 0.9
+    finally:
+        algo.cleanup()
+
+
+def test_apex_dqn_survives_replay_shard_kill():
+    algo = _config().build_algo()
+    try:
+        for _ in range(10):
+            algo.step()
+        # Kill one shard actor mid-training (the Ape-X FT path).
+        victim_id = algo.replay_shards.healthy_actor_ids()[0]
+        ray_tpu.kill(algo.replay_shards.actor(victim_id))
+        for _ in range(10):
+            result = algo.step()
+        # The dead shard was detected and replaced from the factory
+        # (it comes back empty) and training continued.
+        assert result["replay_shards_healthy"] == 2
+        assert result["replay_size"] > 0
+        assert np.isfinite(result.get("td_error_mean", np.nan))
+        # Learner kept updating after the kill (weights still move).
+        import jax
+
+        w1 = jax.tree_util.tree_leaves(algo.learner_group.get_weights())
+        algo.step()
+        w2 = jax.tree_util.tree_leaves(algo.learner_group.get_weights())
+        assert any(not np.allclose(np.asarray(a), np.asarray(b))
+                   for a, b in zip(w1, w2))
+    finally:
+        algo.cleanup()
+
+
+def test_apex_config_defaults():
+    from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig
+
+    cfg = ApexDQNConfig()
+    assert cfg.prioritized_replay is True
+    assert cfg.num_replay_shards == 2
+    assert cfg.algo_class is ApexDQN
