@@ -1,0 +1,114 @@
+"""Scale envelope: 2,000 actors across a multi-raylet cluster.
+
+Reference envelope row: "40,000 actors cluster-wide"
+(release/benchmarks/README.md:9-31, the many-actor scalability test —
+the reference runs it over hundreds of machine cores; ~2.5 actors per
+core at its published scale). Box-proportional slice on this ONE-core
+host: 2,000 real actor processes created, called, and destroyed across
+4 raylet processes, in rolling waves of 250 concurrent live actors.
+
+Why waves: 250 live Python worker processes is already ~250x core
+oversubscription (the full suite's 400-actor storm runs at the same
+density); an attempt at 2,000 SIMULTANEOUS live workers on one core
+drove load-avg past 700 and starved every event loop — that measures
+the Linux scheduler, not this framework. The cumulative-scale claims —
+2,000 creations through the GCS pipeline, a 2,000-entry actor table
+(plus tombstones), SPREAD placement over 4 raylets, 2,000 distinct
+worker processes and driver connections — are exactly what the waves
+exercise.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def multi_cluster():
+    from ray_tpu.core.config import Config
+    from ray_tpu._private.cluster_utils import Cluster
+
+    cfg = Config.from_env()
+    # Storm-tolerant liveness windows: wave bring-ups on a 1-core box
+    # still starve loops for seconds at a time; the default 10 s health
+    # window would have the GCS declaring healthy raylets dead (the
+    # reference's nightly scale tests make the same tuning through
+    # their system configs).
+    cfg.health_check_failure_threshold = 120
+    cfg.num_heartbeats_timeout = 120
+    cfg.worker_startup_timeout_s = 180.0
+    cfg.worker_register_timeout_s = 180.0
+    # Pool capacity defaults to the node's CPU resource — with CPU=600
+    # per raylet the PRESTART pool alone would spawn ~2,400 processes
+    # before the first actor. The dedicated actor workers are the test;
+    # keep the standing pool tiny.
+    cfg.num_workers_soft_limit = 4
+    c = Cluster(config=cfg)
+    for _ in range(4):
+        c.add_node(resources={"CPU": 600})
+    c.wait_for_nodes(4)
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_2000_actors_multi_raylet(multi_cluster):
+    from ray_tpu._private.worker import global_worker
+
+    # num_cpus=1 (not 0): SPREAD balances by utilization, and
+    # zero-footprint actors would leave every node tied at 0.
+    @ray_tpu.remote(num_cpus=1, max_restarts=2,
+                    scheduling_strategy="SPREAD")
+    class Tiny:
+        def whoami(self):
+            import os
+
+            import ray_tpu
+
+            nid = ray_tpu.get_runtime_context().node_id
+            return (os.getpid(), nid.hex() if nid else "")
+
+    n_total = 2_000
+    wave = 250
+    t0 = time.perf_counter()
+    all_pids = set()
+    all_nodes = set()
+    done = 0
+    while done < n_total:
+        k = min(wave, n_total - done)
+        actors = [Tiny.remote() for _ in range(k)]
+        out = ray_tpu.get([a.whoami.remote() for a in actors],
+                          timeout=600)
+        assert len(out) == k
+        all_pids.update(p for p, _ in out)
+        all_nodes.update(nid for _, nid in out)
+        for a in actors:
+            ray_tpu.kill(a)
+        done += k
+        # Let the kill wave drain before the next bring-up so dying
+        # and starting workers don't fight for the core.
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            views = global_worker().gcs_call("list_actors")
+            if sum(1 for v in views
+                   if v["state"] in ("ALIVE", "RESTARTING")) == 0:
+                break
+            time.sleep(1.0)
+    total_s = time.perf_counter() - t0
+
+    assert done == n_total
+    # Every actor owned its own worker process, cluster-wide.
+    assert len(all_pids) == n_total, (
+        f"{n_total} actors used only {len(all_pids)} distinct workers")
+    # SPREAD over the 4 raylets: every node hosted a real share.
+    assert len(all_nodes) == 4, (
+        f"actors landed on {len(all_nodes)}/4 raylets")
+    # The GCS survived a 2,000-actor lifecycle; its table still answers.
+    views = global_worker().gcs_call("list_actors")
+    assert isinstance(views, list)
+    # Throughput floor keeps the row honest about collapse points:
+    # 2,000 created+called+killed under 15 min wall on one core.
+    assert total_s < 900, f"2000-actor lifecycle took {total_s:.0f}s"
