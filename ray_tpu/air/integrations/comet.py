@@ -1,0 +1,72 @@
+"""Comet ML integration, gated on the ``comet_ml`` package.
+
+Reference: python/ray/air/integrations/comet.py (CometLoggerCallback).
+Same per-trial-experiment shape over this framework's Tune callback
+seam; the dependency-free local tracker (tracking.py) is the in-tree
+default when comet is absent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.logger import LoggerCallback, _flatten
+
+
+def _import_comet():
+    try:
+        import comet_ml
+    except ImportError as e:
+        raise ImportError(
+            "comet_ml is not installed. `pip install comet-ml`, or use "
+            "the dependency-free in-tree tracker: "
+            "ray_tpu.air.integrations.setup_tracking / "
+            "TrackingLoggerCallback") from e
+    return comet_ml
+
+
+class CometLoggerCallback(LoggerCallback):
+    """Tune callback: one comet Experiment per trial."""
+
+    def __init__(self, online: bool = True,
+                 tags: Optional[List[str]] = None,
+                 **experiment_kwargs):
+        super().__init__()
+        self._comet = _import_comet()
+        self._online = online
+        self._tags = list(tags or [])
+        self._kwargs = experiment_kwargs
+        self._experiments: Dict[str, Any] = {}
+
+    def _exp_for(self, trial):
+        exp = self._experiments.get(trial.trial_id)
+        if exp is None:
+            cls = (self._comet.Experiment if self._online
+                   else self._comet.OfflineExperiment)
+            exp = cls(**self._kwargs)
+            exp.set_name(f"trial_{trial.trial_id}")
+            exp.add_tags(self._tags)
+            exp.log_parameters(_flatten(trial.config))
+            self._experiments[trial.trial_id] = exp
+        return exp
+
+    def on_trial_start(self, trial) -> None:
+        self._exp_for(trial)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        exp = self._exp_for(trial)
+        step = result.get("training_iteration")
+        metrics = {k: v for k, v in _flatten(result).items()
+                   if isinstance(v, (int, float))
+                   and not isinstance(v, bool)}
+        exp.log_metrics(metrics, step=step)
+
+    def on_trial_complete(self, trial) -> None:
+        exp = self._experiments.pop(trial.trial_id, None)
+        if exp is not None:
+            exp.end()
+
+    def on_experiment_end(self, trials: List) -> None:
+        for exp in self._experiments.values():
+            exp.end()
+        self._experiments.clear()

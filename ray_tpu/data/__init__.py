@@ -15,10 +15,11 @@ from ray_tpu.data.dataset import (DataIterator, Dataset, from_arrow,
                                   from_dask, from_huggingface,
                                   from_items, from_numpy, from_pandas,
                                   from_torch, range, read_avro,
-                                  read_binary_files, read_csv,
-                                  read_images, read_json, read_numpy,
-                                  read_parquet, read_parquet_bulk,
-                                  read_sql, read_text, read_tfrecords,
+                                  read_bigquery, read_binary_files,
+                                  read_csv, read_images, read_json,
+                                  read_mongo, read_numpy, read_parquet,
+                                  read_parquet_bulk, read_sql,
+                                  read_text, read_tfrecords,
                                   read_webdataset)
 from ray_tpu.data import preprocessors
 
@@ -36,10 +37,12 @@ __all__ = [
     "preprocessors",
     "range",
     "read_avro",
+    "read_bigquery",
     "read_binary_files",
     "read_csv",
     "read_json",
     "read_images",
+    "read_mongo",
     "read_numpy",
     "read_parquet",
     "read_parquet_bulk",

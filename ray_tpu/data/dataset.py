@@ -1106,6 +1106,23 @@ def read_avro(paths) -> Dataset:
     return Dataset(datasource.avro_tasks(paths))
 
 
+def read_bigquery(project_id: str, *, dataset: Optional[str] = None,
+                  query: Optional[str] = None) -> Dataset:
+    """BigQuery table/query (reference: read_api.py:546 read_bigquery).
+    Gated on google-cloud-bigquery."""
+    return Dataset(datasource.bigquery_tasks(project_id,
+                                             dataset=dataset,
+                                             query=query))
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline=None) -> Dataset:
+    """MongoDB collection/pipeline (reference: read_api.py:446
+    read_mongo). Gated on pymongo."""
+    return Dataset(datasource.mongo_tasks(uri, database, collection,
+                                          pipeline=pipeline))
+
+
 def read_text(paths) -> Dataset:
     return Dataset(datasource.text_tasks(paths))
 

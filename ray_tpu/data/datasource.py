@@ -414,6 +414,57 @@ def avro_tasks(paths) -> List[ReadTask]:
     return [make(f) for f in files]
 
 
+def bigquery_tasks(project_id: str, dataset: Optional[str] = None,
+                   query: Optional[str] = None) -> List[ReadTask]:
+    """BigQuery read (reference: read_api.py:546 read_bigquery). Gated
+    on google-cloud-bigquery; one task runs the query (or a full-table
+    scan of ``dataset``) and pages rows into a block."""
+    if bool(dataset) == bool(query):
+        raise ValueError("pass exactly one of dataset= or query=")
+
+    def read() -> Block:
+        try:
+            from google.cloud import bigquery  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "read_bigquery requires the 'google-cloud-bigquery' "
+                "package") from e
+        client = bigquery.Client(project=project_id)
+        q = query or f"SELECT * FROM `{dataset}`"  # noqa: S608
+        rows = [dict(r) for r in client.query(q).result()]
+        return block_from_items(rows)
+
+    return [read]
+
+
+def mongo_tasks(uri: str, database: str, collection: str,
+                pipeline: Optional[List[dict]] = None) -> List[ReadTask]:
+    """MongoDB read (reference: read_api.py:446 read_mongo). Gated on
+    pymongo; one task per call runs the aggregation pipeline (or a full
+    find) inside the worker."""
+
+    def read() -> Block:
+        try:
+            import pymongo
+        except ImportError as e:
+            raise ImportError(
+                "read_mongo requires the 'pymongo' package") from e
+        client = pymongo.MongoClient(uri)
+        try:
+            coll = client[database][collection]
+            cursor = (coll.aggregate(pipeline) if pipeline
+                      else coll.find())
+            rows = []
+            for doc in cursor:
+                doc.pop("_id", None)
+                rows.append(dict(doc))
+        finally:
+            client.close()
+        return block_from_items(rows)
+
+    return [read]
+
+
 # ---------------------------------------------------------- partitioning
 def parse_hive_partitions(file_path: str, base_path: str
                           ) -> Dict[str, str]:

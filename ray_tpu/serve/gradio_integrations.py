@@ -1,0 +1,61 @@
+"""Gradio integration, gated on the ``gradio`` package.
+
+Reference: python/ray/serve/gradio_integrations.py:18 (GradioServer —
+wrap a Gradio Blocks app as a Serve deployment so it scales/replicates
+like any deployment; GradioIngress for composing with handles).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ray_tpu import serve
+
+
+def _import_gradio():
+    try:
+        import gradio
+    except ImportError as e:
+        raise ImportError(
+            "gradio is not installed (`pip install gradio`); "
+            "GradioServer wraps a gradio Blocks app as a Serve "
+            "deployment") from e
+    return gradio
+
+
+class GradioIngress:
+    """Base for deployments that front a Gradio app: the builder
+    returns a ``gradio.Blocks``; requests route into its ASGI app."""
+
+    def __init__(self, builder: Callable[[], Any]):
+        gradio = _import_gradio()
+        self._blocks = builder()
+        if not isinstance(self._blocks, gradio.Blocks):
+            raise TypeError(
+                f"builder must return gradio.Blocks, got "
+                f"{type(self._blocks).__name__}")
+        self._app = gradio.routes.App.create_app(self._blocks)
+
+    async def __call__(self, request):
+        """Delegate the HTTP request into gradio's ASGI app through the
+        serve ASGI bridge."""
+        from ray_tpu.serve.asgi import run_asgi
+
+        return await run_asgi(self._app, request)
+
+
+def GradioServer(builder: Callable[[], Any]):
+    """A ready-to-bind Serve deployment hosting the Gradio app
+    (reference: GradioServer). Usage:
+
+        app = GradioServer(lambda: build_my_blocks()).bind()
+        serve.run(app)
+    """
+    _import_gradio()  # fail at build time, not replica start
+
+    @serve.deployment(name="GradioServer")
+    class _GradioServer(GradioIngress):
+        def __init__(self):
+            super().__init__(builder)
+
+    return _GradioServer

@@ -174,6 +174,37 @@ def test_partition_cols_missing_column(tmp_path, ray_session):
             str(tmp_path / "x"), partition_cols=["nope"])
 
 
+# -------------------------------------------------- bigquery/mongo gating
+def test_read_bigquery_mongo_gated(ray_session):
+    """Cloud-DB readers exist and gate with actionable ImportErrors in
+    this hermetic image (reference: read_api.py:546 read_bigquery,
+    :446 read_mongo)."""
+    with pytest.raises(ValueError, match="exactly one"):
+        rd.read_bigquery("proj")
+    try:
+        from google.cloud import bigquery  # noqa: F401
+    except ImportError:
+        ds = rd.read_bigquery("proj", query="SELECT 1")
+        with pytest.raises(Exception, match="bigquery"):
+            ds.take_all()
+    try:
+        import pymongo  # noqa: F401
+    except ImportError:
+        ds = rd.read_mongo("mongodb://x", "db", "coll")
+        with pytest.raises(Exception, match="pymongo"):
+            ds.take_all()
+
+
+def test_serve_gradio_gated():
+    try:
+        import gradio  # noqa: F401
+    except ImportError:
+        from ray_tpu.serve.gradio_integrations import GradioServer
+
+        with pytest.raises(ImportError, match="gradio"):
+            GradioServer(lambda: None)
+
+
 # ------------------------------------------------------------- from_dask
 def test_from_dask_gated(ray_session):
     try:

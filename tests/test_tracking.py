@@ -256,6 +256,63 @@ def test_setup_wandb_imperative(fake_wandb):
     assert os.environ.get("WANDB_MODE") == "offline"
 
 
+# -------------------------------------------------------------- fake comet
+def test_comet_logger_callback(tmp_path):
+    class _FakeExperiment:
+        instances = []
+
+        def __init__(self, **kw):
+            self.kw = kw
+            self.name = None
+            self.tags = []
+            self.params = {}
+            self.metrics = []
+            self.ended = False
+            _FakeExperiment.instances.append(self)
+
+        def set_name(self, name):
+            self.name = name
+
+        def add_tags(self, tags):
+            self.tags.extend(tags)
+
+        def log_parameters(self, params):
+            self.params.update(params)
+
+        def log_metrics(self, metrics, step=None):
+            self.metrics.append((dict(metrics), step))
+
+        def end(self):
+            self.ended = True
+
+    mod = types.ModuleType("comet_ml")
+    mod.Experiment = _FakeExperiment
+    mod.OfflineExperiment = _FakeExperiment
+    _FakeExperiment.instances = []
+    sys.modules["comet_ml"] = mod
+    try:
+        from ray_tpu.air.integrations.comet import CometLoggerCallback
+
+        results = Tuner(
+            _objective,
+            param_space={"x": tune.grid_search([0.0, 0.1])},
+            tune_config=TuneConfig(metric="acc", mode="max"),
+            run_config=RunConfig(
+                storage_path=str(tmp_path),
+                callbacks=[CometLoggerCallback(tags=["ci"])]),
+        ).fit()
+        assert len(results) == 2 and results.num_errors == 0
+        exps = _FakeExperiment.instances
+        assert len(exps) == 2
+        for e in exps:
+            assert e.ended and e.tags == ["ci"]
+            assert e.params["x"] in (0.0, 0.1)
+            accs = [m for m, _ in e.metrics if "acc" in m]
+            assert len(accs) == 3
+    finally:
+        del sys.modules["comet_ml"]
+
+
 # ---------------------------------------------------------------- gating
 def test_adapters_gate_without_packages():
     """Hermetic image: imports succeed, construction raises actionable
@@ -282,3 +339,10 @@ def test_adapters_gate_without_packages():
             WandbLoggerCallback()
         with pytest.raises(ImportError, match="setup_tracking"):
             setup_wandb({})
+    from ray_tpu.air.integrations.comet import CometLoggerCallback
+
+    try:
+        import comet_ml  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="comet"):
+            CometLoggerCallback()
