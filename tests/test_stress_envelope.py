@@ -24,6 +24,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.stress  # run with -m stress (see pytest.ini)
+
 
 @pytest.fixture(scope="module")
 def multi_cluster():

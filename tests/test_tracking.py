@@ -14,9 +14,20 @@ import types
 
 import pytest
 
+import ray_tpu
 from ray_tpu import tune
 from ray_tpu.air.config import RunConfig
 from ray_tpu.tune import TuneConfig, Tuner
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    # Explicit cluster + shutdown: without this, the first Tuner
+    # auto-inits a 1-CPU session that would LEAK into later test
+    # modules and starve their multi-worker gangs.
+    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ctx
+    ray_tpu.shutdown()
 
 
 def _objective(config):
