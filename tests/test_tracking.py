@@ -21,13 +21,12 @@ from ray_tpu.tune import TuneConfig, Tuner
 
 
 @pytest.fixture(scope="module", autouse=True)
-def _cluster():
-    # Explicit cluster + shutdown: without this, the first Tuner
-    # auto-inits a 1-CPU session that would LEAK into later test
-    # modules and starve their multi-worker gangs.
-    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
-    yield ctx
-    ray_tpu.shutdown()
+def _cluster(ray_start_regular):
+    # Explicit cluster + shutdown (via the shared conftest fixture):
+    # without this, the first Tuner auto-inits a 1-CPU session that
+    # would LEAK into later test modules and starve their multi-worker
+    # gangs.
+    yield ray_start_regular
 
 
 def _objective(config):
