@@ -1,0 +1,74 @@
+"""Aim logger, gated on the ``aim`` package.
+
+Reference: python/ray/tune/logger/aim.py:26 (AimLoggerCallback — one
+aim.Run per trial, params as run attributes, metrics tracked per
+step). The dependency-free local tracker
+(ray_tpu.air.integrations.tracking) is the in-tree default.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.logger import LoggerCallback, _flatten
+
+
+def _import_aim():
+    try:
+        import aim
+    except ImportError as e:
+        raise ImportError(
+            "aim is not installed (`pip install aim`); or use the "
+            "dependency-free in-tree tracker: "
+            "ray_tpu.air.integrations.TrackingLoggerCallback") from e
+    return aim
+
+
+class AimLoggerCallback(LoggerCallback):
+    """Tune callback: one aim.Run per trial."""
+
+    def __init__(self, repo: Optional[str] = None,
+                 experiment: Optional[str] = None,
+                 metrics: Optional[List[str]] = None,
+                 **run_kwargs):
+        super().__init__()
+        self._aim = _import_aim()
+        self._repo = repo
+        self._experiment = experiment
+        self._metrics = set(metrics) if metrics else None
+        self._run_kwargs = run_kwargs
+        self._runs: Dict[str, Any] = {}
+
+    def _run_for(self, trial):
+        run = self._runs.get(trial.trial_id)
+        if run is None:
+            run = self._aim.Run(
+                repo=self._repo or trial.experiment_dir,
+                experiment=self._experiment, **self._run_kwargs)
+            run["trial_id"] = trial.trial_id
+            run["hparams"] = {k: v for k, v in
+                              _flatten(trial.config).items()}
+            self._runs[trial.trial_id] = run
+        return run
+
+    def on_trial_start(self, trial) -> None:
+        self._run_for(trial)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        run = self._run_for(trial)
+        step = result.get("training_iteration")
+        for k, v in _flatten(result).items():
+            if self._metrics is not None and k not in self._metrics:
+                continue
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                run.track(v, name=k, step=step)
+
+    def on_trial_complete(self, trial) -> None:
+        run = self._runs.pop(trial.trial_id, None)
+        if run is not None:
+            run.close()
+
+    def on_experiment_end(self, trials: List) -> None:
+        for run in self._runs.values():
+            run.close()
+        self._runs.clear()

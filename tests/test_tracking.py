@@ -356,3 +356,10 @@ def test_adapters_gate_without_packages():
     except ImportError:
         with pytest.raises(ImportError, match="comet"):
             CometLoggerCallback()
+    from ray_tpu.tune.logger_aim import AimLoggerCallback
+
+    try:
+        import aim  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="aim"):
+            AimLoggerCallback()
