@@ -28,6 +28,15 @@ from ray_tpu.models.moe import (
     moe_loss,
     moe_param_specs,
 )
+from ray_tpu.models.t5 import (
+    T5Config,
+    t5_init,
+    t5_forward,
+    t5_encode,
+    t5_decode,
+    t5_loss,
+    t5_param_specs,
+)
 
 __all__ = [
     "LlamaConfig",
@@ -48,4 +57,11 @@ __all__ = [
     "moe_forward",
     "moe_loss",
     "moe_param_specs",
+    "T5Config",
+    "t5_init",
+    "t5_forward",
+    "t5_encode",
+    "t5_decode",
+    "t5_loss",
+    "t5_param_specs",
 ]

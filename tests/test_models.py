@@ -159,3 +159,68 @@ def test_vit_forward_and_sharded_training():
     # half-sized on both matrix dims ([layers, d/fsdp, 3d/tp]).
     shard = params["layers"]["wqkv"].addressable_shards[0].data
     assert shard.shape == (2, 32 // 2, 3 * 32 // 2), shard.shape
+
+
+def test_t5_forward_and_sharded_training():
+    """Encoder-decoder family: forward shapes, teacher-forcing loss with
+    pad masking, GSPMD-sharded train step on the 8-device mesh, loss
+    decreases, params actually partitioned, causal decoder semantics."""
+    import optax
+
+    from ray_tpu.models import (T5Config, t5_decode, t5_encode, t5_init,
+                                t5_loss, t5_param_specs)
+    from ray_tpu.models.t5 import t5_forward
+    from ray_tpu.models.training import make_sharded_train_step
+    from ray_tpu.parallel import MeshSpec, create_mesh
+
+    cfg = T5Config(vocab_size=64, dim=32, n_layers=2, n_heads=4,
+                   ffn_dim=64, dtype=jnp.float32)
+    assert cfg.num_params() > 0
+    params = t5_init(jax.random.PRNGKey(0), cfg)
+    src = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 1, 64)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (2, 7), 1, 64)
+    memory = t5_encode(params, src, cfg)
+    assert memory.shape == (2, 10, 32)
+    logits = t5_decode(params, memory, tgt, cfg)
+    assert logits.shape == (2, 7, 64)
+
+    # Decoder is causal: changing a LATE target token must not change
+    # logits at earlier positions (cross-attention sees all of src).
+    tgt2 = tgt.at[:, -1].set((tgt[:, -1] + 1) % 64)
+    logits2 = t5_decode(params, memory, tgt2, cfg)
+    np.testing.assert_allclose(np.asarray(logits[:, :-1]),
+                               np.asarray(logits2[:, :-1]),
+                               rtol=1e-5, atol=1e-5)
+    # ...and the encoder is NOT causal: a late src change reaches
+    # every decoder position through cross-attention.
+    src2 = src.at[:, -1].set((src[:, -1] + 1) % 64)
+    logits3 = t5_forward(params, src2, tgt, cfg)
+    assert not np.allclose(np.asarray(logits), np.asarray(logits3))
+
+    # Pad labels drop out of the loss.
+    batch = {"src": src,
+             "tgt": jnp.concatenate(
+                 [tgt, jnp.zeros((2, 2), tgt.dtype)], axis=1)}
+    loss_padded = t5_loss(params, batch, cfg)
+    assert jnp.isfinite(loss_padded)
+
+    # Sharded training: copy-task (tgt == src prefix) on the 8-dev mesh.
+    mesh = create_mesh(MeshSpec(dp=2, fsdp=2, tp=2).resolve(8))
+    init_fn, step_fn = make_sharded_train_step(
+        lambda p, b: t5_loss(p, b, cfg),
+        optax.adamw(3e-3), mesh, t5_param_specs(cfg))
+    params, opt_state = init_fn(params)
+    seq = jax.random.randint(jax.random.PRNGKey(3), (8, 8), 1, 64)
+    train_batch = {"src": seq,
+                   "tgt": jnp.concatenate(
+                       [jnp.ones((8, 1), seq.dtype), seq], axis=1)}
+    losses = []
+    for _ in range(10):
+        params, opt_state, metrics = step_fn(params, opt_state,
+                                             train_batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+    # Cross-attn q projection ACTUALLY partitioned:
+    # [layers, d/fsdp, heads/tp, k].
+    shard = params["decoder"]["cross_wq"].addressable_shards[0].data
+    assert shard.shape == (2, 32 // 2, 4 // 2, 8), shard.shape
