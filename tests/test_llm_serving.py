@@ -1,0 +1,55 @@
+"""End-to-end LLM serving: the generation loop behind a Serve
+deployment — the flagship deployment story (reference users serve
+torch LMs through Serve; here the decode path is in-tree and
+TPU-shaped: one jitted prefill+scan program, static shapes)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def serve_instance():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    serve.start(proxy=False)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_serve_llm_generate():
+    @serve.deployment
+    class NanoLM:
+        def __init__(self):
+            import jax
+
+            from ray_tpu.models import LlamaConfig, llama_init
+
+            self.cfg = LlamaConfig.nano()
+            self.params = llama_init(jax.random.PRNGKey(0), self.cfg)
+
+        def generate(self, token_ids, max_new_tokens=8):
+            import jax.numpy as jnp
+
+            from ray_tpu.models.generate import generate
+
+            prompt = jnp.asarray([token_ids], jnp.int32)
+            out = generate(self.params, prompt, self.cfg,
+                           max_new_tokens=max_new_tokens)
+            return np.asarray(out)[0].tolist()
+
+    handle = serve.run(NanoLM.bind(), name="nanolm", route_prefix=None,
+                       _proxy=False)
+    prompt = [1, 2, 3, 4]
+    out = handle.generate.remote(prompt, max_new_tokens=6).result(
+        timeout_s=180)
+    assert out[:4] == prompt and len(out) == 10
+    assert all(0 <= t < 256 for t in out)
+    # Deterministic greedy decode across calls (replica reuses the
+    # compiled program; second call is the cached-compile fast path).
+    out2 = handle.generate.remote(prompt, max_new_tokens=6).result(
+        timeout_s=60)
+    assert out2 == out
+    serve.delete("nanolm")
