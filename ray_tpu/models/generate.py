@@ -34,11 +34,13 @@ def init_cache(cfg: LlamaConfig, batch_size: int,
             "v": jnp.zeros(shape, cfg.dtype)}
 
 
-def _cached_attention(q, k_cache, v_cache, q_positions, kv_valid_len,
-                      cfg: LlamaConfig):
-    """q: [B, S, H, D]; caches [B, max_len, KV, D]. Attends q (at
-    absolute positions q_positions) over cache slots < kv_valid_len,
-    causally (slot index <= query position)."""
+def _cached_attention(q, k_cache, v_cache, q_slots, kv_valid_len,
+                      cfg: LlamaConfig, slot_live=None):
+    """q: [B, S, H, D]; caches [B, max_len, KV, D]. Attends q (written
+    at cache slots q_slots [B, S]) over cache slots < kv_valid_len,
+    causally (slot index <= query slot). ``slot_live`` [B, max_len]
+    (optional) additionally masks dead slots — left-pad positions in a
+    ragged batch."""
     B, S, H, D = q.shape
     max_len = k_cache.shape[1]
     rep = H // k_cache.shape[2]
@@ -48,8 +50,10 @@ def _cached_attention(q, k_cache, v_cache, q_positions, kv_valid_len,
                         preferred_element_type=jnp.float32)
     logits = logits * (D ** -0.5)
     slots = jnp.arange(max_len)
-    mask = (slots[None, None, None, :] <= q_positions[:, None, :, None]) \
+    mask = (slots[None, None, None, :] <= q_slots[:, None, :, None]) \
         & (slots[None, None, None, :] < kv_valid_len)
+    if slot_live is not None:
+        mask = mask & slot_live[:, None, None, :]
     logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     out = jnp.einsum("bhst,bthd->bshd", probs, v,
@@ -57,11 +61,14 @@ def _cached_attention(q, k_cache, v_cache, q_positions, kv_valid_len,
     return out.astype(q.dtype)
 
 
-def _cached_layer(h, layer, k_cache, v_cache, positions, start,
-                  kv_valid_len, cfg: LlamaConfig):
+def _cached_layer(h, layer, k_cache, v_cache, positions, slot_ids,
+                  start, kv_valid_len, cfg: LlamaConfig,
+                  slot_live=None):
     """One decoder layer over a chunk [B, S, d] whose K/V are WRITTEN
-    into the cache at slots [start, start+S); returns (h, k_cache,
-    v_cache)."""
+    into the cache at slots [start, start+S); ``positions`` are the
+    ROPE position ids (per-row, pad-adjusted in ragged batches) while
+    ``slot_ids`` are the cache slot indices the chunk occupies.
+    Returns (h, k_cache, v_cache)."""
     dt = cfg.dtype
     x = _rmsnorm(h, layer["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", x, layer["wq"].astype(dt))
@@ -73,8 +80,8 @@ def _cached_layer(h, layer, k_cache, v_cache, positions, start,
         k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(
         v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
-    o = _cached_attention(q, k_cache, v_cache, positions, kv_valid_len,
-                          cfg)
+    o = _cached_attention(q, k_cache, v_cache, slot_ids, kv_valid_len,
+                          cfg, slot_live=slot_live)
     h = h + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
     x = _rmsnorm(h, layer["mlp_norm"], cfg.norm_eps)
     gate = jnp.einsum("bsd,df->bsf", x, layer["w_gate"].astype(dt))
@@ -85,22 +92,30 @@ def _cached_layer(h, layer, k_cache, v_cache, positions, start,
 
 
 def forward_cached(params: Params, tokens: jax.Array, cache: Cache,
-                   start, cfg: LlamaConfig
+                   start, cfg: LlamaConfig, *,
+                   positions: Optional[jax.Array] = None,
+                   slot_live: Optional[jax.Array] = None
                    ) -> Tuple[jax.Array, Cache]:
-    """Run a token chunk [B, S] at absolute offset `start` (traced
-    scalar ok), writing its K/V into the cache. Returns
+    """Run a token chunk [B, S] at cache offset `start` (traced scalar
+    ok), writing its K/V into the cache. Returns
     (logits [B, S, vocab] f32, updated cache). Prefill is one call with
-    the whole prompt; decode is S=1 calls."""
+    the whole prompt; decode is S=1 calls. ``positions`` overrides the
+    RoPE position ids (ragged batches: left-pad rows start their real
+    tokens at position 0); ``slot_live`` [B, max_len] masks dead (pad)
+    cache slots out of every attention."""
     B, S = tokens.shape
     h = params["tok_embed"].astype(cfg.dtype)[tokens]
-    positions = start + jnp.broadcast_to(jnp.arange(S), (B, S))
+    slot_ids = start + jnp.broadcast_to(jnp.arange(S), (B, S))
+    if positions is None:
+        positions = slot_ids
     kv_valid_len = start + S
 
     def body(carry, xs):
         h = carry
         layer, k_c, v_c = xs
         h, k_c, v_c = _cached_layer(h, layer, k_c, v_c, positions,
-                                    start, kv_valid_len, cfg)
+                                    slot_ids, start, kv_valid_len, cfg,
+                                    slot_live=slot_live)
         return h, (k_c, v_c)
 
     h, (k_new, v_new) = jax.lax.scan(
@@ -117,6 +132,7 @@ def forward_cached(params: Params, tokens: jax.Array, cache: Cache,
 def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
              max_new_tokens: int = 32, temperature: float = 1.0,
              greedy: bool = True, eos_id: Optional[int] = None,
+             prompt_live: Optional[jax.Array] = None,
              rng: Optional[jax.Array] = None) -> jax.Array:
     """prompt [B, P] int32 -> [B, P + max_new_tokens] int32.
 
@@ -124,7 +140,14 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
     `lax.scan` emits max_new_tokens steps (static trip count — XLA
     unrolls nothing, reuses one step computation). With eos_id set,
     finished rows keep emitting eos (scan trip count stays static; the
-    caller trims)."""
+    caller trims).
+
+    Ragged batches: LEFT-pad prompts to a common length and pass
+    ``prompt_live`` [B, P] (True = real token). Pad slots are masked
+    out of every attention, RoPE positions start at 0 on each row's
+    first real token, and every row's last real token lands on slot
+    P-1 — so the uniform decode loop serves rows of different prompt
+    lengths in one program (see ``pad_prompts``)."""
     B, P = prompt.shape
     max_len = P + max_new_tokens
     if max_len > cfg.max_seq_len:
@@ -133,7 +156,21 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     cache = init_cache(cfg, B, max_len)
 
-    logits, cache = forward_cached(params, prompt, cache, 0, cfg)
+    if prompt_live is not None:
+        live = prompt_live.astype(bool)
+        positions = jnp.maximum(
+            jnp.cumsum(live.astype(jnp.int32), axis=1) - 1, 0)
+        slot_live = jnp.concatenate(
+            [live, jnp.ones((B, max_new_tokens), bool)], axis=1)
+        n_real = live.sum(axis=1).astype(jnp.int32)          # [B]
+    else:
+        positions = None
+        slot_live = None
+        n_real = jnp.full((B,), P, jnp.int32)
+
+    logits, cache = forward_cached(params, prompt, cache, 0, cfg,
+                                   positions=positions,
+                                   slot_live=slot_live)
     last = logits[:, -1]
 
     def sample(logits_row, key):
@@ -142,18 +179,36 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
         scaled = logits_row / jnp.maximum(temperature, 1e-6)
         return jax.random.categorical(key, scaled).astype(jnp.int32)
 
-    def step(carry, key):
-        cache, last_logits, pos, done = carry
+    def step(carry, xs):
+        cache, last_logits, slot, pos_ids, done = carry
+        key, = xs
         tok = sample(last_logits, key)
         if eos_id is not None:
             tok = jnp.where(done, eos_id, tok)
             done = done | (tok == eos_id)
         logits, cache = forward_cached(
-            params, tok[:, None], cache, pos, cfg)
-        return (cache, logits[:, 0], pos + 1, done), tok
+            params, tok[:, None], cache, slot, cfg,
+            positions=pos_ids[:, None], slot_live=slot_live)
+        return (cache, logits[:, 0], slot + 1, pos_ids + 1, done), tok
 
     keys = jax.random.split(rng, max_new_tokens)
     done0 = jnp.zeros((B,), bool)
-    (_, _, _, _), toks = jax.lax.scan(
-        step, (cache, last, P, done0), keys)
+    (_, _, _, _, _), toks = jax.lax.scan(
+        step, (cache, last, P, n_real, done0), (keys,))
     return jnp.concatenate([prompt, toks.T], axis=1)
+
+
+def pad_prompts(prompts, pad_id: int = 0):
+    """Left-pad a ragged list of token lists to a dense [B, P] array +
+    the matching ``prompt_live`` mask for `generate`."""
+    import numpy as np
+
+    P = max(len(p) for p in prompts)
+    B = len(prompts)
+    out = np.full((B, P), pad_id, np.int32)
+    live = np.zeros((B, P), bool)
+    for i, p in enumerate(prompts):
+        if p:
+            out[i, P - len(p):] = np.asarray(p, np.int32)
+            live[i, P - len(p):] = True
+    return out, live

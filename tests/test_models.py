@@ -290,3 +290,28 @@ def test_llama_kv_cache_generation():
                                eos_id=eos))
     hit = np.asarray(out3[0, P:]) == eos
     assert hit[0] and hit.all()
+
+
+def test_llama_ragged_batch_generation():
+    """Ragged serving: left-padded batched decode must produce EXACTLY
+    the tokens each row would get generated alone (pad slots masked
+    out of attention, RoPE positions pad-adjusted)."""
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.generate import generate, pad_prompts
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    p0 = [5, 6, 7]
+    p1 = [9, 8, 7, 6, 5, 4]
+    padded, live = pad_prompts([p0, p1])
+    assert padded.shape == (2, 6) and live[0].sum() == 3
+
+    out = np.asarray(generate(params, jnp.asarray(padded), cfg,
+                              max_new_tokens=4,
+                              prompt_live=jnp.asarray(live)))
+    s0 = np.asarray(generate(params, jnp.asarray([p0], jnp.int32),
+                             cfg, max_new_tokens=4))
+    s1 = np.asarray(generate(params, jnp.asarray([p1], jnp.int32),
+                             cfg, max_new_tokens=4))
+    np.testing.assert_array_equal(out[0, -4:], s0[0, -4:])
+    np.testing.assert_array_equal(out[1, -4:], s1[0, -4:])
