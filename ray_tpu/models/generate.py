@@ -179,9 +179,8 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
         scaled = logits_row / jnp.maximum(temperature, 1e-6)
         return jax.random.categorical(key, scaled).astype(jnp.int32)
 
-    def step(carry, xs):
+    def step(carry, key):
         cache, last_logits, slot, pos_ids, done = carry
-        key, = xs
         tok = sample(last_logits, key)
         if eos_id is not None:
             tok = jnp.where(done, eos_id, tok)
@@ -194,21 +193,42 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
     keys = jax.random.split(rng, max_new_tokens)
     done0 = jnp.zeros((B,), bool)
     (_, _, _, _, _), toks = jax.lax.scan(
-        step, (cache, last, P, n_real, done0), (keys,))
+        step, (cache, last, P, n_real, done0), keys)
     return jnp.concatenate([prompt, toks.T], axis=1)
 
 
-def pad_prompts(prompts, pad_id: int = 0):
+def pad_prompts(prompts, pad_id: int = 0, *, bucket_len: bool = False,
+                pad_batch_to: Optional[int] = None):
     """Left-pad a ragged list of token lists to a dense [B, P] array +
-    the matching ``prompt_live`` mask for `generate`."""
+    the matching ``prompt_live`` mask for `generate`.
+
+    Empty prompts are rejected: a fully-dead row has no last real
+    token to sample from (its attention would be all-masked garbage) —
+    prepend a BOS token instead.
+
+    Serving knobs (jit-cache hygiene — every distinct (B, P) pair is a
+    separate XLA compile): ``bucket_len=True`` rounds P up to the next
+    power of two, and ``pad_batch_to=N`` appends single-token filler
+    rows up to batch N (the CALLER slices its outputs back to the real
+    row count) — together a handful of compiles cover all traffic."""
     import numpy as np
 
-    P = max(len(p) for p in prompts)
-    B = len(prompts)
-    out = np.full((B, P), pad_id, np.int32)
-    live = np.zeros((B, P), bool)
-    for i, p in enumerate(prompts):
-        if p:
-            out[i, P - len(p):] = np.asarray(p, np.int32)
-            live[i, P - len(p):] = True
+    if not prompts:
+        raise ValueError("pad_prompts needs at least one prompt")
+    if any(len(p) == 0 for p in prompts):
+        raise ValueError(
+            "empty prompt: generation needs at least one real token "
+            "per row (prepend a BOS token)")
+    n_rows = len(prompts)
+    rows = list(prompts)
+    if pad_batch_to is not None and n_rows < pad_batch_to:
+        rows += [[pad_id]] * (pad_batch_to - n_rows)
+    P = max(len(p) for p in rows)
+    if bucket_len:
+        P = 1 << (P - 1).bit_length()
+    out = np.full((len(rows), P), pad_id, np.int32)
+    live = np.zeros((len(rows), P), bool)
+    for i, p in enumerate(rows):
+        out[i, P - len(p):] = np.asarray(p, np.int32)
+        live[i, P - len(p):] = True
     return out, live

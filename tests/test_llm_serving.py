@@ -80,7 +80,11 @@ def test_serve_llm_dynamic_batched_ragged():
             from ray_tpu.models.generate import generate, pad_prompts
 
             self.batch_sizes.append(len(prompts))
-            padded, live = pad_prompts(prompts)
+            # Bucketed shapes: P to a power of two, B to the batch
+            # cap — a handful of XLA compiles cover all traffic
+            # (every distinct (B, P) is a separate jit compile).
+            padded, live = pad_prompts(prompts, bucket_len=True,
+                                       pad_batch_to=8)
             out = np.asarray(generate(
                 self.params, jnp.asarray(padded), self.cfg,
                 max_new_tokens=4, prompt_live=jnp.asarray(live)))

@@ -315,3 +315,21 @@ def test_llama_ragged_batch_generation():
                              cfg, max_new_tokens=4))
     np.testing.assert_array_equal(out[0, -4:], s0[0, -4:])
     np.testing.assert_array_equal(out[1, -4:], s1[0, -4:])
+
+    # Serving-shape bucketing: P rounds to a power of two, filler rows
+    # bring B to the cap; real rows are unaffected.
+    b_padded, b_live = pad_prompts([p0, p1], bucket_len=True,
+                                   pad_batch_to=4)
+    assert b_padded.shape == (4, 8) and b_live[2].sum() == 1
+    out_b = np.asarray(generate(params, jnp.asarray(b_padded), cfg,
+                                max_new_tokens=4,
+                                prompt_live=jnp.asarray(b_live)))
+    np.testing.assert_array_equal(out_b[0, -4:], s0[0, -4:])
+    np.testing.assert_array_equal(out_b[1, -4:], s1[0, -4:])
+
+    # Guard rails: empty prompts and empty batches are rejected.
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="BOS"):
+        pad_prompts([[1, 2], []])
+    with _pytest.raises(ValueError, match="at least one"):
+        pad_prompts([])
