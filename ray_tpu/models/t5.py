@@ -19,6 +19,7 @@ other in-tree families.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Optional
 
 import jax
@@ -247,6 +248,129 @@ def t5_forward(params: Params, src_tokens: jax.Array,
                tgt_tokens: jax.Array, cfg: T5Config) -> jax.Array:
     return t5_decode(params, t5_encode(params, src_tokens, cfg),
                      tgt_tokens, cfg)
+
+
+# ------------------------------------------------------------- generation
+def _cached_self_attention(q, k_cache, v_cache, slot, cfg: T5Config):
+    """q [B, 1, H, D] over decoder cache slots <= slot."""
+    B, S, H, D = q.shape
+    max_len = k_cache.shape[1]
+    logits = jnp.einsum("bshd,bthd->bhst", q, k_cache,
+                        preferred_element_type=jnp.float32)
+    logits = logits * (D ** -0.5)
+    slots = jnp.arange(max_len)
+    mask = slots[None, None, None, :] <= slot
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v_cache,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _memory_attention(q, mem_k, mem_v, src_live, cfg: T5Config):
+    """Cross-attention of q [B, 1, H, D] over precomputed memory K/V
+    [B, S, H, D]; src_live [B, S] masks pad source positions."""
+    D = q.shape[-1]
+    logits = jnp.einsum("bshd,bthd->bhst", q, mem_k,
+                        preferred_element_type=jnp.float32)
+    logits = logits * (D ** -0.5)
+    if src_live is not None:
+        logits = jnp.where(src_live[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(mem_v.dtype)
+    out = jnp.einsum("bhst,bthd->bshd", probs, mem_v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new_tokens", "greedy"))
+def t5_generate(params: Params, src_tokens: jax.Array, cfg: T5Config, *,
+                bos_id: int = 1, max_new_tokens: int = 32,
+                greedy: bool = True, temperature: float = 1.0,
+                eos_id: Optional[int] = None,
+                src_live: Optional[jax.Array] = None,
+                rng: Optional[jax.Array] = None) -> jax.Array:
+    """src_tokens [B, S] -> generated target tokens
+    [B, max_new_tokens] (starting after bos, which is NOT returned).
+
+    TPU-shaped like the LM decode loop (models/generate.py): the
+    encoder runs once, every decoder layer's cross-attention K/V over
+    the memory are precomputed ONCE, and the decode loop is one
+    `lax.scan` with a static trip count over a preallocated
+    self-attention cache."""
+    B = src_tokens.shape[0]
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    memory = t5_encode(params, src_tokens, cfg)
+    dt = cfg.dtype
+    dec = params["decoder"]
+    # Per-layer cross K/V of the (fixed) memory: [L, B, S, H, D].
+    mem_k = jnp.einsum("bsd,ldhk->lbshk", memory,
+                       dec["cross_wk"].astype(dt))
+    mem_v = jnp.einsum("bsd,ldhk->lbshk", memory,
+                       dec["cross_wv"].astype(dt))
+    cache_shape = (cfg.n_layers, B, max_new_tokens, cfg.n_heads,
+                   cfg.head_dim)
+    self_k = jnp.zeros(cache_shape, dt)
+    self_v = jnp.zeros(cache_shape, dt)
+
+    def decode_step(tok, self_k, self_v, slot):
+        h = params["embed"].astype(dt)[tok[:, None]]       # [B, 1, d]
+        positions = jnp.full((B, 1), slot)
+
+        def body(carry, xs):
+            h = carry
+            layer, k_c, v_c, m_k, m_v = xs
+            x = _rmsnorm(h, layer["self_norm"], cfg.norm_eps)
+            q = _rope(_proj(x, layer["self_wq"], dt), positions,
+                      cfg.rope_theta)
+            k = _rope(_proj(x, layer["self_wk"], dt), positions,
+                      cfg.rope_theta)
+            v = _proj(x, layer["self_wv"], dt)
+            k_c = jax.lax.dynamic_update_slice(
+                k_c, k.astype(k_c.dtype), (0, slot, 0, 0))
+            v_c = jax.lax.dynamic_update_slice(
+                v_c, v.astype(v_c.dtype), (0, slot, 0, 0))
+            o = _cached_self_attention(q, k_c, v_c, slot, cfg)
+            h = h + jnp.einsum("bshk,hkd->bsd", o,
+                               layer["self_wo"].astype(dt))
+            x = _rmsnorm(h, layer["cross_norm"], cfg.norm_eps)
+            q = _proj(x, layer["cross_wq"], dt)
+            o = _memory_attention(q, m_k, m_v, src_live, cfg)
+            h = h + jnp.einsum("bshk,hkd->bsd", o,
+                               layer["cross_wo"].astype(dt))
+            x = _rmsnorm(h, layer["mlp_norm"], cfg.norm_eps)
+            return h + _mlp(x, layer, cfg), (k_c, v_c)
+
+        h, (self_k, self_v) = jax.lax.scan(
+            body, h, (dec, self_k, self_v, mem_k, mem_v))
+        h = _rmsnorm(h, params["dec_final_norm"], cfg.norm_eps)
+        logits = jnp.einsum("btd,vd->btv", h,
+                            params["embed"].astype(h.dtype)
+                            ).astype(jnp.float32)
+        return logits[:, 0], self_k, self_v
+
+    def sample(logits_row, key):
+        if greedy:
+            return jnp.argmax(logits_row, axis=-1).astype(jnp.int32)
+        scaled = logits_row / jnp.maximum(temperature, 1e-6)
+        return jax.random.categorical(key, scaled).astype(jnp.int32)
+
+    def step(carry, xs):
+        tok, self_k, self_v, slot, done = carry
+        key = xs
+        logits, self_k, self_v = decode_step(tok, self_k, self_v, slot)
+        nxt = sample(logits, key)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, self_k, self_v, slot + 1, done), nxt
+
+    keys = jax.random.split(rng, max_new_tokens)
+    bos = jnp.full((B,), bos_id, jnp.int32)
+    done0 = jnp.zeros((B,), bool)
+    (_, _, _, _, _), toks = jax.lax.scan(
+        step, (bos, self_k, self_v, 0, done0), keys)
+    return toks.T
 
 
 def t5_loss(params: Params, batch: Dict[str, jax.Array],
