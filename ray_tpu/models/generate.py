@@ -197,6 +197,57 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
     return jnp.concatenate([prompt, toks.T], axis=1)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _prefill_jit(params, prompt, cache, cfg, positions=None,
+                 slot_live=None):
+    return forward_cached(params, prompt, cache, 0, cfg,
+                          positions=positions, slot_live=slot_live)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _decode_step_jit(params, tok, cache, slot, pos_ids, cfg,
+                     slot_live=None):
+    return forward_cached(params, tok[:, None], cache, slot, cfg,
+                          positions=pos_ids[:, None],
+                          slot_live=slot_live)
+
+
+def generate_stream(params, prompt, cfg: LlamaConfig, *,
+                    max_new_tokens: int = 32,
+                    eos_id: Optional[int] = None):
+    """Greedy decode as a PYTHON GENERATOR yielding one [B] token
+    array per step — the token-streaming serving path (each step is
+    one cached jitted program; `generate`'s scanned loop is the
+    lower-latency batch path when streaming isn't needed). Stops early
+    when every row has emitted eos."""
+    import numpy as np
+
+    B, P = prompt.shape
+    max_len = P + max_new_tokens
+    if max_len > cfg.max_seq_len:
+        raise ValueError(f"{max_len} exceeds max_seq_len "
+                         f"{cfg.max_seq_len}")
+    cache = init_cache(cfg, B, max_len)
+    logits, cache = _prefill_jit(params, prompt, cache, cfg)
+    last = logits[:, -1]
+    done = np.zeros((B,), bool)
+    pos = jnp.full((B,), P, jnp.int32)
+    for step in range(max_new_tokens):
+        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        if eos_id is not None:
+            tok = jnp.where(jnp.asarray(done), eos_id, tok)
+        tok_np = np.asarray(tok)
+        yield tok_np
+        if eos_id is not None:
+            done = done | (tok_np == eos_id)
+            if done.all():
+                return
+        if step + 1 < max_new_tokens:
+            logits, cache = _decode_step_jit(
+                params, tok, cache, P + step, pos + step, cfg)
+            last = logits[:, 0]
+
+
 def pad_prompts(prompts, pad_id: int = 0, *, bucket_len: bool = False,
                 pad_batch_to: Optional[int] = None):
     """Left-pad a ragged list of token lists to a dense [B, P] array +

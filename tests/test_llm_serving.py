@@ -105,3 +105,48 @@ def test_serve_llm_dynamic_batched_ragged():
     sizes = handle.get_batch_sizes.remote().result(timeout_s=30)
     assert max(sizes) > 1, sizes
     serve.delete("batchlm")
+
+
+def test_serve_llm_token_streaming():
+    """Token streaming: the decode loop yields through Serve's
+    streaming-generator plane; streamed tokens equal the batch
+    generate() output and arrive incrementally."""
+
+    @serve.deployment
+    class StreamLM:
+        def __init__(self):
+            import jax
+
+            from ray_tpu.models import LlamaConfig, llama_init
+
+            self.cfg = LlamaConfig.nano()
+            self.params = llama_init(jax.random.PRNGKey(0), self.cfg)
+
+        def stream(self, token_ids, max_new_tokens=6):
+            import jax.numpy as jnp
+
+            from ray_tpu.models.generate import generate_stream
+
+            prompt = jnp.asarray([token_ids], jnp.int32)
+            for tok in generate_stream(self.params, prompt, self.cfg,
+                                       max_new_tokens=max_new_tokens):
+                yield int(tok[0])
+
+        def batch_generate(self, token_ids, max_new_tokens=6):
+            import jax.numpy as jnp
+
+            from ray_tpu.models.generate import generate
+
+            prompt = jnp.asarray([token_ids], jnp.int32)
+            out = generate(self.params, prompt, self.cfg,
+                           max_new_tokens=max_new_tokens)
+            return np.asarray(out)[0, -max_new_tokens:].tolist()
+
+    handle = serve.run(StreamLM.bind(), name="streamlm",
+                       route_prefix=None, _proxy=False)
+    prompt = [4, 5, 6]
+    streamed = [t for t in handle.options(stream=True)
+                .stream.remote(prompt)]
+    batch = handle.batch_generate.remote(prompt).result(timeout_s=180)
+    assert streamed == batch and len(streamed) == 6
+    serve.delete("streamlm")
