@@ -197,14 +197,19 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
     return jnp.concatenate([prompt, toks.T], axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
+# Donated cache: each step consumes the previous cache exactly once —
+# without donation every step would COPY the whole [L,B,max_len,KV,D]
+# cache across the jit boundary (multi-GB per token at real configs).
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache",))
 def _prefill_jit(params, prompt, cache, cfg, positions=None,
                  slot_live=None):
     return forward_cached(params, prompt, cache, 0, cfg,
                           positions=positions, slot_live=slot_live)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache",))
 def _decode_step_jit(params, tok, cache, slot, pos_ids, cfg,
                      slot_live=None):
     return forward_cached(params, tok[:, None], cache, slot, cfg,
@@ -214,12 +219,15 @@ def _decode_step_jit(params, tok, cache, slot, pos_ids, cfg,
 
 def generate_stream(params, prompt, cfg: LlamaConfig, *,
                     max_new_tokens: int = 32,
-                    eos_id: Optional[int] = None):
+                    eos_id: Optional[int] = None,
+                    prompt_live: Optional[jax.Array] = None):
     """Greedy decode as a PYTHON GENERATOR yielding one [B] token
     array per step — the token-streaming serving path (each step is
-    one cached jitted program; `generate`'s scanned loop is the
-    lower-latency batch path when streaming isn't needed). Stops early
-    when every row has emitted eos."""
+    one cached jitted program with a donated KV cache; `generate`'s
+    scanned loop is the lower-latency batch path when streaming isn't
+    needed). Stops early when every row has emitted eos. Ragged
+    batches: LEFT-pad and pass ``prompt_live`` exactly as with
+    `generate`."""
     import numpy as np
 
     B, P = prompt.shape
@@ -228,10 +236,22 @@ def generate_stream(params, prompt, cfg: LlamaConfig, *,
         raise ValueError(f"{max_len} exceeds max_seq_len "
                          f"{cfg.max_seq_len}")
     cache = init_cache(cfg, B, max_len)
-    logits, cache = _prefill_jit(params, prompt, cache, cfg)
+    if prompt_live is not None:
+        live = prompt_live.astype(bool)
+        positions = jnp.maximum(
+            jnp.cumsum(live.astype(jnp.int32), axis=1) - 1, 0)
+        slot_live = jnp.concatenate(
+            [live, jnp.ones((B, max_new_tokens), bool)], axis=1)
+        pos = live.sum(axis=1).astype(jnp.int32)
+    else:
+        positions = None
+        slot_live = None
+        pos = jnp.full((B,), P, jnp.int32)
+    logits, cache = _prefill_jit(params, prompt, cache, cfg,
+                                 positions=positions,
+                                 slot_live=slot_live)
     last = logits[:, -1]
     done = np.zeros((B,), bool)
-    pos = jnp.full((B,), P, jnp.int32)
     for step in range(max_new_tokens):
         tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
         if eos_id is not None:
@@ -244,7 +264,8 @@ def generate_stream(params, prompt, cfg: LlamaConfig, *,
                 return
         if step + 1 < max_new_tokens:
             logits, cache = _decode_step_jit(
-                params, tok, cache, P + step, pos + step, cfg)
+                params, tok, cache, P + step, pos + step, cfg,
+                slot_live=slot_live)
             last = logits[:, 0]
 
 

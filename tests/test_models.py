@@ -376,3 +376,28 @@ def test_t5_generation_matches_uncached_decode():
                                         max_new_tokens=T,
                                         src_live=masked))
     assert not np.array_equal(out, out_masked)
+
+
+def test_llama_streaming_matches_batch_and_ragged():
+    """generate_stream yields exactly generate()'s tokens — dense and
+    ragged (left-padded) — with the donated-cache stepwise path."""
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.generate import (generate, generate_stream,
+                                         pad_prompts)
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[3, 4, 5], [6, 7, 8]], jnp.int32)
+    batch = np.asarray(generate(params, prompt, cfg, max_new_tokens=5))
+    streamed = np.stack(list(generate_stream(
+        params, prompt, cfg, max_new_tokens=5)), axis=1)
+    np.testing.assert_array_equal(streamed, batch[:, -5:])
+
+    padded, live = pad_prompts([[5, 6, 7], [9, 8, 7, 6, 5, 4]])
+    batch_r = np.asarray(generate(params, jnp.asarray(padded), cfg,
+                                  max_new_tokens=4,
+                                  prompt_live=jnp.asarray(live)))
+    streamed_r = np.stack(list(generate_stream(
+        params, jnp.asarray(padded), cfg, max_new_tokens=4,
+        prompt_live=jnp.asarray(live))), axis=1)
+    np.testing.assert_array_equal(streamed_r, batch_r[:, -4:])
