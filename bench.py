@@ -36,6 +36,35 @@ MAX_TRIALS = 7          # extend past TRIALS while spread stays high
 SPREAD_TARGET_PCT = 20.0
 
 
+def flagship_config():
+    """551M flagship: the round-over-round comparable config."""
+    from ray_tpu.models import LlamaConfig
+
+    # remat_policy: saving the three FFN dot outputs (the FLOPs-heavy
+    # 2/3 of each layer) skips their backward-pass recompute; measured
+    # +2.2 MFU over full remat on this chip (tools/remat_sweep.py —
+    # larger save sets OOM at this batch, smaller ones gain nothing).
+    return LlamaConfig(
+        vocab_size=32000, dim=1536, n_layers=16, n_heads=12,
+        n_kv_heads=12, ffn_dim=4096, max_seq_len=2048,
+        remat=True, attn_impl="flash",
+        remat_policy="save:ffn_gate+ffn_up+ffn_down")
+
+
+def large_config():
+    """Largest config that fits one 16 GiB chip (AOT-verified: 15.37 GiB
+    with bf16 params + optimizer state, full remat — f32 AdamW for 1.55B
+    needs 27 GiB and cannot fit; remat saves OOM at this frontier)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import LlamaConfig
+
+    return LlamaConfig(
+        vocab_size=32000, dim=2048, n_layers=28, n_heads=16,
+        n_kv_heads=16, ffn_dim=5504, max_seq_len=2048,
+        remat=True, attn_impl="flash", param_dtype=jnp.bfloat16)
+
+
 def _detect_peak() -> float:
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
     for key, val in PEAK_BF16_FLOPS.items():
@@ -148,7 +177,6 @@ def _bench_config(cfg, batch_size: int, seq_len: int, steps: int,
 
 def main():
     import jax
-    import jax.numpy as jnp
 
     from ray_tpu.models import LlamaConfig
 
@@ -159,22 +187,11 @@ def main():
 
     if on_tpu:
         devices = jax.devices()[:1]
-        flagship = LlamaConfig(
-            vocab_size=32000, dim=1536, n_layers=16, n_heads=12,
-            n_kv_heads=12, ffn_dim=4096, max_seq_len=2048,
-            remat=True, attn_impl="flash")
-        base = _bench_config(flagship, batch_size=8, seq_len=2048,
+        base = _bench_config(flagship_config(), batch_size=8, seq_len=2048,
                              steps=20, trials=TRIALS, devices=devices,
                              peak=peak)
-        # Largest config that fits one 16 GiB chip (AOT-verified:
-        # 15.37 GiB with bf16 params + optimizer state, full remat —
-        # f32 AdamW for 1.55B needs 27 GiB and cannot fit).
-        large_cfg = LlamaConfig(
-            vocab_size=32000, dim=2048, n_layers=28, n_heads=16,
-            n_kv_heads=16, ffn_dim=5504, max_seq_len=2048,
-            remat=True, attn_impl="flash", param_dtype=jnp.bfloat16)
         try:
-            large = _bench_config(large_cfg, batch_size=4, seq_len=2048,
+            large = _bench_config(large_config(), batch_size=4, seq_len=2048,
                                   steps=10, trials=TRIALS,
                                   devices=devices, peak=peak)
         except Exception as e:  # OOM headroom is ~0.4 GiB: degrade, don't die

@@ -20,6 +20,7 @@ import functools
 from typing import Any, Dict, Optional
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -28,6 +29,26 @@ from ray_tpu.ops.ring_attention import ring_attention
 from ray_tpu.parallel.sharding import logical_to_mesh, LogicalAxisRules
 
 Params = Dict[str, Any]
+
+# checkpoint_name tags available to remat_policy="save:...". Each marks
+# one dot output in _decoder_layer; saving it exempts that matmul (and
+# everything downstream of it that is also saved) from the backward-pass
+# recompute. ffn_gate+ffn_up are the FLOPs-heaviest (2/3 of the MLP);
+# qkv covers the three attention input projections.
+REMAT_SAVE_NAMES = frozenset(
+    {"qkv", "attn_out", "wo_out", "ffn_gate", "ffn_up", "ffn_down"})
+
+
+def _parse_save_names(policy: str) -> list:
+    """'save:a+b' -> ['a', 'b']; raises on empty or unknown names."""
+    names = [n for n in policy[len("save:"):].split("+") if n]
+    bad = [n for n in names if n not in REMAT_SAVE_NAMES]
+    if not names or bad:
+        raise ValueError(
+            f"remat_policy {policy!r}: "
+            + (f"unknown names {bad}" if bad else "no names given")
+            + f" (valid: {sorted(REMAT_SAVE_NAMES)})")
+    return names
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,16 +67,23 @@ class LlamaConfig:
     remat: bool = True
     # Per-layer checkpoint policy: "full" recomputes everything (min
     # HBM), "save_dots" keeps matmul outputs (recompute only cheap
-    # elementwise — more HBM, fewer recomputed FLOPs).
+    # elementwise — more HBM, fewer recomputed FLOPs), or
+    # "save:<name>+<name>+..." keeps only the NAMED dot outputs
+    # (checkpoint_name tags in _decoder_layer) — the HBM/recompute
+    # frontier in between. Valid names: REMAT_SAVE_NAMES.
     remat_policy: str = "full"
     attn_impl: str = "auto"            # auto|flash|reference|ring
     ring_axis: str = "sp"
 
     def __post_init__(self):
-        if self.remat_policy not in ("full", "save_dots"):
-            raise ValueError(
-                f"unknown remat_policy {self.remat_policy!r} "
-                "(expected 'full' or 'save_dots')")
+        if self.remat_policy in ("full", "save_dots"):
+            return
+        if self.remat_policy.startswith("save:"):
+            _parse_save_names(self.remat_policy)
+            return
+        raise ValueError(
+            f"unknown remat_policy {self.remat_policy!r} "
+            "(expected 'full', 'save_dots', or 'save:<names>')")
 
     @property
     def head_dim(self) -> int:
@@ -207,20 +235,24 @@ def _attention_call(q, k, v, cfg: LlamaConfig):
 def _decoder_layer(h: jax.Array, layer: Params, positions: jax.Array,
                    cfg: LlamaConfig) -> jax.Array:
     dt = cfg.dtype
+    name = jax.ad_checkpoint.checkpoint_name
     x = _rmsnorm(h, layer["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dhk->bshk", x, layer["wq"].astype(dt))
-    k = jnp.einsum("bsd,dhk->bshk", x, layer["wk"].astype(dt))
-    v = jnp.einsum("bsd,dhk->bshk", x, layer["wv"].astype(dt))
+    q = name(jnp.einsum("bsd,dhk->bshk", x, layer["wq"].astype(dt)), "qkv")
+    k = name(jnp.einsum("bsd,dhk->bshk", x, layer["wk"].astype(dt)), "qkv")
+    v = name(jnp.einsum("bsd,dhk->bshk", x, layer["wv"].astype(dt)), "qkv")
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    o = _attention_call(q, k, v, cfg)
-    h = h + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
+    o = name(_attention_call(q, k, v, cfg), "attn_out")
+    h = h + name(jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt)),
+                 "wo_out")
 
     x = _rmsnorm(h, layer["mlp_norm"], cfg.norm_eps)
-    gate = jnp.einsum("bsd,df->bsf", x, layer["w_gate"].astype(dt))
-    up = jnp.einsum("bsd,df->bsf", x, layer["w_up"].astype(dt))
-    h = h + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
-                       layer["w_down"].astype(dt))
+    gate = name(jnp.einsum("bsd,df->bsf", x, layer["w_gate"].astype(dt)),
+                "ffn_gate")
+    up = name(jnp.einsum("bsd,df->bsf", x, layer["w_up"].astype(dt)),
+              "ffn_up")
+    h = h + name(jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                            layer["w_down"].astype(dt)), "ffn_down")
     return h
 
 
@@ -239,6 +271,11 @@ def llama_forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
                 layer_fn,
                 policy=jax.checkpoint_policies
                 .dots_with_no_batch_dims_saveable)
+        elif cfg.remat_policy.startswith("save:"):
+            layer_fn = jax.checkpoint(
+                layer_fn,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    *_parse_save_names(cfg.remat_policy)))
         else:  # "full" — validated in LlamaConfig.__post_init__
             layer_fn = jax.checkpoint(layer_fn)
 

@@ -39,6 +39,15 @@ class MoeConfig(LlamaConfig):
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
 
+    def __post_init__(self):
+        super().__post_init__()
+        if self.remat_policy != "full":
+            raise ValueError(
+                "MoeConfig supports remat_policy='full' only: "
+                "_moe_decoder_layer carries no checkpoint_name tags, so "
+                "llama's named-save / save_dots policies would silently "
+                "run as full remat")
+
     @staticmethod
     def mixtral_8x7b(**kw) -> "MoeConfig":
         return MoeConfig(vocab_size=32000, dim=4096, n_layers=32,

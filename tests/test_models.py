@@ -32,6 +32,51 @@ def test_llama_causality():
     assert not np.allclose(l1[0, 10:], l2[0, 10:])
 
 
+def test_remat_policies_same_loss_and_grads():
+    """Every remat policy is a pure memory/FLOPs trade: loss AND grads
+    must be bit-comparable to the full-remat baseline (same graph, same
+    dtypes — only what is saved vs recomputed differs)."""
+    import dataclasses
+
+    import pytest
+
+    from ray_tpu.models import LlamaConfig, llama_init, llama_loss
+
+    base = LlamaConfig.nano(remat=True)
+    params = llama_init(jax.random.PRNGKey(0), base)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0,
+                                base.vocab_size)
+    batch = {"tokens": tokens}
+
+    def loss_and_grads(cfg):
+        return jax.jit(jax.value_and_grad(
+            lambda p: llama_loss(p, batch, cfg)))(params)
+
+    ref_loss, ref_grads = loss_and_grads(base)
+    for policy in ("save_dots", "save:ffn_gate+ffn_up",
+                   "save:qkv+attn_out", "save:ffn_down"):
+        cfg = dataclasses.replace(base, remat_policy=policy)
+        loss, grads = loss_and_grads(cfg)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-6, err_msg=policy)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-4, atol=1e-6, err_msg=policy),
+            ref_grads, grads)
+
+    with pytest.raises(ValueError):
+        dataclasses.replace(base, remat_policy="save:not_a_name")
+    with pytest.raises(ValueError):
+        dataclasses.replace(base, remat_policy="bogus")
+
+    # MoE carries no checkpoint_name tags — named policies (which would
+    # silently run as full remat there) must be rejected, not ignored.
+    from ray_tpu.models.moe import MoeConfig
+
+    with pytest.raises(ValueError):
+        MoeConfig.nano_moe(remat_policy="save:ffn_gate")
+
+
 def test_sharded_train_step_loss_decreases():
     import optax
 
