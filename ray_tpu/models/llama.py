@@ -74,6 +74,11 @@ class LlamaConfig:
     remat_policy: str = "full"
     attn_impl: str = "auto"            # auto|flash|reference|ring
     ring_axis: str = "sp"
+    # Flash-kernel tile sizes (None = kernel default). Chip-dependent:
+    # larger tiles amortize the per-block softmax rescale; sweep with
+    # tools/remat_sweep.py-style timing before changing.
+    flash_block_q: Optional[int] = None
+    flash_block_k: Optional[int] = None
 
     def __post_init__(self):
         if self.remat_policy in ("full", "save_dots"):
@@ -220,15 +225,20 @@ def _attention_call(q, k, v, cfg: LlamaConfig):
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
+    blocks = {k_: v_ for k_, v_ in (("block_q", cfg.flash_block_q),
+                                    ("block_k", cfg.flash_block_k))
+              if v_ is not None}
     if cfg.attn_impl == "ring":
-        out = ring_attention(qT, kT, vT, axis_name=cfg.ring_axis, causal=True)
+        out = ring_attention(qT, kT, vT, axis_name=cfg.ring_axis,
+                             causal=True, **blocks)
     elif cfg.attn_impl == "ulysses":
         from ray_tpu.ops.ulysses import ulysses_attention
 
         out = ulysses_attention(qT, kT, vT, axis_name=cfg.ring_axis,
-                                causal=True)
+                                causal=True, **blocks)
     else:
-        out = attention(qT, kT, vT, causal=True, impl=cfg.attn_impl)
+        out = attention(qT, kT, vT, causal=True, impl=cfg.attn_impl,
+                        **blocks)
     return out.transpose(0, 2, 1, 3)
 
 

@@ -43,10 +43,10 @@ class MoeConfig(LlamaConfig):
         super().__post_init__()
         if self.remat_policy != "full":
             raise ValueError(
-                "MoeConfig supports remat_policy='full' only: "
-                "_moe_decoder_layer carries no checkpoint_name tags, so "
-                "llama's named-save / save_dots policies would silently "
-                "run as full remat")
+                "MoeConfig supports remat_policy='full' only: moe_forward "
+                "ignores remat_policy and always applies plain per-layer "
+                "jax.checkpoint (and _moe_decoder_layer carries no "
+                "checkpoint_name tags for named policies either)")
 
     @staticmethod
     def mixtral_8x7b(**kw) -> "MoeConfig":

@@ -128,8 +128,15 @@ def attention(q: jax.Array,
               *,
               causal: bool = True,
               sm_scale: Optional[float] = None,
-              impl: str = "auto") -> jax.Array:
-    """Dispatch: impl in {'auto', 'flash', 'reference'}."""
+              impl: str = "auto",
+              block_q: Optional[int] = None,
+              block_k: Optional[int] = None) -> jax.Array:
+    """Dispatch: impl in {'auto', 'flash', 'reference'}. block_q/block_k
+    override the flash kernel's tile sizes (None = kernel default);
+    ignored on the reference path."""
+    for nm, b in (("block_q", block_q), ("block_k", block_k)):
+        if b is not None and b <= 0:
+            raise ValueError(f"{nm} must be positive, got {b}")
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "reference"
     if impl == "flash":
@@ -137,6 +144,11 @@ def attention(q: jax.Array,
 
         if sm_scale is None:
             sm_scale = q.shape[-1] ** -0.5
+        # only forward explicit overrides; defaulting stays with the
+        # kernel's own signature
+        blocks = {k_: v_ for k_, v_ in
+                  (("block_q", block_q), ("block_k", block_k))
+                  if v_ is not None}
         mesh = _SPMD_MESH.get()
         if mesh is not None and not _in_manual_region():
             spec = _flash_spmd_spec(q.shape, k.shape, mesh)
@@ -144,9 +156,10 @@ def attention(q: jax.Array,
                 from jax import shard_map
 
                 fn = functools.partial(flash_attention, causal=causal,
-                                       sm_scale=sm_scale)
+                                       sm_scale=sm_scale, **blocks)
                 return shard_map(fn, mesh=mesh,
                                  in_specs=(spec, spec, spec),
                                  out_specs=spec, check_vma=False)(q, k, v)
-        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               **blocks)
     return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = "sp", causal: bool = True,
-                      impl: str = "auto") -> jax.Array:
+                      impl: str = "auto", block_q=None,
+                      block_k=None) -> jax.Array:
     """q,k,v: [B, H, S_shard, D] (sequence sharded over axis_name, inside
     shard_map/jit). Returns [B, H, S_shard, D].
 
@@ -43,5 +44,6 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                   concat_axis=1, tiled=True)
 
     qh, kh, vh = swap_in(q), swap_in(k), swap_in(v)
-    out = attention(qh, kh, vh, causal=causal, impl=impl)
+    out = attention(qh, kh, vh, causal=causal, impl=impl,
+                    block_q=block_q, block_k=block_k)
     return swap_out(out)
