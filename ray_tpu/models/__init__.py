@@ -28,6 +28,14 @@ from ray_tpu.models.moe import (
     moe_loss,
     moe_param_specs,
 )
+from ray_tpu.models.lora import (
+    LoraConfig,
+    lora_init,
+    lora_merge,
+    lora_num_params,
+    lora_param_specs,
+    make_lora_train_step,
+)
 from ray_tpu.models.t5 import (
     T5Config,
     t5_init,
@@ -57,6 +65,12 @@ __all__ = [
     "moe_forward",
     "moe_loss",
     "moe_param_specs",
+    "LoraConfig",
+    "lora_init",
+    "lora_merge",
+    "lora_num_params",
+    "lora_param_specs",
+    "make_lora_train_step",
     "T5Config",
     "t5_init",
     "t5_forward",

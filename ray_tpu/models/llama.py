@@ -81,6 +81,12 @@ class LlamaConfig:
     flash_block_k: Optional[int] = None
 
     def __post_init__(self):
+        # validated here, not in dispatch: every attention path (flash,
+        # ring, ulysses) receives these
+        for nm in ("flash_block_q", "flash_block_k"):
+            b = getattr(self, nm)
+            if b is not None and b <= 0:
+                raise ValueError(f"{nm} must be positive, got {b}")
         if self.remat_policy in ("full", "save_dots"):
             return
         if self.remat_policy.startswith("save:"):

@@ -1,0 +1,173 @@
+"""Speculative decoding: a small draft model proposes, the target model
+verifies — exact greedy equivalence at a fraction of the target's
+sequential steps.
+
+Reference counterpart: none (the reference ships no generation loop at
+all); this is a TPU-native serving-latency capability on top of the
+models/generate.py cache machinery.
+
+Why it fits TPU: the target model stops being a chain of S sequential
+single-token programs and becomes S/(c+1) chunk-verify programs of
+width k+1 — wide enough to feed the MXU — while the cheap draft model
+eats the sequential latency. Greedy acceptance (token match against the
+target's argmax) makes the output provably identical to target-only
+greedy decode (tested).
+
+Cache discipline (no explicit rollback): `forward_cached` masks
+attention to slots < kv_valid_len = start + S. Rejected candidates'
+K/V entries live at slots >= the accepted position, which is exactly
+where the next round's chunk starts writing — so stale entries are
+never attended before they are overwritten. The draft consumes a CHUNK
+of not-yet-written tokens each round (1 normally; 2 after a fully
+accepted window, whose last draft token never became a draft input) so
+neither cache ever has a hole behind its valid frontier.
+
+Scope: batch size 1 (speculation is an interactive-latency
+optimization; batched throughput serving uses `generate`'s scanned
+batch decode, where the MXU is already fed by the batch dimension).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.generate import (_prefill_jit, forward_cached,
+                                     init_cache)
+from ray_tpu.models.llama import LlamaConfig
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class SpecStats:
+    """Per-call acceptance telemetry (drives draft-model/window tuning)."""
+    rounds: int = 0
+    proposed: int = 0
+    accepted: int = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "width"),
+                   donate_argnames=("cache",))
+def _draft_propose(params, chunk, cache, start, cfg, width):
+    """Consume `chunk` [B, m] at cache slot `start` (appending its K/V),
+    then greedily roll `width` proposals. Returns
+    (proposals [B, width], cache); the cache gains K/V for the chunk and
+    the first width-1 proposals (the last proposal is never an input)."""
+    logits, cache = forward_cached(params, chunk, cache, start, cfg)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    m = chunk.shape[1]
+
+    def step(carry, _):
+        tok, cache, slot = carry
+        logits, cache = forward_cached(params, tok[:, None], cache, slot,
+                                       cfg)
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return (nxt, cache, slot + 1), tok
+
+    (last, cache, _), toks = jax.lax.scan(
+        step, (first, cache, start + m), None, length=width - 1)
+    proposals = jnp.concatenate([toks.T, last[:, None]], axis=1) \
+        if width > 1 else last[:, None]
+    return proposals, cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache",))
+def _verify_chunk(params, chunk, cache, start, cfg):
+    """Target forward over [last_emitted, d_1..d_w] at slot `start`;
+    returns (argmax tokens [B, w+1], cache) — entry i is the target's
+    greedy continuation of chunk[:, :i+1]."""
+    logits, cache = forward_cached(params, chunk, cache, start, cfg)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+
+def speculative_generate(
+    target_params: Params, target_cfg: LlamaConfig,
+    draft_params: Params, draft_cfg: LlamaConfig,
+    prompt, *, max_new_tokens: int = 32, window: int = 4,
+    eos_id: Optional[int] = None,
+) -> Tuple[jax.Array, SpecStats]:
+    """prompt [1, P] int32 -> ([1, P + n] int32, stats), n <=
+    max_new_tokens (early eos stops short, like `generate_stream`).
+
+    Greedy only: emitted tokens are IDENTICAL to
+    ``generate(target_params, prompt, target_cfg, greedy=True)`` up to
+    eos/max_new_tokens truncation (tested). Draft and target must share
+    the vocabulary."""
+    if target_cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError(
+            f"draft vocab {draft_cfg.vocab_size} != target vocab "
+            f"{target_cfg.vocab_size}")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, P = prompt.shape
+    if B != 1:
+        raise ValueError(
+            "speculative_generate is the B=1 interactive-latency path; "
+            "use generate() for batched decode")
+    # +window+1 margin: the last round may overshoot before trimming
+    max_len = P + max_new_tokens + window + 1
+    for name, c in (("target", target_cfg), ("draft", draft_cfg)):
+        if max_len > c.max_seq_len:
+            raise ValueError(f"{name} max_seq_len {c.max_seq_len} < "
+                             f"required {max_len}")
+
+    t_cache = init_cache(target_cfg, 1, max_len)
+    d_cache = init_cache(draft_cfg, 1, max_len)
+    t_logits, t_cache = _prefill_jit(target_params, prompt, t_cache,
+                                     target_cfg)
+    _, d_cache = _prefill_jit(draft_params, prompt, d_cache, draft_cfg)
+
+    stats = SpecStats()
+    emitted: List[int] = [int(jnp.argmax(t_logits[0, -1]))]
+    # seq = prompt tokens + emitted. Invariants before each round:
+    #   target cache holds K/V for seq[:-1] (slots [0, n));
+    #   draft cache holds K/V for seq[:d_valid], d_valid in {n-1, n}.
+    n = P  # == len(seq) - 1
+    d_valid = P
+
+    while len(emitted) < max_new_tokens and \
+            (eos_id is None or emitted[-1] != eos_id):
+        seq_tail = emitted[-(n + 1 - d_valid):]  # seq[d_valid:]
+        d_chunk = jnp.asarray([seq_tail], jnp.int32)
+        proposals, d_cache = _draft_propose(
+            draft_params, d_chunk, d_cache, d_valid, draft_cfg, window)
+        last = jnp.asarray([emitted[-1]], jnp.int32)
+        chunk = jnp.concatenate([last[:, None], proposals], axis=1)
+        verdict, t_cache = _verify_chunk(
+            target_params, chunk, t_cache, n, target_cfg)
+        prop = np.asarray(proposals[0])
+        ver = np.asarray(verdict[0])          # ver[i] follows chunk[:, i]
+        accept = 0
+        while accept < window and prop[accept] == ver[accept]:
+            accept += 1
+        stats.rounds += 1
+        stats.proposed += window
+        stats.accepted += accept
+        # accepted drafts, then the target's correction (or bonus) token
+        emitted.extend(int(t) for t in prop[:accept])
+        emitted.append(int(ver[accept]))
+        n += accept + 1
+        # draft cache frontier: chunk + first window-1 proposals were
+        # written; of those, [.. d_accept] are now part of seq. A fully
+        # accepted window leaves d_window unwritten (never an input).
+        d_valid = n - 1 if accept == window else n
+        if eos_id is not None and eos_id in emitted:
+            del emitted[emitted.index(eos_id) + 1:]
+            break
+
+    del emitted[max_new_tokens:]
+    out = jnp.concatenate(
+        [prompt, jnp.asarray(emitted, jnp.int32)[None, :]], axis=1)
+    return out, stats

@@ -21,6 +21,21 @@ from ray_tpu.parallel.sharding import logical_to_mesh, LogicalAxisRules
 Pytree = Any
 
 
+def batch_sharding_fn(mesh: Mesh,
+                      batch_logical: Tuple[Optional[str], ...],
+                      rules: Optional[LogicalAxisRules] = None):
+    """Rank-adaptive batch-leaf sharding: batch_logical is truncated /
+    None-padded to each leaf's rank (labels are rank-1, tokens rank-2,
+    images rank-4 — all shard their leading batch axis, trailing axes
+    replicate unless batch_logical names them). Shared by every
+    train-step builder (full fine-tune, LoRA)."""
+    def shard_for(x: jax.Array) -> NamedSharding:
+        logical = tuple(batch_logical[:x.ndim]) + \
+            (None,) * max(0, x.ndim - len(batch_logical))
+        return NamedSharding(mesh, logical_to_mesh(logical, rules))
+    return shard_for
+
+
 def make_sharded_train_step(
     loss_fn: Callable[[Pytree, Dict[str, jax.Array]], jax.Array],
     optimizer: optax.GradientTransformation,
@@ -43,15 +58,7 @@ def make_sharded_train_step(
     param_shardings = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), param_specs,
         is_leaf=lambda x: isinstance(x, P))
-
-    def _batch_sharding_for(x: jax.Array) -> NamedSharding:
-        # Rank-adaptive: batch_logical truncated/None-padded to each
-        # leaf's rank (labels are rank-1, tokens rank-2, images rank-4
-        # — all shard their leading batch axis, trailing axes
-        # replicate unless batch_logical names them).
-        logical = tuple(batch_logical[:x.ndim]) + \
-            (None,) * max(0, x.ndim - len(batch_logical))
-        return NamedSharding(mesh, logical_to_mesh(logical, rules))
+    _batch_sharding_for = batch_sharding_fn(mesh, batch_logical, rules)
 
     def init_fn(params):
         params = jax.tree_util.tree_map(

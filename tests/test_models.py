@@ -77,6 +77,69 @@ def test_remat_policies_same_loss_and_grads():
         MoeConfig.nano_moe(remat_policy="save:ffn_gate")
 
 
+def test_lora_init_is_identity_and_adapter_only_training():
+    """B=0 at init => merged model == base exactly; training moves ONLY
+    the adapters (base tree bit-identical after steps), loss decreases,
+    and the merged tree drives generation unchanged."""
+    import optax
+
+    from ray_tpu.models import (LlamaConfig, LoraConfig, llama_forward,
+                                llama_init, llama_loss, llama_param_specs,
+                                lora_init, lora_merge, lora_num_params,
+                                make_lora_train_step)
+    from ray_tpu.models.generate import generate
+    from ray_tpu.parallel import MeshSpec, create_mesh
+
+    cfg = LlamaConfig.nano()
+    lcfg = LoraConfig(rank=4, targets=("wq", "wv", "w_gate"))
+    base = llama_init(jax.random.PRNGKey(0), cfg)
+    lora = lora_init(jax.random.PRNGKey(1), cfg, lcfg)
+
+    # adapter size sanity: tiny versus the base
+    n_lora = lora_num_params(cfg, lcfg)
+    assert 0 < n_lora < 0.2 * cfg.num_params()
+
+    tokens = jnp.arange(16, dtype=jnp.int32)[None, :] % cfg.vocab_size
+    merged0 = lora_merge(base, lora, cfg, lcfg)
+    np.testing.assert_allclose(llama_forward(merged0, tokens, cfg),
+                               llama_forward(base, tokens, cfg), atol=1e-6)
+
+    mesh = create_mesh(MeshSpec(dp=2, fsdp=2, tp=2).resolve(8))
+    init_fn, step_fn = make_lora_train_step(
+        lambda p, b: llama_loss(p, b, cfg), optax.adamw(1e-2), mesh,
+        cfg, lcfg, llama_param_specs(cfg))
+    base_s, lora_s, opt_state = init_fn(base, lora)
+    base_before = jax.tree_util.tree_map(np.asarray, base_s)
+
+    batch = {"tokens": jax.random.randint(
+        jax.random.PRNGKey(2), (4, 17), 0, cfg.vocab_size)}
+    losses = []
+    for _ in range(5):
+        lora_s, opt_state, metrics = step_fn(lora_s, opt_state, base_s,
+                                             batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+        base_before, base_s)
+    # adapters actually moved
+    assert float(jnp.abs(lora_s["layers"]["wq"]["b"]).sum()) > 0
+
+    # merged tree serves generation end-to-end
+    merged = lora_merge(base, jax.tree_util.tree_map(np.asarray, lora_s),
+                        cfg, lcfg)
+    out = generate(merged, jnp.array([[5, 6, 7]], jnp.int32), cfg,
+                   max_new_tokens=4)
+    assert np.asarray(out).shape == (1, 7)
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        LoraConfig(rank=0)
+    with pytest.raises(ValueError):
+        LoraConfig(targets=("attn_norm",))
+
+
 def test_sharded_train_step_loss_decreases():
     import optax
 
@@ -421,6 +484,68 @@ def test_t5_generation_matches_uncached_decode():
                                         max_new_tokens=T,
                                         src_live=masked))
     assert not np.array_equal(out, out_masked)
+
+
+def test_speculative_decode_exact_vs_greedy():
+    """Speculative output must be token-identical to target-only greedy
+    decode for every window size; a draft IDENTICAL to the target must
+    reach acceptance rate 1.0 (regression: a fully accepted window once
+    left the last draft token's K/V unwritten, corrupting later
+    proposals); eos trims early like generate_stream."""
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.generate import generate
+    from ray_tpu.models.speculative import speculative_generate
+
+    target_cfg = LlamaConfig.nano()
+    draft_cfg = LlamaConfig.nano(n_layers=1, dim=32, n_heads=2,
+                                 n_kv_heads=1, ffn_dim=64)
+    target = llama_init(jax.random.PRNGKey(0), target_cfg)
+    draft = llama_init(jax.random.PRNGKey(7), draft_cfg)
+
+    prompt = jnp.array([[3, 1, 4, 1, 5]], jnp.int32)
+    ref = np.asarray(generate(target, prompt, target_cfg,
+                              max_new_tokens=24, greedy=True))
+
+    for window in (1, 3, 4, 8):
+        out, stats = speculative_generate(
+            target, target_cfg, draft, draft_cfg, prompt,
+            max_new_tokens=24, window=window)
+        np.testing.assert_array_equal(np.asarray(out), ref,
+                                      err_msg=f"window={window}")
+        assert stats.rounds > 0
+        assert 0 <= stats.accepted <= stats.proposed
+
+    # identical draft => every proposal accepted, far fewer rounds
+    out, stats = speculative_generate(
+        target, target_cfg, target, target_cfg, prompt,
+        max_new_tokens=24, window=4)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert stats.acceptance_rate == 1.0, stats
+    assert stats.rounds <= 5  # 24 tokens / (window+1) rounded up
+
+    # eos: pick the 6th generated token as eos — speculative must stop
+    # at its first occurrence, matching the reference prefix
+    eos = int(ref[0, prompt.shape[1] + 5])
+    out, _ = speculative_generate(
+        target, target_cfg, draft, draft_cfg, prompt,
+        max_new_tokens=24, window=4, eos_id=eos)
+    out = np.asarray(out)[0]
+    gen_part = list(out[prompt.shape[1]:])
+    assert eos in gen_part
+    first = gen_part.index(eos)
+    assert first == len(gen_part) - 1  # nothing after eos
+    np.testing.assert_array_equal(
+        out[:prompt.shape[1] + first + 1],
+        ref[0, :prompt.shape[1] + first + 1])
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        speculative_generate(target, target_cfg, draft, draft_cfg,
+                             jnp.zeros((2, 3), jnp.int32))
+    with pytest.raises(ValueError):
+        speculative_generate(target, target_cfg, draft, draft_cfg,
+                             prompt, window=0)
 
 
 def test_llama_streaming_matches_batch_and_ragged():
