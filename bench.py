@@ -143,7 +143,11 @@ def _bench_config(cfg, batch_size: int, seq_len: int, steps: int,
         t0 = time.perf_counter()
         for _ in range(steps):
             params, opt_state, metrics = step_fn(params, opt_state, batch)
-        jax.block_until_ready(metrics["loss"])
+        # float() forces a device->host fetch: on the axon remote
+        # platform block_until_ready can return before remote execution
+        # completes, which times dispatch instead of compute (observed
+        # as an absurd 78,000% MFU trial).
+        float(metrics["loss"])
         return batch_size * seq_len * steps / (time.perf_counter() - t0)
 
     def spread_pct(rs):

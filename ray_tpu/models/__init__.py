@@ -10,6 +10,7 @@ from ray_tpu.models.llama import (
     LlamaConfig,
     llama_init,
     llama_forward,
+    llama_hidden,
     llama_loss,
     llama_param_specs,
 )
@@ -50,6 +51,7 @@ __all__ = [
     "LlamaConfig",
     "llama_init",
     "llama_forward",
+    "llama_hidden",
     "llama_loss",
     "llama_param_specs",
     "ViTConfig",

@@ -77,6 +77,41 @@ def test_remat_policies_same_loss_and_grads():
         MoeConfig.nano_moe(remat_policy="save:ffn_gate")
 
 
+def test_chunked_loss_matches_unchunked():
+    """cfg.loss_chunk is a pure memory/traffic optimization: loss AND
+    grads must match the full-logits path (same f32 softmax math, just
+    lax.map'd per chunk under remat)."""
+    import dataclasses
+
+    from ray_tpu.models import LlamaConfig, llama_init, llama_loss
+
+    base = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), base)
+    # S = 32 after the tokens->inputs shift; chunk 8 divides it
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                base.vocab_size)
+    mask = (jnp.arange(32)[None, :] < jnp.array([[30], [20]])).astype(
+        jnp.float32)
+    for batch in ({"tokens": tokens},
+                  {"inputs": tokens[:, :-1], "targets": tokens[:, 1:],
+                   "mask": mask}):
+        ref_loss, ref_grads = jax.jit(jax.value_and_grad(
+            lambda p: llama_loss(p, batch, base)))(params)
+        chunked = dataclasses.replace(base, loss_chunk=8)
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda p: llama_loss(p, batch, chunked)))(params)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                    atol=1e-6),
+            ref_grads, grads)
+    # non-dividing chunk falls back to the unchunked path (still correct)
+    odd = dataclasses.replace(base, loss_chunk=7)
+    loss = llama_loss(params, {"tokens": tokens}, odd)
+    ref = llama_loss(params, {"tokens": tokens}, base)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-6)
+
+
 def test_lora_init_is_identity_and_adapter_only_training():
     """B=0 at init => merged model == base exactly; training moves ONLY
     the adapters (base tree bit-identical after steps), loss decreases,
