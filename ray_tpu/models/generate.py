@@ -127,11 +127,67 @@ def forward_cached(params: Params, tokens: jax.Array, cache: Cache,
     return logits, {"k": k_new, "v": v_new}
 
 
+def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jax.Array:
+    """Mask logits outside the top-k / nucleus (top-p) candidate set to
+    the dtype's min (so `jax.random.categorical` never samples them).
+
+    [..., vocab] -> same shape. Both knobs are STATIC (one XLA program
+    per (k, p) pair — serving reuses a handful of compiles); when both
+    are given, top-k applies first, then top-p over the survivors (the
+    usual composition). top_p=1.0 / top_k>=vocab are no-ops."""
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, logits.dtype)
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_k < logits.shape[-1]:
+            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, neg, logits)
+    if top_p is not None:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_p < 1.0:
+            sort = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sort.astype(jnp.float32), axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep tokens whose PRECEDING cumulative mass is still below
+            # top_p; the argmax always survives (its preceding mass is 0)
+            keep = (cum - probs) < top_p
+            thresh = jnp.min(
+                jnp.where(keep, sort, jnp.asarray(jnp.inf, sort.dtype)),
+                axis=-1, keepdims=True)
+            logits = jnp.where(logits < thresh, neg, logits)
+    return logits
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
+def _sample_token(logits: jax.Array, key: jax.Array, temperature,
+                  top_k: Optional[int], top_p: Optional[float]) -> jax.Array:
+    """[B, vocab] logits -> [B] sampled int32 (temperature + filters).
+    Jitted (static knobs) so the streaming path's per-token sampling is
+    one fused program, not op-by-op dispatches of sort/softmax/cumsum;
+    inside `generate`'s already-jitted scan it simply inlines."""
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    scaled = filter_logits(scaled, top_k, top_p)
+    return jax.random.categorical(key, scaled).astype(jnp.int32)
+
+
+def _check_sampling_knobs(greedy: bool, top_k, top_p) -> None:
+    """greedy=True (the default) argmaxes — refuse to silently drop
+    explicitly-requested sampling filters."""
+    if greedy and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p require greedy=False (greedy decoding ignores "
+            "sampling filters)")
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("cfg", "max_new_tokens", "greedy"))
+                   static_argnames=("cfg", "max_new_tokens", "greedy",
+                                    "top_k", "top_p"))
 def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
              max_new_tokens: int = 32, temperature: float = 1.0,
              greedy: bool = True, eos_id: Optional[int] = None,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
              prompt_live: Optional[jax.Array] = None,
              rng: Optional[jax.Array] = None) -> jax.Array:
     """prompt [B, P] int32 -> [B, P + max_new_tokens] int32.
@@ -140,7 +196,9 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
     `lax.scan` emits max_new_tokens steps (static trip count — XLA
     unrolls nothing, reuses one step computation). With eos_id set,
     finished rows keep emitting eos (scan trip count stays static; the
-    caller trims).
+    caller trims). Sampling (greedy=False) draws from the
+    temperature-scaled distribution restricted by `filter_logits`'s
+    static top_k / top_p knobs.
 
     Ragged batches: LEFT-pad prompts to a common length and pass
     ``prompt_live`` [B, P] (True = real token). Pad slots are masked
@@ -153,6 +211,7 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
     if max_len > cfg.max_seq_len:
         raise ValueError(f"{max_len} exceeds max_seq_len "
                          f"{cfg.max_seq_len}")
+    _check_sampling_knobs(greedy, top_k, top_p)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     cache = init_cache(cfg, B, max_len)
 
@@ -176,8 +235,7 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
     def sample(logits_row, key):
         if greedy:
             return jnp.argmax(logits_row, axis=-1).astype(jnp.int32)
-        scaled = logits_row / jnp.maximum(temperature, 1e-6)
-        return jax.random.categorical(key, scaled).astype(jnp.int32)
+        return _sample_token(logits_row, key, temperature, top_k, top_p)
 
     def step(carry, key):
         cache, last_logits, slot, pos_ids, done = carry
@@ -220,14 +278,20 @@ def _decode_step_jit(params, tok, cache, slot, pos_ids, cfg,
 def generate_stream(params, prompt, cfg: LlamaConfig, *,
                     max_new_tokens: int = 32,
                     eos_id: Optional[int] = None,
-                    prompt_live: Optional[jax.Array] = None):
-    """Greedy decode as a PYTHON GENERATOR yielding one [B] token
+                    temperature: float = 1.0, greedy: bool = True,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None,
+                    prompt_live: Optional[jax.Array] = None,
+                    rng: Optional[jax.Array] = None):
+    """Decode as a PYTHON GENERATOR yielding one [B] token
     array per step — the token-streaming serving path (each step is
     one cached jitted program with a donated KV cache; `generate`'s
     scanned loop is the lower-latency batch path when streaming isn't
     needed). Stops early when every row has emitted eos. Ragged
     batches: LEFT-pad and pass ``prompt_live`` exactly as with
-    `generate`."""
+    `generate`. Sampling (greedy=False, temperature/top_k/top_p) uses
+    `generate`'s exact per-step key schedule, so a streamed run with
+    the same rng yields token-identical output to the batch path."""
     import numpy as np
 
     B, P = prompt.shape
@@ -235,6 +299,7 @@ def generate_stream(params, prompt, cfg: LlamaConfig, *,
     if max_len > cfg.max_seq_len:
         raise ValueError(f"{max_len} exceeds max_seq_len "
                          f"{cfg.max_seq_len}")
+    _check_sampling_knobs(greedy, top_k, top_p)
     cache = init_cache(cfg, B, max_len)
     if prompt_live is not None:
         live = prompt_live.astype(bool)
@@ -252,8 +317,16 @@ def generate_stream(params, prompt, cfg: LlamaConfig, *,
                                  slot_live=slot_live)
     last = logits[:, -1]
     done = np.zeros((B,), bool)
+    keys = None
+    if not greedy:
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        keys = jax.random.split(rng, max_new_tokens)
     for step in range(max_new_tokens):
-        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        if greedy:
+            tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        else:
+            tok = _sample_token(last, keys[step], temperature,
+                                top_k, top_p)
         if eos_id is not None:
             tok = jnp.where(jnp.asarray(done), eos_id, tok)
         tok_np = np.asarray(tok)

@@ -478,6 +478,92 @@ def test_llama_ragged_batch_generation():
         pad_prompts([])
 
 
+def test_sampling_filters_topk_topp():
+    """filter_logits semantics + generate/generate_stream sampling.
+
+    Unit level: top-k keeps exactly the k largest, top-p keeps the
+    smallest prefix of the sorted distribution whose mass reaches p
+    (argmax always survives), no-op knobs change nothing. Integration:
+    top_k=1 sampling is argmax regardless of temperature, and the
+    streamed sampler with the same rng is token-identical to the
+    scanned batch sampler (shared key schedule)."""
+    import pytest as _pytest
+
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.generate import (filter_logits, generate,
+                                         generate_stream)
+
+    logits = jnp.array([[1.0, 3.0, 2.0, 0.5]], jnp.float32)
+
+    kept = np.isfinite(np.asarray(filter_logits(logits, top_k=2))) \
+        & (np.asarray(filter_logits(logits, top_k=2)) > -1e30)
+    np.testing.assert_array_equal(kept[0], [False, True, True, False])
+
+    # softmax([1,3,2,.5]) ~ [.086, .631, .232, .052]; sorted cum mass =
+    # [.631, .863, .948, 1]. p=0.6 keeps only the argmax (smallest
+    # prefix with mass >= .6); p=0.9 needs three tokens (.863 < .9).
+    f6 = np.asarray(filter_logits(logits, top_p=0.6))[0]
+    assert (f6 > -1e30).tolist() == [False, True, False, False]
+    f9 = np.asarray(filter_logits(logits, top_p=0.9))[0]
+    assert (f9 > -1e30).tolist() == [True, True, True, False]
+
+    # no-op knobs and composition (top-k first, then nucleus)
+    np.testing.assert_array_equal(
+        np.asarray(filter_logits(logits, top_k=4, top_p=1.0)),
+        np.asarray(logits))
+    fb = np.asarray(filter_logits(logits, top_k=2, top_p=0.6))[0]
+    assert (fb > -1e30).tolist() == [False, True, False, False]
+
+    with _pytest.raises(ValueError, match="top_k"):
+        filter_logits(logits, top_k=0)
+    with _pytest.raises(ValueError, match="top_p"):
+        filter_logits(logits, top_p=0.0)
+
+    # sampling knobs alongside greedy=True (the default) are an error,
+    # not silently dropped
+    cfg0 = LlamaConfig.nano()
+    params0 = llama_init(jax.random.PRNGKey(0), cfg0)
+    with _pytest.raises(ValueError, match="greedy=False"):
+        generate(params0, jnp.array([[1, 2]], jnp.int32), cfg0,
+                 max_new_tokens=2, top_p=0.9)
+    with _pytest.raises(ValueError, match="greedy=False"):
+        list(generate_stream(params0, jnp.array([[1, 2]], jnp.int32),
+                             cfg0, max_new_tokens=2, top_k=4))
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.array([[5, 6, 7], [9, 8, 7]], jnp.int32)
+
+    # top_k=1 == greedy even at high temperature
+    g = np.asarray(generate(params, prompt, cfg, max_new_tokens=4))
+    k1 = np.asarray(generate(params, prompt, cfg, max_new_tokens=4,
+                             greedy=False, temperature=5.0, top_k=1,
+                             rng=jax.random.PRNGKey(3)))
+    np.testing.assert_array_equal(g, k1)
+
+    # streamed sampling == scanned sampling under the same rng
+    rng = jax.random.PRNGKey(11)
+    batch = np.asarray(generate(params, prompt, cfg, max_new_tokens=5,
+                                greedy=False, temperature=0.9,
+                                top_k=16, top_p=0.95, rng=rng))
+    streamed = np.stack(list(generate_stream(
+        params, prompt, cfg, max_new_tokens=5, greedy=False,
+        temperature=0.9, top_k=16, top_p=0.95, rng=rng)), axis=1)
+    np.testing.assert_array_equal(batch[:, 3:], streamed)
+
+    # sampled tokens stay inside the top-k set of each step's logits
+    from ray_tpu.models.llama import llama_forward
+    seq = np.asarray(generate(params, prompt, cfg, max_new_tokens=4,
+                              greedy=False, temperature=1.3, top_k=3,
+                              rng=jax.random.PRNGKey(5)))
+    for t in range(4):
+        step_logits = np.asarray(llama_forward(
+            params, jnp.asarray(seq[:, :3 + t]), cfg)[:, -1])
+        topk = np.argsort(step_logits, axis=-1)[:, -3:]
+        for b in range(seq.shape[0]):
+            assert seq[b, 3 + t] in topk[b]
+
+
 def test_t5_generation_matches_uncached_decode():
     """Encoder-decoder decode loop (t5_generate): greedy cached
     generation must equal a manual argmax rollout through the full
