@@ -1,0 +1,322 @@
+"""Continuous-batching decode engine, TPU-first.
+
+The reference has no serving engine for LLMs (Serve hosts arbitrary
+torch callables; continuous batching lives outside it in vLLM-class
+engines). Serving an LM is this framework's flagship deployment, so
+slot-based continuous batching is first-class here, built the XLA way:
+
+- ONE decode program for the whole engine, compiled once: B fixed
+  decode slots advance together each step, every row at its OWN cache
+  offset (per-row scatter writes + per-row masks — no recompilation as
+  requests come and go, no left-padding).
+- Admission is a per-length-bucket prefill program that writes one
+  request's prompt K/V into a freed slot's cache row while the other
+  rows' state rides along untouched (donated buffers, in-place in HBM).
+- A finished row's slot is reused immediately: its stale K/V need no
+  clearing because every mask is `slot < row_len`, and the next
+  occupant's prefill overwrites from slot 0.
+
+Consistency contract (tested): greedy engine output for every request
+is token-identical to that request's solo `generate` run, regardless of
+admission order, slot reuse, or which other requests share the batch.
+
+Cites: reference Serve's dynamic batching seam
+(python/ray/serve/batching.py:1) coalesces CALLS; this engine coalesces
+DECODE STEPS — requests join and leave a running batch mid-flight.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.generate import (_check_sampling_knobs,
+                                     _sample_token, forward_cached,
+                                     init_cache)
+from ray_tpu.models.llama import LlamaConfig, _rmsnorm, _rope
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Compiled programs
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache",))
+def _prefill_row(params: Params, prompt: jax.Array, cache, row,
+                 last_idx, cfg: LlamaConfig):
+    """Write `prompt` [1, Pb] K/V into cache row `row` at slots
+    [0, Pb) and return (last-real-token logits [vocab], cache).
+
+    Pb may exceed the true prompt length (length-bucketed serving):
+    trailing filler tokens' K/V land at slots >= the true length, which
+    every later mask excludes (`slot < row_len`), and causality keeps
+    real tokens from ever attending filler — only the logits at
+    `last_idx` (true length - 1) are read out."""
+    row_cache = {
+        "k": jax.lax.dynamic_slice_in_dim(cache["k"], row, 1, axis=1),
+        "v": jax.lax.dynamic_slice_in_dim(cache["v"], row, 1, axis=1),
+    }
+    logits, row_cache = forward_cached(params, prompt, row_cache, 0, cfg)
+    cache = {
+        "k": jax.lax.dynamic_update_slice_in_dim(
+            cache["k"], row_cache["k"], row, axis=1),
+        "v": jax.lax.dynamic_update_slice_in_dim(
+            cache["v"], row_cache["v"], row, axis=1),
+    }
+    return logits[0, last_idx], cache
+
+
+def _decode_layer_rows(h, layer, k_cache, v_cache, write_slots,
+                       cfg: LlamaConfig):
+    """One decoder layer, one new token per row, each row writing its
+    K/V at its own slot (scatter) and attending its own prefix.
+
+    h: [B, 1, d]; caches [B, max_len, KV, D]; write_slots: [B]."""
+    dt = cfg.dtype
+    B = h.shape[0]
+    x = _rmsnorm(h, layer["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", x, layer["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", x, layer["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", x, layer["wv"].astype(dt))
+    positions = write_slots[:, None]                       # [B, 1]
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    bidx = jnp.arange(B)
+    k_cache = k_cache.at[bidx, write_slots].set(
+        k[:, 0].astype(k_cache.dtype))
+    v_cache = v_cache.at[bidx, write_slots].set(
+        v[:, 0].astype(v_cache.dtype))
+
+    max_len = k_cache.shape[1]
+    rep = q.shape[2] // k_cache.shape[2]
+    kk = jnp.repeat(k_cache, rep, axis=2)                  # [B, T, H, D]
+    vv = jnp.repeat(v_cache, rep, axis=2)
+    logits = jnp.einsum("bshd,bthd->bhst", q, kk,
+                        preferred_element_type=jnp.float32)
+    logits = logits * (q.shape[-1] ** -0.5)
+    slots = jnp.arange(max_len)
+    mask = slots[None, None, None, :] <= write_slots[:, None, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(vv.dtype)
+    o = jnp.einsum("bhst,bthd->bshd", probs, vv,
+                   preferred_element_type=jnp.float32).astype(q.dtype)
+
+    h = h + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
+    x = _rmsnorm(h, layer["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", x, layer["w_gate"].astype(dt))
+    up = jnp.einsum("bsd,df->bsf", x, layer["w_up"].astype(dt))
+    h = h + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                       layer["w_down"].astype(dt))
+    return h, k_cache, v_cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache",))
+def _decode_rows(params: Params, toks: jax.Array, cache, row_len,
+                 cfg: LlamaConfig):
+    """One decode step for ALL slots: row b's token `toks[b]` is
+    written at slot `row_len[b]` and attends slots [0, row_len[b]].
+    Dead rows (row_len 0) compute discarded garbage at slot 0 — their
+    slot is overwritten by the next admission's prefill. Returns
+    (next-token logits [B, vocab] f32, cache)."""
+    write_slots = row_len                                   # [B]
+    h = params["tok_embed"].astype(cfg.dtype)[toks[:, None]]
+
+    def body(carry, xs):
+        h = carry
+        layer, k_c, v_c = xs
+        h, k_c, v_c = _decode_layer_rows(h, layer, k_c, v_c,
+                                         write_slots, cfg)
+        return h, (k_c, v_c)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        body, h, (params["layers"], cache["k"], cache["v"]))
+    h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", h,
+                        params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits[:, 0], {"k": k_new, "v": v_new}
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+class _Request:
+    __slots__ = ("req_id", "prompt", "max_new_tokens", "tokens", "done")
+
+    def __init__(self, req_id: int, prompt: List[int],
+                 max_new_tokens: int):
+        self.req_id = req_id
+        self.prompt = list(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.tokens: List[int] = []
+        self.done = False
+
+
+class DecodeEngine:
+    """Slot-based continuous batching over a shared KV cache.
+
+    `submit()` enqueues a request; `step()` advances the whole engine
+    one token (admitting queued requests into free slots first) and
+    returns the tokens emitted this step; `run()` drains everything.
+    Greedy by default; sampling mode (greedy=False) applies the same
+    temperature/top_k/top_p semantics as `generate` with an
+    engine-owned key stream.
+
+    bucket_lens=True rounds each admission's prefill to the next power
+    of two, so a handful of XLA compiles (one per length bucket) cover
+    all traffic; the decode program compiles exactly once.
+    """
+
+    def __init__(self, params: Params, cfg: LlamaConfig, *,
+                 batch_slots: int = 8, max_len: Optional[int] = None,
+                 greedy: bool = True, temperature: float = 1.0,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 bucket_lens: bool = True,
+                 rng: Optional[jax.Array] = None):
+        _check_sampling_knobs(greedy, top_k, top_p)
+        self.params = params
+        self.cfg = cfg
+        self.B = batch_slots
+        self.max_len = max_len or cfg.max_seq_len
+        if self.max_len > cfg.max_seq_len:
+            raise ValueError(f"max_len {self.max_len} exceeds "
+                             f"max_seq_len {cfg.max_seq_len}")
+        self.greedy = greedy
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+        self.bucket_lens = bucket_lens
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        self.cache = init_cache(cfg, self.B, self.max_len)
+        self.row_len = np.zeros((self.B,), np.int32)   # written slots
+        self.row_req: List[Optional[_Request]] = [None] * self.B
+        self.row_budget = np.zeros((self.B,), np.int32)
+        self._next_tok = np.zeros((self.B,), np.int32)  # pending feed
+        self._queue: collections.deque = collections.deque()
+        self._next_id = 0
+        self.results: Dict[int, _Request] = {}
+        self.finished: set = set()      # done but not yet popped
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, prompt: List[int], max_new_tokens: int = 32) -> int:
+        """Enqueue a request; returns its id (see `results`)."""
+        if not len(prompt):
+            raise ValueError("empty prompt: need at least one token "
+                             "(prepend a BOS token)")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds engine max_len "
+                f"{self.max_len}")
+        req = _Request(self._next_id, prompt, max_new_tokens)
+        self._next_id += 1
+        self._queue.append(req)
+        self.results[req.req_id] = req
+        return req.req_id
+
+    def pending(self) -> bool:
+        return bool(self._queue) or any(
+            r is not None for r in self.row_req)
+
+    def step(self) -> Dict[int, List[int]]:
+        """Admit queued requests into free slots, then advance every
+        live slot one token. Returns {req_id: [tokens]} emitted this
+        step — a just-admitted request can emit TWO tokens in one step
+        (its prefill's first token, then the decode's)."""
+        emitted: Dict[int, List[int]] = {}
+        for row in range(self.B):
+            if self.row_req[row] is None and self._queue:
+                self._admit(row, self._queue.popleft(), emitted)
+
+        live = [b for b in range(self.B) if self.row_req[b] is not None]
+        if not live:
+            return emitted
+
+        toks = jnp.asarray(self._next_tok)
+        logits, self.cache = _decode_rows(
+            self.params, toks, self.cache, jnp.asarray(self.row_len),
+            self.cfg)
+        self.row_len[live] += 1  # fed tokens now occupy their slots
+        nxt = self._sample(logits)
+        for b in live:
+            self._emit(b, int(nxt[b]), emitted)
+        return emitted
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drain queue + slots; returns {req_id: generated tokens} for
+        every finished request and POPS them from the engine (a
+        long-running server that never popped would leak one _Request
+        per call served)."""
+        while self.pending():
+            self.step()
+        return {rid: self.pop_result(rid) for rid in list(self.finished)}
+
+    def pop_result(self, req_id: int) -> List[int]:
+        """Remove a FINISHED request from the engine and return its
+        generated tokens. Long-running callers driving step() directly
+        must pop each request as it finishes (see `finished`)."""
+        if req_id not in self.finished:
+            raise KeyError(f"request {req_id} unknown or not finished")
+        self.finished.discard(req_id)
+        return self.results.pop(req_id).tokens
+
+    # -- internals ---------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        if not self.bucket_lens:
+            return n
+        return min(1 << (n - 1).bit_length(), self.max_len)
+
+    def _admit(self, row: int, req: _Request,
+               emitted: Dict[int, List[int]]) -> None:
+        P = len(req.prompt)
+        Pb = self._bucket(P)
+        padded = np.zeros((1, Pb), np.int32)
+        padded[0, :P] = req.prompt
+        last_logits, self.cache = _prefill_row(
+            self.params, jnp.asarray(padded), self.cache,
+            jnp.int32(row), jnp.int32(P - 1), self.cfg)
+        self.row_req[row] = req
+        self.row_len[row] = P
+        self.row_budget[row] = req.max_new_tokens
+        tok = int(self._sample(last_logits[None, :])[0])
+        self._emit(row, tok, emitted)
+
+    def _sample(self, logits: jax.Array) -> np.ndarray:
+        if self.greedy:
+            return np.asarray(jnp.argmax(logits, axis=-1)).astype(
+                np.int32)
+        self._rng, key = jax.random.split(self._rng)
+        return np.asarray(_sample_token(
+            logits, key, self.temperature, self.top_k, self.top_p))
+
+    def _emit(self, row: int, tok: int,
+              emitted: Dict[int, List[int]]) -> None:
+        req = self.row_req[row]
+        req.tokens.append(tok)
+        emitted.setdefault(req.req_id, []).append(tok)
+        self.row_budget[row] -= 1
+        out_of_room = self.row_len[row] + 1 >= self.max_len
+        if (self.row_budget[row] <= 0 or out_of_room
+                or (self.eos_id is not None and tok == self.eos_id)):
+            req.done = True
+            self.finished.add(req.req_id)
+            self.row_req[row] = None
+            self.row_len[row] = 0        # slot free for the next prefill
+            self._next_tok[row] = 0
+        else:
+            self._next_tok[row] = tok

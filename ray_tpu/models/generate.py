@@ -291,15 +291,28 @@ def generate_stream(params, prompt, cfg: LlamaConfig, *,
     batches: LEFT-pad and pass ``prompt_live`` exactly as with
     `generate`. Sampling (greedy=False, temperature/top_k/top_p) uses
     `generate`'s exact per-step key schedule, so a streamed run with
-    the same rng yields token-identical output to the batch path."""
-    import numpy as np
+    the same rng yields token-identical output to the batch path.
 
+    Validation runs EAGERLY (this is a plain function returning the
+    generator): bad knobs fail at the call site, not mid-stream at the
+    first next()."""
     B, P = prompt.shape
     max_len = P + max_new_tokens
     if max_len > cfg.max_seq_len:
         raise ValueError(f"{max_len} exceeds max_seq_len "
                          f"{cfg.max_seq_len}")
     _check_sampling_knobs(greedy, top_k, top_p)
+    return _stream_inner(params, prompt, cfg, max_new_tokens, eos_id,
+                         temperature, greedy, top_k, top_p,
+                         prompt_live, rng)
+
+
+def _stream_inner(params, prompt, cfg, max_new_tokens, eos_id,
+                  temperature, greedy, top_k, top_p, prompt_live, rng):
+    import numpy as np
+
+    B, P = prompt.shape
+    max_len = P + max_new_tokens
     cache = init_cache(cfg, B, max_len)
     if prompt_live is not None:
         live = prompt_live.astype(bool)

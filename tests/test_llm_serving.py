@@ -107,6 +107,104 @@ def test_serve_llm_dynamic_batched_ragged():
     serve.delete("batchlm")
 
 
+def test_serve_llm_continuous_batching():
+    """Continuous batching behind Serve: concurrent requests share ONE
+    DecodeEngine — each submits into a slot and a background stepper
+    advances the whole batch, so requests join and leave mid-flight.
+    Every caller's tokens equal its solo generate run, and the engine
+    really served overlapping requests (not one at a time)."""
+
+    @serve.deployment(max_ongoing_requests=16)
+    class EngineLM:
+        def __init__(self):
+            import jax
+
+            from ray_tpu.models import LlamaConfig, llama_init
+            from ray_tpu.models.engine import DecodeEngine
+
+            self.cfg = LlamaConfig.nano()
+            self.params = llama_init(jax.random.PRNGKey(0), self.cfg)
+            self.engine = DecodeEngine(self.params, self.cfg,
+                                       batch_slots=2, max_len=32)
+            self._queues = {}
+            self._stepper = None
+            self.max_live = 0
+
+        async def _step_loop(self):
+            import asyncio
+
+            while self.engine.pending():
+                emitted = self.engine.step()
+                self.max_live = max(
+                    self.max_live,
+                    sum(r is not None for r in self.engine.row_req))
+                for rid, toks in emitted.items():
+                    q = self._queues.get(rid)
+                    if q is not None:
+                        for t in toks:
+                            q.put_nowait(t)
+                        if rid in self.engine.finished:
+                            q.put_nowait(None)
+                # a real (if tiny) sleep: lets the replica's RPC
+                # reader tasks deliver new submissions mid-batch
+                await asyncio.sleep(0.001)
+
+        async def generate(self, prompt, max_new_tokens=4):
+            import asyncio
+
+            rid = self.engine.submit(prompt, max_new_tokens)
+            q = asyncio.Queue()
+            self._queues[rid] = q
+            if self._stepper is None or self._stepper.done():
+                self._stepper = asyncio.create_task(self._step_loop())
+            toks = []
+            while True:
+                t = await q.get()
+                if t is None:
+                    break
+                toks.append(t)
+            del self._queues[rid]
+            assert self.engine.pop_result(rid) == toks
+            return prompt + toks
+
+        def get_max_live(self):
+            return self.max_live
+
+    @serve.deployment
+    class SoloLM:
+        def __init__(self):
+            import jax
+
+            from ray_tpu.models import LlamaConfig, llama_init
+
+            self.cfg = LlamaConfig.nano()
+            self.params = llama_init(jax.random.PRNGKey(0), self.cfg)
+
+        def generate(self, token_ids, max_new_tokens=4):
+            import jax.numpy as jnp
+
+            from ray_tpu.models.generate import generate
+
+            out = generate(self.params,
+                           jnp.asarray([token_ids], jnp.int32),
+                           self.cfg, max_new_tokens=max_new_tokens)
+            return np.asarray(out)[0].tolist()
+
+    handle = serve.run(EngineLM.bind(), name="englm",
+                       route_prefix=None, _proxy=False, timeout_s=180)
+    solo = serve.run(SoloLM.bind(), name="sololm",
+                     route_prefix=None, _proxy=False, timeout_s=180)
+    prompts = [[5, 6, 7], [9, 8, 7, 6, 5], [1, 2], [3, 1, 4, 1]]
+    futures = [handle.generate.remote(p, 5) for p in prompts]
+    outs = [f.result(timeout_s=300) for f in futures]
+    for p, out in zip(prompts, outs):
+        want = solo.generate.remote(p, 5).result(timeout_s=300)
+        assert out == want, f"prompt {p}"
+    assert handle.get_max_live.remote().result(timeout_s=30) > 1
+    serve.delete("englm")
+    serve.delete("sololm")
+
+
 def test_serve_llm_token_streaming():
     """Token streaming: the decode loop yields through Serve's
     streaming-generator plane; streamed tokens equal the batch

@@ -527,8 +527,9 @@ def test_sampling_filters_topk_topp():
         generate(params0, jnp.array([[1, 2]], jnp.int32), cfg0,
                  max_new_tokens=2, top_p=0.9)
     with _pytest.raises(ValueError, match="greedy=False"):
-        list(generate_stream(params0, jnp.array([[1, 2]], jnp.int32),
-                             cfg0, max_new_tokens=2, top_k=4))
+        # eager: the error fires at the CALL, before any iteration
+        generate_stream(params0, jnp.array([[1, 2]], jnp.int32),
+                        cfg0, max_new_tokens=2, top_k=4)
 
     cfg = LlamaConfig.nano()
     params = llama_init(jax.random.PRNGKey(0), cfg)
