@@ -113,7 +113,8 @@ def _quiesce(max_wait_s: float = 90.0, threshold: float = 1.5) -> dict:
 
 
 def _bench_config(cfg, batch_size: int, seq_len: int, steps: int,
-                  trials: int, devices, peak: float) -> dict:
+                  trials: int, devices, peak: float,
+                  optimizer=None) -> dict:
     import jax
     import optax
 
@@ -126,7 +127,7 @@ def _bench_config(cfg, batch_size: int, seq_len: int, steps: int,
     params = llama_init(jax.random.PRNGKey(0), cfg)
     init_fn, step_fn = make_sharded_train_step(
         lambda p, b: llama_loss(p, b, cfg),
-        optax.adamw(3e-4, weight_decay=0.0),
+        optimizer or optax.adamw(3e-4, weight_decay=0.0),
         mesh, llama_param_specs(cfg))
     params, opt_state = init_fn(params)
 

@@ -1,0 +1,109 @@
+"""Round-5 continuation sweep: fund bigger remat save-sets with the
+memory loss_chunk frees.
+
+bench.py's flagship sits at 54.5% MFU with save:ffn_* (the three FFN
+dots) — larger save sets OOM at batch 8 because the unchunked loss
+keeps [B, S, vocab] f32 logits + softmax residuals (~4 GiB) live.
+cfg.loss_chunk computes the vocab projection chunk-at-a-time (grads
+identical — tested), freeing that memory to ALSO save the qkv dots,
+which removes the last dot recompute from the backward pass (attention
+fwd is still recomputed from saved qkv; its FLOPs are ~5% here).
+
+Usage: python tools/frontier_sweep.py [flagship|large|both]
+Each candidate prints one JSON line; OOM is an expected, reported
+outcome. Adopted winners go into bench.py's configs with measured
+numbers in the comment.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_case(name, cfg, batch_size, steps, trials=3, optimizer=None):
+    import jax
+
+    from bench import _bench_config, _detect_peak
+
+    try:
+        r = _bench_config(cfg, batch_size=batch_size, seq_len=2048,
+                          steps=steps, trials=trials,
+                          devices=jax.devices()[:1], peak=_detect_peak(),
+                          optimizer=optimizer)
+        out = {"case": name, "batch": batch_size, "mfu": r["mfu"],
+               "tokens_per_sec": r["tokens_per_sec_per_chip"],
+               "spread_pct": r["trial_spread_pct"]}
+    except Exception as e:  # noqa: BLE001 — OOM is an expected outcome
+        out = {"case": name, "batch": batch_size,
+               "error": f"{type(e).__name__}: {str(e)[:140]}"}
+    jax.clear_caches()
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def flagship_cases():
+    import jax.numpy as jnp
+    import optax
+
+    from bench import flagship_config
+
+    base = flagship_config()
+    mu16 = optax.adamw(3e-4, weight_decay=0.0, mu_dtype=jnp.bfloat16)
+    all_dots = "save:qkv+attn_out+wo_out+ffn_gate+ffn_up+ffn_down"
+    cases = [
+        ("base(save:ffn)", base, 8, None),
+        ("chunk512", dataclasses.replace(base, loss_chunk=512), 8, None),
+        ("chunk512+qkv",
+         dataclasses.replace(base, loss_chunk=512,
+                             remat_policy="save:qkv+ffn_gate+ffn_up"
+                                          "+ffn_down"), 8, None),
+        ("chunk512+alldots",
+         dataclasses.replace(base, loss_chunk=512,
+                             remat_policy=all_dots), 8, None),
+        ("chunk512+qkv+mu16",
+         dataclasses.replace(base, loss_chunk=512,
+                             remat_policy="save:qkv+ffn_gate+ffn_up"
+                                          "+ffn_down"), 8, mu16),
+        ("chunk512+b12",
+         dataclasses.replace(base, loss_chunk=512), 12, None),
+        ("chunk512+qkv+b12+mu16",
+         dataclasses.replace(base, loss_chunk=512,
+                             remat_policy="save:qkv+ffn_gate+ffn_up"
+                                          "+ffn_down"), 12, mu16),
+    ]
+    return [(n, c, b, 20, o) for (n, c, b, o) in cases]
+
+
+def large_cases():
+    from bench import large_config
+
+    base = large_config()
+    cases = [
+        ("large-base(full)", base, 4, None),
+        ("large-chunk512", dataclasses.replace(base, loss_chunk=512),
+         4, None),
+        ("large-chunk512+qkv",
+         dataclasses.replace(base, loss_chunk=512,
+                             remat_policy="save:qkv"), 4, None),
+        ("large-chunk512+b6",
+         dataclasses.replace(base, loss_chunk=512), 6, None),
+    ]
+    return [(n, c, b, 10, o) for (n, c, b, o) in cases]
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    cases = []
+    if which in ("flagship", "both"):
+        cases += flagship_cases()
+    if which in ("large", "both"):
+        cases += large_cases()
+    for name, cfg, batch, steps, opt in cases:
+        run_case(name, cfg, batch, steps, optimizer=opt)
+
+
+if __name__ == "__main__":
+    main()
