@@ -1,9 +1,14 @@
-"""Headline benchmark: Llama train-step MFU on one TPU chip.
+"""Headline benchmarks: Llama train-step MFU + LLM serving throughput
+on one TPU chip.
 
-Prints ONE JSON line (last line): the flagship 551M-param config's MFU —
-comparable across rounds — with the second, largest-fits-one-chip config
-(1.55B params, bf16 params/optimizer state, remat) embedded as
-``large_*`` fields, plus trial spread so load contamination is visible.
+Prints TWO JSON lines: first the SERVING block
+(``llama_decode_tokens_per_sec_1chip`` — engine prefill and decode
+tokens/s at 2-3 batch sizes plus DecodeEngine throughput under
+mid-flight churn), then — LAST line, the driver's round-over-round
+anchor — the train block: the flagship 551M-param config's MFU with
+the second, largest-fits-one-chip config (1.55B params, bf16
+params/optimizer state, remat) embedded as ``large_*`` fields, plus
+trial spread so load contamination is visible.
 
 Hardening (round-3 verdict: a single capture swung 2x under co-tenant
 load): the bench quiesces on machine load before timing, runs 5 timed
@@ -184,6 +189,103 @@ def _bench_config(cfg, batch_size: int, seq_len: int, steps: int,
     }
 
 
+def _bench_serving(cfg, *, batch_sizes, prompt_len: int,
+                   new_tokens: int, trials: int) -> dict:
+    """Engine serving throughput on ONE chip: per batch size, the
+    prefill rate (row-by-row admission prefills, the engine's real
+    admission path) and the steady-state decode rate (the shared
+    per-row-scatter decode program with every slot live), plus
+    mid-flight-churn throughput (queue deeper than slots, ragged
+    budgets — slots are reused as rows finish). Tokens/s are wall-clock
+    host-inclusive numbers: this measures the serving engine, not the
+    bare kernel."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import llama_init
+    from ray_tpu.models.engine import DecodeEngine
+
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    max_len = prompt_len + new_tokens + 1
+
+    def prompts(n, length=prompt_len):
+        return [rng.randint(1, cfg.vocab_size, size=length).tolist()
+                for _ in range(n)]
+
+    def make_engine(B):
+        return DecodeEngine(params, cfg, batch_slots=B, max_len=max_len,
+                            enable_metrics=False)
+
+    def spread_pct(rs):
+        return ((max(rs) - min(rs)) / max(rs) * 100.0) if max(rs) else 0.0
+
+    per_batch = {}
+    for B in batch_sizes:
+        # warmup: compile this B's prefill bucket + decode program
+        eng = make_engine(B)
+        for p in prompts(B):
+            eng.submit(p, new_tokens)
+        eng.run()
+
+        pre_rates, dec_rates = [], []
+        for _ in range(trials):
+            eng = make_engine(B)
+            for p in prompts(B):
+                eng.submit(p, new_tokens)
+            t0 = time.perf_counter()
+            eng.step()       # admits all B rows: B prefills (+1 decode)
+            t1 = time.perf_counter()
+            steps = 0
+            while eng.pending():
+                eng.step()   # pure decode, all slots live
+                steps += 1
+            t2 = time.perf_counter()
+            pre_rates.append(B * prompt_len / (t1 - t0))
+            if steps:
+                dec_rates.append(B * steps / (t2 - t1))
+        per_batch[f"b{B}"] = {
+            "prefill_tokens_per_sec": round(
+                statistics.median(pre_rates), 1),
+            "decode_tokens_per_sec": round(
+                statistics.median(dec_rates), 1),
+            "trial_spread_pct": round(spread_pct(dec_rates), 2),
+            "trials_taken": len(dec_rates),
+        }
+
+    # Churn: 3x oversubscribed queue, ragged budgets — requests join
+    # and leave mid-flight, slots are reused, prefills interleave with
+    # decode steps. Tokens/s over the whole drain is the end-to-end
+    # engine throughput a loaded server actually delivers.
+    B = max(batch_sizes)
+    churn_rates = []
+    for _ in range(trials):
+        eng = make_engine(B)
+        total = 0
+        for i, p in enumerate(prompts(3 * B)):
+            n = new_tokens if i % 2 == 0 else max(2, new_tokens // 2)
+            eng.submit(p, n)
+            total += n
+        t0 = time.perf_counter()
+        eng.run()
+        churn_rates.append(total / (time.perf_counter() - t0))
+
+    biggest = per_batch[f"b{max(batch_sizes)}"]
+    return {
+        "metric": "llama_decode_tokens_per_sec_1chip",
+        "value": biggest["decode_tokens_per_sec"],
+        "unit": "tokens/s",
+        "prefill_tokens_per_sec": biggest["prefill_tokens_per_sec"],
+        "decode_tokens_per_sec": biggest["decode_tokens_per_sec"],
+        "churn_tokens_per_sec": round(statistics.median(churn_rates), 1),
+        "batch_sizes": list(batch_sizes),
+        "per_batch": per_batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "model_params": cfg.num_params(),
+    }
+
+
 def main():
     import jax
 
@@ -205,11 +307,21 @@ def main():
                                   devices=devices, peak=peak)
         except Exception as e:  # OOM headroom is ~0.4 GiB: degrade, don't die
             large = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+        try:
+            serving = _bench_serving(
+                flagship_config(), batch_sizes=(1, 8, 16),
+                prompt_len=512, new_tokens=64, trials=TRIALS)
+        except Exception as e:
+            serving = {"metric": "llama_decode_tokens_per_sec_1chip",
+                       "error": f"{type(e).__name__}: {str(e)[:200]}"}
     else:  # smoke mode off-TPU
         devices = jax.devices()
         base = _bench_config(LlamaConfig.nano(), batch_size=4, seq_len=128,
                              steps=3, trials=1, devices=devices, peak=peak)
         large = {"skipped": "no TPU"}
+        serving = _bench_serving(LlamaConfig.nano(), batch_sizes=(2, 4),
+                                 prompt_len=16, new_tokens=8, trials=1)
+        serving["dry_run"] = True
 
     out = {
         "metric": "llama_train_mfu_1chip",
@@ -227,6 +339,11 @@ def main():
     }
     for k, v in large.items():
         out[f"large_{k}"] = v
+    serving.setdefault("backend", jax.default_backend())
+    serving["host_load_at_start"] = round(gate["load"], 2)
+    # Serving block on its own line; the train block stays the LAST
+    # line (the driver's historical parse contract).
+    print(json.dumps(serving))
     print(json.dumps(out))
 
 

@@ -46,6 +46,14 @@ from ray_tpu.models.t5 import (
     t5_loss,
     t5_param_specs,
 )
+from ray_tpu.models.engine import DecodeEngine
+from ray_tpu.models.engine_metrics import EngineMetrics
+from ray_tpu.models.scheduler import (
+    EngineOverloaded,
+    FIFOPolicy,
+    PriorityPolicy,
+    SchedulerPolicy,
+)
 
 __all__ = [
     "LlamaConfig",
@@ -80,4 +88,10 @@ __all__ = [
     "t5_decode",
     "t5_loss",
     "t5_param_specs",
+    "DecodeEngine",
+    "EngineMetrics",
+    "EngineOverloaded",
+    "FIFOPolicy",
+    "PriorityPolicy",
+    "SchedulerPolicy",
 ]

@@ -18,7 +18,15 @@ slot-based continuous batching is first-class here, built the XLA way:
 
 Consistency contract (tested): greedy engine output for every request
 is token-identical to that request's solo `generate` run, regardless of
-admission order, slot reuse, or which other requests share the batch.
+admission order, slot reuse, or which other requests share the batch —
+and regardless of the SCHEDULER POLICY: scheduling (models/scheduler.py
+— FIFO, priority classes, bounded-queue backpressure, per-step prefill
+budget) only reorders admissions, never what an admitted row computes.
+
+Telemetry (models/engine_metrics.py) timestamps every request through
+queued → admitted → decoding → finished and exports queue-wait / TTFT /
+TPOT / occupancy through the util.metrics Prometheus plane; `stats()`
+snapshots it for the Serve path (serve.metrics.report_engine_stats).
 
 Cites: reference Serve's dynamic batching seam
 (python/ray/serve/batching.py:1) coalesces CALLS; this engine coalesces
@@ -27,18 +35,20 @@ DECODE STEPS — requests join and leave a running batch mid-flight.
 
 from __future__ import annotations
 
-import collections
 import functools
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.models.engine_metrics import EngineMetrics, NullEngineMetrics
 from ray_tpu.models.generate import (_check_sampling_knobs,
-                                     _sample_token, forward_cached,
-                                     init_cache)
-from ray_tpu.models.llama import LlamaConfig, _rmsnorm, _rope
+                                     _layer_body, _sample_token,
+                                     forward_cached, init_cache)
+from ray_tpu.models.llama import LlamaConfig, _rmsnorm
+from ray_tpu.models.scheduler import (EngineOverloaded, SchedulerPolicy,
+                                      make_policy)
 
 Params = Dict[str, Any]
 
@@ -78,43 +88,28 @@ def _decode_layer_rows(h, layer, k_cache, v_cache, write_slots,
     """One decoder layer, one new token per row, each row writing its
     K/V at its own slot (scatter) and attending its own prefix.
 
-    h: [B, 1, d]; caches [B, max_len, KV, D]; write_slots: [B]."""
-    dt = cfg.dtype
+    h: [B, 1, d]; caches [B, max_len, KV, D]; write_slots: [B].
+
+    All the per-layer math lives in generate.py's `_layer_body` (one
+    source of truth for both decode paths); only the cache-write
+    strategy differs — per-row scatter here vs the contiguous chunk
+    slice in `_cached_layer`. The per-prefix causal mask falls out of
+    `_cached_attention` with q_slots = each row's own write slot and
+    kv_valid_len = max_len (dead slots beyond a row's frontier are
+    already excluded by `slot <= write_slot`)."""
     B = h.shape[0]
-    x = _rmsnorm(h, layer["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dhk->bshk", x, layer["wq"].astype(dt))
-    k = jnp.einsum("bsd,dhk->bshk", x, layer["wk"].astype(dt))
-    v = jnp.einsum("bsd,dhk->bshk", x, layer["wv"].astype(dt))
-    positions = write_slots[:, None]                       # [B, 1]
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
     bidx = jnp.arange(B)
-    k_cache = k_cache.at[bidx, write_slots].set(
-        k[:, 0].astype(k_cache.dtype))
-    v_cache = v_cache.at[bidx, write_slots].set(
-        v[:, 0].astype(v_cache.dtype))
 
-    max_len = k_cache.shape[1]
-    rep = q.shape[2] // k_cache.shape[2]
-    kk = jnp.repeat(k_cache, rep, axis=2)                  # [B, T, H, D]
-    vv = jnp.repeat(v_cache, rep, axis=2)
-    logits = jnp.einsum("bshd,bthd->bhst", q, kk,
-                        preferred_element_type=jnp.float32)
-    logits = logits * (q.shape[-1] ** -0.5)
-    slots = jnp.arange(max_len)
-    mask = slots[None, None, None, :] <= write_slots[:, None, None, None]
-    logits = jnp.where(mask, logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(vv.dtype)
-    o = jnp.einsum("bhst,bthd->bshd", probs, vv,
-                   preferred_element_type=jnp.float32).astype(q.dtype)
+    def write_kv(k_cache, v_cache, k, v):
+        k_cache = k_cache.at[bidx, write_slots].set(
+            k[:, 0].astype(k_cache.dtype))
+        v_cache = v_cache.at[bidx, write_slots].set(
+            v[:, 0].astype(v_cache.dtype))
+        return k_cache, v_cache
 
-    h = h + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
-    x = _rmsnorm(h, layer["mlp_norm"], cfg.norm_eps)
-    gate = jnp.einsum("bsd,df->bsf", x, layer["w_gate"].astype(dt))
-    up = jnp.einsum("bsd,df->bsf", x, layer["w_up"].astype(dt))
-    h = h + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
-                       layer["w_down"].astype(dt))
-    return h, k_cache, v_cache
+    return _layer_body(h, layer, k_cache, v_cache,
+                       write_slots[:, None], write_kv,
+                       write_slots[:, None], k_cache.shape[1], cfg)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",),
@@ -150,15 +145,18 @@ def _decode_rows(params: Params, toks: jax.Array, cache, row_len,
 # ---------------------------------------------------------------------------
 
 class _Request:
-    __slots__ = ("req_id", "prompt", "max_new_tokens", "tokens", "done")
+    __slots__ = ("req_id", "prompt", "max_new_tokens", "tokens", "done",
+                 "priority", "seq")
 
     def __init__(self, req_id: int, prompt: List[int],
-                 max_new_tokens: int):
+                 max_new_tokens: int, priority: int = 0, seq: int = 0):
         self.req_id = req_id
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
         self.tokens: List[int] = []
         self.done = False
+        self.priority = priority    # lower = admitted first (priority policy)
+        self.seq = seq              # submission order (FIFO tie-break)
 
 
 class DecodeEngine:
@@ -174,6 +172,21 @@ class DecodeEngine:
     bucket_lens=True rounds each admission's prefill to the next power
     of two, so a handful of XLA compiles (one per length bucket) cover
     all traffic; the decode program compiles exactly once.
+
+    Scheduling / admission control (models/scheduler.py):
+      scheduler="fifo"|"priority"|SchedulerPolicy — which queued
+        request takes the next freed slot (`submit(..., priority=)`
+        orders the priority policy; lower admits first);
+      max_queue + on_full ("reject"|"block") — bounded queue
+        backpressure: reject raises EngineOverloaded, block drives
+        step() until a queue slot frees;
+      max_prefills_per_step — per-step prefill admission budget so a
+        burst of long prompts cannot starve in-flight decode rows.
+
+    Telemetry: `self.metrics` (EngineMetrics) records queue-wait /
+    TTFT / TPOT / occupancy through the util.metrics Prometheus plane;
+    `stats()` returns the flat snapshot. enable_metrics=False swaps in
+    a no-op recorder for benchmark inner loops.
     """
 
     def __init__(self, params: Params, cfg: LlamaConfig, *,
@@ -183,8 +196,21 @@ class DecodeEngine:
                  top_p: Optional[float] = None,
                  eos_id: Optional[int] = None,
                  bucket_lens: bool = True,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None,
+                 scheduler: Union[str, SchedulerPolicy] = "fifo",
+                 max_queue: Optional[int] = None,
+                 on_full: str = "reject",
+                 max_prefills_per_step: Optional[int] = None,
+                 engine_id: Optional[str] = None,
+                 enable_metrics: bool = True):
         _check_sampling_knobs(greedy, top_k, top_p)
+        if on_full not in ("reject", "block"):
+            raise ValueError(f"on_full must be 'reject' or 'block', "
+                             f"got {on_full!r}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        if max_prefills_per_step is not None and max_prefills_per_step < 1:
+            raise ValueError("max_prefills_per_step must be >= 1")
         self.params = params
         self.cfg = cfg
         self.B = batch_slots
@@ -200,20 +226,34 @@ class DecodeEngine:
         self.bucket_lens = bucket_lens
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
 
+        self.scheduler = make_policy(scheduler)
+        self.max_queue = max_queue
+        self.on_full = on_full
+        self.max_prefills_per_step = max_prefills_per_step
+        self.metrics = (EngineMetrics(engine_id=engine_id,
+                                      batch_slots=self.B)
+                        if enable_metrics else NullEngineMetrics())
+
         self.cache = init_cache(cfg, self.B, self.max_len)
         self.row_len = np.zeros((self.B,), np.int32)   # written slots
         self.row_req: List[Optional[_Request]] = [None] * self.B
         self.row_budget = np.zeros((self.B,), np.int32)
         self._next_tok = np.zeros((self.B,), np.int32)  # pending feed
-        self._queue: collections.deque = collections.deque()
         self._next_id = 0
         self.results: Dict[int, _Request] = {}
         self.finished: set = set()      # done but not yet popped
 
     # -- public API --------------------------------------------------------
 
-    def submit(self, prompt: List[int], max_new_tokens: int = 32) -> int:
-        """Enqueue a request; returns its id (see `results`)."""
+    def submit(self, prompt: List[int], max_new_tokens: int = 32,
+               priority: int = 0) -> int:
+        """Enqueue a request; returns its id (see `results`).
+
+        ``priority`` (lower = sooner) orders admission under the
+        priority policy; the FIFO policy ignores it. With a bounded
+        queue (max_queue), a full queue either raises EngineOverloaded
+        (on_full="reject") or drives the engine until a queue slot
+        frees (on_full="block")."""
         if not len(prompt):
             raise ValueError("empty prompt: need at least one token "
                              "(prepend a BOS token)")
@@ -222,25 +262,42 @@ class DecodeEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds engine max_len "
                 f"{self.max_len}")
-        req = _Request(self._next_id, prompt, max_new_tokens)
+        if self.max_queue is not None and \
+                len(self.scheduler) >= self.max_queue:
+            if self.on_full == "reject":
+                self.metrics.on_reject()
+                raise EngineOverloaded(
+                    f"queue full ({self.max_queue} queued requests); "
+                    f"shed load or use on_full='block'")
+            while len(self.scheduler) >= self.max_queue:
+                self.step()   # admissions + finishes drain the queue
+        req = _Request(self._next_id, prompt, max_new_tokens,
+                       priority=priority, seq=self._next_id)
         self._next_id += 1
-        self._queue.append(req)
+        self.scheduler.push(req)
         self.results[req.req_id] = req
+        self.metrics.on_submit(req.req_id)
+        self.metrics.observe_queue_depth(len(self.scheduler))
         return req.req_id
 
     def pending(self) -> bool:
-        return bool(self._queue) or any(
+        return bool(len(self.scheduler)) or any(
             r is not None for r in self.row_req)
 
     def step(self) -> Dict[int, List[int]]:
-        """Admit queued requests into free slots, then advance every
-        live slot one token. Returns {req_id: [tokens]} emitted this
-        step — a just-admitted request can emit TWO tokens in one step
-        (its prefill's first token, then the decode's)."""
+        """Admit queued requests into free slots (at most
+        max_prefills_per_step of them), then advance every live slot
+        one token. Returns {req_id: [tokens]} emitted this step — a
+        just-admitted request can emit TWO tokens in one step (its
+        prefill's first token, then the decode's)."""
         emitted: Dict[int, List[int]] = {}
+        budget = self.max_prefills_per_step or self.B
         for row in range(self.B):
-            if self.row_req[row] is None and self._queue:
-                self._admit(row, self._queue.popleft(), emitted)
+            if budget <= 0:
+                break
+            if self.row_req[row] is None and len(self.scheduler):
+                self._admit(row, self.scheduler.pop(), emitted)
+                budget -= 1
 
         live = [b for b in range(self.B) if self.row_req[b] is not None]
         if not live:
@@ -254,7 +311,22 @@ class DecodeEngine:
         nxt = self._sample(logits)
         for b in live:
             self._emit(b, int(nxt[b]), emitted)
+        self.metrics.on_step(
+            sum(r is not None for r in self.row_req),
+            len(self.scheduler),
+            sum(len(t) for t in emitted.values()))
         return emitted
+
+    def stats(self) -> Dict[str, float]:
+        """Flat numeric telemetry snapshot (EngineMetrics.stats) plus
+        the engine's instantaneous queue/slot state — safe to publish
+        as gauges (serve.metrics.report_engine_stats)."""
+        out = self.metrics.stats()
+        out["queue_depth"] = float(len(self.scheduler))
+        out["live_slots"] = float(
+            sum(r is not None for r in self.row_req))
+        out["slot_occupancy"] = out["live_slots"] / self.B
+        return out
 
     def run(self) -> Dict[int, List[int]]:
         """Drain queue + slots; returns {req_id: generated tokens} for
@@ -283,6 +355,7 @@ class DecodeEngine:
 
     def _admit(self, row: int, req: _Request,
                emitted: Dict[int, List[int]]) -> None:
+        self.metrics.on_admit(req.req_id)   # queue wait ends here
         P = len(req.prompt)
         Pb = self._bucket(P)
         padded = np.zeros((1, Pb), np.int32)
@@ -309,12 +382,14 @@ class DecodeEngine:
         req = self.row_req[row]
         req.tokens.append(tok)
         emitted.setdefault(req.req_id, []).append(tok)
+        self.metrics.on_token(req.req_id)
         self.row_budget[row] -= 1
         out_of_room = self.row_len[row] + 1 >= self.max_len
         if (self.row_budget[row] <= 0 or out_of_room
                 or (self.eos_id is not None and tok == self.eos_id)):
             req.done = True
             self.finished.add(req.req_id)
+            self.metrics.on_finish(req.req_id)
             self.row_req[row] = None
             self.row_len[row] = 0        # slot free for the next prefill
             self._next_tok[row] = 0

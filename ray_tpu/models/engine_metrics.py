@@ -1,0 +1,262 @@
+"""Request-lifecycle telemetry for the continuous-batching DecodeEngine.
+
+Every request moves queued → admitted (prefill) → decoding → finished;
+this module timestamps each transition and exports the serving numbers
+a vLLM-class engine is judged by:
+
+- queue wait      (submit → prefill admission)
+- TTFT            (submit → first emitted token)
+- TPOT            (gap between consecutive tokens of one request)
+- tokens/steps    (throughput counters)
+- slot occupancy / batch efficiency per step (how full the shared
+  decode program actually runs)
+
+Export goes through the ordinary `ray_tpu.util.metrics`
+Counter/Gauge/Histogram plane, so inside a cluster the series flow to
+the GCS metrics table and the dashboard /metrics Prometheus endpoint
+exactly like every other runtime metric (reference analog: Serve's
+replica request/latency series in python/ray/serve/_private/replica.py
+feeding python/ray/_private/metrics_agent.py). Outside a cluster the
+registry is still populated locally — tests and notebooks read
+`stats()` or `ray_tpu._private.metrics.snapshots()` directly.
+
+All instruments carry an ``engine`` tag (one DecodeEngine = one tag
+value) so several engines in one process — or one per replica — stay
+separable in the same Prometheus plane.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+# Token-scale latency buckets: default runtime boundaries top out at
+# 1000 (s) for RPCs; decode cadences live in the 0.5 ms – 30 s range.
+LATENCY_BOUNDARIES_S = [
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0]
+
+_engine_ids = itertools.count()
+
+
+class _Agg:
+    """Tiny running aggregate (count/sum/max) for the stats() snapshot —
+    the full distribution lives in the Histogram instruments."""
+
+    __slots__ = ("count", "sum", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        if v > self.max:
+            self.max = v
+
+    def fields(self, prefix: str, out: Dict[str, float]) -> None:
+        out[f"{prefix}_count"] = self.count
+        out[f"{prefix}_mean"] = self.sum / self.count if self.count else 0.0
+        out[f"{prefix}_max"] = self.max
+
+
+class _ReqTimes:
+    __slots__ = ("submit_t", "admit_t", "first_token_t", "last_token_t",
+                 "n_tokens")
+
+    def __init__(self, submit_t: float):
+        self.submit_t = submit_t
+        self.admit_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.last_token_t: Optional[float] = None
+        self.n_tokens = 0
+
+
+class EngineMetrics:
+    """One instance per DecodeEngine. The engine calls the on_* hooks
+    at each lifecycle transition; `stats()` returns a flat numeric
+    snapshot (gauge-friendly — see serve.metrics.report_engine_stats).
+
+    ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, *, engine_id: Optional[str] = None,
+                 batch_slots: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        self.engine_id = engine_id or f"engine-{next(_engine_ids)}"
+        self.batch_slots = max(1, batch_slots)
+        self._clock = clock
+        self._req: Dict[int, _ReqTimes] = {}
+
+        self.requests_submitted = 0
+        self.requests_admitted = 0
+        self.requests_finished = 0
+        self.requests_rejected = 0
+        self.tokens_generated = 0
+        self.steps = 0
+        self.queue_depth = 0
+        self.live_slots = 0
+        self.batch_efficiency = 0.0
+        self.queue_wait_s = _Agg()
+        self.ttft_s = _Agg()
+        self.tpot_s = _Agg()
+
+        tag = {"engine": self.engine_id}
+        keys = ("engine",)
+
+        def counter(name, desc):
+            return Counter(name, desc, tag_keys=keys).set_default_tags(tag)
+
+        def gauge(name, desc):
+            return Gauge(name, desc, tag_keys=keys).set_default_tags(tag)
+
+        def hist(name, desc):
+            return Histogram(name, desc, boundaries=LATENCY_BOUNDARIES_S,
+                             tag_keys=keys).set_default_tags(tag)
+
+        self._m_submitted = counter(
+            "llm_engine_requests_submitted_total",
+            "Requests accepted into the engine queue")
+        self._m_finished = counter(
+            "llm_engine_requests_finished_total",
+            "Requests that completed (budget, eos, or max_len)")
+        self._m_rejected = counter(
+            "llm_engine_requests_rejected_total",
+            "Requests shed by bounded-queue backpressure")
+        self._m_tokens = counter(
+            "llm_engine_tokens_generated_total",
+            "Tokens emitted across all requests")
+        self._m_steps = counter(
+            "llm_engine_steps_total",
+            "Shared decode steps executed")
+        self._m_queue_wait = hist(
+            "llm_engine_queue_wait_s",
+            "Seconds from submit to prefill admission")
+        self._m_ttft = hist(
+            "llm_engine_ttft_s",
+            "Seconds from submit to first emitted token")
+        self._m_tpot = hist(
+            "llm_engine_tpot_s",
+            "Seconds between consecutive tokens of one request")
+        self._m_queue_depth = gauge(
+            "llm_engine_queue_depth",
+            "Requests queued awaiting a decode slot")
+        self._m_occupancy = gauge(
+            "llm_engine_slot_occupancy",
+            "Live decode slots / total slots (0..1)")
+        self._m_batch_eff = gauge(
+            "llm_engine_batch_efficiency",
+            "Tokens emitted this step / total slots (0..1; ~occupancy "
+            "unless rows finished mid-step)")
+
+    # -- lifecycle hooks (called by DecodeEngine) --------------------------
+
+    def on_submit(self, req_id: int) -> None:
+        self._req[req_id] = _ReqTimes(self._clock())
+        self.requests_submitted += 1
+        self._m_submitted.inc()
+
+    def on_reject(self) -> None:
+        self.requests_rejected += 1
+        self._m_rejected.inc()
+
+    def on_admit(self, req_id: int) -> None:
+        rt = self._req.get(req_id)
+        if rt is None or rt.admit_t is not None:
+            return
+        rt.admit_t = self._clock()
+        wait = rt.admit_t - rt.submit_t
+        self.requests_admitted += 1
+        self.queue_wait_s.add(wait)
+        self._m_queue_wait.observe(wait)
+
+    def on_token(self, req_id: int, n: int = 1) -> None:
+        rt = self._req.get(req_id)
+        now = self._clock()
+        self.tokens_generated += n
+        self._m_tokens.inc(n)
+        if rt is None:
+            return
+        if rt.first_token_t is None:
+            rt.first_token_t = now
+            ttft = now - rt.submit_t
+            self.ttft_s.add(ttft)
+            self._m_ttft.observe(ttft)
+        else:
+            tpot = now - rt.last_token_t
+            self.tpot_s.add(tpot)
+            self._m_tpot.observe(tpot)
+        rt.last_token_t = now
+        rt.n_tokens += n
+
+    def on_finish(self, req_id: int) -> None:
+        self.requests_finished += 1
+        self._m_finished.inc()
+        self._req.pop(req_id, None)
+
+    def on_step(self, live_slots: int, queue_depth: int,
+                tokens_emitted: int) -> None:
+        self.steps += 1
+        self.live_slots = live_slots
+        self.queue_depth = queue_depth
+        self.batch_efficiency = tokens_emitted / self.batch_slots
+        self._m_steps.inc()
+        self._m_queue_depth.set(queue_depth)
+        self._m_occupancy.set(live_slots / self.batch_slots)
+        self._m_batch_eff.set(self.batch_efficiency)
+
+    def observe_queue_depth(self, depth: int) -> None:
+        """Gauge update outside a step (e.g. right after submit)."""
+        self.queue_depth = depth
+        self._m_queue_depth.set(depth)
+
+    # -- snapshot ----------------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        """Flat numeric snapshot of everything above — each field can
+        be re-published as a gauge (serve.metrics.report_engine_stats)
+        or asserted on directly in tests."""
+        out: Dict[str, float] = {
+            "requests_submitted": self.requests_submitted,
+            "requests_admitted": self.requests_admitted,
+            "requests_finished": self.requests_finished,
+            "requests_rejected": self.requests_rejected,
+            "tokens_generated": self.tokens_generated,
+            "steps": self.steps,
+            "queue_depth": self.queue_depth,
+            "live_slots": self.live_slots,
+            "slot_occupancy": self.live_slots / self.batch_slots,
+            "batch_efficiency": self.batch_efficiency,
+        }
+        self.queue_wait_s.fields("queue_wait_s", out)
+        self.ttft_s.fields("ttft_s", out)
+        self.tpot_s.fields("tpot_s", out)
+        return out
+
+
+class NullEngineMetrics:
+    """No-op twin for benchmark loops that must not pay even the
+    timestamping cost (DecodeEngine(..., enable_metrics=False))."""
+
+    engine_id = "disabled"
+
+    def on_submit(self, req_id): pass
+
+    def on_reject(self): pass
+
+    def on_admit(self, req_id): pass
+
+    def on_token(self, req_id, n=1): pass
+
+    def on_finish(self, req_id): pass
+
+    def on_step(self, live_slots, queue_depth, tokens_emitted): pass
+
+    def observe_queue_depth(self, depth): pass
+
+    def stats(self):
+        return {}

@@ -61,14 +61,19 @@ def _cached_attention(q, k_cache, v_cache, q_slots, kv_valid_len,
     return out.astype(q.dtype)
 
 
-def _cached_layer(h, layer, k_cache, v_cache, positions, slot_ids,
-                  start, kv_valid_len, cfg: LlamaConfig,
-                  slot_live=None):
-    """One decoder layer over a chunk [B, S, d] whose K/V are WRITTEN
-    into the cache at slots [start, start+S); ``positions`` are the
-    ROPE position ids (per-row, pad-adjusted in ragged batches) while
-    ``slot_ids`` are the cache slot indices the chunk occupies.
-    Returns (h, k_cache, v_cache)."""
+def _layer_body(h, layer, k_cache, v_cache, positions, write_kv,
+                q_slots, kv_valid_len, cfg: LlamaConfig,
+                slot_live=None):
+    """The decoder-layer math shared by BOTH cached decode paths —
+    generate.py's contiguous-chunk writes and engine.py's per-row
+    scatter writes: rmsnorm → q/k/v projections → RoPE → cache write →
+    causal cached attention → attn residual → gated MLP residual.
+
+    The ONLY thing that differs between the two paths is how this
+    chunk's K/V land in the cache, so exactly that is injected as
+    ``write_kv(k_cache, v_cache, k, v) -> (k_cache, v_cache)``; every
+    other op stays in lockstep by construction (a norm tweak or
+    attention change here reaches the engine automatically)."""
     dt = cfg.dtype
     x = _rmsnorm(h, layer["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", x, layer["wq"].astype(dt))
@@ -76,11 +81,8 @@ def _cached_layer(h, layer, k_cache, v_cache, positions, slot_ids,
     v = jnp.einsum("bsd,dhk->bshk", x, layer["wv"].astype(dt))
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
-    o = _cached_attention(q, k_cache, v_cache, slot_ids, kv_valid_len,
+    k_cache, v_cache = write_kv(k_cache, v_cache, k, v)
+    o = _cached_attention(q, k_cache, v_cache, q_slots, kv_valid_len,
                           cfg, slot_live=slot_live)
     h = h + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
     x = _rmsnorm(h, layer["mlp_norm"], cfg.norm_eps)
@@ -89,6 +91,26 @@ def _cached_layer(h, layer, k_cache, v_cache, positions, slot_ids,
     h = h + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
                        layer["w_down"].astype(dt))
     return h, k_cache, v_cache
+
+
+def _cached_layer(h, layer, k_cache, v_cache, positions, slot_ids,
+                  start, kv_valid_len, cfg: LlamaConfig,
+                  slot_live=None):
+    """One decoder layer over a chunk [B, S, d] whose K/V are WRITTEN
+    into the cache at slots [start, start+S); ``positions`` are the
+    ROPE position ids (per-row, pad-adjusted in ragged batches) while
+    ``slot_ids`` are the cache slot indices the chunk occupies.
+    Returns (h, k_cache, v_cache)."""
+
+    def write_kv(k_cache, v_cache, k, v):
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
+        return k_cache, v_cache
+
+    return _layer_body(h, layer, k_cache, v_cache, positions, write_kv,
+                       slot_ids, kv_valid_len, cfg, slot_live=slot_live)
 
 
 def forward_cached(params: Params, tokens: jax.Array, cache: Cache,
@@ -147,16 +169,19 @@ def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if top_p < 1.0:
-            sort = jnp.sort(logits, axis=-1)[..., ::-1]
+            idx = jnp.argsort(logits, axis=-1)[..., ::-1]
+            sort = jnp.take_along_axis(logits, idx, axis=-1)
             probs = jax.nn.softmax(sort.astype(jnp.float32), axis=-1)
             cum = jnp.cumsum(probs, axis=-1)
             # keep tokens whose PRECEDING cumulative mass is still below
             # top_p; the argmax always survives (its preceding mass is 0)
             keep = (cum - probs) < top_p
-            thresh = jnp.min(
-                jnp.where(keep, sort, jnp.asarray(jnp.inf, sort.dtype)),
-                axis=-1, keepdims=True)
-            logits = jnp.where(logits < thresh, neg, logits)
+            # scatter the keep-mask back through the argsort rather than
+            # thresholding on the logit VALUE: a token tying the smallest
+            # kept logit must not ride into the nucleus and inflate it
+            inv = jnp.argsort(idx, axis=-1)
+            keep = jnp.take_along_axis(keep, inv, axis=-1)
+            logits = jnp.where(keep, logits, neg)
     return logits
 
 

@@ -95,6 +95,40 @@ class Histogram(_ServeTagged, _um.Histogram):
         super().observe(value, tags)
 
 
+_engine_stat_gauges: Dict[str, Gauge] = {}
+
+
+def report_engine_stats(stats: Dict[str, float],
+                        prefix: str = "serve_llm_engine") -> None:
+    """Publish a DecodeEngine ``stats()`` snapshot through the serve
+    metric plane: every numeric field becomes a ``<prefix>_<field>``
+    gauge carrying the replica's deployment/replica/application context
+    tags, so engine health (queue depth, slot occupancy, TTFT/TPOT
+    means, token counters) lands on the same GCS → dashboard /metrics
+    Prometheus path as the built-in request series.
+
+    Call it from the replica that owns the engine — typically once per
+    stepper-loop iteration or on a timer:
+
+        emitted = self.engine.step()
+        serve.metrics.report_engine_stats(self.engine.stats())
+
+    The engine's OWN util.metrics instruments (llm_engine_*) are
+    engine-tagged but replica-blind; this is the deployment-tagged
+    view. Gauges are cached per field, so per-step calls only pay a
+    dict update. Outside a replica the gauges still record, just
+    without context tags (same contract as user serve metrics)."""
+    for field, value in stats.items():
+        if not isinstance(value, (int, float)):
+            continue
+        name = f"{prefix}_{field}"
+        g = _engine_stat_gauges.get(name)
+        if g is None:
+            g = _engine_stat_gauges[name] = Gauge(
+                name, f"DecodeEngine stats field {field!r}")
+        g.set(float(value))
+
+
 def record_autoscaling_metric(value: float) -> None:
     """Publish this replica's current value of the deployment's custom
     autoscaling metric. The controller averages the per-replica values

@@ -111,12 +111,19 @@ def test_serve_llm_continuous_batching():
     """Continuous batching behind Serve: concurrent requests share ONE
     DecodeEngine — each submits into a slot and a background stepper
     advances the whole batch, so requests join and leave mid-flight.
-    Every caller's tokens equal its solo generate run, and the engine
-    really served overlapping requests (not one at a time)."""
+    Every caller's tokens equal its solo generate run, the engine
+    really served overlapping requests (not one at a time), and the
+    engine's stats() snapshot flows through the serve metric plane.
+
+    Overlap is DETERMINISTIC, not timing-dependent: the stepper is
+    gated on a barrier until all test requests have been submitted, so
+    the first decode step always sees a full queue — a slow CI box
+    cannot serialize the requests."""
 
     @serve.deployment(max_ongoing_requests=16)
     class EngineLM:
-        def __init__(self):
+        def __init__(self, barrier_n=1):
+            import asyncio
             import jax
 
             from ray_tpu.models import LlamaConfig, llama_init
@@ -129,15 +136,24 @@ def test_serve_llm_continuous_batching():
             self._queues = {}
             self._stepper = None
             self.max_live = 0
+            self._barrier_n = barrier_n
+            self._submitted = 0
+            self._barrier = asyncio.Event()
 
         async def _step_loop(self):
             import asyncio
 
+            from ray_tpu import serve as _serve
+
+            # barrier: don't decode until the whole test workload is
+            # queued — overlap stops depending on event-loop timing
+            await self._barrier.wait()
             while self.engine.pending():
                 emitted = self.engine.step()
                 self.max_live = max(
                     self.max_live,
                     sum(r is not None for r in self.engine.row_req))
+                _serve.metrics.report_engine_stats(self.engine.stats())
                 for rid, toks in emitted.items():
                     q = self._queues.get(rid)
                     if q is not None:
@@ -153,6 +169,9 @@ def test_serve_llm_continuous_batching():
             import asyncio
 
             rid = self.engine.submit(prompt, max_new_tokens)
+            self._submitted += 1
+            if self._submitted >= self._barrier_n:
+                self._barrier.set()
             q = asyncio.Queue()
             self._queues[rid] = q
             if self._stepper is None or self._stepper.done():
@@ -169,6 +188,9 @@ def test_serve_llm_continuous_batching():
 
         def get_max_live(self):
             return self.max_live
+
+        def get_stats(self):
+            return self.engine.stats()
 
     @serve.deployment
     class SoloLM:
@@ -190,17 +212,43 @@ def test_serve_llm_continuous_batching():
                            self.cfg, max_new_tokens=max_new_tokens)
             return np.asarray(out)[0].tolist()
 
-    handle = serve.run(EngineLM.bind(), name="englm",
+    prompts = [[5, 6, 7], [9, 8, 7, 6, 5], [1, 2], [3, 1, 4, 1]]
+    handle = serve.run(EngineLM.bind(len(prompts)), name="englm",
                        route_prefix=None, _proxy=False, timeout_s=180)
     solo = serve.run(SoloLM.bind(), name="sololm",
                      route_prefix=None, _proxy=False, timeout_s=180)
-    prompts = [[5, 6, 7], [9, 8, 7, 6, 5], [1, 2], [3, 1, 4, 1]]
     futures = [handle.generate.remote(p, 5) for p in prompts]
     outs = [f.result(timeout_s=300) for f in futures]
     for p, out in zip(prompts, outs):
         want = solo.generate.remote(p, 5).result(timeout_s=300)
         assert out == want, f"prompt {p}"
     assert handle.get_max_live.remote().result(timeout_s=30) > 1
+
+    # Engine telemetry surfaced through the deployment: the stats()
+    # snapshot counted the workload...
+    stats = handle.get_stats.remote().result(timeout_s=30)
+    assert stats["requests_finished"] == len(prompts)
+    assert stats["tokens_generated"] == 5 * len(prompts)
+    assert stats["ttft_s_count"] == len(prompts)
+    assert stats["queue_wait_s_mean"] >= 0
+    # ...and report_engine_stats republished it as deployment-tagged
+    # serve_llm_engine_* gauges on the GCS -> /metrics Prometheus path.
+    import time as _time
+
+    from ray_tpu._private.worker import global_worker
+
+    deadline = _time.time() + 20
+    rows = []
+    while _time.time() < deadline:
+        rows = [r for r in global_worker().gcs_call("get_metrics")
+                if r["name"] == "serve_llm_engine_tokens_generated"
+                and r["tags"].get("deployment") == "EngineLM"]
+        if rows:
+            break
+        _time.sleep(0.5)
+    assert rows, "engine stats never reached the GCS metric plane"
+    assert rows[0]["value"] == 5 * len(prompts)
+    assert rows[0]["tags"]["application"] == "englm"
     serve.delete("englm")
     serve.delete("sololm")
 

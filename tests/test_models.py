@@ -507,6 +507,23 @@ def test_sampling_filters_topk_topp():
     f9 = np.asarray(filter_logits(logits, top_p=0.9))[0]
     assert (f9 > -1e30).tolist() == [True, True, True, False]
 
+    # tied logits must NOT inflate the nucleus: four equal logits
+    # (mass .25 each) at p=0.3 keep exactly the 2-token sorted prefix
+    # (preceding masses 0 and .25 < .3) — the old value-threshold
+    # compare kept all four ties
+    tied = jnp.zeros((1, 4), jnp.float32)
+    ft = np.asarray(filter_logits(tied, top_p=0.3))[0]
+    assert (ft > -1e30).sum() == 2
+    # and at p=0.2 only the first sorted token survives
+    ft1 = np.asarray(filter_logits(tied, top_p=0.2))[0]
+    assert (ft1 > -1e30).sum() == 1
+    # partial tie: [3, 3, 1] with p=0.6 keeps both tied threes (their
+    # preceding masses 0 and .468 are < .6) and excludes the third
+    ft2 = np.asarray(filter_logits(
+        jnp.array([[3.0, 3.0, 1.0]], jnp.float32), top_p=0.6))[0]
+    assert (ft2 > -1e30).tolist()[2] is False
+    assert (ft2 > -1e30).sum() == 2
+
     # no-op knobs and composition (top-k first, then nucleus)
     np.testing.assert_array_equal(
         np.asarray(filter_logits(logits, top_k=4, top_p=1.0)),
