@@ -190,15 +190,19 @@ def _bench_config(cfg, batch_size: int, seq_len: int, steps: int,
 
 
 def _bench_serving(cfg, *, batch_sizes, prompt_len: int,
-                   new_tokens: int, trials: int) -> dict:
+                   new_tokens: int, trials: int,
+                   horizons=(1, 4, 8)) -> dict:
     """Engine serving throughput on ONE chip: per batch size, the
-    prefill rate (row-by-row admission prefills, the engine's real
-    admission path) and the steady-state decode rate (the shared
-    per-row-scatter decode program with every slot live), plus
-    mid-flight-churn throughput (queue deeper than slots, ragged
-    budgets — slots are reused as rows finish). Tokens/s are wall-clock
-    host-inclusive numbers: this measures the serving engine, not the
-    bare kernel."""
+    prefill rate (batched admission prefills, the engine's real
+    admission path) and the steady-state fused-decode rate (every slot
+    live, adaptive horizon), plus a HORIZON SWEEP (pinned H — H=1 is
+    the historical one-dispatch-one-sync-per-token path, larger H
+    amortizes both across the fused block; `host_syncs_per_token` is
+    the direct evidence) and mid-flight-churn throughput at
+    decode_horizon 1 vs the default (queue deeper than slots, ragged
+    budgets — slots are reused as rows finish mid-horizon). Tokens/s
+    are wall-clock host-inclusive numbers: this measures the serving
+    engine, not the bare kernel."""
     import jax
     import numpy as np
 
@@ -213,62 +217,107 @@ def _bench_serving(cfg, *, batch_sizes, prompt_len: int,
         return [rng.randint(1, cfg.vocab_size, size=length).tolist()
                 for _ in range(n)]
 
-    def make_engine(B):
+    def make_engine(B, horizon=8):
         return DecodeEngine(params, cfg, batch_slots=B, max_len=max_len,
+                            decode_horizon=horizon,
                             enable_metrics=False)
 
     def spread_pct(rs):
         return ((max(rs) - min(rs)) / max(rs) * 100.0) if max(rs) else 0.0
 
+    def drain(eng, horizon=None):
+        """Drive to empty at a pinned (or adaptive) horizon; returns
+        tokens emitted — a fused step emits up to H per row, so rates
+        must count TOKENS, never steps x slots."""
+        toks = 0
+        while eng.pending():
+            ev = eng.step(horizon=horizon)
+            toks += sum(len(t) for t in ev.values())
+        return toks
+
     per_batch = {}
     for B in batch_sizes:
-        # warmup: compile this B's prefill bucket + decode program
+        # warmup: compile this B's prefill bucket + fused decode
+        # programs (adaptive drain touches H=1 and the full horizon)
         eng = make_engine(B)
         for p in prompts(B):
             eng.submit(p, new_tokens)
-        eng.run()
+        drain(eng)
 
-        pre_rates, dec_rates = [], []
+        pre_rates, dec_rates, spt = [], [], []
         for _ in range(trials):
             eng = make_engine(B)
             for p in prompts(B):
                 eng.submit(p, new_tokens)
             t0 = time.perf_counter()
-            eng.step()       # admits all B rows: B prefills (+1 decode)
+            eng.step(horizon=1)  # admits all B rows (batched prefill)
             t1 = time.perf_counter()
-            steps = 0
-            while eng.pending():
-                eng.step()   # pure decode, all slots live
-                steps += 1
+            toks = drain(eng)    # fused decode, all slots live
             t2 = time.perf_counter()
             pre_rates.append(B * prompt_len / (t1 - t0))
-            if steps:
-                dec_rates.append(B * steps / (t2 - t1))
+            if toks:
+                dec_rates.append(toks / (t2 - t1))
+            s = eng.stats()
+            spt.append(s["host_syncs_per_token"])
         per_batch[f"b{B}"] = {
             "prefill_tokens_per_sec": round(
                 statistics.median(pre_rates), 1),
             "decode_tokens_per_sec": round(
                 statistics.median(dec_rates), 1),
+            "host_syncs_per_token": round(statistics.median(spt), 4),
             "trial_spread_pct": round(spread_pct(dec_rates), 2),
             "trials_taken": len(dec_rates),
         }
 
+    # Horizon sweep at the largest batch: same workload, pinned H.
+    B = max(batch_sizes)
+    horizon_sweep = {}
+    for H in horizons:
+        eng = make_engine(B, horizon=H)      # warmup: compile THIS H
+        for p in prompts(B):
+            eng.submit(p, new_tokens)
+        eng.step(horizon=1)
+        drain(eng, horizon=H)
+        rates, spt = [], []
+        for _ in range(trials):
+            eng = make_engine(B, horizon=H)
+            for p in prompts(B):
+                eng.submit(p, new_tokens)
+            eng.step(horizon=1)          # admission outside the clock
+            t0 = time.perf_counter()
+            toks = drain(eng, horizon=H)
+            dt = time.perf_counter() - t0
+            if toks:
+                rates.append(toks / dt)
+            spt.append(eng.stats()["host_syncs_per_token"])
+        horizon_sweep[f"h{H}"] = {
+            "decode_tokens_per_sec": round(statistics.median(rates), 1),
+            "host_syncs_per_token": round(statistics.median(spt), 4),
+            "trial_spread_pct": round(spread_pct(rates), 2),
+        }
+
     # Churn: 3x oversubscribed queue, ragged budgets — requests join
     # and leave mid-flight, slots are reused, prefills interleave with
-    # decode steps. Tokens/s over the whole drain is the end-to-end
-    # engine throughput a loaded server actually delivers.
-    B = max(batch_sizes)
-    churn_rates = []
-    for _ in range(trials):
-        eng = make_engine(B)
-        total = 0
-        for i, p in enumerate(prompts(3 * B)):
-            n = new_tokens if i % 2 == 0 else max(2, new_tokens // 2)
-            eng.submit(p, n)
-            total += n
-        t0 = time.perf_counter()
-        eng.run()
-        churn_rates.append(total / (time.perf_counter() - t0))
+    # fused decode blocks. Run at decode_horizon=1 (the historical
+    # per-step path) and the default horizon: the gap is the tentpole's
+    # end-to-end win under realistic load.
+    def churn(horizon):
+        rates = []
+        for trial in range(trials + 1):     # +1 untimed warmup: churn
+            eng = make_engine(B, horizon=horizon)   # hits prefill
+            total = 0                       # group sizes and capped
+            for i, p in enumerate(prompts(3 * B)):  # horizons the
+                n = new_tokens if i % 2 == 0 else max(2, new_tokens // 2)
+                eng.submit(p, n)            # steady sweep never compiled
+                total += n
+            t0 = time.perf_counter()
+            eng.run()
+            if trial:
+                rates.append(total / (time.perf_counter() - t0))
+        return round(statistics.median(rates), 1)
+
+    churn_h1 = churn(1)
+    churn_h8 = churn(8)
 
     biggest = per_batch[f"b{max(batch_sizes)}"]
     return {
@@ -277,7 +326,11 @@ def _bench_serving(cfg, *, batch_sizes, prompt_len: int,
         "unit": "tokens/s",
         "prefill_tokens_per_sec": biggest["prefill_tokens_per_sec"],
         "decode_tokens_per_sec": biggest["decode_tokens_per_sec"],
-        "churn_tokens_per_sec": round(statistics.median(churn_rates), 1),
+        "host_syncs_per_token": biggest["host_syncs_per_token"],
+        "churn_tokens_per_sec": churn_h8,
+        "churn_tokens_per_sec_h1": churn_h1,
+        "churn_tokens_per_sec_h8": churn_h8,
+        "horizon_sweep": horizon_sweep,
         "batch_sizes": list(batch_sizes),
         "per_batch": per_batch,
         "prompt_len": prompt_len,

@@ -5,16 +5,27 @@ torch callables; continuous batching lives outside it in vLLM-class
 engines). Serving an LM is this framework's flagship deployment, so
 slot-based continuous batching is first-class here, built the XLA way:
 
-- ONE decode program for the whole engine, compiled once: B fixed
-  decode slots advance together each step, every row at its OWN cache
-  offset (per-row scatter writes + per-row masks — no recompilation as
-  requests come and go, no left-padding).
-- Admission is a per-length-bucket prefill program that writes one
-  request's prompt K/V into a freed slot's cache row while the other
-  rows' state rides along untouched (donated buffers, in-place in HBM).
+- ONE fused decode program for the whole engine: B fixed decode slots
+  advance together, every row at its OWN cache offset (per-row scatter
+  writes + per-row masks — no recompilation as requests come and go,
+  no left-padding). H decode iterations run inside a single program
+  (`_decode_multi`: lax.scan + on-device sampling + per-row eos/budget
+  freezing), so the host pays ONE dispatch and ONE device->host
+  transfer per H tokens instead of a blocking sample per token — the
+  vLLM/Orca lesson that the decode inner loop must be free of host
+  synchronization, applied the XLA way.
+- Admission is a per-length-bucket BATCHED prefill program
+  (`_prefill_rows`): all same-bucket admissions of a step write their
+  prompts' K/V into freed slots' cache rows in one dispatch while the
+  other rows' state rides along untouched (donated buffers, in-place
+  in HBM). First tokens are sampled on device by the fused decode from
+  the device-resident `last_logits` — admission costs zero host
+  round-trips.
 - A finished row's slot is reused immediately: its stale K/V need no
   clearing because every mask is `slot < row_len`, and the next
-  occupant's prefill overwrites from slot 0.
+  occupant's prefill overwrites from slot 0. Rows finishing
+  mid-horizon freeze on device (row_len stops, emits masked to -1)
+  and are retired by the host replay of the token block.
 
 Consistency contract (tested): greedy engine output for every request
 is token-identical to that request's solo `generate` run, regardless of
@@ -44,8 +55,8 @@ import numpy as np
 
 from ray_tpu.models.engine_metrics import EngineMetrics, NullEngineMetrics
 from ray_tpu.models.generate import (_check_sampling_knobs,
-                                     _layer_body, _sample_token,
-                                     forward_cached, init_cache)
+                                     _layer_body, forward_cached,
+                                     init_cache, sample_rows)
 from ray_tpu.models.llama import LlamaConfig, _rmsnorm
 from ray_tpu.models.scheduler import (EngineOverloaded, SchedulerPolicy,
                                       make_policy)
@@ -53,34 +64,59 @@ from ray_tpu.models.scheduler import (EngineOverloaded, SchedulerPolicy,
 Params = Dict[str, Any]
 
 
+def _key_data(key) -> np.ndarray:
+    """Raw uint32[2] bits of a PRNG key (legacy array or typed key)."""
+    try:
+        return np.asarray(key, np.uint32).reshape(2)
+    except (TypeError, ValueError):
+        return np.asarray(jax.random.key_data(key),
+                          np.uint32).reshape(2)
+
+
+def _device_get(x) -> np.ndarray:
+    """The engine's ONLY device->host transfer. Every blocking fetch in
+    the serving loop funnels through here so (a) the engine can count
+    host syncs for telemetry (`host_syncs_per_token`) and (b) tests can
+    wrap it to GATE the transfer budget — the fused decode path must
+    stay at one pull per horizon, and an accidental per-token sync
+    reintroduction fails tests/test_engine_horizon.py."""
+    return np.asarray(x)
+
+
 # ---------------------------------------------------------------------------
 # Compiled programs
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=("cfg",),
-                   donate_argnames=("cache",))
-def _prefill_row(params: Params, prompt: jax.Array, cache, row,
-                 last_idx, cfg: LlamaConfig):
-    """Write `prompt` [1, Pb] K/V into cache row `row` at slots
-    [0, Pb) and return (last-real-token logits [vocab], cache).
+                   donate_argnames=("cache", "last_logits"))
+def _prefill_rows(params: Params, prompts: jax.Array, cache,
+                  last_logits, rows: jax.Array, last_idx: jax.Array,
+                  cfg: LlamaConfig):
+    """Batched admission: write N same-bucket prompts' [N, Pb] K/V into
+    N freed slots in ONE program and scatter each row's last-real-token
+    logits into the engine's device-resident `last_logits` [B, vocab].
+    Returns (cache, last_logits) — no logits ever cross to the host;
+    the fused decode program samples the first token on device, so an
+    admission costs zero host round-trips.
 
-    Pb may exceed the true prompt length (length-bucketed serving):
+    Pb may exceed a prompt's true length (length-bucketed serving):
     trailing filler tokens' K/V land at slots >= the true length, which
     every later mask excludes (`slot < row_len`), and causality keeps
     real tokens from ever attending filler — only the logits at
-    `last_idx` (true length - 1) are read out."""
-    row_cache = {
-        "k": jax.lax.dynamic_slice_in_dim(cache["k"], row, 1, axis=1),
-        "v": jax.lax.dynamic_slice_in_dim(cache["v"], row, 1, axis=1),
-    }
-    logits, row_cache = forward_cached(params, prompt, row_cache, 0, cfg)
+    `last_idx` (true length - 1) are read out. `rows` may contain
+    duplicates (power-of-two group padding repeats the last admission
+    verbatim): duplicate scatters write identical values, so the result
+    is deterministic."""
+    row_cache = {"k": cache["k"][:, rows], "v": cache["v"][:, rows]}
+    logits, row_cache = forward_cached(params, prompts, row_cache, 0,
+                                       cfg)
     cache = {
-        "k": jax.lax.dynamic_update_slice_in_dim(
-            cache["k"], row_cache["k"], row, axis=1),
-        "v": jax.lax.dynamic_update_slice_in_dim(
-            cache["v"], row_cache["v"], row, axis=1),
+        "k": cache["k"].at[:, rows].set(row_cache["k"]),
+        "v": cache["v"].at[:, rows].set(row_cache["v"]),
     }
-    return logits[0, last_idx], cache
+    n = prompts.shape[0]
+    last = logits[jnp.arange(n), last_idx]              # [N, vocab]
+    return cache, last_logits.at[rows].set(last)
 
 
 def _decode_layer_rows(h, layer, k_cache, v_cache, write_slots,
@@ -112,15 +148,15 @@ def _decode_layer_rows(h, layer, k_cache, v_cache, write_slots,
                        write_slots[:, None], k_cache.shape[1], cfg)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",),
-                   donate_argnames=("cache",))
-def _decode_rows(params: Params, toks: jax.Array, cache, row_len,
+def _decode_core(params: Params, toks: jax.Array, cache, row_len,
                  cfg: LlamaConfig):
     """One decode step for ALL slots: row b's token `toks[b]` is
     written at slot `row_len[b]` and attends slots [0, row_len[b]].
-    Dead rows (row_len 0) compute discarded garbage at slot 0 — their
-    slot is overwritten by the next admission's prefill. Returns
-    (next-token logits [B, vocab] f32, cache)."""
+    Dead/frozen rows compute discarded garbage at their frontier slot —
+    it lands one past their real tokens (or at slot 0 for empty rows)
+    and is overwritten by the next occupant's prefill, with every mask
+    excluding it meanwhile. Returns (next-token logits [B, vocab] f32,
+    cache). Plain function so `_decode_multi`'s scan can inline it."""
     write_slots = row_len                                   # [B]
     h = params["tok_embed"].astype(cfg.dtype)[toks[:, None]]
 
@@ -140,16 +176,77 @@ def _decode_rows(params: Params, toks: jax.Array, cache, row_len,
     return logits[:, 0], {"k": k_new, "v": v_new}
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "horizon", "greedy",
+                                    "top_k", "top_p", "eos_id"),
+                   donate_argnames=("cache", "last_logits"))
+def _decode_multi(params: Params, cache, last_logits, row_len, active,
+                  budget, tok_idx, row_keys, temperature,
+                  cfg: LlamaConfig, horizon: int, greedy: bool,
+                  top_k: Optional[int], top_p: Optional[float],
+                  eos_id: Optional[int]):
+    """Fuse `horizon` decode iterations into ONE program: a `lax.scan`
+    whose body samples every row's next token ON DEVICE from the
+    carried `last_logits` (greedy argmax, or per-row rng streams — see
+    generate.sample_rows), feeds it through `_decode_core`, and applies
+    per-row eos/budget/room masking so rows that finish mid-horizon
+    FREEZE: their row_len stops advancing, their `last_logits` stops
+    updating, and their remaining emits are masked to -1. The host gets
+    the whole [horizon, B] token block in a single transfer instead of
+    one blocking sample per token.
+
+    Per-iteration transition (bit-identical to the host replay in
+    `DecodeEngine._emit`, which mirrors it without touching the
+    device):
+        tok      = sample(last_logits)          # emit if active
+        budget  -= active;  tok_idx += active
+        done     = budget <= 0 | row_len+1 >= max_len | tok == eos
+        feed tok at slot row_len (all rows; frozen rows write garbage
+        one slot past their content — masked everywhere, overwritten by
+        the slot's next prefill)
+        row_len += active & ~done;  last_logits updates where continuing
+
+    Returns (toks [horizon, B] int32, cache, last_logits). `last_logits`
+    carries across calls, so the final iteration's decode is never
+    wasted — the next horizon samples straight from it."""
+    max_len = cache["k"].shape[2]
+
+    def body(carry, _):
+        cache, last_logits, row_len, active, budget, tok_idx = carry
+        tok = sample_rows(last_logits, row_keys, tok_idx,
+                          greedy=greedy, temperature=temperature,
+                          top_k=top_k, top_p=top_p)
+        emit = jnp.where(active, tok, -1)
+        live = active.astype(jnp.int32)
+        budget = budget - live
+        tok_idx = tok_idx + live
+        done_now = (budget <= 0) | (row_len + 1 >= max_len)
+        if eos_id is not None:
+            done_now = done_now | (tok == eos_id)
+        cont = active & ~done_now
+        logits, cache = _decode_core(params, tok, cache, row_len, cfg)
+        row_len = row_len + cont.astype(jnp.int32)
+        last_logits = jnp.where(cont[:, None], logits, last_logits)
+        return (cache, last_logits, row_len, cont, budget,
+                tok_idx), emit
+
+    (cache, last_logits, _, _, _, _), toks = jax.lax.scan(
+        body, (cache, last_logits, row_len, active, budget, tok_idx),
+        None, length=horizon)
+    return toks, cache, last_logits
+
+
 # ---------------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------------
 
 class _Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "tokens", "done",
-                 "priority", "seq")
+                 "priority", "seq", "rng")
 
     def __init__(self, req_id: int, prompt: List[int],
-                 max_new_tokens: int, priority: int = 0, seq: int = 0):
+                 max_new_tokens: int, priority: int = 0, seq: int = 0,
+                 rng: Optional[np.ndarray] = None):
         self.req_id = req_id
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
@@ -157,21 +254,35 @@ class _Request:
         self.done = False
         self.priority = priority    # lower = admitted first (priority policy)
         self.seq = seq              # submission order (FIFO tie-break)
+        self.rng = rng              # [2] uint32 per-request key stream
 
 
 class DecodeEngine:
     """Slot-based continuous batching over a shared KV cache.
 
-    `submit()` enqueues a request; `step()` advances the whole engine
-    one token (admitting queued requests into free slots first) and
-    returns the tokens emitted this step; `run()` drains everything.
+    `submit()` enqueues a request; `step()` admits queued requests into
+    free slots (batched, same-bucket prefills share ONE program), then
+    advances every live slot up to `decode_horizon` tokens with ONE
+    fused device program and ONE device->host transfer (the [H, B]
+    token block); `run()` drains everything. The horizon adapts each
+    step via the scheduler's `horizon_hint`: 1 while queued requests
+    could take a free slot next step (protect TTFT), the full
+    `decode_horizon` once slots are saturated or the queue is empty
+    (amortize dispatch overhead) — pass `step(horizon=...)` to pin it.
+
     Greedy by default; sampling mode (greedy=False) applies the same
-    temperature/top_k/top_p semantics as `generate` with an
-    engine-owned key stream.
+    temperature/top_k/top_p semantics as `generate`, with a PER-REQUEST
+    key stream: request r's i-th token uses
+    ``step_rng_key(r.rng, i)`` — exactly solo `generate`'s schedule —
+    so sampled output, like greedy output, is token-identical to that
+    request's solo run (pass ``submit(..., rng=...)`` to pin a stream;
+    the default derives one from the engine rng and request id).
 
     bucket_lens=True rounds each admission's prefill to the next power
-    of two, so a handful of XLA compiles (one per length bucket) cover
-    all traffic; the decode program compiles exactly once.
+    of two, so a handful of XLA compiles (one per length bucket x
+    power-of-two admission-group size) cover all traffic; adaptive
+    stepping rounds the horizon down to a power of two, so the fused
+    decode program compiles at most log2(decode_horizon)+1 variants.
 
     Scheduling / admission control (models/scheduler.py):
       scheduler="fifo"|"priority"|SchedulerPolicy — which queued
@@ -201,6 +312,7 @@ class DecodeEngine:
                  max_queue: Optional[int] = None,
                  on_full: str = "reject",
                  max_prefills_per_step: Optional[int] = None,
+                 decode_horizon: int = 8,
                  engine_id: Optional[str] = None,
                  enable_metrics: bool = True):
         _check_sampling_knobs(greedy, top_k, top_p)
@@ -211,6 +323,8 @@ class DecodeEngine:
             raise ValueError("max_queue must be >= 1")
         if max_prefills_per_step is not None and max_prefills_per_step < 1:
             raise ValueError("max_prefills_per_step must be >= 1")
+        if decode_horizon < 1:
+            raise ValueError("decode_horizon must be >= 1")
         self.params = params
         self.cfg = cfg
         self.B = batch_slots
@@ -230,30 +344,49 @@ class DecodeEngine:
         self.max_queue = max_queue
         self.on_full = on_full
         self.max_prefills_per_step = max_prefills_per_step
+        self.decode_horizon = decode_horizon
         self.metrics = (EngineMetrics(engine_id=engine_id,
                                       batch_slots=self.B)
                         if enable_metrics else NullEngineMetrics())
 
         self.cache = init_cache(cfg, self.B, self.max_len)
+        # Next-token logits per slot, DEVICE-resident: prefill scatters
+        # into it, the fused decode samples from and re-carries it —
+        # logits never cross the jit boundary to the host.
+        self._last_logits = jnp.zeros((self.B, cfg.vocab_size),
+                                      jnp.float32)
         self.row_len = np.zeros((self.B,), np.int32)   # written slots
         self.row_req: List[Optional[_Request]] = [None] * self.B
         self.row_budget = np.zeros((self.B,), np.int32)
-        self._next_tok = np.zeros((self.B,), np.int32)  # pending feed
+        self._tok_idx = np.zeros((self.B,), np.int32)  # sampled so far
+        self._row_keys = np.zeros((self.B, 2), np.uint32)
+        self._base_key = _key_data(self._rng)
         self._next_id = 0
         self.results: Dict[int, _Request] = {}
         self.finished: set = set()      # done but not yet popped
+        # Dispatch/transfer accounting (plain ints so the benchmark's
+        # enable_metrics=False engines still report them):
+        self.decode_dispatches = 0     # fused decode program launches
+        self.prefill_dispatches = 0    # batched prefill launches
+        self.host_syncs = 0            # device->host transfers
+        self.tokens_out = 0            # tokens emitted, all requests
 
     # -- public API --------------------------------------------------------
 
     def submit(self, prompt: List[int], max_new_tokens: int = 32,
-               priority: int = 0) -> int:
+               priority: int = 0,
+               rng: Optional[jax.Array] = None) -> int:
         """Enqueue a request; returns its id (see `results`).
 
         ``priority`` (lower = sooner) orders admission under the
         priority policy; the FIFO policy ignores it. With a bounded
         queue (max_queue), a full queue either raises EngineOverloaded
         (on_full="reject") or drives the engine until a queue slot
-        frees (on_full="block")."""
+        frees (on_full="block"). ``rng`` pins this request's sampling
+        key stream (greedy=False engines): with the same key, the
+        request's sampled tokens equal solo
+        ``generate(..., rng=rng)``; by default a distinct stream is
+        derived from the engine rng and request id."""
         if not len(prompt):
             raise ValueError("empty prompt: need at least one token "
                              "(prepend a BOS token)")
@@ -272,7 +405,8 @@ class DecodeEngine:
             while len(self.scheduler) >= self.max_queue:
                 self.step()   # admissions + finishes drain the queue
         req = _Request(self._next_id, prompt, max_new_tokens,
-                       priority=priority, seq=self._next_id)
+                       priority=priority, seq=self._next_id,
+                       rng=None if rng is None else _key_data(rng))
         self._next_id += 1
         self.scheduler.push(req)
         self.results[req.req_id] = req
@@ -284,37 +418,72 @@ class DecodeEngine:
         return bool(len(self.scheduler)) or any(
             r is not None for r in self.row_req)
 
-    def step(self) -> Dict[int, List[int]]:
+    def step(self, horizon: Optional[int] = None) -> Dict[int, List[int]]:
         """Admit queued requests into free slots (at most
-        max_prefills_per_step of them), then advance every live slot
-        one token. Returns {req_id: [tokens]} emitted this step — a
-        just-admitted request can emit TWO tokens in one step (its
-        prefill's first token, then the decode's)."""
+        max_prefills_per_step of them, same-bucket admissions batched
+        into one prefill program each), then advance every live slot up
+        to `horizon` tokens in ONE fused device program with ONE
+        device->host transfer. Returns {req_id: [tokens]} emitted this
+        step — up to `horizon` per request; a request that finishes
+        mid-horizon (budget/eos/room) is frozen on device and retired
+        here, and its slot admits a newcomer next step.
+
+        ``horizon=None`` (the default) adapts: the scheduler's
+        `horizon_hint` picks 1 while a queued request could take a free
+        slot next step, else `decode_horizon`, capped at the largest
+        remaining budget (no trailing iterations run fully frozen) and
+        rounded down to a power of two (bounded compile count)."""
+        if horizon is not None and horizon < 1:
+            raise ValueError("horizon must be >= 1")
         emitted: Dict[int, List[int]] = {}
         budget = self.max_prefills_per_step or self.B
+        admissions: List[Tuple[int, _Request]] = []
         for row in range(self.B):
             if budget <= 0:
                 break
             if self.row_req[row] is None and len(self.scheduler):
-                self._admit(row, self.scheduler.pop(), emitted)
+                admissions.append((row, self.scheduler.pop()))
                 budget -= 1
+        if admissions:
+            self._admit_rows(admissions)
 
         live = [b for b in range(self.B) if self.row_req[b] is not None]
         if not live:
             return emitted
 
-        toks = jnp.asarray(self._next_tok)
-        logits, self.cache = _decode_rows(
-            self.params, toks, self.cache, jnp.asarray(self.row_len),
-            self.cfg)
-        self.row_len[live] += 1  # fed tokens now occupy their slots
-        nxt = self._sample(logits)
-        for b in live:
-            self._emit(b, int(nxt[b]), emitted)
+        H = horizon
+        if H is None:
+            free = self.B - len(live)
+            H = self.scheduler.horizon_hint(
+                free_slots=free, max_horizon=self.decode_horizon)
+            # Cap at the largest remaining row budget (no trailing
+            # iterations with every row frozen), rounded DOWN to a
+            # power of two: the fused program recompiles per distinct
+            # H, so adaptive serving touches at most log2(horizon)+1
+            # programs instead of one per budget remainder.
+            H = min(H, int(self.row_budget[live].max()))
+            H = 1 << max(0, H.bit_length() - 1)
+        active = np.array([r is not None for r in self.row_req])
+        toks, self.cache, self._last_logits = _decode_multi(
+            self.params, self.cache, self._last_logits,
+            jnp.asarray(self.row_len), jnp.asarray(active),
+            jnp.asarray(self.row_budget), jnp.asarray(self._tok_idx),
+            jnp.asarray(self._row_keys), self.temperature, self.cfg,
+            H, self.greedy, self.top_k, self.top_p, self.eos_id)
+        self.decode_dispatches += 1
+        block = _device_get(toks)          # the step's ONE host sync
+        self.host_syncs += 1
+        for i in range(H):
+            for b in live:
+                if self.row_req[b] is None:
+                    continue               # retired earlier in block
+                self._emit(b, int(block[i, b]), emitted)
+        n_tokens = sum(len(t) for t in emitted.values())
+        self.tokens_out += n_tokens
+        self.metrics.on_dispatch(H)
         self.metrics.on_step(
             sum(r is not None for r in self.row_req),
-            len(self.scheduler),
-            sum(len(t) for t in emitted.values()))
+            len(self.scheduler), n_tokens)
         return emitted
 
     def stats(self) -> Dict[str, float]:
@@ -326,6 +495,13 @@ class DecodeEngine:
         out["live_slots"] = float(
             sum(r is not None for r in self.row_req))
         out["slot_occupancy"] = out["live_slots"] / self.B
+        # Engine-level dispatch accounting (kept even when metrics are
+        # disabled — benchmarks read these to report syncs per token).
+        out["decode_dispatches"] = float(self.decode_dispatches)
+        out["prefill_dispatches"] = float(self.prefill_dispatches)
+        out["host_syncs"] = float(self.host_syncs)
+        out["host_syncs_per_token"] = (
+            self.host_syncs / self.tokens_out if self.tokens_out else 0.0)
         return out
 
     def run(self) -> Dict[int, List[int]]:
@@ -353,37 +529,69 @@ class DecodeEngine:
             return n
         return min(1 << (n - 1).bit_length(), self.max_len)
 
-    def _admit(self, row: int, req: _Request,
-               emitted: Dict[int, List[int]]) -> None:
-        self.metrics.on_admit(req.req_id)   # queue wait ends here
-        P = len(req.prompt)
-        Pb = self._bucket(P)
-        padded = np.zeros((1, Pb), np.int32)
-        padded[0, :P] = req.prompt
-        last_logits, self.cache = _prefill_row(
-            self.params, jnp.asarray(padded), self.cache,
-            jnp.int32(row), jnp.int32(P - 1), self.cfg)
-        self.row_req[row] = req
-        self.row_len[row] = P
-        self.row_budget[row] = req.max_new_tokens
-        tok = int(self._sample(last_logits[None, :])[0])
-        self._emit(row, tok, emitted)
+    def _req_key(self, req: _Request) -> np.ndarray:
+        """Per-request sampling stream: the submitted key verbatim, or
+        a distinct stream mixed host-side from the engine key and the
+        request id (no device dispatch per admission)."""
+        if req.rng is not None:
+            return req.rng
+        mix0 = (req.req_id * 0x9E3779B9 + 1) & 0xFFFFFFFF
+        mix1 = (req.req_id * 0x85EBCA6B + 1) & 0xFFFFFFFF
+        return np.array([int(self._base_key[0]) ^ mix0,
+                         int(self._base_key[1]) ^ mix1], np.uint32)
 
-    def _sample(self, logits: jax.Array) -> np.ndarray:
-        if self.greedy:
-            return np.asarray(jnp.argmax(logits, axis=-1)).astype(
-                np.int32)
-        self._rng, key = jax.random.split(self._rng)
-        return np.asarray(_sample_token(
-            logits, key, self.temperature, self.top_k, self.top_p))
+    def _admit_rows(self, admissions: List[Tuple[int, _Request]]) -> None:
+        """Prefill this step's admissions, grouped so every same-bucket
+        group runs as ONE batched `_prefill_rows` program (group size
+        padded to a power of two by repeating the last admission, so a
+        handful of compiles cover all traffic). First tokens are NOT
+        sampled here: each row's last-prompt logits stay on device in
+        `_last_logits` and the fused decode samples them — admission
+        costs zero host round-trips."""
+        groups: Dict[int, List[Tuple[int, _Request]]] = {}
+        for row, req in admissions:
+            self.metrics.on_admit(req.req_id)   # queue wait ends here
+            groups.setdefault(self._bucket(len(req.prompt)),
+                              []).append((row, req))
+        for Pb in sorted(groups):
+            grp = groups[Pb]
+            n = len(grp)
+            n_pad = 1 << (n - 1).bit_length()
+            prompts = np.zeros((n_pad, Pb), np.int32)
+            rows = np.zeros((n_pad,), np.int32)
+            last_idx = np.zeros((n_pad,), np.int32)
+            for i, (row, req) in enumerate(grp):
+                P = len(req.prompt)
+                prompts[i, :P] = req.prompt
+                rows[i] = row
+                last_idx[i] = P - 1
+                self.row_req[row] = req
+                self.row_len[row] = P
+                self.row_budget[row] = req.max_new_tokens
+                self._tok_idx[row] = 0
+                self._row_keys[row] = self._req_key(req)
+            prompts[n:] = prompts[n - 1]    # filler: repeat last row —
+            rows[n:] = rows[n - 1]          # duplicate scatters write
+            last_idx[n:] = last_idx[n - 1]  # identical values
+            self.cache, self._last_logits = _prefill_rows(
+                self.params, jnp.asarray(prompts), self.cache,
+                self._last_logits, jnp.asarray(rows),
+                jnp.asarray(last_idx), self.cfg)
+            self.prefill_dispatches += 1
 
     def _emit(self, row: int, tok: int,
               emitted: Dict[int, List[int]]) -> None:
+        """Host replay of ONE device emit: mirrors `_decode_multi`'s
+        per-iteration transition exactly (budget decrement, eos/room
+        check against the pre-advance row_len, then the row_len advance
+        for continuing rows) so host bookkeeping tracks device state
+        without any extra transfer."""
         req = self.row_req[row]
         req.tokens.append(tok)
         emitted.setdefault(req.req_id, []).append(tok)
         self.metrics.on_token(req.req_id)
         self.row_budget[row] -= 1
+        self._tok_idx[row] += 1
         out_of_room = self.row_len[row] + 1 >= self.max_len
         if (self.row_budget[row] <= 0 or out_of_room
                 or (self.eos_id is not None and tok == self.eos_id)):
@@ -392,6 +600,7 @@ class DecodeEngine:
             self.metrics.on_finish(req.req_id)
             self.row_req[row] = None
             self.row_len[row] = 0        # slot free for the next prefill
-            self._next_tok[row] = 0
+            self.row_budget[row] = 0
+            self._tok_idx[row] = 0
         else:
-            self._next_tok[row] = tok
+            self.row_len[row] += 1       # the fed token took its slot

@@ -39,6 +39,10 @@ LATENCY_BOUNDARIES_S = [
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0]
 
+# Fused-decode horizon buckets (tokens per dispatch): powers of two up
+# to well past the default decode_horizon of 8.
+HORIZON_BOUNDARIES = [1, 2, 4, 8, 16, 32, 64]
+
 _engine_ids = itertools.count()
 
 
@@ -104,6 +108,9 @@ class EngineMetrics:
         self.queue_wait_s = _Agg()
         self.ttft_s = _Agg()
         self.tpot_s = _Agg()
+        self.decode_dispatches = 0
+        self.host_syncs = 0
+        self.decode_horizon = _Agg()
 
         tag = {"engine": self.engine_id}
         keys = ("engine",)
@@ -152,6 +159,17 @@ class EngineMetrics:
             "llm_engine_batch_efficiency",
             "Tokens emitted this step / total slots (0..1; ~occupancy "
             "unless rows finished mid-step)")
+        self._m_dispatches = counter(
+            "llm_engine_decode_dispatches_total",
+            "Fused decode program launches (one per step horizon)")
+        self._m_host_syncs = counter(
+            "llm_engine_host_syncs_total",
+            "Blocking device->host transfers in the serving loop")
+        self._m_horizon = Histogram(
+            "llm_engine_decode_horizon",
+            "Decode iterations fused per dispatch (adaptive horizon)",
+            boundaries=HORIZON_BOUNDARIES,
+            tag_keys=keys).set_default_tags(tag)
 
     # -- lifecycle hooks (called by DecodeEngine) --------------------------
 
@@ -209,6 +227,17 @@ class EngineMetrics:
         self._m_occupancy.set(live_slots / self.batch_slots)
         self._m_batch_eff.set(self.batch_efficiency)
 
+    def on_dispatch(self, horizon: int, host_syncs: int = 1) -> None:
+        """One fused decode dispatch of `horizon` iterations, costing
+        `host_syncs` blocking device->host transfers (1 on the fused
+        path: the [H, B] token block)."""
+        self.decode_dispatches += 1
+        self.host_syncs += host_syncs
+        self.decode_horizon.add(horizon)
+        self._m_dispatches.inc()
+        self._m_host_syncs.inc(host_syncs)
+        self._m_horizon.observe(horizon)
+
     def observe_queue_depth(self, depth: int) -> None:
         """Gauge update outside a step (e.g. right after submit)."""
         self.queue_depth = depth
@@ -232,9 +261,18 @@ class EngineMetrics:
             "slot_occupancy": self.live_slots / self.batch_slots,
             "batch_efficiency": self.batch_efficiency,
         }
+        out["decode_dispatches"] = self.decode_dispatches
+        out["host_syncs"] = self.host_syncs
+        out["host_syncs_per_token"] = (
+            self.host_syncs / self.tokens_generated
+            if self.tokens_generated else 0.0)
+        out["dispatches_per_token"] = (
+            self.decode_dispatches / self.tokens_generated
+            if self.tokens_generated else 0.0)
         self.queue_wait_s.fields("queue_wait_s", out)
         self.ttft_s.fields("ttft_s", out)
         self.tpot_s.fields("tpot_s", out)
+        self.decode_horizon.fields("decode_horizon", out)
         return out
 
 
@@ -255,6 +293,8 @@ class NullEngineMetrics:
     def on_finish(self, req_id): pass
 
     def on_step(self, live_slots, queue_depth, tokens_emitted): pass
+
+    def on_dispatch(self, horizon, host_syncs=1): pass
 
     def observe_queue_depth(self, depth): pass
 

@@ -197,6 +197,40 @@ def _sample_token(logits: jax.Array, key: jax.Array, temperature,
     return jax.random.categorical(key, scaled).astype(jnp.int32)
 
 
+def step_rng_key(rng: jax.Array, step) -> jax.Array:
+    """The ONE per-step sampling-key schedule: ``fold_in(rng, step)``.
+
+    Deliberately independent of max_new_tokens, of the batch size, and
+    of how many steps are fused into one program — the key for a row's
+    i-th sampled token depends only on (rng, i). That invariance is
+    what lets the continuous-batching engine fuse H decode iterations
+    into one program (engine.py `_decode_multi`) and still reproduce a
+    request's solo `generate` samples token-for-token: each request
+    carries its own rng stream, folded with its own token index, no
+    matter which batch companions or horizon boundaries it crosses."""
+    return jax.random.fold_in(rng, step)
+
+
+def sample_rows(logits: jax.Array, row_keys: jax.Array,
+                tok_idx: jax.Array, *, greedy: bool, temperature,
+                top_k: Optional[int], top_p: Optional[float]) -> jax.Array:
+    """Per-ROW sampling inside an already-jitted decode program.
+
+    logits [B, vocab] f32; row_keys [B, 2] uint32 (one rng stream per
+    row); tok_idx [B] int32 (tokens that row has sampled so far). Row b
+    draws with ``step_rng_key(row_keys[b], tok_idx[b])`` and its own
+    categorical — bit-identical to a solo B=1 `generate` seeded with
+    that row's rng (counter-mode bits make the [1, vocab] and [vocab]
+    draws equal), so batched engine sampling can honor the per-request
+    token-identity contract. Greedy ignores keys (argmax)."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    keys = jax.vmap(step_rng_key)(row_keys, tok_idx)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    scaled = filter_logits(scaled, top_k, top_p)
+    return jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
+
+
 def _check_sampling_knobs(greedy: bool, top_k, top_p) -> None:
     """greedy=True (the default) argmaxes — refuse to silently drop
     explicitly-requested sampling filters."""
@@ -223,7 +257,9 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
     finished rows keep emitting eos (scan trip count stays static; the
     caller trims). Sampling (greedy=False) draws from the
     temperature-scaled distribution restricted by `filter_logits`'s
-    static top_k / top_p knobs.
+    static top_k / top_p knobs; token i's key is
+    ``step_rng_key(rng, i)`` (see its docstring — the schedule is the
+    cross-path sampling contract shared with the serving engine).
 
     Ragged batches: LEFT-pad prompts to a common length and pass
     ``prompt_live`` [B, P] (True = real token). Pad slots are masked
@@ -257,14 +293,15 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
                                    slot_live=slot_live)
     last = logits[:, -1]
 
-    def sample(logits_row, key):
+    def sample(logits_row, i):
         if greedy:
             return jnp.argmax(logits_row, axis=-1).astype(jnp.int32)
-        return _sample_token(logits_row, key, temperature, top_k, top_p)
+        return _sample_token(logits_row, step_rng_key(rng, i),
+                             temperature, top_k, top_p)
 
-    def step(carry, key):
+    def step(carry, i):
         cache, last_logits, slot, pos_ids, done = carry
-        tok = sample(last_logits, key)
+        tok = sample(last_logits, i)
         if eos_id is not None:
             tok = jnp.where(done, eos_id, tok)
             done = done | (tok == eos_id)
@@ -273,10 +310,10 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig, *,
             positions=pos_ids[:, None], slot_live=slot_live)
         return (cache, logits[:, 0], slot + 1, pos_ids + 1, done), tok
 
-    keys = jax.random.split(rng, max_new_tokens)
     done0 = jnp.zeros((B,), bool)
     (_, _, _, _, _), toks = jax.lax.scan(
-        step, (cache, last, P, n_real, done0), keys)
+        step, (cache, last, P, n_real, done0),
+        jnp.arange(max_new_tokens))
     return jnp.concatenate([prompt, toks.T], axis=1)
 
 
@@ -355,16 +392,14 @@ def _stream_inner(params, prompt, cfg, max_new_tokens, eos_id,
                                  slot_live=slot_live)
     last = logits[:, -1]
     done = np.zeros((B,), bool)
-    keys = None
     if not greedy:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        keys = jax.random.split(rng, max_new_tokens)
     for step in range(max_new_tokens):
         if greedy:
             tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
         else:
-            tok = _sample_token(last, keys[step], temperature,
-                                top_k, top_p)
+            tok = _sample_token(last, step_rng_key(rng, step),
+                                temperature, top_k, top_p)
         if eos_id is not None:
             tok = jnp.where(jnp.asarray(done), eos_id, tok)
         tok_np = np.asarray(tok)

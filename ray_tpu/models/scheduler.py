@@ -19,9 +19,12 @@ server grows:
   per step bounds the inter-token latency in-flight requests can lose
   to newcomers.
 
-Scheduling only changes WHICH request is admitted when a slot frees,
-never what any admitted request computes — greedy outputs stay
-token-identical to solo `generate` under every policy (tested).
+Scheduling only changes WHICH request is admitted when a slot frees —
+and, via `horizon_hint`, how many decode iterations the engine fuses
+into one program before it re-consults the queue (TTFT vs throughput)
+— never what any admitted request computes: outputs stay
+token-identical to solo `generate` under every policy and every
+horizon (tested).
 """
 
 from __future__ import annotations
@@ -58,6 +61,26 @@ class SchedulerPolicy:
     def snapshot(self) -> List[int]:
         """Queued request ids, in no particular order (introspection)."""
         raise NotImplementedError
+
+    def horizon_hint(self, *, free_slots: int,
+                     max_horizon: int) -> int:
+        """Suggested fused-decode horizon for the NEXT engine step
+        (how many decode iterations to fuse into one program before
+        the host looks at the queue again).
+
+        Default policy, shared by every built-in: while a queued
+        request could take a free slot next step (queue non-empty AND
+        free_slots > 0 — admission was capped by the prefill budget
+        this step), answer 1 so the newcomer's TTFT is not held behind
+        a long horizon; otherwise (slots saturated, or nothing queued)
+        answer `max_horizon` and amortize dispatch overhead. Policies
+        may override — e.g. a deadline-aware policy shortening the
+        horizon as the head-of-queue deadline approaches. The engine
+        additionally caps the hint at the largest remaining row budget
+        and rounds it down to a power of two (bounded compile count)."""
+        if len(self) and free_slots > 0:
+            return 1
+        return max_horizon
 
 
 class FIFOPolicy(SchedulerPolicy):

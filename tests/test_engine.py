@@ -148,8 +148,8 @@ def test_engine_sampling_and_guards(nano_model):
     with pytest.raises(KeyError):
         eng.pop_result(rid)
     rid2 = eng.submit([5, 6], 3)
-    eng.step()
-    with pytest.raises(KeyError):
+    eng.step(horizon=1)                  # pinned: adaptive H would
+    with pytest.raises(KeyError):        # finish all 3 tokens at once
         eng.pop_result(rid2)             # still decoding
     eng.run()
     assert rid2 not in eng.results

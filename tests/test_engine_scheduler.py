@@ -92,7 +92,7 @@ def test_priority_overtakes_queued_fifo_traffic(nano_model):
     urgent = eng.submit([1, 2], 3, priority=0)
     admitted = []
     while eng.pending():
-        eng.step()
+        eng.step(horizon=1)      # pinned: per-step occupant observation
         occupant = eng.row_req[0]
         if (occupant is not None and occupant.req_id != running
                 and occupant.req_id not in admitted):
@@ -140,21 +140,21 @@ def test_prefill_budget_guards_decode_rows(nano_model):
     eng = DecodeEngine(params, cfg, batch_slots=4, max_len=32,
                        max_prefills_per_step=1)
     first = eng.submit([5, 6, 7], 8)
-    eng.step()                                   # first occupies a slot
-    for p in ([9, 8], [1, 2], [3, 4], [7, 7]):
-        eng.submit(p, 8)
-    live = [sum(r is not None for r in eng.row_req)]
+    eng.step(horizon=1)        # first occupies a slot (pinned horizon:
+    for p in ([9, 8], [1, 2], [3, 4], [7, 7]):   # the test observes
+        eng.submit(p, 8)       # per-step admissions; adaptive H would
+    live = [sum(r is not None for r in eng.row_req)]   # finish rows)
     for _ in range(3):
-        eng.step()
+        eng.step(horizon=1)
         live.append(sum(r is not None for r in eng.row_req))
     assert live == [1, 2, 3, 4]                  # one admission per step
     # unbudgeted engine admits the whole burst in one step
     eng2 = DecodeEngine(params, cfg, batch_slots=4, max_len=32)
     eng2.submit([5, 6, 7], 8)
-    eng2.step()
+    eng2.step(horizon=1)
     for p in ([9, 8], [1, 2], [3, 4], [7, 7]):
         eng2.submit(p, 8)
-    eng2.step()
+    eng2.step(horizon=1)
     assert sum(r is not None for r in eng2.row_req) == 4
     out = eng.run()
     assert out[first] == _solo(params, cfg, [5, 6, 7], 8)

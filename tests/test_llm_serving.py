@@ -150,9 +150,12 @@ def test_serve_llm_continuous_batching():
             await self._barrier.wait()
             while self.engine.pending():
                 emitted = self.engine.step()
+                # overlap = requests that emitted in the SAME fused
+                # step (row_req is empty again once a fused horizon
+                # finishes a request mid-step)
                 self.max_live = max(
                     self.max_live,
-                    sum(r is not None for r in self.engine.row_req))
+                    sum(1 for toks in emitted.values() if toks))
                 _serve.metrics.report_engine_stats(self.engine.stats())
                 for rid, toks in emitted.items():
                     q = self._queues.get(rid)
