@@ -113,15 +113,88 @@ def _decode_dispatch_section(quick: bool) -> list:
     return results
 
 
+def _prefix_admission_section(quick: bool) -> list:
+    """Admission cost with the shared-prefix KV cache
+    (models/engine.py + models/prefix_cache.py): per prefix length,
+    the wall ms and host syncs of admitting a request COLD (full
+    prompt prefill, pool copy-out of the novel blocks) vs WARM (pool
+    copy-in of the cached blocks + suffix-only prefill). The gap is
+    what prefix reuse buys every repeat of a system prompt. Runs
+    anywhere — the nano model makes the prefill cost small but the
+    cold/warm ORDERING and the sync counts are real on any backend."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.engine import DecodeEngine
+
+    lens = (128,) if quick else (128, 512, 2048)
+    suffix_len, new_tokens, T = 16, 4, 32
+    results = []
+    for P in lens:
+        cfg = LlamaConfig.nano(max_seq_len=P + suffix_len + new_tokens + 8)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(P)
+        prefix = rng.randint(1, cfg.vocab_size, size=P).tolist()
+
+        def make():
+            return DecodeEngine(params, cfg, batch_slots=2,
+                                max_len=cfg.max_seq_len,
+                                prefix_cache=True, prefix_block=T,
+                                enable_metrics=False)
+
+        def admit_once(eng):
+            """Submit one prefix+fresh-suffix request, time its
+            admission step, return (ms, host syncs)."""
+            p = prefix + rng.randint(1, cfg.vocab_size,
+                                     size=suffix_len).tolist()
+            rid = eng.submit(p, new_tokens)
+            syncs0 = eng.host_syncs
+            t0 = time.perf_counter()
+            eng.step(horizon=1)
+            ms = (time.perf_counter() - t0) * 1000
+            syncs = eng.host_syncs - syncs0
+            while eng.pending():          # drain so the slot frees
+                eng.step(horizon=1)
+            eng.pop_result(rid)
+            return ms, syncs
+
+        admit_once(make())                # warmup eng: compile cold path
+        warm_eng = make()
+        admit_once(warm_eng)              # seed + compile warm path
+        admit_once(warm_eng)
+
+        cold_ms, warm_ms = [], []
+        cold_syncs = warm_syncs = 0
+        for _ in range(TRIALS):
+            eng = make()                  # empty trie: first is cold
+            ms, cold_syncs = admit_once(eng)
+            cold_ms.append(ms)
+            ms, warm_syncs = admit_once(eng)   # trie now holds prefix
+            warm_ms.append(ms)
+        results.append((f"engine_prefix_admission_cold_ms_p{P}",
+                        statistics.median(cold_ms), "ms"))
+        results.append((f"engine_prefix_admission_warm_ms_p{P}",
+                        statistics.median(warm_ms), "ms"))
+        results.append((f"engine_prefix_admission_cold_syncs_p{P}",
+                        float(cold_syncs), "syncs"))
+        results.append((f"engine_prefix_admission_warm_syncs_p{P}",
+                        float(warm_syncs), "syncs"))
+    return results
+
+
 def main(quick: bool = False):
     import numpy as np
 
     import ray_tpu
 
     scale = 0.1 if quick else 1.0
-    # Print the serving-engine section immediately: its numbers must
+    # Print the serving-engine sections immediately: their numbers must
     # survive an environment-specific failure in a later section.
     for name, value, unit in _decode_dispatch_section(quick):
+        print(json.dumps({"metric": name, "value": round(value, 4),
+                          "unit": unit}), flush=True)
+    for name, value, unit in _prefix_admission_section(quick):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     results = []

@@ -48,9 +48,11 @@ from ray_tpu.models.t5 import (
 )
 from ray_tpu.models.engine import DecodeEngine
 from ray_tpu.models.engine_metrics import EngineMetrics
+from ray_tpu.models.prefix_cache import PrefixCacheIndex
 from ray_tpu.models.scheduler import (
     EngineOverloaded,
     FIFOPolicy,
+    PrefixAffinityPolicy,
     PriorityPolicy,
     SchedulerPolicy,
 )
@@ -92,6 +94,8 @@ __all__ = [
     "EngineMetrics",
     "EngineOverloaded",
     "FIFOPolicy",
+    "PrefixAffinityPolicy",
+    "PrefixCacheIndex",
     "PriorityPolicy",
     "SchedulerPolicy",
 ]

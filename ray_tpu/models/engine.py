@@ -55,13 +55,19 @@ import numpy as np
 
 from ray_tpu.models.engine_metrics import EngineMetrics, NullEngineMetrics
 from ray_tpu.models.generate import (_check_sampling_knobs,
-                                     _layer_body, forward_cached,
+                                     _layer_body, forward_cached_rows,
                                      init_cache, sample_rows)
 from ray_tpu.models.llama import LlamaConfig, _rmsnorm
+from ray_tpu.models.prefix_cache import PrefixCacheIndex, block_bytes
 from ray_tpu.models.scheduler import (EngineOverloaded, SchedulerPolicy,
                                       make_policy)
 
 Params = Dict[str, Any]
+
+
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    return 1 << (n - 1).bit_length()
 
 
 def _key_data(key) -> np.ndarray:
@@ -90,26 +96,31 @@ def _device_get(x) -> np.ndarray:
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnames=("cache", "last_logits"))
 def _prefill_rows(params: Params, prompts: jax.Array, cache,
-                  last_logits, rows: jax.Array, last_idx: jax.Array,
-                  cfg: LlamaConfig):
-    """Batched admission: write N same-bucket prompts' [N, Pb] K/V into
-    N freed slots in ONE program and scatter each row's last-real-token
-    logits into the engine's device-resident `last_logits` [B, vocab].
-    Returns (cache, last_logits) — no logits ever cross to the host;
-    the fused decode program samples the first token on device, so an
-    admission costs zero host round-trips.
+                  last_logits, rows: jax.Array, starts: jax.Array,
+                  last_idx: jax.Array, cfg: LlamaConfig):
+    """Batched admission/continuation prefill: write N same-bucket
+    chunks' [N, Cb] K/V into N slots in ONE program — each row at its
+    OWN cache offset ``starts[n]`` (0 for a cold admission; the cached
+    prefix length for a warm one; the chunk frontier for a chunked
+    continuation) — and scatter each row's last-real-token logits into
+    the engine's device-resident `last_logits` [B, vocab]. Returns
+    (cache, last_logits) — no logits ever cross to the host; the fused
+    decode program samples the first token on device, so an admission
+    costs zero host round-trips.
 
-    Pb may exceed a prompt's true length (length-bucketed serving):
-    trailing filler tokens' K/V land at slots >= the true length, which
-    every later mask excludes (`slot < row_len`), and causality keeps
-    real tokens from ever attending filler — only the logits at
-    `last_idx` (true length - 1) are read out. `rows` may contain
-    duplicates (power-of-two group padding repeats the last admission
-    verbatim): duplicate scatters write identical values, so the result
-    is deterministic."""
+    Cb may exceed a chunk's true length (length-bucketed serving):
+    trailing filler tokens' K/V land at slots >= the true frontier,
+    which every later mask excludes (`slot <= q_slot` caps decode
+    attention at the written frontier and the next chunk/decode write
+    overwrites them) — only the logits at `last_idx` (true chunk length
+    - 1) are read out, and only the FINAL chunk's scatter survives in
+    `last_logits` (earlier chunks' scatters are overwritten before the
+    row ever decodes). `rows` may contain duplicates (power-of-two
+    group padding repeats the last admission verbatim): duplicate
+    scatters write identical values, so the result is deterministic."""
     row_cache = {"k": cache["k"][:, rows], "v": cache["v"][:, rows]}
-    logits, row_cache = forward_cached(params, prompts, row_cache, 0,
-                                       cfg)
+    logits, row_cache = forward_cached_rows(params, prompts, row_cache,
+                                            starts, cfg)
     cache = {
         "k": cache["k"].at[:, rows].set(row_cache["k"]),
         "v": cache["v"].at[:, rows].set(row_cache["v"]),
@@ -117,6 +128,66 @@ def _prefill_rows(params: Params, prompts: jax.Array, cache,
     n = prompts.shape[0]
     last = logits[jnp.arange(n), last_idx]              # [N, vocab]
     return cache, last_logits.at[rows].set(last)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_blocks", "block_tokens"),
+                   donate_argnames=("cache",))
+def _prefix_copy_in(cache, pool_k, pool_v, block_ids: jax.Array,
+                    rows: jax.Array, n_blocks: int, block_tokens: int):
+    """Copy cached prefix blocks into engine slot rows: ONE gather
+    program per step moves every warm admission's shared K/V from the
+    device-resident pool into its slot — zero host round-trips, the
+    same choke-point discipline as `_prefill_rows`.
+
+    pool_k/v: [L, NB, T, KV, D]; block_ids [N, n_blocks]; rows [N].
+    Row n's blocks land contiguously at slots [0, n_blocks*T). Both N
+    and n_blocks are power-of-two padded by the caller (repeat the last
+    row / the last block id), so a handful of compiles cover all chain
+    lengths: duplicate row scatters write identical values, and padded
+    trailing blocks write garbage BEYOND the row's matched prefix —
+    slots the suffix prefill and decode overwrite before any mask ever
+    admits them."""
+    span = n_blocks * block_tokens
+    blk_k = pool_k[:, block_ids]          # [L, N, nb, T, KV, D]
+    blk_v = pool_v[:, block_ids]
+    L, N = blk_k.shape[:2]
+    k = blk_k.reshape(L, N, span, *blk_k.shape[4:])
+    v = blk_v.reshape(L, N, span, *blk_v.shape[4:])
+    return {
+        "k": cache["k"].at[:, rows, :span].set(k.astype(cache["k"].dtype)),
+        "v": cache["v"].at[:, rows, :span].set(v.astype(cache["v"].dtype)),
+    }
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_blocks", "block_tokens"),
+                   donate_argnames=("pool_k", "pool_v"))
+def _prefix_copy_out(cache_k, cache_v, pool_k, pool_v, row,
+                     start_slot, block_ids: jax.Array, n_blocks: int,
+                     block_tokens: int):
+    """Insert a freshly prefilled prefix into the pool: slice
+    [start_slot, start_slot + n_blocks*T) out of one slot row and
+    scatter it into the pool at ``block_ids`` — one program per novel
+    prefix segment, dispatched right after the chunk that produced it
+    (dispatch order guarantees any copy-in already in flight still
+    reads the blocks' OLD content). n_blocks is power-of-two padded
+    with the reserved scratch block id 0: padding writes (clamped
+    slices of whatever follows the segment) land in the scratch block,
+    which the index never hands out."""
+    span = n_blocks * block_tokens
+    max_len = cache_k.shape[2]
+    slots = jnp.minimum(start_slot + jnp.arange(span), max_len - 1)
+    row_k = jnp.take(cache_k, row, axis=1)      # [L, max_len, KV, D]
+    row_v = jnp.take(cache_v, row, axis=1)
+    seg_k = jnp.take(row_k, slots, axis=1)      # [L, span, KV, D]
+    seg_v = jnp.take(row_v, slots, axis=1)
+    L = seg_k.shape[0]
+    seg_k = seg_k.reshape(L, n_blocks, block_tokens, *seg_k.shape[2:])
+    seg_v = seg_v.reshape(L, n_blocks, block_tokens, *seg_v.shape[2:])
+    pool_k = pool_k.at[:, block_ids].set(seg_k.astype(pool_k.dtype))
+    pool_v = pool_v.at[:, block_ids].set(seg_v.astype(pool_v.dtype))
+    return pool_k, pool_v
 
 
 def _decode_layer_rows(h, layer, k_cache, v_cache, write_slots,
@@ -257,6 +328,22 @@ class _Request:
         self.rng = rng              # [2] uint32 per-request key stream
 
 
+class _PrefillState:
+    """A slot row whose prompt suffix is still being written.
+
+    ``pos`` is the row's prefill frontier: slots [0, pos) hold valid
+    K/V (copied prefix + completed chunks). ``nodes`` are the PENDING
+    trie nodes this row's prefill will fill — each is copied out to the
+    pool and committed as soon as the frontier covers its block."""
+
+    __slots__ = ("req", "pos", "nodes")
+
+    def __init__(self, req: _Request, pos: int, nodes: list):
+        self.req = req
+        self.pos = pos
+        self.nodes = nodes
+
+
 class DecodeEngine:
     """Slot-based continuous batching over a shared KV cache.
 
@@ -313,6 +400,10 @@ class DecodeEngine:
                  on_full: str = "reject",
                  max_prefills_per_step: Optional[int] = None,
                  decode_horizon: int = 8,
+                 prefix_cache: bool = False,
+                 prefix_block: int = 32,
+                 prefix_cache_bytes: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
                  engine_id: Optional[str] = None,
                  enable_metrics: bool = True):
         _check_sampling_knobs(greedy, top_k, top_p)
@@ -325,6 +416,10 @@ class DecodeEngine:
             raise ValueError("max_prefills_per_step must be >= 1")
         if decode_horizon < 1:
             raise ValueError("decode_horizon must be >= 1")
+        if prefix_block < 1:
+            raise ValueError("prefix_block must be >= 1")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
         self.params = params
         self.cfg = cfg
         self.B = batch_slots
@@ -370,6 +465,51 @@ class DecodeEngine:
         self.prefill_dispatches = 0    # batched prefill launches
         self.host_syncs = 0            # device->host transfers
         self.tokens_out = 0            # tokens emitted, all requests
+        # Prefill/prefix-reuse accounting (same plain-int discipline):
+        self.prefill_real_tokens = 0   # true chunk tokens prefilled
+        self.prefill_padded_tokens = 0  # bucket + pow2-group filler
+        self.prefix_lookups = 0        # admissions probed in the trie
+        self.prefix_hits = 0           # ... that matched >= 1 block
+        self.prefix_reused_tokens = 0  # prompt tokens copied, not run
+        self.prefix_evictions = 0      # LRU blocks recycled
+        self.prefix_copy_dispatches = 0  # pool copy-in/out launches
+        self.chunked_prefill_stalls = 0  # steps with a row mid-prefill
+
+        # Chunked prefill: rows whose suffix is still being written,
+        # row -> _PrefillState. A row in here is EXCLUDED from decode
+        # (its last_logits are not final) and advances one chunk per
+        # step via _advance_prefills().
+        self.prefill_chunk = prefill_chunk
+        self._row_prefill: Dict[int, _PrefillState] = {}
+
+        # Shared-prefix KV cache: host-side radix index over committed
+        # prompt blocks + a device-resident pool the copy programs
+        # gather from / scatter into. Sized by prefix_cache_bytes
+        # (default: room for 2 full batches of max_len tokens), plus
+        # the reserved scratch block 0.
+        self.prefix_block = prefix_block
+        if prefix_cache:
+            L, _, _, KV, D = self.cache["k"].shape
+            kv_dtype = self.cache["k"].dtype
+            bb = block_bytes(L, prefix_block, KV, D,
+                             jnp.dtype(kv_dtype).itemsize)
+            if prefix_cache_bytes is None:
+                n_blocks = 1 + (2 * self.B * self.max_len) // prefix_block
+            else:
+                n_blocks = 1 + prefix_cache_bytes // bb
+            self._prefix: Optional[PrefixCacheIndex] = PrefixCacheIndex(
+                block_tokens=prefix_block, n_blocks=n_blocks,
+                on_evict=self._on_prefix_evict)
+            self._pool_k = jnp.zeros(
+                (L, n_blocks, prefix_block, KV, D), kv_dtype)
+            self._pool_v = jnp.zeros(
+                (L, n_blocks, prefix_block, KV, D), kv_dtype)
+            attach = getattr(self.scheduler, "attach_prefix_probe", None)
+            if attach is not None:
+                attach(self._prefix_probe)
+        else:
+            self._prefix = None
+            self._pool_k = self._pool_v = None
 
     # -- public API --------------------------------------------------------
 
@@ -438,17 +578,34 @@ class DecodeEngine:
         emitted: Dict[int, List[int]] = {}
         budget = self.max_prefills_per_step or self.B
         admissions: List[Tuple[int, _Request]] = []
+        begin = getattr(self.scheduler, "begin_admission_round", None)
+        if begin is not None:
+            begin()
         for row in range(self.B):
             if budget <= 0:
                 break
             if self.row_req[row] is None and len(self.scheduler):
-                admissions.append((row, self.scheduler.pop()))
+                req = self.scheduler.pop()
+                if req is None:
+                    break      # prefix policy deferred the whole queue
+                admissions.append((row, req))
                 budget -= 1
         if admissions:
             self._admit_rows(admissions)
+        self._advance_prefills()
 
         live = [b for b in range(self.B) if self.row_req[b] is not None]
         if not live:
+            return emitted
+        # Rows mid-chunked-prefill are NOT decodable: their last_logits
+        # still hold an intermediate chunk's scatter. They ride along
+        # frozen (active=False) and take their next chunk next step.
+        decodable = [b for b in live if b not in self._row_prefill]
+        if len(decodable) < len(live):
+            self.chunked_prefill_stalls += 1
+            self.metrics.on_prefill_stall()
+        if not decodable:
+            self.metrics.on_step(len(live), len(self.scheduler), 0)
             return emitted
 
         H = horizon
@@ -456,14 +613,20 @@ class DecodeEngine:
             free = self.B - len(live)
             H = self.scheduler.horizon_hint(
                 free_slots=free, max_horizon=self.decode_horizon)
+            if len(decodable) < len(live):
+                H = 1          # keep the chunk cadence: a mid-prefill
+                #                row must not wait a long horizon for
+                #                its next chunk (bounded TTFT)
             # Cap at the largest remaining row budget (no trailing
             # iterations with every row frozen), rounded DOWN to a
             # power of two: the fused program recompiles per distinct
             # H, so adaptive serving touches at most log2(horizon)+1
             # programs instead of one per budget remainder.
-            H = min(H, int(self.row_budget[live].max()))
+            H = min(H, int(self.row_budget[decodable].max()))
             H = 1 << max(0, H.bit_length() - 1)
-        active = np.array([r is not None for r in self.row_req])
+        active = np.array([self.row_req[b] is not None
+                           and b not in self._row_prefill
+                           for b in range(self.B)])
         toks, self.cache, self._last_logits = _decode_multi(
             self.params, self.cache, self._last_logits,
             jnp.asarray(self.row_len), jnp.asarray(active),
@@ -474,7 +637,7 @@ class DecodeEngine:
         block = _device_get(toks)          # the step's ONE host sync
         self.host_syncs += 1
         for i in range(H):
-            for b in live:
+            for b in decodable:
                 if self.row_req[b] is None:
                     continue               # retired earlier in block
                 self._emit(b, int(block[i, b]), emitted)
@@ -497,11 +660,41 @@ class DecodeEngine:
         out["slot_occupancy"] = out["live_slots"] / self.B
         # Engine-level dispatch accounting (kept even when metrics are
         # disabled — benchmarks read these to report syncs per token).
+        # Every derived ratio guards its denominator: a fresh engine
+        # (no token emitted, no prefill run) reports 0.0, never NaN.
+        def _ratio(num: float, den: float) -> float:
+            return num / den if den else 0.0
+
         out["decode_dispatches"] = float(self.decode_dispatches)
         out["prefill_dispatches"] = float(self.prefill_dispatches)
         out["host_syncs"] = float(self.host_syncs)
-        out["host_syncs_per_token"] = (
-            self.host_syncs / self.tokens_out if self.tokens_out else 0.0)
+        out["host_syncs_per_token"] = _ratio(self.host_syncs,
+                                             self.tokens_out)
+        out["dispatches_per_token"] = _ratio(self.decode_dispatches,
+                                             self.tokens_out)
+        # Prefill efficiency: real suffix tokens vs bucket/pow2 filler.
+        out["prefill_real_tokens"] = float(self.prefill_real_tokens)
+        out["prefill_padded_tokens"] = float(self.prefill_padded_tokens)
+        out["prefill_padding_waste_frac"] = _ratio(
+            self.prefill_padded_tokens,
+            self.prefill_real_tokens + self.prefill_padded_tokens)
+        # Prefix-reuse plane: reused = prompt tokens COPIED from the
+        # pool; recomputed (= prefill_real_tokens) = prompt tokens the
+        # prefill actually ran.
+        out["prefix_lookups"] = float(self.prefix_lookups)
+        out["prefix_hits"] = float(self.prefix_hits)
+        out["prefix_hit_rate"] = _ratio(self.prefix_hits,
+                                        self.prefix_lookups)
+        out["prefix_reused_tokens"] = float(self.prefix_reused_tokens)
+        out["prefix_reused_frac"] = _ratio(
+            self.prefix_reused_tokens,
+            self.prefix_reused_tokens + self.prefill_real_tokens)
+        out["prefix_evictions"] = float(self.prefix_evictions)
+        out["prefix_copy_dispatches"] = float(self.prefix_copy_dispatches)
+        out["chunked_prefill_stalls"] = float(self.chunked_prefill_stalls)
+        if self._prefix is not None:
+            out["prefix_blocks_in_use"] = float(self._prefix.blocks_in_use)
+            out["prefix_blocks_total"] = float(self._prefix.blocks_total)
         return out
 
     def run(self) -> Dict[int, List[int]]:
@@ -540,44 +733,163 @@ class DecodeEngine:
         return np.array([int(self._base_key[0]) ^ mix0,
                          int(self._base_key[1]) ^ mix1], np.uint32)
 
+    def _on_prefix_evict(self, n: int) -> None:
+        self.prefix_evictions += n
+        self.metrics.on_prefix_evictions(n)
+
+    def _prefix_probe(self, prompt) -> Tuple[int, Optional[tuple],
+                                             bool]:
+        """(matched_tokens, prefix_group_key, next_block_pending) for
+        the prefix-affinity scheduler — a pure host trie walk, zero
+        device dispatches. The group key (the prompt's first block) is
+        None for prompts too short to ever share a block."""
+        ids, pending = self._prefix.match(prompt)
+        T = self.prefix_block
+        key = tuple(prompt[:T]) if len(prompt) > T else None
+        return len(ids) * T, key, pending
+
     def _admit_rows(self, admissions: List[Tuple[int, _Request]]) -> None:
-        """Prefill this step's admissions, grouped so every same-bucket
-        group runs as ONE batched `_prefill_rows` program (group size
-        padded to a power of two by repeating the last admission, so a
-        handful of compiles cover all traffic). First tokens are NOT
-        sampled here: each row's last-prompt logits stay on device in
-        `_last_logits` and the fused decode samples them — admission
+        """Bind this step's admissions to their rows and start their
+        prefills. With the prefix cache on, each admission first probes
+        the trie: a warm prompt's matched blocks are COPIED from the
+        device pool into the row (grouped so same-chain-length copies
+        share ONE `_prefix_copy_in` program) and only the suffix is
+        prefilled; novel full blocks are registered PENDING and copied
+        out to the pool as the row's prefill covers them. The actual
+        prefill work — whole suffix, or `prefill_chunk`-sized pieces
+        across steps — runs in `_advance_prefills`. First tokens are
+        NOT sampled here: each row's last-prompt logits stay on device
+        in `_last_logits` and the fused decode samples them — admission
         costs zero host round-trips."""
-        groups: Dict[int, List[Tuple[int, _Request]]] = {}
+        copy_groups: Dict[int, List[Tuple[int, List[int]]]] = {}
         for row, req in admissions:
             self.metrics.on_admit(req.req_id)   # queue wait ends here
-            groups.setdefault(self._bucket(len(req.prompt)),
-                              []).append((row, req))
-        for Pb in sorted(groups):
-            grp = groups[Pb]
+            start = 0
+            nodes: list = []
+            if self._prefix is not None:
+                ids, _ = self._prefix.match(req.prompt)
+                self.prefix_lookups += 1
+                T = self.prefix_block
+                if ids:
+                    self.prefix_hits += 1
+                    start = len(ids) * T
+                    self.prefix_reused_tokens += start
+                    # Pad the chain to a power of two (repeat the last
+                    # block: its rewrite is overwritten by the suffix
+                    # prefill / never attended) so a handful of copy-in
+                    # compiles cover every chain length.
+                    nbp = _pow2(len(ids))
+                    if nbp * T > self.max_len:
+                        nbp = len(ids)
+                    ids_p = list(ids) + [ids[-1]] * (nbp - len(ids))
+                    copy_groups.setdefault(nbp, []).append((row, ids_p))
+                nodes = self._prefix.extend(req.prompt)
+                self.metrics.on_prefix(hit=bool(ids), reused_tokens=start)
+            self.row_req[row] = req
+            self.row_len[row] = start          # frontier: copied prefix
+            self.row_budget[row] = req.max_new_tokens
+            self._tok_idx[row] = 0
+            self._row_keys[row] = self._req_key(req)
+            self._row_prefill[row] = _PrefillState(req, start, nodes)
+        for nbp in sorted(copy_groups):
+            grp = copy_groups[nbp]
             n = len(grp)
-            n_pad = 1 << (n - 1).bit_length()
-            prompts = np.zeros((n_pad, Pb), np.int32)
+            n_pad = _pow2(n)
             rows = np.zeros((n_pad,), np.int32)
-            last_idx = np.zeros((n_pad,), np.int32)
-            for i, (row, req) in enumerate(grp):
-                P = len(req.prompt)
-                prompts[i, :P] = req.prompt
+            bids = np.zeros((n_pad, nbp), np.int32)
+            for i, (row, ids_p) in enumerate(grp):
                 rows[i] = row
-                last_idx[i] = P - 1
-                self.row_req[row] = req
-                self.row_len[row] = P
-                self.row_budget[row] = req.max_new_tokens
-                self._tok_idx[row] = 0
-                self._row_keys[row] = self._req_key(req)
+                bids[i] = ids_p
+            rows[n:] = rows[n - 1]     # duplicate scatters: identical
+            bids[n:] = bids[n - 1]     # values, deterministic result
+            self.cache = _prefix_copy_in(
+                self.cache, self._pool_k, self._pool_v,
+                jnp.asarray(bids), jnp.asarray(rows), nbp,
+                self.prefix_block)
+            self.prefix_copy_dispatches += 1
+
+    def _advance_prefills(self) -> None:
+        """Advance every mid-prefill row by one chunk (the whole
+        remaining suffix when `prefill_chunk` is None), same-bucket
+        chunks batched into ONE `_prefill_rows` program. A row whose
+        frontier reaches its prompt length leaves `_row_prefill` and is
+        decodable THIS step (its last chunk scattered the true
+        last-prompt logits). Completed prefix blocks are flushed to the
+        pool and committed as the frontier passes them."""
+        if not self._row_prefill:
+            return
+        groups: Dict[int, List[Tuple[int, _PrefillState, int]]] = {}
+        for row, st in self._row_prefill.items():
+            C = len(st.req.prompt) - st.pos
+            if self.prefill_chunk is not None:
+                C = min(C, self.prefill_chunk)
+            # Bucket the chunk, capped so the scatter never runs past
+            # max_len (starts differ per row; the cap is per-row).
+            Cb = min(self._bucket(C), self.max_len - st.pos)
+            groups.setdefault(Cb, []).append((row, st, C))
+        for Cb in sorted(groups):
+            grp = groups[Cb]
+            n = len(grp)
+            n_pad = _pow2(n)
+            prompts = np.zeros((n_pad, Cb), np.int32)
+            rows = np.zeros((n_pad,), np.int32)
+            starts = np.zeros((n_pad,), np.int32)
+            last_idx = np.zeros((n_pad,), np.int32)
+            real = 0
+            for i, (row, st, C) in enumerate(grp):
+                prompts[i, :C] = st.req.prompt[st.pos:st.pos + C]
+                rows[i] = row
+                starts[i] = st.pos
+                last_idx[i] = C - 1
+                real += C
             prompts[n:] = prompts[n - 1]    # filler: repeat last row —
             rows[n:] = rows[n - 1]          # duplicate scatters write
-            last_idx[n:] = last_idx[n - 1]  # identical values
+            starts[n:] = starts[n - 1]      # identical values
+            last_idx[n:] = last_idx[n - 1]
             self.cache, self._last_logits = _prefill_rows(
                 self.params, jnp.asarray(prompts), self.cache,
                 self._last_logits, jnp.asarray(rows),
-                jnp.asarray(last_idx), self.cfg)
+                jnp.asarray(starts), jnp.asarray(last_idx), self.cfg)
             self.prefill_dispatches += 1
+            padded = n_pad * Cb - real
+            self.prefill_real_tokens += real
+            self.prefill_padded_tokens += padded
+            self.metrics.on_prefill_batch(real, padded)
+        done_rows = []
+        for grp in groups.values():
+            for row, st, C in grp:
+                st.pos += C
+                self.row_len[row] = st.pos
+                if self._prefix is not None:
+                    self._flush_copy_out(row, st)
+                if st.pos >= len(st.req.prompt):
+                    done_rows.append(row)
+        for row in done_rows:
+            del self._row_prefill[row]
+
+    def _flush_copy_out(self, row: int, st: _PrefillState) -> None:
+        """Copy every pending prefix block the row's frontier now
+        covers out to the pool (one program per consecutive run,
+        chain length padded to a power of two with the scratch block)
+        and COMMIT it — from the next admission round on, `match` will
+        hand the block to warm requests."""
+        T = self.prefix_block
+        while st.nodes and (st.nodes[0][0] + 1) * T <= st.pos:
+            run = [st.nodes.pop(0)]
+            while st.nodes and st.nodes[0][0] == run[-1][0] + 1 and \
+                    (st.nodes[0][0] + 1) * T <= st.pos:
+                run.append(st.nodes.pop(0))
+            nbp = _pow2(len(run))
+            bids = np.zeros((nbp,), np.int32)   # pad = scratch block 0
+            for i, (_, node) in enumerate(run):
+                bids[i] = node.block_id
+            self._pool_k, self._pool_v = _prefix_copy_out(
+                self.cache["k"], self.cache["v"], self._pool_k,
+                self._pool_v, row,
+                run[0][0] * T, jnp.asarray(bids), nbp, T)
+            self.prefix_copy_dispatches += 1
+            for _, node in run:
+                self._prefix.commit(node)
 
     def _emit(self, row: int, tok: int,
               emitted: Dict[int, List[int]]) -> None:

@@ -170,6 +170,37 @@ class EngineMetrics:
             "Decode iterations fused per dispatch (adaptive horizon)",
             boundaries=HORIZON_BOUNDARIES,
             tag_keys=keys).set_default_tags(tag)
+        # Prefix-reuse / prefill-efficiency plane (PR: shared-prefix KV
+        # cache + chunked prefill):
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_reused_tokens = 0
+        self.prefix_evictions = 0
+        self.prefill_real_tokens = 0
+        self.prefill_padded_tokens = 0
+        self.prefill_stalls = 0
+        self._m_prefix_lookups = counter(
+            "llm_engine_prefix_lookups_total",
+            "Admissions probed against the prefix-cache trie")
+        self._m_prefix_hits = counter(
+            "llm_engine_prefix_hits_total",
+            "Admissions that matched >= 1 cached prefix block")
+        self._m_prefix_reused = counter(
+            "llm_engine_prefix_reused_tokens_total",
+            "Prompt tokens copied from the prefix pool, not prefilled")
+        self._m_prefix_evictions = counter(
+            "llm_engine_prefix_evictions_total",
+            "Cold prefix blocks recycled by LRU eviction")
+        self._m_prefill_real = counter(
+            "llm_engine_prefill_tokens_total",
+            "True prompt/suffix tokens run through batched prefill")
+        self._m_prefill_padded = counter(
+            "llm_engine_prefill_padded_tokens_total",
+            "Length-bucket + pow2-group filler tokens run through "
+            "batched prefill (padding waste)")
+        self._m_prefill_stalls = counter(
+            "llm_engine_chunked_prefill_stalls_total",
+            "Engine steps with >= 1 row frozen mid-chunked-prefill")
 
     # -- lifecycle hooks (called by DecodeEngine) --------------------------
 
@@ -238,6 +269,41 @@ class EngineMetrics:
         self._m_host_syncs.inc(host_syncs)
         self._m_horizon.observe(horizon)
 
+    def on_prefix(self, *, hit: bool, reused_tokens: int = 0) -> None:
+        """One admission probed the prefix-cache trie; on a hit,
+        `reused_tokens` prompt tokens were copied instead of run."""
+        self.prefix_lookups += 1
+        self._m_prefix_lookups.inc()
+        if hit:
+            self.prefix_hits += 1
+            self._m_prefix_hits.inc()
+        if reused_tokens > 0:
+            self.prefix_reused_tokens += reused_tokens
+            self._m_prefix_reused.inc(reused_tokens)
+
+    def on_prefix_evictions(self, n: int = 1) -> None:
+        if n > 0:
+            self.prefix_evictions += n
+            self._m_prefix_evictions.inc(n)
+
+    def on_prefill_batch(self, real_tokens: int,
+                         padded_tokens: int) -> None:
+        """One batched prefill program: `real_tokens` true chunk tokens
+        plus `padded_tokens` bucket/pow2 filler riding along."""
+        self.prefill_real_tokens += real_tokens
+        self.prefill_padded_tokens += padded_tokens
+        if real_tokens > 0:
+            self._m_prefill_real.inc(real_tokens)
+        if padded_tokens > 0:
+            self._m_prefill_padded.inc(padded_tokens)
+
+    def on_prefill_stall(self, n: int = 1) -> None:
+        """One engine step ran with >= 1 row frozen mid-chunked-prefill
+        (decode advanced without it, or was skipped entirely)."""
+        if n > 0:
+            self.prefill_stalls += n
+            self._m_prefill_stalls.inc(n)
+
     def observe_queue_depth(self, depth: int) -> None:
         """Gauge update outside a step (e.g. right after submit)."""
         self.queue_depth = depth
@@ -269,6 +335,20 @@ class EngineMetrics:
         out["dispatches_per_token"] = (
             self.decode_dispatches / self.tokens_generated
             if self.tokens_generated else 0.0)
+        out["prefix_lookups"] = self.prefix_lookups
+        out["prefix_hits"] = self.prefix_hits
+        out["prefix_hit_rate"] = (
+            self.prefix_hits / self.prefix_lookups
+            if self.prefix_lookups else 0.0)
+        out["prefix_reused_tokens"] = self.prefix_reused_tokens
+        out["prefix_evictions"] = self.prefix_evictions
+        out["prefill_real_tokens"] = self.prefill_real_tokens
+        out["prefill_padded_tokens"] = self.prefill_padded_tokens
+        prefill_total = self.prefill_real_tokens + self.prefill_padded_tokens
+        out["prefill_padding_waste_frac"] = (
+            self.prefill_padded_tokens / prefill_total
+            if prefill_total else 0.0)
+        out["chunked_prefill_stalls"] = self.prefill_stalls
         self.queue_wait_s.fields("queue_wait_s", out)
         self.ttft_s.fields("ttft_s", out)
         self.tpot_s.fields("tpot_s", out)
@@ -295,6 +375,14 @@ class NullEngineMetrics:
     def on_step(self, live_slots, queue_depth, tokens_emitted): pass
 
     def on_dispatch(self, horizon, host_syncs=1): pass
+
+    def on_prefix(self, *, hit, reused_tokens=0): pass
+
+    def on_prefix_evictions(self, n=1): pass
+
+    def on_prefill_batch(self, real_tokens, padded_tokens): pass
+
+    def on_prefill_stall(self, n=1): pass
 
     def observe_queue_depth(self, depth): pass
 

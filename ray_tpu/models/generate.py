@@ -149,6 +149,53 @@ def forward_cached(params: Params, tokens: jax.Array, cache: Cache,
     return logits, {"k": k_new, "v": v_new}
 
 
+def forward_cached_rows(params: Params, tokens: jax.Array, cache: Cache,
+                        starts: jax.Array, cfg: LlamaConfig
+                        ) -> Tuple[jax.Array, Cache]:
+    """Run a token chunk [B, S] with a PER-ROW cache offset: row b's
+    tokens land at cache slots ``starts[b] + i`` (scatter writes) and
+    attend that row's whole prefix ``[0, starts[b] + i]``. Returns
+    (logits [B, S, vocab] f32, updated cache).
+
+    This is the suffix-offset prefill entry for prefix-reuse serving:
+    `forward_cached` prefills a chunk at ONE shared offset (solo
+    generate, where every row starts at 0), while the engine admits
+    rows whose cached-prefix lengths differ — each suffix must continue
+    from its own row's frontier in the same batched program. Rows'
+    slots below ``starts[b]`` must already hold valid K/V (a copied
+    prefix and/or earlier chunks); slots at or beyond the chunk are
+    excluded by the causal ``slot <= q_slot`` mask, so stale K/V from a
+    slot's previous occupant is never attended. RoPE positions equal
+    cache slots (no left-padding in slot-based serving)."""
+    B, S = tokens.shape
+    h = params["tok_embed"].astype(cfg.dtype)[tokens]
+    slot_ids = starts[:, None] + jnp.arange(S)[None, :]      # [B, S]
+    bidx = jnp.arange(B)
+
+    def body(carry, xs):
+        h = carry
+        layer, k_c, v_c = xs
+
+        def write_kv(k_cache, v_cache, k, v):
+            k_cache = k_cache.at[bidx[:, None], slot_ids].set(
+                k.astype(k_cache.dtype))
+            v_cache = v_cache.at[bidx[:, None], slot_ids].set(
+                v.astype(v_cache.dtype))
+            return k_cache, v_cache
+
+        h, k_c, v_c = _layer_body(h, layer, k_c, v_c, slot_ids,
+                                  write_kv, slot_ids, k_c.shape[1], cfg)
+        return h, (k_c, v_c)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        body, h, (params["layers"], cache["k"], cache["v"]))
+    h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", h,
+                        params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
+
+
 def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
                   top_p: Optional[float] = None) -> jax.Array:
     """Mask logits outside the top-k / nucleus (top-p) candidate set to

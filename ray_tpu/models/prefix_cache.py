@@ -1,0 +1,191 @@
+"""Host-side index for the DecodeEngine's shared-prefix KV cache.
+
+Serving traffic is dominated by shared prompt prefixes (system prompts,
+few-shot preambles, multi-turn history): vLLM's PagedAttention and
+SGLang's RadixAttention showed that REUSING the K/V of an
+already-computed prefix, instead of re-running prefill over it, is the
+single largest remaining throughput lever once decode itself is fused.
+
+This module is the pure-host half of that design: a radix/trie index at
+BLOCK granularity (``block_tokens`` tokens per node — only full blocks
+are shareable, the vLLM rule) mapping token-sequence prefixes to slots
+in a device-resident pool of cached K/V blocks. The device half — the
+pool arrays themselves and the one-program gather/scatter copies in and
+out of engine slot rows — lives in ``models/engine.py``
+(``_prefix_copy_in`` / ``_prefix_copy_out``); this index never touches
+a device buffer, so matching and eviction cost zero dispatches.
+
+Concurrency/ordering contract with the engine (single-threaded, but
+dispatch-ordered): a node is created PENDING when the engine plans to
+fill its block (the owning row's prefill must first produce the K/V)
+and COMMITTED once the copy-out program has been dispatched. `match`
+only walks committed nodes; eviction only takes committed leaves.
+Because XLA executes same-device programs in dispatch order, a block
+evicted and reassigned on the host is still read with its OLD content
+by any copy-in dispatched before the new owner's copy-out.
+
+Eviction is LRU over committed leaf nodes under a byte budget (the pool
+is preallocated at ``n_blocks`` = budget // block_bytes): evicting a
+leaf frees exactly one block; interior nodes become leaves as their
+children go, so cold chains drain tail-first while hot shared prefixes
+(recent ``last_use``) survive.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+def block_bytes(n_layers: int, block_tokens: int, kv_heads: int,
+                head_dim: int, dtype_bytes: int) -> int:
+    """Device bytes one cached block occupies (K and V)."""
+    return 2 * n_layers * block_tokens * kv_heads * head_dim * dtype_bytes
+
+
+class _Node:
+    __slots__ = ("key", "block_id", "parent", "children", "committed",
+                 "last_use")
+
+    def __init__(self, key: Optional[Tuple[int, ...]], block_id: int,
+                 parent: Optional["_Node"]):
+        self.key = key
+        self.block_id = block_id
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.committed = False
+        self.last_use = 0
+
+
+class PrefixCacheIndex:
+    """Radix index over cached prompt prefixes at block granularity.
+
+    ``match(prompt)`` returns the pool block ids of the longest
+    COMMITTED chain of full blocks prefixing ``prompt`` — capped so the
+    matched length never covers the whole prompt (the engine must
+    always prefill at least the final token to have last-token logits
+    to sample from, the same rule vLLM applies).
+
+    ``extend(prompt)`` walks the chain for every full block of
+    ``prompt`` and creates missing nodes as PENDING, allocating pool
+    blocks from the free list (evicting LRU committed leaves when it
+    runs dry). The caller fills each pending node's block from the
+    owning row's prefilled K/V and then calls ``commit(node)``.
+
+    Block id 0 is RESERVED as scratch: copy programs pad their block-id
+    vectors to a power of two with it so a handful of XLA compiles
+    cover every chain length; garbage scattered there is never indexed.
+    """
+
+    def __init__(self, *, block_tokens: int, n_blocks: int,
+                 on_evict: Optional[Callable[[int], None]] = None):
+        if block_tokens < 1:
+            raise ValueError("block_tokens must be >= 1")
+        if n_blocks < 2:
+            raise ValueError(
+                "n_blocks must be >= 2 (block 0 is the scratch block); "
+                "raise prefix_cache_bytes or shrink prefix_block")
+        self.block_tokens = block_tokens
+        self.n_blocks = n_blocks
+        self._free: List[int] = list(range(n_blocks - 1, 0, -1))
+        self._root = _Node(None, -1, None)
+        self._nodes: List[_Node] = []
+        self._clock = 0
+        self.evictions = 0
+        self._on_evict = on_evict
+
+    # -- introspection -----------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return len(self._nodes)
+
+    @property
+    def blocks_total(self) -> int:
+        return self.n_blocks - 1          # scratch block excluded
+
+    # -- core ops ----------------------------------------------------------
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _chunk(self, prompt, j: int) -> Tuple[int, ...]:
+        T = self.block_tokens
+        return tuple(prompt[j * T:(j + 1) * T])
+
+    def match(self, prompt) -> Tuple[List[int], bool]:
+        """Longest committed full-block chain prefixing ``prompt``.
+
+        Returns (block_ids, next_is_pending): the matched chain walks at
+        most ``(len(prompt) - 1) // block_tokens`` blocks (at least one
+        suffix token is always left for the engine to prefill), and
+        ``next_is_pending`` reports whether the walk stopped at a node
+        another row is still filling — the prefix-affinity scheduler
+        defers such requests one step so they admit warm."""
+        node = self._root
+        ids: List[int] = []
+        max_blocks = (len(prompt) - 1) // self.block_tokens
+        while len(ids) < max_blocks:
+            child = node.children.get(self._chunk(prompt, len(ids)))
+            if child is None:
+                return ids, False
+            if not child.committed:
+                return ids, True
+            child.last_use = self._tick()
+            ids.append(child.block_id)
+            node = child
+        return ids, False
+
+    def extend(self, prompt) -> List[Tuple[int, "_Node"]]:
+        """Ensure a (possibly pending) node chain exists for every full
+        block of ``prompt``; returns ``[(block_index, node), ...]`` for
+        the nodes CREATED by this call — always a consecutive tail of
+        the chain — which the caller must fill and ``commit``. Stops
+        early (shorter list) if the pool runs dry even after LRU
+        eviction; the uncached tail simply isn't shared."""
+        node = self._root
+        created: List[Tuple[int, _Node]] = []
+        protect = {id(self._root)}
+        for j in range(len(prompt) // self.block_tokens):
+            key = self._chunk(prompt, j)
+            child = node.children.get(key)
+            if child is None:
+                bid = self._alloc(protect)
+                if bid is None:
+                    break
+                child = _Node(key, bid, node)
+                node.children[key] = child
+                self._nodes.append(child)
+                created.append((j, child))
+            child.last_use = self._tick()
+            protect.add(id(child))
+            node = child
+        return created
+
+    def commit(self, node: "_Node") -> None:
+        """Mark a pending node's block as filled (copy-out dispatched)."""
+        node.committed = True
+        node.last_use = self._tick()
+
+    # -- allocation / eviction ---------------------------------------------
+
+    def _alloc(self, protect) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        victim = None
+        for n in self._nodes:
+            if n.children or not n.committed or id(n) in protect:
+                continue
+            if victim is None or n.last_use < victim.last_use:
+                victim = n
+        if victim is None:
+            return None
+        victim.parent.children.pop(victim.key, None)
+        self._nodes.remove(victim)
+        self.evictions += 1
+        if self._on_evict is not None:
+            self._on_evict(1)
+        return victim.block_id

@@ -130,12 +130,72 @@ class PriorityPolicy(SchedulerPolicy):
         return [r.req_id for _, _, r in self._heap]
 
 
-_POLICIES = {"fifo": FIFOPolicy, "priority": PriorityPolicy}
+class PrefixAffinityPolicy(FIFOPolicy):
+    """FIFO order, made prefix-cache aware: maximize KV reuse by never
+    admitting a request COLD when admitting it one step later would be
+    WARM.
+
+    The engine (when built with prefix_cache=True) attaches a probe via
+    `attach_prefix_probe`: ``probe(prompt) -> (matched_tokens,
+    prefix_group_key, next_block_pending)`` — a pure host walk of the
+    prefix trie. `pop` scans the queue in FIFO order and SKIPS, for
+    this admission round only, any request that is about to become
+    warmer than it is now:
+
+    - its next prefix block is PENDING — an in-flight row is already
+      prefilling exactly the blocks this request would recompute; once
+      that row's copy-out commits (at most a few steps), this request
+      admits warm and prefills only its own suffix;
+    - a same-prefix-group request (same first block) was already popped
+      COLD this round — the classic burst of N requests sharing one
+      system prompt: the first becomes the group's leader and computes
+      the shared blocks once; the other N-1 wait for it rather than
+      all recomputing the prefix in parallel rows.
+
+    `pop` returns None when every queued request is deferred (the
+    engine stops admitting for the step). Progress is guaranteed: the
+    leader IS admitted and its prefill always advances, so the blocks
+    followers wait on commit after finitely many steps — deferral
+    trades one short admission delay for an order-of-magnitude prefill
+    saving. Without a probe attached the policy degrades to plain
+    FIFO. Like every policy, this reorders ADMISSION only: admitted
+    requests compute exactly what they would under FIFO (token-identity
+    is tested)."""
+
+    name = "prefix"
+
+    def __init__(self):
+        super().__init__()
+        self._probe = None
+        self._round_cold: set = set()   # group keys popped cold this round
+
+    def attach_prefix_probe(self, probe) -> None:
+        self._probe = probe
+
+    def begin_admission_round(self) -> None:
+        self._round_cold = set()
+
+    def pop(self):
+        if self._probe is None:
+            return super().pop()
+        for i, req in enumerate(self._q):
+            matched, key, pending = self._probe(req.prompt)
+            if pending or (key is not None and key in self._round_cold):
+                continue                 # warmer next round — defer
+            if key is not None and matched == 0:
+                self._round_cold.add(key)   # cold leader for its group
+            del self._q[i]
+            return req
+        return None
+
+
+_POLICIES = {"fifo": FIFOPolicy, "priority": PriorityPolicy,
+             "prefix": PrefixAffinityPolicy}
 
 
 def make_policy(spec) -> SchedulerPolicy:
     """Resolve a policy spec: an instance passes through, a name
-    ("fifo" | "priority") constructs the built-in."""
+    ("fifo" | "priority" | "prefix") constructs the built-in."""
     if isinstance(spec, SchedulerPolicy):
         return spec
     try:

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import itertools
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -40,6 +41,7 @@ import numpy as np
 from ray_tpu.models.generate import (_prefill_jit, forward_cached,
                                      init_cache)
 from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.util.metrics import Counter, Gauge
 
 Params = Dict[str, Any]
 
@@ -54,6 +56,82 @@ class SpecStats:
     @property
     def acceptance_rate(self) -> float:
         return self.accepted / self.proposed if self.proposed else 0.0
+
+
+_spec_ids = itertools.count()
+
+
+class SpecMetrics:
+    """Publish SpecStats through the util.metrics Prometheus plane, the
+    way EngineMetrics publishes DecodeEngine telemetry: pass one
+    instance to `speculative_generate(..., metrics=...)` and every
+    call's rounds/proposed/accepted land as tagged counters (plus an
+    acceptance-rate gauge) next to the llm_engine_* series — so
+    draft-model tuning reads off the same dashboard as serving. All
+    instruments carry a ``spec`` tag (one draft/target pairing = one
+    tag value); `stats()` returns the flat numeric snapshot."""
+
+    def __init__(self, *, spec_id: Optional[str] = None):
+        self.spec_id = spec_id or f"spec-{next(_spec_ids)}"
+        self.calls = 0
+        self.rounds = 0
+        self.proposed = 0
+        self.accepted = 0
+
+        tag = {"spec": self.spec_id}
+        keys = ("spec",)
+
+        def counter(name, desc):
+            return Counter(name, desc, tag_keys=keys).set_default_tags(tag)
+
+        self._m_calls = counter(
+            "llm_spec_calls_total",
+            "speculative_generate invocations")
+        self._m_rounds = counter(
+            "llm_spec_rounds_total",
+            "Draft-propose / target-verify rounds")
+        self._m_proposed = counter(
+            "llm_spec_proposed_total",
+            "Draft tokens proposed for verification")
+        self._m_accepted = counter(
+            "llm_spec_accepted_total",
+            "Draft tokens accepted by the target")
+        self._m_rate = Gauge(
+            "llm_spec_acceptance_rate",
+            "Cumulative accepted / proposed (0..1)",
+            tag_keys=keys).set_default_tags(tag)
+
+    def observe(self, stats: SpecStats) -> None:
+        """Fold one call's SpecStats into the cumulative series."""
+        self.calls += 1
+        self.rounds += stats.rounds
+        self.proposed += stats.proposed
+        self.accepted += stats.accepted
+        self._m_calls.inc()
+        if stats.rounds > 0:
+            self._m_rounds.inc(stats.rounds)
+        if stats.proposed > 0:
+            self._m_proposed.inc(stats.proposed)
+        if stats.accepted > 0:
+            self._m_accepted.inc(stats.accepted)
+        self._m_rate.set(self.acceptance_rate)
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+    def stats(self) -> Dict[str, float]:
+        """Flat numeric snapshot, gauge-friendly like
+        EngineMetrics.stats() (all ratios 0.0 before any call)."""
+        return {
+            "calls": float(self.calls),
+            "rounds": float(self.rounds),
+            "proposed": float(self.proposed),
+            "accepted": float(self.accepted),
+            "acceptance_rate": self.acceptance_rate,
+            "rounds_per_call": (self.rounds / self.calls
+                                if self.calls else 0.0),
+        }
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "width"),
@@ -96,6 +174,7 @@ def speculative_generate(
     draft_params: Params, draft_cfg: LlamaConfig,
     prompt, *, max_new_tokens: int = 32, window: int = 4,
     eos_id: Optional[int] = None,
+    metrics: Optional[SpecMetrics] = None,
 ) -> Tuple[jax.Array, SpecStats]:
     """prompt [1, P] int32 -> ([1, P + n] int32, stats), n <=
     max_new_tokens (early eos stops short, like `generate_stream`).
@@ -103,7 +182,8 @@ def speculative_generate(
     Greedy only: emitted tokens are IDENTICAL to
     ``generate(target_params, prompt, target_cfg, greedy=True)`` up to
     eos/max_new_tokens truncation (tested). Draft and target must share
-    the vocabulary."""
+    the vocabulary. Pass a `SpecMetrics` to publish this call's
+    acceptance telemetry to the util.metrics Prometheus plane."""
     if target_cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError(
             f"draft vocab {draft_cfg.vocab_size} != target vocab "
@@ -170,4 +250,6 @@ def speculative_generate(
     del emitted[max_new_tokens:]
     out = jnp.concatenate(
         [prompt, jnp.asarray(emitted, jnp.int32)[None, :]], axis=1)
+    if metrics is not None:
+        metrics.observe(stats)
     return out, stats
