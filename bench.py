@@ -198,11 +198,14 @@ def _bench_serving(cfg, *, batch_sizes, prompt_len: int,
     live, adaptive horizon), plus a HORIZON SWEEP (pinned H — H=1 is
     the historical one-dispatch-one-sync-per-token path, larger H
     amortizes both across the fused block; `host_syncs_per_token` is
-    the direct evidence) and mid-flight-churn throughput at
+    the direct evidence), mid-flight-churn throughput at
     decode_horizon 1 vs the default (queue deeper than slots, ragged
-    budgets — slots are reused as rows finish mid-horizon). Tokens/s
-    are wall-clock host-inclusive numbers: this measures the serving
-    engine, not the bare kernel."""
+    budgets — slots are reused as rows finish mid-horizon), and a
+    PIPELINE DEPTH SWEEP (d1 = synchronous, d2/d4 = async
+    double-buffered run-ahead overlapping host replay with device
+    compute) on both steady-state decode and the churn workload.
+    Tokens/s are wall-clock host-inclusive numbers: this measures the
+    serving engine, not the bare kernel."""
     import jax
     import numpy as np
 
@@ -217,9 +220,10 @@ def _bench_serving(cfg, *, batch_sizes, prompt_len: int,
         return [rng.randint(1, cfg.vocab_size, size=length).tolist()
                 for _ in range(n)]
 
-    def make_engine(B, horizon=8):
+    def make_engine(B, horizon=8, depth=2):
         return DecodeEngine(params, cfg, batch_slots=B, max_len=max_len,
                             decode_horizon=horizon,
+                            pipeline_depth=depth,
                             enable_metrics=False)
 
     def spread_pct(rs):
@@ -301,10 +305,11 @@ def _bench_serving(cfg, *, batch_sizes, prompt_len: int,
     # fused decode blocks. Run at decode_horizon=1 (the historical
     # per-step path) and the default horizon: the gap is the tentpole's
     # end-to-end win under realistic load.
-    def churn(horizon):
+    def churn(horizon, depth=2):
         rates = []
         for trial in range(trials + 1):     # +1 untimed warmup: churn
-            eng = make_engine(B, horizon=horizon)   # hits prefill
+            eng = make_engine(B, horizon=horizon,   # hits prefill
+                              depth=depth)
             total = 0                       # group sizes and capped
             for i, p in enumerate(prompts(3 * B)):  # horizons the
                 n = new_tokens if i % 2 == 0 else max(2, new_tokens // 2)
@@ -319,6 +324,44 @@ def _bench_serving(cfg, *, batch_sizes, prompt_len: int,
     churn_h1 = churn(1)
     churn_h8 = churn(8)
 
+    # Pipeline depth sweep at the default horizon: d1 is the
+    # synchronous engine, d2/d4 run ahead — the device computes block
+    # N+1 while the host replays block N off its async copy.
+    # Steady-state decode is where run-ahead engages end-to-end;
+    # churn (3x oversubscribed, admissions forcing flushes) shows the
+    # overlap at least breaks even under realistic load.
+    # depth_effective / overrun_tokens quantify how much run-ahead
+    # actually happened and what it wasted.
+    pipeline_sweep = {}
+    for depth in (1, 2, 4):
+        eng = make_engine(B, depth=depth)           # warmup this depth
+        for p in prompts(B):
+            eng.submit(p, new_tokens)
+        drain(eng)
+        rates = []
+        eff = over = 0.0
+        for _ in range(trials):
+            eng = make_engine(B, depth=depth)
+            for p in prompts(B):
+                eng.submit(p, new_tokens)
+            eng.step(horizon=1)          # admission outside the clock
+            t0 = time.perf_counter()
+            toks = drain(eng)
+            dt = time.perf_counter() - t0
+            if toks:
+                rates.append(toks / dt)
+            s = eng.stats()
+            eff = s["pipeline_depth_effective"]
+            over = s["pipeline_overrun_tokens"]
+        pipeline_sweep[f"d{depth}"] = {
+            "decode_tokens_per_sec": round(
+                statistics.median(rates), 1),
+            "churn_tokens_per_sec": churn(8, depth=depth),
+            "pipeline_depth_effective": round(eff, 3),
+            "pipeline_overrun_tokens": over,
+            "trial_spread_pct": round(spread_pct(rates), 2),
+        }
+
     biggest = per_batch[f"b{max(batch_sizes)}"]
     return {
         "metric": "llama_decode_tokens_per_sec_1chip",
@@ -331,6 +374,7 @@ def _bench_serving(cfg, *, batch_sizes, prompt_len: int,
         "churn_tokens_per_sec_h1": churn_h1,
         "churn_tokens_per_sec_h8": churn_h8,
         "horizon_sweep": horizon_sweep,
+        "pipeline_sweep": pipeline_sweep,
         "batch_sizes": list(batch_sizes),
         "per_batch": per_batch,
         "prompt_len": prompt_len,

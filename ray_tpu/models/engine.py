@@ -26,6 +26,16 @@ slot-based continuous batching is first-class here, built the XLA way:
   occupant's prefill overwrites from slot 0. Rows finishing
   mid-horizon freeze on device (row_len stops, emits masked to -1)
   and are retired by the host replay of the token block.
+- The decode loop is ASYNC double-buffered (`pipeline_depth`, default
+  2): during pure-decode stretches (queue empty, nothing mid-prefill)
+  the engine keeps a bounded ring of fused steps in flight, chaining
+  each run-ahead dispatch off the previous one's device-carried row
+  state and issuing `copy_to_host_async` on every token block, so the
+  host replays step N's tokens while the device computes step N+1.
+  The ring is flushed before any admission/prefill/prefix copy (those
+  mutate the donated cache from the host side), and run-ahead
+  iterations on rows that finished mid-flight are masked on device and
+  accounted as `pipeline_overrun_tokens`.
 
 Consistency contract (tested): greedy engine output for every request
 is token-identical to that request's solo `generate` run, regardless of
@@ -46,6 +56,7 @@ DECODE STEPS — requests join and leave a running batch mid-flight.
 
 from __future__ import annotations
 
+import collections
 import functools
 from typing import Any, Dict, List, Optional, Tuple, Union
 
@@ -85,7 +96,11 @@ def _device_get(x) -> np.ndarray:
     host syncs for telemetry (`host_syncs_per_token`) and (b) tests can
     wrap it to GATE the transfer budget — the fused decode path must
     stay at one pull per horizon, and an accidental per-token sync
-    reintroduction fails tests/test_engine_horizon.py."""
+    reintroduction fails tests/test_engine_horizon.py. Under the async
+    pipeline the pull is usually a no-op wait: the block's
+    `copy_to_host_async` was issued at dispatch, one or more fused
+    steps earlier (tests/test_engine_pipeline.py gates that the next
+    dispatch is issued BEFORE this fetch)."""
     return np.asarray(x)
 
 
@@ -267,7 +282,7 @@ def _decode_multi(params: Params, cache, last_logits, row_len, active,
     one blocking sample per token.
 
     Per-iteration transition (bit-identical to the host replay in
-    `DecodeEngine._emit`, which mirrors it without touching the
+    `DecodeEngine._emit_block`, which mirrors it without touching the
     device):
         tok      = sample(last_logits)          # emit if active
         budget  -= active;  tok_idx += active
@@ -277,9 +292,14 @@ def _decode_multi(params: Params, cache, last_logits, row_len, active,
         the slot's next prefill)
         row_len += active & ~done;  last_logits updates where continuing
 
-    Returns (toks [horizon, B] int32, cache, last_logits). `last_logits`
-    carries across calls, so the final iteration's decode is never
-    wasted — the next horizon samples straight from it."""
+    Returns (toks [horizon, B] int32, cache, last_logits, row_len,
+    active, budget, tok_idx) — the FULL scan carry, not just the token
+    block. `last_logits` carries across calls, so the final iteration's
+    decode is never wasted — the next horizon samples straight from it
+    — and the carried row state lets the async pipeline chain a
+    run-ahead dispatch directly off the previous one's device arrays,
+    with zero host synchronization between dispatches (the host's own
+    row_len/budget copies catch up when it drains the token block)."""
     max_len = cache["k"].shape[2]
 
     def body(carry, _):
@@ -301,10 +321,12 @@ def _decode_multi(params: Params, cache, last_logits, row_len, active,
         return (cache, last_logits, row_len, cont, budget,
                 tok_idx), emit
 
-    (cache, last_logits, _, _, _, _), toks = jax.lax.scan(
-        body, (cache, last_logits, row_len, active, budget, tok_idx),
-        None, length=horizon)
-    return toks, cache, last_logits
+    (cache, last_logits, row_len, active, budget, tok_idx), toks = \
+        jax.lax.scan(
+            body, (cache, last_logits, row_len, active, budget,
+                   tok_idx),
+            None, length=horizon)
+    return toks, cache, last_logits, row_len, active, budget, tok_idx
 
 
 # ---------------------------------------------------------------------------
@@ -344,6 +366,30 @@ class _PrefillState:
         self.nodes = nodes
 
 
+class _InflightStep:
+    """One dispatched-but-not-yet-drained fused decode step.
+
+    ``toks`` is the step's [H, B] device token block — its
+    `copy_to_host_async` was issued at dispatch, so by the time the
+    host drains it (one or more steps later) the bytes are already on
+    their way or landed. ``chain`` is the dispatch's returned device
+    row state (row_len, active, budget, tok_idx): the NEXT run-ahead
+    dispatch consumes it directly, so queued steps never synchronize
+    with the host. ``run_ahead`` marks steps dispatched before the
+    host had replayed the previous block — only those can contain
+    overrun iterations for rows that had already finished."""
+
+    __slots__ = ("toks", "H", "rows", "run_ahead", "chain")
+
+    def __init__(self, toks, H: int, rows: List[int], run_ahead: bool,
+                 chain: tuple):
+        self.toks = toks
+        self.H = H
+        self.rows = rows
+        self.run_ahead = run_ahead
+        self.chain = chain
+
+
 class DecodeEngine:
     """Slot-based continuous batching over a shared KV cache.
 
@@ -356,6 +402,16 @@ class DecodeEngine:
     could take a free slot next step (protect TTFT), the full
     `decode_horizon` once slots are saturated or the queue is empty
     (amortize dispatch overhead) — pass `step(horizon=...)` to pin it.
+
+    `pipeline_depth` (default 2) bounds the async ring of fused steps
+    kept in flight during pure-decode stretches: step N+1 is dispatched
+    BEFORE step N's token block is pulled to the host (the block's
+    `copy_to_host_async` overlaps N+1's compute), chained through the
+    device-carried row state, and the host drains/replays one step
+    behind. The ring flushes whenever the scheduler reports pending
+    admissions or a row is mid-chunked-prefill, so scheduling decisions
+    always see fully-replayed host state; depth 1 is the synchronous
+    engine. Output is token-identical at every depth.
 
     Greedy by default; sampling mode (greedy=False) applies the same
     temperature/top_k/top_p semantics as `generate`, with a PER-REQUEST
@@ -400,6 +456,7 @@ class DecodeEngine:
                  on_full: str = "reject",
                  max_prefills_per_step: Optional[int] = None,
                  decode_horizon: int = 8,
+                 pipeline_depth: int = 2,
                  prefix_cache: bool = False,
                  prefix_block: int = 32,
                  prefix_cache_bytes: Optional[int] = None,
@@ -416,6 +473,8 @@ class DecodeEngine:
             raise ValueError("max_prefills_per_step must be >= 1")
         if decode_horizon < 1:
             raise ValueError("decode_horizon must be >= 1")
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
         if prefix_block < 1:
             raise ValueError("prefix_block must be >= 1")
         if prefill_chunk is not None and prefill_chunk < 1:
@@ -440,6 +499,7 @@ class DecodeEngine:
         self.on_full = on_full
         self.max_prefills_per_step = max_prefills_per_step
         self.decode_horizon = decode_horizon
+        self.pipeline_depth = pipeline_depth
         self.metrics = (EngineMetrics(engine_id=engine_id,
                                       batch_slots=self.B)
                         if enable_metrics else NullEngineMetrics())
@@ -474,6 +534,14 @@ class DecodeEngine:
         self.prefix_evictions = 0      # LRU blocks recycled
         self.prefix_copy_dispatches = 0  # pool copy-in/out launches
         self.chunked_prefill_stalls = 0  # steps with a row mid-prefill
+        # Async pipeline: dispatched-but-undrained fused steps, oldest
+        # first. Same plain-int discipline for the counters so
+        # enable_metrics=False benches still report the pipeline plane.
+        self._ring: collections.deque = collections.deque()
+        self.pipeline_flushes = 0      # forced full drains of the ring
+        self.pipeline_overrun_tokens = 0  # masked run-ahead iterations
+        self._pl_depth_sum = 0         # ring depth sampled at each drain
+        self._pl_depth_n = 0
 
         # Chunked prefill: rows whose suffix is still being written,
         # row -> _PrefillState. A row in here is EXCLUDED from decode
@@ -572,10 +640,27 @@ class DecodeEngine:
         `horizon_hint` picks 1 while a queued request could take a free
         slot next step, else `decode_horizon`, capped at the largest
         remaining budget (no trailing iterations run fully frozen) and
-        rounded down to a power of two (bounded compile count)."""
+        rounded down to a power of two (bounded compile count).
+
+        With `pipeline_depth >= 2` and a pure-decode stretch (queue
+        empty, nothing mid-prefill), the step dispatches ahead: it tops
+        the in-flight ring up to `pipeline_depth` fused steps (each
+        chained off the previous one's device row state) BEFORE pulling
+        the oldest step's token block, so the device computes step N+1
+        while the host replays step N. Per-call emissions are identical
+        to the synchronous engine: each call still drains exactly one
+        block, whose horizon follows the same budget arithmetic."""
         if horizon is not None and horizon < 1:
             raise ValueError("horizon must be >= 1")
         emitted: Dict[int, List[int]] = {}
+        # Flush the pipeline before any admission / prefill / prefix
+        # copy: those paths mutate the cache from the host side and
+        # read row/slot state, so every in-flight run-ahead block must
+        # be replayed first (freed slots, retired requests) for the
+        # admission decision to see true state.
+        if self._ring and (self.scheduler.admissions_pending()
+                           or self._row_prefill):
+            self._flush_pipeline(emitted)
         budget = self.max_prefills_per_step or self.B
         admissions: List[Tuple[int, _Request]] = []
         begin = getattr(self.scheduler, "begin_admission_round", None)
@@ -596,6 +681,8 @@ class DecodeEngine:
 
         live = [b for b in range(self.B) if self.row_req[b] is not None]
         if not live:
+            if self._ring:             # defensive: never strand blocks
+                self._flush_pipeline(emitted)
             return emitted
         # Rows mid-chunked-prefill are NOT decodable: their last_logits
         # still hold an intermediate chunk's scatter. They ride along
@@ -608,46 +695,132 @@ class DecodeEngine:
             self.metrics.on_step(len(live), len(self.scheduler), 0)
             return emitted
 
-        H = horizon
-        if H is None:
-            free = self.B - len(live)
-            H = self.scheduler.horizon_hint(
-                free_slots=free, max_horizon=self.decode_horizon)
-            if len(decodable) < len(live):
-                H = 1          # keep the chunk cadence: a mid-prefill
-                #                row must not wait a long horizon for
-                #                its next chunk (bounded TTFT)
-            # Cap at the largest remaining row budget (no trailing
-            # iterations with every row frozen), rounded DOWN to a
-            # power of two: the fused program recompiles per distinct
-            # H, so adaptive serving touches at most log2(horizon)+1
-            # programs instead of one per budget remainder.
-            H = min(H, int(self.row_budget[decodable].max()))
-            H = 1 << max(0, H.bit_length() - 1)
-        active = np.array([self.row_req[b] is not None
-                           and b not in self._row_prefill
-                           for b in range(self.B)])
-        toks, self.cache, self._last_logits = _decode_multi(
-            self.params, self.cache, self._last_logits,
-            jnp.asarray(self.row_len), jnp.asarray(active),
-            jnp.asarray(self.row_budget), jnp.asarray(self._tok_idx),
-            jnp.asarray(self._row_keys), self.temperature, self.cfg,
-            H, self.greedy, self.top_k, self.top_p, self.eos_id)
-        self.decode_dispatches += 1
-        block = _device_get(toks)          # the step's ONE host sync
-        self.host_syncs += 1
-        for i in range(H):
-            for b in decodable:
-                if self.row_req[b] is None:
-                    continue               # retired earlier in block
-                self._emit(b, int(block[i, b]), emitted)
+        if not self._ring:
+            H = horizon
+            if H is None:
+                free = self.B - len(live)
+                H = self.scheduler.horizon_hint(
+                    free_slots=free, max_horizon=self.decode_horizon)
+                if len(decodable) < len(live):
+                    H = 1      # keep the chunk cadence: a mid-prefill
+                    #            row must not wait a long horizon for
+                    #            its next chunk (bounded TTFT)
+                # Cap at the largest remaining row budget (no trailing
+                # iterations with every row frozen), rounded DOWN to a
+                # power of two: the fused program recompiles per
+                # distinct H, so adaptive serving touches at most
+                # log2(horizon)+1 programs instead of one per budget
+                # remainder.
+                H = min(H, int(self.row_budget[decodable].max()))
+                H = 1 << max(0, H.bit_length() - 1)
+            self._dispatch_decode(H, decodable, chain=None)
+        self._top_up_pipeline(decodable, horizon)
+        self._drain_one(emitted)
+        # End of stream: every request retired, but run-ahead blocks
+        # may remain (all-masked overrun). Drain them now so pending()
+        # reads true and the ring never outlives its requests.
+        if self._ring and not any(r is not None for r in self.row_req):
+            self._flush_pipeline(emitted)
         n_tokens = sum(len(t) for t in emitted.values())
         self.tokens_out += n_tokens
-        self.metrics.on_dispatch(H)
         self.metrics.on_step(
             sum(r is not None for r in self.row_req),
             len(self.scheduler), n_tokens)
         return emitted
+
+    # -- async pipeline ----------------------------------------------------
+
+    def _dispatch_decode(self, H: int, rows: List[int],
+                         chain: Optional[tuple]) -> None:
+        """Launch ONE fused decode step without waiting on anything:
+        from replayed host state after a flush (`chain=None`), or
+        chained off the previous in-flight dispatch's device-carried
+        row state (run-ahead). The token block's `copy_to_host_async`
+        is issued immediately, so the transfer overlaps the device
+        computing the block — and any queued successors."""
+        if chain is None:
+            active = np.array([self.row_req[b] is not None
+                               and b not in self._row_prefill
+                               for b in range(self.B)])
+            args = (jnp.asarray(self.row_len), jnp.asarray(active),
+                    jnp.asarray(self.row_budget),
+                    jnp.asarray(self._tok_idx))
+        else:
+            args = chain
+        toks, self.cache, self._last_logits, rl, ac, bu, ti = \
+            _decode_multi(
+                self.params, self.cache, self._last_logits, *args,
+                jnp.asarray(self._row_keys), self.temperature,
+                self.cfg, H, self.greedy, self.top_k, self.top_p,
+                self.eos_id)
+        try:
+            toks.copy_to_host_async()
+        except AttributeError:
+            pass                   # non-jax.Array backends (tests)
+        self._ring.append(_InflightStep(toks, H, list(rows),
+                                        run_ahead=chain is not None,
+                                        chain=(rl, ac, bu, ti)))
+        self.decode_dispatches += 1
+        self.metrics.on_dispatch(H, host_syncs=0)
+
+    def _top_up_pipeline(self, rows: List[int],
+                         horizon: Optional[int]) -> None:
+        """Run ahead: keep up to `pipeline_depth` fused steps in flight
+        while the engine is in a pure-decode stretch (no admission
+        could change the batch, no row mid-prefill). Each queued step
+        chains the previous dispatch's device row state, so no host
+        sync happens between dispatches. Horizons are chosen from host
+        budgets minus everything already in flight — pessimistic, so a
+        queued step is never provably all-frozen; rows that finish
+        mid-flight still mask their tail iterations on device
+        (`pipeline_overrun_tokens`)."""
+        if (self.pipeline_depth < 2 or self._row_prefill
+                or self.scheduler.admissions_pending()):
+            return
+        while len(self._ring) < self.pipeline_depth:
+            inflight = sum(e.H for e in self._ring)
+            rem = int(self.row_budget[rows].max()) - inflight
+            if rem <= 0:
+                break              # every further iteration would be
+                #                    overrun — nothing left to compute
+            if horizon is not None:
+                Hn = horizon
+            else:
+                Hn = self.scheduler.horizon_hint(
+                    free_slots=self.B - sum(r is not None
+                                            for r in self.row_req),
+                    max_horizon=self.decode_horizon)
+                Hn = min(Hn, rem)
+                Hn = 1 << max(0, Hn.bit_length() - 1)
+            self._dispatch_decode(Hn, rows,
+                                  chain=self._ring[-1].chain)
+
+    def _drain_one(self, emitted: Dict[int, List[int]]) -> None:
+        """Pull the OLDEST in-flight token block to the host (its async
+        copy has been in progress since dispatch) and replay it. With
+        the ring topped up first, the device is already computing the
+        next step(s) while this replay runs — the overlap that hides
+        the host bookkeeping."""
+        entry = self._ring.popleft()
+        depth = len(self._ring) + 1    # steps in flight at this drain
+        self._pl_depth_sum += depth
+        self._pl_depth_n += 1
+        block = _device_get(entry.toks)
+        self.host_syncs += 1
+        self.metrics.on_host_sync()
+        self._emit_block(block, entry, emitted)
+        self.metrics.on_pipeline_drain(depth, len(self._ring))
+
+    def _flush_pipeline(self, emitted: Dict[int, List[int]]) -> None:
+        """Drain EVERY in-flight step. Called before any admission /
+        prefill / prefix copy, and at end of stream — the points where
+        host state must be fully caught up with the device."""
+        if not self._ring:
+            return
+        self.pipeline_flushes += 1
+        self.metrics.on_pipeline_flush()
+        while self._ring:
+            self._drain_one(emitted)
 
     def stats(self) -> Dict[str, float]:
         """Flat numeric telemetry snapshot (EngineMetrics.stats) plus
@@ -692,6 +865,20 @@ class DecodeEngine:
         out["prefix_evictions"] = float(self.prefix_evictions)
         out["prefix_copy_dispatches"] = float(self.prefix_copy_dispatches)
         out["chunked_prefill_stalls"] = float(self.chunked_prefill_stalls)
+        # Async-pipeline plane. depth_effective is the mean number of
+        # fused steps in flight at each drain (1.0 = synchronous; ->
+        # pipeline_depth when run-ahead is sustained); host_lag_steps
+        # is the instantaneous ring length (dispatched, not yet
+        # replayed); overrun tokens are masked device iterations run
+        # ahead for rows that had already finished. Fresh engine: all
+        # 0.0 (the _ratio guard).
+        out["pipeline_depth"] = float(self.pipeline_depth)
+        out["pipeline_depth_effective"] = _ratio(self._pl_depth_sum,
+                                                 self._pl_depth_n)
+        out["pipeline_flushes"] = float(self.pipeline_flushes)
+        out["pipeline_overrun_tokens"] = float(
+            self.pipeline_overrun_tokens)
+        out["host_lag_steps"] = float(len(self._ring))
         if self._prefix is not None:
             out["prefix_blocks_in_use"] = float(self._prefix.blocks_in_use)
             out["prefix_blocks_total"] = float(self._prefix.blocks_total)
@@ -891,28 +1078,61 @@ class DecodeEngine:
             for _, node in run:
                 self._prefix.commit(node)
 
-    def _emit(self, row: int, tok: int,
-              emitted: Dict[int, List[int]]) -> None:
-        """Host replay of ONE device emit: mirrors `_decode_multi`'s
-        per-iteration transition exactly (budget decrement, eos/room
-        check against the pre-advance row_len, then the row_len advance
-        for continuing rows) so host bookkeeping tracks device state
-        without any extra transfer."""
-        req = self.row_req[row]
-        req.tokens.append(tok)
-        emitted.setdefault(req.req_id, []).append(tok)
-        self.metrics.on_token(req.req_id)
-        self.row_budget[row] -= 1
-        self._tok_idx[row] += 1
-        out_of_room = self.row_len[row] + 1 >= self.max_len
-        if (self.row_budget[row] <= 0 or out_of_room
-                or (self.eos_id is not None and tok == self.eos_id)):
-            req.done = True
-            self.finished.add(req.req_id)
-            self.metrics.on_finish(req.req_id)
-            self.row_req[row] = None
-            self.row_len[row] = 0        # slot free for the next prefill
-            self.row_budget[row] = 0
-            self._tok_idx[row] = 0
-        else:
-            self.row_len[row] += 1       # the fed token took its slot
+    def _emit_block(self, block: np.ndarray, entry: _InflightStep,
+                    emitted: Dict[int, List[int]]) -> None:
+        """VECTORIZED host replay of one [H, B] token block: mirrors
+        `_decode_multi`'s per-iteration transition without touching the
+        device, but in one numpy slice + one arithmetic pass per ROW
+        instead of a Python iteration per token.
+
+        The device masks every emit after a row freezes to -1, and
+        `active` only ever transitions True->False inside a block, so
+        each column is a prefix of real tokens followed by -1s: the
+        count of != -1 entries IS the number of emitted tokens, and
+        replaying the transition once with that count is bit-identical
+        to replaying it token by token —
+            budget   -= count;  tok_idx += count
+            done      = budget <= 0
+                        | row_len + count >= max_len   (room check at
+                          the LAST emitted token's pre-advance row_len)
+                        | last_tok == eos
+            row_len  += count if continuing (a finishing row's state is
+                        reset on retirement, so its advance is moot)
+        Emission order is unchanged from the scalar loop: every live
+        row emits from iteration 0, so `emitted` insertion order — and
+        therefore retire-on-eos ordering — is identical.
+
+        Rows found already retired (`row_req is None`) only occur in
+        run-ahead blocks dispatched before the host replayed the
+        retiring block; their columns are all-masked on device and
+        accounted as `pipeline_overrun_tokens`."""
+        for b in entry.rows:
+            req = self.row_req[b]
+            if req is None:
+                if entry.run_ahead:
+                    self.pipeline_overrun_tokens += entry.H
+                    self.metrics.on_pipeline_overrun(entry.H)
+                continue
+            col = block[:, b]
+            count = int((col != -1).sum())
+            if count == 0:
+                continue
+            toks = col[:count].tolist()
+            req.tokens.extend(toks)
+            emitted.setdefault(req.req_id, []).extend(toks)
+            self.metrics.on_tokens(req.req_id, count)
+            self.row_budget[b] -= count
+            self._tok_idx[b] += count
+            out_of_room = self.row_len[b] + count >= self.max_len
+            if (self.row_budget[b] <= 0 or out_of_room
+                    or (self.eos_id is not None
+                        and toks[-1] == self.eos_id)):
+                req.done = True
+                self.finished.add(req.req_id)
+                self.metrics.on_finish(req.req_id)
+                self.row_req[b] = None
+                self.row_len[b] = 0      # slot free for the next prefill
+                self.row_budget[b] = 0
+                self._tok_idx[b] = 0
+            else:
+                self.row_len[b] += count  # the fed tokens took their slots

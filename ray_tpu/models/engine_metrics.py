@@ -201,6 +201,23 @@ class EngineMetrics:
         self._m_prefill_stalls = counter(
             "llm_engine_chunked_prefill_stalls_total",
             "Engine steps with >= 1 row frozen mid-chunked-prefill")
+        # Async-pipeline plane (PR: double-buffered decode):
+        self.pipeline_flushes = 0
+        self.pipeline_overrun_tokens = 0
+        self.host_lag_steps = 0
+        self.pipeline_depth = _Agg()
+        self._m_pipe_flushes = counter(
+            "llm_engine_pipeline_flushes_total",
+            "Forced full drains of the in-flight decode ring "
+            "(pending admission, mid-prefill row, or end of stream)")
+        self._m_pipe_overrun = counter(
+            "llm_engine_pipeline_overrun_tokens_total",
+            "Masked run-ahead decode iterations dispatched for rows "
+            "that had already finished")
+        self._m_host_lag = gauge(
+            "llm_engine_host_lag_steps",
+            "Fused decode steps dispatched but not yet replayed on "
+            "the host (ring length after the last drain)")
 
     # -- lifecycle hooks (called by DecodeEngine) --------------------------
 
@@ -242,6 +259,38 @@ class EngineMetrics:
         rt.last_token_t = now
         rt.n_tokens += n
 
+    def on_tokens(self, req_id: int, n: int) -> None:
+        """`n` tokens of one request landing TOGETHER (one drained
+        [H, B] block) — the vectorized twin of per-token `on_token`
+        calls, preserving its observation arithmetic: TTFT once at the
+        request's first token, then one TPOT observation per further
+        token (total = tokens - 1 per request). The first gap of a
+        block is the real inter-block wall gap; the rest are 0.0 —
+        honest for a fused block, whose tokens genuinely arrive at the
+        same instant."""
+        if n <= 0:
+            return
+        rt = self._req.get(req_id)
+        now = self._clock()
+        self.tokens_generated += n
+        self._m_tokens.inc(n)
+        if rt is None:
+            return
+        if rt.first_token_t is None:
+            rt.first_token_t = now
+            ttft = now - rt.submit_t
+            self.ttft_s.add(ttft)
+            self._m_ttft.observe(ttft)
+        else:
+            tpot = now - rt.last_token_t
+            self.tpot_s.add(tpot)
+            self._m_tpot.observe(tpot)
+        for _ in range(n - 1):
+            self.tpot_s.add(0.0)
+            self._m_tpot.observe(0.0)
+        rt.last_token_t = now
+        rt.n_tokens += n
+
     def on_finish(self, req_id: int) -> None:
         self.requests_finished += 1
         self._m_finished.inc()
@@ -266,8 +315,34 @@ class EngineMetrics:
         self.host_syncs += host_syncs
         self.decode_horizon.add(horizon)
         self._m_dispatches.inc()
-        self._m_host_syncs.inc(host_syncs)
+        if host_syncs > 0:
+            self._m_host_syncs.inc(host_syncs)
         self._m_horizon.observe(horizon)
+
+    def on_host_sync(self, n: int = 1) -> None:
+        """A blocking device->host pull completed (a drained token
+        block). Decoupled from `on_dispatch` by the async pipeline —
+        dispatch happens up to `pipeline_depth` steps before its
+        block's sync; totals converge once the ring drains."""
+        self.host_syncs += n
+        self._m_host_syncs.inc(n)
+
+    def on_pipeline_drain(self, depth: int, lag: int) -> None:
+        """One in-flight block replayed: `depth` fused steps were in
+        flight when the drain started (1 = synchronous), `lag` remain
+        after it (the host_lag_steps gauge)."""
+        self.pipeline_depth.add(depth)
+        self.host_lag_steps = lag
+        self._m_host_lag.set(lag)
+
+    def on_pipeline_flush(self, n: int = 1) -> None:
+        self.pipeline_flushes += n
+        self._m_pipe_flushes.inc(n)
+
+    def on_pipeline_overrun(self, n: int) -> None:
+        if n > 0:
+            self.pipeline_overrun_tokens += n
+            self._m_pipe_overrun.inc(n)
 
     def on_prefix(self, *, hit: bool, reused_tokens: int = 0) -> None:
         """One admission probed the prefix-cache trie; on a hit,
@@ -349,6 +424,12 @@ class EngineMetrics:
             self.prefill_padded_tokens / prefill_total
             if prefill_total else 0.0)
         out["chunked_prefill_stalls"] = self.prefill_stalls
+        out["pipeline_flushes"] = self.pipeline_flushes
+        out["pipeline_overrun_tokens"] = self.pipeline_overrun_tokens
+        out["host_lag_steps"] = self.host_lag_steps
+        out["pipeline_depth_effective"] = (
+            self.pipeline_depth.sum / self.pipeline_depth.count
+            if self.pipeline_depth.count else 0.0)
         self.queue_wait_s.fields("queue_wait_s", out)
         self.ttft_s.fields("ttft_s", out)
         self.tpot_s.fields("tpot_s", out)
@@ -370,11 +451,21 @@ class NullEngineMetrics:
 
     def on_token(self, req_id, n=1): pass
 
+    def on_tokens(self, req_id, n): pass
+
     def on_finish(self, req_id): pass
 
     def on_step(self, live_slots, queue_depth, tokens_emitted): pass
 
     def on_dispatch(self, horizon, host_syncs=1): pass
+
+    def on_host_sync(self, n=1): pass
+
+    def on_pipeline_drain(self, depth, lag): pass
+
+    def on_pipeline_flush(self, n=1): pass
+
+    def on_pipeline_overrun(self, n): pass
 
     def on_prefix(self, *, hit, reused_tokens=0): pass
 

@@ -82,6 +82,19 @@ class SchedulerPolicy:
             return 1
         return max_horizon
 
+    def admissions_pending(self) -> bool:
+        """Could an admission decision change the batch soon? The
+        engine's async decode pipeline consults this before running
+        ahead: a pending admission means every freed slot must be
+        re-examined with fully-replayed host state, so the engine
+        FLUSHES its in-flight ring and steps synchronously instead of
+        dispatching run-ahead decode blocks the newcomer could not
+        join. Default: queue non-empty. Policies that defer requests
+        (e.g. prefix affinity holding followers for a warm trie) must
+        still answer True while anything is queued — a deferred
+        request is admissible again next round."""
+        return len(self) > 0
+
 
 class FIFOPolicy(SchedulerPolicy):
     """Admit in submission order (the engine's historical behavior)."""
