@@ -282,6 +282,57 @@ def _prefix_admission_section(quick: bool) -> list:
     return results
 
 
+def _fleet_router_section(quick: bool) -> list:
+    """Per-decision cost of the fleet routers (models/fleet.py): the
+    wall microseconds one `submit()` spends choosing a replica, per
+    fleet size. The pow-2 + affinity router probes EVERY replica's
+    prefix trie and stats plane per decision (peek-only host walks,
+    zero device work), so its cost must stay trivially small next to
+    a single prefill — this section is the guard. Round-robin is the
+    floor (an index increment)."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import LLMFleet, LlamaConfig, llama_init
+    from ray_tpu.models.engine import DecodeEngine
+    from ray_tpu.models.fleet import (PowerOfTwoAffinityRouter,
+                                      RoundRobinRouter)
+
+    cfg = LlamaConfig.nano(max_seq_len=256)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(3)
+    sizes = (4,) if quick else (2, 4, 8)
+    n_decisions = 50 if quick else 200
+    prompt = rng.randint(1, cfg.vocab_size, size=96).tolist()
+
+    results = []
+    for n in sizes:
+        for router_name, router in (
+                ("round_robin", RoundRobinRouter()),
+                ("pow2_affinity", PowerOfTwoAffinityRouter())):
+            def factory(name):
+                return DecodeEngine(params, cfg, batch_slots=2,
+                                    max_len=cfg.max_seq_len,
+                                    prefix_cache=True, prefix_block=16,
+                                    enable_metrics=False)
+            fleet = LLMFleet(factory, initial_replicas=n,
+                             router=router,
+                             fleet_id=f"mb-{router_name}-{n}")
+            # Seed one replica's trie so the affinity probe walks a
+            # non-trivial index (the expensive honest case).
+            fleet.submit(prompt, 2)
+            fleet.run()
+            running = fleet._running()
+            t0 = time.perf_counter()
+            for _ in range(n_decisions):
+                router.choose(running, prompt)
+            us = (time.perf_counter() - t0) / n_decisions * 1e6
+            results.append((
+                f"fleet_router_{router_name}_decision_us_n{n}",
+                us, "us"))
+    return results
+
+
 def main(quick: bool = False):
     import numpy as np
 
@@ -297,6 +348,9 @@ def main(quick: bool = False):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     for name, value, unit in _prefix_admission_section(quick):
+        print(json.dumps({"metric": name, "value": round(value, 4),
+                          "unit": unit}), flush=True)
+    for name, value, unit in _fleet_router_section(quick):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     results = []

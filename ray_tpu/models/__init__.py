@@ -48,8 +48,17 @@ from ray_tpu.models.t5 import (
 )
 from ray_tpu.models.engine import DecodeEngine
 from ray_tpu.models.engine_metrics import EngineMetrics
+from ray_tpu.models.fleet import (
+    EngineStatsAutoscaler,
+    FleetAutoscalingConfig,
+    FleetRouter,
+    LLMFleet,
+    PowerOfTwoAffinityRouter,
+    RoundRobinRouter,
+)
 from ray_tpu.models.prefix_cache import PrefixCacheIndex
 from ray_tpu.models.scheduler import (
+    EngineDraining,
     EngineOverloaded,
     FIFOPolicy,
     PrefixAffinityPolicy,
@@ -91,11 +100,18 @@ __all__ = [
     "t5_loss",
     "t5_param_specs",
     "DecodeEngine",
+    "EngineDraining",
     "EngineMetrics",
     "EngineOverloaded",
+    "EngineStatsAutoscaler",
     "FIFOPolicy",
+    "FleetAutoscalingConfig",
+    "FleetRouter",
+    "LLMFleet",
+    "PowerOfTwoAffinityRouter",
     "PrefixAffinityPolicy",
     "PrefixCacheIndex",
     "PriorityPolicy",
+    "RoundRobinRouter",
     "SchedulerPolicy",
 ]

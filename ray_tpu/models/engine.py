@@ -58,7 +58,8 @@ from __future__ import annotations
 
 import collections
 import functools
-from typing import Any, Dict, List, Optional, Tuple, Union
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -70,8 +71,8 @@ from ray_tpu.models.generate import (_check_sampling_knobs,
                                      init_cache, sample_rows)
 from ray_tpu.models.llama import LlamaConfig, _rmsnorm
 from ray_tpu.models.prefix_cache import PrefixCacheIndex, block_bytes
-from ray_tpu.models.scheduler import (EngineOverloaded, SchedulerPolicy,
-                                      make_policy)
+from ray_tpu.models.scheduler import (EngineDraining, EngineOverloaded,
+                                      SchedulerPolicy, make_policy)
 
 Params = Dict[str, Any]
 
@@ -335,11 +336,12 @@ def _decode_multi(params: Params, cache, last_logits, row_len, active,
 
 class _Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "tokens", "done",
-                 "priority", "seq", "rng")
+                 "priority", "seq", "rng", "deadline", "shed")
 
     def __init__(self, req_id: int, prompt: List[int],
                  max_new_tokens: int, priority: int = 0, seq: int = 0,
-                 rng: Optional[np.ndarray] = None):
+                 rng: Optional[np.ndarray] = None,
+                 deadline: Optional[float] = None):
         self.req_id = req_id
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
@@ -348,6 +350,8 @@ class _Request:
         self.priority = priority    # lower = admitted first (priority policy)
         self.seq = seq              # submission order (FIFO tie-break)
         self.rng = rng              # [2] uint32 per-request key stream
+        self.deadline = deadline    # absolute clock time; None = no SLO
+        self.shed = False           # retired past-deadline, no prefill run
 
 
 class _PrefillState:
@@ -462,7 +466,8 @@ class DecodeEngine:
                  prefix_cache_bytes: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  engine_id: Optional[str] = None,
-                 enable_metrics: bool = True):
+                 enable_metrics: bool = True,
+                 clock: Callable[[], float] = time.monotonic):
         _check_sampling_knobs(greedy, top_k, top_p)
         if on_full not in ("reject", "block"):
             raise ValueError(f"on_full must be 'reject' or 'block', "
@@ -500,8 +505,11 @@ class DecodeEngine:
         self.max_prefills_per_step = max_prefills_per_step
         self.decode_horizon = decode_horizon
         self.pipeline_depth = pipeline_depth
+        # One clock for telemetry AND deadline shedding — injectable so
+        # hysteresis/expiry tests advance time without sleeping.
+        self._clock = clock
         self.metrics = (EngineMetrics(engine_id=engine_id,
-                                      batch_slots=self.B)
+                                      batch_slots=self.B, clock=clock)
                         if enable_metrics else NullEngineMetrics())
 
         self.cache = init_cache(cfg, self.B, self.max_len)
@@ -519,6 +527,9 @@ class DecodeEngine:
         self._next_id = 0
         self.results: Dict[int, _Request] = {}
         self.finished: set = set()      # done but not yet popped
+        self.shed_ids: set = set()      # finished as past-deadline sheds
+        self.requests_shed = 0          # plain int (enable_metrics=False)
+        self.draining = False           # begin_drain(): no new submits
         # Dispatch/transfer accounting (plain ints so the benchmark's
         # enable_metrics=False engines still report them):
         self.decode_dispatches = 0     # fused decode program launches
@@ -583,7 +594,8 @@ class DecodeEngine:
 
     def submit(self, prompt: List[int], max_new_tokens: int = 32,
                priority: int = 0,
-               rng: Optional[jax.Array] = None) -> int:
+               rng: Optional[jax.Array] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Enqueue a request; returns its id (see `results`).
 
         ``priority`` (lower = sooner) orders admission under the
@@ -594,7 +606,23 @@ class DecodeEngine:
         key stream (greedy=False engines): with the same key, the
         request's sampled tokens equal solo
         ``generate(..., rng=rng)``; by default a distinct stream is
-        derived from the engine rng and request id."""
+        derived from the engine rng and request id.
+
+        ``deadline_s`` is the request's admission SLO: a latency budget
+        (seconds from now, on the engine clock) within which prefill
+        must START. A request still queued when its deadline passes is
+        SHED — retired with zero tokens, ``shed_ids`` membership, and
+        the ``requests_shed`` counter — instead of burning prefill
+        compute no caller is waiting for; requests already admitted
+        always run to completion (killing mid-decode would waste the
+        prefill already paid). ``deadline_s <= 0`` sheds immediately
+        (reject-before-prefill). After ``begin_drain()`` submit raises
+        EngineDraining — a draining replica finishes what it holds but
+        takes nothing new."""
+        if self.draining:
+            raise EngineDraining(
+                "engine is draining (begin_drain was called): it will "
+                "finish in-flight work but accepts no new requests")
         if not len(prompt):
             raise ValueError("empty prompt: need at least one token "
                              "(prepend a BOS token)")
@@ -603,6 +631,20 @@ class DecodeEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds engine max_len "
                 f"{self.max_len}")
+        deadline = (None if deadline_s is None
+                    else self._clock() + deadline_s)
+        if deadline is not None and self._clock() >= deadline:
+            # Dead on arrival: shed before the bounded-queue check —
+            # it will never occupy a queue slot, let alone a prefill.
+            req = _Request(self._next_id, prompt, max_new_tokens,
+                           priority=priority, seq=self._next_id,
+                           rng=None if rng is None else _key_data(rng),
+                           deadline=deadline)
+            self._next_id += 1
+            self.results[req.req_id] = req
+            self.metrics.on_submit(req.req_id)
+            self._shed(req)
+            return req.req_id
         if self.max_queue is not None and \
                 len(self.scheduler) >= self.max_queue:
             if self.on_full == "reject":
@@ -614,7 +656,8 @@ class DecodeEngine:
                 self.step()   # admissions + finishes drain the queue
         req = _Request(self._next_id, prompt, max_new_tokens,
                        priority=priority, seq=self._next_id,
-                       rng=None if rng is None else _key_data(rng))
+                       rng=None if rng is None else _key_data(rng),
+                       deadline=deadline)
         self._next_id += 1
         self.scheduler.push(req)
         self.results[req.req_id] = req
@@ -666,15 +709,31 @@ class DecodeEngine:
         begin = getattr(self.scheduler, "begin_admission_round", None)
         if begin is not None:
             begin()
+        deferred = False
         for row in range(self.B):
-            if budget <= 0:
+            if budget <= 0 or deferred:
                 break
-            if self.row_req[row] is None and len(self.scheduler):
-                req = self.scheduler.pop()
-                if req is None:
-                    break      # prefix policy deferred the whole queue
-                admissions.append((row, req))
-                budget -= 1
+            if self.row_req[row] is not None:
+                continue
+            req = None
+            while len(self.scheduler):
+                cand = self.scheduler.pop()
+                if cand is None:
+                    deferred = True  # prefix policy deferred the queue
+                    break
+                if cand.deadline is not None and \
+                        self._clock() >= cand.deadline:
+                    # Expired mid-queue: shed at the admission gate —
+                    # the last moment before prefill compute would be
+                    # committed to a request nobody is waiting for.
+                    self._shed(cand)
+                    continue
+                req = cand
+                break
+            if req is None:
+                continue       # queue drained to empty (or deferred)
+            admissions.append((row, req))
+            budget -= 1
         if admissions:
             self._admit_rows(admissions)
         self._advance_prefills()
@@ -831,6 +890,12 @@ class DecodeEngine:
         out["live_slots"] = float(
             sum(r is not None for r in self.row_req))
         out["slot_occupancy"] = out["live_slots"] / self.B
+        # Fleet plane: the router scores replicas on these three plus
+        # the TTFT/TPOT percentiles from EngineMetrics.stats().
+        out["requests_shed"] = float(self.requests_shed)
+        out["pending_prefill_tokens"] = float(
+            self.pending_prefill_tokens())
+        out["draining"] = 1.0 if self.draining else 0.0
         # Engine-level dispatch accounting (kept even when metrics are
         # disabled — benchmarks read these to report syncs per token).
         # Every derived ratio guards its denominator: a fresh engine
@@ -896,11 +961,62 @@ class DecodeEngine:
     def pop_result(self, req_id: int) -> List[int]:
         """Remove a FINISHED request from the engine and return its
         generated tokens. Long-running callers driving step() directly
-        must pop each request as it finishes (see `finished`)."""
+        must pop each request as it finishes (see `finished`). A shed
+        request pops an empty list — check `shed_ids` BEFORE popping
+        to distinguish a shed from a zero-token finish."""
         if req_id not in self.finished:
             raise KeyError(f"request {req_id} unknown or not finished")
         self.finished.discard(req_id)
+        self.shed_ids.discard(req_id)
         return self.results.pop(req_id).tokens
+
+    # -- fleet integration: drain hook + router load probes ----------------
+
+    def begin_drain(self) -> None:
+        """Stop accepting new requests; everything already submitted
+        (queued or in-flight) still runs to completion. This is the
+        flush-before-removal half of fleet scale-down: the fleet stops
+        routing to a DRAINING replica, keeps stepping it until
+        `pending()` reads False, then removes it — so an admitted
+        token is never lost to a scale decision. Idempotent."""
+        self.draining = True
+
+    def drain(self) -> Dict[int, List[int]]:
+        """`begin_drain()` + run to empty: flushes the async pipeline,
+        finishes every queued/in-flight request, and returns
+        {req_id: tokens} for all of them (popping, like `run()`)."""
+        self.begin_drain()
+        return self.run()
+
+    def pending_prefill_tokens(self) -> int:
+        """Prompt tokens this engine has accepted but not yet
+        prefilled: every queued request's full prompt plus the
+        uncovered suffix of every row mid-chunked-prefill. A pure host
+        count (zero device syncs) — the fleet router's per-replica
+        cost signal: a replica may show free slots yet owe seconds of
+        prefill to requests ahead of the newcomer."""
+        n = sum(len(st.req.prompt) - st.pos
+                for st in self._row_prefill.values())
+        queued = getattr(self.scheduler, "queued_requests", None)
+        if queued is not None:
+            try:
+                for r in queued():
+                    n += len(r.prompt)
+            except NotImplementedError:
+                pass     # custom policy without the probe: slots-only
+        return n
+
+    def prefix_match_tokens(self, prompt: List[int]) -> int:
+        """Prompt tokens this engine could COPY from its prefix pool
+        instead of prefilling, right now (0 without a prefix cache).
+        A pure host trie walk with peek=True: probing every replica
+        per routing decision must not perturb any replica's LRU
+        recency — only the replica that WINS the request touches its
+        trie (at admission)."""
+        if self._prefix is None:
+            return 0
+        ids, _ = self._prefix.match(prompt, peek=True)
+        return len(ids) * self.prefix_block
 
     # -- internals ---------------------------------------------------------
 
@@ -919,6 +1035,18 @@ class DecodeEngine:
         mix1 = (req.req_id * 0x85EBCA6B + 1) & 0xFFFFFFFF
         return np.array([int(self._base_key[0]) ^ mix0,
                          int(self._base_key[1]) ^ mix1], np.uint32)
+
+    def _shed(self, req: _Request) -> None:
+        """Retire a past-deadline request WITHOUT admitting it: no
+        slot, no prefill, no tokens. It lands in `finished` (and
+        `shed_ids`) like a normal completion so callers polling
+        finished/pop_result need no special path."""
+        req.done = True
+        req.shed = True
+        self.finished.add(req.req_id)
+        self.shed_ids.add(req.req_id)
+        self.requests_shed += 1
+        self.metrics.on_shed(req.req_id)
 
     def _on_prefix_evict(self, n: int) -> None:
         self.prefix_evictions += n

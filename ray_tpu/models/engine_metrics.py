@@ -47,26 +47,54 @@ _engine_ids = itertools.count()
 
 
 class _Agg:
-    """Tiny running aggregate (count/sum/max) for the stats() snapshot —
-    the full distribution lives in the Histogram instruments."""
+    """Running aggregate (count/sum/max) plus a bounded ring of recent
+    observations for tail-percentile snapshots. Mean/max alone hide the
+    tail — the autoscaler scales on TTFT p95 and the SLO bench reports
+    p95/p99, so `fields` additionally emits `_p50`/`_p95`/`_p99` over
+    the last ``WINDOW`` observations (a sliding window, the serving
+    convention: an SLO is judged on RECENT traffic, and the bound keeps
+    a long-running engine's snapshot cost flat). The full unbounded
+    distribution still lives in the Histogram instruments."""
 
-    __slots__ = ("count", "sum", "max")
+    WINDOW = 2048
+
+    __slots__ = ("count", "sum", "max", "_ring", "_ring_i")
 
     def __init__(self):
         self.count = 0
         self.sum = 0.0
         self.max = 0.0
+        self._ring: List[float] = []
+        self._ring_i = 0
 
     def add(self, v: float) -> None:
         self.count += 1
         self.sum += v
         if v > self.max:
             self.max = v
+        if len(self._ring) < self.WINDOW:
+            self._ring.append(v)
+        else:                       # overwrite oldest: O(1), no shift
+            self._ring[self._ring_i] = v
+            self._ring_i = (self._ring_i + 1) % self.WINDOW
+
+    def percentile(self, q: float) -> float:
+        """q-th percentile (0..100) of the retained window — the
+        nearest-rank method on a sorted copy; 0.0 when empty."""
+        if not self._ring:
+            return 0.0
+        vals = sorted(self._ring)
+        rank = max(0, min(len(vals) - 1,
+                          int(round(q / 100.0 * (len(vals) - 1)))))
+        return vals[rank]
 
     def fields(self, prefix: str, out: Dict[str, float]) -> None:
         out[f"{prefix}_count"] = self.count
         out[f"{prefix}_mean"] = self.sum / self.count if self.count else 0.0
         out[f"{prefix}_max"] = self.max
+        out[f"{prefix}_p50"] = self.percentile(50.0)
+        out[f"{prefix}_p95"] = self.percentile(95.0)
+        out[f"{prefix}_p99"] = self.percentile(99.0)
 
 
 class _ReqTimes:
@@ -100,6 +128,7 @@ class EngineMetrics:
         self.requests_admitted = 0
         self.requests_finished = 0
         self.requests_rejected = 0
+        self.requests_shed = 0
         self.tokens_generated = 0
         self.steps = 0
         self.queue_depth = 0
@@ -134,6 +163,10 @@ class EngineMetrics:
         self._m_rejected = counter(
             "llm_engine_requests_rejected_total",
             "Requests shed by bounded-queue backpressure")
+        self._m_shed = counter(
+            "llm_engine_requests_shed_total",
+            "Requests shed past their deadline before burning prefill "
+            "(at submit, or expired mid-queue at admission)")
         self._m_tokens = counter(
             "llm_engine_tokens_generated_total",
             "Tokens emitted across all requests")
@@ -229,6 +262,16 @@ class EngineMetrics:
     def on_reject(self) -> None:
         self.requests_rejected += 1
         self._m_rejected.inc()
+
+    def on_shed(self, req_id: int) -> None:
+        """A queued request crossed its deadline and was retired
+        WITHOUT prefilling (the overload plane's reject-before-prefill
+        path). Distinct from on_reject: rejection is queue-full
+        backpressure at submit; shedding is deadline expiry of an
+        accepted request."""
+        self.requests_shed += 1
+        self._m_shed.inc()
+        self._req.pop(req_id, None)
 
     def on_admit(self, req_id: int) -> None:
         rt = self._req.get(req_id)
@@ -395,6 +438,7 @@ class EngineMetrics:
             "requests_admitted": self.requests_admitted,
             "requests_finished": self.requests_finished,
             "requests_rejected": self.requests_rejected,
+            "requests_shed": self.requests_shed,
             "tokens_generated": self.tokens_generated,
             "steps": self.steps,
             "queue_depth": self.queue_depth,
@@ -446,6 +490,8 @@ class NullEngineMetrics:
     def on_submit(self, req_id): pass
 
     def on_reject(self): pass
+
+    def on_shed(self, req_id): pass
 
     def on_admit(self, req_id): pass
 

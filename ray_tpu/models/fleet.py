@@ -1,0 +1,662 @@
+"""SLO-aware serving fleet: replica router + engine-stats autoscaler.
+
+PRs 1-5 made ONE `DecodeEngine` fast (fused horizon, prefix cache,
+async pipeline); this module makes N of them serve as a single system.
+The fleet-scale literature (Ray Serve's pow-2-choice router, Orca/vLLM
+continuous batching at scale) is unanimous about where tail latency is
+won once the kernel is fast: in the ROUTER (which replica gets the
+request) and the SCALING POLICY (when replicas appear and disappear) —
+so those are the two first-class objects here.
+
+Three planes, one `submit()`-shaped facade (`LLMFleet`):
+
+- ROUTING. Each request is placed by scoring replicas on their live
+  `engine.stats()`-plane signals — queue depth, slot occupancy,
+  pending prefill tokens, and the prompt's prefix-cache hit potential
+  probed directly against each replica's radix index (`peek=True`, so
+  losing candidates' LRU recency is untouched). The default router is
+  power-of-two-choices (two random candidates, pick the less loaded —
+  O(1) with near-best-of-N tail behavior, the Serve router's design)
+  with a PREFIX-AFFINITY OVERRIDE: a replica that already holds a
+  request's prefix blocks wins outright unless it is overloaded
+  relative to the fleet, because re-computing a cached prefix on a
+  "less loaded" replica costs more than queueing behind the warm one.
+
+- AUTOSCALING. `EngineStatsAutoscaler` consumes per-replica
+  TTFT/TPOT-p95 and occupancy gauges — NOT request rate: QPS says
+  nothing about cost when one request can be 10 or 10k tokens — and
+  adds or drains replicas with hysteresis (sustained breach for
+  `upscale_hold_s` before +1; sustained idle for `downscale_hold_s`
+  before -1; the asymmetry is deliberate, scale-up cheap and fast,
+  scale-down slow and safe). Scale-down NEVER kills work:
+  the victim replica is put in DRAINING (its engine refuses new
+  submits, the router stops offering it), runs to empty, and only then
+  leaves the pool — flush-before-removal, zero in-flight tokens lost.
+
+- OVERLOAD. Priority classes ride the engine's own priority scheduler
+  (`submit(priority=...)` passes straight through) and deadline-based
+  shedding rides `DecodeEngine.submit(deadline_s=...)`: a request that
+  is past its admission deadline is retired WITHOUT burning prefill,
+  at submit (dead on arrival) or at admission pop (expired mid-queue).
+  Shed requests surface through the same finished/pop_result path with
+  `shed_ids` membership, so one polling loop serves both outcomes.
+
+Every replica keeps the engine's token-identity invariant: routing,
+scale-up, drain, and shedding change WHICH engine runs a request and
+WHEN it is admitted — never what it computes. Outputs stay
+token-identical to solo `generate` (greedy, and sampled with a pinned
+per-request rng), which `tests/test_fleet.py` asserts as a matrix.
+
+Fleet health exports as `llm_fleet_*` gauges through the ordinary
+`ray_tpu.util.metrics` plane (tagged by fleet id, same pattern as the
+engine's `llm_engine_*` series) and as a flat `stats()` snapshot.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+from ray_tpu.util.metrics import Gauge
+
+__all__ = [
+    "LLMFleet",
+    "FleetRouter",
+    "RoundRobinRouter",
+    "PowerOfTwoAffinityRouter",
+    "FleetAutoscalingConfig",
+    "EngineStatsAutoscaler",
+    "make_router",
+    "replica_score",
+]
+
+
+# ---------------------------------------------------------------------------
+# Replica pool
+# ---------------------------------------------------------------------------
+
+RUNNING = "RUNNING"
+DRAINING = "DRAINING"
+
+
+class _Replica:
+    """One DecodeEngine plus its fleet bookkeeping: the replica-local
+    request-id -> fleet request-id map (each engine numbers its own
+    requests from 0) and the RUNNING/DRAINING state the router and
+    scaler act on."""
+
+    __slots__ = ("name", "engine", "state", "rid_to_fid", "routed")
+
+    def __init__(self, name: str, engine):
+        self.name = name
+        self.engine = engine
+        self.state = RUNNING
+        self.rid_to_fid: Dict[int, int] = {}
+        self.routed = 0          # requests this replica has been given
+
+
+# ---------------------------------------------------------------------------
+# Routers
+# ---------------------------------------------------------------------------
+
+def replica_score(replica: _Replica, prompt: List[int],
+                  *, queue_cost: float = 64.0,
+                  slot_cost: float = 8.0) -> float:
+    """Estimated cost (in prompt-token equivalents) of placing `prompt`
+    on `replica` RIGHT NOW — the scoring function both routers and the
+    bench share.
+
+    pending_prefill_tokens is the real backlog unit (prompt tokens owed
+    before the newcomer's prefill can start); queue depth and live
+    slots are converted to the same unit with fixed exchange rates
+    (`queue_cost` per queued request ~ a short prompt's prefill,
+    `slot_cost` per live slot ~ the decode interference it adds); the
+    prompt's own cost counts only its COLD suffix — tokens the
+    replica's prefix pool cannot copy (probed with peek=True: scoring
+    must not touch any replica's LRU recency; only the winner's trie
+    is touched, at admission). All host-side reads, zero device work
+    per decision."""
+    eng = replica.engine
+    queued = float(len(eng.scheduler))
+    live = float(sum(r is not None for r in eng.row_req))
+    pending = float(eng.pending_prefill_tokens())
+    cold = float(max(len(prompt) - eng.prefix_match_tokens(prompt), 1))
+    return queued * queue_cost + live * slot_cost + pending + cold
+
+
+class FleetRouter:
+    """Chooses the replica a request is submitted to. Only RUNNING
+    replicas are offered (the fleet filters DRAINING out before
+    calling)."""
+
+    name = "base"
+
+    def choose(self, replicas: List[_Replica],
+               prompt: List[int]) -> _Replica:
+        raise NotImplementedError
+
+
+class RoundRobinRouter(FleetRouter):
+    """Stats-blind baseline: replicas in rotation. Exists to be beaten
+    — the bench's control arm for the pow-2 + affinity router."""
+
+    name = "round_robin"
+
+    def __init__(self):
+        self._i = 0
+
+    def choose(self, replicas: List[_Replica],
+               prompt: List[int]) -> _Replica:
+        rep = replicas[self._i % len(replicas)]
+        self._i += 1
+        return rep
+
+
+class PowerOfTwoAffinityRouter(FleetRouter):
+    """Power-of-two-choices over `replica_score`, with a prefix-
+    affinity override.
+
+    Affinity first: the replica whose radix index holds the LONGEST
+    committed prefix of this prompt wins outright — IF its score stays
+    within `affinity_overload_factor` of the best score in the fleet.
+    The cap is what keeps affinity from defeating itself: without it,
+    every request of a hot shared-prefix group piles onto the one warm
+    replica until its queue dwarfs the prefill it saves (the classic
+    cache-affinity hotspot). Past the cap the request routes by load
+    and becomes the group's cache seed on a second replica.
+
+    Otherwise pow-2: sample two distinct candidates with a SEEDED
+    stream (deterministic tests and benches), pick the lower score.
+    Two random choices get within a constant factor of scanning all N
+    — the Serve router's own rationale — and the score here folds in
+    everything stats() knows, not just queue length."""
+
+    name = "pow2_affinity"
+
+    def __init__(self, *, seed: int = 0, affinity: bool = True,
+                 affinity_overload_factor: float = 4.0,
+                 queue_cost: float = 64.0, slot_cost: float = 8.0):
+        if affinity_overload_factor < 1.0:
+            raise ValueError("affinity_overload_factor must be >= 1.0")
+        self._rng = random.Random(seed)
+        self.affinity = affinity
+        self.affinity_overload_factor = affinity_overload_factor
+        self.queue_cost = queue_cost
+        self.slot_cost = slot_cost
+        self.affinity_wins = 0   # decisions the prefix override took
+        self.pow2_wins = 0       # decisions left to power-of-two
+
+    def _score(self, rep: _Replica, prompt: List[int]) -> float:
+        return replica_score(rep, prompt, queue_cost=self.queue_cost,
+                             slot_cost=self.slot_cost)
+
+    def choose(self, replicas: List[_Replica],
+               prompt: List[int]) -> _Replica:
+        if len(replicas) == 1:
+            return replicas[0]
+        if self.affinity:
+            scores = [self._score(r, prompt) for r in replicas]
+            best_score = min(scores)
+            warm_i, warm_tokens = -1, 0
+            for i, r in enumerate(replicas):
+                m = r.engine.prefix_match_tokens(prompt)
+                if m > warm_tokens:
+                    warm_i, warm_tokens = i, m
+            if warm_i >= 0 and scores[warm_i] <= \
+                    self.affinity_overload_factor * (best_score + 1.0):
+                self.affinity_wins += 1
+                return replicas[warm_i]
+        i = self._rng.randrange(len(replicas))
+        j = self._rng.randrange(len(replicas) - 1)
+        if j >= i:
+            j += 1
+        a, b = replicas[i], replicas[j]
+        self.pow2_wins += 1
+        return a if self._score(a, prompt) <= self._score(b, prompt) \
+            else b
+
+
+_ROUTERS = {"round_robin": RoundRobinRouter,
+            "pow2": PowerOfTwoAffinityRouter,
+            "pow2_affinity": PowerOfTwoAffinityRouter}
+
+
+def make_router(spec: Union[str, FleetRouter]) -> FleetRouter:
+    """Resolve a router spec: an instance passes through, a name
+    ("round_robin" | "pow2" | "pow2_affinity") constructs the
+    built-in."""
+    if isinstance(spec, FleetRouter):
+        return spec
+    try:
+        return _ROUTERS[spec]()
+    except (KeyError, TypeError):
+        raise ValueError(
+            f"unknown fleet router {spec!r}: expected a FleetRouter "
+            f"instance or one of {sorted(_ROUTERS)}")
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler
+# ---------------------------------------------------------------------------
+
+class FleetAutoscalingConfig:
+    """Scaling policy knobs for `EngineStatsAutoscaler`.
+
+    The breach signals are the SERVING SLOs, not traffic: TTFT p95 over
+    `ttft_p95_slo_s` (the tail of submit -> first token, the number a
+    user feels) or mean slot occupancy over `occupancy_high` (the fleet
+    is out of decode slots even if the tail has not blown up yet), or —
+    when `target_custom_metric` is set — a caller-recorded scalar
+    (`serve.metrics.record_autoscaling_metric`, read back through
+    `custom_metric_source`) exceeding its target. Scale-down needs ALL
+    clear: occupancy under `occupancy_low`, custom metric (if any)
+    under target, TTFT inside SLO.
+
+    `upscale_hold_s` / `downscale_hold_s` are the hysteresis: a breach
+    (resp. idle spell) must be CONTINUOUS for that long before the
+    scaler acts, and the timers reset whenever the condition breaks.
+    Downscale defaults much slower than upscale — adding a replica
+    wastes a little compute; removing one into a traffic return wastes
+    user latency."""
+
+    def __init__(self, *, min_replicas: int = 1, max_replicas: int = 4,
+                 ttft_p95_slo_s: Optional[float] = None,
+                 occupancy_high: float = 0.85,
+                 occupancy_low: float = 0.30,
+                 upscale_hold_s: float = 3.0,
+                 downscale_hold_s: float = 30.0,
+                 target_custom_metric: Optional[float] = None,
+                 custom_metric_source: Optional[
+                     Callable[[], Optional[float]]] = None):
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if not 0.0 <= occupancy_low <= occupancy_high <= 1.0:
+            raise ValueError(
+                "need 0 <= occupancy_low <= occupancy_high <= 1")
+        if upscale_hold_s < 0 or downscale_hold_s < 0:
+            raise ValueError("hold times must be >= 0")
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.ttft_p95_slo_s = ttft_p95_slo_s
+        self.occupancy_high = occupancy_high
+        self.occupancy_low = occupancy_low
+        self.upscale_hold_s = upscale_hold_s
+        self.downscale_hold_s = downscale_hold_s
+        self.target_custom_metric = target_custom_metric
+        self.custom_metric_source = custom_metric_source
+
+
+class EngineStatsAutoscaler:
+    """Hysteresis state machine over per-replica engine stats.
+
+    `tick(stats_list, n_replicas)` returns the scale decision for this
+    instant: +1 (add a replica), -1 (drain one), or 0. The caller (the
+    fleet) applies it; the scaler only decides. Mirrors the serve
+    controller's AutoscalingState decision-hold pattern
+    (_private/autoscaling.py) but reads the LLM-native gauges: worst
+    per-replica TTFT p95 (one hot replica IS an SLO breach — means
+    would hide it), mean occupancy (fleet-level headroom), and the
+    optional custom metric.
+
+    All timing flows through the injected clock, so tests drive
+    hysteresis with a fake clock instead of sleeping real time."""
+
+    def __init__(self, config: FleetAutoscalingConfig,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config
+        self._clock = clock
+        self._breach_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self.scale_ups = 0
+        self.scale_downs = 0
+        # Last tick's inputs/verdict, for stats() and the bench log.
+        self.last_signals: Dict[str, float] = {}
+
+    def _signals(self, stats_list: List[Dict[str, float]]
+                 ) -> Tuple[float, float, float, Optional[float]]:
+        ttft_p95 = max((s.get("ttft_s_p95", 0.0) for s in stats_list),
+                       default=0.0)
+        occ = (sum(s.get("slot_occupancy", 0.0) for s in stats_list)
+               / len(stats_list)) if stats_list else 0.0
+        qdepth = sum(s.get("queue_depth", 0.0) for s in stats_list)
+        custom = None
+        if self.config.custom_metric_source is not None:
+            custom = self.config.custom_metric_source()
+        return ttft_p95, occ, qdepth, custom
+
+    def tick(self, stats_list: List[Dict[str, float]],
+             n_replicas: int) -> int:
+        """One scaling decision from the current per-replica snapshots.
+        Call at the fleet's step cadence; returns +1 / 0 / -1."""
+        cfg = self.config
+        now = self._clock()
+        ttft_p95, occ, qdepth, custom = self._signals(stats_list)
+
+        # TTFT p95 is a sliding WINDOW over past requests — once
+        # traffic stops the window goes stale at its last (bad) value.
+        # A latency breach therefore only counts while the fleet is
+        # actually busy (work queued or slots occupied); an idle fleet
+        # quoting an old p95 must scale DOWN, not up.
+        busy = occ > 0.0 or qdepth > 0.0
+        breach = occ > cfg.occupancy_high
+        if busy and cfg.ttft_p95_slo_s is not None and \
+                ttft_p95 > cfg.ttft_p95_slo_s:
+            breach = True
+        if cfg.target_custom_metric is not None and custom is not None \
+                and custom > cfg.target_custom_metric:
+            breach = True
+
+        idle = (not breach) and occ < cfg.occupancy_low
+        if cfg.target_custom_metric is not None and custom is not None \
+                and custom >= cfg.target_custom_metric:
+            idle = False
+
+        self.last_signals = {
+            "ttft_p95": ttft_p95, "occupancy": occ,
+            "queue_depth": qdepth,
+            "custom": float("nan") if custom is None else custom,
+            "breach": 1.0 if breach else 0.0,
+            "idle": 1.0 if idle else 0.0,
+        }
+
+        if breach:
+            self._idle_since = None
+            if self._breach_since is None:
+                self._breach_since = now
+            if now - self._breach_since >= cfg.upscale_hold_s and \
+                    n_replicas < cfg.max_replicas:
+                self._breach_since = None   # re-arm: next +1 needs a
+                self.scale_ups += 1         # fresh sustained breach
+                return +1
+            return 0
+        self._breach_since = None
+
+        if idle:
+            if self._idle_since is None:
+                self._idle_since = now
+            if now - self._idle_since >= cfg.downscale_hold_s and \
+                    n_replicas > cfg.min_replicas:
+                self._idle_since = None
+                self.scale_downs += 1
+                return -1
+            return 0
+        self._idle_since = None
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# Fleet facade
+# ---------------------------------------------------------------------------
+
+_fleet_gauges: Dict[str, Gauge] = {}
+
+
+class LLMFleet:
+    """N `DecodeEngine` replicas behind one engine-shaped API.
+
+    `engine_factory(name)` builds one replica's engine (the fleet
+    passes a unique replica name — use it as `engine_id` so the
+    per-engine `llm_engine_*` series stay separable). The fleet owns
+    replica lifecycle: it starts with `initial_replicas` (or the
+    autoscaler's min), the router places every `submit`, `step()`
+    advances every replica one engine step and applies at most one
+    scale decision, and DRAINING replicas leave the pool only once
+    empty.
+
+    The API mirrors DecodeEngine on purpose — submit / step / run /
+    pending / pop_result / finished / shed_ids / stats — so a serving
+    loop written against one engine drives a fleet unchanged. Request
+    ids are FLEET-scoped (each engine numbers its own; the fleet maps
+    engine ids back per replica)."""
+
+    def __init__(self, engine_factory: Callable[[str], object], *,
+                 initial_replicas: Optional[int] = None,
+                 router: Union[str, FleetRouter] = "pow2_affinity",
+                 autoscaling: Optional[FleetAutoscalingConfig] = None,
+                 fleet_id: str = "fleet-0",
+                 clock: Callable[[], float] = time.monotonic):
+        self._factory = engine_factory
+        self.router = make_router(router)
+        self.fleet_id = fleet_id
+        self._clock = clock
+        self.autoscaler = (EngineStatsAutoscaler(autoscaling, clock)
+                           if autoscaling is not None else None)
+        n = initial_replicas
+        if n is None:
+            n = autoscaling.min_replicas if autoscaling else 2
+        if n < 1:
+            raise ValueError("initial_replicas must be >= 1")
+        if autoscaling is not None and \
+                not autoscaling.min_replicas <= n \
+                <= autoscaling.max_replicas:
+            raise ValueError(
+                f"initial_replicas {n} outside autoscaling bounds "
+                f"[{autoscaling.min_replicas}, "
+                f"{autoscaling.max_replicas}]")
+        self.replicas: List[_Replica] = []
+        self._next_replica = 0
+        for _ in range(n):
+            self.add_replica()
+        self._next_fid = 0
+        self._placement: Dict[int, Tuple[_Replica, int]] = {}
+        self._done: Dict[int, List[int]] = {}
+        self.finished: set = set()
+        self.shed_ids: set = set()
+        self.requests_routed = 0
+        self.requests_shed = 0
+        self.replicas_removed = 0
+        self.tokens_lost_to_drain = 0   # stays 0 by construction;
+        #                                 asserted in tests AND here
+
+    # -- replica lifecycle -------------------------------------------------
+
+    def add_replica(self) -> str:
+        """Build a fresh replica via the factory and put it in the
+        routing rotation; returns its name."""
+        name = f"{self.fleet_id}-r{self._next_replica}"
+        self._next_replica += 1
+        self.replicas.append(_Replica(name, self._factory(name)))
+        return name
+
+    def drain_replica(self, name: str) -> None:
+        """Move a replica to DRAINING: its engine refuses new submits
+        (EngineDraining), the router no longer offers it, and `step()`
+        keeps advancing it until empty, then removes it. In-flight and
+        queued work all complete — flush-before-removal."""
+        rep = self._replica(name)
+        rep.state = DRAINING
+        rep.engine.begin_drain()
+
+    def _replica(self, name: str) -> _Replica:
+        for rep in self.replicas:
+            if rep.name == name:
+                return rep
+        raise KeyError(f"no replica named {name!r}")
+
+    def _running(self) -> List[_Replica]:
+        return [r for r in self.replicas if r.state == RUNNING]
+
+    # -- request path ------------------------------------------------------
+
+    def submit(self, prompt: List[int], max_new_tokens: int = 32,
+               priority: int = 0, rng=None,
+               deadline_s: Optional[float] = None) -> int:
+        """Route and enqueue one request; returns its FLEET id.
+
+        priority / rng / deadline_s pass straight through to the chosen
+        engine's submit — the fleet adds placement, nothing else, so
+        per-replica token identity is the engine's own guarantee. A
+        dead-on-arrival deadline still routes (the engine sheds it
+        before it can occupy a queue slot) and is visible in
+        `finished` + `shed_ids` immediately."""
+        running = self._running()
+        if not running:
+            raise RuntimeError(
+                "fleet has no RUNNING replicas to route to")
+        rep = self.router.choose(running, prompt)
+        rid = rep.engine.submit(prompt, max_new_tokens,
+                                priority=priority, rng=rng,
+                                deadline_s=deadline_s)
+        fid = self._next_fid
+        self._next_fid += 1
+        rep.rid_to_fid[rid] = fid
+        self._placement[fid] = (rep, rid)
+        rep.routed += 1
+        self.requests_routed += 1
+        self._sweep_finished(rep)    # DOA sheds surface immediately
+        return fid
+
+    def step(self) -> Dict[int, List[int]]:
+        """Advance every replica one engine step; returns the merged
+        {fleet_id: new tokens} emissions. Also applies at most one
+        autoscaler decision and retires DRAINING replicas that have
+        run empty.
+
+        The scale decision is taken on the PRE-step snapshots: submits
+        land between steps, so the backlog visible now — before this
+        step consumes any of it — is the demand the fleet is actually
+        facing. (Post-step stats systematically under-read: a fast
+        engine may clear its whole queue within the step and report an
+        idle instant while sustained traffic is breaching the SLO.)"""
+        if self.autoscaler is not None:
+            self._apply_scale(self.autoscaler.tick(
+                [r.engine.stats() for r in self.replicas],
+                len(self._running())))
+        emitted: Dict[int, List[int]] = {}
+        for rep in list(self.replicas):
+            if not rep.engine.pending():
+                self._sweep_finished(rep)
+                continue
+            em = rep.engine.step()
+            for rid, toks in em.items():
+                fid = rep.rid_to_fid.get(rid)
+                if fid is not None and toks:
+                    emitted.setdefault(fid, []).extend(toks)
+            self._sweep_finished(rep)
+        self._retire_drained()
+        return emitted
+
+    def pending(self) -> bool:
+        return any(r.engine.pending() for r in self.replicas)
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drain every replica; returns {fleet_id: tokens} for every
+        finished request and pops them (like DecodeEngine.run)."""
+        while self.pending():
+            self.step()
+        for rep in list(self.replicas):
+            self._sweep_finished(rep)
+        self._retire_drained()
+        return {fid: self.pop_result(fid)
+                for fid in list(self.finished)}
+
+    def pop_result(self, fid: int) -> List[int]:
+        """Tokens of a FINISHED fleet request (empty for a shed one —
+        check `shed_ids` before popping, same contract as the
+        engine)."""
+        if fid not in self.finished:
+            raise KeyError(f"fleet request {fid} unknown or "
+                           f"not finished")
+        self.finished.discard(fid)
+        self.shed_ids.discard(fid)
+        return self._done.pop(fid)
+
+    # -- internals ---------------------------------------------------------
+
+    def _sweep_finished(self, rep: _Replica) -> None:
+        """Move the replica's finished engine requests into the fleet's
+        finished set (popping them from the engine, so a drained
+        replica ends truly empty)."""
+        for rid in list(rep.engine.finished):
+            fid = rep.rid_to_fid.pop(rid, None)
+            if fid is None:
+                continue
+            shed = rid in rep.engine.shed_ids
+            toks = rep.engine.pop_result(rid)
+            self._done[fid] = toks
+            self.finished.add(fid)
+            self._placement.pop(fid, None)
+            if shed:
+                self.shed_ids.add(fid)
+                self.requests_shed += 1
+
+    def _retire_drained(self) -> None:
+        """Remove DRAINING replicas that have fully flushed. The
+        zero-loss invariant is checked here, not trusted: a replica
+        may only leave with no queued work, no live rows, and no
+        unswept results."""
+        for rep in list(self.replicas):
+            if rep.state != DRAINING:
+                continue
+            if rep.engine.pending() or rep.engine.finished or \
+                    rep.rid_to_fid:
+                continue    # still owes work or unswept results: kept
+            self.replicas.remove(rep)
+            self.replicas_removed += 1
+
+    def _apply_scale(self, decision: int) -> None:
+        if decision > 0:
+            self.add_replica()
+        elif decision < 0:
+            running = self._running()
+            if len(running) <= 1:
+                return          # never drain the last live replica
+            # Drain the replica with the least outstanding work — the
+            # cheapest flush, so capacity leaves the pool fastest.
+            victim = min(
+                running,
+                key=lambda r: (r.engine.pending_prefill_tokens()
+                               + sum(x is not None
+                                     for x in r.engine.row_req)))
+            self.drain_replica(victim.name)
+
+    # -- telemetry ---------------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        """Flat fleet snapshot (gauge-friendly, like engine.stats()).
+        Every field is also published as an `llm_fleet_<field>` gauge
+        tagged with the fleet id through util.metrics."""
+        running = self._running()
+        draining = [r for r in self.replicas if r.state == DRAINING]
+        per = [r.engine.stats() for r in self.replicas]
+        out: Dict[str, float] = {
+            "replicas": float(len(self.replicas)),
+            "replicas_running": float(len(running)),
+            "replicas_draining": float(len(draining)),
+            "replicas_removed": float(self.replicas_removed),
+            "requests_routed": float(self.requests_routed),
+            "requests_shed": float(self.requests_shed),
+            "tokens_lost_to_drain": float(self.tokens_lost_to_drain),
+            "queue_depth": sum(s.get("queue_depth", 0.0) for s in per),
+            "pending_prefill_tokens": sum(
+                s.get("pending_prefill_tokens", 0.0) for s in per),
+            "slot_occupancy_mean": (
+                sum(s.get("slot_occupancy", 0.0) for s in per)
+                / len(per)) if per else 0.0,
+            "ttft_s_p95_max": max(
+                (s.get("ttft_s_p95", 0.0) for s in per), default=0.0),
+            "tpot_s_p95_max": max(
+                (s.get("tpot_s_p95", 0.0) for s in per), default=0.0),
+        }
+        out["router_affinity_wins"] = float(
+            getattr(self.router, "affinity_wins", 0))
+        out["router_pow2_wins"] = float(
+            getattr(self.router, "pow2_wins", 0))
+        if self.autoscaler is not None:
+            out["scale_ups"] = float(self.autoscaler.scale_ups)
+            out["scale_downs"] = float(self.autoscaler.scale_downs)
+        self._publish(out)
+        return out
+
+    def _publish(self, stats: Dict[str, float]) -> None:
+        for field, value in stats.items():
+            name = f"llm_fleet_{field}"
+            g = _fleet_gauges.get(name)
+            if g is None:
+                g = _fleet_gauges[name] = Gauge(
+                    name, f"LLMFleet stats field {field!r}",
+                    tag_keys=("fleet",))
+            g.set(float(value), tags={"fleet": self.fleet_id})

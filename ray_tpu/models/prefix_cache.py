@@ -116,7 +116,7 @@ class PrefixCacheIndex:
         T = self.block_tokens
         return tuple(prompt[j * T:(j + 1) * T])
 
-    def match(self, prompt) -> Tuple[List[int], bool]:
+    def match(self, prompt, *, peek: bool = False) -> Tuple[List[int], bool]:
         """Longest committed full-block chain prefixing ``prompt``.
 
         Returns (block_ids, next_is_pending): the matched chain walks at
@@ -124,7 +124,12 @@ class PrefixCacheIndex:
         suffix token is always left for the engine to prefill), and
         ``next_is_pending`` reports whether the walk stopped at a node
         another row is still filling — the prefix-affinity scheduler
-        defers such requests one step so they admit warm."""
+        defers such requests one step so they admit warm.
+
+        ``peek=True`` leaves LRU recency untouched: a pure read for
+        load probes (the fleet router scores EVERY replica's trie per
+        request — touching last_use from probes that lose the routing
+        decision would let routing traffic evict genuinely hot blocks)."""
         node = self._root
         ids: List[int] = []
         max_blocks = (len(prompt) - 1) // self.block_tokens
@@ -134,7 +139,8 @@ class PrefixCacheIndex:
                 return ids, False
             if not child.committed:
                 return ids, True
-            child.last_use = self._tick()
+            if not peek:
+                child.last_use = self._tick()
             ids.append(child.block_id)
             node = child
         return ids, False

@@ -39,6 +39,12 @@ class EngineOverloaded(RuntimeError):
     and the engine was configured with on_full="reject"."""
 
 
+class EngineDraining(RuntimeError):
+    """Raised by `DecodeEngine.submit()` after `begin_drain()`: a
+    draining engine finishes its in-flight and queued work but accepts
+    no new requests (the fleet routes around it until removal)."""
+
+
 class SchedulerPolicy:
     """Ordering policy for queued (not-yet-admitted) requests.
 
@@ -60,6 +66,13 @@ class SchedulerPolicy:
 
     def snapshot(self) -> List[int]:
         """Queued request ids, in no particular order (introspection)."""
+        raise NotImplementedError
+
+    def queued_requests(self) -> list:
+        """The queued request OBJECTS, in no particular order — a
+        read-only view for load probes (the fleet router sums queued
+        prompt lengths into a replica's pending-prefill estimate).
+        Callers must not mutate the returned requests or the list."""
         raise NotImplementedError
 
     def horizon_hint(self, *, free_slots: int,
@@ -116,6 +129,9 @@ class FIFOPolicy(SchedulerPolicy):
     def snapshot(self) -> List[int]:
         return [r.req_id for r in self._q]
 
+    def queued_requests(self) -> list:
+        return list(self._q)
+
 
 class PriorityPolicy(SchedulerPolicy):
     """Admit by priority class (LOWER number = admitted first), FIFO
@@ -141,6 +157,9 @@ class PriorityPolicy(SchedulerPolicy):
 
     def snapshot(self) -> List[int]:
         return [r.req_id for _, _, r in self._heap]
+
+    def queued_requests(self) -> list:
+        return [r for _, _, r in self._heap]
 
 
 class PrefixAffinityPolicy(FIFOPolicy):

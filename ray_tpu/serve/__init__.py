@@ -24,6 +24,7 @@ from ray_tpu.serve.deployment import Application, Deployment, deployment, \
 from ray_tpu.serve.handle import (DeploymentHandle, DeploymentResponse,
                                   DeploymentResponseGenerator)
 from ray_tpu.serve import metrics
+from ray_tpu.serve import llm
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve._private.proxy import ServeRequest
 from ray_tpu.serve.schema import (ApplicationSchema, DeploymentSchema,
@@ -53,6 +54,7 @@ __all__ = [
     "get_deployment_handle",
     "get_multiplexed_model_id",
     "ingress",
+    "llm",
     "metrics",
     "multiplexed",
     "run",

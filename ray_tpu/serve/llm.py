@@ -1,0 +1,139 @@
+"""serve.llm — thin deployment shim over the LLM serving fleet.
+
+The fleet itself (router + replica pool + autoscaler) is pure models/
+code (`ray_tpu.models.fleet.LLMFleet`) and knows nothing about Serve.
+This module is the glue that makes it deployable:
+
+- `LLMFleetServer` is a deployment body: construct it with an engine
+  factory and (optionally) a `FleetAutoscalingConfig`, call
+  `generate()` per request. Works equally outside Serve (tests,
+  notebooks drive it directly) and inside a replica, where every
+  `generate` also publishes the fleet's `stats()` snapshot through the
+  serve metric plane and records the fleet's scaling signal via
+  `record_autoscaling_metric` — so the serve CONTROLLER's own
+  autoscaler (scaling replica actors, each holding a whole fleet) sees
+  the same pressure the fleet-internal scaler acts on.
+
+- `llm_deployment(...)` wraps it in `@serve.deployment` with the
+  usual options.
+
+Custom-metric wiring (the previously dangling seam): when the fleet's
+`FleetAutoscalingConfig` sets `target_custom_metric` but no
+`custom_metric_source`, the shim plugs in
+`serve.metrics.recorded_autoscaling_metric` — so any scalar the
+replica publishes with `serve.metrics.record_autoscaling_metric(v)`
+becomes a live scale-up/-down signal for the fleet autoscaler.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Union
+
+from ray_tpu.models.fleet import (FleetAutoscalingConfig, FleetRouter,
+                                  LLMFleet)
+from ray_tpu.serve import metrics as serve_metrics
+
+__all__ = ["LLMFleetServer", "llm_deployment"]
+
+
+class LLMFleetServer:
+    """Deployment body fronting one `LLMFleet`.
+
+    ``engine_factory(name) -> DecodeEngine`` builds each replica's
+    engine. ``autoscaling`` may be a `FleetAutoscalingConfig` or a
+    plain dict of its kwargs (config-file friendly). All other kwargs
+    pass through to `LLMFleet`."""
+
+    def __init__(self, engine_factory: Callable[[str], object], *,
+                 router: Union[str, FleetRouter] = "pow2_affinity",
+                 autoscaling: Union[FleetAutoscalingConfig, dict,
+                                    None] = None,
+                 fleet_id: str = "llm-fleet",
+                 report_stats: bool = True,
+                 **fleet_kwargs):
+        if isinstance(autoscaling, dict):
+            autoscaling = FleetAutoscalingConfig(**autoscaling)
+        if autoscaling is not None and \
+                autoscaling.target_custom_metric is not None and \
+                autoscaling.custom_metric_source is None:
+            # The dangling seam, closed: scalars recorded through
+            # serve.metrics.record_autoscaling_metric now feed the
+            # fleet autoscaler's custom-metric breach check.
+            autoscaling.custom_metric_source = \
+                serve_metrics.recorded_autoscaling_metric
+        self.fleet = LLMFleet(engine_factory, router=router,
+                              autoscaling=autoscaling,
+                              fleet_id=fleet_id, **fleet_kwargs)
+        self._report_stats = report_stats
+
+    def generate(self, token_ids: List[int],
+                 max_new_tokens: int = 32, priority: int = 0,
+                 deadline_s: Optional[float] = None) -> Dict:
+        """Route one request through the fleet and drive it to
+        completion. Returns ``{"tokens": prompt + generated,
+        "shed": bool}`` — a shed request (past its deadline before
+        prefill) comes back with the bare prompt and shed=True instead
+        of an error, so callers distinguish 'declined under overload'
+        from failure."""
+        fid = self.fleet.submit(token_ids, max_new_tokens,
+                                priority=priority,
+                                deadline_s=deadline_s)
+        while fid not in self.fleet.finished:
+            self.fleet.step()
+        shed = fid in self.fleet.shed_ids
+        out = self.fleet.pop_result(fid)
+        if self._report_stats:
+            self._publish()
+        return {"tokens": list(token_ids) + out, "shed": shed}
+
+    def _publish(self) -> None:
+        """Fleet stats() -> serve-tagged gauges, plus the replica-level
+        autoscaling scalar (queued work per running replica — the
+        controller's cue that this whole-fleet replica is saturating).
+        Publishing the scalar through record_autoscaling_metric ALSO
+        makes it visible to the fleet-internal autoscaler when its
+        config targets the custom metric, closing the loop both ways.
+        Outside a replica the gauges still record (untagged) and the
+        scalar is skipped."""
+        stats = self.fleet.stats()
+        serve_metrics.report_engine_stats(stats,
+                                          prefix="serve_llm_fleet")
+        from ray_tpu.serve._private.replica import get_current_replica
+        if get_current_replica() is not None:
+            per_rep = stats["queue_depth"] / max(
+                stats["replicas_running"], 1.0)
+            serve_metrics.record_autoscaling_metric(per_rep)
+
+    def stats(self) -> Dict[str, float]:
+        return self.fleet.stats()
+
+    def drain(self) -> None:
+        """Flush every replica (prepare_for_shutdown hook): finish all
+        queued/in-flight work so a replica actor holding this fleet
+        can exit without losing tokens."""
+        for rep in list(self.fleet.replicas):
+            self.fleet.drain_replica(rep.name)
+        self.fleet.run()
+
+
+def llm_deployment(engine_factory: Callable[[str], object], *,
+                   name: str = "llm", **deployment_options):
+    """`LLMFleetServer` as a bound serve application:
+
+        app = llm_deployment(factory,
+                             autoscaling={"max_replicas": 4})
+        handle = serve.run(app)
+        handle.generate.remote([1, 2, 3], max_new_tokens=16)
+
+    Keyword args that `LLMFleetServer` understands (router,
+    autoscaling, fleet_id, initial_replicas, ...) are forwarded to it
+    at bind time; the rest are `@serve.deployment` options."""
+    from ray_tpu.serve.deployment import deployment
+
+    shim_keys = ("router", "autoscaling", "fleet_id", "report_stats",
+                 "initial_replicas", "clock")
+    shim_kwargs = {k: deployment_options.pop(k)
+                   for k in list(deployment_options)
+                   if k in shim_keys}
+    dep = deployment(name=name, **deployment_options)(LLMFleetServer)
+    return dep.bind(engine_factory, **shim_kwargs)

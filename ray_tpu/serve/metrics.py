@@ -143,3 +143,23 @@ def record_autoscaling_metric(value: float) -> None:
             "record_autoscaling_metric must be called inside a serve "
             "replica")
     rep._custom_autoscaling_metric = float(value)
+
+
+def recorded_autoscaling_metric() -> Optional[float]:
+    """Read back the scalar this replica last published via
+    ``record_autoscaling_metric`` — None outside a replica or before
+    the first record.
+
+    This is the consumer half of the custom-metric seam: the LLM fleet
+    autoscaler (models/fleet.py) takes it as its default
+    ``custom_metric_source`` when a deployment declares
+    ``target_custom_metric``, so a scalar the replica records (tokens
+    in flight, app-level queue length, anything) directly drives
+    scale decisions — the same loop the reference controller runs by
+    polling ``get_autoscaling_metric`` off each replica."""
+    from ray_tpu.serve._private.replica import get_current_replica
+
+    rep = get_current_replica()
+    if rep is None:
+        return None
+    return rep.get_autoscaling_metric()

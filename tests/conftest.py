@@ -30,6 +30,29 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+class FakeClock:
+    """Deterministic monotonic clock: tests inject it as the engine /
+    fleet / autoscaler ``clock`` and advance time explicitly, so
+    deadline-expiry and autoscaler-hysteresis behavior is exercised in
+    microseconds of wall time instead of real sleeps (the hold windows
+    involved are seconds to minutes)."""
+
+    def __init__(self, start: float = 0.0):
+        self.t = float(start)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        assert dt >= 0, "monotonic clocks do not rewind"
+        self.t += dt
+
+
+@pytest.fixture
+def fake_clock():
+    return FakeClock()
+
+
 @pytest.fixture(scope="module")
 def ray_start_regular():
     import ray_tpu
