@@ -17,6 +17,15 @@ import time
 
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
+# The sharded-dispatch section sweeps tensor-parallel degree; off-TPU
+# that needs a forced multi-device CPU world, set before jax initializes
+# (if jax is already up with fewer devices the section skips tp=4).
+if "jax" not in sys.modules and "--xla_force_host_platform_device_count" \
+        not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        (os.environ.get("XLA_FLAGS", "") +
+         " --xla_force_host_platform_device_count=8").strip())
+
 TRIALS = 3
 
 
@@ -114,6 +123,97 @@ def _decode_dispatch_section(quick: bool) -> list:
                         max(0.0, wall - dev), "ms"))
         results.append((f"engine_decode_transfers_per_token_h{H}",
                         syncs_per_tok, "syncs/token"))
+    return results
+
+
+def _sharded_dispatch_section(quick: bool) -> list:
+    """Per-step cost of the TENSOR-PARALLEL engine vs the plain one:
+    wall ms/step (engine.step over a tp mesh: host bookkeeping +
+    sharded dispatch + the one replicated [H, B] token-block pull) and
+    device ms/step (the bare jitted _decode_multi with the engine's
+    NamedShardings, chained through its donated buffers) at tp=1 (the
+    unsharded control) and tp=4, plus host bytes/token at each degree.
+    The gate: the host-side numbers must NOT scale with chip count —
+    the choke point stays one replicated block pull per fused step, so
+    bytes/token is flat and wall - device stays the same host tax the
+    plain engine pays. Runs anywhere (the module-top flag forces an
+    8-device CPU world; skips tp=4 if the backend has fewer devices)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.engine import DecodeEngine, _decode_multi
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    B, prompt_len, H = 4, 16, 8
+    new_tokens = 16 if quick else 64
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, size=prompt_len).tolist()
+               for _ in range(B)]
+    max_len = prompt_len + new_tokens + 1
+    results = []
+
+    def fill(tp):
+        # pipeline_depth=1: the synchronous per-step cost is the
+        # number under test (overlap is _dispatch_gap_section's job);
+        # tp=1 is the PLAIN engine, not a 1-device mesh, so the sweep
+        # prices the sharding machinery itself.
+        kw = {} if tp == 1 else {"tp": tp}
+        eng = DecodeEngine(params, cfg, batch_slots=B, max_len=max_len,
+                           decode_horizon=H, pipeline_depth=1,
+                           enable_metrics=False, **kw)
+        for p in prompts:
+            eng.submit(p, new_tokens)
+        eng.step(horizon=1)          # admit all rows (+1 token each)
+        return eng
+
+    for tp in (1, 4):
+        if tp > len(jax.devices()):
+            continue
+        fill(tp).run()               # warmup: compile prefill + decode
+
+        wall_ms, toks, steps = [], 0, 0
+        for _ in range(TRIALS):
+            eng = fill(tp)
+            t0 = time.perf_counter()
+            while eng.pending():
+                ev = eng.step(horizon=H)
+                steps += 1
+                toks += sum(len(t) for t in ev.values())
+            wall_ms.append((time.perf_counter() - t0) * 1000)
+        n_steps = steps // TRIALS
+        wall = statistics.median(wall_ms) / max(1, n_steps)
+        bytes_per_tok = eng.stats()["host_transfer_bytes_per_token"]
+
+        # DEVICE: the bare fused program under this tp's shardings,
+        # chained through its donated cache/last_logits.
+        eng = fill(tp)
+        dev_ms = []
+        args = (jnp.asarray(eng.row_len),
+                jnp.asarray(np.array([True] * B)),
+                jnp.asarray(eng.row_budget + 10_000),
+                jnp.asarray(eng._tok_idx), jnp.asarray(eng._row_keys))
+        cache, last = eng.cache, eng._last_logits
+        for _ in range(TRIALS):
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                toks_d, cache, last, *_rest = _decode_multi(
+                    eng.params, cache, last, *args, eng.temperature,
+                    cfg, H, True, None, None, None,
+                    shardings=eng._shardings)
+            jax.block_until_ready(toks_d)
+            dev_ms.append((time.perf_counter() - t0) * 1000 /
+                          max(1, n_steps))
+        dev = statistics.median(dev_ms)
+
+        results.append((f"engine_sharded_wall_ms_per_step_tp{tp}",
+                        wall, "ms"))
+        results.append((f"engine_sharded_device_ms_per_step_tp{tp}",
+                        dev, "ms"))
+        results.append((f"engine_sharded_host_bytes_per_token_tp{tp}",
+                        bytes_per_tok, "bytes/token"))
     return results
 
 
@@ -342,6 +442,9 @@ def main(quick: bool = False):
     # Print the serving-engine sections immediately: their numbers must
     # survive an environment-specific failure in a later section.
     for name, value, unit in _decode_dispatch_section(quick):
+        print(json.dumps({"metric": name, "value": round(value, 4),
+                          "unit": unit}), flush=True)
+    for name, value, unit in _sharded_dispatch_section(quick):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     for name, value, unit in _dispatch_gap_section(quick):

@@ -57,6 +57,7 @@ DECODE STEPS — requests join and leave a running batch mid-flight.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import functools
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
@@ -64,15 +65,21 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.models.engine_metrics import EngineMetrics, NullEngineMetrics
 from ray_tpu.models.generate import (_check_sampling_knobs,
                                      _layer_body, forward_cached_rows,
                                      init_cache, sample_rows)
-from ray_tpu.models.llama import LlamaConfig, _rmsnorm
+from ray_tpu.models.llama import (LlamaConfig, _rmsnorm,
+                                  llama_param_specs)
 from ray_tpu.models.prefix_cache import PrefixCacheIndex, block_bytes
 from ray_tpu.models.scheduler import (EngineDraining, EngineOverloaded,
                                       SchedulerPolicy, make_policy)
+from ray_tpu.parallel.mesh import create_mesh
+from ray_tpu.parallel.sharding import (DEFAULT_RULES, named_sharding,
+                                       prune_rules_for_mesh,
+                                       shard_pytree)
 
 Params = Dict[str, Any]
 
@@ -105,15 +112,43 @@ def _device_get(x) -> np.ndarray:
     return np.asarray(x)
 
 
+@dataclasses.dataclass(frozen=True)
+class _EngineShardings:
+    """NamedShardings the tensor-parallel engine threads through its
+    compiled programs as a STATIC jit argument (NamedSharding is
+    hashable, so each mesh compiles its own program set and the
+    unsharded engine — shardings=None — compiles exactly what it did
+    before).
+
+    ``cache``  [L, B, max_len, KV, D] — KV-head axis over "tp" (when
+               the model's n_kv_heads divides tp; replicated otherwise)
+    ``logits`` [B, vocab]             — vocab over "tp"
+    ``pool``   [L, NB, T, KV, D]      — prefix pool, KV axis like the
+               cache so copy-in/out gathers stay chip-local
+    """
+
+    cache: NamedSharding
+    logits: NamedSharding
+    pool: NamedSharding
+
+    @property
+    def replicated(self) -> NamedSharding:
+        """Fully-replicated sharding on the same mesh — the [H, B]
+        token block is pinned to it so the single device->host transfer
+        stays whole on every chip (no cross-chip fetch at drain)."""
+        return NamedSharding(self.cache.mesh, P())
+
+
 # ---------------------------------------------------------------------------
 # Compiled programs
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("cfg",),
+@functools.partial(jax.jit, static_argnames=("cfg", "shardings"),
                    donate_argnames=("cache", "last_logits"))
 def _prefill_rows(params: Params, prompts: jax.Array, cache,
                   last_logits, rows: jax.Array, starts: jax.Array,
-                  last_idx: jax.Array, cfg: LlamaConfig):
+                  last_idx: jax.Array, cfg: LlamaConfig,
+                  shardings: Optional[_EngineShardings] = None):
     """Batched admission/continuation prefill: write N same-bucket
     chunks' [N, Cb] K/V into N slots in ONE program — each row at its
     OWN cache offset ``starts[n]`` (0 for a cold admission; the cached
@@ -143,14 +178,22 @@ def _prefill_rows(params: Params, prompts: jax.Array, cache,
     }
     n = prompts.shape[0]
     last = logits[jnp.arange(n), last_idx]              # [N, vocab]
-    return cache, last_logits.at[rows].set(last)
+    out_logits = last_logits.at[rows].set(last)
+    if shardings is not None:
+        # Donated buffers must leave with the sharding they arrived in.
+        cache = jax.lax.with_sharding_constraint(cache, shardings.cache)
+        out_logits = jax.lax.with_sharding_constraint(
+            out_logits, shardings.logits)
+    return cache, out_logits
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("n_blocks", "block_tokens"),
+                   static_argnames=("n_blocks", "block_tokens",
+                                    "shardings"),
                    donate_argnames=("cache",))
 def _prefix_copy_in(cache, pool_k, pool_v, block_ids: jax.Array,
-                    rows: jax.Array, n_blocks: int, block_tokens: int):
+                    rows: jax.Array, n_blocks: int, block_tokens: int,
+                    shardings: Optional[_EngineShardings] = None):
     """Copy cached prefix blocks into engine slot rows: ONE gather
     program per step moves every warm admission's shared K/V from the
     device-resident pool into its slot — zero host round-trips, the
@@ -167,21 +210,37 @@ def _prefix_copy_in(cache, pool_k, pool_v, block_ids: jax.Array,
     span = n_blocks * block_tokens
     blk_k = pool_k[:, block_ids]          # [L, N, nb, T, KV, D]
     blk_v = pool_v[:, block_ids]
+    if shardings is not None:
+        # Sharded gather: pool and cache carry the same KV-head
+        # sharding, so pin the gathered blocks to it too — each chip
+        # gathers ONLY its heads' slice of the pool and scatters it
+        # into its own cache shard; no cross-chip block traffic.
+        sp = shardings.pool.spec          # (l, nb, t, kv, d)
+        blk_spec = NamedSharding(
+            shardings.pool.mesh, P(sp[0], None, sp[1], sp[2], sp[3],
+                                   sp[4]))
+        blk_k = jax.lax.with_sharding_constraint(blk_k, blk_spec)
+        blk_v = jax.lax.with_sharding_constraint(blk_v, blk_spec)
     L, N = blk_k.shape[:2]
     k = blk_k.reshape(L, N, span, *blk_k.shape[4:])
     v = blk_v.reshape(L, N, span, *blk_v.shape[4:])
-    return {
+    out = {
         "k": cache["k"].at[:, rows, :span].set(k.astype(cache["k"].dtype)),
         "v": cache["v"].at[:, rows, :span].set(v.astype(cache["v"].dtype)),
     }
+    if shardings is not None:
+        out = jax.lax.with_sharding_constraint(out, shardings.cache)
+    return out
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("n_blocks", "block_tokens"),
+                   static_argnames=("n_blocks", "block_tokens",
+                                    "shardings"),
                    donate_argnames=("pool_k", "pool_v"))
 def _prefix_copy_out(cache_k, cache_v, pool_k, pool_v, row,
                      start_slot, block_ids: jax.Array, n_blocks: int,
-                     block_tokens: int):
+                     block_tokens: int,
+                     shardings: Optional[_EngineShardings] = None):
     """Insert a freshly prefilled prefix into the pool: slice
     [start_slot, start_slot + n_blocks*T) out of one slot row and
     scatter it into the pool at ``block_ids`` — one program per novel
@@ -203,6 +262,12 @@ def _prefix_copy_out(cache_k, cache_v, pool_k, pool_v, row,
     seg_v = seg_v.reshape(L, n_blocks, block_tokens, *seg_v.shape[2:])
     pool_k = pool_k.at[:, block_ids].set(seg_k.astype(pool_k.dtype))
     pool_v = pool_v.at[:, block_ids].set(seg_v.astype(pool_v.dtype))
+    if shardings is not None:
+        # Sharded scatter, the mirror of copy-in's gather: cache row
+        # and pool share the KV-head sharding, so each chip writes its
+        # own heads' slice of the block. Donated pools keep layout.
+        pool_k = jax.lax.with_sharding_constraint(pool_k, shardings.pool)
+        pool_v = jax.lax.with_sharding_constraint(pool_v, shardings.pool)
     return pool_k, pool_v
 
 
@@ -265,13 +330,15 @@ def _decode_core(params: Params, toks: jax.Array, cache, row_len,
 
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "horizon", "greedy",
-                                    "top_k", "top_p", "eos_id"),
+                                    "top_k", "top_p", "eos_id",
+                                    "shardings"),
                    donate_argnames=("cache", "last_logits"))
 def _decode_multi(params: Params, cache, last_logits, row_len, active,
                   budget, tok_idx, row_keys, temperature,
                   cfg: LlamaConfig, horizon: int, greedy: bool,
                   top_k: Optional[int], top_p: Optional[float],
-                  eos_id: Optional[int]):
+                  eos_id: Optional[int],
+                  shardings: Optional[_EngineShardings] = None):
     """Fuse `horizon` decode iterations into ONE program: a `lax.scan`
     whose body samples every row's next token ON DEVICE from the
     carried `last_logits` (greedy argmax, or per-row rng streams — see
@@ -319,6 +386,16 @@ def _decode_multi(params: Params, cache, last_logits, row_len, active,
         logits, cache = _decode_core(params, tok, cache, row_len, cfg)
         row_len = row_len + cont.astype(jnp.int32)
         last_logits = jnp.where(cont[:, None], logits, last_logits)
+        if shardings is not None:
+            # Pin the scan carry to the engine's layout every
+            # iteration: the KV write stays a chip-local scatter (each
+            # chip owns its heads' cache shard) and the carried logits
+            # stay vocab-sharded — XLA partitions attention heads and
+            # MLP width instead of replicating the whole model.
+            cache = jax.lax.with_sharding_constraint(
+                cache, shardings.cache)
+            last_logits = jax.lax.with_sharding_constraint(
+                last_logits, shardings.logits)
         return (cache, last_logits, row_len, cont, budget,
                 tok_idx), emit
 
@@ -327,6 +404,12 @@ def _decode_multi(params: Params, cache, last_logits, row_len, active,
             body, (cache, last_logits, row_len, active, budget,
                    tok_idx),
             None, length=horizon)
+    if shardings is not None:
+        # The [H, B] block is the ONE device->host transfer: keep it
+        # fully replicated so the drain reads whole from any chip —
+        # host-sync bytes stay 4*H*B regardless of tp degree.
+        toks = jax.lax.with_sharding_constraint(
+            toks, shardings.replicated)
     return toks, cache, last_logits, row_len, active, budget, tok_idx
 
 
@@ -441,6 +524,18 @@ class DecodeEngine:
       max_prefills_per_step — per-step prefill admission budget so a
         burst of long prompts cannot starve in-flight decode rows.
 
+    Tensor parallelism: ``tp=n`` (or a prebuilt ``mesh=`` with a "tp"
+    axis) shards the model weights, the KV cache, the prefix block
+    pool and the fused programs' carried state across n chips via the
+    model's logical axis rules — attention heads, MLP width and the
+    vocab dimension split over ICI; KV heads split when ``n_kv_heads``
+    divides tp and replicate otherwise (prune_rules_for_mesh). The
+    host never notices: scheduling, chunked prefill, the async
+    pipeline and the single [H, B] device->host block (kept fully
+    replicated) are identical at every tp degree, and so is every
+    emitted token (greedy and sampled) — gated by
+    tests/test_engine_sharded.py.
+
     Telemetry: `self.metrics` (EngineMetrics) records queue-wait /
     TTFT / TPOT / occupancy through the util.metrics Prometheus plane;
     `stats()` returns the flat snapshot. enable_metrics=False swaps in
@@ -465,6 +560,9 @@ class DecodeEngine:
                  prefix_block: int = 32,
                  prefix_cache_bytes: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
+                 mesh: Optional[Mesh] = None,
+                 tp: Optional[int] = None,
+                 sharding_rules=None,
                  engine_id: Optional[str] = None,
                  enable_metrics: bool = True,
                  clock: Callable[[], float] = time.monotonic):
@@ -512,12 +610,71 @@ class DecodeEngine:
                                       batch_slots=self.B, clock=clock)
                         if enable_metrics else NullEngineMetrics())
 
-        self.cache = init_cache(cfg, self.B, self.max_len)
+        # Tensor parallelism over an ICI mesh: `tp=n` builds a
+        # {"tp": n} mesh over the first n visible devices; `mesh=`
+        # hands over a prebuilt mesh carrying a "tp" axis. Weights, the
+        # KV cache, the prefix block pool and the fused programs' scan
+        # state are sharded over it via the model's logical axis rules
+        # (heads/mlp/vocab split across chips; KV heads split when
+        # n_kv_heads divides tp, replicated otherwise — see
+        # prune_rules_for_mesh). Host-side scheduling, the async
+        # pipeline and the single [H, B] transfer are tp-blind.
+        if tp is not None:
+            if mesh is not None:
+                raise ValueError("pass mesh= or tp=, not both")
+            if tp < 1:
+                raise ValueError("tp must be >= 1")
+            devs = jax.devices()
+            if tp > len(devs):
+                raise ValueError(
+                    f"tp={tp} exceeds the {len(devs)} visible "
+                    "device(s); on CPU force a virtual world with "
+                    "XLA_FLAGS=--xla_force_host_platform_device_count")
+            mesh = create_mesh({"tp": tp}, devs[:tp])
+        self.mesh = mesh
+        if mesh is not None:
+            if "tp" not in mesh.axis_names:
+                raise ValueError(
+                    "serving mesh needs a 'tp' axis, got axes "
+                    f"{mesh.axis_names}")
+            self.tp_degree = int(dict(mesh.shape)["tp"])
+            dims = {"heads": cfg.n_heads, "qkv": cfg.n_heads,
+                    "kv": cfg.n_kv_heads, "mlp": cfg.ffn_dim,
+                    "vocab": cfg.vocab_size, "embed": cfg.dim,
+                    "batch": self.B}
+            base = dict(DEFAULT_RULES)
+            base["kv"] = "tp"   # serving shards the KV-head axis; the
+            #                     training table replicates it
+            rules = (sharding_rules if sharding_rules is not None
+                     else prune_rules_for_mesh(base, mesh, dims))
+            self._rules = rules
+            self.params = shard_pytree(
+                params, llama_param_specs(cfg, rules), mesh)
+            self._shardings = _EngineShardings(
+                cache=named_sharding(mesh, "layers", "batch", "length",
+                                     "kv", "head_dim", rules=rules),
+                logits=named_sharding(mesh, "batch", "vocab",
+                                      rules=rules),
+                pool=named_sharding(mesh, "layers", None, None, "kv",
+                                    "head_dim", rules=rules))
+        else:
+            self.tp_degree = 1
+            self._rules = None
+            self._shardings = None
+        self.metrics.on_tp_degree(self.tp_degree)
+
+        self.cache = init_cache(
+            cfg, self.B, self.max_len,
+            sharding=None if self._shardings is None
+            else self._shardings.cache)
         # Next-token logits per slot, DEVICE-resident: prefill scatters
         # into it, the fused decode samples from and re-carries it —
         # logits never cross the jit boundary to the host.
         self._last_logits = jnp.zeros((self.B, cfg.vocab_size),
                                       jnp.float32)
+        if self._shardings is not None:
+            self._last_logits = jax.device_put(self._last_logits,
+                                               self._shardings.logits)
         self.row_len = np.zeros((self.B,), np.int32)   # written slots
         self.row_req: List[Optional[_Request]] = [None] * self.B
         self.row_budget = np.zeros((self.B,), np.int32)
@@ -535,6 +692,7 @@ class DecodeEngine:
         self.decode_dispatches = 0     # fused decode program launches
         self.prefill_dispatches = 0    # batched prefill launches
         self.host_syncs = 0            # device->host transfers
+        self.host_transfer_bytes = 0   # bytes those transfers moved
         self.tokens_out = 0            # tokens emitted, all requests
         # Prefill/prefix-reuse accounting (same plain-int discipline):
         self.prefill_real_tokens = 0   # true chunk tokens prefilled
@@ -583,6 +741,16 @@ class DecodeEngine:
                 (L, n_blocks, prefix_block, KV, D), kv_dtype)
             self._pool_v = jnp.zeros(
                 (L, n_blocks, prefix_block, KV, D), kv_dtype)
+            if self._shardings is not None:
+                # Pool lives on the mesh with the cache's KV sharding:
+                # each chip holds only its heads' slice of every block
+                # (prefix_cache_bytes stays the GLOBAL pool footprint;
+                # per-chip resident bytes are that / tp when KV
+                # shards).
+                self._pool_k = jax.device_put(self._pool_k,
+                                              self._shardings.pool)
+                self._pool_v = jax.device_put(self._pool_v,
+                                              self._shardings.pool)
             attach = getattr(self.scheduler, "attach_prefix_probe", None)
             if attach is not None:
                 attach(self._prefix_probe)
@@ -811,7 +979,7 @@ class DecodeEngine:
                 self.params, self.cache, self._last_logits, *args,
                 jnp.asarray(self._row_keys), self.temperature,
                 self.cfg, H, self.greedy, self.top_k, self.top_p,
-                self.eos_id)
+                self.eos_id, shardings=self._shardings)
         try:
             toks.copy_to_host_async()
         except AttributeError:
@@ -866,7 +1034,9 @@ class DecodeEngine:
         self._pl_depth_n += 1
         block = _device_get(entry.toks)
         self.host_syncs += 1
-        self.metrics.on_host_sync()
+        nbytes = int(getattr(block, "nbytes", block.size * 4))
+        self.host_transfer_bytes += nbytes
+        self.metrics.on_host_sync(nbytes=nbytes)
         self._emit_block(block, entry, emitted)
         self.metrics.on_pipeline_drain(depth, len(self._ring))
 
@@ -908,6 +1078,14 @@ class DecodeEngine:
         out["host_syncs"] = float(self.host_syncs)
         out["host_syncs_per_token"] = _ratio(self.host_syncs,
                                              self.tokens_out)
+        # Tensor-parallel plane: tp_degree is 1 for an unsharded
+        # engine; transfer bytes count the [H, B] token blocks pulled
+        # at drain — the replicated choke point, so bytes/token must
+        # NOT grow with tp degree (microbench gates this).
+        out["tp_degree"] = float(self.tp_degree)
+        out["host_transfer_bytes"] = float(self.host_transfer_bytes)
+        out["host_transfer_bytes_per_token"] = _ratio(
+            self.host_transfer_bytes, self.tokens_out)
         out["dispatches_per_token"] = _ratio(self.decode_dispatches,
                                              self.tokens_out)
         # Prefill efficiency: real suffix tokens vs bucket/pow2 filler.
@@ -1120,7 +1298,7 @@ class DecodeEngine:
             self.cache = _prefix_copy_in(
                 self.cache, self._pool_k, self._pool_v,
                 jnp.asarray(bids), jnp.asarray(rows), nbp,
-                self.prefix_block)
+                self.prefix_block, shardings=self._shardings)
             self.prefix_copy_dispatches += 1
 
     def _advance_prefills(self) -> None:
@@ -1164,7 +1342,8 @@ class DecodeEngine:
             self.cache, self._last_logits = _prefill_rows(
                 self.params, jnp.asarray(prompts), self.cache,
                 self._last_logits, jnp.asarray(rows),
-                jnp.asarray(starts), jnp.asarray(last_idx), self.cfg)
+                jnp.asarray(starts), jnp.asarray(last_idx), self.cfg,
+                shardings=self._shardings)
             self.prefill_dispatches += 1
             padded = n_pad * Cb - real
             self.prefill_real_tokens += real
@@ -1201,7 +1380,8 @@ class DecodeEngine:
             self._pool_k, self._pool_v = _prefix_copy_out(
                 self.cache["k"], self.cache["v"], self._pool_k,
                 self._pool_v, row,
-                run[0][0] * T, jnp.asarray(bids), nbp, T)
+                run[0][0] * T, jnp.asarray(bids), nbp, T,
+                shardings=self._shardings)
             self.prefix_copy_dispatches += 1
             for _, node in run:
                 self._prefix.commit(node)

@@ -251,6 +251,18 @@ class EngineMetrics:
             "llm_engine_host_lag_steps",
             "Fused decode steps dispatched but not yet replayed on "
             "the host (ring length after the last drain)")
+        # Tensor-parallel plane (PR: sharded engine over an ICI mesh):
+        self.tp_degree = 1
+        self.host_transfer_bytes = 0
+        self._m_tp_degree = gauge(
+            "llm_engine_tp_degree",
+            "Tensor-parallel degree of the serving mesh (1 = "
+            "unsharded single-chip engine)")
+        self._m_transfer_bytes = counter(
+            "llm_engine_host_transfer_bytes_total",
+            "Bytes moved device->host by the serving loop (drained "
+            "[H, B] token blocks — replicated, so per-token bytes do "
+            "not grow with tp degree)")
 
     # -- lifecycle hooks (called by DecodeEngine) --------------------------
 
@@ -362,13 +374,23 @@ class EngineMetrics:
             self._m_host_syncs.inc(host_syncs)
         self._m_horizon.observe(horizon)
 
-    def on_host_sync(self, n: int = 1) -> None:
+    def on_host_sync(self, n: int = 1, nbytes: int = 0) -> None:
         """A blocking device->host pull completed (a drained token
-        block). Decoupled from `on_dispatch` by the async pipeline —
-        dispatch happens up to `pipeline_depth` steps before its
-        block's sync; totals converge once the ring drains."""
+        block of `nbytes` bytes). Decoupled from `on_dispatch` by the
+        async pipeline — dispatch happens up to `pipeline_depth` steps
+        before its block's sync; totals converge once the ring
+        drains."""
         self.host_syncs += n
         self._m_host_syncs.inc(n)
+        if nbytes > 0:
+            self.host_transfer_bytes += nbytes
+            self._m_transfer_bytes.inc(nbytes)
+
+    def on_tp_degree(self, tp: int) -> None:
+        """Record the engine's tensor-parallel degree (once, at
+        construction)."""
+        self.tp_degree = int(tp)
+        self._m_tp_degree.set(float(tp))
 
     def on_pipeline_drain(self, depth: int, lag: int) -> None:
         """One in-flight block replayed: `depth` fused steps were in
@@ -451,6 +473,11 @@ class EngineMetrics:
         out["host_syncs_per_token"] = (
             self.host_syncs / self.tokens_generated
             if self.tokens_generated else 0.0)
+        out["tp_degree"] = self.tp_degree
+        out["host_transfer_bytes"] = self.host_transfer_bytes
+        out["host_transfer_bytes_per_token"] = (
+            self.host_transfer_bytes / self.tokens_generated
+            if self.tokens_generated else 0.0)
         out["dispatches_per_token"] = (
             self.decode_dispatches / self.tokens_generated
             if self.tokens_generated else 0.0)
@@ -505,7 +532,9 @@ class NullEngineMetrics:
 
     def on_dispatch(self, horizon, host_syncs=1): pass
 
-    def on_host_sync(self, n=1): pass
+    def on_host_sync(self, n=1, nbytes=0): pass
+
+    def on_tp_degree(self, tp): pass
 
     def on_pipeline_drain(self, depth, lag): pass
 

@@ -640,6 +640,17 @@ class LLMFleet:
                 (s.get("ttft_s_p95", 0.0) for s in per), default=0.0),
             "tpot_s_p95_max": max(
                 (s.get("tpot_s_p95", 0.0) for s in per), default=0.0),
+            # Tensor-parallel plane: replicas built by engine_factory
+            # may themselves be tp-sharded over an ICI mesh — the
+            # fleet then scales in units of whole meshes. Replicas are
+            # homogeneous in practice, so max == the fleet's tp; the
+            # per-replica view flows through each engine's own
+            # llm_engine_* series (and serve_llm_engine_* when a
+            # replica republishes via report_engine_stats).
+            "tp_degree_max": max(
+                (s.get("tp_degree", 1.0) for s in per), default=1.0),
+            "host_transfer_bytes": sum(
+                s.get("host_transfer_bytes", 0.0) for s in per),
         }
         out["router_affinity_wins"] = float(
             getattr(self.router, "affinity_wins", 0))

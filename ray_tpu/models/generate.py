@@ -26,12 +26,20 @@ Cache = Dict[str, jax.Array]  # {"k","v": [L, B, max_len, kv_heads, hd]}
 
 
 def init_cache(cfg: LlamaConfig, batch_size: int,
-               max_len: Optional[int] = None) -> Cache:
+               max_len: Optional[int] = None,
+               sharding=None) -> Cache:
+    """Zero KV cache ``[L, B, max_len, KV, D]``. ``sharding`` (an
+    optional `jax.sharding.Sharding`) commits both arrays to a device
+    mesh — the tensor-parallel engine shards the KV-head axis so each
+    chip holds only its heads' cache."""
     max_len = max_len or cfg.max_seq_len
     shape = (cfg.n_layers, batch_size, max_len, cfg.n_kv_heads,
              cfg.head_dim)
-    return {"k": jnp.zeros(shape, cfg.dtype),
-            "v": jnp.zeros(shape, cfg.dtype)}
+    cache = {"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+    if sharding is not None:
+        cache = {k: jax.device_put(v, sharding) for k, v in cache.items()}
+    return cache
 
 
 def _cached_attention(q, k_cache, v_cache, q_slots, kv_valid_len,

@@ -38,7 +38,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 def block_bytes(n_layers: int, block_tokens: int, kv_heads: int,
                 head_dim: int, dtype_bytes: int) -> int:
-    """Device bytes one cached block occupies (K and V)."""
+    """Device bytes one cached block occupies (K and V) — the GLOBAL
+    footprint across the serving mesh. On a tensor-parallel engine
+    whose KV-head axis shards over tp, each chip holds block_bytes/tp
+    of it; ``prefix_cache_bytes`` therefore sizes the pool in global
+    bytes at every tp degree (same block count, smaller per-chip
+    slice), so eviction behavior — and the emitted token stream — is
+    identical sharded or not."""
     return 2 * n_layers * block_tokens * kv_heads * head_dim * dtype_bytes
 
 

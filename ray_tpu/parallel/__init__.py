@@ -21,6 +21,7 @@ from ray_tpu.parallel.sharding import (
     LogicalAxisRules,
     DEFAULT_RULES,
     logical_to_mesh,
+    prune_rules_for_mesh,
     spec_for,
     shard_pytree,
     with_logical_constraint,
@@ -41,6 +42,7 @@ __all__ = [
     "LogicalAxisRules",
     "DEFAULT_RULES",
     "logical_to_mesh",
+    "prune_rules_for_mesh",
     "spec_for",
     "shard_pytree",
     "with_logical_constraint",

@@ -38,6 +38,46 @@ DEFAULT_RULES: LogicalAxisRules = {
 }
 
 
+def prune_rules_for_mesh(rules: LogicalAxisRules, mesh: Mesh,
+                         dim_sizes: Optional[Dict[str, int]] = None
+                         ) -> LogicalAxisRules:
+    """Restrict a rule table to what ``mesh`` can actually shard.
+
+    For each logical axis, keep only the mesh axes that exist in the
+    mesh with size > 1 AND — when ``dim_sizes`` knows the logical
+    dimension — whose cumulative product divides it evenly (GSPMD
+    requires even splits for donated buffers to keep their layout).
+    Axes that lose every mesh axis become None (replicate).
+
+    This is what lets one rule table serve both training and serving
+    meshes: on a pure ``{"tp": 4}`` inference mesh the training axes
+    (dp/fsdp/sp/...) vanish, and a model whose ``n_kv_heads`` is not
+    divisible by tp degrades to replicated KV while heads/mlp/vocab
+    still shard.
+    """
+    dim_sizes = dim_sizes or {}
+    out: LogicalAxisRules = {}
+    for logical, mesh_ax in rules.items():
+        if mesh_ax is None:
+            out[logical] = None
+            continue
+        axes = (mesh_ax,) if isinstance(mesh_ax, str) else tuple(mesh_ax)
+        size = dim_sizes.get(logical)
+        kept = []
+        prod = 1
+        for a in axes:
+            n = dict(mesh.shape).get(a, 1)
+            if n <= 1:
+                continue
+            if size is not None and size % (prod * n):
+                continue
+            kept.append(a)
+            prod *= n
+        out[logical] = (None if not kept
+                        else kept[0] if len(kept) == 1 else tuple(kept))
+    return out
+
+
 def logical_to_mesh(logical_axes: Sequence[Optional[str]],
                     rules: Optional[LogicalAxisRules] = None) -> P:
     """('batch','length','embed') -> PartitionSpec(('dp','fsdp'),'sp','fsdp')."""

@@ -117,7 +117,14 @@ def report_engine_stats(stats: Dict[str, float],
     engine-tagged but replica-blind; this is the deployment-tagged
     view. Gauges are cached per field, so per-step calls only pay a
     dict update. Outside a replica the gauges still record, just
-    without context tags (same contract as user serve metrics)."""
+    without context tags (same contract as user serve metrics).
+
+    Every NUMERIC stats field passes through — including the
+    tensor-parallel plane a sharded replica reports
+    (``serve_llm_engine_tp_degree``,
+    ``serve_llm_engine_host_transfer_bytes`` and its per-token ratio)
+    — so a fleet of tp-sharded replicas needs no extra wiring to get
+    per-replica mesh telemetry on the dashboard path."""
     for field, value in stats.items():
         if not isinstance(value, (int, float)):
             continue

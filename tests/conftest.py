@@ -53,6 +53,29 @@ def fake_clock():
     return FakeClock()
 
 
+# Multi-device pattern for sharded-engine tests: the session itself IS
+# the forced multi-device world — the XLA_FLAGS line above sets
+# --xla_force_host_platform_device_count=8 BEFORE jax initializes, so
+# every test process already sees 8 virtual CPU devices and a tp mesh
+# is just a subset of jax.devices(). No subprocess spawn is needed (the
+# re-exec pattern __graft_entry__._reexec_with_cpu_world uses exists
+# only for callers whose jax backend initialized BEFORE the flag could
+# be set — never the case under this conftest). New fixtures that need
+# devices should build on cpu_mesh_devices below, not re-exec.
+@pytest.fixture(scope="session")
+def tp_mesh(cpu_mesh_devices):
+    """Factory fixture: ``tp_mesh(n)`` -> a ``{"tp": n}`` serving mesh
+    over the first n virtual CPU devices, for DecodeEngine(mesh=...).
+    (Engines can also just take ``tp=n`` — the factory exists for
+    tests that pre-build or share a mesh across engines.)"""
+    from ray_tpu.parallel import create_mesh
+
+    def make(n: int):
+        return create_mesh({"tp": n}, cpu_mesh_devices[:n])
+
+    return make
+
+
 @pytest.fixture(scope="module")
 def ray_start_regular():
     import ray_tpu
