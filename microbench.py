@@ -382,6 +382,71 @@ def _prefix_admission_section(quick: bool) -> list:
     return results
 
 
+def _paged_gather_section(quick: bool) -> list:
+    """Block-table-gather overhead of paged attention
+    (ops/attention.py `paged_attention` vs the dense
+    `_cached_attention` it must stay in op-for-op lockstep with): per
+    max_len span, the wall ms of one fused decode-shaped attention
+    over (a) a contiguous dense cache row and (b) the same K/V read
+    through a per-row block table out of a 4x-oversized pool. The
+    delta is the pure cost of the paged indirection — the price the
+    engine pays per decode step for pool-bounded admission and
+    zero-copy prefix shares. Runs anywhere: on CPU both lower to the
+    same XLA reference einsums, so the gather overhead is the real
+    quantity measured; Mosaic kernels change the constant, not the
+    comparison's meaning."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.generate import _cached_attention
+    from ray_tpu.ops.attention import paged_attention
+
+    B, H, KV, D, T = 8, 4, 2, 16, 16
+    spans = (256,) if quick else (256, 1024)
+    results = []
+    for span in spans:
+        MB = span // T
+        NB = 4 * MB + 1                    # 4x oversized pool + null
+        key = jax.random.PRNGKey(span)
+        q = jax.random.normal(key, (B, 1, H, D), jnp.float32)
+        dense_k = jax.random.normal(key, (B, span, KV, D), jnp.float32)
+        dense_v = dense_k + 1.0
+        pool_k = jax.random.normal(key, (NB, T, KV, D), jnp.float32)
+        pool_v = pool_k + 1.0
+        # scattered tables: stride the pool so the gather is non-unit
+        bt = (1 + (jnp.arange(B * MB) * 7) % (NB - 1)).reshape(B, MB)
+        bt = bt.astype(jnp.int32)
+        slots = jnp.full((B, 1), span - 1, jnp.int32)
+
+        dense_fn = jax.jit(lambda q, k, v: _cached_attention(
+            q, k, v, slots, span, None))
+        paged_fn = jax.jit(lambda q, k, v: paged_attention(
+            q, k, v, bt, slots, kv_valid_len=span))
+        dense_fn(q, dense_k, dense_v).block_until_ready()
+        paged_fn(q, pool_k, pool_v).block_until_ready()
+
+        def run(fn, *args):
+            ts = []
+            for _ in range(TRIALS):
+                t0 = time.perf_counter()
+                for _ in range(20):
+                    out = fn(*args)
+                out.block_until_ready()
+                ts.append((time.perf_counter() - t0) / 20 * 1000)
+            return statistics.median(ts)
+
+        d_ms = run(dense_fn, q, dense_k, dense_v)
+        p_ms = run(paged_fn, q, pool_k, pool_v)
+        results.append((f"paged_attention_dense_ms_s{span}", d_ms,
+                        "ms"))
+        results.append((f"paged_attention_paged_ms_s{span}", p_ms,
+                        "ms"))
+        results.append((f"paged_attention_gather_overhead_pct_s{span}",
+                        (p_ms - d_ms) / d_ms * 100.0 if d_ms else 0.0,
+                        "%"))
+    return results
+
+
 def _fleet_router_section(quick: bool) -> list:
     """Per-decision cost of the fleet routers (models/fleet.py): the
     wall microseconds one `submit()` spends choosing a replica, per
@@ -451,6 +516,9 @@ def main(quick: bool = False):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     for name, value, unit in _prefix_admission_section(quick):
+        print(json.dumps({"metric": name, "value": round(value, 4),
+                          "unit": unit}), flush=True)
+    for name, value, unit in _paged_gather_section(quick):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     for name, value, unit in _fleet_router_section(quick):

@@ -67,6 +67,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu.models.block_pool import BlockPool
 from ray_tpu.models.engine_metrics import EngineMetrics, NullEngineMetrics
 from ray_tpu.models.generate import (_check_sampling_knobs,
                                      _layer_body, forward_cached_rows,
@@ -74,6 +75,7 @@ from ray_tpu.models.generate import (_check_sampling_knobs,
 from ray_tpu.models.llama import (LlamaConfig, _rmsnorm,
                                   llama_param_specs)
 from ray_tpu.models.prefix_cache import PrefixCacheIndex, block_bytes
+from ray_tpu.ops.attention import paged_attention
 from ray_tpu.models.scheduler import (EngineDraining, EngineOverloaded,
                                       SchedulerPolicy, make_policy)
 from ray_tpu.parallel.mesh import create_mesh
@@ -414,12 +416,247 @@ def _decode_multi(params: Params, cache, last_logits, row_len, active,
 
 
 # ---------------------------------------------------------------------------
+# Compiled programs — paged KV mode
+# ---------------------------------------------------------------------------
+# The paged engine has NO dense per-slot cache: every request's K/V
+# lives in fixed-size token blocks of ONE device pool
+# [L, NB, T, KV, D] (the same pool the prefix cache commits into) and
+# each program reaches it through the per-row block table bt [B, MB].
+# MB * T == max_len is enforced at construction, so the gathered
+# per-row view has EXACTLY the dense cache row's shape and every
+# program below is the dense program evaluated on that view — which is
+# what makes paged output bit-identical to the dense engine and to
+# solo `generate` (tests/test_engine_paged.py). Block id 0 is the
+# reserved null block: unallocated table entries point at it, padded
+# gathers/scatters dump garbage into it, and no mask ever admits it.
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "shardings"),
+                   donate_argnames=("pool_k", "pool_v", "last_logits"))
+def _prefill_rows_paged(params: Params, prompts: jax.Array, pool_k,
+                        pool_v, last_logits, bt: jax.Array,
+                        rows: jax.Array, starts: jax.Array,
+                        last_idx: jax.Array, cfg: LlamaConfig,
+                        shardings: Optional[_EngineShardings] = None):
+    """`_prefill_rows` for the block pool: gather each admission row's
+    full [max_len] view through its block table, run the SAME
+    `forward_cached_rows` math, scatter the view back block-by-block.
+    One program per length bucket, zero host round-trips, and —
+    because MB*T == max_len — the exact op sequence of the dense
+    prefill on identical shapes.
+
+    The whole-view write-back is safe by construction: each row only
+    MODIFIES view slots [start, start+S) (its own private suffix
+    blocks — shared prefix blocks sit strictly below `start`, so they
+    are rewritten with the unmodified gathered bytes), duplicate
+    block-table entries across rows are either shared blocks (same
+    bytes) or the null block (garbage nobody reads), and duplicate
+    padded rows repeat the last admission verbatim."""
+    blk_k = pool_k[:, bt]                  # [L, N, MB, T, KV, D]
+    blk_v = pool_v[:, bt]
+    if shardings is not None:
+        # Same chip-local discipline as _prefix_copy_in: the gathered
+        # view carries the pool's KV-head sharding.
+        sp = shardings.pool.spec           # (l, nb, t, kv, d)
+        blk_spec = NamedSharding(
+            shardings.pool.mesh, P(sp[0], None, sp[1], sp[2], sp[3],
+                                   sp[4]))
+        blk_k = jax.lax.with_sharding_constraint(blk_k, blk_spec)
+        blk_v = jax.lax.with_sharding_constraint(blk_v, blk_spec)
+    L, N, MB, T = blk_k.shape[:4]
+    row_cache = {
+        "k": blk_k.reshape(L, N, MB * T, *blk_k.shape[4:]),
+        "v": blk_v.reshape(L, N, MB * T, *blk_v.shape[4:]),
+    }
+    logits, row_cache = forward_cached_rows(params, prompts, row_cache,
+                                            starts, cfg)
+    k = row_cache["k"].reshape(L, N, MB, T, *blk_k.shape[4:])
+    v = row_cache["v"].reshape(L, N, MB, T, *blk_v.shape[4:])
+    pool_k = pool_k.at[:, bt].set(k.astype(pool_k.dtype))
+    pool_v = pool_v.at[:, bt].set(v.astype(pool_v.dtype))
+    n = prompts.shape[0]
+    last = logits[jnp.arange(n), last_idx]              # [N, vocab]
+    out_logits = last_logits.at[rows].set(last)
+    if shardings is not None:
+        pool_k = jax.lax.with_sharding_constraint(pool_k, shardings.pool)
+        pool_v = jax.lax.with_sharding_constraint(pool_v, shardings.pool)
+        out_logits = jax.lax.with_sharding_constraint(
+            out_logits, shardings.logits)
+    return pool_k, pool_v, out_logits
+
+
+def _decode_layer_rows_paged(h, layer, k_pages, v_pages, bt,
+                             write_slots, cfg: LlamaConfig):
+    """`_decode_layer_rows` against the pool: row b's new K/V scatter
+    into physical block ``bt[b, slot//T]`` at offset ``slot%T`` and
+    attention reads back through `ops.attention.paged_attention` (the
+    block-table gather + `_cached_attention`'s exact op sequence).
+    Frontier blocks are always private to their row — a shared block
+    is never a write target (full-prompt prefix hits copy-on-write
+    their tail block at admission) — so the scatter pairs are unique
+    across live rows; retired/empty rows scatter garbage into the
+    null block."""
+    B = h.shape[0]
+    bidx = jnp.arange(B)
+    T = k_pages.shape[1]
+    span = bt.shape[1] * T                 # == engine max_len
+    blk = bt[bidx, write_slots // T]       # [B] physical frontier block
+    off = write_slots % T
+
+    def write_kv(k_pages, v_pages, k, v):
+        k_pages = k_pages.at[blk, off].set(k[:, 0].astype(k_pages.dtype))
+        v_pages = v_pages.at[blk, off].set(v[:, 0].astype(v_pages.dtype))
+        return k_pages, v_pages
+
+    def attend(q, k_pages, v_pages):
+        return paged_attention(q, k_pages, v_pages, bt,
+                               write_slots[:, None], kv_valid_len=span)
+
+    return _layer_body(h, layer, k_pages, v_pages, write_slots[:, None],
+                       write_kv, write_slots[:, None], span, cfg,
+                       attend=attend)
+
+
+def _decode_core_paged(params: Params, toks: jax.Array, pool_k, pool_v,
+                       bt, row_len, cfg: LlamaConfig):
+    """`_decode_core` over the pool: the layer scan unstacks the pool's
+    layer axis exactly as the dense scan unstacks the cache's. Plain
+    function so `_decode_multi_paged`'s scan can inline it."""
+    write_slots = row_len                                   # [B]
+    h = params["tok_embed"].astype(cfg.dtype)[toks[:, None]]
+
+    def body(carry, xs):
+        h = carry
+        layer, k_p, v_p = xs
+        h, k_p, v_p = _decode_layer_rows_paged(h, layer, k_p, v_p, bt,
+                                               write_slots, cfg)
+        return h, (k_p, v_p)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        body, h, (params["layers"], pool_k, pool_v))
+    h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", h,
+                        params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits[:, 0], k_new, v_new
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "horizon", "greedy",
+                                    "top_k", "top_p", "eos_id",
+                                    "shardings"),
+                   donate_argnames=("pool_k", "pool_v", "last_logits"))
+def _decode_multi_paged(params: Params, pool_k, pool_v, bt,
+                        last_logits, row_len, active, budget, tok_idx,
+                        row_keys, temperature, cfg: LlamaConfig,
+                        horizon: int, greedy: bool,
+                        top_k: Optional[int], top_p: Optional[float],
+                        eos_id: Optional[int],
+                        shardings: Optional[_EngineShardings] = None):
+    """`_decode_multi` with the pool + block tables standing in for
+    the dense cache: identical scan body, identical per-iteration
+    transition, identical [H, B] single-transfer contract — only the
+    KV write (block scatter) and the attention read (block-table
+    gather) differ, both inside `_decode_core_paged`. The block table
+    is a step invariant: the host grows/rebuilds it between
+    dispatches, never inside one."""
+    max_len = bt.shape[1] * pool_k.shape[2]
+
+    def body(carry, _):
+        pool_k, pool_v, last_logits, row_len, active, budget, \
+            tok_idx = carry
+        tok = sample_rows(last_logits, row_keys, tok_idx,
+                          greedy=greedy, temperature=temperature,
+                          top_k=top_k, top_p=top_p)
+        emit = jnp.where(active, tok, -1)
+        live = active.astype(jnp.int32)
+        budget = budget - live
+        tok_idx = tok_idx + live
+        done_now = (budget <= 0) | (row_len + 1 >= max_len)
+        if eos_id is not None:
+            done_now = done_now | (tok == eos_id)
+        cont = active & ~done_now
+        logits, pool_k, pool_v = _decode_core_paged(
+            params, tok, pool_k, pool_v, bt, row_len, cfg)
+        row_len = row_len + cont.astype(jnp.int32)
+        last_logits = jnp.where(cont[:, None], logits, last_logits)
+        if shardings is not None:
+            pool_k = jax.lax.with_sharding_constraint(
+                pool_k, shardings.pool)
+            pool_v = jax.lax.with_sharding_constraint(
+                pool_v, shardings.pool)
+            last_logits = jax.lax.with_sharding_constraint(
+                last_logits, shardings.logits)
+        return (pool_k, pool_v, last_logits, row_len, cont, budget,
+                tok_idx), emit
+
+    (pool_k, pool_v, last_logits, row_len, active, budget, tok_idx), \
+        toks = jax.lax.scan(
+            body, (pool_k, pool_v, last_logits, row_len, active,
+                   budget, tok_idx),
+            None, length=horizon)
+    if shardings is not None:
+        toks = jax.lax.with_sharding_constraint(
+            toks, shardings.replicated)
+    return (toks, pool_k, pool_v, last_logits, row_len, active,
+            budget, tok_idx)
+
+
+@functools.partial(jax.jit, static_argnames=("shardings",),
+                   donate_argnames=("pool_k", "pool_v"))
+def _cow_blocks(pool_k, pool_v, src: jax.Array, dst: jax.Array,
+                shardings: Optional[_EngineShardings] = None):
+    """Copy-on-write block duplication: ONE program copies every
+    (src -> dst) pair of this admission round. Dispatched when a warm
+    admission matched its FULL prompt — the tail block must still grow
+    the row's generated tokens, so the row gets a private copy instead
+    of a share (every non-tail matched block stays zero-copy). src/dst
+    are power-of-two padded with (0, 0): null -> null, harmless."""
+    pool_k = pool_k.at[:, dst].set(pool_k[:, src])
+    pool_v = pool_v.at[:, dst].set(pool_v[:, src])
+    if shardings is not None:
+        pool_k = jax.lax.with_sharding_constraint(pool_k, shardings.pool)
+        pool_v = jax.lax.with_sharding_constraint(pool_v, shardings.pool)
+    return pool_k, pool_v
+
+
+@functools.partial(jax.jit, static_argnames=("shardings",))
+def _swap_out_gather(pool_k, pool_v, block_ids: jax.Array,
+                     shardings: Optional[_EngineShardings] = None):
+    """Gather a preemption victim's blocks [L, n, T, KV, D] out of the
+    pool into fresh buffers. The caller issues `copy_to_host_async` on
+    the result and drops the device reference once the host copy
+    lands, so the victim's HBM is actually reclaimed. block_ids is
+    power-of-two padded with the null block (its garbage rides along
+    and is scattered straight back at swap-in)."""
+    return pool_k[:, block_ids], pool_v[:, block_ids]
+
+
+@functools.partial(jax.jit, static_argnames=("shardings",),
+                   donate_argnames=("pool_k", "pool_v"))
+def _swap_in_scatter(pool_k, pool_v, host_k, host_v,
+                     block_ids: jax.Array,
+                     shardings: Optional[_EngineShardings] = None):
+    """Scatter a swapped-out request's host K/V into a freshly
+    allocated block chain — the other half of preempt-and-swap. The
+    new physical block ids need not match the old ones: the block
+    table indirection is what makes the bytes land logically where
+    they were."""
+    pool_k = pool_k.at[:, block_ids].set(host_k.astype(pool_k.dtype))
+    pool_v = pool_v.at[:, block_ids].set(host_v.astype(pool_v.dtype))
+    if shardings is not None:
+        pool_k = jax.lax.with_sharding_constraint(pool_k, shardings.pool)
+        pool_v = jax.lax.with_sharding_constraint(pool_v, shardings.pool)
+    return pool_k, pool_v
+
+
+# ---------------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------------
 
 class _Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "tokens", "done",
-                 "priority", "seq", "rng", "deadline", "shed")
+                 "priority", "seq", "rng", "deadline", "shed", "resume")
 
     def __init__(self, req_id: int, prompt: List[int],
                  max_new_tokens: int, priority: int = 0, seq: int = 0,
@@ -435,6 +672,7 @@ class _Request:
         self.rng = rng              # [2] uint32 per-request key stream
         self.deadline = deadline    # absolute clock time; None = no SLO
         self.shed = False           # retired past-deadline, no prefill run
+        self.resume = False         # preempted; re-queued to swap back in
 
 
 class _PrefillState:
@@ -443,14 +681,46 @@ class _PrefillState:
     ``pos`` is the row's prefill frontier: slots [0, pos) hold valid
     K/V (copied prefix + completed chunks). ``nodes`` are the PENDING
     trie nodes this row's prefill will fill — each is copied out to the
-    pool and committed as soon as the frontier covers its block."""
+    pool and committed as soon as the frontier covers its block.
+    ``prompt`` is the token sequence being prefilled — the request's
+    prompt, except for a preempt="recompute" re-admission, which
+    replays prompt + already-emitted tokens (same K/V, recomputed)."""
 
-    __slots__ = ("req", "pos", "nodes")
+    __slots__ = ("req", "pos", "nodes", "prompt")
 
-    def __init__(self, req: _Request, pos: int, nodes: list):
+    def __init__(self, req: _Request, pos: int, nodes: list,
+                 prompt: Optional[List[int]] = None):
         self.req = req
         self.pos = pos
         self.nodes = nodes
+        self.prompt = req.prompt if prompt is None else prompt
+
+
+class _SwapState:
+    """A preempted request's spilled decode state (paged engine).
+
+    ``k``/``v`` are HOST copies of the victim's gathered blocks
+    [L, nbp, T, KV, D] — `copy_to_host_async` overlaps the pull, and
+    dropping the device reference is what actually returns the HBM.
+    They are None under preempt="recompute", where re-admission
+    re-prefills prompt + emitted tokens instead of scattering bytes
+    back. ``row_len``/``tok_idx``/``budget``/``logits`` restore the
+    row exactly where it froze; the token stream then continues
+    bit-identically because `step_rng_key` depends only on the
+    request's key and tok_idx — never on which row or which step."""
+
+    __slots__ = ("k", "v", "n_blocks", "row_len", "tok_idx", "budget",
+                 "logits")
+
+    def __init__(self, k, v, n_blocks: int, row_len: int, tok_idx: int,
+                 budget: int, logits):
+        self.k = k
+        self.v = v
+        self.n_blocks = n_blocks
+        self.row_len = row_len
+        self.tok_idx = tok_idx
+        self.budget = budget
+        self.logits = logits
 
 
 class _InflightStep:
@@ -560,6 +830,10 @@ class DecodeEngine:
                  prefix_block: int = 32,
                  prefix_cache_bytes: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
+                 paged: bool = False,
+                 kv_block_tokens: Optional[int] = None,
+                 kv_pool_bytes: Optional[int] = None,
+                 preempt: str = "swap",
                  mesh: Optional[Mesh] = None,
                  tp: Optional[int] = None,
                  sharding_rules=None,
@@ -582,6 +856,11 @@ class DecodeEngine:
             raise ValueError("prefix_block must be >= 1")
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
+        if preempt not in ("swap", "recompute"):
+            raise ValueError(f"preempt must be 'swap' or 'recompute', "
+                             f"got {preempt!r}")
+        if kv_block_tokens is not None and kv_block_tokens < 1:
+            raise ValueError("kv_block_tokens must be >= 1")
         self.params = params
         self.cfg = cfg
         self.B = batch_slots
@@ -663,7 +942,23 @@ class DecodeEngine:
             self._shardings = None
         self.metrics.on_tp_degree(self.tp_degree)
 
-        self.cache = init_cache(
+        # Paged KV mode: no dense per-slot cache at all — every row's
+        # K/V lives in pool blocks behind its block table (state built
+        # below, after this shared row bookkeeping). The dense engine
+        # keeps its [L, B, max_len, KV, D] cache unchanged.
+        self.paged = paged
+        self.preempt_mode = preempt
+        self.kv_block_tokens = (kv_block_tokens
+                                if kv_block_tokens is not None
+                                else prefix_block)
+        if paged and self.max_len % self.kv_block_tokens:
+            raise ValueError(
+                f"paged engine needs max_len ({self.max_len}) "
+                f"divisible by kv_block_tokens "
+                f"({self.kv_block_tokens}): the block view must span "
+                "exactly the dense cache row so paged attention is "
+                "bit-identical to the dense path")
+        self.cache = None if paged else init_cache(
             cfg, self.B, self.max_len,
             sharding=None if self._shardings is None
             else self._shardings.cache)
@@ -703,6 +998,15 @@ class DecodeEngine:
         self.prefix_evictions = 0      # LRU blocks recycled
         self.prefix_copy_dispatches = 0  # pool copy-in/out launches
         self.chunked_prefill_stalls = 0  # steps with a row mid-prefill
+        # Paged-KV plane (plain ints; identically zero on the dense
+        # engine so fleet rollups can sum them blindly):
+        self.kv_blocks_shared = 0      # warm-admission zero-copy shares
+        self.kv_block_cows = 0         # tail blocks duplicated on write
+        self.preemptions = 0           # rows evicted mid-decode
+        self.swap_ins = 0              # preempted rows re-admitted
+        self.swap_outs = 0             # swap-mode spills to host
+        self.swap_in_bytes = 0         # host->device swap traffic
+        self.swap_out_bytes = 0        # device->host swap traffic
         # Async pipeline: dispatched-but-undrained fused steps, oldest
         # first. Same plain-int discipline for the counters so
         # enable_metrics=False benches still report the pipeline plane.
@@ -720,21 +1024,57 @@ class DecodeEngine:
         self._row_prefill: Dict[int, _PrefillState] = {}
 
         # Shared-prefix KV cache: host-side radix index over committed
-        # prompt blocks + a device-resident pool the copy programs
-        # gather from / scatter into. Sized by prefix_cache_bytes
-        # (default: room for 2 full batches of max_len tokens), plus
-        # the reserved scratch block 0.
-        self.prefix_block = prefix_block
-        if prefix_cache:
-            L, _, _, KV, D = self.cache["k"].shape
-            kv_dtype = self.cache["k"].dtype
-            bb = block_bytes(L, prefix_block, KV, D,
-                             jnp.dtype(kv_dtype).itemsize)
+        # prompt blocks + a device-resident pool. Dense mode keeps the
+        # PR-4 copy-in/copy-out pool, sized by prefix_cache_bytes
+        # (default: room for 2 full batches of max_len tokens) plus
+        # the reserved scratch block 0. Paged mode has ONE pool for
+        # everything — live rows' K/V and the prefix cache are the
+        # same refcounted blocks, so the trie indexes the pool
+        # directly and a warm admission SHARES blocks instead of
+        # copying them.
+        self.prefix_block = (self.kv_block_tokens if paged
+                             else prefix_block)
+        self._prefix: Optional[PrefixCacheIndex] = None
+        self.kv_pool: Optional[BlockPool] = None
+        L, KV, D = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        kv_dtype = jnp.dtype(cfg.dtype)
+        if paged:
+            T = self.prefix_block
+            bb = block_bytes(L, T, KV, D, kv_dtype.itemsize)
+            budget_bytes = (kv_pool_bytes if kv_pool_bytes is not None
+                            else prefix_cache_bytes)
+            if budget_bytes is None:
+                # Default: the dense engine's footprint — room for two
+                # full batches of max_len tokens.
+                n_blocks = 1 + (2 * self.B * self.max_len) // T
+            else:
+                n_blocks = 1 + budget_bytes // bb
+            self._mb = self.max_len // T   # block-table width
+            self.kv_pool = BlockPool(n_blocks)
+            self._bt = np.zeros((self.B, self._mb), np.int32)
+            self._row_blocks: List[List[int]] = [
+                [] for _ in range(self.B)]
+            self._swapped: Dict[int, _SwapState] = {}
+            self._admit_seq = 0            # preemption recency order
+            self._row_admit_seq = np.zeros((self.B,), np.int64)
+            self._pool_k = jnp.zeros((L, n_blocks, T, KV, D), kv_dtype)
+            self._pool_v = jnp.zeros((L, n_blocks, T, KV, D), kv_dtype)
+            if self._shardings is not None:
+                self._pool_k = jax.device_put(self._pool_k,
+                                              self._shardings.pool)
+                self._pool_v = jax.device_put(self._pool_v,
+                                              self._shardings.pool)
+            if prefix_cache:
+                self._prefix = PrefixCacheIndex(
+                    block_tokens=T, n_blocks=n_blocks,
+                    on_evict=self._on_prefix_evict, pool=self.kv_pool)
+        elif prefix_cache:
+            bb = block_bytes(L, prefix_block, KV, D, kv_dtype.itemsize)
             if prefix_cache_bytes is None:
                 n_blocks = 1 + (2 * self.B * self.max_len) // prefix_block
             else:
                 n_blocks = 1 + prefix_cache_bytes // bb
-            self._prefix: Optional[PrefixCacheIndex] = PrefixCacheIndex(
+            self._prefix = PrefixCacheIndex(
                 block_tokens=prefix_block, n_blocks=n_blocks,
                 on_evict=self._on_prefix_evict)
             self._pool_k = jnp.zeros(
@@ -751,12 +1091,12 @@ class DecodeEngine:
                                               self._shardings.pool)
                 self._pool_v = jax.device_put(self._pool_v,
                                               self._shardings.pool)
+        else:
+            self._pool_k = self._pool_v = None
+        if self._prefix is not None:
             attach = getattr(self.scheduler, "attach_prefix_probe", None)
             if attach is not None:
                 attach(self._prefix_probe)
-        else:
-            self._prefix = None
-            self._pool_k = self._pool_v = None
 
     # -- public API --------------------------------------------------------
 
@@ -791,6 +1131,9 @@ class DecodeEngine:
             raise EngineDraining(
                 "engine is draining (begin_drain was called): it will "
                 "finish in-flight work but accepts no new requests")
+        # Normalise to plain ints: device arrays make unusable
+        # prefix-trie keys (unhashable) and unreliable equality checks.
+        prompt = [int(t) for t in prompt]
         if not len(prompt):
             raise ValueError("empty prompt: need at least one token "
                              "(prepend a BOS token)")
@@ -799,6 +1142,19 @@ class DecodeEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds engine max_len "
                 f"{self.max_len}")
+        if self.paged:
+            # A request must fit the pool ALONE in the worst case
+            # (every other row preempted, every cold prefix block
+            # evicted) or it could never complete.
+            T = self.prefix_block
+            need = -(-(len(prompt) + max_new_tokens) // T)
+            if need > self.kv_pool.blocks_total:
+                raise ValueError(
+                    f"request needs {need} KV blocks ({len(prompt)} "
+                    f"prompt + {max_new_tokens} new tokens at "
+                    f"{T} tokens/block) but the pool holds only "
+                    f"{self.kv_pool.blocks_total}; raise "
+                    "kv_pool_bytes or shrink the request")
         deadline = (None if deadline_s is None
                     else self._clock() + deadline_s)
         if deadline is not None and self._clock() >= deadline:
@@ -890,12 +1246,24 @@ class DecodeEngine:
                     deferred = True  # prefix policy deferred the queue
                     break
                 if cand.deadline is not None and \
-                        self._clock() >= cand.deadline:
+                        self._clock() >= cand.deadline and \
+                        not cand.resume:
                     # Expired mid-queue: shed at the admission gate —
                     # the last moment before prefill compute would be
                     # committed to a request nobody is waiting for.
+                    # A PREEMPTED request is exempt: it was already
+                    # admitted once, and admitted requests run to
+                    # completion.
                     self._shed(cand)
                     continue
+                if self.paged and not self._fits_now(cand):
+                    # No room even counting evictable cold prefix
+                    # blocks: capacity, not order, is the constraint —
+                    # stop admitting this step and retry when decode
+                    # retirements free blocks.
+                    self._requeue_front(cand)
+                    deferred = True
+                    break
                 req = cand
                 break
             if req is None:
@@ -940,6 +1308,12 @@ class DecodeEngine:
                 # remainder.
                 H = min(H, int(self.row_budget[decodable].max()))
                 H = 1 << max(0, H.bit_length() - 1)
+            if self.paged:
+                # Grow every decodable row's chain to cover the
+                # horizon, preempting victims if the pool runs dry —
+                # admission capacity is pool bytes, not slots, so
+                # over-admission is resolved here, not refused there.
+                decodable, H = self._reserve_decode_blocks(decodable, H)
             self._dispatch_decode(H, decodable, chain=None)
         self._top_up_pipeline(decodable, horizon)
         self._drain_one(emitted)
@@ -953,6 +1327,10 @@ class DecodeEngine:
         self.metrics.on_step(
             sum(r is not None for r in self.row_req),
             len(self.scheduler), n_tokens)
+        if self.paged:
+            self.metrics.on_kv_pool(self.kv_pool.blocks_total,
+                                    self.kv_pool.blocks_in_use,
+                                    self.kv_pool.free_blocks)
         return emitted
 
     # -- async pipeline ----------------------------------------------------
@@ -974,12 +1352,29 @@ class DecodeEngine:
                     jnp.asarray(self._tok_idx))
         else:
             args = chain
-        toks, self.cache, self._last_logits, rl, ac, bu, ti = \
-            _decode_multi(
-                self.params, self.cache, self._last_logits, *args,
-                jnp.asarray(self._row_keys), self.temperature,
-                self.cfg, H, self.greedy, self.top_k, self.top_p,
-                self.eos_id, shardings=self._shardings)
+        if self.paged:
+            # Snapshot the block table at dispatch: jnp.asarray copies
+            # it to device, so host-side growth between chained
+            # dispatches only reaches FUTURE dispatches (in-flight
+            # steps never read past the coverage they were reserved).
+            bt_dev = jnp.asarray(self._bt)
+            if self._shardings is not None:
+                bt_dev = jax.device_put(bt_dev,
+                                        self._shardings.replicated)
+            (toks, self._pool_k, self._pool_v, self._last_logits,
+             rl, ac, bu, ti) = _decode_multi_paged(
+                self.params, self._pool_k, self._pool_v, bt_dev,
+                self._last_logits, *args, jnp.asarray(self._row_keys),
+                self.temperature, self.cfg, H, self.greedy,
+                self.top_k, self.top_p, self.eos_id,
+                shardings=self._shardings)
+        else:
+            toks, self.cache, self._last_logits, rl, ac, bu, ti = \
+                _decode_multi(
+                    self.params, self.cache, self._last_logits, *args,
+                    jnp.asarray(self._row_keys), self.temperature,
+                    self.cfg, H, self.greedy, self.top_k, self.top_p,
+                    self.eos_id, shardings=self._shardings)
         try:
             toks.copy_to_host_async()
         except AttributeError:
@@ -1019,6 +1414,12 @@ class DecodeEngine:
                     max_horizon=self.decode_horizon)
                 Hn = min(Hn, rem)
                 Hn = 1 << max(0, Hn.bit_length() - 1)
+            if self.paged and not self._ensure_decode_blocks(
+                    rows, Hn, inflight):
+                # Pool dry: no run-ahead. Preemption needs replayed
+                # host state, so it only runs on the primary dispatch
+                # path once the ring empties.
+                break
             self._dispatch_decode(Hn, rows,
                                   chain=self._ring[-1].chain)
 
@@ -1125,6 +1526,27 @@ class DecodeEngine:
         if self._prefix is not None:
             out["prefix_blocks_in_use"] = float(self._prefix.blocks_in_use)
             out["prefix_blocks_total"] = float(self._prefix.blocks_total)
+        # Paged-KV plane: zero-copy sharing, CoW, preempt-and-swap.
+        # Counters are identically 0.0 on the dense engine so fleet
+        # rollups sum them without mode checks.
+        out["paged"] = 1.0 if self.paged else 0.0
+        out["kv_blocks_shared"] = float(self.kv_blocks_shared)
+        out["kv_block_cows"] = float(self.kv_block_cows)
+        out["preemptions"] = float(self.preemptions)
+        out["swap_ins"] = float(self.swap_ins)
+        out["swap_outs"] = float(self.swap_outs)
+        out["swap_in_bytes"] = float(self.swap_in_bytes)
+        out["swap_out_bytes"] = float(self.swap_out_bytes)
+        out["kv_used_fraction"] = self.kv_used_fraction()
+        if self.paged:
+            pool = self.kv_pool
+            out["kv_pool_blocks_total"] = float(pool.blocks_total)
+            out["kv_pool_blocks_in_use"] = float(pool.blocks_in_use)
+            out["kv_pool_blocks_free"] = float(pool.free_blocks)
+            out["kv_pool_occupancy"] = _ratio(pool.blocks_in_use,
+                                              pool.blocks_total)
+            out["kv_free_blocks"] = float(self.kv_free_blocks())
+            out["requests_swapped"] = float(len(self._swapped))
         return out
 
     def run(self) -> Dict[int, List[int]]:
@@ -1173,16 +1595,48 @@ class DecodeEngine:
         count (zero device syncs) — the fleet router's per-replica
         cost signal: a replica may show free slots yet owe seconds of
         prefill to requests ahead of the newcomer."""
-        n = sum(len(st.req.prompt) - st.pos
+        n = sum(len(st.prompt) - st.pos
                 for st in self._row_prefill.values())
         queued = getattr(self.scheduler, "queued_requests", None)
         if queued is not None:
             try:
                 for r in queued():
+                    swap = (self._swapped.get(r.req_id)
+                            if self.paged else None)
+                    if swap is not None and swap.k is not None:
+                        continue   # swap-in is a scatter, no prefill owed
+                    if swap is not None:
+                        n += len(r.prompt) + len(r.tokens)  # replay
+                        continue
                     n += len(r.prompt)
             except NotImplementedError:
                 pass     # custom policy without the probe: slots-only
         return n
+
+    def kv_free_blocks(self) -> int:
+        """KV blocks an admission could claim right now: free +
+        evictable cold prefix blocks. 0 for the dense engine (no
+        pool) — the router falls back to `kv_used_fraction`. Pure
+        host arithmetic, zero device syncs."""
+        if not self.paged:
+            return 0
+        n = self.kv_pool.free_blocks
+        if self._prefix is not None:
+            n += self._prefix.evictable_blocks()
+        return n
+
+    def kv_used_fraction(self) -> float:
+        """Unreclaimable KV pressure in [0, 1] — the fleet router's
+        occupancy signal. Paged: fraction of pool blocks neither free
+        nor evictable-cold. Dense: live slots / batch slots (each
+        live slot pins a full max_len cache row, so slot occupancy IS
+        KV occupancy there)."""
+        if self.paged:
+            total = self.kv_pool.blocks_total
+            if not total:
+                return 1.0
+            return max(0.0, 1.0 - self.kv_free_blocks() / total)
+        return sum(r is not None for r in self.row_req) / self.B
 
     def prefix_match_tokens(self, prompt: List[int]) -> int:
         """Prompt tokens this engine could COPY from its prefix pool
@@ -1253,7 +1707,12 @@ class DecodeEngine:
         across steps — runs in `_advance_prefills`. First tokens are
         NOT sampled here: each row's last-prompt logits stay on device
         in `_last_logits` and the fused decode samples them — admission
-        costs zero host round-trips."""
+        costs zero host round-trips. The paged engine admits through
+        `_admit_rows_paged` instead: matched blocks are SHARED (incref,
+        zero copies), not copied."""
+        if self.paged:
+            self._admit_rows_paged(admissions)
+            return
         copy_groups: Dict[int, List[Tuple[int, List[int]]]] = {}
         for row, req in admissions:
             self.metrics.on_admit(req.req_id)   # queue wait ends here
@@ -1301,6 +1760,334 @@ class DecodeEngine:
                 self.prefix_block, shardings=self._shardings)
             self.prefix_copy_dispatches += 1
 
+    # -- paged KV: admission, block accounting, preempt-and-swap -----------
+
+    def _admit_rows_paged(
+            self, admissions: List[Tuple[int, _Request]]) -> None:
+        """Paged admission: bind each request to a BLOCK CHAIN instead
+        of a cache row. A warm prompt's matched blocks are shared by
+        incref — zero bytes move, the PR-4 `_prefix_copy_in` gather
+        does not exist on this path. A FULL-prompt match keeps all but
+        the tail block shared and copies the tail once (copy-on-write:
+        the row's first generated token must extend it). Novel prompt
+        blocks are freshly allocated, registered PENDING in the trie
+        (the row's prefill writes them in place — commit needs no copy
+        either), and the suffix prefills exactly as in dense mode."""
+        T = self.prefix_block
+        cow_pairs: List[Tuple[int, int]] = []
+        for row, req in admissions:
+            self.metrics.on_admit(req.req_id)
+            swap = self._swapped.pop(req.req_id, None)
+            if swap is not None:
+                if not self._swap_in_row(row, req, swap):
+                    # The admission gate's estimate went stale (an
+                    # earlier admission this step took the headroom):
+                    # requeue; the slot stays empty this round.
+                    self._swapped[req.req_id] = swap
+                    self._requeue_front(req)
+                continue
+            start = 0
+            shared: List[int] = []
+            cow_src: Optional[int] = None
+            nodes: list = []
+            if self._prefix is not None:
+                ids, _ = self._prefix.match(req.prompt, allow_full=True)
+                self.prefix_lookups += 1
+                if ids and len(ids) * T == len(req.prompt):
+                    # Full-prompt hit: share every block but the tail,
+                    # which the row must grow — that one is duplicated
+                    # by `_cow_blocks` (the round's single batched
+                    # copy) and the prefill recomputes ONLY the last
+                    # prompt token to land its true next-token logits.
+                    cow_src = int(ids[-1])
+                    shared = [int(i) for i in ids[:-1]]
+                    start = len(req.prompt) - 1
+                elif ids:
+                    shared = [int(i) for i in ids]
+                    start = len(shared) * T
+            n_total = -(-len(req.prompt) // T)
+            # Pin the shared blocks FIRST: holding the row's reference
+            # means the eviction fallback inside _pool_alloc can never
+            # recycle them out from under this admission.
+            self.kv_pool.incref(shared)
+            new_ids = self._pool_alloc(n_total - len(shared))
+            if new_ids is None:
+                self.kv_pool.decref(shared)
+                self._requeue_front(req)
+                continue
+            if cow_src is not None:
+                cow_pairs.append((cow_src, new_ids[0]))
+                self.kv_block_cows += 1
+                self.metrics.on_kv_cow()
+            chain = shared + new_ids
+            if self._prefix is not None:
+                hit = bool(shared) or cow_src is not None
+                if hit:
+                    self.prefix_hits += 1
+                self.prefix_reused_tokens += start
+                self.kv_blocks_shared += len(shared)
+                if shared:
+                    self.metrics.on_kv_shared(len(shared))
+                self.metrics.on_prefix(hit=hit, reused_tokens=start)
+                nodes = self._prefix.register(req.prompt, chain)
+            self._bind_row(row, req, chain, start)
+            self._row_prefill[row] = _PrefillState(req, start, nodes)
+        if cow_pairs:
+            n = len(cow_pairs)
+            n_pad = _pow2(n)
+            src = np.zeros((n_pad,), np.int32)   # pad = null block:
+            dst = np.zeros((n_pad,), np.int32)   # 0 -> 0 is a no-op
+            for i, (s, d) in enumerate(cow_pairs):
+                src[i] = s
+                dst[i] = d
+            self._pool_k, self._pool_v = _cow_blocks(
+                self._pool_k, self._pool_v, jnp.asarray(src),
+                jnp.asarray(dst), shardings=self._shardings)
+
+    def _bind_row(self, row: int, req: _Request, chain: List[int],
+                  start: int) -> None:
+        """Point a slot row at its block chain and reset its decode
+        state (budget/tok_idx overridden after the call by the swap-in
+        path, which restores rather than restarts)."""
+        self._row_blocks[row] = list(chain)
+        self._bt[row, :] = 0
+        self._bt[row, :len(chain)] = chain
+        self.row_req[row] = req
+        self.row_len[row] = start
+        self.row_budget[row] = req.max_new_tokens
+        self._tok_idx[row] = 0
+        self._row_keys[row] = self._req_key(req)
+        self._row_admit_seq[row] = self._admit_seq
+        self._admit_seq += 1
+
+    def _requeue_front(self, req: _Request) -> None:
+        pf = getattr(self.scheduler, "push_front", None)
+        (pf if pf is not None else self.scheduler.push)(req)
+        self.metrics.observe_queue_depth(len(self.scheduler))
+
+    def _pool_alloc(self, n: int) -> Optional[List[int]]:
+        """n fresh blocks, evicting cold committed prefix blocks
+        LRU-first when the free list runs short (the trie's eviction
+        honors refcounts: a block any row still shares is never a
+        victim). None when nothing more can be evicted — the caller
+        preempts a row or defers the admission."""
+        if n <= 0:
+            return []
+        ids = self.kv_pool.alloc(n)
+        while ids is None:
+            if self._prefix is None or not self._prefix.evict_one():
+                return None
+            ids = self.kv_pool.alloc(n)
+        return ids
+
+    def _ensure_decode_blocks(self, rows: List[int], H: int,
+                              inflight: int) -> bool:
+        """Grow each row's chain to cover ``row_len + inflight + H``
+        slots (capped at the row's own completion point — prompt +
+        budget — and at max_len). Growth appends to the host block
+        table only; in-flight dispatches hold their own device
+        snapshot. False when the pool (plus evictable prefix blocks)
+        cannot cover it; rows already grown keep their blocks — no
+        leak, the retry after preemption re-walks them as no-ops."""
+        T = self.prefix_block
+        for b in rows:
+            req = self.row_req[b]
+            lim = min(len(req.prompt) + req.max_new_tokens,
+                      self.max_len)
+            need_slots = min(int(self.row_len[b]) + inflight + H, lim)
+            nb = -(-need_slots // T)
+            have = len(self._row_blocks[b])
+            if nb > have:
+                got = self._pool_alloc(nb - have)
+                if got is None:
+                    return False
+                self._row_blocks[b].extend(got)
+                self._bt[b, have:have + len(got)] = got
+        return True
+
+    def _reserve_decode_blocks(self, decodable: List[int],
+                               H: int) -> Tuple[List[int], int]:
+        """Make the coming fused step safe: every decodable row must
+        own the blocks its next H tokens will write. When the pool
+        runs dry, PREEMPT victims (newest admission first — oldest
+        rows are closest to finishing and have the most sunk compute)
+        until the survivors fit. Only called with the pipeline ring
+        empty: preemption reads host row state, which must be fully
+        replayed."""
+        decodable = list(decodable)
+        while not self._ensure_decode_blocks(decodable, H, 0):
+            if len(decodable) <= 1:
+                if H > 1:
+                    H = 1      # shrink the horizon before giving up
+                    continue
+                raise RuntimeError(
+                    "paged KV pool exhausted with a single decodable "
+                    "row at horizon 1 — kv_pool_bytes is too small "
+                    "for this request shape (mid-prefill rows may be "
+                    "holding the remainder)")
+            victim = self._choose_victim(decodable)
+            self._preempt_row(victim)
+            decodable.remove(victim)
+        return decodable, H
+
+    def _choose_victim(self, rows: List[int]) -> int:
+        """Which decodable row to preempt. Rows are offered to the
+        scheduler's `choose_victim` hook oldest-admission-first; the
+        default (and every built-in policy) takes the LAST-admitted
+        row — LIFO preemption, the vLLM discipline that protects sunk
+        compute."""
+        ordered = sorted(rows, key=lambda b: self._row_admit_seq[b])
+        hook = getattr(self.scheduler, "choose_victim", None)
+        if hook is not None:
+            return hook(ordered, self.row_req)
+        return ordered[-1]
+
+    def _preempt_row(self, row: int) -> None:
+        """Evict a live decodable row mid-decode. swap mode gathers
+        its blocks into fresh buffers, starts `copy_to_host_async`,
+        and frees the blocks once the host copy lands — HBM is
+        reclaimed, and re-admission scatters the bytes back into
+        whatever physical blocks are free then (the block table makes
+        them logically identical). recompute mode just drops the
+        blocks and replays prompt + emitted tokens at re-admission.
+        Either way the request returns to the FRONT of the queue with
+        `resume` set: its deadline no longer applies (it was admitted
+        once) and the prefix-affinity policy skips its probe."""
+        assert not self._ring, "preemption needs a drained pipeline"
+        req = self.row_req[row]
+        ids = self._row_blocks[row]
+        if self.preempt_mode == "swap":
+            n = len(ids)
+            nbp = _pow2(max(1, n))
+            bids = np.zeros((nbp,), np.int32)
+            bids[:n] = ids
+            k, v = _swap_out_gather(self._pool_k, self._pool_v,
+                                    jnp.asarray(bids),
+                                    shardings=self._shardings)
+            lg = self._last_logits[row]
+            for x in (k, v, lg):
+                try:
+                    x.copy_to_host_async()
+                except AttributeError:
+                    pass
+            k = np.asarray(k)
+            v = np.asarray(v)
+            lg = np.asarray(lg)
+            self._swapped[req.req_id] = _SwapState(
+                k, v, n, int(self.row_len[row]),
+                int(self._tok_idx[row]), int(self.row_budget[row]), lg)
+            nbytes = k.nbytes + v.nbytes + lg.nbytes
+            self.swap_outs += 1
+            self.swap_out_bytes += nbytes
+            self.metrics.on_swap_out(nbytes)
+        else:
+            self._swapped[req.req_id] = _SwapState(
+                None, None, len(ids), int(self.row_len[row]),
+                int(self._tok_idx[row]), int(self.row_budget[row]),
+                None)
+        self._release_row_blocks(row)
+        self.row_req[row] = None
+        self.row_len[row] = 0
+        self.row_budget[row] = 0
+        self._tok_idx[row] = 0
+        self.preemptions += 1
+        self.metrics.on_preempt()
+        req.resume = True
+        self._requeue_front(req)
+
+    def _swap_in_row(self, row: int, req: _Request,
+                     swap: _SwapState) -> bool:
+        """Re-admit a preempted request. swap mode scatters its host
+        K/V into a fresh chain and restores the row EXACTLY where it
+        froze — decodable this very step, no prefill. recompute mode
+        re-prefills prompt + emitted tokens (mathematically the same
+        K/V) and continues the token stream at the saved tok_idx.
+        False if the pool cannot cover it right now (caller requeues)."""
+        T = self.prefix_block
+        if swap.k is None:
+            replay = list(req.prompt) + list(req.tokens)
+            ids = self._pool_alloc(-(-len(replay) // T))
+            if ids is None:
+                return False
+            self._bind_row(row, req, ids, 0)
+            self.row_budget[row] = req.max_new_tokens - len(req.tokens)
+            self._tok_idx[row] = len(req.tokens)
+            # No trie registration: emitted tokens are not a shared
+            # prompt, and the prompt's own blocks were registered (and
+            # possibly still live) on first admission.
+            self._row_prefill[row] = _PrefillState(req, 0, [],
+                                                   prompt=replay)
+            self.swap_ins += 1
+            return True
+        ids = self._pool_alloc(swap.n_blocks)
+        if ids is None:
+            return False
+        nbp = _pow2(max(1, swap.n_blocks))
+        bids = np.zeros((nbp,), np.int32)      # pad = null block: the
+        bids[:swap.n_blocks] = ids             # gather's padding lands
+        #                                        back where it came from
+        self._pool_k, self._pool_v = _swap_in_scatter(
+            self._pool_k, self._pool_v, jnp.asarray(swap.k),
+            jnp.asarray(swap.v), jnp.asarray(bids),
+            shardings=self._shardings)
+        self._last_logits = self._last_logits.at[row].set(
+            jnp.asarray(swap.logits))
+        if self._shardings is not None:
+            self._last_logits = jax.device_put(self._last_logits,
+                                               self._shardings.logits)
+        self._bind_row(row, req, ids, swap.row_len)
+        self.row_budget[row] = swap.budget
+        self._tok_idx[row] = swap.tok_idx
+        nbytes = swap.k.nbytes + swap.v.nbytes + swap.logits.nbytes
+        self.swap_ins += 1
+        self.swap_in_bytes += nbytes
+        self.metrics.on_swap_in(nbytes)
+        return True
+
+    def _release_row_blocks(self, row: int) -> None:
+        """Drop the row's reference on its chain (trie-shared blocks
+        survive via the trie's own reference) and point the table back
+        at the null block."""
+        ids = self._row_blocks[row]
+        if ids:
+            self.kv_pool.decref(ids)
+        self._row_blocks[row] = []
+        self._bt[row, :] = 0
+
+    def _fits_now(self, req: _Request) -> bool:
+        """Admission gate: would this request's NEW blocks fit the
+        pool right now, counting evictable cold trie blocks as
+        reclaimable? Pure host probe (peek=True) — deferring an
+        admission must not perturb LRU recency. An optimistic stale
+        answer is safe: `_admit_rows_paged` re-checks and requeues."""
+        T = self.prefix_block
+        swap = self._swapped.get(req.req_id)
+        if swap is not None:
+            if swap.k is not None:
+                need = swap.n_blocks
+            else:
+                need = -(-(len(req.prompt) + len(req.tokens)) // T)
+        else:
+            need = -(-len(req.prompt) // T)
+            if self._prefix is not None:
+                ids, _ = self._prefix.match(req.prompt, peek=True,
+                                            allow_full=True)
+                if ids and len(ids) * T == len(req.prompt):
+                    need -= len(ids) - 1   # tail block is CoW'd
+                else:
+                    need -= len(ids)
+        return need <= self.kv_free_blocks()
+
+    def _commit_covered(self, row: int, st: _PrefillState) -> None:
+        """Paged twin of `_flush_copy_out`: the row's prefill writes
+        the trie's blocks DIRECTLY (they ARE the row's chain), so a
+        pending block the frontier has covered just commits — zero
+        copy dispatches, which is the whole point."""
+        T = self.prefix_block
+        while st.nodes and (st.nodes[0][0] + 1) * T <= st.pos:
+            _, node = st.nodes.pop(0)
+            self._prefix.commit(node)
+
     def _advance_prefills(self) -> None:
         """Advance every mid-prefill row by one chunk (the whole
         remaining suffix when `prefill_chunk` is None), same-bucket
@@ -1313,7 +2100,7 @@ class DecodeEngine:
             return
         groups: Dict[int, List[Tuple[int, _PrefillState, int]]] = {}
         for row, st in self._row_prefill.items():
-            C = len(st.req.prompt) - st.pos
+            C = len(st.prompt) - st.pos
             if self.prefill_chunk is not None:
                 C = min(C, self.prefill_chunk)
             # Bucket the chunk, capped so the scatter never runs past
@@ -1330,7 +2117,7 @@ class DecodeEngine:
             last_idx = np.zeros((n_pad,), np.int32)
             real = 0
             for i, (row, st, C) in enumerate(grp):
-                prompts[i, :C] = st.req.prompt[st.pos:st.pos + C]
+                prompts[i, :C] = st.prompt[st.pos:st.pos + C]
                 rows[i] = row
                 starts[i] = st.pos
                 last_idx[i] = C - 1
@@ -1339,11 +2126,21 @@ class DecodeEngine:
             rows[n:] = rows[n - 1]          # duplicate scatters write
             starts[n:] = starts[n - 1]      # identical values
             last_idx[n:] = last_idx[n - 1]
-            self.cache, self._last_logits = _prefill_rows(
-                self.params, jnp.asarray(prompts), self.cache,
-                self._last_logits, jnp.asarray(rows),
-                jnp.asarray(starts), jnp.asarray(last_idx), self.cfg,
-                shardings=self._shardings)
+            if self.paged:
+                bt_grp = self._bt[rows]            # [n_pad, MB]
+                (self._pool_k, self._pool_v,
+                 self._last_logits) = _prefill_rows_paged(
+                    self.params, jnp.asarray(prompts), self._pool_k,
+                    self._pool_v, self._last_logits,
+                    jnp.asarray(bt_grp), jnp.asarray(rows),
+                    jnp.asarray(starts), jnp.asarray(last_idx),
+                    self.cfg, shardings=self._shardings)
+            else:
+                self.cache, self._last_logits = _prefill_rows(
+                    self.params, jnp.asarray(prompts), self.cache,
+                    self._last_logits, jnp.asarray(rows),
+                    jnp.asarray(starts), jnp.asarray(last_idx),
+                    self.cfg, shardings=self._shardings)
             self.prefill_dispatches += 1
             padded = n_pad * Cb - real
             self.prefill_real_tokens += real
@@ -1355,8 +2152,11 @@ class DecodeEngine:
                 st.pos += C
                 self.row_len[row] = st.pos
                 if self._prefix is not None:
-                    self._flush_copy_out(row, st)
-                if st.pos >= len(st.req.prompt):
+                    if self.paged:
+                        self._commit_covered(row, st)
+                    else:
+                        self._flush_copy_out(row, st)
+                if st.pos >= len(st.prompt):
                     done_rows.append(row)
         for row in done_rows:
             del self._row_prefill[row]
@@ -1442,5 +2242,11 @@ class DecodeEngine:
                 self.row_len[b] = 0      # slot free for the next prefill
                 self.row_budget[b] = 0
                 self._tok_idx[b] = 0
+                if self.paged:
+                    # Blocks the trie shares stay resident (its ref);
+                    # everything else returns to the pool NOW — this
+                    # is what lets admission capacity track finished
+                    # tokens instead of max-live slots.
+                    self._release_row_blocks(b)
             else:
                 self.row_len[b] += count  # the fed tokens took their slots

@@ -263,6 +263,45 @@ class EngineMetrics:
             "Bytes moved device->host by the serving loop (drained "
             "[H, B] token blocks — replicated, so per-token bytes do "
             "not grow with tp degree)")
+        # Paged-KV plane (PR: one refcounted block pool, zero-copy
+        # prefix shares, preempt-and-swap):
+        self.kv_blocks_shared = 0
+        self.kv_block_cows = 0
+        self.preemptions = 0
+        self.swap_in_bytes = 0
+        self.swap_out_bytes = 0
+        self.kv_pool_blocks_total = 0
+        self.kv_pool_blocks_in_use = 0
+        self.kv_pool_blocks_free = 0
+        self._m_kv_shared = counter(
+            "llm_engine_kv_blocks_shared_total",
+            "Prefix-cache blocks SHARED into warm admissions by "
+            "refcount (zero bytes copied — the paged twin of "
+            "prefix_reused_tokens)")
+        self._m_kv_cow = counter(
+            "llm_engine_kv_block_cow_total",
+            "Shared blocks duplicated copy-on-write (a full-prompt "
+            "hit whose tail block the new row must extend)")
+        self._m_preemptions = counter(
+            "llm_engine_preemptions_total",
+            "Live decode rows evicted to free KV pool blocks "
+            "(preempt-and-swap or preempt-and-recompute)")
+        self._m_swap_out = counter(
+            "llm_engine_swap_out_bytes_total",
+            "Bytes spilled device->host by preemption swap-outs")
+        self._m_swap_in = counter(
+            "llm_engine_swap_in_bytes_total",
+            "Bytes restored host->device by preemption swap-ins")
+        self._m_kv_pool_total = gauge(
+            "llm_engine_kv_pool_blocks",
+            "KV pool size in blocks (scratch block excluded)")
+        self._m_kv_pool_in_use = gauge(
+            "llm_engine_kv_pool_blocks_in_use",
+            "KV pool blocks currently referenced by rows or the "
+            "prefix trie")
+        self._m_kv_pool_free = gauge(
+            "llm_engine_kv_pool_blocks_free",
+            "KV pool blocks on the free list")
 
     # -- lifecycle hooks (called by DecodeEngine) --------------------------
 
@@ -426,6 +465,42 @@ class EngineMetrics:
             self.prefix_evictions += n
             self._m_prefix_evictions.inc(n)
 
+    def on_kv_shared(self, n: int) -> None:
+        """`n` pool blocks handed to an admission by incref — the warm
+        part of the prompt cost zero copy bytes."""
+        if n > 0:
+            self.kv_blocks_shared += n
+            self._m_kv_shared.inc(n)
+
+    def on_kv_cow(self, n: int = 1) -> None:
+        if n > 0:
+            self.kv_block_cows += n
+            self._m_kv_cow.inc(n)
+
+    def on_preempt(self, n: int = 1) -> None:
+        if n > 0:
+            self.preemptions += n
+            self._m_preemptions.inc(n)
+
+    def on_swap_out(self, nbytes: int) -> None:
+        if nbytes > 0:
+            self.swap_out_bytes += nbytes
+            self._m_swap_out.inc(nbytes)
+
+    def on_swap_in(self, nbytes: int) -> None:
+        if nbytes > 0:
+            self.swap_in_bytes += nbytes
+            self._m_swap_in.inc(nbytes)
+
+    def on_kv_pool(self, total: int, in_use: int, free: int) -> None:
+        """Gauge update at step end: pool occupancy in blocks."""
+        self.kv_pool_blocks_total = total
+        self.kv_pool_blocks_in_use = in_use
+        self.kv_pool_blocks_free = free
+        self._m_kv_pool_total.set(total)
+        self._m_kv_pool_in_use.set(in_use)
+        self._m_kv_pool_free.set(free)
+
     def on_prefill_batch(self, real_tokens: int,
                          padded_tokens: int) -> None:
         """One batched prefill program: `real_tokens` true chunk tokens
@@ -497,6 +572,17 @@ class EngineMetrics:
         out["chunked_prefill_stalls"] = self.prefill_stalls
         out["pipeline_flushes"] = self.pipeline_flushes
         out["pipeline_overrun_tokens"] = self.pipeline_overrun_tokens
+        out["kv_blocks_shared"] = self.kv_blocks_shared
+        out["kv_block_cows"] = self.kv_block_cows
+        out["preemptions"] = self.preemptions
+        out["swap_in_bytes"] = self.swap_in_bytes
+        out["swap_out_bytes"] = self.swap_out_bytes
+        out["kv_pool_blocks_total"] = self.kv_pool_blocks_total
+        out["kv_pool_blocks_in_use"] = self.kv_pool_blocks_in_use
+        out["kv_pool_blocks_free"] = self.kv_pool_blocks_free
+        out["kv_pool_occupancy"] = (
+            self.kv_pool_blocks_in_use / self.kv_pool_blocks_total
+            if self.kv_pool_blocks_total else 0.0)
         out["host_lag_steps"] = self.host_lag_steps
         out["pipeline_depth_effective"] = (
             self.pipeline_depth.sum / self.pipeline_depth.count
@@ -545,6 +631,18 @@ class NullEngineMetrics:
     def on_prefix(self, *, hit, reused_tokens=0): pass
 
     def on_prefix_evictions(self, n=1): pass
+
+    def on_kv_shared(self, n): pass
+
+    def on_kv_cow(self, n=1): pass
+
+    def on_preempt(self, n=1): pass
+
+    def on_swap_out(self, nbytes): pass
+
+    def on_swap_in(self, nbytes): pass
+
+    def on_kv_pool(self, total, in_use, free): pass
 
     def on_prefill_batch(self, real_tokens, padded_tokens): pass
 

@@ -108,21 +108,31 @@ def replica_score(replica: _Replica, prompt: List[int],
     bench share.
 
     pending_prefill_tokens is the real backlog unit (prompt tokens owed
-    before the newcomer's prefill can start); queue depth and live
-    slots are converted to the same unit with fixed exchange rates
+    before the newcomer's prefill can start); queue depth and KV
+    occupancy are converted to the same unit with fixed exchange rates
     (`queue_cost` per queued request ~ a short prompt's prefill,
-    `slot_cost` per live slot ~ the decode interference it adds); the
-    prompt's own cost counts only its COLD suffix — tokens the
-    replica's prefix pool cannot copy (probed with peek=True: scoring
-    must not touch any replica's LRU recency; only the winner's trie
-    is touched, at admission). All host-side reads, zero device work
-    per decision."""
+    `slot_cost` per occupied slot-equivalent ~ the decode interference
+    it adds); the prompt's own cost counts only its COLD suffix —
+    tokens the replica's prefix pool cannot copy (probed with
+    peek=True: scoring must not touch any replica's LRU recency; only
+    the winner's trie is touched, at admission).
+
+    Occupancy reads through `kv_used_fraction()`: on a DENSE engine
+    that is live_rows / batch_slots, so the term equals the historical
+    `live * slot_cost` exactly; on a PAGED engine it is the fraction
+    of KV pool blocks not free-or-evictable, so a replica whose pool
+    is nearly dry — about to preempt — scores as loaded even when its
+    row slots look empty, and the router steers toward free KV blocks.
+    All host-side reads, zero device work per decision."""
     eng = replica.engine
     queued = float(len(eng.scheduler))
-    live = float(sum(r is not None for r in eng.row_req))
+    if hasattr(eng, "kv_used_fraction"):
+        occupied = eng.kv_used_fraction() * len(eng.row_req)
+    else:
+        occupied = float(sum(r is not None for r in eng.row_req))
     pending = float(eng.pending_prefill_tokens())
     cold = float(max(len(prompt) - eng.prefix_match_tokens(prompt), 1))
-    return queued * queue_cost + live * slot_cost + pending + cold
+    return queued * queue_cost + occupied * slot_cost + pending + cold
 
 
 class FleetRouter:
@@ -651,6 +661,23 @@ class LLMFleet:
                 (s.get("tp_degree", 1.0) for s in per), default=1.0),
             "host_transfer_bytes": sum(
                 s.get("host_transfer_bytes", 0.0) for s in per),
+            # Paged-KV plane: zero-copy sharing / preempt-and-swap
+            # rollup (all-zero when replicas run the dense cache).
+            "kv_blocks_shared": sum(
+                s.get("kv_blocks_shared", 0.0) for s in per),
+            "kv_block_cows": sum(
+                s.get("kv_block_cows", 0.0) for s in per),
+            "preemptions": sum(
+                s.get("preemptions", 0.0) for s in per),
+            "swap_in_bytes": sum(
+                s.get("swap_in_bytes", 0.0) for s in per),
+            "swap_out_bytes": sum(
+                s.get("swap_out_bytes", 0.0) for s in per),
+            "kv_free_blocks": sum(
+                s.get("kv_free_blocks", 0.0) for s in per),
+            "kv_used_fraction_mean": (
+                sum(s.get("kv_used_fraction", 0.0) for s in per)
+                / len(per)) if per else 0.0,
         }
         out["router_affinity_wins"] = float(
             getattr(self.router, "affinity_wins", 0))

@@ -71,17 +71,23 @@ def _cached_attention(q, k_cache, v_cache, q_slots, kv_valid_len,
 
 def _layer_body(h, layer, k_cache, v_cache, positions, write_kv,
                 q_slots, kv_valid_len, cfg: LlamaConfig,
-                slot_live=None):
-    """The decoder-layer math shared by BOTH cached decode paths —
-    generate.py's contiguous-chunk writes and engine.py's per-row
-    scatter writes: rmsnorm → q/k/v projections → RoPE → cache write →
-    causal cached attention → attn residual → gated MLP residual.
+                slot_live=None, attend=None):
+    """The decoder-layer math shared by ALL cached decode paths —
+    generate.py's contiguous-chunk writes, engine.py's per-row
+    scatter writes, and the paged engine's block-pool writes: rmsnorm
+    → q/k/v projections → RoPE → cache write → causal cached attention
+    → attn residual → gated MLP residual.
 
-    The ONLY thing that differs between the two paths is how this
-    chunk's K/V land in the cache, so exactly that is injected as
-    ``write_kv(k_cache, v_cache, k, v) -> (k_cache, v_cache)``; every
-    other op stays in lockstep by construction (a norm tweak or
-    attention change here reaches the engine automatically)."""
+    The ONLY things that differ between the paths are how this chunk's
+    K/V land in storage and how attention reads them back, so exactly
+    those are injected: ``write_kv(k_cache, v_cache, k, v) ->
+    (k_cache, v_cache)`` always, and optionally ``attend(q, k_cache,
+    v_cache) -> o`` when the storage is not a dense [B, max_len] cache
+    row (the paged engine passes `ops.attention.paged_attention` over
+    its block pool — which stays op-for-op lockstep with
+    `_cached_attention`, so token identity across paths holds). Every
+    other op is shared by construction (a norm tweak or attention
+    change here reaches every engine automatically)."""
     dt = cfg.dtype
     x = _rmsnorm(h, layer["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", x, layer["wq"].astype(dt))
@@ -90,8 +96,11 @@ def _layer_body(h, layer, k_cache, v_cache, positions, write_kv,
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     k_cache, v_cache = write_kv(k_cache, v_cache, k, v)
-    o = _cached_attention(q, k_cache, v_cache, q_slots, kv_valid_len,
-                          cfg, slot_live=slot_live)
+    if attend is not None:
+        o = attend(q, k_cache, v_cache)
+    else:
+        o = _cached_attention(q, k_cache, v_cache, q_slots,
+                              kv_valid_len, cfg, slot_live=slot_live)
     h = h + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
     x = _rmsnorm(h, layer["mlp_norm"], cfg.norm_eps)
     gate = jnp.einsum("bsd,df->bsf", x, layer["w_gate"].astype(dt))

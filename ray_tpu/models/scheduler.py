@@ -57,9 +57,29 @@ class SchedulerPolicy:
     def push(self, req) -> None:
         raise NotImplementedError
 
+    def push_front(self, req) -> None:
+        """Re-queue a request at the HEAD of the policy's order — used
+        by the paged engine when an admission gate turns out stale
+        (pool momentarily full) and, crucially, when a live row is
+        PREEMPTED: the victim must be first in line to swap back in,
+        not re-ranked behind the traffic that evicted it. Policies
+        without a natural front (e.g. priority heaps, where `req.seq`
+        already restores the original rank) may fall back to push."""
+        self.push(req)
+
     def pop(self):
         """Remove and return the next request to admit."""
         raise NotImplementedError
+
+    def choose_victim(self, rows: List[int], requests) -> int:
+        """Pick which live row the paged engine preempts when the KV
+        pool runs dry mid-decode. `rows` is ordered oldest-admitted
+        first; `requests[row]` is the in-flight request. Default is
+        LIFO — evict the newest admission (vLLM's discipline: the
+        oldest request is closest to finishing and has absorbed the
+        most compute, so it is the worst thing to throw away).
+        Policies may override, e.g. priority-aware victim choice."""
+        return rows[-1]
 
     def __len__(self) -> int:
         raise NotImplementedError
@@ -119,6 +139,9 @@ class FIFOPolicy(SchedulerPolicy):
 
     def push(self, req) -> None:
         self._q.append(req)
+
+    def push_front(self, req) -> None:
+        self._q.appendleft(req)
 
     def pop(self):
         return self._q.popleft()
@@ -211,6 +234,13 @@ class PrefixAffinityPolicy(FIFOPolicy):
         if self._probe is None:
             return super().pop()
         for i, req in enumerate(self._q):
+            if getattr(req, "resume", False):
+                # Preempted row swapping back in: its KV is in the host
+                # swap buffer (or replayed from its own history), not
+                # the trie — probing/deferring it can only delay the
+                # restart it is owed.
+                del self._q[i]
+                return req
             matched, key, pending = self._probe(req.prompt)
             if pending or (key is not None and key in self._round_cold):
                 continue                 # warmer next round — defer

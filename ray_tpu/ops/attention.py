@@ -122,6 +122,82 @@ def mha_reference(q: jax.Array,
     return out.astype(q.dtype)
 
 
+def paged_attention(q: jax.Array,
+                    k_pages: jax.Array,
+                    v_pages: jax.Array,
+                    block_tables: jax.Array,
+                    q_slots: jax.Array,
+                    *,
+                    kv_valid_len,
+                    sm_scale: Optional[float] = None,
+                    impl: str = "auto") -> jax.Array:
+    """Attention over PAGED K/V: each query row reads its keys/values
+    through a per-row block table instead of a contiguous cache row —
+    the vLLM/PagedAttention access pattern, serving the DecodeEngine's
+    one-pool-many-requests memory plane.
+
+      q            [B, S, H, D]   queries (S=1 fused decode; S>1 would
+                                  be a paged prefill chunk)
+      k/v_pages    [NB, T, KV, D] the shared block pool, ONE layer's
+                                  slice (the engine scans layers; NB
+                                  blocks of T tokens each; block 0 is
+                                  the reserved null block)
+      block_tables [B, MB]        row b's logical block p covers cache
+                                  slots [p*T, (p+1)*T); unallocated
+                                  entries point at block 0
+      q_slots      [B, S]         the cache slot each query occupies
+      kv_valid_len scalar         slots >= this are masked (the
+                                  engine's max_len)
+
+    Semantics are EXACTLY the dense path's `_cached_attention` (see
+    models/generate.py) evaluated on the gathered view: causal mask
+    ``slot <= q_slot`` plus the valid-length cap, -1e30 fill, f32
+    softmax. The two must stay in lockstep op-for-op — the paged
+    engine's token-identity to the dense engine and to solo `generate`
+    (tests/test_engine_paged.py) rests on it. Positions gathered from
+    unallocated/garbage block entries are always masked: exp(-1e30 -
+    max) underflows to exactly 0.0, so any finite garbage contributes
+    exactly nothing.
+
+    ``impl`` mirrors `attention`'s dispatch seam. Only the pure-lax
+    "reference" lowering exists today — the gather materializes the
+    [B, MB*T, KV, D] view and XLA fuses it into the einsums, which is
+    the right CPU/interpret-mode form (Pallas is unavailable in this
+    environment); a Mosaic kernel that walks the block table in-VMEM
+    without materializing the view slots in here under impl="flash"
+    when the toolchain lands. "auto" therefore resolves to "reference"
+    on every backend for now."""
+    if impl not in ("auto", "flash", "reference"):
+        raise ValueError(f"impl must be auto|flash|reference, got {impl!r}")
+    B, S, H, D = q.shape
+    NB, T, KV, _ = k_pages.shape
+    if H % KV:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {KV}")
+    # Gather the per-row dense view: [B, MB, T, KV, D] -> [B, MB*T, ..]
+    # (logical slot p*T + t of row b is block_tables[b, p] slot t, so
+    # the reshape restores contiguous slot order per row).
+    k = k_pages[block_tables]
+    v = v_pages[block_tables]
+    span = k.shape[1] * T
+    k = k.reshape(B, span, KV, D)
+    v = v.reshape(B, span, KV, D)
+    # -- lockstep with generate._cached_attention from here on --
+    rep = H // KV
+    k = jnp.repeat(k, rep, axis=2)                 # [B, span, H, D]
+    v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bshd,bthd->bhst", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits * (sm_scale if sm_scale is not None else D ** -0.5)
+    slots = jnp.arange(span)
+    mask = (slots[None, None, None, :] <= q_slots[:, None, :, None]) \
+        & (slots[None, None, None, :] < kv_valid_len)
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
 def attention(q: jax.Array,
               k: jax.Array,
               v: jax.Array,

@@ -35,6 +35,14 @@ DEFAULT_RULES: LogicalAxisRules = {
     "expert": "ep",
     "layers": None,
     "stage": "pp",
+    # Paged-KV pool axes ([layers, kv_blocks, block_tokens, kv,
+    # head_dim]): block ids are row-LOCAL indirection — every shard
+    # must hold every block so a row's block table resolves anywhere,
+    # so the pool replicates over blocks/tokens and tp-shards only
+    # over kv heads (the engine's pool sharding spec picks "kv" -> tp
+    # when n_kv_heads divides; see DecodeEngine paged mode).
+    "kv_blocks": None,
+    "block_tokens": None,
 }
 
 

@@ -1,0 +1,421 @@
+"""Paged KV memory (ray_tpu/models/engine.py paged=True).
+
+The paged engine stores every request's K/V in blocks of one shared
+refcounted pool (`models/block_pool.py`) behind a per-request block
+table, instead of a private [max_len] cache row per slot. The gold
+contract is unchanged and is THE thing this file pins:
+
+- TOKEN IDENTITY. Paged output == dense-engine output == solo
+  `generate`, greedy and sampled, under the prefix cache, chunked
+  prefill, the async pipeline, tensor parallelism, and preemption.
+  `paged_attention` is the dense `_cached_attention` evaluated on the
+  block-table gather (the engine enforces max_len % block_tokens == 0
+  so the gathered view has exactly the dense cache-row shape), so the
+  identity holds bit-for-bit, not just approximately.
+- ZERO-COPY warm admission. A prefix-cache hit increfs the matched
+  blocks into the new request's table — no `_prefix_copy_in` gather,
+  no device bytes moved. Only a FULL-prompt hit pays one
+  copy-on-write block (the new row must extend the shared tail).
+- PREEMPT-AND-SWAP. When the pool runs dry mid-decode the engine
+  evicts the newest row (LIFO), spills its blocks to host (or drops
+  them for preempt="recompute"), and later swaps back in and
+  continues — with identical tokens, because the per-token rng key
+  depends only on (request key, token index).
+- CAPACITY. Admission is bounded by pool blocks, not row slots: a
+  pool sized for B dense rows runs 2B+ concurrent requests when their
+  actual lengths need less than max_len each.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.models import LlamaConfig, llama_init  # noqa: E402
+from ray_tpu.models.block_pool import BlockPool  # noqa: E402
+from ray_tpu.models.engine import DecodeEngine  # noqa: E402
+from ray_tpu.models.generate import generate  # noqa: E402
+from ray_tpu.models.prefix_cache import (  # noqa: E402
+    PrefixCacheIndex, block_bytes)
+
+T = 4           # kv_block_tokens under test
+MAX_LEN = 32
+
+
+@pytest.fixture(scope="module")
+def nano_model():
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _prompts(n, cfg, seed=7, lo=3, hi=9):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, cfg.vocab_size,
+                        size=rng.randint(lo, hi)).tolist()
+            for _ in range(n)]
+
+
+def _req_keys(n, seed=0):
+    return [jax.random.PRNGKey(2000 + seed * 100 + i) for i in range(n)]
+
+
+def _solo(params, cfg, prompt, n, mode=None, rng=None):
+    out = np.asarray(generate(params, jnp.asarray([prompt], jnp.int32),
+                              cfg, max_new_tokens=n, rng=rng,
+                              **(mode or {})))
+    return out[0, len(prompt):].tolist()
+
+
+def _run(params, cfg, prompts, budgets, *, eng_kw=None, keys=None,
+         slots=2):
+    eng = DecodeEngine(params, cfg, batch_slots=slots, max_len=MAX_LEN,
+                       **(eng_kw or {}))
+    ids = [eng.submit(p, n, rng=None if keys is None else keys[i])
+           for i, (p, n) in enumerate(zip(prompts, budgets))]
+    out = eng.run()
+    return [out[r] for r in ids], eng
+
+
+def _pool_bytes(cfg, n_blocks):
+    """Bytes buying exactly `n_blocks` usable pool blocks at T."""
+    return n_blocks * block_bytes(cfg.n_layers, T, cfg.n_kv_heads,
+                                  cfg.head_dim,
+                                  jnp.dtype(cfg.dtype).itemsize)
+
+
+# ---------------------------------------------------------------------------
+# Token identity: paged x sampling x engine feature matrix
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", [
+    {"greedy": True},
+    {"greedy": False, "temperature": 0.9, "top_k": 5},
+], ids=["greedy", "top_k"])
+@pytest.mark.parametrize("features", [
+    {},
+    {"prefix_cache": True},
+    {"prefix_cache": True, "pipeline_depth": 2},
+    {"prefill_chunk": 3, "prefix_cache": True},
+    {"tp": 2, "prefix_cache": True},
+], ids=["plain", "prefix", "prefix_pipeline", "chunked", "tp2"])
+def test_paged_token_identity_matrix(nano_model, mode, features):
+    """Paged == dense == solo generate across the feature matrix.
+    Shared-prefix prompts drive refcounted block sharing under the
+    prefix variants; 5 requests through 2 slots churn admissions so
+    block alloc/free crosses slot reuse."""
+    cfg, params = nano_model
+    base = _prompts(5, cfg)
+    shared = list(range(3, 11))      # 2 full blocks at T=4
+    prompts = [shared + p for p in base[:2]] + base[2:]
+    budgets = [7, 4, 9, 5, 6]
+    keys = None if mode["greedy"] else _req_keys(len(prompts))
+    rng_kw = {} if mode["greedy"] else {"rng": jax.random.PRNGKey(7)}
+    ref = [_solo(params, cfg, p, n, mode,
+                 rng=None if keys is None else keys[i])
+           for i, (p, n) in enumerate(zip(prompts, budgets))]
+
+    dense, _ = _run(params, cfg, prompts, budgets,
+                    eng_kw={**mode, **rng_kw, **features}, keys=keys)
+    assert dense == ref, "dense engine diverged from solo generate"
+
+    paged, eng = _run(params, cfg, prompts, budgets,
+                      eng_kw={**mode, **rng_kw, **features,
+                              "paged": True, "kv_block_tokens": T},
+                      keys=keys)
+    assert paged == ref, "paged engine diverged from solo generate"
+    assert paged == dense
+    s = eng.stats()
+    assert s["paged"] == 1.0
+    assert s["kv_pool_blocks_in_use"] >= 0.0
+    # every retired row returned its blocks: only trie-held blocks stay
+    assert eng.kv_pool.blocks_in_use == \
+        (eng._prefix.blocks_in_use if eng._prefix else 0)
+
+
+def test_paged_rejects_misaligned_block_size(nano_model):
+    cfg, params = nano_model
+    with pytest.raises(ValueError, match="divisible"):
+        DecodeEngine(params, cfg, batch_slots=2, max_len=30,
+                     paged=True, kv_block_tokens=T)
+    with pytest.raises(ValueError, match="preempt"):
+        DecodeEngine(params, cfg, batch_slots=2, max_len=MAX_LEN,
+                     paged=True, kv_block_tokens=T, preempt="drop")
+
+
+# ---------------------------------------------------------------------------
+# Zero-copy prefix sharing + copy-on-write
+# ---------------------------------------------------------------------------
+
+def test_warm_admission_is_zero_copy(nano_model):
+    """The PR's acceptance gate: a warm admission SHARES committed
+    blocks by incref — zero `_prefix_copy_in` dispatches, zero bytes
+    gathered — where the dense engine pays a d2d copy per hit."""
+    cfg, params = nano_model
+    sys_p = list(range(1, 13))       # 3 full blocks at T=4
+    eng = DecodeEngine(params, cfg, batch_slots=2, max_len=MAX_LEN,
+                       paged=True, kv_block_tokens=T,
+                       prefix_cache=True)
+    a = eng.submit(sys_p + [50, 51], 4)
+    out = eng.run()
+    assert out[a] == _solo(params, cfg, sys_p + [50, 51], 4)
+    s0 = eng.stats()
+
+    b = eng.submit(sys_p + [60, 61, 62], 4)     # warm: 3 shared blocks
+    out = eng.run()
+    assert out[b] == _solo(params, cfg, sys_p + [60, 61, 62], 4)
+    s1 = eng.stats()
+    assert s1["prefix_hits"] - s0["prefix_hits"] == 1
+    assert s1["kv_blocks_shared"] - s0["kv_blocks_shared"] == 3
+    # THE gate: no copy-in program ran for the warm admission.
+    assert s1["prefix_copy_dispatches"] == s0["prefix_copy_dispatches"]
+    # non-aligned suffix -> frontier block is fresh, no CoW either
+    assert s1["kv_block_cows"] == s0["kv_block_cows"]
+    # reused tokens flow into the shared prefix accounting
+    assert s1["prefix_reused_tokens"] - s0["prefix_reused_tokens"] == 12
+
+
+def test_full_prompt_hit_pays_one_cow_block(nano_model):
+    """A prompt that IS a committed chain would share its own write
+    frontier; the engine copies exactly the tail block (CoW) and
+    shares the rest."""
+    cfg, params = nano_model
+    sys_p = list(range(1, 13))       # exactly 3 blocks
+    eng = DecodeEngine(params, cfg, batch_slots=2, max_len=MAX_LEN,
+                       paged=True, kv_block_tokens=T,
+                       prefix_cache=True)
+    a = eng.submit(sys_p, 4)
+    eng.run()
+    s0 = eng.stats()
+    b = eng.submit(sys_p, 4)         # full-prompt hit
+    out = eng.run()
+    assert out[b] == _solo(params, cfg, sys_p, 4)
+    s1 = eng.stats()
+    assert s1["kv_block_cows"] - s0["kv_block_cows"] == 1
+    assert s1["kv_blocks_shared"] - s0["kv_blocks_shared"] == 2
+    assert s1["prefix_copy_dispatches"] == s0["prefix_copy_dispatches"]
+
+
+# ---------------------------------------------------------------------------
+# Preempt-and-swap
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", [
+    {"greedy": True},
+    {"greedy": False, "temperature": 0.9, "top_k": 5},
+], ids=["greedy", "top_k"])
+def test_preempt_and_swap_round_trip_identity(nano_model, mode):
+    """Pool sized for 2 of 4 in-flight requests: decode growth must
+    preempt rows (swap out to host), requeue them, swap back in, and
+    finish with tokens identical to solo generate. The per-token rng
+    key depends only on (request key, token index), so a sampled row
+    resumes bit-identically too."""
+    cfg, params = nano_model
+    prompts = [[7, 8, 9, 10, 11], [3, 1, 4, 1, 5],
+               [2, 7, 1, 8, 2], [9, 9, 8, 8, 7]]
+    M = 12                           # each row needs 5 blocks at T=4
+    keys = None if mode["greedy"] else _req_keys(len(prompts), seed=3)
+    rng_kw = {} if mode["greedy"] else {"rng": jax.random.PRNGKey(7)}
+    eng = DecodeEngine(params, cfg, batch_slots=4, max_len=MAX_LEN,
+                       paged=True, kv_block_tokens=T,
+                       kv_pool_bytes=_pool_bytes(cfg, 10),
+                       prefix_cache=False, **mode, **rng_kw)
+    assert eng.kv_pool.blocks_total == 10
+    ids = [eng.submit(p, M, rng=None if keys is None else keys[i])
+           for i, p in enumerate(prompts)]
+    out = eng.run()
+    for i, (rid, p) in enumerate(zip(ids, prompts)):
+        want = _solo(params, cfg, p, M, mode,
+                     rng=None if keys is None else keys[i])
+        assert out[rid] == want, f"req {rid} diverged across swap"
+    s = eng.stats()
+    assert s["preemptions"] >= 1
+    assert s["swap_outs"] == s["preemptions"]
+    assert s["swap_ins"] == s["swap_outs"]
+    assert s["swap_out_bytes"] > 0 and s["swap_in_bytes"] > 0
+    assert s["requests_swapped"] == 0.0          # all restored
+    assert eng.kv_pool.blocks_in_use == 0        # all returned
+
+
+def test_preempt_recompute_identity(nano_model):
+    """preempt="recompute" drops the victim's blocks and replays
+    prompt+emitted through prefill on re-admission — same tokens,
+    zero swap traffic (greedy: prefill recomputes the same K/V the
+    decode originally wrote)."""
+    cfg, params = nano_model
+    prompts = [[7, 8, 9, 10, 11], [3, 1, 4, 1, 5],
+               [2, 7, 1, 8, 2], [9, 9, 8, 8, 7]]
+    M = 12
+    eng = DecodeEngine(params, cfg, batch_slots=4, max_len=MAX_LEN,
+                       paged=True, kv_block_tokens=T,
+                       preempt="recompute",
+                       kv_pool_bytes=_pool_bytes(cfg, 10),
+                       prefix_cache=False)
+    ids = [eng.submit(p, M) for p in prompts]
+    out = eng.run()
+    for rid, p in zip(ids, prompts):
+        assert out[rid] == _solo(params, cfg, p, M)
+    s = eng.stats()
+    assert s["preemptions"] >= 1
+    assert s["swap_out_bytes"] == 0.0 and s["swap_in_bytes"] == 0.0
+
+
+def test_tight_pool_with_shared_prefix_trie_terminates(nano_model):
+    """Regression: the admission gate must count CASCADE-evictable
+    trie chains as capacity. A cold shared-prefix chain pins interior
+    blocks that are not instantaneously-evictable leaves; if
+    `_fits_now` only counts the leaves, a preempted request 'never
+    fits' and step() livelocks doing nothing. Pool of 7 blocks, rows
+    needing 6 (4 of them a shared trie chain): the engine must evict
+    through the chain, preempt-and-swap, and finish every request
+    with solo-identical tokens in bounded steps."""
+    cfg, params = nano_model
+    shared = list(range(1, 13))      # 3 full blocks at T=4
+    rng = np.random.RandomState(5)
+    prompts = [shared + rng.randint(1, cfg.vocab_size,
+                                    size=3).tolist()
+               for _ in range(6)]
+    M = 6                            # each row: ceil(21/4) = 6 blocks
+    eng = DecodeEngine(params, cfg, batch_slots=3, max_len=MAX_LEN,
+                       paged=True, kv_block_tokens=T,
+                       kv_pool_bytes=_pool_bytes(cfg, 7),
+                       prefix_cache=True)
+    ids = [eng.submit(p, M) for p in prompts]
+    steps = 0
+    while eng.pending():
+        eng.step()
+        steps += 1
+        assert steps < 500, "paged admission gate livelocked"
+    out = {r: eng.pop_result(r) for r in list(eng.finished)}
+    for rid, p in zip(ids, prompts):
+        assert out[rid] == _solo(params, cfg, p, M)
+
+
+def test_preempt_and_swap_under_tp(nano_model):
+    """Swap-out gathers and swap-in scatters cross a tp=2 sharded
+    pool; tokens stay identical to solo generate."""
+    cfg, params = nano_model
+    prompts = [[7, 8, 9, 10, 11], [3, 1, 4, 1, 5],
+               [2, 7, 1, 8, 2], [9, 9, 8, 8, 7]]
+    M = 12
+    eng = DecodeEngine(params, cfg, batch_slots=4, max_len=MAX_LEN,
+                       tp=2, paged=True, kv_block_tokens=T,
+                       kv_pool_bytes=_pool_bytes(cfg, 10),
+                       prefix_cache=False)
+    ids = [eng.submit(p, M) for p in prompts]
+    out = eng.run()
+    for rid, p in zip(ids, prompts):
+        assert out[rid] == _solo(params, cfg, p, M)
+    assert eng.stats()["preemptions"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Capacity: pool-bounded admission beats slot-bounded admission
+# ---------------------------------------------------------------------------
+
+def test_paged_runs_2x_dense_concurrency_on_same_budget(nano_model):
+    """The PR's capacity acceptance: on a pool holding what a dense
+    engine spends on 2 rows (2 * max_len tokens of K/V), the paged
+    engine runs 4+ CONCURRENT requests — their actual footprints are
+    small, and admission charges blocks, not a max_len-sized slot —
+    with every token still identical to solo generate."""
+    cfg, params = nano_model
+    n_dense_rows = 2
+    pool_blocks = n_dense_rows * (MAX_LEN // T)       # 16 blocks
+    prompts = _prompts(6, cfg, seed=11, lo=3, hi=7)
+    budgets = [5] * len(prompts)     # ceil((~6+5)/4) <= 3 blocks/row
+
+    eng = DecodeEngine(params, cfg, batch_slots=2 * n_dense_rows,
+                       max_len=MAX_LEN, paged=True, kv_block_tokens=T,
+                       kv_pool_bytes=_pool_bytes(cfg, pool_blocks),
+                       prefix_cache=False)
+    assert eng.kv_pool.blocks_total == pool_blocks
+    ids = [eng.submit(p, n) for p, n in zip(prompts, budgets)]
+    eng.step()
+    live = sum(r is not None for r in eng.row_req)
+    assert live >= 2 * n_dense_rows, \
+        f"only {live} live rows on a {n_dense_rows}-dense-row budget"
+    out = eng.run()
+    for rid, p, n in zip(ids, prompts, budgets):
+        assert out[rid] == _solo(params, cfg, p, n)
+
+
+def test_submit_rejects_request_larger_than_pool(nano_model):
+    cfg, params = nano_model
+    eng = DecodeEngine(params, cfg, batch_slots=2, max_len=MAX_LEN,
+                       paged=True, kv_block_tokens=T,
+                       kv_pool_bytes=_pool_bytes(cfg, 3),
+                       prefix_cache=False)
+    with pytest.raises(ValueError, match="pool"):
+        eng.submit(list(range(1, 9)), 12)    # needs 5 > 3 blocks
+
+
+# ---------------------------------------------------------------------------
+# Refcount safety: shared blocks never evicted while referenced
+# ---------------------------------------------------------------------------
+
+def test_referenced_blocks_never_evicted_property():
+    """Property test over the BlockPool + PrefixCacheIndex pair: drive
+    random register/match/incref/decref/evict traffic and assert the
+    trie never evicts a block some live row still references, and
+    refcounts never go negative or leak."""
+    rng = np.random.RandomState(0)
+    pool = BlockPool(24)
+    idx = PrefixCacheIndex(block_tokens=4, n_blocks=24, pool=pool)
+    live = []                        # simulated rows: lists of bids
+
+    def rand_prompt():
+        n_blocks = rng.randint(1, 4)
+        return rng.randint(1, 50, size=4 * n_blocks).tolist()
+
+    for _ in range(300):
+        op = rng.randint(4)
+        if op == 0 and pool.free_blocks >= 3:         # admit a row
+            prompt = rand_prompt()
+            need = len(prompt) // 4
+            ids, _pending = idx.match(prompt, allow_full=True)
+            shared = ids[:need]
+            pool.incref(shared)
+            fresh = pool.alloc(need - len(shared))
+            if fresh is None:
+                pool.decref(shared)
+                continue
+            chain = shared + fresh
+            for _, node in idx.register(prompt, chain):
+                idx.commit(node)
+            live.append(chain)
+        elif op == 1 and live:                        # retire a row
+            row = live.pop(rng.randint(len(live)))
+            pool.decref(row)
+        elif op == 2:                                 # memory pressure
+            idx.evict_one()
+        else:                                         # audit
+            held = set(b for row in live for b in row)
+            for b in held:
+                assert pool.ref(b) >= 1, \
+                    f"block {b} referenced by a live row but free"
+    # teardown: retiring every row and draining the trie frees all
+    for row in live:
+        pool.decref(row)
+    while idx.evict_one():
+        pass
+    assert pool.blocks_in_use == 0
+    assert pool.free_blocks == pool.blocks_total
+
+
+def test_block_pool_basics():
+    pool = BlockPool(8)              # 7 usable; block 0 reserved
+    assert pool.blocks_total == 7
+    ids = pool.alloc(3)
+    assert ids is not None and 0 not in ids
+    assert pool.alloc(5) is None     # all-or-nothing
+    assert pool.alloc(4) is not None
+    assert pool.free_blocks == 0
+    pool.incref(ids)
+    assert pool.decref(ids) == []    # still referenced
+    assert sorted(pool.decref(ids)) == sorted(ids)
+    with pytest.raises(ValueError):
+        pool.incref(ids)             # free blocks can't be ref'd
+    with pytest.raises(ValueError):
+        BlockPool(1)

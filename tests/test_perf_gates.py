@@ -158,3 +158,37 @@ def test_gate_actor_storm(gate_cluster):
         return statistics.median(rates)
 
     _gate(measure, 50, "actor creation storm (actors/s)")
+
+
+def test_gate_warm_admission_zero_copy_bytes():
+    """Gate (r8, paged KV): a warm prefix admission on the paged
+    engine moves ZERO device->device KV bytes — shared blocks are
+    increfed into the new row's block table, never gathered. Counting,
+    not timing, so it holds on any box: the gate fails if a future
+    change reintroduces a copy-in program (or any CoW block) on a
+    non-aligned warm admission."""
+    jax = pytest.importorskip("jax")
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.engine import DecodeEngine
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    sys_p = list(range(1, 17))       # 4 full blocks at T=4
+    eng = DecodeEngine(params, cfg, batch_slots=2, max_len=32,
+                       paged=True, kv_block_tokens=4,
+                       prefix_cache=True)
+    eng.submit(sys_p + [50, 51], 4)  # cold: commits the chain
+    eng.run()
+    s0 = eng.stats()
+    for i in range(3):               # warm admissions
+        eng.submit(sys_p + [60 + i, 70 + i], 4)
+    eng.run()
+    s1 = eng.stats()
+    assert s1["prefix_hits"] - s0["prefix_hits"] == 3
+    assert s1["kv_blocks_shared"] - s0["kv_blocks_shared"] == 12
+    copies = s1["prefix_copy_dispatches"] - s0["prefix_copy_dispatches"]
+    assert copies == 0, (
+        f"warm admission dispatched {copies} KV copy program(s); "
+        "paged prefix hits must be zero-copy block shares")
+    assert s1["kv_block_cows"] == s0["kv_block_cows"], \
+        "non-aligned warm admissions must not pay copy-on-write"
