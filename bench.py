@@ -670,15 +670,15 @@ def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
         deadline = 0.0 if i % 8 == 7 else None
         arrivals.append((prompt, priority, deadline))
 
-    def run_one(router, n_replicas):
+    def run_one(router, n_replicas, trace=False, trace_path=None):
         def factory(name):
             return DecodeEngine(params, cfg, batch_slots=batch_slots,
                                 max_len=max_len, scheduler="priority",
                                 prefix_cache=True,
                                 prefix_block=prefix_block,
-                                engine_id=name)
+                                engine_id=name, trace=trace)
         fleet = LLMFleet(factory, initial_replicas=n_replicas,
-                         router=router,
+                         router=router, trace=trace,
                          fleet_id=f"bench-{router}-{n_replicas}")
         t0 = time.perf_counter()
         for i, (prompt, priority, deadline) in enumerate(arrivals):
@@ -688,6 +688,8 @@ def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
                 fleet.step()
         fleet.run()
         wall = time.perf_counter() - t0
+        if trace_path is not None:
+            fleet.dump_trace(trace_path)
         s = fleet.stats()
         per = [r.engine.stats() for r in fleet.replicas]
         served = n_requests - int(s["requests_shed"])
@@ -724,6 +726,17 @@ def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
 
     n0 = replica_counts[0]
     rr, aff = pick("round_robin", n0), pick("pow2_affinity", n0)
+
+    # Tracing tax on the identical churn: re-run the affinity arm with
+    # the lifecycle tracer ON (compiled programs already warm) and dump
+    # the chrome trace as the run's artifact — the request-level
+    # timeline behind the aggregate numbers above
+    # (tools/trace_report.py prints the breakdown).
+    traced = run_one("pow2_affinity", n0, trace=True,
+                     trace_path="BENCH_fleet.trace.json")
+    trace_overhead = (traced["wall_s"] - aff["wall_s"]) \
+        / aff["wall_s"] if aff["wall_s"] else 0.0
+
     return {
         "n_groups": n_groups,
         "prefix_len": prefix_len,
@@ -739,6 +752,8 @@ def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
             1.0 - aff["prefill_real_tokens"]
             / rr["prefill_real_tokens"], 4)
         if rr["prefill_real_tokens"] else 0.0,
+        "trace_overhead_frac": round(trace_overhead, 4),
+        "trace_artifact": "BENCH_fleet.trace.json",
     }
 
 

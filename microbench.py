@@ -498,6 +498,69 @@ def _fleet_router_section(quick: bool) -> list:
     return results
 
 
+def _tracer_overhead_section(quick: bool) -> list:
+    """Cost of the request-lifecycle tracer (models/engine_trace.py):
+    raw event-emit throughput, and the engine-level tax — wall time of
+    an identical decode churn with tracing OFF (the NullEngineTracer
+    default), with the ring tracer ON, and the on/off overhead
+    fraction. The zero-cost-when-off claim is the one that matters
+    (every call site guards on `trace.enabled` before building args),
+    so off-vs-baseline must be noise; on-vs-off bounds what turning a
+    production engine's tracing on costs per token."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.engine import DecodeEngine
+    from ray_tpu.models.engine_trace import EngineTracer
+
+    # Raw primitive cost: one span via the mark frontier (the decode
+    # hot path's shape: span_since_mark with a small args dict).
+    tracer = EngineTracer(capacity=1 << 14)
+    n_ev = 20_000 if quick else 100_000
+    tracer.mark(0)
+
+    def emit():
+        for _ in range(n_ev):
+            tracer.span_since_mark("decode_block", 0,
+                                   {"tokens": 1, "horizon": 8})
+
+    results = [("tracer_span_emit_per_second",
+                timed_median(emit, n_ev), "events/s")]
+
+    cfg = LlamaConfig.nano(max_seq_len=256)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, cfg.vocab_size, size=24).tolist()
+               for _ in range(8)]
+    new_tokens = 8 if quick else 32
+
+    def churn(trace):
+        eng = DecodeEngine(params, cfg, batch_slots=4,
+                           max_len=cfg.max_seq_len,
+                           enable_metrics=False, trace=trace)
+        for p in prompts:
+            eng.submit(p, new_tokens)
+        eng.run()         # compile warmup
+        for p in prompts:
+            eng.submit(p, new_tokens)
+        t0 = time.perf_counter()
+        eng.run()
+        return time.perf_counter() - t0
+
+    churn(False)          # shared jit cache warm
+    n_tok = len(prompts) * new_tokens
+    off = statistics.median([churn(False) for _ in range(TRIALS)])
+    on = statistics.median([churn(True) for _ in range(TRIALS)])
+    results.append(("tracer_off_decode_us_per_token",
+                    off / n_tok * 1e6, "us"))
+    results.append(("tracer_on_decode_us_per_token",
+                    on / n_tok * 1e6, "us"))
+    results.append(("tracer_overhead_frac",
+                    (on - off) / off if off else 0.0, "frac"))
+    return results
+
+
 def main(quick: bool = False):
     import numpy as np
 
@@ -522,6 +585,9 @@ def main(quick: bool = False):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     for name, value, unit in _fleet_router_section(quick):
+        print(json.dumps({"metric": name, "value": round(value, 4),
+                          "unit": unit}), flush=True)
+    for name, value, unit in _tracer_overhead_section(quick):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     results = []

@@ -80,6 +80,73 @@ def snapshots() -> List[Dict[str, Any]]:
         return [m.snapshot() for m in _registry.values()]
 
 
+# -- Prometheus text exposition ---------------------------------------------
+#
+# The ONE renderer for metric snapshots -> exposition format, shared by
+# the dashboard head's /metrics route (GCS-aggregated rows) and
+# util.metrics.prometheus_text() (this process's registry). Keeping it
+# next to the registry means the snapshot dict shape and its renderer
+# can never drift apart.
+
+def escape_label(value: str) -> str:
+    """Prometheus exposition-format label escaping (backslash, quote,
+    newline) — unescaped user tag values would break the whole scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping: backslash and newline only (the format
+    leaves quotes alone there)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def prometheus_text(rows: Optional[List[Dict[str, Any]]] = None,
+                    prefix: str = "ray_tpu_") -> str:
+    """Render metric snapshot rows (`snapshots()` by default) as
+    Prometheus text exposition: one `# HELP` / `# TYPE` header per
+    metric with every series of that metric grouped under it (the
+    format REQUIRES samples of one metric to be contiguous), sorted
+    label rendering, and cumulative histogram `_bucket{le=...}` lines
+    ending in the implicit `+Inf` bucket plus `_sum` / `_count`.
+    Metric names are mangled `<prefix> + name.replace('.', '_')` —
+    `util.metrics` dots become Prometheus underscores."""
+    if rows is None:
+        rows = snapshots()
+    groups: Dict[str, List[Dict[str, Any]]] = {}
+    for m in rows:
+        name = prefix + m["name"].replace(".", "_")
+        groups.setdefault(name, []).append(m)
+    lines: List[str] = []
+    for name, ms in groups.items():
+        first = ms[0]
+        if first.get("description"):
+            lines.append(
+                f"# HELP {name} {_escape_help(first['description'])}")
+        kind = {"counter": "counter", "gauge": "gauge",
+                "histogram": "histogram"}[first["kind"]]
+        lines.append(f"# TYPE {name} {kind}")
+        for m in ms:
+            tag_str = ",".join(f'{k}="{escape_label(v)}"'
+                               for k, v in sorted(m["tags"].items()))
+            label = f"{{{tag_str}}}" if tag_str else ""
+            if m["kind"] == "histogram":
+                cumulative = 0
+                bounds = m.get("boundaries", [])
+                for i, c in enumerate(m.get("bucket_counts", [])):
+                    cumulative += c
+                    le = bounds[i] if i < len(bounds) else "+Inf"
+                    extra = f'le="{le}"'
+                    tags = (f"{{{tag_str},{extra}}}" if tag_str
+                            else f"{{{extra}}}")
+                    lines.append(f"{name}_bucket{tags} {cumulative}")
+                lines.append(f"{name}_sum{label} {m.get('sum', 0)}")
+                lines.append(f"{name}_count{label} {m.get('count', 0)}")
+            else:
+                lines.append(f"{name}{label} {m['value']}")
+    return "\n".join(lines) + "\n"
+
+
 def _push_loop(interval_s: float) -> None:
     from ray_tpu._private.worker import global_worker_or_none
 

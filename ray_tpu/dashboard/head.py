@@ -44,43 +44,15 @@ from typing import Any, Dict, List, Optional
 logger = logging.getLogger(__name__)
 
 
-def _escape_label(value: str) -> str:
-    """Prometheus exposition-format label escaping (backslash, quote,
-    newline) — unescaped user tag values would break the whole scrape."""
-    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
-            .replace("\n", "\\n"))
-
-
 def _prometheus_text(metrics: List[Dict[str, Any]]) -> str:
-    lines = []
-    seen_meta = set()
-    for m in metrics:
-        name = "ray_tpu_" + m["name"].replace(".", "_")
-        if name not in seen_meta:
-            seen_meta.add(name)
-            if m.get("description"):
-                lines.append(f"# HELP {name} {m['description']}")
-            kind = {"counter": "counter", "gauge": "gauge",
-                    "histogram": "histogram"}[m["kind"]]
-            lines.append(f"# TYPE {name} {kind}")
-        tag_str = ",".join(f'{k}="{_escape_label(v)}"'
-                           for k, v in sorted(m["tags"].items()))
-        label = f"{{{tag_str}}}" if tag_str else ""
-        if m["kind"] == "histogram":
-            cumulative = 0
-            bounds = m.get("boundaries", [])
-            for i, c in enumerate(m.get("bucket_counts", [])):
-                cumulative += c
-                le = bounds[i] if i < len(bounds) else "+Inf"
-                extra = f'le="{le}"'
-                tags = f"{{{tag_str},{extra}}}" if tag_str else \
-                    f"{{{extra}}}"
-                lines.append(f"{name}_bucket{tags} {cumulative}")
-            lines.append(f"{name}_sum{label} {m.get('sum', 0)}")
-            lines.append(f"{name}_count{label} {m.get('count', 0)}")
-        else:
-            lines.append(f"{name}{label} {m['value']}")
-    return "\n".join(lines) + "\n"
+    # Canonical renderer lives beside the registry so local
+    # (util.metrics.prometheus_text) and cluster-wide (this route)
+    # exposition can never drift; it also groups each metric's series
+    # contiguously, which the exposition format requires and the old
+    # in-place renderer got wrong for interleaved GCS rows.
+    from ray_tpu._private.metrics import prometheus_text
+
+    return prometheus_text(metrics)
 
 
 class DashboardHead:

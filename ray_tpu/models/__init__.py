@@ -48,6 +48,7 @@ from ray_tpu.models.t5 import (
 )
 from ray_tpu.models.engine import DecodeEngine
 from ray_tpu.models.engine_metrics import EngineMetrics
+from ray_tpu.models.engine_trace import EngineTracer, NullEngineTracer
 from ray_tpu.models.fleet import (
     EngineStatsAutoscaler,
     FleetAutoscalingConfig,
@@ -103,6 +104,8 @@ __all__ = [
     "EngineDraining",
     "EngineMetrics",
     "EngineOverloaded",
+    "EngineTracer",
+    "NullEngineTracer",
     "EngineStatsAutoscaler",
     "FIFOPolicy",
     "FleetAutoscalingConfig",

@@ -69,6 +69,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.models.block_pool import BlockPool
 from ray_tpu.models.engine_metrics import EngineMetrics, NullEngineMetrics
+from ray_tpu.models.engine_trace import resolve_tracer
 from ray_tpu.models.generate import (_check_sampling_knobs,
                                      _layer_body, forward_cached_rows,
                                      init_cache, sample_rows)
@@ -839,6 +840,7 @@ class DecodeEngine:
                  sharding_rules=None,
                  engine_id: Optional[str] = None,
                  enable_metrics: bool = True,
+                 trace=None,
                  clock: Callable[[], float] = time.monotonic):
         _check_sampling_knobs(greedy, top_k, top_p)
         if on_full not in ("reject", "block"):
@@ -888,6 +890,15 @@ class DecodeEngine:
         self.metrics = (EngineMetrics(engine_id=engine_id,
                                       batch_slots=self.B, clock=clock)
                         if enable_metrics else NullEngineMetrics())
+        # Request-lifecycle tracer (engine_trace.py): `trace=` takes an
+        # EngineTracer, True (build one), False (force off), or None —
+        # defer to the RAY_TPU_TRACE env gate, else the no-op tracer.
+        # Every hot-path call site guards on `self.trace.enabled`, so
+        # the default costs one attribute read per seam.
+        self.engine_id = engine_id or (self.metrics.engine_id
+                                       if enable_metrics else "engine")
+        self.trace = resolve_tracer(trace, engine_id=self.engine_id,
+                                    clock=clock)
 
         # Tensor parallelism over an ICI mesh: `tp=n` builds a
         # {"tp": n} mesh over the first n visible devices; `mesh=`
@@ -1167,6 +1178,13 @@ class DecodeEngine:
             self._next_id += 1
             self.results[req.req_id] = req
             self.metrics.on_submit(req.req_id)
+            if self.trace.enabled:
+                self.trace.instant(
+                    "submit", req.req_id,
+                    {"prompt_tokens": len(prompt),
+                     "max_new_tokens": max_new_tokens,
+                     "priority": priority})
+                self.trace.open("queue_wait", req.req_id)
             self._shed(req)
             return req.req_id
         if self.max_queue is not None and \
@@ -1187,6 +1205,13 @@ class DecodeEngine:
         self.results[req.req_id] = req
         self.metrics.on_submit(req.req_id)
         self.metrics.observe_queue_depth(len(self.scheduler))
+        if self.trace.enabled:
+            self.trace.instant(
+                "submit", req.req_id,
+                {"prompt_tokens": len(prompt),
+                 "max_new_tokens": max_new_tokens,
+                 "priority": priority})
+            self.trace.open("queue_wait", req.req_id)
         return req.req_id
 
     def pending(self) -> bool:
@@ -1270,6 +1295,9 @@ class DecodeEngine:
                 continue       # queue drained to empty (or deferred)
             admissions.append((row, req))
             budget -= 1
+        if deferred and self.trace.enabled:
+            self.trace.instant("admission_defer", lane="events",
+                               args={"queued": len(self.scheduler)})
         if admissions:
             self._admit_rows(admissions)
         self._advance_prefills()
@@ -1343,6 +1371,8 @@ class DecodeEngine:
         row state (run-ahead). The token block's `copy_to_host_async`
         is issued immediately, so the transfer overlaps the device
         computing the block — and any queued successors."""
+        tr = self.trace
+        t0 = tr.now() if tr.enabled else 0.0
         if chain is None:
             active = np.array([self.row_req[b] is not None
                                and b not in self._row_prefill
@@ -1384,6 +1414,10 @@ class DecodeEngine:
                                         chain=(rl, ac, bu, ti)))
         self.decode_dispatches += 1
         self.metrics.on_dispatch(H, host_syncs=0)
+        if tr.enabled:
+            tr.add("dispatch", t0, tr.now() - t0, lane="dispatch",
+                   args={"horizon": H, "rows": len(rows),
+                         "run_ahead": chain is not None})
 
     def _top_up_pipeline(self, rows: List[int],
                          horizon: Optional[int]) -> None:
@@ -1429,6 +1463,8 @@ class DecodeEngine:
         the ring topped up first, the device is already computing the
         next step(s) while this replay runs — the overlap that hides
         the host bookkeeping."""
+        tr = self.trace
+        t0 = tr.now() if tr.enabled else 0.0
         entry = self._ring.popleft()
         depth = len(self._ring) + 1    # steps in flight at this drain
         self._pl_depth_sum += depth
@@ -1440,6 +1476,10 @@ class DecodeEngine:
         self.metrics.on_host_sync(nbytes=nbytes)
         self._emit_block(block, entry, emitted)
         self.metrics.on_pipeline_drain(depth, len(self._ring))
+        if tr.enabled:
+            tr.add("host_drain", t0, tr.now() - t0, lane="drain",
+                   args={"horizon": entry.H, "depth": depth,
+                         "bytes": nbytes})
 
     def _flush_pipeline(self, emitted: Dict[int, List[int]]) -> None:
         """Drain EVERY in-flight step. Called before any admission /
@@ -1449,8 +1489,14 @@ class DecodeEngine:
             return
         self.pipeline_flushes += 1
         self.metrics.on_pipeline_flush()
+        tr = self.trace
+        t0 = tr.now() if tr.enabled else 0.0
+        steps = len(self._ring)
         while self._ring:
             self._drain_one(emitted)
+        if tr.enabled:
+            tr.add("pipeline_flush", t0, tr.now() - t0, lane="drain",
+                   args={"steps": steps})
 
     def stats(self) -> Dict[str, float]:
         """Flat numeric telemetry snapshot (EngineMetrics.stats) plus
@@ -1558,6 +1604,14 @@ class DecodeEngine:
             self.step()
         return {rid: self.pop_result(rid) for rid in list(self.finished)}
 
+    def dump_trace(self, path: Optional[str] = None) -> List[dict]:
+        """chrome://tracing export of this engine's request-lifecycle
+        spans (pid = engine_id, tid = one lane per request plus
+        `engine:dispatch` / `engine:drain` step lanes). Writes JSON to
+        `path` (falling back to the RAY_TPU_TRACE dump path) and
+        returns the event list — empty with tracing off."""
+        return self.trace.dump(path, pid=self.engine_id)
+
     def pop_result(self, req_id: int) -> List[int]:
         """Remove a FINISHED request from the engine and return its
         generated tokens. Long-running callers driving step() directly
@@ -1579,6 +1633,9 @@ class DecodeEngine:
         routing to a DRAINING replica, keeps stepping it until
         `pending()` reads False, then removes it — so an admitted
         token is never lost to a scale decision. Idempotent."""
+        if self.trace.enabled and not self.draining:
+            self.trace.instant("drain", lane="events",
+                               args={"queued": len(self.scheduler)})
         self.draining = True
 
     def drain(self) -> Dict[int, List[int]]:
@@ -1679,6 +1736,9 @@ class DecodeEngine:
         self.shed_ids.add(req.req_id)
         self.requests_shed += 1
         self.metrics.on_shed(req.req_id)
+        if self.trace.enabled:
+            self.trace.close("queue_wait", req.req_id, {"shed": True})
+            self.trace.finish(req.req_id, {"shed": True}, name="shed")
 
     def _on_prefix_evict(self, n: int) -> None:
         self.prefix_evictions += n
@@ -1716,6 +1776,9 @@ class DecodeEngine:
         copy_groups: Dict[int, List[Tuple[int, List[int]]]] = {}
         for row, req in admissions:
             self.metrics.on_admit(req.req_id)   # queue wait ends here
+            if self.trace.enabled:
+                self.trace.close("queue_wait", req.req_id)
+                self.trace.instant("admit", req.req_id, {"row": row})
             start = 0
             nodes: list = []
             if self._prefix is not None:
@@ -1737,6 +1800,10 @@ class DecodeEngine:
                     copy_groups.setdefault(nbp, []).append((row, ids_p))
                 nodes = self._prefix.extend(req.prompt)
                 self.metrics.on_prefix(hit=bool(ids), reused_tokens=start)
+                if self.trace.enabled:
+                    self.trace.instant(
+                        "prefix_match", req.req_id,
+                        {"hit": bool(ids), "matched_tokens": start})
             self.row_req[row] = req
             self.row_len[row] = start          # frontier: copied prefix
             self.row_budget[row] = req.max_new_tokens
@@ -1786,6 +1853,9 @@ class DecodeEngine:
                     self._swapped[req.req_id] = swap
                     self._requeue_front(req)
                 continue
+            if self.trace.enabled:
+                self.trace.close("queue_wait", req.req_id)
+                self.trace.instant("admit", req.req_id, {"row": row})
             start = 0
             shared: List[int] = []
             cow_src: Optional[int] = None
@@ -1813,6 +1883,10 @@ class DecodeEngine:
             new_ids = self._pool_alloc(n_total - len(shared))
             if new_ids is None:
                 self.kv_pool.decref(shared)
+                if self.trace.enabled:
+                    # Back to the queue: re-open queue_wait so the
+                    # retry wait stays a span, not a trace gap.
+                    self.trace.open("queue_wait", req.req_id)
                 self._requeue_front(req)
                 continue
             if cow_src is not None:
@@ -1829,6 +1903,12 @@ class DecodeEngine:
                 if shared:
                     self.metrics.on_kv_shared(len(shared))
                 self.metrics.on_prefix(hit=hit, reused_tokens=start)
+                if self.trace.enabled:
+                    self.trace.instant(
+                        "prefix_match", req.req_id,
+                        {"hit": hit, "matched_tokens": start,
+                         "shared_blocks": len(shared),
+                         "cow": cow_src is not None})
                 nodes = self._prefix.register(req.prompt, chain)
             self._bind_row(row, req, chain, start)
             self._row_prefill[row] = _PrefillState(req, start, nodes)
@@ -1980,7 +2060,9 @@ class DecodeEngine:
             self.swap_outs += 1
             self.swap_out_bytes += nbytes
             self.metrics.on_swap_out(nbytes)
+            swap_bytes = nbytes
         else:
+            swap_bytes = 0
             self._swapped[req.req_id] = _SwapState(
                 None, None, len(ids), int(self.row_len[row]),
                 int(self._tok_idx[row]), int(self.row_budget[row]),
@@ -1992,6 +2074,11 @@ class DecodeEngine:
         self._tok_idx[row] = 0
         self.preemptions += 1
         self.metrics.on_preempt()
+        if self.trace.enabled:
+            self.trace.span_since_mark(
+                "preempt_swap_out", req.req_id,
+                {"mode": self.preempt_mode, "blocks": len(ids),
+                 "bytes": swap_bytes})
         req.resume = True
         self._requeue_front(req)
 
@@ -2018,6 +2105,11 @@ class DecodeEngine:
             self._row_prefill[row] = _PrefillState(req, 0, [],
                                                    prompt=replay)
             self.swap_ins += 1
+            if self.trace.enabled:
+                self.trace.span_since_mark(
+                    "swap_in", req.req_id,
+                    {"mode": "recompute",
+                     "replay_tokens": len(replay)})
             return True
         ids = self._pool_alloc(swap.n_blocks)
         if ids is None:
@@ -2042,6 +2134,11 @@ class DecodeEngine:
         self.swap_ins += 1
         self.swap_in_bytes += nbytes
         self.metrics.on_swap_in(nbytes)
+        if self.trace.enabled:
+            self.trace.span_since_mark(
+                "swap_in", req.req_id,
+                {"mode": "swap", "bytes": nbytes,
+                 "blocks": swap.n_blocks})
         return True
 
     def _release_row_blocks(self, row: int) -> None:
@@ -2110,6 +2207,7 @@ class DecodeEngine:
         for Cb in sorted(groups):
             grp = groups[Cb]
             n = len(grp)
+            t0 = self.trace.now() if self.trace.enabled else 0.0
             n_pad = _pow2(n)
             prompts = np.zeros((n_pad, Cb), np.int32)
             rows = np.zeros((n_pad,), np.int32)
@@ -2146,11 +2244,21 @@ class DecodeEngine:
             self.prefill_real_tokens += real
             self.prefill_padded_tokens += padded
             self.metrics.on_prefill_batch(real, padded)
+            if self.trace.enabled:
+                self.trace.add("prefill_dispatch", t0,
+                               self.trace.now() - t0, lane="dispatch",
+                               args={"bucket": Cb, "rows": n,
+                                     "real": real, "padded": padded})
         done_rows = []
         for grp in groups.values():
             for row, st, C in grp:
                 st.pos += C
                 self.row_len[row] = st.pos
+                if self.trace.enabled:
+                    self.trace.span_since_mark(
+                        "prefill_chunk", st.req.req_id,
+                        {"pos": st.pos, "tokens": C,
+                         "prompt_tokens": len(st.prompt)})
                 if self._prefix is not None:
                     if self.paged:
                         self._commit_covered(row, st)
@@ -2214,6 +2322,7 @@ class DecodeEngine:
         run-ahead blocks dispatched before the host replayed the
         retiring block; their columns are all-masked on device and
         accounted as `pipeline_overrun_tokens`."""
+        tr = self.trace
         for b in entry.rows:
             req = self.row_req[b]
             if req is None:
@@ -2229,6 +2338,11 @@ class DecodeEngine:
             req.tokens.extend(toks)
             emitted.setdefault(req.req_id, []).extend(toks)
             self.metrics.on_tokens(req.req_id, count)
+            if tr.enabled:
+                tr.span_since_mark(
+                    "decode_block", req.req_id,
+                    {"tokens": count, "horizon": entry.H,
+                     "batch": len(entry.rows)})
             self.row_budget[b] -= count
             self._tok_idx[b] += count
             out_of_room = self.row_len[b] + count >= self.max_len
@@ -2238,6 +2352,9 @@ class DecodeEngine:
                 req.done = True
                 self.finished.add(req.req_id)
                 self.metrics.on_finish(req.req_id)
+                if tr.enabled:
+                    tr.finish(req.req_id,
+                              {"tokens": len(req.tokens)})
                 self.row_req[b] = None
                 self.row_len[b] = 0      # slot free for the next prefill
                 self.row_budget[b] = 0

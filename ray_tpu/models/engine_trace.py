@@ -1,0 +1,280 @@
+"""Request-lifecycle tracing for the serving stack.
+
+`EngineTracer` is the per-request observability twin of
+`engine_metrics.EngineMetrics`: where metrics aggregate (counters,
+window percentiles), the tracer keeps the individual spans — one
+bounded ring buffer of (name, req_id, lane, t0, dur, args) records fed
+by `DecodeEngine` at the exact seams where the metrics hooks already
+fire, and stitched across replicas by `LLMFleet`. `dump_trace()` emits
+chrome://tracing complete events through `util.timeline`'s shared
+event shape, so an engine trace, a fleet trace and a `ray timeline`
+task dump all concatenate into one loadable file.
+
+Design rules (mirroring engine_metrics):
+
+- Zero-cost-when-off. The default is `NULL_TRACER`, a no-op twin with
+  ``enabled = False``; every engine hot-path call site guards with
+  ``if tr.enabled:`` so the off path never builds an args dict, never
+  reads a clock, never allocates. `tests/test_perf_gates.py` pins
+  this with a tracemalloc gate.
+- Bounded-memory-when-on. The ring overwrites its OLDEST record when
+  full and counts the overwrite in ``events_dropped`` — a long churn
+  run keeps the most recent window, never grows without bound.
+- Injectable ``clock=`` (monotonic by default), same discipline as
+  `EngineMetrics`: tests drive spans on a FakeClock.
+
+Per-request spans are CONTIGUOUS by construction: each request carries
+a frontier timestamp (`_req_mark`) advanced by every span emitted for
+it, so queue_wait + prefill_chunk* + swap spans + decode_block* sums
+exactly to submit->finish wall time — the property `tools/trace_report.py`
+and the lifecycle tests lean on.
+
+Env gate: ``RAY_TPU_TRACE=<prefix>`` (the `_private/profiling_hook.py`
+pattern) turns tracing on for every engine constructed with
+``trace=None`` and dumps ``<prefix>.<engine_id>.<pid>.trace.json`` at
+process exit. ``RAY_TPU_PROFILE`` composes independently: it profiles
+the host control plane with cProfile, this traces requests — setting
+both gets both artifacts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from ray_tpu.util.timeline import chrome_complete_event
+
+ENV_TRACE = "RAY_TPU_TRACE"
+
+# Default ring capacity: ~16k spans covers thousands of requests of
+# recent history at a few spans per request, at < 2 MiB of host RAM.
+DEFAULT_CAPACITY = 16384
+
+
+class EngineTracer:
+    """Bounded ring buffer of lifecycle spans.
+
+    Records are tuples ``(name, req_id, lane, t0, dur, args)``;
+    ``req_id=None`` marks an engine-level span (dispatch / host-drain
+    lanes), ``dur=0.0`` an instant marker. `chrome_events()` maps them
+    to the trace-viewer layout: pid = this tracer's id (the replica),
+    tid = ``req-<id>`` per request or ``engine:<lane>`` for engine
+    lanes."""
+
+    enabled = True
+
+    def __init__(self, *, capacity: int = DEFAULT_CAPACITY,
+                 clock: Callable[[], float] = time.monotonic,
+                 engine_id: Optional[str] = None,
+                 dump_path: Optional[str] = None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self.clock = clock
+        self.engine_id = engine_id or "engine"
+        self.dump_path = dump_path
+        self.events_dropped = 0
+        self._buf: List[Optional[tuple]] = [None] * capacity
+        self._n = 0          # records ever written
+        # Open spans awaiting their close (queue_wait mostly) and the
+        # per-request contiguity frontier. Both are pruned on
+        # finish/shed, so they stay O(live + queued requests).
+        self._open: Dict[Tuple[str, Any], float] = {}
+        self._req_mark: Dict[Any, float] = {}
+
+    # -- primitives --------------------------------------------------------
+
+    def now(self) -> float:
+        return self.clock()
+
+    def add(self, name: str, t0: float, dur: float = 0.0,
+            req_id: Any = None, lane: Optional[str] = None,
+            args: Optional[dict] = None) -> None:
+        """Append one record; overwrite the oldest (and count the
+        drop) when the ring is full."""
+        if self._n >= self.capacity:
+            self.events_dropped += 1
+        self._buf[self._n % self.capacity] = (
+            name, req_id, lane, t0, dur, args)
+        self._n += 1
+
+    def instant(self, name: str, req_id: Any = None,
+                args: Optional[dict] = None,
+                lane: Optional[str] = None) -> None:
+        self.add(name, self.clock(), 0.0, req_id, lane, args)
+
+    def open(self, name: str, req_id: Any) -> None:
+        """Mark the start of a span closed later by `close` (or
+        synthesized as still-open at dump time, the `util/timeline.py`
+        discipline for hung work)."""
+        self._open[(name, req_id)] = self.clock()
+
+    def close(self, name: str, req_id: Any,
+              args: Optional[dict] = None) -> float:
+        """Emit the span opened by `open`; returns its end time (which
+        also becomes the request's contiguity frontier)."""
+        t1 = self.clock()
+        t0 = self._open.pop((name, req_id), None)
+        if t0 is not None:
+            self.add(name, t0, t1 - t0, req_id, None, args)
+        self._req_mark[req_id] = t1
+        return t1
+
+    def mark(self, req_id: Any) -> None:
+        """Reset a request's frontier to now (span-less advance)."""
+        self._req_mark[req_id] = self.clock()
+
+    def span_since_mark(self, name: str, req_id: Any,
+                        args: Optional[dict] = None) -> None:
+        """Emit a span from the request's frontier to now and advance
+        the frontier — the primitive that keeps each request's spans
+        contiguous (durations sum to end-to-end latency)."""
+        t1 = self.clock()
+        t0 = self._req_mark.get(req_id, t1)
+        self.add(name, t0, t1 - t0, req_id, None, args)
+        self._req_mark[req_id] = t1
+
+    def finish(self, req_id: Any, args: Optional[dict] = None,
+               name: str = "finish") -> None:
+        """Instant `finish` (or `shed`) marker + drop the request's
+        frontier/open state (bounded bookkeeping under endless
+        churn)."""
+        self.add(name, self.clock(), 0.0, req_id, None, args)
+        self._req_mark.pop(req_id, None)
+        for key in [k for k in self._open if k[1] == req_id]:
+            del self._open[key]
+
+    # -- introspection / export --------------------------------------------
+
+    def __len__(self) -> int:
+        return min(self._n, self.capacity)
+
+    def events(self) -> List[tuple]:
+        """Ring contents, oldest first."""
+        if self._n <= self.capacity:
+            return [e for e in self._buf[:self._n]]
+        i = self._n % self.capacity
+        return [e for e in self._buf[i:] + self._buf[:i]]
+
+    def chrome_events(self, pid: Any = None) -> List[dict]:
+        """Ring -> chrome://tracing complete events (plus synthesized
+        still-open spans for anything `open`ed but never closed), in
+        timestamp order."""
+        pid = self.engine_id if pid is None else pid
+        out = []
+        for name, req_id, lane, t0, dur, args in self.events():
+            tid = (f"req-{req_id}" if req_id is not None
+                   else f"engine:{lane or 'events'}")
+            out.append(chrome_complete_event(
+                name, "request" if req_id is not None else "engine",
+                t0, dur, pid, tid, args))
+        now = self.clock()
+        for (name, req_id), t0 in self._open.items():
+            out.append(chrome_complete_event(
+                name, "request", t0, now - t0, pid, f"req-{req_id}",
+                {"open": True}))
+        out.sort(key=lambda e: e["ts"])
+        return out
+
+    def dump(self, path: Optional[str] = None,
+             pid: Any = None) -> List[dict]:
+        """Write (and return) the chrome-trace JSON. ``path=None``
+        falls back to the env-gate dump path; with neither, the events
+        are just returned."""
+        events = self.chrome_events(pid=pid)
+        path = path or self.dump_path
+        if path:
+            with open(path, "w") as f:
+                json.dump(events, f)
+        return events
+
+
+class NullEngineTracer:
+    """No-op twin: every engine/fleet hot-path call site guards on
+    ``enabled`` so the off path costs one attribute read; the methods
+    exist so unguarded callers still work."""
+
+    enabled = False
+    engine_id = "disabled"
+    events_dropped = 0
+    dump_path = None
+
+    def now(self) -> float:
+        return 0.0
+
+    def add(self, *a, **k) -> None:
+        pass
+
+    def instant(self, *a, **k) -> None:
+        pass
+
+    def open(self, *a, **k) -> None:
+        pass
+
+    def close(self, *a, **k) -> float:
+        return 0.0
+
+    def mark(self, *a, **k) -> None:
+        pass
+
+    def span_since_mark(self, *a, **k) -> None:
+        pass
+
+    def finish(self, *a, **k) -> None:
+        pass
+
+    def __len__(self) -> int:
+        return 0
+
+    def events(self) -> List[tuple]:
+        return []
+
+    def chrome_events(self, pid: Any = None) -> List[dict]:
+        return []
+
+    def dump(self, path: Optional[str] = None, pid: Any = None) -> List[dict]:
+        return []
+
+
+NULL_TRACER = NullEngineTracer()
+
+
+def maybe_tracer_from_env(tag: str,
+                          clock: Callable[[], float] = time.monotonic,
+                          ) -> Optional[EngineTracer]:
+    """`RAY_TPU_TRACE=<prefix>` -> an EngineTracer that dumps
+    ``<prefix>.<tag>.<pid>.trace.json`` at process exit (the
+    `profiling_hook.maybe_enable_profiler` pattern); None when the
+    env gate is off."""
+    prefix = os.environ.get(ENV_TRACE)
+    if not prefix:
+        return None
+    import atexit
+
+    tracer = EngineTracer(
+        clock=clock, engine_id=tag,
+        dump_path=f"{prefix}.{tag}.{os.getpid()}.trace.json")
+    atexit.register(tracer.dump)
+    return tracer
+
+
+def resolve_tracer(spec: Union[None, bool, EngineTracer,
+                               NullEngineTracer, "EngineTracer"],
+                   *, engine_id: str,
+                   clock: Callable[[], float] = time.monotonic):
+    """The `trace=` knob: an EngineTracer instance is used as-is,
+    ``True`` builds one, ``False`` forces off, and ``None`` (the
+    default) defers to the RAY_TPU_TRACE env gate."""
+    if spec is None:
+        # Explicit None check: an EngineTracer defines __len__, so a
+        # fresh (empty) one is FALSY — `env_tracer or NULL_TRACER`
+        # would silently discard it.
+        env_tracer = maybe_tracer_from_env(engine_id, clock)
+        return NULL_TRACER if env_tracer is None else env_tracer
+    if spec is False:
+        return NULL_TRACER
+    if spec is True:
+        return EngineTracer(clock=clock, engine_id=engine_id)
+    return spec

@@ -54,10 +54,12 @@ engine's `llm_engine_*` series) and as a flat `stats()` snapshot.
 
 from __future__ import annotations
 
+import json
 import random
 import time
 from typing import Callable, Dict, List, Optional, Tuple, Union
 
+from ray_tpu.models.engine_trace import resolve_tracer
 from ray_tpu.util.metrics import Gauge
 
 __all__ = [
@@ -427,11 +429,21 @@ class LLMFleet:
                  router: Union[str, FleetRouter] = "pow2_affinity",
                  autoscaling: Optional[FleetAutoscalingConfig] = None,
                  fleet_id: str = "fleet-0",
+                 trace=None,
                  clock: Callable[[], float] = time.monotonic):
         self._factory = engine_factory
         self.router = make_router(router)
         self.fleet_id = fleet_id
         self._clock = clock
+        # Fleet-level tracer: holds the `route` spans (one per submit,
+        # carrying the router's scoring decision) that stitch replica
+        # traces into one request story. Same knob semantics as
+        # DecodeEngine(trace=...): instance / True / False / None
+        # (env gate). Replica ENGINE tracing stays the factory's call —
+        # dump_trace() merges whatever replicas traced.
+        self.trace = resolve_tracer(trace, engine_id=fleet_id,
+                                    clock=clock)
+        self._retired_trace: List[dict] = []   # drained replicas' spans
         self.autoscaler = (EngineStatsAutoscaler(autoscaling, clock)
                            if autoscaling is not None else None)
         n = initial_replicas
@@ -506,12 +518,29 @@ class LLMFleet:
         if not running:
             raise RuntimeError(
                 "fleet has no RUNNING replicas to route to")
+        tr = self.trace
+        if tr.enabled:
+            # Snapshot what the router is about to see (pure peek
+            # probes, no LRU perturbation) so the route span carries
+            # the scoring decision, not a post-hoc reconstruction.
+            t0 = tr.now()
+            scores = {r.name: round(replica_score(r, prompt), 2)
+                      for r in running}
+            warm = {r.name: r.engine.prefix_match_tokens(prompt)
+                    for r in running}
         rep = self.router.choose(running, prompt)
         rid = rep.engine.submit(prompt, max_new_tokens,
                                 priority=priority, rng=rng,
                                 deadline_s=deadline_s)
         fid = self._next_fid
         self._next_fid += 1
+        if tr.enabled:
+            tr.add("route", t0, tr.now() - t0, req_id=fid,
+                   args={"replica": rep.name, "rid": rid,
+                         "router": getattr(self.router, "name",
+                                           type(self.router).__name__),
+                         "scores": scores, "warm_tokens": warm,
+                         "warm": warm.get(rep.name, 0) > 0})
         rep.rid_to_fid[rid] = fid
         self._placement[fid] = (rep, rid)
         rep.routed += 1
@@ -604,6 +633,16 @@ class LLMFleet:
             if rep.engine.pending() or rep.engine.finished or \
                     rep.rid_to_fid:
                 continue    # still owes work or unswept results: kept
+            etr = getattr(rep.engine, "trace", None)
+            if etr is not None and etr.enabled:
+                # Keep the drained replica's spans so dump_trace()
+                # still tells the whole story — bounded like the rings
+                # it collects from (oldest spans trimmed first).
+                self._retired_trace.extend(
+                    etr.chrome_events(pid=rep.name))
+                cap = 4 * getattr(etr, "capacity", 16384)
+                if len(self._retired_trace) > cap:
+                    self._retired_trace = self._retired_trace[-cap:]
             self.replicas.remove(rep)
             self.replicas_removed += 1
 
@@ -624,6 +663,28 @@ class LLMFleet:
             self.drain_replica(victim.name)
 
     # -- telemetry ---------------------------------------------------------
+
+    def dump_trace(self, path: Optional[str] = None) -> List[dict]:
+        """One chrome://tracing JSON for the whole fleet: the fleet
+        tracer's `route` spans (pid = fleet id, tid = fleet request
+        lane) merged with every replica engine's lifecycle spans
+        (pid = replica name, tid = replica-local request lane) plus
+        spans harvested from replicas already drained out of the pool.
+        A route span's args carry the chosen replica and its
+        replica-local rid, which is the join key between the two pid
+        groups. Writes JSON to `path` when given; returns the event
+        list (empty when nothing traced)."""
+        events = list(self._retired_trace)
+        for rep in self.replicas:
+            etr = getattr(rep.engine, "trace", None)
+            if etr is not None and etr.enabled:
+                events.extend(etr.chrome_events(pid=rep.name))
+        events.extend(self.trace.chrome_events(pid=self.fleet_id))
+        events.sort(key=lambda e: e["ts"])
+        if path:
+            with open(path, "w") as f:
+                json.dump(events, f)
+        return events
 
     def stats(self) -> Dict[str, float]:
         """Flat fleet snapshot (gauge-friendly, like engine.stats()).

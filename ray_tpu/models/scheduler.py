@@ -223,6 +223,10 @@ class PrefixAffinityPolicy(FIFOPolicy):
         super().__init__()
         self._probe = None
         self._round_cold: set = set()   # group keys popped cold this round
+        self.deferrals = 0   # pops skipped to wait for a warmer admit
+        #                      (observability: the tracer's
+        #                      admission_defer events and this counter
+        #                      say how often affinity held a request)
 
     def attach_prefix_probe(self, probe) -> None:
         self._probe = probe
@@ -243,6 +247,7 @@ class PrefixAffinityPolicy(FIFOPolicy):
                 return req
             matched, key, pending = self._probe(req.prompt)
             if pending or (key is not None and key in self._round_cold):
+                self.deferrals += 1
                 continue                 # warmer next round — defer
             if key is not None and matched == 0:
                 self._round_cold.add(key)   # cold leader for its group

@@ -107,6 +107,15 @@ class LLMFleetServer:
     def stats(self) -> Dict[str, float]:
         return self.fleet.stats()
 
+    def dump_trace(self, path: Optional[str] = None) -> List[Dict]:
+        """chrome://tracing export of the fleet's request-lifecycle
+        spans (route spans + every traced replica's engine spans) —
+        `LLMFleet.dump_trace` passed through, so a Serve handle can
+        pull a timeline off a live deployment:
+        ``handle.dump_trace.remote()``. Empty when tracing is off
+        (``trace=`` knob / RAY_TPU_TRACE env gate)."""
+        return self.fleet.dump_trace(path)
+
     def drain(self) -> None:
         """Flush every replica (prepare_for_shutdown hook): finish all
         queued/in-flight work so a replica actor holding this fleet
@@ -131,7 +140,7 @@ def llm_deployment(engine_factory: Callable[[str], object], *,
     from ray_tpu.serve.deployment import deployment
 
     shim_keys = ("router", "autoscaling", "fleet_id", "report_stats",
-                 "initial_replicas", "clock")
+                 "initial_replicas", "trace", "clock")
     shim_kwargs = {k: deployment_options.pop(k)
                    for k in list(deployment_options)
                    if k in shim_keys}

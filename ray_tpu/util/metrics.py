@@ -8,9 +8,28 @@ exposed as Prometheus text by the dashboard (/metrics).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import metrics as _impl
+
+
+def snapshots() -> List[Dict[str, Any]]:
+    """Snapshot every metric series registered IN THIS PROCESS — rows
+    of ``{name, kind, description, tags, value}`` (histograms add
+    ``boundaries/bucket_counts/sum/count``). This is the local view;
+    the dashboard's /metrics aggregates the same rows cluster-wide via
+    the GCS pusher."""
+    return _impl.snapshots()
+
+
+def prometheus_text(rows: Optional[List[Dict[str, Any]]] = None,
+                    prefix: str = "ray_tpu_") -> str:
+    """Prometheus text exposition of metric snapshot rows (this
+    process's registry by default) — scrape-ready: HELP/TYPE headers,
+    escaped sorted labels, cumulative histogram buckets. The engine and
+    fleet gauges (`llm.engine.*` / `llm.fleet.*`) come out as
+    `ray_tpu_llm_engine_*` / `ray_tpu_llm_fleet_*` series."""
+    return _impl.prometheus_text(rows, prefix=prefix)
 
 
 class _Base:

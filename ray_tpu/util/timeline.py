@@ -2,6 +2,11 @@
 
 Reference: python/ray/_private/profiling.py:84 (`ray timeline` dumps a
 chrome://tracing JSON of task state transitions stored in GcsTaskManager).
+
+`chrome_complete_event` is the one event shape every exporter in the
+tree shares — the GCS task timeline here and the serving tracer
+(`models/engine_trace.py` dump_trace) both emit through it, so a fleet
+trace and a task timeline concatenate into one loadable file.
 """
 
 from __future__ import annotations
@@ -10,16 +15,40 @@ import json
 from typing import Any, Dict, List, Optional
 
 
-def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Returns chrome-trace events; optionally writes them to filename."""
-    from ray_tpu._private.worker import global_worker
+def chrome_complete_event(name: str, cat: str, start_s: float,
+                          dur_s: float, pid: Any, tid: Any,
+                          args: Optional[dict] = None) -> Dict[str, Any]:
+    """One chrome://tracing complete ("X") event. Times are SECONDS in,
+    microseconds out (the trace viewer's unit)."""
+    return {
+        "name": name,
+        "cat": cat,
+        "ph": "X",
+        "ts": start_s * 1e6,
+        "dur": max(0.0, dur_s) * 1e6,
+        "pid": pid,
+        "tid": tid,
+        "args": args or {},
+    }
 
-    events = global_worker().gcs_call("list_task_events",
-                                      {"limit": 100_000}) or []
+
+def events_to_trace(events: List[dict],
+                    now: Optional[float] = None) -> List[Dict[str, Any]]:
+    """Pure pairing logic: GCS task events -> chrome-trace events.
+
+    RUNNING -> FINISHED/FAILED pairs become complete ("X") spans;
+    PROFILE events pass through directly. A RUNNING event that never
+    reached a terminal state is NOT dropped: it becomes an open span
+    stretching to `now` (default: the latest timestamp in the feed)
+    with ``end_state: "RUNNING"`` in its args — hung work shows up in
+    the trace instead of vanishing from it."""
     events = sorted(events, key=lambda e: e.get("time", 0.0))
-    # Pair RUNNING -> FINISHED/FAILED per task into complete ("X") events.
     running: Dict[str, dict] = {}
     trace: List[Dict[str, Any]] = []
+    if now is None:
+        now = max((e.get("time", 0.0) for e in events), default=0.0)
+        now = max(now, max((e.get("end_time", 0.0) for e in events),
+                           default=0.0))
     for ev in events:
         tid = ev["task_id"]
         tid = tid.hex() if isinstance(tid, bytes) else str(tid)
@@ -27,39 +56,45 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         if state == "PROFILE":
             worker = ev.get("worker_id", b"")
             worker = worker.hex() if isinstance(worker, bytes) else worker
-            trace.append({
-                "name": ev.get("name", "span"),
-                "cat": "profile",
-                "ph": "X",
-                "ts": ev["time"] * 1e6,
-                "dur": (ev.get("end_time", ev["time"]) - ev["time"]) * 1e6,
-                "pid": worker[:8],
-                "tid": worker[:8],
-                "args": ev.get("extra", {}),
-            })
+            trace.append(chrome_complete_event(
+                ev.get("name", "span"), "profile", ev["time"],
+                ev.get("end_time", ev["time"]) - ev["time"],
+                worker[:8], worker[:8], ev.get("extra", {})))
         elif state == "RUNNING":
             running[tid] = ev
         elif state in ("FINISHED", "FAILED") and tid in running:
             start = running.pop(tid)
             worker = start.get("worker_id", b"")
             worker = worker.hex() if isinstance(worker, bytes) else worker
-            trace.append({
-                "name": start.get("name", "task"),
-                "cat": "task",
-                "ph": "X",
-                "ts": start["time"] * 1e6,
-                "dur": (ev["time"] - start["time"]) * 1e6,
-                "pid": worker[:8],
-                "tid": worker[:8],
+            trace.append(chrome_complete_event(
+                start.get("name", "task"), "task", start["time"],
+                ev["time"] - start["time"], worker[:8], worker[:8],
                 # Distributed trace context (tracing_helper.py:326
                 # analog): nested calls share trace_id; parent_span_id
                 # is the submitting task. chrome://tracing shows these
                 # in the args pane; exporters can rebuild span trees.
-                "args": {"task_id": tid, "end_state": state,
-                         "trace_id": start.get("trace_id", ""),
-                         "parent_span_id": start.get("parent_span_id",
-                                                     "")},
-            })
+                {"task_id": tid, "end_state": state,
+                 "trace_id": start.get("trace_id", ""),
+                 "parent_span_id": start.get("parent_span_id", "")}))
+    for tid, start in running.items():
+        worker = start.get("worker_id", b"")
+        worker = worker.hex() if isinstance(worker, bytes) else worker
+        trace.append(chrome_complete_event(
+            start.get("name", "task"), "task", start["time"],
+            now - start["time"], worker[:8], worker[:8],
+            {"task_id": tid, "end_state": "RUNNING",
+             "trace_id": start.get("trace_id", ""),
+             "parent_span_id": start.get("parent_span_id", "")}))
+    return trace
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Returns chrome-trace events; optionally writes them to filename."""
+    from ray_tpu._private.worker import global_worker
+
+    events = global_worker().gcs_call("list_task_events",
+                                      {"limit": 100_000}) or []
+    trace = events_to_trace(events)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -114,3 +114,91 @@ def test_write_metrics_configs(tmp_path):
     assert "ray_tpu_a_count" in dashboard_metric_names(board)
     prom = open(out["prometheus"]).read()
     assert "targets: ['127.0.0.1:9999']" in prom
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (the canonical renderer in
+# _private/metrics.py, re-exported by util.metrics and
+# dashboard/metrics_module and served by the head's /metrics route)
+# ---------------------------------------------------------------------------
+
+def test_prometheus_text_exposition_format():
+    """Deterministic rows -> byte-exact exposition: HELP/TYPE headers,
+    sorted + escaped labels, cumulative histogram buckets with the
+    implicit +Inf, _sum/_count, dot->underscore mangling."""
+    from ray_tpu._private.metrics import prometheus_text
+
+    rows = [
+        {"name": "llm.engine.tokens", "kind": "counter",
+         "description": "tokens out",
+         "tags": {"engine": "e0", "a": "x"}, "value": 5.0},
+        {"name": "llm.fleet.replicas", "kind": "gauge",
+         "description": "", "tags": {}, "value": 2.0},
+        {"name": "llm.engine.step_s", "kind": "histogram",
+         "description": "step latency", "tags": {"engine": "e0"},
+         "value": 0.0, "boundaries": [0.01, 0.1],
+         "bucket_counts": [1, 2, 1], "sum": 0.3, "count": 4},
+    ]
+    assert prometheus_text(rows) == (
+        "# HELP ray_tpu_llm_engine_tokens tokens out\n"
+        "# TYPE ray_tpu_llm_engine_tokens counter\n"
+        'ray_tpu_llm_engine_tokens{a="x",engine="e0"} 5.0\n'
+        "# TYPE ray_tpu_llm_fleet_replicas gauge\n"
+        "ray_tpu_llm_fleet_replicas 2.0\n"
+        "# HELP ray_tpu_llm_engine_step_s step latency\n"
+        "# TYPE ray_tpu_llm_engine_step_s histogram\n"
+        'ray_tpu_llm_engine_step_s_bucket{engine="e0",le="0.01"} 1\n'
+        'ray_tpu_llm_engine_step_s_bucket{engine="e0",le="0.1"} 3\n'
+        'ray_tpu_llm_engine_step_s_bucket{engine="e0",le="+Inf"} 4\n'
+        'ray_tpu_llm_engine_step_s_sum{engine="e0"} 0.3\n'
+        'ray_tpu_llm_engine_step_s_count{engine="e0"} 4\n')
+
+
+def test_prometheus_text_escaping_and_grouping():
+    """Label values with quotes/backslashes/newlines are escaped, and
+    INTERLEAVED rows of one metric come out contiguous under a single
+    HELP/TYPE header — the exposition format requires it and
+    aggregated GCS rows arrive interleaved by node."""
+    from ray_tpu._private.metrics import prometheus_text
+
+    rows = [
+        {"name": "m.a", "kind": "counter", "description": "A",
+         "tags": {"t": 'v"1'}, "value": 1.0},
+        {"name": "m.b", "kind": "gauge", "description": "B",
+         "tags": {}, "value": 9.0},
+        {"name": "m.a", "kind": "counter", "description": "A",
+         "tags": {"t": "v\\2\n"}, "value": 2.0},
+    ]
+    text = prometheus_text(rows)
+    assert 'ray_tpu_m_a{t="v\\"1"} 1.0' in text
+    assert 'ray_tpu_m_a{t="v\\\\2\\n"} 2.0' in text
+    lines = text.strip().splitlines()
+    a_lines = [i for i, l in enumerate(lines)
+               if l.startswith("ray_tpu_m_a{")]
+    assert a_lines == [2, 3], f"series interleaved: {lines}"
+    assert lines.count("# TYPE ray_tpu_m_a counter") == 1
+
+
+def test_prometheus_text_from_live_registry():
+    """The util.metrics / metrics_module entry points render THIS
+    process's registry: engine-style series recorded through the
+    public classes become scrapeable ray_tpu_llm_* lines, identical
+    through every entry point (head route included)."""
+    from ray_tpu._private.metrics import snapshots
+    from ray_tpu.dashboard.head import _prometheus_text
+    from ray_tpu.dashboard.metrics_module import prometheus_metrics_text
+    from ray_tpu.util import metrics as um
+
+    Counter("promtest.llm.engine.requests", description="served",
+            tag_keys=("engine",)).inc(3.0, {"engine": "e0"})
+    Gauge("promtest.llm.fleet.queue_depth",
+          description="queued").set(7.0)
+
+    text = um.prometheus_text()
+    assert "# TYPE ray_tpu_promtest_llm_engine_requests counter" in text
+    assert 'ray_tpu_promtest_llm_engine_requests{engine="e0"} 3.0' \
+        in text
+    assert "ray_tpu_promtest_llm_fleet_queue_depth 7.0" in text
+    assert text == prometheus_metrics_text()
+    assert text == _prometheus_text(um.snapshots())
+    assert um.snapshots() == snapshots()

@@ -320,3 +320,65 @@ def test_event_framework(ray_start_cluster):
     # Filterable through the state predicate set.
     warns = ev.list_events(filters=[("source", "=", "test")])
     assert all(r["source"] == "test" for r in warns)
+
+
+# ---------------------------------------------------------------------------
+# timeline pairing logic (pure: no cluster needed)
+# ---------------------------------------------------------------------------
+
+def test_events_to_trace_pairing_and_open_spans():
+    """RUNNING->FINISHED/FAILED pairs become X spans carrying end_state
+    and trace context; PROFILE passes through; an unpaired RUNNING is
+    synthesized as an open span to `now` instead of vanishing."""
+    from ray_tpu.util.timeline import events_to_trace
+
+    events = [
+        {"task_id": "t1", "state": "RUNNING", "time": 1.0,
+         "worker_id": "w1", "name": "good", "trace_id": "tr",
+         "parent_span_id": "pp"},
+        {"task_id": "t1", "state": "FINISHED", "time": 3.0},
+        {"task_id": "t2", "state": "RUNNING", "time": 2.0,
+         "worker_id": "w1", "name": "bad"},
+        {"task_id": "t2", "state": "FAILED", "time": 2.5},
+        {"task_id": "t3", "state": "RUNNING", "time": 4.0,
+         "worker_id": "w2", "name": "hung"},
+        {"task_id": "p", "state": "PROFILE", "time": 1.5,
+         "end_time": 1.7, "worker_id": "w1", "name": "section",
+         "extra": {"k": "v"}},
+    ]
+    trace = events_to_trace(events, now=10.0)
+    assert all(e["ph"] == "X" for e in trace)
+    by_name = {e["name"]: e for e in trace}
+
+    good = by_name["good"]
+    assert good["ts"] == 1.0e6 and good["dur"] == 2.0e6
+    assert good["args"]["end_state"] == "FINISHED"
+    assert good["args"]["trace_id"] == "tr"
+    assert good["args"]["parent_span_id"] == "pp"
+    assert by_name["bad"]["args"]["end_state"] == "FAILED"
+
+    prof = by_name["section"]
+    assert prof["cat"] == "profile"
+    assert prof["dur"] == pytest.approx(0.2e6)
+    assert prof["args"] == {"k": "v"}
+
+    hung = by_name["hung"]               # the satellite fix: still-open
+    assert hung["args"]["end_state"] == "RUNNING"
+    assert hung["dur"] == pytest.approx(6.0e6)   # 4.0 -> now=10.0
+
+
+def test_events_to_trace_default_now_and_terminal_without_start():
+    """Default `now` is the feed's max time/end_time; a terminal event
+    with no RUNNING start is ignored (no negative-duration junk)."""
+    from ray_tpu.util.timeline import events_to_trace
+
+    trace = events_to_trace([
+        {"task_id": "a", "state": "RUNNING", "time": 1.0,
+         "worker_id": b"\xaa\xbb", "name": "open_one"},
+        {"task_id": "z", "state": "FINISHED", "time": 6.0},
+    ])
+    assert len(trace) == 1
+    ev = trace[0]
+    assert ev["name"] == "open_one"
+    assert ev["dur"] == pytest.approx(5.0e6)     # to now = 6.0
+    assert ev["pid"] == b"\xaa\xbb".hex()[:8]

@@ -192,3 +192,61 @@ def test_gate_warm_admission_zero_copy_bytes():
         "paged prefix hits must be zero-copy block shares")
     assert s1["kv_block_cows"] == s0["kv_block_cows"], \
         "non-aligned warm admissions must not pay copy-on-write"
+
+
+def test_gate_null_tracer_zero_allocations_on_decode_path():
+    """Gate (r9, tracing): with tracing OFF (the default NullEngineTracer)
+    a decode churn allocates ZERO bytes inside engine_trace.py —
+    the zero-cost-when-off contract. Counting allocations (tracemalloc
+    filtered to the module), not timing, so it holds on any box: the
+    gate fails if a call site ever builds an args dict or reads a
+    clock before checking `trace.enabled`."""
+    import tracemalloc
+
+    jax = pytest.importorskip("jax")
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models import engine_trace
+    from ray_tpu.models.engine import DecodeEngine
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    eng = DecodeEngine(params, cfg, batch_slots=2, max_len=32)
+    assert eng.trace.enabled is False
+    eng.submit([5, 6, 7], 4)
+    eng.run()                        # compile outside the window
+
+    trace_filter = tracemalloc.Filter(
+        True, engine_trace.__file__)
+    tracemalloc.start()
+    try:
+        for i in range(3):
+            eng.submit([5, 6, 7 + i], 4)
+        eng.run()
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    stats = snap.filter_traces([trace_filter]).statistics("lineno")
+    total = sum(s.size for s in stats)
+    assert total == 0, (
+        f"no-op tracer allocated {total} bytes on the decode path: "
+        + "; ".join(str(s) for s in stats[:5]))
+
+
+def test_gate_tracer_ring_bounded_under_flood():
+    """Gate (r9, tracing): 10k events through a small ring stay
+    BOUNDED — capacity records live, the rest counted in
+    events_dropped, chrome export sized to the ring. A tracer that
+    grew without bound would turn a long serving run into an OOM."""
+    from ray_tpu.models.engine_trace import EngineTracer
+
+    cap = 256
+    tr = EngineTracer(capacity=cap)
+    n = 10_000
+    for i in range(n):
+        tr.span_since_mark("decode_block", i % 7, {"tokens": 1})
+    assert len(tr) == cap
+    assert tr.events_dropped == n - cap
+    assert len(tr._buf) == cap       # storage itself never grew
+    assert len(tr.chrome_events()) == cap
+    # Bookkeeping dicts track live requests, not event volume.
+    assert len(tr._req_mark) == 7 and len(tr._open) == 0

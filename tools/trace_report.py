@@ -1,0 +1,131 @@
+"""Per-request latency breakdown from a dumped engine/fleet trace.
+
+Input is the chrome://tracing JSON that `DecodeEngine.dump_trace()` /
+`LLMFleet.dump_trace()` write (or the RAY_TPU_TRACE atexit dump): a
+flat list of "X"-phase complete events. The span design makes the
+report exact, not sampled — each request's spans are CONTIGUOUS
+(every span starts at the previous one's end), so the phase durations
+sum to the request's end-to-end latency by construction.
+
+Run:  python tools/trace_report.py fleet.trace.json [--top 5]
+
+Prints one row per request — e2e latency plus the fraction spent in
+queue / prefill / decode / swap — a totals line, and the top-N slowest
+requests with their dominant phase. The aggregation functions
+(`load_trace`, `request_breakdowns`, `format_report`) are importable
+so tests and notebooks can drive them on in-memory event lists.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Dict, List, Optional
+
+# span name -> report phase. Spans not listed (route, admit instants,
+# prefix_match, ...) carry args but no duration worth attributing.
+PHASE_OF = {
+    "queue_wait": "queue",
+    "prefill_chunk": "prefill",
+    "decode_block": "decode",
+    "preempt_swap_out": "swap",
+    "swap_in": "swap",
+}
+PHASES = ("queue", "prefill", "decode", "swap")
+
+
+def load_trace(path: str) -> List[Dict[str, Any]]:
+    with open(path) as f:
+        events = json.load(f)
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: expected a JSON list of trace events")
+    return events
+
+
+def request_breakdowns(
+        events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Fold a trace's events into one row per request:
+    ``{req, pid, e2e_s, tokens, shed, <phase>_s, <phase>_frac, ...}``.
+    Requests are keyed (pid, tid) so same-numbered requests on
+    different fleet replicas stay distinct."""
+    rows: Dict[tuple, Dict[str, Any]] = {}
+    for ev in events:
+        tid = str(ev.get("tid", ""))
+        if not tid.startswith("req-"):
+            continue  # engine-lane events (dispatch/drain) aggregate
+            #           batches, not single requests
+        key = (ev.get("pid"), tid)
+        row = rows.get(key)
+        if row is None:
+            row = rows[key] = {
+                "req": tid[len("req-"):], "pid": ev.get("pid"),
+                "t0": None, "t1": None, "tokens": 0, "shed": False,
+                **{f"{p}_s": 0.0 for p in PHASES}}
+        ts, dur = ev.get("ts", 0.0), ev.get("dur", 0.0)
+        row["t0"] = ts if row["t0"] is None else min(row["t0"], ts)
+        row["t1"] = max(row["t1"] or 0.0, ts + dur)
+        name = ev.get("name", "")
+        phase = PHASE_OF.get(name)
+        if phase is not None:
+            row[f"{phase}_s"] += dur / 1e6
+        if name == "finish":
+            row["tokens"] = (ev.get("args") or {}).get("tokens", 0)
+        elif name == "shed":
+            row["shed"] = True
+    out = []
+    for row in rows.values():
+        e2e = max(0.0, (row["t1"] - row["t0"]) / 1e6) \
+            if row["t0"] is not None else 0.0
+        row["e2e_s"] = e2e
+        for p in PHASES:
+            row[f"{p}_frac"] = row[f"{p}_s"] / e2e if e2e > 0 else 0.0
+        del row["t0"], row["t1"]
+        out.append(row)
+    out.sort(key=lambda r: -r["e2e_s"])
+    return out
+
+
+def format_report(rows: List[Dict[str, Any]], top: int = 5) -> str:
+    lines = [f"{'request':>10} {'pid':>8} {'e2e_ms':>9} "
+             f"{'queue%':>7} {'prefill%':>9} {'decode%':>8} "
+             f"{'swap%':>6} {'tokens':>7}"]
+    for r in rows:
+        tag = " SHED" if r["shed"] else ""
+        lines.append(
+            f"{r['req']:>10} {str(r['pid']):>8} "
+            f"{r['e2e_s'] * 1e3:>9.2f} "
+            f"{r['queue_frac'] * 100:>6.1f}% "
+            f"{r['prefill_frac'] * 100:>8.1f}% "
+            f"{r['decode_frac'] * 100:>7.1f}% "
+            f"{r['swap_frac'] * 100:>5.1f}% "
+            f"{r['tokens']:>7}{tag}")
+    if rows:
+        tot = sum(r["e2e_s"] for r in rows)
+        lines.append(
+            f"-- {len(rows)} requests, "
+            f"{sum(r['tokens'] for r in rows)} tokens, "
+            f"sum(e2e) {tot * 1e3:.1f} ms, "
+            f"{sum(r['shed'] for r in rows)} shed")
+        lines.append(f"-- top {min(top, len(rows))} slowest:")
+        for r in rows[:top]:
+            dom = max(PHASES, key=lambda p: r[f"{p}_s"])
+            lines.append(
+                f"   {r['req']} ({r['pid']}): "
+                f"{r['e2e_s'] * 1e3:.2f} ms, "
+                f"{r[f'{dom}_frac'] * 100:.0f}% in {dom}")
+    else:
+        lines.append("-- no request spans in trace")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="chrome trace JSON from dump_trace()")
+    ap.add_argument("--top", type=int, default=5,
+                    help="slowest requests to detail (default 5)")
+    args = ap.parse_args(argv)
+    rows = request_breakdowns(load_trace(args.trace))
+    print(format_report(rows, top=args.top))
+
+
+if __name__ == "__main__":
+    main()
