@@ -670,7 +670,11 @@ def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
         deadline = 0.0 if i % 8 == 7 else None
         arrivals.append((prompt, priority, deadline))
 
-    def run_one(router, n_replicas, trace=False, trace_path=None):
+    def run_one(router, n_replicas, trace=False, trace_path=None,
+                probe_state=False):
+        from ray_tpu.util import metrics_history as mh
+        from ray_tpu.util.state import serving
+
         def factory(name):
             return DecodeEngine(params, cfg, batch_slots=batch_slots,
                                 max_len=max_len, scheduler="priority",
@@ -680,12 +684,23 @@ def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
         fleet = LLMFleet(factory, initial_replicas=n_replicas,
                          router=router, trace=trace,
                          fleet_id=f"bench-{router}-{n_replicas}")
+        probe_samples = []
         t0 = time.perf_counter()
         for i, (prompt, priority, deadline) in enumerate(arrivals):
             fleet.submit(prompt, new_tokens, priority=priority,
                          deadline_s=deadline)
             if i % 2 == 1:       # two arrivals per engine step
                 fleet.step()
+                if probe_state:
+                    # One full status poll against the LIVE churn
+                    # state: fleet rollup + forced history sample.
+                    # Probed every step for statistics; the reported
+                    # overhead uses the median probe cost against a
+                    # 10 Hz poll period (see below).
+                    p0 = time.perf_counter()
+                    serving.summarize_fleet()
+                    mh.sample_now(force=True)
+                    probe_samples.append(time.perf_counter() - p0)
         fleet.run()
         wall = time.perf_counter() - t0
         if trace_path is not None:
@@ -693,6 +708,8 @@ def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
         s = fleet.stats()
         per = [r.engine.stats() for r in fleet.replicas]
         served = n_requests - int(s["requests_shed"])
+        if probe_state:
+            return {"wall_s": wall, "probe_samples": probe_samples}
         return {
             "router": router,
             "n_replicas": n_replicas,
@@ -737,6 +754,25 @@ def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
     trace_overhead = (traced["wall_s"] - aff["wall_s"]) \
         / aff["wall_s"] if aff["wall_s"] else 0.0
 
+    # Observability tax on the identical churn: the affinity arm once
+    # more with a full status poll (`summarize_fleet()` + forced
+    # metrics-history sample) taken against the live mid-churn state
+    # at every step. The reported fraction is the steady-state cost of
+    # a 10 Hz status poller: median per-poll seconds over the 100 ms
+    # poll period. Median, not sum — a single GC pause inside one
+    # probe would otherwise dominate the dry run's tiny wall.
+    # Target: < 1%.
+    # Collect first: engines from the arms above die in reference
+    # cycles, and until the GC runs they linger in the weak serving
+    # registry — the probe would pay a stats sweep over every corpse.
+    import gc
+    gc.collect()
+    probed = run_one("pow2_affinity", n0, probe_state=True)
+    poll_period_s = 0.1
+    state_overhead = (statistics.median(probed["probe_samples"])
+                      / poll_period_s
+                      if probed["probe_samples"] else 0.0)
+
     return {
         "n_groups": n_groups,
         "prefix_len": prefix_len,
@@ -754,6 +790,7 @@ def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
         if rr["prefill_real_tokens"] else 0.0,
         "trace_overhead_frac": round(trace_overhead, 4),
         "trace_artifact": "BENCH_fleet.trace.json",
+        "state_snapshot_overhead_frac": round(state_overhead, 4),
     }
 
 

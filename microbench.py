@@ -561,6 +561,59 @@ def _tracer_overhead_section(quick: bool) -> list:
     return results
 
 
+def _state_snapshot_section(quick: bool) -> list:
+    """Cost of one serving state snapshot (util/state/serving.py) and
+    one metrics-history sample (util/metrics_history.py) against a
+    BUSY engine — queue + live slots + mid-prefill rows, the state a
+    status poller actually reads. Calls/s for each query plus the
+    per-poll microseconds of the full status-CLI read set; these are
+    the numbers behind bench.py's `state_snapshot_overhead_frac`."""
+    import gc
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.engine import DecodeEngine
+    from ray_tpu.util import metrics_history as mh
+    from ray_tpu.util.state import serving
+
+    gc.collect()                  # drop corpses from earlier sections
+    cfg = LlamaConfig.nano(max_seq_len=256)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(7)
+    eng = DecodeEngine(params, cfg, batch_slots=4,
+                       max_len=cfg.max_seq_len, prefix_cache=True,
+                       prefix_block=16)
+    for _ in range(12):           # oversubscribed: queue stays deep
+        eng.submit(rng.randint(1, cfg.vocab_size, size=24).tolist(),
+                   64)
+    eng.step()                    # live slots + queue, mid-churn
+
+    n = 2_000 if quick else 10_000
+    results = []
+    for name, fn in [
+        ("state_list_engines_per_second", serving.list_engines),
+        ("state_list_requests_per_second", serving.list_requests),
+        ("state_summarize_fleet_per_second", serving.summarize_fleet),
+        ("metrics_history_sample_per_second",
+         lambda: mh.sample_now(force=True)),
+    ]:
+        fn()                      # warm lazy paths outside the window
+        results.append((name, timed_median(
+            lambda: [fn() for _ in range(n)], n), "calls/s"))
+
+    def poll():
+        serving.summarize_fleet()
+        mh.sample_now(force=True)
+
+    rate = timed_median(lambda: [poll() for _ in range(n)], n)
+    results.append(("state_full_poll_us", 1e6 / rate if rate else 0.0,
+                    "us"))
+    eng.run()
+    return results
+
+
 def main(quick: bool = False):
     import numpy as np
 
@@ -588,6 +641,9 @@ def main(quick: bool = False):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     for name, value, unit in _tracer_overhead_section(quick):
+        print(json.dumps({"metric": name, "value": round(value, 4),
+                          "unit": unit}), flush=True)
+    for name, value, unit in _state_snapshot_section(quick):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     results = []

@@ -80,6 +80,17 @@ def snapshots() -> List[Dict[str, Any]]:
         return [m.snapshot() for m in _registry.values()]
 
 
+def reset_registry() -> None:
+    """Drop every registered series (TEST ISOLATION, not production):
+    the process-local registry is module state, so counters recorded by
+    one test module would otherwise leak into the next module's
+    snapshots()/prometheus_text() assertions. Metric objects held by
+    callers (EngineMetrics instruments, fleet gauge caches) stay valid
+    — register() lazily re-creates a series on the next record."""
+    with _lock:
+        _registry.clear()
+
+
 # -- Prometheus text exposition ---------------------------------------------
 #
 # The ONE renderer for metric snapshots -> exposition format, shared by

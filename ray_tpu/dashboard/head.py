@@ -30,6 +30,13 @@ Endpoints:
       the live metric registry (dashboard/metrics_module.py)
   GET /api/prometheus_scrape_config -> prometheus.yml text targeting
       this head's /metrics
+  GET /api/v0/state/engines  -> serving state API: live engine rows
+  GET /api/v0/state/requests?status=X&engine_id=Y -> in-flight request
+      rows (status: queued|prefilling|decoding|swapped|draining)
+  GET /api/v0/state/kv_pools -> KV block-pool / prefix-pool occupancy
+  GET /api/v0/state/summary  -> `ray status`-shaped fleet rollup
+  GET /api/v0/metrics_history -> bounded time-series ring of serving
+      gauges (each hit also records one sample, cadence-guarded)
 """
 
 from __future__ import annotations
@@ -145,6 +152,61 @@ class DashboardHead:
             return web.json_response(
                 await offload(self._gcs, "get_metrics") or [],
                 dumps=_dumps)
+
+        # ---- serving state API (ray_tpu.util.state.serving) ----
+        # These read the HEAD PROCESS's registrations: engines/fleets
+        # constructed in this process (driver-embedded dashboard, the
+        # CPU dry-run topology, tests). Pure host snapshots, offloaded
+        # off the event loop like every other route.
+
+        @routes.get("/api/v0/state/engines")
+        async def state_engines(request):
+            from ray_tpu.util.state import serving
+
+            return web.json_response(
+                await offload(serving.list_engines), dumps=_dumps)
+
+        @routes.get("/api/v0/state/requests")
+        async def state_requests(request):
+            from ray_tpu.util.state import serving
+
+            status = request.query.get("status") or None
+            engine_id = request.query.get("engine_id") or None
+            try:
+                rows = await offload(
+                    lambda: serving.list_requests(
+                        status=status, engine_id=engine_id))
+            except ValueError as e:
+                return web.Response(status=400, text=str(e))
+            return web.json_response(rows, dumps=_dumps)
+
+        @routes.get("/api/v0/state/kv_pools")
+        async def state_kv_pools(request):
+            from ray_tpu.util.state import serving
+
+            return web.json_response(
+                await offload(serving.list_kv_pools), dumps=_dumps)
+
+        @routes.get("/api/v0/state/summary")
+        async def state_summary(request):
+            from ray_tpu.util.state import serving
+
+            return web.json_response(
+                await offload(serving.summarize_fleet), dumps=_dumps)
+
+        @routes.get("/api/v0/metrics_history")
+        async def metrics_history_route(request):
+            """Pull-driven history: every hit records one sample into
+            the global ring (the cadence guard makes aggressive polling
+            harmless) and returns the retained window."""
+            from ray_tpu.util import metrics_history as mh
+
+            def sample_and_dump():
+                mh.sample_now()
+                return mh.global_history().snapshot()
+
+            return web.json_response(await offload(sample_and_dump),
+                                     dumps=_dumps)
 
         @routes.get("/api/cluster_status")
         async def cluster_status(request):

@@ -68,6 +68,21 @@ class BlockPool:
         """Current holder count of a block (0 = free)."""
         return self._refs[bid]
 
+    def snapshot(self) -> dict:
+        """Plain-dict ledger view for the state API / status CLI:
+        totals plus how sharing is distributed (blocks with >1 holder
+        are the zero-copy prefix shares; `refs_max` is the hottest
+        block's holder count). Pure host arithmetic over the refcount
+        list — no allocation state is touched."""
+        shared = sum(1 for r in self._refs if r > 1)
+        return {
+            "blocks_total": self.blocks_total,
+            "blocks_in_use": self.blocks_in_use,
+            "free_blocks": len(self._free),
+            "blocks_shared": shared,
+            "refs_max": max(self._refs) if self._refs else 0,
+        }
+
     # -- alloc / share / release -------------------------------------------
 
     def alloc(self, n: int) -> Optional[List[int]]:

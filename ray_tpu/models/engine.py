@@ -1109,6 +1109,17 @@ class DecodeEngine:
             if attach is not None:
                 attach(self._prefix_probe)
 
+        # Serving-state plane: wall-clock birth + a step counter that
+        # survives enable_metrics=False (the metrics `steps` field
+        # vanishes with NullEngineMetrics), then a WEAK registration in
+        # the process-local state API so `ray_tpu.util.state`
+        # list_engines()/list_requests() can find this engine without
+        # holding it alive.
+        self._start_t = clock()
+        self.steps_total = 0
+        from ray_tpu.util.state.serving import register_engine
+        register_engine(self)
+
     # -- public API --------------------------------------------------------
 
     def submit(self, prompt: List[int], max_new_tokens: int = 32,
@@ -1244,6 +1255,7 @@ class DecodeEngine:
         block, whose horizon follows the same budget arithmetic."""
         if horizon is not None and horizon < 1:
             raise ValueError("horizon must be >= 1")
+        self.steps_total += 1
         emitted: Dict[int, List[int]] = {}
         # Flush the pipeline before any admission / prefill / prefix
         # copy: those paths mutate the cache from the host side and
@@ -1513,6 +1525,11 @@ class DecodeEngine:
         out["pending_prefill_tokens"] = float(
             self.pending_prefill_tokens())
         out["draining"] = 1.0 if self.draining else 0.0
+        # Engine lifetime on the injectable clock + the plain-int step
+        # counter (the metrics-plane `steps` field disappears under
+        # enable_metrics=False; these two never do).
+        out["uptime_s"] = max(0.0, self._clock() - self._start_t)
+        out["steps_total"] = float(self.steps_total)
         # Engine-level dispatch accounting (kept even when metrics are
         # disabled — benchmarks read these to report syncs per token).
         # Every derived ratio guards its denominator: a fresh engine

@@ -472,6 +472,11 @@ class LLMFleet:
         self.replicas_removed = 0
         self.tokens_lost_to_drain = 0   # stays 0 by construction;
         #                                 asserted in tests AND here
+        # Weak registration in the serving state API: summarize_fleet /
+        # the status CLI find this fleet (and attribute its replicas'
+        # engines) without the fleet holding any extra lifecycle.
+        from ray_tpu.util.state.serving import register_fleet
+        register_fleet(self)
 
     # -- replica lifecycle -------------------------------------------------
 

@@ -95,6 +95,25 @@ class SchedulerPolicy:
         Callers must not mutate the returned requests or the list."""
         raise NotImplementedError
 
+    def queued_state(self) -> List[dict]:
+        """Plain-dict view of the queue for the state API
+        (`ray_tpu.util.state.list_requests`): one entry per queued
+        request with the fields an operator reads — and the request
+        object itself under ``"request"`` so the caller can classify
+        further (swap ledger, deadlines) without re-walking the queue.
+        Falls back to id-only entries for a custom policy that
+        implements `snapshot()` but not `queued_requests()`. Read-only:
+        never mutates queue order or the requests."""
+        try:
+            reqs = self.queued_requests()
+        except NotImplementedError:
+            return [{"req_id": rid} for rid in self.snapshot()]
+        return [{"req_id": r.req_id, "priority": r.priority,
+                 "prompt_tokens": len(r.prompt),
+                 "max_new_tokens": r.max_new_tokens,
+                 "deadline": r.deadline, "resume": r.resume,
+                 "request": r} for r in reqs]
+
     def horizon_hint(self, *, free_slots: int,
                      max_horizon: int) -> int:
         """Suggested fused-decode horizon for the NEXT engine step

@@ -65,6 +65,11 @@ class LLMFleetServer:
                               autoscaling=autoscaling,
                               fleet_id=fleet_id, **fleet_kwargs)
         self._report_stats = report_stats
+        # Serving state API registration (weak): the deployment body
+        # shows up in `ray_tpu.util.state.servers()` beside the fleet
+        # and engines it fronts.
+        from ray_tpu.util.state.serving import register_server
+        register_server(self)
 
     def generate(self, token_ids: List[int],
                  max_new_tokens: int = 32, priority: int = 0,

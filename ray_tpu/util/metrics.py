@@ -32,6 +32,15 @@ def prometheus_text(rows: Optional[List[Dict[str, Any]]] = None,
     return _impl.prometheus_text(rows, prefix=prefix)
 
 
+def reset_registry() -> None:
+    """TEST HELPER: clear this process's metric registry so series
+    recorded by one test module cannot leak ordering or values into
+    another's `snapshots()` / `prometheus_text()` assertions. Existing
+    Counter/Gauge/Histogram objects keep working — the backing series
+    is lazily re-registered on their next record."""
+    _impl.reset_registry()
+
+
 class _Base:
     _kind = ""
 

@@ -1,0 +1,270 @@
+"""Metrics time-series history: a bounded ring of recent samples.
+
+Reference: the Ray dashboard keeps a short history of key series so an
+operator can answer "what was this doing 60 seconds ago" without
+standing up Prometheus. Here the recorder samples the SERVING stats
+plane — TTFT/TPOT percentiles, occupancy, `kv_used_fraction`, queue
+depth, sheds, swap bytes — on a configurable cadence into a bounded
+buffer, and exposes the window to `dashboard/head.py`
+(`/api/v0/metrics_history`) and the status CLI's trend arrows.
+
+Boundedness is the contract: a recorder left running for days holds at
+most ``capacity`` samples. Past the window it does not simply drop the
+past — when the buffer fills, the OLDEST half is compacted by
+averaging adjacent pairs (weighted by how many raw samples each entry
+already represents), so the retained span keeps doubling at coarser
+resolution while recent samples stay at full cadence: the `ray status`
+trade (fresh detail, coarse history) in ~capacity dicts of memory.
+
+Sampling is pull-driven — `sample(values)` with a stats dict, or
+`sample_now()` which aggregates over the engines registered in the
+serving state API. A cadence guard makes polling idempotent: callers
+can hit the endpoint as fast as they like; at most one sample lands
+per ``cadence_s``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = ["MetricsHistory", "DEFAULT_KEYS", "global_history",
+           "sample_now", "reset_global_history", "trend_of_points",
+           "collect_serving_sample"]
+
+# The operator's SLO-and-pressure shortlist; callers can widen it.
+DEFAULT_KEYS = (
+    "ttft_s_p50", "ttft_s_p95", "tpot_s_p50", "tpot_s_p95",
+    "slot_occupancy", "kv_used_fraction", "queue_depth",
+    "requests_shed", "swap_in_bytes", "swap_out_bytes",
+    "tokens_out", "requests_inflight",
+)
+
+
+class MetricsHistory:
+    """Bounded sample ring with pair-averaging compaction.
+
+    Each retained entry is ``{"t": <clock>, "n": <raw samples
+    folded in>, "values": {key: float}}``. ``capacity`` bounds the
+    entry count forever; ``compactions`` counts how many times the old
+    half was folded. ``clock`` is injectable (the engine/fleet seam) so
+    cadence and trend tests advance time explicitly."""
+
+    def __init__(self, *, capacity: int = 512, cadence_s: float = 1.0,
+                 keys: Optional[Sequence[str]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if capacity < 8:
+            raise ValueError("capacity must be >= 8")
+        if cadence_s < 0:
+            raise ValueError("cadence_s must be >= 0")
+        self.capacity = capacity
+        self.cadence_s = cadence_s
+        self.keys = tuple(keys if keys is not None else DEFAULT_KEYS)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._samples: List[Dict[str, Any]] = []
+        self._last_t: Optional[float] = None
+        self.samples_taken = 0      # raw samples accepted
+        self.samples_skipped = 0    # cadence-guard rejections
+        self.compactions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def due(self) -> bool:
+        """Would an un-forced `sample()` land right now? Callers with
+        an EXPENSIVE values collection (`sample_now` walking every
+        engine's stats) check this first so a cadence-rejected poll
+        costs a clock read, not a stats sweep."""
+        with self._lock:
+            return self._last_t is None or \
+                self._clock() - self._last_t >= self.cadence_s
+
+    # -- recording ---------------------------------------------------------
+
+    def sample(self, values: Dict[str, float],
+               force: bool = False) -> bool:
+        """Record one sample (restricted to `self.keys`); returns
+        whether it landed. Within ``cadence_s`` of the previous sample
+        the call is a cheap no-op unless ``force=True`` — so a polling
+        endpoint and a serving loop can both call this blindly."""
+        now = self._clock()
+        with self._lock:
+            if not force and self._last_t is not None and \
+                    now - self._last_t < self.cadence_s:
+                self.samples_skipped += 1
+                return False
+            self._last_t = now
+            self.samples_taken += 1
+            self._samples.append({
+                "t": now, "n": 1,
+                "values": {k: float(values[k]) for k in self.keys
+                           if k in values}})
+            if len(self._samples) >= self.capacity:
+                self._compact_locked()
+            return True
+
+    def _compact_locked(self) -> None:
+        """Fold the oldest half pairwise: each pair becomes one entry
+        at their weighted-mean time/values. Halves the old half's
+        entry count, doubling its per-entry span — repeated fills give
+        power-of-two resolution tiers, newest at full cadence."""
+        half = len(self._samples) // 2
+        old, recent = self._samples[:half], self._samples[half:]
+        folded: List[Dict[str, Any]] = []
+        for i in range(0, len(old) - 1, 2):
+            a, b = old[i], old[i + 1]
+            na, nb = a["n"], b["n"]
+            n = na + nb
+            vals: Dict[str, float] = {}
+            for k in set(a["values"]) | set(b["values"]):
+                va = a["values"].get(k)
+                vb = b["values"].get(k)
+                if va is None:
+                    vals[k] = vb
+                elif vb is None:
+                    vals[k] = va
+                else:
+                    vals[k] = (va * na + vb * nb) / n
+            folded.append({"t": (a["t"] * na + b["t"] * nb) / n,
+                           "n": n, "values": vals})
+        if len(old) % 2:
+            folded.append(old[-1])
+        self._samples = folded + recent
+        self.compactions += 1
+
+    # -- queries -----------------------------------------------------------
+
+    def series(self, key: str) -> List[tuple]:
+        """[(t, value), ...] oldest-first for one key (entries missing
+        the key are skipped)."""
+        with self._lock:
+            return [(s["t"], s["values"][key]) for s in self._samples
+                    if key in s["values"]]
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return dict(self._samples[-1]) if self._samples else None
+
+    def trend(self, key: str, *, window: int = 8,
+              rel_threshold: float = 0.05) -> int:
+        """Direction of the recent curve: +1 rising, -1 falling, 0
+        flat/unknown — the status CLI's arrow (see
+        `trend_of_points`)."""
+        return trend_of_points([v for _, v in self.series(key)],
+                               window=window,
+                               rel_threshold=rel_threshold)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready dump: knobs, bookkeeping counters, and the
+        retained samples oldest-first."""
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "cadence_s": self.cadence_s,
+                "keys": list(self.keys),
+                "samples_taken": self.samples_taken,
+                "samples_skipped": self.samples_skipped,
+                "compactions": self.compactions,
+                "samples": [
+                    {"t": s["t"], "n": s["n"], **s["values"]}
+                    for s in self._samples],
+            }
+
+
+def trend_of_points(points: Sequence[float], *, window: int = 8,
+                    rel_threshold: float = 0.05) -> int:
+    """+1 rising, -1 falling, 0 flat/unknown: mean of the newest
+    ``window`` points vs the ``window`` before them; moves smaller
+    than ``rel_threshold`` (relative to the older mean, absolute when
+    that is 0) count as flat. Shared by `MetricsHistory.trend` and the
+    status CLI (which re-derives arrows from an HTTP-fetched
+    snapshot)."""
+    if len(points) < 2 * window:
+        return 0
+    new = sum(points[-window:]) / window
+    old = sum(points[-2 * window:-window]) / window
+    base = abs(old) if old else 1.0
+    if new - old > rel_threshold * base:
+        return 1
+    if old - new > rel_threshold * base:
+        return -1
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Process-global recorder over the serving state registry
+# ---------------------------------------------------------------------------
+
+_global_lock = threading.Lock()
+_global: Optional[MetricsHistory] = None
+
+
+def global_history(**kwargs) -> MetricsHistory:
+    """The process's shared recorder (built on first use; kwargs only
+    apply then). The dashboard's /api/v0/metrics_history samples into
+    and serves from this instance."""
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = MetricsHistory(**kwargs)
+        return _global
+
+
+def reset_global_history() -> None:
+    """Drop the shared recorder (test isolation)."""
+    global _global
+    with _global_lock:
+        _global = None
+
+
+def collect_serving_sample() -> Dict[str, float]:
+    """One fleet-wide stats dict from every engine registered in the
+    serving state API: SLO percentiles as maxima (an SLO is judged on
+    the worst replica), occupancy as means, queues/sheds/swap bytes as
+    sums. Host-side reads only."""
+    from ray_tpu.util.state import serving
+
+    engs = serving.engines()
+    vals: Dict[str, float] = {
+        "queue_depth": 0.0, "requests_shed": 0.0, "tokens_out": 0.0,
+        "swap_in_bytes": 0.0, "swap_out_bytes": 0.0,
+        "requests_inflight": 0.0,
+        "ttft_s_p50": 0.0, "ttft_s_p95": 0.0,
+        "tpot_s_p50": 0.0, "tpot_s_p95": 0.0,
+        "slot_occupancy": 0.0, "kv_used_fraction": 0.0,
+    }
+    for eng in engs:
+        s = eng.stats()
+        vals["queue_depth"] += s.get("queue_depth", 0.0)
+        vals["requests_shed"] += s.get("requests_shed", 0.0)
+        vals["tokens_out"] += s.get("tokens_generated",
+                                    float(eng.tokens_out))
+        vals["swap_in_bytes"] += s.get("swap_in_bytes", 0.0)
+        vals["swap_out_bytes"] += s.get("swap_out_bytes", 0.0)
+        vals["requests_inflight"] += (
+            s.get("queue_depth", 0.0) + s.get("live_slots", 0.0))
+        for k in ("ttft_s_p50", "ttft_s_p95",
+                  "tpot_s_p50", "tpot_s_p95"):
+            vals[k] = max(vals[k], s.get(k, 0.0))
+        vals["slot_occupancy"] += s.get("slot_occupancy", 0.0)
+        vals["kv_used_fraction"] += s.get("kv_used_fraction", 0.0)
+    if engs:
+        vals["slot_occupancy"] /= len(engs)
+        vals["kv_used_fraction"] /= len(engs)
+    return vals
+
+
+def sample_now(force: bool = False) -> bool:
+    """Collect one serving sample into the global recorder (cadence
+    guard applies unless forced). The dashboard endpoint calls this on
+    every hit, making history pull-driven: no background thread, no
+    cost when nobody is looking — and a within-cadence hit skips even
+    the stats sweep (see `due`), so aggressive polling stays cheap."""
+    h = global_history()
+    if not force and not h.due():
+        h.samples_skipped += 1
+        return False
+    return h.sample(collect_serving_sample(), force=force)

@@ -4,11 +4,22 @@ Reference: python/ray/util/state/api.py (list_actors/list_nodes/
 list_tasks/list_objects/list_placement_groups + summaries) backed by the
 GCS actor/node/task tables; here each call is one GCS RPC through the
 connected worker.
+
+The SERVING-plane state API (list_engines / list_requests /
+list_kv_pools / summarize_fleet over live DecodeEngine/LLMFleet
+registrations) lives in the `serving` submodule and is re-exported
+here, so `from ray_tpu.util import state; state.list_engines()` works
+the same way the cluster queries do.
 """
 
 from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
+
+from ray_tpu.util.state.serving import (  # noqa: F401
+    engine_requests, engine_state, engines, fleets, list_engines,
+    list_kv_pools, list_requests, register_engine, register_fleet,
+    register_server, reset_serving_state, servers, summarize_fleet)
 
 
 def _gcs(method: str, data: Optional[dict] = None):

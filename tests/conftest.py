@@ -53,6 +53,25 @@ def fake_clock():
     return FakeClock()
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_metric_registry():
+    """Each test module starts from an empty process-local metric
+    registry (util.metrics.reset_registry): counters/gauges recorded by
+    an earlier module would otherwise leak into a later module's
+    snapshots()/prometheus_text() assertions, making pass/fail depend
+    on collection order. The serving state registry gets the same
+    treatment — engines registered (weakly) by one module must not
+    appear in another module's list_engines()."""
+    from ray_tpu.util.metrics import reset_registry
+    from ray_tpu.util.metrics_history import reset_global_history
+    from ray_tpu.util.state.serving import reset_serving_state
+
+    reset_registry()
+    reset_serving_state()
+    reset_global_history()
+    yield
+
+
 # Multi-device pattern for sharded-engine tests: the session itself IS
 # the forced multi-device world — the XLA_FLAGS line above sets
 # --xla_force_host_platform_device_count=8 BEFORE jax initializes, so

@@ -202,3 +202,129 @@ def test_prometheus_text_from_live_registry():
     assert text == prometheus_metrics_text()
     assert text == _prometheus_text(um.snapshots())
     assert um.snapshots() == snapshots()
+
+
+# ---------------------------------------------------------------------------
+# Serving state API + metrics history endpoints (/api/v0/*)
+# ---------------------------------------------------------------------------
+#
+# The dashboard head runs in a thread of THIS process, so engines the
+# test constructs are exactly the head's registrations — the endpoints
+# must agree with the in-process serving API byte-for-byte (modulo the
+# wall-clock age field).
+
+def _get_json(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        return json.load(r)
+
+
+@pytest.fixture()
+def dash_base():
+    from ray_tpu.dashboard import start_dashboard
+
+    port = _free_port()
+    dash = start_dashboard(port=port)
+    yield f"http://127.0.0.1:{port}"
+    dash.stop()
+
+
+def test_state_endpoints_empty_world(dash_base):
+    """Before any engine exists: every state endpoint returns its
+    well-formed empty shape, not an error."""
+    from ray_tpu.util.metrics_history import reset_global_history
+    from ray_tpu.util.state.serving import reset_serving_state
+
+    reset_serving_state()
+    reset_global_history()
+    assert _get_json(dash_base, "/api/v0/state/engines") == []
+    assert _get_json(dash_base, "/api/v0/state/requests") == []
+    assert _get_json(dash_base, "/api/v0/state/kv_pools") == []
+    summary = _get_json(dash_base, "/api/v0/state/summary")
+    assert summary["fleets"] == []
+    assert summary["engines_total"] == 0
+    assert summary["requests_inflight"] == 0
+    hist = _get_json(dash_base, "/api/v0/metrics_history")
+    # The hit itself records one all-zero sample (pull-driven).
+    assert hist["samples"]
+    assert all(v == 0.0 for s in hist["samples"] for k, v in s.items()
+               if k not in ("t", "n"))
+
+
+def test_state_endpoints_live_engine(dash_base):
+    """A live engine with work in flight shows through every endpoint,
+    identical to the in-process serving API; the status filter works
+    over HTTP and a bogus status is a 400, not a 500."""
+    jax = pytest.importorskip("jax")
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.engine import DecodeEngine
+    from ray_tpu.util.state import serving
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    eng = DecodeEngine(params, cfg, batch_slots=2, max_len=32,
+                       prefix_cache=True, prefix_block=4,
+                       engine_id="dash-eng")
+    for p, n in [([5, 6, 7], 8), ([9, 8, 7, 6], 8), ([1, 2], 8),
+                 ([3, 1, 4], 8)]:
+        eng.submit(p, n)
+    eng.step()
+
+    rows = _get_json(dash_base, "/api/v0/state/engines")
+    row, = [r for r in rows if r["engine_id"] == "dash-eng"]
+    assert row["batch_slots"] == 2
+    assert row["queue_depth"] == len(eng.scheduler)
+    assert row["live_slots"] == \
+        sum(r is not None for r in eng.row_req)
+
+    def strip_age(rs):
+        return [{k: v for k, v in r.items() if k != "age_s"}
+                for r in rs]
+
+    http_reqs = _get_json(
+        dash_base, "/api/v0/state/requests?engine_id=dash-eng")
+    assert strip_age(http_reqs) == \
+        strip_age(serving.list_requests(engine_id="dash-eng"))
+    queued = _get_json(
+        dash_base,
+        "/api/v0/state/requests?status=queued&engine_id=dash-eng")
+    assert all(r["status"] == "queued" for r in queued)
+    assert len(queued) == row["queue_depth"]
+
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get_json(dash_base, "/api/v0/state/requests?status=bogus")
+    assert exc.value.code == 400
+    assert "unknown status" in exc.value.read().decode()
+
+    pools = _get_json(dash_base, "/api/v0/state/kv_pools")
+    pool, = [p for p in pools if p["engine_id"] == "dash-eng"]
+    assert pool["kind"] == "prefix"
+    assert pool["blocks_total"] == eng._prefix.blocks_total
+
+    summary = _get_json(dash_base, "/api/v0/state/summary")
+    assert summary["engines_total"] == len(serving.engines())
+    assert summary["requests_inflight"] == \
+        len(serving.list_requests())
+    eng.run()
+
+
+def test_metrics_history_endpoint_downsampling(dash_base):
+    """Polling the endpoint past the ring's capacity: the window stays
+    bounded, compactions kick in, and the coarse/fine tier boundary is
+    visible in the returned n weights (old entries fold, newest stay
+    raw)."""
+    from ray_tpu.util import metrics_history as mh
+
+    mh.reset_global_history()
+    h = mh.global_history(capacity=8, cadence_s=0.0)
+    for i in range(30):
+        h.sample({"queue_depth": float(i)})
+    hist = _get_json(dash_base, "/api/v0/metrics_history")
+    assert hist["capacity"] == 8
+    assert len(hist["samples"]) < 8
+    assert hist["compactions"] > 0
+    ns = [s["n"] for s in hist["samples"]]
+    assert ns[0] > 1 and ns[-1] == 1, ns
+    assert sum(ns) == hist["samples_taken"]
+    ts = [s["t"] for s in hist["samples"]]
+    assert ts == sorted(ts)
+    mh.reset_global_history()

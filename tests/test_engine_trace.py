@@ -432,3 +432,36 @@ def test_trace_report_breakdowns(nano_model, tmp_path):
     assert rows == sorted(rows, key=lambda r: -r["e2e_s"])
     text = format_report(rows, top=2)
     assert "top 2 slowest" in text and "requests" in text
+
+
+def test_trace_report_json_mode(nano_model, tmp_path, capsys):
+    """--json emits the SAME breakdown rows plus a totals block the
+    text footer is computed from — one aggregation path, two
+    renderings."""
+    import json as _json
+    import sys
+    sys.path.insert(0, "/root/repo")
+    from tools.trace_report import (load_trace, main,
+                                    request_breakdowns, totals)
+
+    cfg, params = nano_model
+    eng = DecodeEngine(params, cfg, batch_slots=2, max_len=32,
+                       trace=True, engine_id="repj")
+    for p, n in [([5, 6, 7], 4), ([1, 2], 6)]:
+        eng.submit(p, n)
+    eng.run()
+    path = tmp_path / "j.trace.json"
+    eng.dump_trace(str(path))
+
+    main([str(path), "--json"])
+    payload = _json.loads(capsys.readouterr().out)
+    rows = request_breakdowns(load_trace(str(path)))
+    assert payload["requests"] == rows
+    assert payload["totals"] == totals(rows)
+    t = payload["totals"]
+    assert t["requests"] == 2 and t["shed"] == 0
+    assert t["tokens"] == sum(r["tokens"] for r in rows)
+    assert t["e2e_s_sum"] == pytest.approx(
+        sum(r["e2e_s"] for r in rows))
+    for p in ("queue", "prefill", "decode", "swap"):
+        assert f"{p}_s_sum" in t

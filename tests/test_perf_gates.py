@@ -250,3 +250,76 @@ def test_gate_tracer_ring_bounded_under_flood():
     assert len(tr.chrome_events()) == cap
     # Bookkeeping dicts track live requests, not event volume.
     assert len(tr._req_mark) == 7 and len(tr._open) == 0
+
+
+def test_gate_state_snapshot_bounded_allocations():
+    """Gate (r11, state API): one FULL serving snapshot — engine rows,
+    every in-flight request, KV pools, fleet summary — over a busy
+    engine allocates a bounded, small number of live bytes inside
+    serving.py. Counting bytes, not timing, so it holds on any box:
+    the gate fails if a snapshot ever starts copying KV blocks,
+    token lists, or device arrays instead of host-side counters."""
+    import tracemalloc
+
+    jax = pytest.importorskip("jax")
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.engine import DecodeEngine
+    from ray_tpu.util.state import serving
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    eng = DecodeEngine(params, cfg, batch_slots=4, max_len=32,
+                       prefix_cache=True, prefix_block=4)
+    for i in range(8):
+        eng.submit([5 + i, 6, 7, 8 + i], 16)
+    eng.step()                       # genuinely busy: queue + slots
+    serving.summarize_fleet()        # warm lazy imports outside window
+
+    tracemalloc.start()
+    try:
+        held = (serving.list_engines(), serving.list_requests(),
+                serving.list_kv_pools(), serving.summarize_fleet())
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    stats = snap.filter_traces(
+        [tracemalloc.Filter(True, serving.__file__)]).statistics(
+            "lineno")
+    total = sum(s.size for s in stats)
+    assert held[1], "gate needs in-flight requests to be meaningful"
+    assert total < 256 * 1024, (
+        f"one serving snapshot holds {total} bytes live: "
+        + "; ".join(str(s) for s in stats[:5]))
+    eng.run()
+
+
+def test_gate_metrics_history_bounded_allocations():
+    """Gate (r11, state API): 10k samples through a 32-entry history
+    ring retain O(capacity) live bytes inside metrics_history.py —
+    the boundedness contract as a memory number, not an entry count
+    (an entry that secretly accreted per-sample state would pass
+    len() checks and still OOM a long-running server)."""
+    import tracemalloc
+
+    from ray_tpu.util import metrics_history as mh
+
+    vals = {k: 1.0 for k in mh.DEFAULT_KEYS}
+    warm = mh.MetricsHistory(capacity=32, cadence_s=0.0)
+    for _ in range(100):
+        warm.sample(vals)            # warm code paths outside window
+
+    tracemalloc.start()
+    try:
+        h = mh.MetricsHistory(capacity=32, cadence_s=0.0)
+        for _ in range(10_000):
+            h.sample(vals)
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    stats = snap.filter_traces(
+        [tracemalloc.Filter(True, mh.__file__)]).statistics("lineno")
+    total = sum(s.size for s in stats)
+    assert len(h) < 32 and h.compactions > 0
+    assert total < 128 * 1024, (
+        f"history ring holds {total} bytes live after 10k samples: "
+        + "; ".join(str(s) for s in stats[:5]))

@@ -1,0 +1,189 @@
+"""`ray status`-style report over the serving state API.
+
+Renders, from one snapshot:
+
+- fleet topology: replicas per fleet, router, tp degree, draining
+  flags, autoscaler presence;
+- one line per engine with occupancy / queue / KV-pool bars;
+- SLO percentiles (TTFT/TPOT p50/p95) with trend arrows derived from
+  the metrics-history ring;
+- the top-N longest-running in-flight requests with their current
+  phase (queued / prefilling / decoding / swapped).
+
+Run against a live dashboard head:
+
+    python tools/ray_tpu_status.py --addr http://127.0.0.1:8265
+
+or in-process (no HTTP): import `collect` / `format_status` and call
+them beside a running engine/fleet — which is also how the test drives
+a full report off a live 2-replica CPU dry-run fleet. `--json` dumps
+the raw collected state for scripting.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Dict, List, Optional
+
+ARROWS = {1: "^", -1: "v", 0: "-"}
+SLO_KEYS = ("ttft_s_p50", "ttft_s_p95", "tpot_s_p50", "tpot_s_p95")
+
+
+def collect(addr: Optional[str] = None) -> Dict[str, Any]:
+    """One coherent snapshot of the serving plane: engines, in-flight
+    requests, KV pools, fleet summary, metrics history. From the
+    dashboard head's /api/v0 endpoints when ``addr`` is given, else
+    from this process's own registrations (a fresh history sample is
+    forced so the report is never empty-handed)."""
+    if addr is not None:
+        import urllib.request
+
+        def get(path):
+            with urllib.request.urlopen(addr.rstrip("/") + path,
+                                        timeout=10) as r:
+                return json.load(r)
+
+        return {"engines": get("/api/v0/state/engines"),
+                "requests": get("/api/v0/state/requests"),
+                "kv_pools": get("/api/v0/state/kv_pools"),
+                "summary": get("/api/v0/state/summary"),
+                "history": get("/api/v0/metrics_history")}
+
+    from ray_tpu.util import metrics_history as mh
+    from ray_tpu.util.state import serving
+
+    mh.sample_now(force=True)
+    return {"engines": serving.list_engines(),
+            "requests": serving.list_requests(),
+            "kv_pools": serving.list_kv_pools(),
+            "summary": serving.summarize_fleet(),
+            "history": mh.global_history().snapshot()}
+
+
+def _bar(frac: float, width: int = 20) -> str:
+    frac = max(0.0, min(1.0, float(frac)))
+    fill = int(round(frac * width))
+    return "[" + "#" * fill + "-" * (width - fill) + "]"
+
+
+def _phases_line(counts: Dict[str, int]) -> str:
+    order = ("queued", "prefilling", "decoding", "swapped")
+    return " / ".join(f"{counts.get(p, 0)} {p}" for p in order)
+
+
+def _trends(history: Dict[str, Any]) -> Dict[str, int]:
+    """Per-SLO-key trend arrow direction from a history SNAPSHOT (the
+    JSON shape both the endpoint and `MetricsHistory.snapshot`
+    return)."""
+    from ray_tpu.util.metrics_history import trend_of_points
+
+    samples = history.get("samples", [])
+    return {k: trend_of_points([s[k] for s in samples if k in s])
+            for k in SLO_KEYS}
+
+
+def format_status(data: Dict[str, Any], top: int = 5) -> str:
+    """The report text. Pure formatting over `collect()`'s dict — no
+    live state is touched, so tests can feed synthetic snapshots."""
+    engines: List[Dict[str, Any]] = data["engines"]
+    requests: List[Dict[str, Any]] = data["requests"]
+    pools = {p["engine_id"]: p for p in data["kv_pools"]}
+    summary = data["summary"]
+    lines: List[str] = []
+
+    lines.append("======== Fleet ========")
+    for fb in summary["fleets"]:
+        drain = (f", {fb['replicas_draining']} draining"
+                 if fb["replicas_draining"] else "")
+        auto = " autoscaling" if fb.get("autoscaling") else ""
+        lines.append(
+            f"fleet {fb['fleet_id']}: {fb['replicas']} replicas "
+            f"({fb['replicas_running']} running{drain}) "
+            f"router={fb['router']} tp={fb['tp_degree_max']}{auto}")
+        lines.append(f"  requests: {_phases_line(fb['requests'])}"
+                     f"   shed total: {fb['requests_shed']}")
+    if not summary["fleets"]:
+        lines.append("no fleets registered")
+    if summary["engines_unattached"]:
+        lines.append(f"{summary['engines_unattached']} engine(s) "
+                     "outside any fleet")
+    lines.append("in-flight: " + _phases_line(summary["requests"]))
+
+    lines.append("")
+    lines.append("======== Replicas ========")
+    for e in engines:
+        pool = pools.get(e["engine_id"])
+        kv = (f" kv {_bar(pool.get('occupancy', 0.0), 10)} "
+              f"{pool.get('blocks_in_use', 0)}/"
+              f"{pool.get('blocks_total', 0)} blk"
+              if pool else "")
+        flags = "".join(
+            [" DRAINING" if e["draining"] else "",
+             f" tp={e['tp_degree']}" if e["tp_degree"] > 1 else "",
+             " paged" if e["paged"] else ""])
+        lines.append(
+            f"{e['engine_id']:>16} "
+            f"occ {_bar(e['slot_occupancy'], 10)} "
+            f"{e['live_slots']}/{e['batch_slots']} "
+            f"queue {e['queue_depth']:>3}{kv} "
+            f"up {e['uptime_s']:.1f}s steps {e['steps_total']}"
+            f"{flags}")
+    if not engines:
+        lines.append("no engines registered")
+
+    lines.append("")
+    lines.append("======== SLO (recent window) ========")
+    arrows = _trends(data.get("history", {}))
+    samples = data.get("history", {}).get("samples", [])
+    last = samples[-1] if samples else {}
+    for key in SLO_KEYS:
+        val = last.get(key)
+        shown = f"{val * 1e3:8.2f} ms" if val is not None else \
+            "     n/a   "
+        lines.append(f"{key:>12}: {shown}  {ARROWS[arrows[key]]}")
+    lines.append(f"history: {len(samples)} samples retained, "
+                 f"{data.get('history', {}).get('compactions', 0)} "
+                 "compactions")
+
+    lines.append("")
+    lines.append(f"======== Longest-running requests (top {top}) "
+                 "========")
+    with_age = [r for r in requests if r.get("age_s") is not None]
+    with_age.sort(key=lambda r: -r["age_s"])
+    for r in with_age[:top]:
+        where = f"row {r['row']}" if r.get("row") is not None \
+            else "unplaced"
+        extra = ""
+        if r["status"] == "prefilling" and "prefill_pos" in r:
+            extra = (f" prefill {r['prefill_pos']}/"
+                     f"{r['prompt_tokens']}")
+        lines.append(
+            f"req {r['req_id']:>5} @{r['engine_id']:<16} "
+            f"{r['status']:<10} age {r['age_s']:7.2f}s "
+            f"tokens {r.get('tokens_out', 0)}/"
+            f"{r.get('max_new_tokens', '?')} {where}{extra}")
+    if not with_age:
+        lines.append("no in-flight requests")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--addr", default=None,
+                    help="dashboard base URL (e.g. "
+                         "http://127.0.0.1:8265); default: this "
+                         "process's registrations")
+    ap.add_argument("--top", type=int, default=5,
+                    help="longest-running requests to show")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw collected snapshot as JSON")
+    args = ap.parse_args(argv)
+    data = collect(args.addr)
+    if args.json:
+        print(json.dumps(data, indent=1, default=str))
+    else:
+        print(format_status(data, top=args.top))
+
+
+if __name__ == "__main__":
+    main()

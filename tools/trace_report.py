@@ -7,7 +7,7 @@ report exact, not sampled — each request's spans are CONTIGUOUS
 (every span starts at the previous one's end), so the phase durations
 sum to the request's end-to-end latency by construction.
 
-Run:  python tools/trace_report.py fleet.trace.json [--top 5]
+Run:  python tools/trace_report.py fleet.trace.json [--top 5] [--json]
 
 Prints one row per request — e2e latency plus the fraction spent in
 queue / prefill / decode / swap — a totals line, and the top-N slowest
@@ -84,6 +84,20 @@ def request_breakdowns(
     return out
 
 
+def totals(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate line over breakdown rows — the ONE place the summary
+    numbers are computed, shared by the text report's footer and the
+    --json payload."""
+    return {
+        "requests": len(rows),
+        "tokens": sum(r["tokens"] for r in rows),
+        "e2e_s_sum": sum(r["e2e_s"] for r in rows),
+        "shed": sum(1 for r in rows if r["shed"]),
+        **{f"{p}_s_sum": sum(r[f"{p}_s"] for r in rows)
+           for p in PHASES},
+    }
+
+
 def format_report(rows: List[Dict[str, Any]], top: int = 5) -> str:
     lines = [f"{'request':>10} {'pid':>8} {'e2e_ms':>9} "
              f"{'queue%':>7} {'prefill%':>9} {'decode%':>8} "
@@ -99,12 +113,12 @@ def format_report(rows: List[Dict[str, Any]], top: int = 5) -> str:
             f"{r['swap_frac'] * 100:>5.1f}% "
             f"{r['tokens']:>7}{tag}")
     if rows:
-        tot = sum(r["e2e_s"] for r in rows)
+        t = totals(rows)
         lines.append(
-            f"-- {len(rows)} requests, "
-            f"{sum(r['tokens'] for r in rows)} tokens, "
-            f"sum(e2e) {tot * 1e3:.1f} ms, "
-            f"{sum(r['shed'] for r in rows)} shed")
+            f"-- {t['requests']} requests, "
+            f"{t['tokens']} tokens, "
+            f"sum(e2e) {t['e2e_s_sum'] * 1e3:.1f} ms, "
+            f"{t['shed']} shed")
         lines.append(f"-- top {min(top, len(rows))} slowest:")
         for r in rows[:top]:
             dom = max(PHASES, key=lambda p: r[f"{p}_s"])
@@ -122,9 +136,16 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("trace", help="chrome trace JSON from dump_trace()")
     ap.add_argument("--top", type=int, default=5,
                     help="slowest requests to detail (default 5)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable JSON (the same "
+                         "breakdown rows + totals) instead of text")
     args = ap.parse_args(argv)
     rows = request_breakdowns(load_trace(args.trace))
-    print(format_report(rows, top=args.top))
+    if args.json:
+        print(json.dumps({"requests": rows, "totals": totals(rows)},
+                         indent=1))
+    else:
+        print(format_report(rows, top=args.top))
 
 
 if __name__ == "__main__":
