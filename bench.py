@@ -924,6 +924,119 @@ def _bench_multichip_serving(cfg, *, tps=(1, 2, 4), prompt_len: int,
     }
 
 
+def _spec_model_pair(cfg, draft_layers: int = 1):
+    """(target_params, draft_params, draft_cfg) for the speculative
+    churn: both models are built EMBEDDING-PASSTHROUGH — every layer's
+    output projections (`wo`, `w_down`) are zeroed, so the residual
+    stream is exactly the last token's embedding, and the draft shares
+    the target's tok_embed / final_norm / lm_head. The two models then
+    argmax-agree on every position BY CONSTRUCTION (high-acceptance
+    churn) while the draft runs `draft_layers` of the target's
+    `n_layers` — and zeroed weights change nothing about matmul cost,
+    so the measured work ratio is the real draft/target ratio."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama_init
+
+    def passthrough(params):
+        layers = dict(params["layers"])
+        layers["wo"] = jnp.zeros_like(layers["wo"])
+        layers["w_down"] = jnp.zeros_like(layers["w_down"])
+        return {**params, "layers": layers}
+
+    target = passthrough(llama_init(jax.random.PRNGKey(0), cfg))
+    draft_cfg = dataclasses.replace(cfg, n_layers=draft_layers)
+    draft = passthrough(llama_init(jax.random.PRNGKey(1), draft_cfg))
+    for k in ("tok_embed", "final_norm", "lm_head"):
+        draft[k] = target[k]
+    return target, draft, draft_cfg
+
+
+def _bench_spec(cfg, *, batch_slots: int, n_requests: int,
+                new_tokens: int, trials: int, windows=(0, 2, 4),
+                draft_layers: int = 1, prompt_len: int = 8) -> dict:
+    """Speculative-decoding churn (the spec tentpole's end-to-end
+    number): the same ragged-budget churn at every draft window in
+    `windows` — window 0 is the plain engine (identical workload, no
+    draft plane), so `spec_speedup` is window-best tokens/s over
+    window-0 tokens/s on the SAME box, same prompts, same budgets.
+    The model pair is the high-acceptance construction from
+    `_spec_model_pair`; acceptance and effective window come straight
+    off `engine.stats()`. Output identity across windows is asserted
+    here too — a speedup that changed tokens would be meaningless."""
+    import jax  # noqa: F401  (model pair builds devices lazily)
+    import numpy as np
+
+    from ray_tpu.models.engine import DecodeEngine
+
+    target, draft, draft_cfg = _spec_model_pair(
+        cfg, draft_layers=draft_layers)
+    rng = np.random.RandomState(11)
+    max_len = prompt_len + new_tokens + max(windows) + 1
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           size=prompt_len).tolist()
+               for _ in range(n_requests)]
+    budgets = [new_tokens if i % 2 == 0 else max(2, new_tokens // 2)
+               for i in range(n_requests)]
+
+    def spread_pct(rs):
+        return ((max(rs) - min(rs)) / max(rs) * 100.0) if max(rs) else 0.0
+
+    per_window, outputs = {}, {}
+    for w in windows:
+        kw = dict(draft_params=draft, draft_cfg=draft_cfg,
+                  spec_window=w) if w else {}
+        rates = []
+        for trial in range(trials + 1):
+            eng = DecodeEngine(target, cfg, batch_slots=batch_slots,
+                               max_len=max_len, enable_metrics=False,
+                               **kw)
+            ids = [eng.submit(p, n) for p, n in zip(prompts, budgets)]
+            t0 = time.perf_counter()
+            out = eng.run()
+            dt = time.perf_counter() - t0
+            if trial:
+                rates.append(sum(budgets) / dt)
+        outputs[w] = [out[i] for i in ids]
+        s = eng.stats()
+        per_window[f"window{w}"] = {
+            "churn_tokens_per_sec": round(statistics.median(rates), 1),
+            "spec_acceptance_rate": round(s["spec_acceptance_rate"], 4),
+            "spec_window_effective": round(s["spec_window_effective"],
+                                           3),
+            "spec_dispatches": int(s["spec_dispatches"]),
+            "trial_spread_pct": round(spread_pct(rates), 2),
+        }
+    for w in windows:
+        assert outputs[w] == outputs[windows[0]], \
+            f"speculation changed tokens at window={w}"
+    base = per_window[f"window{windows[0]}"]["churn_tokens_per_sec"]
+    best_w = max(windows,
+                 key=lambda w:
+                 per_window[f"window{w}"]["churn_tokens_per_sec"])
+    best = per_window[f"window{best_w}"]["churn_tokens_per_sec"]
+    return {
+        "metric": "llama_decode_tokens_per_sec_spec",
+        "value": best,
+        "unit": "tokens/s",
+        "windows": list(windows),
+        "per_window": per_window,
+        "best_window": best_w,
+        "spec_speedup": round(best / base, 3) if base else 0.0,
+        "spec_acceptance_rate":
+            per_window[f"window{best_w}"]["spec_acceptance_rate"],
+        "draft_layers": draft_layers,
+        "target_layers": cfg.n_layers,
+        "n_requests": n_requests,
+        "new_tokens": new_tokens,
+        "batch_slots": batch_slots,
+        "outputs_identical_across_windows": True,
+    }
+
+
 def main():
     import jax
 
@@ -985,6 +1098,14 @@ def main():
             serving["multichip"] = {
                 "metric": "llama_decode_tokens_per_sec_multichip",
                 "error": f"{type(e).__name__}: {str(e)[:200]}"}
+        try:
+            serving["speculative"] = _bench_spec(
+                flagship_config(), batch_slots=8, n_requests=16,
+                new_tokens=64, trials=TRIALS)
+        except Exception as e:
+            serving["speculative"] = {
+                "metric": "llama_decode_tokens_per_sec_spec",
+                "error": f"{type(e).__name__}: {str(e)[:200]}"}
     else:  # smoke mode off-TPU
         # The module-top flag forces 8 virtual CPU devices for the tp
         # sweep; the train smoke stays single-device (its historical
@@ -1026,6 +1147,14 @@ def main():
         serving["multichip"] = _bench_multichip_serving(
             LlamaConfig.nano(), tps=(1, 2, 4), prompt_len=16,
             new_tokens=8, batch_slots=2, trials=1)
+        # Speculative churn, CPU dry run: a 16-layer passthrough target
+        # with a 1-layer draft — the speedup RATIO (same box, same
+        # workload, window 0 vs best) and the acceptance rate are real
+        # on any backend; absolute tokens/s is not. Budgets are
+        # multiples of window+1 so no final round truncates acceptance.
+        serving["speculative"] = _bench_spec(
+            LlamaConfig.nano(n_layers=16, dim=128, ffn_dim=256),
+            batch_slots=4, n_requests=8, new_tokens=60, trials=2)
 
     out = {
         "metric": "llama_train_mfu_1chip",

@@ -108,7 +108,8 @@ def _decode_dispatch_section(quick: bool) -> list:
             t0 = time.perf_counter()
             for _ in range(n_steps):
                 toks_d, cache, last, *_rest = _decode_multi(
-                    eng.params, cache, last, *args, eng.temperature,
+                    eng.params, cache, last, *args,
+                    jnp.asarray(np.array([True] * B)), eng.temperature,
                     cfg, H, True, None, None, None)
             jax.block_until_ready(toks_d)
             dev_ms.append((time.perf_counter() - t0) * 1000 /
@@ -123,6 +124,76 @@ def _decode_dispatch_section(quick: bool) -> list:
                         max(0.0, wall - dev), "ms"))
         results.append((f"engine_decode_transfers_per_token_h{H}",
                         syncs_per_tok, "syncs/token"))
+    return results
+
+
+def _spec_dispatch_section(quick: bool) -> list:
+    """ONE speculative dispatch vs window+1 plain dispatches: the spec
+    engine's whole round (draft scan of W proposals + one batched
+    verify + on-device acceptance) is a single program launch emitting
+    up to W+1 verified tokens per row, where the horizon-1 plain
+    engine pays W+1 separate dispatch+drain round trips for the same
+    tokens. Draft == target (perfect acceptance), so the token counts
+    divide exactly and the per-token ratio isolates the dispatch
+    amortization — the host-side overhead is real on any backend.
+    pipeline_depth=1 on both engines: this measures the synchronous
+    cost; run-ahead overlap is _dispatch_gap_section's job."""
+    import jax  # noqa: F401
+    import numpy as np
+
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.engine import DecodeEngine
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    B, prompt_len, W = 4, 16, 4
+    new_tokens = 20 if quick else 40     # multiples of W+1: no
+    #                                      truncated final round
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, size=prompt_len).tolist()
+               for _ in range(B)]
+    max_len = prompt_len + new_tokens + W + 1
+
+    def make(spec):
+        kw = (dict(draft_params=params, draft_cfg=cfg, spec_window=W)
+              if spec else dict(decode_horizon=1))
+        eng = DecodeEngine(params, cfg, batch_slots=B, max_len=max_len,
+                           pipeline_depth=1, enable_metrics=False,
+                           **kw)
+        for p in prompts:
+            eng.submit(p, new_tokens)
+        return eng
+
+    per_tok = {}
+    results = []
+    for spec in (False, True):
+        make(spec).run()                 # warmup: compile this path
+        ms = []
+        for _ in range(TRIALS):
+            eng = make(spec)
+            t0 = time.perf_counter()
+            eng.run()
+            ms.append((time.perf_counter() - t0) * 1000)
+        med = statistics.median(ms)
+        total = B * new_tokens
+        per_tok[spec] = med / total
+        s = eng.stats()
+        if spec:
+            disp = max(1, int(s["spec_dispatches"]))
+            results.append((f"engine_spec_wall_ms_per_dispatch_w{W}",
+                            med / disp, "ms"))
+            results.append((f"engine_spec_tokens_per_dispatch_w{W}",
+                            total / disp, "tokens"))
+            results.append((f"engine_spec_acceptance_rate_w{W}",
+                            s["spec_acceptance_rate"], "frac"))
+            results.append((f"engine_spec_ms_per_token_w{W}",
+                            per_tok[True], "ms"))
+        else:
+            results.append(("engine_plain_ms_per_token_h1",
+                            per_tok[False], "ms"))
+    results.append((f"engine_spec_dispatch_speedup_w{W}_vs_h1",
+                    per_tok[False] / per_tok[True]
+                    if per_tok[True] else 0.0, "x"))
     return results
 
 
@@ -200,7 +271,8 @@ def _sharded_dispatch_section(quick: bool) -> list:
             t0 = time.perf_counter()
             for _ in range(n_steps):
                 toks_d, cache, last, *_rest = _decode_multi(
-                    eng.params, cache, last, *args, eng.temperature,
+                    eng.params, cache, last, *args,
+                    jnp.asarray(np.array([True] * B)), eng.temperature,
                     cfg, H, True, None, None, None,
                     shardings=eng._shardings)
             jax.block_until_ready(toks_d)
@@ -623,6 +695,9 @@ def main(quick: bool = False):
     # Print the serving-engine sections immediately: their numbers must
     # survive an environment-specific failure in a later section.
     for name, value, unit in _decode_dispatch_section(quick):
+        print(json.dumps({"metric": name, "value": round(value, 4),
+                          "unit": unit}), flush=True)
+    for name, value, unit in _spec_dispatch_section(quick):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     for name, value, unit in _sharded_dispatch_section(quick):

@@ -37,13 +37,17 @@ class BlockPool:
     one, freeing the block when the count reaches zero. All O(1) per
     block, pure host state."""
 
-    def __init__(self, n_blocks: int):
+    def __init__(self, n_blocks: int, *, label: str = "kv"):
         if n_blocks < 2:
             raise ValueError(
                 "n_blocks must be >= 2 (block 0 is the reserved "
                 "null/scratch block); raise kv_pool_bytes or shrink "
                 "kv_block_tokens")
         self.n_blocks = n_blocks
+        # Which plane this ledger backs — the speculative engine runs
+        # TWO pools side by side (target "kv" + "draft_kv"), and the
+        # label keeps their snapshots distinguishable in the state API.
+        self.label = label
         # Stack of free ids, low ids on top (pop order is deterministic
         # so engine runs — and their compiled gather shapes — replay
         # identically across processes).
@@ -76,6 +80,7 @@ class BlockPool:
         list — no allocation state is touched."""
         shared = sum(1 for r in self._refs if r > 1)
         return {
+            "label": self.label,
             "blocks_total": self.blocks_total,
             "blocks_in_use": self.blocks_in_use,
             "free_blocks": len(self._free),

@@ -36,6 +36,22 @@ slot-based continuous batching is first-class here, built the XLA way:
   mutate the donated cache from the host side), and run-ahead
   iterations on rows that finished mid-flight are masked on device and
   accounted as `pipeline_overrun_tokens`.
+- SPECULATIVE decoding composes with all of the above
+  (`draft_params=`/`draft_cfg=`/`spec_window=`): the engine keeps a
+  second (draft) KV plane per slot — dense rings, or a second block
+  pool in paged mode — and each decode dispatch becomes ONE batched
+  draft-propose / target-verify round (`_spec_round`): the draft scans
+  up to `spec_window` greedy proposals for every live row, one batched
+  target pass verifies the [B, window+1] chunk, and per-row
+  acceptance / correction / eos / budget freezing happens on device,
+  so the host still sees a single [window+1, B] token block per
+  dispatch (the -1-trailing-column emit contract is unchanged). Greedy
+  rows stay token-identical to solo `generate(greedy=True)`; sampled
+  rows fall back to the plain fused decode per-row via the decode-mode
+  lane (`submit(..., greedy=...)`) — rejection sampling is follow-up
+  work. Per-row draft widths adapt to the measured acceptance rate via
+  `SchedulerPolicy.spec_window_hint` (the speculation analog of
+  `horizon_hint`).
 
 Consistency contract (tested): greedy engine output for every request
 is token-identical to that request's solo `generate` run, regardless of
@@ -128,11 +144,18 @@ class _EngineShardings:
     ``logits`` [B, vocab]             — vocab over "tp"
     ``pool``   [L, NB, T, KV, D]      — prefix pool, KV axis like the
                cache so copy-in/out gathers stay chip-local
+    ``d_cache``/``d_pool`` — the DRAFT model's KV plane, pruned against
+               the draft config's own dims (a nano draft often can't
+               split its kv heads over the same mesh the target can).
+               None on non-speculative engines, so every existing
+               program signature hashes exactly as before.
     """
 
     cache: NamedSharding
     logits: NamedSharding
     pool: NamedSharding
+    d_cache: Optional[NamedSharding] = None
+    d_pool: Optional[NamedSharding] = None
 
     @property
     def replicated(self) -> NamedSharding:
@@ -337,7 +360,7 @@ def _decode_core(params: Params, toks: jax.Array, cache, row_len,
                                     "shardings"),
                    donate_argnames=("cache", "last_logits"))
 def _decode_multi(params: Params, cache, last_logits, row_len, active,
-                  budget, tok_idx, row_keys, temperature,
+                  budget, tok_idx, row_keys, row_greedy, temperature,
                   cfg: LlamaConfig, horizon: int, greedy: bool,
                   top_k: Optional[int], top_p: Optional[float],
                   eos_id: Optional[int],
@@ -370,7 +393,14 @@ def _decode_multi(params: Params, cache, last_logits, row_len, active,
     — and the carried row state lets the async pipeline chain a
     run-ahead dispatch directly off the previous one's device arrays,
     with zero host synchronization between dispatches (the host's own
-    row_len/budget copies catch up when it drains the token block)."""
+    row_len/budget copies catch up when it drains the token block).
+
+    `row_greedy` is the per-row DECODE-MODE lane (bool [B]): when the
+    static `greedy` flag is False (some live row samples), rows whose
+    lane is True still take the argmax so a mixed batch serves both
+    modes in one program. When `greedy` is True the lane is dead code
+    and XLA drops it — the all-greedy fast path compiles exactly what
+    it always did."""
     max_len = cache["k"].shape[2]
 
     def body(carry, _):
@@ -378,6 +408,11 @@ def _decode_multi(params: Params, cache, last_logits, row_len, active,
         tok = sample_rows(last_logits, row_keys, tok_idx,
                           greedy=greedy, temperature=temperature,
                           top_k=top_k, top_p=top_p)
+        if not greedy:
+            tok = jnp.where(
+                row_greedy,
+                jnp.argmax(last_logits, axis=-1).astype(tok.dtype),
+                tok)
         emit = jnp.where(active, tok, -1)
         live = active.astype(jnp.int32)
         budget = budget - live
@@ -414,6 +449,157 @@ def _decode_multi(params: Params, cache, last_logits, row_len, active,
         toks = jax.lax.with_sharding_constraint(
             toks, shardings.replicated)
     return toks, cache, last_logits, row_len, active, budget, tok_idx
+
+
+def _spec_accept(chunk, proposals, ver, v_logits, last_logits, row_len,
+                 active, budget, tok_idx, d_tok, row_greedy, w_row,
+                 window: int, eos_id: Optional[int], max_len: int):
+    """On-device acceptance/correction/freeze shared by the dense and
+    paged speculative rounds — the batched analog of the solo accept
+    loop in models/speculative.py, fused so the host never sees logits.
+
+    Per row: count the longest prefix of `proposals` matching the
+    target's argmax continuation `ver` (capped at the row's adaptive
+    width `w_row`; forced 0 on sampled rows — their lane emits just the
+    t0 they sampled), emit `[t0, d_1..d_a, correction]` truncated by
+    eos / budget / room exactly like `_decode_multi`'s per-iteration
+    masking, and carry the corrected `last_logits` so the next round's
+    t0 is this round's on-device correction. Returns the -1-trailing
+    [window+1, B] emit block plus the advanced carry, including the
+    draft-lag lane: after a FULLY accepted round the draft has already
+    consumed d_1..d_{W-1} and only owes d_W (lag 1, pending token
+    `d_tok`); any rejection resets the draft frontier to the emitted
+    history (lag 0)."""
+    B = row_len.shape[0]
+    bidx = jnp.arange(B)
+    jW = jnp.arange(window)
+    match = (proposals == ver[:, :window]) \
+        & (jW[None, :] < w_row[:, None]) & row_greedy[:, None]
+    acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+    pos = jnp.arange(window + 1)
+    valid = pos[None, :] <= acc[:, None]
+    if eos_id is not None:
+        # Keep the first eos, cut everything after it (mid-window eos).
+        iseos = ((chunk == eos_id) & valid).astype(jnp.int32)
+        valid = valid & ((jnp.cumsum(iseos, axis=1) - iseos) == 0)
+    valid = valid & (pos[None, :] < budget[:, None]) & active[:, None]
+    n = valid.sum(axis=1).astype(jnp.int32)
+    emits = jnp.where(valid, chunk, -1).T            # [window+1, B]
+
+    budget = budget - n
+    tok_idx = tok_idx + n
+    last_tok = chunk[bidx, jnp.maximum(n - 1, 0)]
+    done_now = (budget <= 0) | (row_len + n >= max_len)
+    if eos_id is not None:
+        done_now = done_now | ((n >= 1) & (last_tok == eos_id))
+    cont = active & ~done_now
+    row_len = row_len + n * cont.astype(jnp.int32)
+    sel = v_logits[bidx, jnp.maximum(n - 1, 0)]
+    last_logits = jnp.where(cont[:, None], sel, last_logits)
+    full = cont & (n == window + 1)
+    d_tok = jnp.where(full, chunk[:, window], d_tok)
+    d_lag = jnp.where(active, full.astype(jnp.int32), 0)
+    return emits, last_logits, row_len, cont, budget, tok_idx, \
+        d_lag, d_tok
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "d_cfg", "window", "greedy",
+                                    "top_k", "top_p", "eos_id",
+                                    "shardings"),
+                   donate_argnames=("cache", "d_cache", "last_logits"))
+def _spec_round(params: Params, d_params: Params, cache, d_cache,
+                last_logits, row_len, active, budget, tok_idx, d_lag,
+                d_tok, row_keys, row_greedy, w_row, temperature,
+                cfg: LlamaConfig, d_cfg: LlamaConfig, window: int,
+                greedy: bool, top_k: Optional[int],
+                top_p: Optional[float], eos_id: Optional[int],
+                shardings: Optional[_EngineShardings] = None):
+    """ONE batched draft-propose / target-verify round for every live
+    row — the speculative replacement for a `_decode_multi` dispatch.
+
+    Round structure (greedy rows; sampled rows ride the same program
+    with acceptance forced to 0, so they advance exactly one sampled
+    token per round — their solo stream):
+
+      t0        = argmax(last_logits)         # last round's correction
+      draft     consumes its 2-wide catch-up chunk at `row_len - d_lag`
+                (the fixed-width lag trick: after a fully-accepted
+                round the draft still owes its final proposal — carried
+                in `d_tok` with `d_lag`=1 — so the consume chunk is
+                always exactly [pend, t0] and the program never
+                recompiles on acceptance length), then scans
+                `window - 1` more greedy proposals at slots
+                row_len+1+j.
+      verify    ONE target pass over [t0, d_1..d_W] at `row_len` — the
+                chunk-verify program that feeds the MXU.
+      accept    `_spec_accept` on device; stale K/V from rejected
+                candidates sits exactly where next round's writes land
+                (write-before-attend, same argument as solo spec).
+
+    Emitted tokens are ALWAYS the target's own argmax chain — a stale
+    or cold draft plane can only shrink acceptance, never change
+    output — which is what makes swap-in re-seeding and cold draft
+    admissions safe. Returns the [window+1, B] -1-trailing emit block
+    plus the full carry (incl. the draft plane and lag lane), so the
+    async pipeline chains speculative run-ahead dispatches exactly like
+    plain ones."""
+    B = row_len.shape[0]
+    bidx = jnp.arange(B)
+    W = window
+    max_len = cache["k"].shape[2]
+
+    t_greedy = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+    if greedy:
+        t0 = t_greedy
+    else:
+        t_samp = sample_rows(last_logits, row_keys, tok_idx,
+                             greedy=False, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
+        t0 = jnp.where(row_greedy, t_greedy, t_samp)
+
+    # Draft: catch-up consume, then propose W greedy tokens.
+    pend = jnp.where(d_lag == 1, d_tok, t0)
+    chunk2 = jnp.stack([pend, t0], axis=1)           # [B, 2]
+    d_logits, d_cache = forward_cached_rows(
+        d_params, chunk2, d_cache, row_len - d_lag, d_cfg)
+    first = jnp.argmax(d_logits[bidx, d_lag],
+                       axis=-1).astype(jnp.int32)
+
+    def dstep(carry, j):
+        tok, d_cache = carry
+        lg, d_cache = forward_cached_rows(
+            d_params, tok[:, None], d_cache, row_len + 1 + j, d_cfg)
+        nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+        return (nxt, d_cache), tok
+
+    (lastp, d_cache), dtoks = jax.lax.scan(
+        dstep, (first, d_cache), jnp.arange(W - 1))
+    proposals = jnp.concatenate([dtoks.T, lastp[:, None]], axis=1) \
+        if W > 1 else lastp[:, None]                 # [B, W]
+
+    # Target: one batched verify over [t0, d_1..d_W].
+    chunk = jnp.concatenate([t0[:, None], proposals], axis=1)
+    v_logits, cache = forward_cached_rows(params, chunk, cache,
+                                          row_len, cfg)
+    ver = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
+
+    (emits, last_logits, row_len, active, budget, tok_idx, d_lag,
+     d_tok) = _spec_accept(chunk, proposals, ver, v_logits,
+                           last_logits, row_len, active, budget,
+                           tok_idx, d_tok, row_greedy, w_row, W,
+                           eos_id, max_len)
+    if shardings is not None:
+        cache = jax.lax.with_sharding_constraint(cache,
+                                                 shardings.cache)
+        d_cache = jax.lax.with_sharding_constraint(d_cache,
+                                                   shardings.d_cache)
+        last_logits = jax.lax.with_sharding_constraint(
+            last_logits, shardings.logits)
+        emits = jax.lax.with_sharding_constraint(emits,
+                                                 shardings.replicated)
+    return (emits, cache, d_cache, last_logits, row_len, active,
+            budget, tok_idx, d_lag, d_tok)
 
 
 # ---------------------------------------------------------------------------
@@ -549,7 +735,8 @@ def _decode_core_paged(params: Params, toks: jax.Array, pool_k, pool_v,
                    donate_argnames=("pool_k", "pool_v", "last_logits"))
 def _decode_multi_paged(params: Params, pool_k, pool_v, bt,
                         last_logits, row_len, active, budget, tok_idx,
-                        row_keys, temperature, cfg: LlamaConfig,
+                        row_keys, row_greedy, temperature,
+                        cfg: LlamaConfig,
                         horizon: int, greedy: bool,
                         top_k: Optional[int], top_p: Optional[float],
                         eos_id: Optional[int],
@@ -569,6 +756,11 @@ def _decode_multi_paged(params: Params, pool_k, pool_v, bt,
         tok = sample_rows(last_logits, row_keys, tok_idx,
                           greedy=greedy, temperature=temperature,
                           top_k=top_k, top_p=top_p)
+        if not greedy:
+            tok = jnp.where(
+                row_greedy,
+                jnp.argmax(last_logits, axis=-1).astype(tok.dtype),
+                tok)
         emit = jnp.where(active, tok, -1)
         live = active.astype(jnp.int32)
         budget = budget - live
@@ -601,6 +793,140 @@ def _decode_multi_paged(params: Params, pool_k, pool_v, bt,
             toks, shardings.replicated)
     return (toks, pool_k, pool_v, last_logits, row_len, active,
             budget, tok_idx)
+
+
+def _spec_layer_rows_paged(h, layer, k_pages, v_pages, bt, slots,
+                           cfg: LlamaConfig):
+    """S-wide `_decode_layer_rows_paged`: each row's S new K/V entries
+    scatter through its block table and the S queries attend through
+    it, with per-query causal masking inside `paged_attention`. Slots
+    past a row's allocated chain map to the null block (write garbage
+    nobody reads; only overshoot queries — whose results the accept
+    mask discards — ever look that far)."""
+    T = k_pages.shape[1]
+    span = bt.shape[1] * T
+    bidx = jnp.arange(slots.shape[0])[:, None]
+    blk = bt[bidx, slots // T]             # [B, S]
+    off = slots % T
+
+    def write_kv(k_pages, v_pages, k, v):
+        k_pages = k_pages.at[blk, off].set(k.astype(k_pages.dtype))
+        v_pages = v_pages.at[blk, off].set(v.astype(v_pages.dtype))
+        return k_pages, v_pages
+
+    def attend(q, k_pages, v_pages):
+        return paged_attention(q, k_pages, v_pages, bt, slots,
+                               kv_valid_len=span)
+
+    return _layer_body(h, layer, k_pages, v_pages, slots, write_kv,
+                       slots, span, cfg, attend=attend)
+
+
+def _spec_core_paged(params: Params, toks: jax.Array, pool_k, pool_v,
+                     bt, starts, cfg: LlamaConfig):
+    """S-wide `_decode_core_paged`: feed each row's [S] chunk at slots
+    ``starts + arange(S)`` and return the full [B, S, vocab] logits —
+    the draft consume/scan steps and the target verify pass are all
+    this one shape family."""
+    S = toks.shape[1]
+    slots = starts[:, None] + jnp.arange(S)[None, :]
+    h = params["tok_embed"].astype(cfg.dtype)[toks]
+
+    def body(carry, xs):
+        h = carry
+        layer, k_p, v_p = xs
+        h, k_p, v_p = _spec_layer_rows_paged(h, layer, k_p, v_p, bt,
+                                             slots, cfg)
+        return h, (k_p, v_p)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        body, h, (params["layers"], pool_k, pool_v))
+    h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", h,
+                        params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, k_new, v_new
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "d_cfg", "window", "greedy",
+                                    "top_k", "top_p", "eos_id",
+                                    "shardings"),
+                   donate_argnames=("pool_k", "pool_v", "pool_dk",
+                                    "pool_dv", "last_logits"))
+def _spec_round_paged(params: Params, d_params: Params, pool_k, pool_v,
+                      pool_dk, pool_dv, bt, bt_d, last_logits, row_len,
+                      active, budget, tok_idx, d_lag, d_tok, row_keys,
+                      row_greedy, w_row, temperature, cfg: LlamaConfig,
+                      d_cfg: LlamaConfig, window: int, greedy: bool,
+                      top_k: Optional[int], top_p: Optional[float],
+                      eos_id: Optional[int],
+                      shardings: Optional[_EngineShardings] = None):
+    """`_spec_round` over the block pools: the target plane reaches its
+    K/V through `bt`, the draft plane through its own private table
+    `bt_d` (draft blocks are never shared — the trie only indexes the
+    target pool). Same round structure, same `_spec_accept`, same emit
+    contract."""
+    B = row_len.shape[0]
+    bidx = jnp.arange(B)
+    W = window
+    max_len = bt.shape[1] * pool_k.shape[2]
+
+    t_greedy = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+    if greedy:
+        t0 = t_greedy
+    else:
+        t_samp = sample_rows(last_logits, row_keys, tok_idx,
+                             greedy=False, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
+        t0 = jnp.where(row_greedy, t_greedy, t_samp)
+
+    pend = jnp.where(d_lag == 1, d_tok, t0)
+    chunk2 = jnp.stack([pend, t0], axis=1)
+    d_logits, pool_dk, pool_dv = _spec_core_paged(
+        d_params, chunk2, pool_dk, pool_dv, bt_d, row_len - d_lag,
+        d_cfg)
+    first = jnp.argmax(d_logits[bidx, d_lag],
+                       axis=-1).astype(jnp.int32)
+
+    def dstep(carry, j):
+        tok, pool_dk, pool_dv = carry
+        lg, pool_dk, pool_dv = _spec_core_paged(
+            d_params, tok[:, None], pool_dk, pool_dv, bt_d,
+            row_len + 1 + j, d_cfg)
+        nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+        return (nxt, pool_dk, pool_dv), tok
+
+    (lastp, pool_dk, pool_dv), dtoks = jax.lax.scan(
+        dstep, (first, pool_dk, pool_dv), jnp.arange(W - 1))
+    proposals = jnp.concatenate([dtoks.T, lastp[:, None]], axis=1) \
+        if W > 1 else lastp[:, None]
+
+    chunk = jnp.concatenate([t0[:, None], proposals], axis=1)
+    v_logits, pool_k, pool_v = _spec_core_paged(
+        params, chunk, pool_k, pool_v, bt, row_len, cfg)
+    ver = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
+
+    (emits, last_logits, row_len, active, budget, tok_idx, d_lag,
+     d_tok) = _spec_accept(chunk, proposals, ver, v_logits,
+                           last_logits, row_len, active, budget,
+                           tok_idx, d_tok, row_greedy, w_row, W,
+                           eos_id, max_len)
+    if shardings is not None:
+        pool_k = jax.lax.with_sharding_constraint(pool_k,
+                                                  shardings.pool)
+        pool_v = jax.lax.with_sharding_constraint(pool_v,
+                                                  shardings.pool)
+        pool_dk = jax.lax.with_sharding_constraint(pool_dk,
+                                                   shardings.d_pool)
+        pool_dv = jax.lax.with_sharding_constraint(pool_dv,
+                                                   shardings.d_pool)
+        last_logits = jax.lax.with_sharding_constraint(
+            last_logits, shardings.logits)
+        emits = jax.lax.with_sharding_constraint(emits,
+                                                 shardings.replicated)
+    return (emits, pool_k, pool_v, pool_dk, pool_dv, last_logits,
+            row_len, active, budget, tok_idx, d_lag, d_tok)
 
 
 @functools.partial(jax.jit, static_argnames=("shardings",),
@@ -657,7 +983,8 @@ def _swap_in_scatter(pool_k, pool_v, host_k, host_v,
 
 class _Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "tokens", "done",
-                 "priority", "seq", "rng", "deadline", "shed", "resume")
+                 "priority", "seq", "rng", "deadline", "shed", "resume",
+                 "greedy")
 
     def __init__(self, req_id: int, prompt: List[int],
                  max_new_tokens: int, priority: int = 0, seq: int = 0,
@@ -674,6 +1001,7 @@ class _Request:
         self.deadline = deadline    # absolute clock time; None = no SLO
         self.shed = False           # retired past-deadline, no prefill run
         self.resume = False         # preempted; re-queued to swap back in
+        self.greedy = None          # per-request decode-mode override
 
 
 class _PrefillState:
@@ -737,15 +1065,20 @@ class _InflightStep:
     host had replayed the previous block — only those can contain
     overrun iterations for rows that had already finished."""
 
-    __slots__ = ("toks", "H", "rows", "run_ahead", "chain")
+    __slots__ = ("toks", "H", "rows", "run_ahead", "chain", "spec",
+                 "w_max", "w_row")
 
     def __init__(self, toks, H: int, rows: List[int], run_ahead: bool,
-                 chain: tuple):
+                 chain: tuple, spec: bool = False, w_max: int = 0,
+                 w_row=None):
         self.toks = toks
         self.H = H
         self.rows = rows
         self.run_ahead = run_ahead
         self.chain = chain
+        self.spec = spec            # speculative round: H == w_max + 1
+        self.w_max = w_max          # dispatch draft width
+        self.w_row = w_row          # per-row width snapshot [B] (np)
 
 
 class DecodeEngine:
@@ -835,6 +1168,9 @@ class DecodeEngine:
                  kv_block_tokens: Optional[int] = None,
                  kv_pool_bytes: Optional[int] = None,
                  preempt: str = "swap",
+                 draft_params: Optional[Params] = None,
+                 draft_cfg: Optional[LlamaConfig] = None,
+                 spec_window: int = 4,
                  mesh: Optional[Mesh] = None,
                  tp: Optional[int] = None,
                  sharding_rules=None,
@@ -863,6 +1199,16 @@ class DecodeEngine:
                              f"got {preempt!r}")
         if kv_block_tokens is not None and kv_block_tokens < 1:
             raise ValueError("kv_block_tokens must be >= 1")
+        if draft_params is not None:
+            if draft_cfg is None:
+                raise ValueError("draft_params needs draft_cfg")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target "
+                    f"vocab {cfg.vocab_size}: speculative decoding "
+                    "needs a shared tokenizer")
+            if spec_window < 1:
+                raise ValueError("spec_window must be >= 1")
         self.params = params
         self.cfg = cfg
         self.B = batch_slots
@@ -940,17 +1286,50 @@ class DecodeEngine:
             self._rules = rules
             self.params = shard_pytree(
                 params, llama_param_specs(cfg, rules), mesh)
+            d_cache_sh = d_pool_sh = None
+            self._d_shardings = None
+            if draft_params is not None:
+                # The draft shards over the SAME mesh, but its rules
+                # prune against its OWN dims — a nano draft whose kv
+                # heads don't divide tp replicates that axis while the
+                # target still splits its.
+                d_dims = {"heads": draft_cfg.n_heads,
+                          "qkv": draft_cfg.n_heads,
+                          "kv": draft_cfg.n_kv_heads,
+                          "mlp": draft_cfg.ffn_dim,
+                          "vocab": draft_cfg.vocab_size,
+                          "embed": draft_cfg.dim, "batch": self.B}
+                d_rules = prune_rules_for_mesh(dict(base), mesh, d_dims)
+                draft_params = shard_pytree(
+                    draft_params, llama_param_specs(draft_cfg, d_rules),
+                    mesh)
+                d_cache_sh = named_sharding(
+                    mesh, "layers", "batch", "length", "kv", "head_dim",
+                    rules=d_rules)
+                d_pool_sh = named_sharding(
+                    mesh, "layers", None, None, "kv", "head_dim",
+                    rules=d_rules)
+                # A second shardings view with the DRAFT plane in the
+                # primary slots, so `_prefill_rows(_paged)` runs
+                # unchanged when seeding the draft cache.
+                self._d_shardings = _EngineShardings(
+                    cache=d_cache_sh,
+                    logits=named_sharding(mesh, "batch", "vocab",
+                                          rules=d_rules),
+                    pool=d_pool_sh)
             self._shardings = _EngineShardings(
                 cache=named_sharding(mesh, "layers", "batch", "length",
                                      "kv", "head_dim", rules=rules),
                 logits=named_sharding(mesh, "batch", "vocab",
                                       rules=rules),
                 pool=named_sharding(mesh, "layers", None, None, "kv",
-                                    "head_dim", rules=rules))
+                                    "head_dim", rules=rules),
+                d_cache=d_cache_sh, d_pool=d_pool_sh)
         else:
             self.tp_degree = 1
             self._rules = None
             self._shardings = None
+            self._d_shardings = None
         self.metrics.on_tp_degree(self.tp_degree)
 
         # Paged KV mode: no dense per-slot cache at all — every row's
@@ -1109,6 +1488,79 @@ class DecodeEngine:
             if attach is not None:
                 attach(self._prefix_probe)
 
+        # Speculative plane: the DRAFT model's KV lives in a second
+        # per-slot plane — a dense [L_d, B, max_len, KV_d, D_d] ring,
+        # or its own private block pool + table in paged mode (draft
+        # blocks are never shared or tried; sized so every slot can
+        # hold a full row, the draft allocator can never run dry).
+        # Host lanes mirror the device's draft-lag trick and feed the
+        # adaptive per-row window from a sliding acceptance history.
+        self.spec_enabled = draft_params is not None
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.spec_window = spec_window
+        self.spec_dispatches = 0       # speculative program launches
+        self.spec_rounds = 0           # per-row rounds replayed
+        self.spec_proposed = 0         # draft tokens proposed (w_row)
+        self.spec_accepted = 0         # draft tokens emitted
+        self.spec_wasted = 0           # dispatch-width slots rejected
+        self.spec_prefill_dispatches = 0   # draft-plane seeding programs
+        self.spec_metrics = None
+        if self.spec_enabled:
+            if draft_cfg.max_seq_len < self.max_len:
+                raise ValueError(
+                    f"draft max_seq_len {draft_cfg.max_seq_len} < "
+                    f"engine max_len {self.max_len}")
+            self._d_lag = np.zeros((self.B,), np.int32)
+            self._d_tok = np.zeros((self.B,), np.int32)
+            self._spec_hist: List[collections.deque] = [
+                collections.deque(maxlen=16) for _ in range(self.B)]
+            self._d_last_logits = jnp.zeros(
+                (self.B, draft_cfg.vocab_size), jnp.float32)
+            if self._d_shardings is not None:
+                self._d_last_logits = jax.device_put(
+                    self._d_last_logits, self._d_shardings.logits)
+            L_d, KV_d, D_d = (draft_cfg.n_layers, draft_cfg.n_kv_heads,
+                              draft_cfg.head_dim)
+            d_dtype = jnp.dtype(draft_cfg.dtype)
+            if paged:
+                T = self.prefix_block
+                n_blocks_d = 1 + self.B * self._mb
+                self.kv_pool_d = BlockPool(n_blocks_d,
+                                           label="draft_kv")
+                self._bt_d = np.zeros((self.B, self._mb), np.int32)
+                self._row_blocks_d: List[List[int]] = [
+                    [] for _ in range(self.B)]
+                self._pool_dk = jnp.zeros(
+                    (L_d, n_blocks_d, T, KV_d, D_d), d_dtype)
+                self._pool_dv = jnp.zeros(
+                    (L_d, n_blocks_d, T, KV_d, D_d), d_dtype)
+                if self._d_shardings is not None:
+                    self._pool_dk = jax.device_put(
+                        self._pool_dk, self._d_shardings.pool)
+                    self._pool_dv = jax.device_put(
+                        self._pool_dv, self._d_shardings.pool)
+                self._d_cache = None
+            else:
+                self.kv_pool_d = None
+                self._d_cache = init_cache(
+                    draft_cfg, self.B, self.max_len,
+                    sharding=None if self._d_shardings is None
+                    else self._d_shardings.cache)
+                self._pool_dk = self._pool_dv = None
+            if enable_metrics:
+                # llm_spec_* Prometheus counters share the engine's
+                # tag, so fleet dashboards can join the spec plane onto
+                # the engine's other series (satellite: telemetry
+                # routed through the engine identity).
+                from ray_tpu.models.speculative import SpecMetrics
+                self.spec_metrics = SpecMetrics(spec_id=self.engine_id)
+        # Per-row decode-mode lane: True = argmax, False = sampled.
+        # Defaults to the engine-wide mode; submit(greedy=...) overrides
+        # per request at bind time. Retirement resets to the default so
+        # the all-greedy fast path recompiles nothing.
+        self._row_greedy = np.full((self.B,), bool(greedy), bool)
+
         # Serving-state plane: wall-clock birth + a step counter that
         # survives enable_metrics=False (the metrics `steps` field
         # vanishes with NullEngineMetrics), then a WEAK registration in
@@ -1125,7 +1577,8 @@ class DecodeEngine:
     def submit(self, prompt: List[int], max_new_tokens: int = 32,
                priority: int = 0,
                rng: Optional[jax.Array] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               greedy: Optional[bool] = None) -> int:
         """Enqueue a request; returns its id (see `results`).
 
         ``priority`` (lower = sooner) orders admission under the
@@ -1137,6 +1590,14 @@ class DecodeEngine:
         request's sampled tokens equal solo
         ``generate(..., rng=rng)``; by default a distinct stream is
         derived from the engine rng and request id.
+
+        ``greedy`` overrides the engine-wide decode mode for THIS
+        request (the per-row decode-mode lane): on a speculative
+        engine, greedy rows ride the draft/verify fast path while
+        sampled rows fall back to one plain sampled token per round —
+        their streams are unchanged vs a non-speculative engine
+        (rejection sampling for speculative sampled rows is follow-up
+        work). ``None`` (default) inherits the engine mode.
 
         ``deadline_s`` is the request's admission SLO: a latency budget
         (seconds from now, on the engine clock) within which prefill
@@ -1164,6 +1625,15 @@ class DecodeEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds engine max_len "
                 f"{self.max_len}")
+        if (self.spec_enabled and len(prompt) + max_new_tokens
+                + self.spec_window > self.max_len):
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) + spec_window "
+                f"({self.spec_window}) exceeds engine max_len "
+                f"{self.max_len}: the verify chunk writes up to "
+                "spec_window slots past the last emitted token, so "
+                "speculative engines need that margin")
         if self.paged:
             # A request must fit the pool ALONE in the worst case
             # (every other row preempted, every cold prefix block
@@ -1186,6 +1656,7 @@ class DecodeEngine:
                            priority=priority, seq=self._next_id,
                            rng=None if rng is None else _key_data(rng),
                            deadline=deadline)
+            req.greedy = greedy
             self._next_id += 1
             self.results[req.req_id] = req
             self.metrics.on_submit(req.req_id)
@@ -1211,6 +1682,7 @@ class DecodeEngine:
                        priority=priority, seq=self._next_id,
                        rng=None if rng is None else _key_data(rng),
                        deadline=deadline)
+        req.greedy = greedy
         self._next_id += 1
         self.scheduler.push(req)
         self.results[req.req_id] = req
@@ -1331,30 +1803,7 @@ class DecodeEngine:
             return emitted
 
         if not self._ring:
-            H = horizon
-            if H is None:
-                free = self.B - len(live)
-                H = self.scheduler.horizon_hint(
-                    free_slots=free, max_horizon=self.decode_horizon)
-                if len(decodable) < len(live):
-                    H = 1      # keep the chunk cadence: a mid-prefill
-                    #            row must not wait a long horizon for
-                    #            its next chunk (bounded TTFT)
-                # Cap at the largest remaining row budget (no trailing
-                # iterations with every row frozen), rounded DOWN to a
-                # power of two: the fused program recompiles per
-                # distinct H, so adaptive serving touches at most
-                # log2(horizon)+1 programs instead of one per budget
-                # remainder.
-                H = min(H, int(self.row_budget[decodable].max()))
-                H = 1 << max(0, H.bit_length() - 1)
-            if self.paged:
-                # Grow every decodable row's chain to cover the
-                # horizon, preempting victims if the pool runs dry —
-                # admission capacity is pool bytes, not slots, so
-                # over-admission is resolved here, not refused there.
-                decodable, H = self._reserve_decode_blocks(decodable, H)
-            self._dispatch_decode(H, decodable, chain=None)
+            decodable = self._dispatch_primary(decodable, live, horizon)
         self._top_up_pipeline(decodable, horizon)
         self._drain_one(emitted)
         # End of stream: every request retired, but run-ahead blocks
@@ -1375,6 +1824,158 @@ class DecodeEngine:
 
     # -- async pipeline ----------------------------------------------------
 
+    def _dispatch_primary(self, decodable: List[int], live: List[int],
+                          horizon: Optional[int]) -> List[int]:
+        """Launch the step's PRIMARY dispatch (ring empty, host state
+        fully replayed): a speculative draft/verify round when the
+        engine has a draft plane and at least one decodable greedy row
+        with budget to speculate into, else the plain fused horizon.
+        Mid-chunked-prefill steps always take the plain H=1 path — the
+        chunk cadence outranks speculation depth. Returns the possibly
+        narrowed decodable set (paged reservation may preempt)."""
+        if self.spec_enabled and len(decodable) == len(live):
+            W, w_row = self._spec_plan(decodable)
+            if W:
+                if self.paged:
+                    decodable, Hr = self._reserve_decode_blocks(
+                        decodable, W + 1)
+                    if Hr < W + 1:
+                        # Pool too tight to cover the verify chunk even
+                        # after preemption: decode plainly at whatever
+                        # horizon the reservation could hold.
+                        self._dispatch_decode(Hr, decodable, chain=None)
+                        return decodable
+                self._dispatch_spec(W, w_row, decodable, chain=None)
+                return decodable
+        H = horizon
+        if H is None:
+            free = self.B - len(live)
+            H = self.scheduler.horizon_hint(
+                free_slots=free, max_horizon=self.decode_horizon)
+            if len(decodable) < len(live):
+                H = 1      # keep the chunk cadence: a mid-prefill
+                #            row must not wait a long horizon for
+                #            its next chunk (bounded TTFT)
+            # Cap at the largest remaining row budget (no trailing
+            # iterations with every row frozen), rounded DOWN to a
+            # power of two: the fused program recompiles per
+            # distinct H, so adaptive serving touches at most
+            # log2(horizon)+1 programs instead of one per budget
+            # remainder.
+            H = min(H, int(self.row_budget[decodable].max()))
+            H = 1 << max(0, H.bit_length() - 1)
+        if self.paged:
+            # Grow every decodable row's chain to cover the
+            # horizon, preempting victims if the pool runs dry —
+            # admission capacity is pool bytes, not slots, so
+            # over-admission is resolved here, not refused there.
+            decodable, H = self._reserve_decode_blocks(decodable, H)
+        self._dispatch_decode(H, decodable, chain=None)
+        return decodable
+
+    def _spec_plan(self, decodable: List[int]):
+        """Pick this dispatch's draft width. Each greedy decodable
+        row's sliding acceptance window (last 16 rounds) feeds
+        `SchedulerPolicy.spec_window_hint`; the dispatch width W is the
+        max hint rounded UP to a power of two (bounded compile count,
+        like the horizon), capped at `spec_window`, and each row keeps
+        its own hint as a traced acceptance cap (`w_row`) — a shrinking
+        row narrows its drafting without recompiling anything. Returns
+        (0, None) to decline speculation: no decodable greedy row, or
+        every greedy row down to its last budgeted token (a plain step
+        emits the same single token with a cheaper program)."""
+        greedy_rows = [b for b in decodable if self._row_greedy[b]]
+        if not greedy_rows:
+            return 0, None
+        if int(self.row_budget[greedy_rows].max()) <= 1:
+            return 0, None
+        rates: List[Optional[float]] = []
+        for b in greedy_rows:
+            prop = sum(p for p, _ in self._spec_hist[b])
+            acc = sum(a for _, a in self._spec_hist[b])
+            rates.append(acc / prop if prop else None)
+        hints = self.scheduler.spec_window_hint(
+            rates=rates, spec_window=self.spec_window)
+        w_row = np.ones((self.B,), np.int32)
+        wmax = 1
+        for b, w in zip(greedy_rows, hints):
+            w = max(1, min(int(w), self.spec_window))
+            w_row[b] = w
+            wmax = max(wmax, w)
+        return min(self.spec_window, _pow2(wmax)), w_row
+
+    def _dispatch_spec(self, W: int, w_row: np.ndarray,
+                       rows: List[int],
+                       chain: Optional[tuple]) -> None:
+        """Launch ONE speculative draft/verify round — the spec twin of
+        `_dispatch_decode`, same async contract: emit block's
+        `copy_to_host_async` issued immediately, full device carry
+        (including the draft-lag lane) stored for run-ahead chaining,
+        ONE host pull later at drain. The ring entry's H is W+1 (the
+        emit block height and the pessimistic in-flight token count)."""
+        tr = self.trace
+        t0 = tr.now() if tr.enabled else 0.0
+        if chain is None:
+            active = np.array([self.row_req[b] is not None
+                               and b not in self._row_prefill
+                               for b in range(self.B)])
+            args = (jnp.asarray(self.row_len), jnp.asarray(active),
+                    jnp.asarray(self.row_budget),
+                    jnp.asarray(self._tok_idx),
+                    jnp.asarray(self._d_lag),
+                    jnp.asarray(self._d_tok))
+        else:
+            args = chain
+        rg = jnp.asarray(self._row_greedy)
+        all_greedy = bool(self._row_greedy.all())
+        wr = jnp.asarray(w_row)
+        if self.paged:
+            bt_dev = jnp.asarray(self._bt)
+            btd_dev = jnp.asarray(self._bt_d)
+            if self._shardings is not None:
+                bt_dev = jax.device_put(bt_dev,
+                                        self._shardings.replicated)
+                btd_dev = jax.device_put(btd_dev,
+                                         self._shardings.replicated)
+            (toks, self._pool_k, self._pool_v, self._pool_dk,
+             self._pool_dv, self._last_logits, rl, ac, bu, ti, dl,
+             dt) = _spec_round_paged(
+                self.params, self.draft_params, self._pool_k,
+                self._pool_v, self._pool_dk, self._pool_dv, bt_dev,
+                btd_dev, self._last_logits, *args,
+                jnp.asarray(self._row_keys), rg, wr, self.temperature,
+                self.cfg, self.draft_cfg, W, all_greedy, self.top_k,
+                self.top_p, self.eos_id, shardings=self._shardings)
+        else:
+            (toks, self.cache, self._d_cache, self._last_logits, rl,
+             ac, bu, ti, dl, dt) = _spec_round(
+                self.params, self.draft_params, self.cache,
+                self._d_cache, self._last_logits, *args,
+                jnp.asarray(self._row_keys), rg, wr, self.temperature,
+                self.cfg, self.draft_cfg, W, all_greedy, self.top_k,
+                self.top_p, self.eos_id, shardings=self._shardings)
+        try:
+            toks.copy_to_host_async()
+        except AttributeError:
+            pass                   # non-jax.Array backends (tests)
+        self._ring.append(_InflightStep(
+            toks, W + 1, list(rows), run_ahead=chain is not None,
+            chain=(rl, ac, bu, ti, dl, dt), spec=True, w_max=W,
+            w_row=np.array(w_row, np.int32)))
+        self.decode_dispatches += 1
+        self.spec_dispatches += 1
+        self.metrics.on_dispatch(W + 1, host_syncs=0)
+        if tr.enabled:
+            # The draft scan and verify pass live inside ONE fused
+            # program, so the dispatch seam carries the spec_draft
+            # span (proposal width known here) and the drain seam
+            # carries spec_verify (acceptance known there).
+            tr.add("spec_draft", t0, tr.now() - t0, lane="dispatch",
+                   args={"window": W,
+                         "proposed": int(w_row[rows].sum()),
+                         "rows": len(rows),
+                         "run_ahead": chain is not None})
+
     def _dispatch_decode(self, H: int, rows: List[int],
                          chain: Optional[tuple]) -> None:
         """Launch ONE fused decode step without waiting on anything:
@@ -1394,6 +1995,12 @@ class DecodeEngine:
                     jnp.asarray(self._tok_idx))
         else:
             args = chain
+        # The static greedy flag is the all-greedy fast path: without
+        # per-request overrides it equals the engine-wide mode exactly
+        # (the lane resets to the default at retirement), so existing
+        # engines compile the same two programs they always did.
+        rg = jnp.asarray(self._row_greedy)
+        all_greedy = bool(self._row_greedy.all())
         if self.paged:
             # Snapshot the block table at dispatch: jnp.asarray copies
             # it to device, so host-side growth between chained
@@ -1407,15 +2014,15 @@ class DecodeEngine:
              rl, ac, bu, ti) = _decode_multi_paged(
                 self.params, self._pool_k, self._pool_v, bt_dev,
                 self._last_logits, *args, jnp.asarray(self._row_keys),
-                self.temperature, self.cfg, H, self.greedy,
+                rg, self.temperature, self.cfg, H, all_greedy,
                 self.top_k, self.top_p, self.eos_id,
                 shardings=self._shardings)
         else:
             toks, self.cache, self._last_logits, rl, ac, bu, ti = \
                 _decode_multi(
                     self.params, self.cache, self._last_logits, *args,
-                    jnp.asarray(self._row_keys), self.temperature,
-                    self.cfg, H, self.greedy, self.top_k, self.top_p,
+                    jnp.asarray(self._row_keys), rg, self.temperature,
+                    self.cfg, H, all_greedy, self.top_k, self.top_p,
                     self.eos_id, shardings=self._shardings)
         try:
             toks.copy_to_host_async()
@@ -1446,11 +2053,25 @@ class DecodeEngine:
                 or self.scheduler.admissions_pending()):
             return
         while len(self._ring) < self.pipeline_depth:
+            last = self._ring[-1]
             inflight = sum(e.H for e in self._ring)
             rem = int(self.row_budget[rows].max()) - inflight
             if rem <= 0:
                 break              # every further iteration would be
                 #                    overrun — nothing left to compute
+            if last.spec:
+                # Chain another speculative round at the SAME widths:
+                # the adaptive window can only move once the host has
+                # replayed acceptance, and an unchanged (W, w_row)
+                # keeps the chained dispatch on the compiled program.
+                # H accounting is pessimistic (every round could emit
+                # w_max+1), same discipline as plain run-ahead.
+                if self.paged and not self._ensure_decode_blocks(
+                        rows, last.w_max + 1, inflight):
+                    break
+                self._dispatch_spec(last.w_max, last.w_row, rows,
+                                    chain=last.chain)
+                continue
             if horizon is not None:
                 Hn = horizon
             else:
@@ -1486,8 +2107,24 @@ class DecodeEngine:
         nbytes = int(getattr(block, "nbytes", block.size * 4))
         self.host_transfer_bytes += nbytes
         self.metrics.on_host_sync(nbytes=nbytes)
-        self._emit_block(block, entry, emitted)
+        sp_rounds, sp_prop, sp_acc = self._emit_block(
+            block, entry, emitted)
         self.metrics.on_pipeline_drain(depth, len(self._ring))
+        if entry.spec and sp_rounds:
+            self.metrics.on_spec_round(sp_rounds, sp_prop, sp_acc)
+            if self.spec_metrics is not None:
+                from ray_tpu.models.speculative import SpecStats
+                self.spec_metrics.observe(SpecStats(
+                    rounds=sp_rounds, proposed=sp_prop,
+                    accepted=sp_acc))
+        if entry.spec and tr.enabled:
+            # The draft scan and verify pass live inside ONE fused
+            # program, so acceptance is only knowable here at drain:
+            # spec_draft marks the dispatch seam, spec_verify the
+            # drain seam where the accept counts land.
+            tr.add("spec_verify", t0, tr.now() - t0, lane="drain",
+                   args={"window": entry.w_max, "rounds": sp_rounds,
+                         "proposed": sp_prop, "accepted": sp_acc})
         if tr.enabled:
             tr.add("host_drain", t0, tr.now() - t0, lane="drain",
                    args={"horizon": entry.H, "depth": depth,
@@ -1610,6 +2247,28 @@ class DecodeEngine:
                                               pool.blocks_total)
             out["kv_free_blocks"] = float(self.kv_free_blocks())
             out["requests_swapped"] = float(len(self._swapped))
+        # Speculative plane: identically 0.0 with spec off, so fleet
+        # rollups sum/weight them without mode checks. acceptance_rate
+        # is accepted/proposed over the engine's lifetime;
+        # window_effective is the mean per-round draft width the
+        # adaptive policy actually dispatched (proposed/rounds).
+        out["spec_enabled"] = 1.0 if self.spec_enabled else 0.0
+        out["spec_window"] = float(self.spec_window
+                                   if self.spec_enabled else 0)
+        out["spec_dispatches"] = float(self.spec_dispatches)
+        out["spec_rounds"] = float(self.spec_rounds)
+        out["spec_proposed"] = float(self.spec_proposed)
+        out["spec_accepted"] = float(self.spec_accepted)
+        out["spec_acceptance_rate"] = _ratio(self.spec_accepted,
+                                             self.spec_proposed)
+        out["spec_window_effective"] = _ratio(self.spec_proposed,
+                                              self.spec_rounds)
+        out["spec_draft_tokens_wasted"] = float(self.spec_wasted)
+        out["spec_prefill_dispatches"] = float(
+            self.spec_prefill_dispatches)
+        if self.spec_enabled and self.paged:
+            out["spec_kv_pool_blocks_in_use"] = float(
+                self.kv_pool_d.blocks_in_use)
         return out
 
     def run(self) -> Dict[int, List[int]]:
@@ -1791,6 +2450,7 @@ class DecodeEngine:
             self._admit_rows_paged(admissions)
             return
         copy_groups: Dict[int, List[Tuple[int, List[int]]]] = {}
+        draft_seeds: List[Tuple[int, List[int]]] = []
         for row, req in admissions:
             self.metrics.on_admit(req.req_id)   # queue wait ends here
             if self.trace.enabled:
@@ -1826,7 +2486,14 @@ class DecodeEngine:
             self.row_budget[row] = req.max_new_tokens
             self._tok_idx[row] = 0
             self._row_keys[row] = self._req_key(req)
+            self._row_greedy[row] = (self.greedy if req.greedy is None
+                                     else bool(req.greedy))
             self._row_prefill[row] = _PrefillState(req, start, nodes)
+            if self.spec_enabled:
+                # The draft plane has no prefix cache: even a warm
+                # target admission seeds the draft with the FULL
+                # prompt, piggybacked on this admission step.
+                draft_seeds.append((row, list(req.prompt)))
         for nbp in sorted(copy_groups):
             grp = copy_groups[nbp]
             n = len(grp)
@@ -1843,6 +2510,7 @@ class DecodeEngine:
                 jnp.asarray(bids), jnp.asarray(rows), nbp,
                 self.prefix_block, shardings=self._shardings)
             self.prefix_copy_dispatches += 1
+        self._seed_draft_rows(draft_seeds)
 
     # -- paged KV: admission, block accounting, preempt-and-swap -----------
 
@@ -1859,6 +2527,7 @@ class DecodeEngine:
         either), and the suffix prefills exactly as in dense mode."""
         T = self.prefix_block
         cow_pairs: List[Tuple[int, int]] = []
+        draft_seeds: List[Tuple[int, List[int]]] = []
         for row, req in admissions:
             self.metrics.on_admit(req.req_id)
             swap = self._swapped.pop(req.req_id, None)
@@ -1869,6 +2538,13 @@ class DecodeEngine:
                     # requeue; the slot stays empty this round.
                     self._swapped[req.req_id] = swap
                     self._requeue_front(req)
+                elif self.spec_enabled:
+                    # The swap ledger never carries the draft plane:
+                    # re-seed it from prompt + emitted tokens (the
+                    # exact sequence the target's restored K/V
+                    # encodes), so acceptance recovers immediately.
+                    draft_seeds.append(
+                        (row, list(req.prompt) + list(req.tokens)))
                 continue
             if self.trace.enabled:
                 self.trace.close("queue_wait", req.req_id)
@@ -1929,6 +2605,8 @@ class DecodeEngine:
                 nodes = self._prefix.register(req.prompt, chain)
             self._bind_row(row, req, chain, start)
             self._row_prefill[row] = _PrefillState(req, start, nodes)
+            if self.spec_enabled:
+                draft_seeds.append((row, list(req.prompt)))
         if cow_pairs:
             n = len(cow_pairs)
             n_pad = _pow2(n)
@@ -1940,6 +2618,69 @@ class DecodeEngine:
             self._pool_k, self._pool_v = _cow_blocks(
                 self._pool_k, self._pool_v, jnp.asarray(src),
                 jnp.asarray(dst), shardings=self._shardings)
+        self._seed_draft_rows(draft_seeds)
+
+    def _seed_draft_rows(
+            self, seeds: List[Tuple[int, List[int]]]) -> None:
+        """Seed the DRAFT KV plane for freshly (re)bound rows: one
+        full-sequence draft prefill per length bucket, piggybacked on
+        the admission step (the draft is cheap enough that chunking it
+        buys nothing — the target's chunked prefill still paces TTFT).
+        Each seeded row also resets its draft-lag lane and acceptance
+        history. A failed draft-chain alloc skips the seed: a cold
+        draft only lowers acceptance, never changes emitted tokens."""
+        if not self.spec_enabled or not seeds:
+            return
+        T = self.prefix_block
+        groups: Dict[int, List[Tuple[int, List[int]]]] = {}
+        for row, toks in seeds:
+            self._d_lag[row] = 0
+            self._d_tok[row] = 0
+            self._spec_hist[row].clear()
+            if not toks:
+                continue
+            if self.paged and not self._ensure_draft_blocks(
+                    row, -(-len(toks) // T)):
+                continue
+            Cb = min(self._bucket(len(toks)), self.max_len)
+            groups.setdefault(Cb, []).append((row, toks))
+        for Cb in sorted(groups):
+            grp = groups[Cb]
+            n = len(grp)
+            t0 = self.trace.now() if self.trace.enabled else 0.0
+            n_pad = _pow2(n)
+            prompts = np.zeros((n_pad, Cb), np.int32)
+            rows = np.zeros((n_pad,), np.int32)
+            starts = np.zeros((n_pad,), np.int32)
+            last_idx = np.zeros((n_pad,), np.int32)
+            for i, (row, toks) in enumerate(grp):
+                prompts[i, :len(toks)] = toks
+                rows[i] = row
+                last_idx[i] = len(toks) - 1
+            prompts[n:] = prompts[n - 1]    # filler: repeat last row —
+            rows[n:] = rows[n - 1]          # duplicate scatters write
+            last_idx[n:] = last_idx[n - 1]  # identical values
+            if self.paged:
+                bt_grp = self._bt_d[rows]
+                (self._pool_dk, self._pool_dv,
+                 self._d_last_logits) = _prefill_rows_paged(
+                    self.draft_params, jnp.asarray(prompts),
+                    self._pool_dk, self._pool_dv, self._d_last_logits,
+                    jnp.asarray(bt_grp), jnp.asarray(rows),
+                    jnp.asarray(starts), jnp.asarray(last_idx),
+                    self.draft_cfg, shardings=self._d_shardings)
+            else:
+                self._d_cache, self._d_last_logits = _prefill_rows(
+                    self.draft_params, jnp.asarray(prompts),
+                    self._d_cache, self._d_last_logits,
+                    jnp.asarray(rows), jnp.asarray(starts),
+                    jnp.asarray(last_idx), self.draft_cfg,
+                    shardings=self._d_shardings)
+            self.spec_prefill_dispatches += 1
+            if self.trace.enabled:
+                self.trace.add(
+                    "spec_draft_prefill", t0, self.trace.now() - t0,
+                    lane="dispatch", args={"bucket": Cb, "rows": n})
 
     def _bind_row(self, row: int, req: _Request, chain: List[int],
                   start: int) -> None:
@@ -1954,6 +2695,8 @@ class DecodeEngine:
         self.row_budget[row] = req.max_new_tokens
         self._tok_idx[row] = 0
         self._row_keys[row] = self._req_key(req)
+        self._row_greedy[row] = (self.greedy if req.greedy is None
+                                 else bool(req.greedy))
         self._row_admit_seq[row] = self._admit_seq
         self._admit_seq += 1
 
@@ -2000,6 +2743,28 @@ class DecodeEngine:
                     return False
                 self._row_blocks[b].extend(got)
                 self._bt[b, have:have + len(got)] = got
+            if self.spec_enabled and not self._ensure_draft_blocks(b, nb):
+                return False
+        return True
+
+    def _ensure_draft_blocks(self, b: int, nb: int) -> bool:
+        """Grow row ``b``'s DRAFT chain to ``nb`` blocks. The draft
+        pool is sized so every slot can hold a full-length chain, so
+        this cannot fail for live rows in steady state; False is
+        returned defensively (the caller treats it like target-pool
+        exhaustion). Draft coverage is a performance nicety, not a
+        correctness requirement: an overshooting draft write past the
+        chain lands in table entry 0 — the null block — whose garbage
+        is never attended (``kv_valid_len`` masks it), and a garbage
+        draft only lowers acceptance, never changes emitted tokens."""
+        have = len(self._row_blocks_d[b])
+        if nb <= have:
+            return True
+        got = self.kv_pool_d.alloc(nb - have)
+        if got is None:
+            return False
+        self._row_blocks_d[b].extend(got)
+        self._bt_d[b, have:have + len(got)] = got
         return True
 
     def _reserve_decode_blocks(self, decodable: List[int],
@@ -2167,6 +2932,15 @@ class DecodeEngine:
             self.kv_pool.decref(ids)
         self._row_blocks[row] = []
         self._bt[row, :] = 0
+        if self.spec_enabled:
+            # Draft chains are private (never trie-shared), so decref
+            # frees them outright; the plane is re-seeded from scratch
+            # at (re-)admission.
+            d_ids = self._row_blocks_d[row]
+            if d_ids:
+                self.kv_pool_d.decref(d_ids)
+            self._row_blocks_d[row] = []
+            self._bt_d[row, :] = 0
 
     def _fits_now(self, req: _Request) -> bool:
         """Admission gate: would this request's NEW blocks fit the
@@ -2312,7 +3086,8 @@ class DecodeEngine:
                 self._prefix.commit(node)
 
     def _emit_block(self, block: np.ndarray, entry: _InflightStep,
-                    emitted: Dict[int, List[int]]) -> None:
+                    emitted: Dict[int, List[int]]
+                    ) -> Tuple[int, int, int]:
         """VECTORIZED host replay of one [H, B] token block: mirrors
         `_decode_multi`'s per-iteration transition without touching the
         device, but in one numpy slice + one arithmetic pass per ROW
@@ -2338,8 +3113,21 @@ class DecodeEngine:
         Rows found already retired (`row_req is None`) only occur in
         run-ahead blocks dispatched before the host replayed the
         retiring block; their columns are all-masked on device and
-        accounted as `pipeline_overrun_tokens`."""
+        accounted as `pipeline_overrun_tokens`.
+
+        Returns `(rounds, proposed, accepted)` speculative accounting
+        for this block — all zero for a plain decode block — so
+        `_drain_one` can feed SpecMetrics and the `spec_verify` span
+        without rescanning the columns. For a spec block each live
+        greedy row is one ROUND: it proposed `w_row[b]` draft tokens
+        and had `count - 1` of them accepted (the +1 is the verify
+        pass's own token, which is free). The host `_d_lag/_d_tok`
+        lanes mirror the device's draft-lag carry: a fully-accepted
+        round leaves the last accepted token un-fed to the DRAFT
+        (lag 1), anything else leaves the draft exactly at the
+        frontier (lag 0)."""
         tr = self.trace
+        sp_rounds = sp_prop = sp_acc = 0
         for b in entry.rows:
             req = self.row_req[b]
             if req is None:
@@ -2360,6 +3148,17 @@ class DecodeEngine:
                     "decode_block", req.req_id,
                     {"tokens": count, "horizon": entry.H,
                      "batch": len(entry.rows)})
+            if entry.spec and self._row_greedy[b]:
+                proposed_b = int(entry.w_row[b])
+                accepted_b = count - 1
+                sp_rounds += 1
+                sp_prop += proposed_b
+                sp_acc += accepted_b
+                self.spec_rounds += 1
+                self.spec_proposed += proposed_b
+                self.spec_accepted += accepted_b
+                self.spec_wasted += proposed_b - accepted_b
+                self._spec_hist[b].append((proposed_b, accepted_b))
             self.row_budget[b] -= count
             self._tok_idx[b] += count
             out_of_room = self.row_len[b] + count >= self.max_len
@@ -2376,6 +3175,13 @@ class DecodeEngine:
                 self.row_len[b] = 0      # slot free for the next prefill
                 self.row_budget[b] = 0
                 self._tok_idx[b] = 0
+                # Lane reset: the slot's next tenant starts from the
+                # engine default, so an override-free engine keeps its
+                # all-greedy fast path (one static compile).
+                self._row_greedy[b] = bool(self.greedy)
+                if self.spec_enabled:
+                    self._d_lag[b] = 0
+                    self._d_tok[b] = 0
                 if self.paged:
                     # Blocks the trie shares stay resident (its ref);
                     # everything else returns to the pool NOW — this
@@ -2384,3 +3190,8 @@ class DecodeEngine:
                     self._release_row_blocks(b)
             else:
                 self.row_len[b] += count  # the fed tokens took their slots
+                if entry.spec:
+                    full = count == entry.H
+                    self._d_lag[b] = 1 if full else 0
+                    self._d_tok[b] = int(toks[-1]) if full else 0
+        return sp_rounds, sp_prop, sp_acc

@@ -302,6 +302,25 @@ class EngineMetrics:
         self._m_kv_pool_free = gauge(
             "llm_engine_kv_pool_blocks_free",
             "KV pool blocks on the free list")
+        # Speculative plane (PR: engine-integrated draft/verify). The
+        # per-spec-plane llm_spec_* series live in SpecMetrics, tagged
+        # with the SAME engine id; these engine-tagged aggregates let
+        # dashboards join acceptance onto the other engine series.
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self._m_spec_rounds = counter(
+            "llm_engine_spec_rounds_total",
+            "Draft-propose / target-verify rounds replayed at drain")
+        self._m_spec_proposed = counter(
+            "llm_engine_spec_proposed_total",
+            "Draft tokens proposed inside fused spec dispatches")
+        self._m_spec_accepted = counter(
+            "llm_engine_spec_accepted_total",
+            "Proposed draft tokens the target accepted")
+        self._m_spec_rate = gauge(
+            "llm_engine_spec_acceptance_rate",
+            "Cumulative accepted / proposed (0..1; 0 with spec off)")
 
     # -- lifecycle hooks (called by DecodeEngine) --------------------------
 
@@ -519,6 +538,25 @@ class EngineMetrics:
             self.prefill_stalls += n
             self._m_prefill_stalls.inc(n)
 
+    def on_spec_round(self, rounds: int, proposed: int,
+                      accepted: int) -> None:
+        """One drained speculative block's acceptance accounting:
+        `rounds` live greedy rows each verified their proposals —
+        `proposed` draft tokens total, of which `accepted` matched the
+        target's argmax chain (and were emitted for free)."""
+        self.spec_rounds += rounds
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        if rounds > 0:
+            self._m_spec_rounds.inc(rounds)
+        if proposed > 0:
+            self._m_spec_proposed.inc(proposed)
+        if accepted > 0:
+            self._m_spec_accepted.inc(accepted)
+        if self.spec_proposed:
+            self._m_spec_rate.set(self.spec_accepted
+                                  / self.spec_proposed)
+
     def observe_queue_depth(self, depth: int) -> None:
         """Gauge update outside a step (e.g. right after submit)."""
         self.queue_depth = depth
@@ -587,6 +625,12 @@ class EngineMetrics:
         out["pipeline_depth_effective"] = (
             self.pipeline_depth.sum / self.pipeline_depth.count
             if self.pipeline_depth.count else 0.0)
+        out["spec_rounds"] = self.spec_rounds
+        out["spec_proposed"] = self.spec_proposed
+        out["spec_accepted"] = self.spec_accepted
+        out["spec_acceptance_rate"] = (
+            self.spec_accepted / self.spec_proposed
+            if self.spec_proposed else 0.0)
         self.queue_wait_s.fields("queue_wait_s", out)
         self.ttft_s.fields("ttft_s", out)
         self.tpot_s.fields("tpot_s", out)
@@ -647,6 +691,8 @@ class NullEngineMetrics:
     def on_prefill_batch(self, real_tokens, padded_tokens): pass
 
     def on_prefill_stall(self, n=1): pass
+
+    def on_spec_round(self, rounds, proposed, accepted): pass
 
     def observe_queue_depth(self, depth): pass
 

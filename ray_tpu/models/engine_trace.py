@@ -35,6 +35,33 @@ pattern) turns tracing on for every engine constructed with
 process exit. ``RAY_TPU_PROFILE`` composes independently: it profiles
 the host control plane with cProfile, this traces requests — setting
 both gets both artifacts.
+
+Span catalogue (name / tid lane / meaning):
+
+- ``queue_wait`` (req): submit -> admission.
+- ``prefill_chunk`` (req): one prompt-prefill program (chunked
+  prefill emits one span per chunk).
+- ``decode_block`` (req): the request's share of one fused decode
+  dispatch+drain (args: tokens emitted).
+- ``preempt_swap_out`` / ``swap_in`` (req): paged preemption round
+  trip.
+- ``finish`` / ``shed`` (req): instant markers closing the lifecycle.
+- ``dispatch`` / ``host_drain`` (engine lane): one batched program
+  launch / one blocking device->host token pull.
+- ``spec_draft`` (engine ``dispatch`` lane): one speculative dispatch
+  — draft proposals + target verify fused in one program (args:
+  window, proposed, rows, run_ahead).
+- ``spec_draft_prefill`` (engine ``dispatch`` lane): draft-plane
+  prompt seeding at admission / swap-in (args: bucket, rows).
+- ``spec_verify`` (engine ``drain`` lane): the host-side acceptance
+  accounting for one drained speculative block (args: window, rounds,
+  proposed, accepted).
+
+Speculative spans ride the ENGINE lanes, not per-request tids — one
+spec dispatch serves the whole batch, so attributing it to a request
+would break the per-request contiguity sum that `tools/trace_report.py`
+leans on; the report aggregates them in a separate engine-lane
+speculation summary instead.
 """
 
 from __future__ import annotations

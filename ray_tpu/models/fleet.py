@@ -745,6 +745,27 @@ class LLMFleet:
                 sum(s.get("kv_used_fraction", 0.0) for s in per)
                 / len(per)) if per else 0.0,
         }
+        # Speculative plane (all-zero when no replica carries a draft
+        # model). Rates are re-derived from the summed raw counters —
+        # a proposal-weighted mean — so a busy replica's acceptance
+        # dominates an idle one's instead of averaging per-replica
+        # ratios.
+        sp_prop = sum(s.get("spec_proposed", 0.0) for s in per)
+        sp_acc = sum(s.get("spec_accepted", 0.0) for s in per)
+        sp_rounds = sum(s.get("spec_rounds", 0.0) for s in per)
+        out["spec_replicas"] = sum(
+            s.get("spec_enabled", 0.0) for s in per)
+        out["spec_dispatches"] = sum(
+            s.get("spec_dispatches", 0.0) for s in per)
+        out["spec_rounds"] = sp_rounds
+        out["spec_proposed"] = sp_prop
+        out["spec_accepted"] = sp_acc
+        out["spec_acceptance_rate"] = (
+            sp_acc / sp_prop if sp_prop else 0.0)
+        out["spec_window_effective"] = (
+            sp_prop / sp_rounds if sp_rounds else 0.0)
+        out["spec_draft_tokens_wasted"] = sum(
+            s.get("spec_draft_tokens_wasted", 0.0) for s in per)
         out["router_affinity_wins"] = float(
             getattr(self.router, "affinity_wins", 0))
         out["router_pow2_wins"] = float(

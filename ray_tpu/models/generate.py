@@ -183,7 +183,15 @@ def forward_cached_rows(params: Params, tokens: jax.Array, cache: Cache,
     prefix and/or earlier chunks); slots at or beyond the chunk are
     excluded by the causal ``slot <= q_slot`` mask, so stale K/V from a
     slot's previous occupant is never attended. RoPE positions equal
-    cache slots (no left-padding in slot-based serving)."""
+    cache slots (no left-padding in slot-based serving).
+
+    Write-before-attend: the whole chunk's K/V is scattered into the
+    cache BEFORE the chunk attends, so re-running a chunk over slots
+    whose previous contents are stale simply overwrites them. The
+    engine's speculative path leans on this as its no-rollback cache
+    discipline — a rejected draft window's K/V is left in place and the
+    next round's verify chunk lands exactly on top of it, the causal
+    mask hiding whatever lies beyond the chunk."""
     B, S = tokens.shape
     h = params["tok_embed"].astype(cfg.dtype)[tokens]
     slot_ids = starts[:, None] + jnp.arange(S)[None, :]      # [B, S]

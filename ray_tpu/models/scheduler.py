@@ -134,6 +134,38 @@ class SchedulerPolicy:
             return 1
         return max_horizon
 
+    def spec_window_hint(self, *, rates: List[Optional[float]],
+                         spec_window: int) -> List[int]:
+        """Per-row ADAPTIVE draft window for the next speculative
+        dispatch — the speculation analog of `horizon_hint`. `rates`
+        has one entry per candidate row: that row's recent acceptance
+        rate (accepted / proposed over the engine's sliding window of
+        rounds), or None for a row with no history yet (fresh
+        admission). Returns one draft width per row, each in
+        [1, spec_window].
+
+        Default policy: trust a fresh row with the full window
+        (optimistic — the first rounds measure it), then track the
+        measured acceptance rate linearly: a row accepting everything
+        keeps `spec_window`, a row rejecting everything shrinks to 1
+        (one proposal still rides free on the verify pass), rows in
+        between get `1 + rate * (spec_window - 1)` rounded. The engine
+        takes the max over rows (rounded up to a power of two, capped
+        at `spec_window`) as the dispatch width and applies each row's
+        hint as its per-row acceptance cap, so one shrinking row never
+        recompiles the program. Policies may override — e.g. a
+        deadline-aware policy forcing 1 to minimize per-round latency
+        variance."""
+        out = []
+        for r in rates:
+            if r is None:
+                out.append(spec_window)
+            else:
+                out.append(max(1, min(spec_window,
+                                      1 + int(r * (spec_window - 1)
+                                              + 0.5))))
+        return out
+
     def admissions_pending(self) -> bool:
         """Could an admission decision change the batch soon? The
         engine's async decode pipeline consults this before running

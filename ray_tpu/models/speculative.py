@@ -13,18 +13,26 @@ eats the sequential latency. Greedy acceptance (token match against the
 target's argmax) makes the output provably identical to target-only
 greedy decode (tested).
 
-Cache discipline (no explicit rollback): `forward_cached` masks
-attention to slots < kv_valid_len = start + S. Rejected candidates'
-K/V entries live at slots >= the accepted position, which is exactly
-where the next round's chunk starts writing — so stale entries are
-never attended before they are overwritten. The draft consumes a CHUNK
-of not-yet-written tokens each round (1 normally; 2 after a fully
-accepted window, whose last draft token never became a draft input) so
-neither cache ever has a hole behind its valid frontier.
+Cache discipline (no explicit rollback): attention is masked to
+slots <= the query's own slot. Rejected candidates' K/V entries live
+at slots >= the accepted position, which is exactly where the next
+round's chunk starts writing — so stale entries are never attended
+before they are overwritten. The draft consumes a fixed-width CHUNK
+of 2 tokens each round via a per-row LAG lane (lag=1 after a fully
+accepted window: the last draft token never became a draft input and
+is still pending; lag=0 otherwise, where the second chunk token is a
+junk duplicate whose K/V is overwritten by the first proposal's write
+before anything attends it) so neither cache ever has a hole behind
+its valid frontier — and the chunk shape stays static across rows
+with different lags.
 
-Scope: batch size 1 (speculation is an interactive-latency
-optimization; batched throughput serving uses `generate`'s scanned
-batch decode, where the MXU is already fed by the batch dimension).
+Scope: any batch size. Rows advance independently — the host accept
+loop is vectorized with numpy over the batch (the same replay
+discipline as the engine's `_emit_block`), and every jitted program
+takes per-row `starts`, so rows with divergent acceptance histories
+share one program. `DecodeEngine(draft_params=...)` integrates the
+same round structure into continuous batching (see models/engine.py);
+this standalone entry point remains the no-engine path.
 """
 
 from __future__ import annotations
@@ -38,7 +46,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_tpu.models.generate import (_prefill_jit, forward_cached,
+from ray_tpu.models.generate import (_prefill_jit, forward_cached_rows,
                                      init_cache)
 from ray_tpu.models.llama import LlamaConfig
 from ray_tpu.util.metrics import Counter, Gauge
@@ -136,24 +144,32 @@ class SpecMetrics:
 
 @functools.partial(jax.jit, static_argnames=("cfg", "width"),
                    donate_argnames=("cache",))
-def _draft_propose(params, chunk, cache, start, cfg, width):
-    """Consume `chunk` [B, m] at cache slot `start` (appending its K/V),
-    then greedily roll `width` proposals. Returns
-    (proposals [B, width], cache); the cache gains K/V for the chunk and
-    the first width-1 proposals (the last proposal is never an input)."""
-    logits, cache = forward_cached(params, chunk, cache, start, cfg)
-    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-    m = chunk.shape[1]
+def _draft_propose_rows(params, chunk2, cache, starts, lag, cfg, width):
+    """Consume the fixed-width-2 chunk [pending-or-last, last] at
+    per-row slot `starts` = n - lag (appending its K/V), then greedily
+    roll `width` proposals. Row b's first proposal follows its LAST
+    token, i.e. logits column `lag[b]` (lag=1: [d_pending@n-1, last@n];
+    lag=0: [last@n, junk@n+1] whose junk K/V the first proposal's write
+    at n+1 overwrites before any query attends it). Returns
+    (proposals [B, width], cache); the cache gains K/V for the chunk
+    and the first width-1 proposals (the last proposal is never an
+    input)."""
+    B = chunk2.shape[0]
+    logits, cache = forward_cached_rows(params, chunk2, cache, starts,
+                                        cfg)
+    first = jnp.argmax(logits[jnp.arange(B), lag],
+                       axis=-1).astype(jnp.int32)
+    frontier = starts + lag          # == n: proposal j writes at n+1+j
 
-    def step(carry, _):
-        tok, cache, slot = carry
-        logits, cache = forward_cached(params, tok[:, None], cache, slot,
-                                       cfg)
+    def step(carry, j):
+        tok, cache = carry
+        logits, cache = forward_cached_rows(
+            params, tok[:, None], cache, frontier + 1 + j, cfg)
         nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-        return (nxt, cache, slot + 1), tok
+        return (nxt, cache), tok
 
-    (last, cache, _), toks = jax.lax.scan(
-        step, (first, cache, start + m), None, length=width - 1)
+    (last, cache), toks = jax.lax.scan(
+        step, (first, cache), jnp.arange(width - 1))
     proposals = jnp.concatenate([toks.T, last[:, None]], axis=1) \
         if width > 1 else last[:, None]
     return proposals, cache
@@ -161,11 +177,12 @@ def _draft_propose(params, chunk, cache, start, cfg, width):
 
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnames=("cache",))
-def _verify_chunk(params, chunk, cache, start, cfg):
-    """Target forward over [last_emitted, d_1..d_w] at slot `start`;
-    returns (argmax tokens [B, w+1], cache) — entry i is the target's
-    greedy continuation of chunk[:, :i+1]."""
-    logits, cache = forward_cached(params, chunk, cache, start, cfg)
+def _verify_rows(params, chunk, cache, starts, cfg):
+    """Target forward over [last_emitted, d_1..d_w] at per-row slot
+    `starts`; returns (argmax tokens [B, w+1], cache) — entry i is the
+    target's greedy continuation of chunk[:, :i+1]."""
+    logits, cache = forward_cached_rows(params, chunk, cache, starts,
+                                        cfg)
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
 
@@ -176,14 +193,21 @@ def speculative_generate(
     eos_id: Optional[int] = None,
     metrics: Optional[SpecMetrics] = None,
 ) -> Tuple[jax.Array, SpecStats]:
-    """prompt [1, P] int32 -> ([1, P + n] int32, stats), n <=
-    max_new_tokens (early eos stops short, like `generate_stream`).
+    """prompt [B, P] int32 -> (tokens, stats). B=1 returns
+    [1, P + n], n <= max_new_tokens (early eos stops short, like
+    `generate_stream`). B>1 returns the rectangular
+    [B, P + max_new_tokens] with finished rows eos-filled past their
+    terminal eos (ragged rows cannot share one array otherwise).
 
-    Greedy only: emitted tokens are IDENTICAL to
-    ``generate(target_params, prompt, target_cfg, greedy=True)`` up to
-    eos/max_new_tokens truncation (tested). Draft and target must share
-    the vocabulary. Pass a `SpecMetrics` to publish this call's
-    acceptance telemetry to the util.metrics Prometheus plane."""
+    Greedy only: each row's emitted tokens are IDENTICAL to
+    ``generate(target_params, prompt, target_cfg, greedy=True)`` on
+    that row up to eos/max_new_tokens truncation (tested). Rows advance
+    independently: a row that keeps rejecting does not slow a row that
+    keeps accepting — the host accept loop is vectorized with numpy and
+    finished rows ride along frozen (their writes land beyond their
+    frontier and are never attended). Draft and target must share the
+    vocabulary. Pass a `SpecMetrics` to publish this call's acceptance
+    telemetry to the util.metrics Prometheus plane."""
     if target_cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError(
             f"draft vocab {draft_cfg.vocab_size} != target vocab "
@@ -192,10 +216,6 @@ def speculative_generate(
         raise ValueError(f"window must be >= 1, got {window}")
     prompt = jnp.asarray(prompt, jnp.int32)
     B, P = prompt.shape
-    if B != 1:
-        raise ValueError(
-            "speculative_generate is the B=1 interactive-latency path; "
-            "use generate() for batched decode")
     # +window+1 margin: the last round may overshoot before trimming
     max_len = P + max_new_tokens + window + 1
     for name, c in (("target", target_cfg), ("draft", draft_cfg)):
@@ -203,53 +223,75 @@ def speculative_generate(
             raise ValueError(f"{name} max_seq_len {c.max_seq_len} < "
                              f"required {max_len}")
 
-    t_cache = init_cache(target_cfg, 1, max_len)
-    d_cache = init_cache(draft_cfg, 1, max_len)
+    t_cache = init_cache(target_cfg, B, max_len)
+    d_cache = init_cache(draft_cfg, B, max_len)
     t_logits, t_cache = _prefill_jit(target_params, prompt, t_cache,
                                      target_cfg)
     _, d_cache = _prefill_jit(draft_params, prompt, d_cache, draft_cfg)
 
     stats = SpecStats()
-    emitted: List[int] = [int(jnp.argmax(t_logits[0, -1]))]
-    # seq = prompt tokens + emitted. Invariants before each round:
-    #   target cache holds K/V for seq[:-1] (slots [0, n));
-    #   draft cache holds K/V for seq[:d_valid], d_valid in {n-1, n}.
-    n = P  # == len(seq) - 1
-    d_valid = P
+    first_toks = np.asarray(jnp.argmax(t_logits[:, -1], axis=-1))
+    emitted: List[List[int]] = [[int(first_toks[b])] for b in range(B)]
+    # seq_b = prompt tokens + emitted[b]. Invariants before each round:
+    #   target cache row b holds K/V for seq_b[:-1] (slots [0, n_b));
+    #   draft cache row b holds K/V for seq_b[:n_b - lag_b],
+    #   lag_b in {0, 1} (1 exactly when the last window fully accepted:
+    #   its final draft token never became a draft input).
+    n = np.full(B, P, np.int64)      # == len(seq_b) - 1
+    lag = np.zeros(B, np.int64)
 
-    while len(emitted) < max_new_tokens and \
-            (eos_id is None or emitted[-1] != eos_id):
-        seq_tail = emitted[-(n + 1 - d_valid):]  # seq[d_valid:]
-        d_chunk = jnp.asarray([seq_tail], jnp.int32)
-        proposals, d_cache = _draft_propose(
-            draft_params, d_chunk, d_cache, d_valid, draft_cfg, window)
-        last = jnp.asarray([emitted[-1]], jnp.int32)
-        chunk = jnp.concatenate([last[:, None], proposals], axis=1)
-        verdict, t_cache = _verify_chunk(
-            target_params, chunk, t_cache, n, target_cfg)
-        prop = np.asarray(proposals[0])
-        ver = np.asarray(verdict[0])          # ver[i] follows chunk[:, i]
-        accept = 0
-        while accept < window and prop[accept] == ver[accept]:
-            accept += 1
+    def _done(b: int) -> bool:
+        e = emitted[b]
+        return len(e) >= max_new_tokens or \
+            (eos_id is not None and e[-1] == eos_id)
+
+    while not all(_done(b) for b in range(B)):
+        last = np.array([e[-1] for e in emitted], np.int32)
+        pend = np.array([e[-2] if lag[b] else e[-1]
+                         for b, e in enumerate(emitted)], np.int32)
+        chunk2 = np.stack([pend, last], axis=1)
+        proposals, d_cache = _draft_propose_rows(
+            draft_params, jnp.asarray(chunk2), d_cache,
+            jnp.asarray(n - lag, jnp.int32), jnp.asarray(lag, jnp.int32),
+            draft_cfg, window)
+        chunk = jnp.concatenate([jnp.asarray(last)[:, None], proposals],
+                                axis=1)
+        verdict, t_cache = _verify_rows(
+            target_params, chunk, t_cache, jnp.asarray(n, jnp.int32),
+            target_cfg)
+        prop = np.asarray(proposals)          # [B, window]
+        ver = np.asarray(verdict)             # ver[i] follows chunk[:, i]
+        match = prop == ver[:, :window]
+        accept = np.cumprod(match, axis=1).sum(axis=1)  # [B], 0..window
         stats.rounds += 1
-        stats.proposed += window
-        stats.accepted += accept
-        # accepted drafts, then the target's correction (or bonus) token
-        emitted.extend(int(t) for t in prop[:accept])
-        emitted.append(int(ver[accept]))
-        n += accept + 1
-        # draft cache frontier: chunk + first window-1 proposals were
-        # written; of those, [.. d_accept] are now part of seq. A fully
-        # accepted window leaves d_window unwritten (never an input).
-        d_valid = n - 1 if accept == window else n
-        if eos_id is not None and eos_id in emitted:
-            del emitted[emitted.index(eos_id) + 1:]
-            break
+        for b in range(B):
+            if _done(b):
+                continue              # frozen row rode along; no emits
+            a = int(accept[b])
+            stats.proposed += window
+            stats.accepted += a
+            # accepted drafts, then the target's correction (or bonus)
+            emitted[b].extend(int(t) for t in prop[b, :a])
+            emitted[b].append(int(ver[b, a]))
+            n[b] += a + 1
+            # draft frontier: chunk + first window-1 proposals were
+            # written; a fully accepted window leaves d_window unwritten.
+            lag[b] = 1 if a == window else 0
+            if eos_id is not None and eos_id in emitted[b]:
+                del emitted[b][emitted[b].index(eos_id) + 1:]
+        for b in range(B):
+            del emitted[b][max_new_tokens:]
 
-    del emitted[max_new_tokens:]
-    out = jnp.concatenate(
-        [prompt, jnp.asarray(emitted, jnp.int32)[None, :]], axis=1)
     if metrics is not None:
         metrics.observe(stats)
+    if B == 1:
+        out = jnp.concatenate(
+            [prompt, jnp.asarray(emitted[0], jnp.int32)[None, :]],
+            axis=1)
+        return out, stats
+    fill = eos_id if eos_id is not None else 0
+    rect = np.full((B, max_new_tokens), fill, np.int32)
+    for b in range(B):
+        rect[b, :len(emitted[b])] = emitted[b]
+    out = jnp.concatenate([prompt, jnp.asarray(rect)], axis=1)
     return out, stats

@@ -38,7 +38,7 @@ DEFAULT_KEYS = (
     "ttft_s_p50", "ttft_s_p95", "tpot_s_p50", "tpot_s_p95",
     "slot_occupancy", "kv_used_fraction", "queue_depth",
     "requests_shed", "swap_in_bytes", "swap_out_bytes",
-    "tokens_out", "requests_inflight",
+    "tokens_out", "requests_inflight", "spec_acceptance_rate",
 )
 
 
@@ -235,7 +235,9 @@ def collect_serving_sample() -> Dict[str, float]:
         "ttft_s_p50": 0.0, "ttft_s_p95": 0.0,
         "tpot_s_p50": 0.0, "tpot_s_p95": 0.0,
         "slot_occupancy": 0.0, "kv_used_fraction": 0.0,
+        "spec_acceptance_rate": 0.0,
     }
+    sp_prop = sp_acc = 0.0
     for eng in engs:
         s = eng.stats()
         vals["queue_depth"] += s.get("queue_depth", 0.0)
@@ -251,9 +253,14 @@ def collect_serving_sample() -> Dict[str, float]:
             vals[k] = max(vals[k], s.get(k, 0.0))
         vals["slot_occupancy"] += s.get("slot_occupancy", 0.0)
         vals["kv_used_fraction"] += s.get("kv_used_fraction", 0.0)
+        sp_prop += s.get("spec_proposed", 0.0)
+        sp_acc += s.get("spec_accepted", 0.0)
     if engs:
         vals["slot_occupancy"] /= len(engs)
         vals["kv_used_fraction"] /= len(engs)
+    # Proposal-weighted across engines: a busy speculative replica's
+    # acceptance dominates an idle one's (0.0 when nothing speculates).
+    vals["spec_acceptance_rate"] = sp_acc / sp_prop if sp_prop else 0.0
     return vals
 
 

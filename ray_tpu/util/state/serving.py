@@ -140,6 +140,13 @@ def engine_state(engine) -> Dict[str, Any]:
         "tokens_out": engine.tokens_out,
         "uptime_s": max(0.0, engine._clock() - engine._start_t),
         "steps_total": engine.steps_total,
+        # Speculative plane (host counters; all-zero without a draft).
+        "spec_enabled": bool(engine.spec_enabled),
+        "spec_window": engine.spec_window if engine.spec_enabled else 0,
+        "spec_dispatches": engine.spec_dispatches,
+        "spec_acceptance_rate": (
+            engine.spec_accepted / engine.spec_proposed
+            if engine.spec_proposed else 0.0),
     }
     row.update(_fleet_of(engine))
     return row

@@ -677,11 +677,22 @@ def test_speculative_decode_exact_vs_greedy():
         out[:prompt.shape[1] + first + 1],
         ref[0, :prompt.shape[1] + first + 1])
 
+    # batched prompts (the historical B=1 restriction is lifted): each
+    # row matches its own solo greedy run
+    batch = jnp.array([[3, 1, 4, 1, 5], [2, 7, 1, 8, 2]], jnp.int32)
+    out, stats = speculative_generate(
+        target, target_cfg, draft, draft_cfg, batch,
+        max_new_tokens=12, window=4)
+    out = np.asarray(out)
+    for b in range(2):
+        ref_b = np.asarray(generate(target, batch[b:b + 1], target_cfg,
+                                    max_new_tokens=12, greedy=True))
+        np.testing.assert_array_equal(out[b:b + 1], ref_b,
+                                      err_msg=f"row={b}")
+    assert stats.rounds > 0
+
     import pytest
 
-    with pytest.raises(ValueError):
-        speculative_generate(target, target_cfg, draft, draft_cfg,
-                             jnp.zeros((2, 3), jnp.int32))
     with pytest.raises(ValueError):
         speculative_generate(target, target_cfg, draft, draft_cfg,
                              prompt, window=0)

@@ -323,3 +323,79 @@ def test_gate_metrics_history_bounded_allocations():
     assert total < 128 * 1024, (
         f"history ring holds {total} bytes live after 10k samples: "
         + "; ".join(str(s) for s in stats[:5]))
+
+
+def test_gate_spec_off_zero_allocations_in_spec_path():
+    """Gate (r12, speculative): an engine built WITHOUT draft_params
+    pays nothing for the spec plane — a decode churn allocates ZERO
+    bytes inside speculative.py (SpecStats/SpecMetrics never touched)
+    and every dispatch takes the plain `_dispatch_decode` branch
+    (spec_dispatches stays 0). Counting allocations, not timing, so it
+    holds on any box: the gate fails if the spec seam ever builds
+    per-round objects before checking `spec_enabled`."""
+    import tracemalloc
+
+    jax = pytest.importorskip("jax")
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models import speculative
+    from ray_tpu.models.engine import DecodeEngine
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    eng = DecodeEngine(params, cfg, batch_slots=2, max_len=32)
+    eng.submit([5, 6, 7], 4)
+    eng.run()                        # compile outside the window
+
+    tracemalloc.start()
+    try:
+        for i in range(3):
+            eng.submit([5, 6, 7 + i], 4)
+        eng.run()
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    stats = snap.filter_traces(
+        [tracemalloc.Filter(True, speculative.__file__)]).statistics(
+            "lineno")
+    total = sum(s.size for s in stats)
+    assert total == 0, (
+        f"spec-off engine allocated {total} bytes in speculative.py: "
+        + "; ".join(str(s) for s in stats[:5]))
+    s = eng.stats()
+    assert s["spec_dispatches"] == 0.0
+    assert s["host_syncs_per_token"] <= 1.0, (
+        "spec-off engine regressed host syncs per token")
+
+
+def test_gate_spec_host_syncs_quartered():
+    """Gate (r12, speculative): with a perfect draft at window=4 the
+    engine advances (window+1) verified tokens per dispatch, so its
+    blocking device->host pulls per token must be <= 1/4 of the H=1
+    non-spec baseline (budget=20 is a multiple of window+1, so no
+    round truncates). Counting syncs, not timing — holds on any box."""
+    jax = pytest.importorskip("jax")
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.engine import DecodeEngine
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    prompts = [[5, 6, 7], [9, 8, 7, 6]]
+
+    base = DecodeEngine(params, cfg, batch_slots=2, max_len=32,
+                        decode_horizon=1)
+    for p in prompts:
+        base.submit(p, 20)
+    base.run()
+    base_spt = base.stats()["host_syncs_per_token"]
+
+    spec = DecodeEngine(params, cfg, batch_slots=2, max_len=32,
+                        draft_params=params, draft_cfg=cfg,
+                        spec_window=4)
+    for p in prompts:
+        spec.submit(p, 20)
+    spec.run()
+    s = spec.stats()
+    assert s["spec_acceptance_rate"] == 1.0, s["spec_acceptance_rate"]
+    assert s["host_syncs_per_token"] <= base_spt / 4.0, (
+        f"spec engine pays {s['host_syncs_per_token']:.3f} syncs/token "
+        f"vs H=1 baseline {base_spt:.3f}; want <= baseline/4")

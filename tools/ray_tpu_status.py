@@ -111,12 +111,25 @@ def format_status(data: Dict[str, Any], top: int = 5) -> str:
 
     lines.append("")
     lines.append("======== Replicas ========")
+    # Acceptance trend is fleet-wide (the history ring samples one
+    # proposal-weighted rate across engines); each spec replica's line
+    # shows its own instantaneous rate with the shared arrow.
+    from ray_tpu.util.metrics_history import trend_of_points
+    hist_samples = data.get("history", {}).get("samples", [])
+    spec_arrow = ARROWS[trend_of_points(
+        [s["spec_acceptance_rate"] for s in hist_samples
+         if "spec_acceptance_rate" in s])]
     for e in engines:
         pool = pools.get(e["engine_id"])
         kv = (f" kv {_bar(pool.get('occupancy', 0.0), 10)} "
               f"{pool.get('blocks_in_use', 0)}/"
               f"{pool.get('blocks_total', 0)} blk"
               if pool else "")
+        spec = ""
+        if e.get("spec_enabled"):
+            spec = (f" spec w{e.get('spec_window', 0)} "
+                    f"acc {e.get('spec_acceptance_rate', 0.0) * 100:.0f}%"
+                    f" {spec_arrow}")
         flags = "".join(
             [" DRAINING" if e["draining"] else "",
              f" tp={e['tp_degree']}" if e["tp_degree"] > 1 else "",
@@ -127,7 +140,7 @@ def format_status(data: Dict[str, Any], top: int = 5) -> str:
             f"{e['live_slots']}/{e['batch_slots']} "
             f"queue {e['queue_depth']:>3}{kv} "
             f"up {e['uptime_s']:.1f}s steps {e['steps_total']}"
-            f"{flags}")
+            f"{spec}{flags}")
     if not engines:
         lines.append("no engines registered")
 

@@ -84,6 +84,44 @@ def request_breakdowns(
     return out
 
 
+def spec_summary(
+        events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Aggregate the ENGINE-lane speculation spans (spec_draft /
+    spec_verify / spec_draft_prefill) into one summary dict, or None
+    for a trace with no speculative activity. These spans serve whole
+    batches, so they are summarized separately rather than attributed
+    to requests via PHASE_OF (which would break the per-request
+    contiguity sum)."""
+    disp = ver = seed = 0
+    disp_s = ver_s = seed_s = 0.0
+    rounds = proposed = accepted = 0
+    for ev in events:
+        name = ev.get("name", "")
+        if name == "spec_draft":
+            disp += 1
+            disp_s += ev.get("dur", 0.0) / 1e6
+        elif name == "spec_verify":
+            ver += 1
+            ver_s += ev.get("dur", 0.0) / 1e6
+            a = ev.get("args") or {}
+            rounds += a.get("rounds", 0)
+            proposed += a.get("proposed", 0)
+            accepted += a.get("accepted", 0)
+        elif name == "spec_draft_prefill":
+            seed += 1
+            seed_s += ev.get("dur", 0.0) / 1e6
+    if not (disp or ver or seed):
+        return None
+    return {
+        "spec_dispatches": disp, "spec_dispatch_s": disp_s,
+        "spec_drains": ver, "spec_drain_s": ver_s,
+        "spec_prefills": seed, "spec_prefill_s": seed_s,
+        "spec_rounds": rounds, "spec_proposed": proposed,
+        "spec_accepted": accepted,
+        "spec_acceptance_rate": accepted / proposed if proposed else 0.0,
+    }
+
+
 def totals(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Aggregate line over breakdown rows — the ONE place the summary
     numbers are computed, shared by the text report's footer and the
@@ -98,7 +136,8 @@ def totals(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
-def format_report(rows: List[Dict[str, Any]], top: int = 5) -> str:
+def format_report(rows: List[Dict[str, Any]], top: int = 5,
+                  spec: Optional[Dict[str, Any]] = None) -> str:
     lines = [f"{'request':>10} {'pid':>8} {'e2e_ms':>9} "
              f"{'queue%':>7} {'prefill%':>9} {'decode%':>8} "
              f"{'swap%':>6} {'tokens':>7}"]
@@ -128,6 +167,14 @@ def format_report(rows: List[Dict[str, Any]], top: int = 5) -> str:
                 f"{r[f'{dom}_frac'] * 100:.0f}% in {dom}")
     else:
         lines.append("-- no request spans in trace")
+    if spec is not None:
+        lines.append(
+            f"-- speculation: {spec['spec_dispatches']} dispatches "
+            f"({spec['spec_dispatch_s'] * 1e3:.1f} ms), "
+            f"{spec['spec_rounds']} rounds, "
+            f"{spec['spec_accepted']}/{spec['spec_proposed']} accepted "
+            f"({spec['spec_acceptance_rate'] * 100:.1f}%), "
+            f"{spec['spec_prefills']} draft prefills")
     return "\n".join(lines)
 
 
@@ -140,12 +187,16 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="emit machine-readable JSON (the same "
                          "breakdown rows + totals) instead of text")
     args = ap.parse_args(argv)
-    rows = request_breakdowns(load_trace(args.trace))
+    events = load_trace(args.trace)
+    rows = request_breakdowns(events)
+    spec = spec_summary(events)
     if args.json:
-        print(json.dumps({"requests": rows, "totals": totals(rows)},
-                         indent=1))
+        payload = {"requests": rows, "totals": totals(rows)}
+        if spec is not None:
+            payload["speculation"] = spec
+        print(json.dumps(payload, indent=1))
     else:
-        print(format_report(rows, top=args.top))
+        print(format_report(rows, top=args.top, spec=spec))
 
 
 if __name__ == "__main__":
