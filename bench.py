@@ -640,7 +640,13 @@ def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
     shed-rate, and the prefill/reuse token counters as supporting
     evidence. Requests arrive a few per step (not all upfront) so the
     router sees live queue/occupancy/trie state, like a server
-    would."""
+    would.
+
+    The closing CHAOS arm reruns the churn at the top replica count
+    with a scripted `FaultInjector` killing one replica mid-churn:
+    recovery time, throughput dip vs the fault-free control, and the
+    determinism checks (token-identical results, zero tokens lost)
+    land under the ``chaos`` key."""
     import jax
     import numpy as np
 
@@ -773,6 +779,91 @@ def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
                       / poll_period_s
                       if probed["probe_samples"] else 0.0)
 
+    # Chaos arm: kill 1-of-N replicas mid-churn (scripted
+    # FaultInjector) against a fault-free control of the IDENTICAL
+    # fleet shape and arrival sequence. Reported numbers: recovery
+    # time (kill detected -> every failed-over request finished),
+    # throughput dip vs the control, and the zero-loss/token-identity
+    # checks — all real on any backend; absolute tokens/s is not.
+    from ray_tpu.models import FaultInjector
+
+    n_chaos = replica_counts[-1]
+
+    def run_chaos(inj, fleet_id):
+        def factory(name):
+            return DecodeEngine(params, cfg, batch_slots=batch_slots,
+                                max_len=max_len, scheduler="priority",
+                                prefix_cache=True,
+                                prefix_block=prefix_block,
+                                engine_id=name)
+        fleet = LLMFleet(factory, initial_replicas=n_chaos,
+                         router="pow2_affinity", fleet_id=fleet_id,
+                         fault_injector=inj)
+        kill_t = recover_t = None
+        n_failed_over = 0
+
+        def watch():
+            nonlocal kill_t, recover_t, n_failed_over
+            if kill_t is None and fleet.replicas_failed:
+                kill_t = time.perf_counter()
+                # Right after the failing step the retry queue holds
+                # every reconstructed request (drain happens at the
+                # NEXT step's start).
+                n_failed_over = len(fleet._retry)
+            elif kill_t is not None and recover_t is None and \
+                    fleet.requests_recovered >= n_failed_over:
+                recover_t = time.perf_counter()
+
+        t0 = time.perf_counter()
+        for i, (prompt, priority, deadline) in enumerate(arrivals):
+            fleet.submit(prompt, new_tokens, priority=priority,
+                         deadline_s=deadline)
+            if i % 2 == 1:
+                fleet.step()
+                watch()
+        while fleet.pending():
+            fleet.step()
+            watch()
+        results = fleet.run()
+        wall = time.perf_counter() - t0
+        s = fleet.stats()
+        served = n_requests - int(s["requests_shed"])
+        return {
+            "results": results, "wall_s": wall, "stats": s,
+            "tokens_per_sec": served * new_tokens / wall
+            if wall else 0.0,
+            "recovery_s": (recover_t - kill_t)
+            if kill_t is not None and recover_t is not None else None,
+        }
+
+    chaos_id = f"bench-chaos-{n_chaos}"
+    control = run_chaos(None, f"bench-chaos-ctl-{n_chaos}")
+    inj = FaultInjector(schedule={f"{chaos_id}-r0": [(2, "kill")]})
+    chaos = run_chaos(inj, chaos_id)
+    cs = chaos["stats"]
+    chaos_block = {
+        "n_replicas": n_chaos,
+        "killed_replica": f"{chaos_id}-r0",
+        "kill_fired": bool(inj.fired),
+        "identical_to_fault_free": (
+            chaos["results"] == control["results"]),
+        "tokens_lost_to_failure": int(cs["tokens_lost_to_failure"]),
+        "requests_recovered": int(cs["requests_recovered"]),
+        "retries": int(cs["retries"]),
+        "replicas_failed": int(cs["replicas_failed"]),
+        "replicas_after": int(cs["replicas"]),
+        "recovery_s": (round(chaos["recovery_s"], 4)
+                       if chaos["recovery_s"] is not None else None),
+        "wall_s": round(chaos["wall_s"], 3),
+        "wall_fault_free_s": round(control["wall_s"], 3),
+        "tokens_per_sec": round(chaos["tokens_per_sec"], 1),
+        "tokens_per_sec_fault_free": round(
+            control["tokens_per_sec"], 1),
+        "throughput_dip_frac": round(
+            1.0 - chaos["tokens_per_sec"] / control["tokens_per_sec"],
+            4) if control["tokens_per_sec"] else 0.0,
+    }
+
     return {
         "n_groups": n_groups,
         "prefix_len": prefix_len,
@@ -791,6 +882,7 @@ def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
         "trace_overhead_frac": round(trace_overhead, 4),
         "trace_artifact": "BENCH_fleet.trace.json",
         "state_snapshot_overhead_frac": round(state_overhead, 4),
+        "chaos": chaos_block,
     }
 
 

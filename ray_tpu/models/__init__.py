@@ -49,12 +49,17 @@ from ray_tpu.models.t5 import (
 from ray_tpu.models.engine import DecodeEngine
 from ray_tpu.models.engine_metrics import EngineMetrics
 from ray_tpu.models.engine_trace import EngineTracer, NullEngineTracer
+from ray_tpu.models.fault_injection import FaultInjector, InjectedFault
 from ray_tpu.models.fleet import (
     EngineStatsAutoscaler,
     FleetAutoscalingConfig,
+    FleetError,
+    FleetHealthConfig,
     FleetRouter,
     LLMFleet,
     PowerOfTwoAffinityRouter,
+    ReplicaUnavailable,
+    RetriesExhausted,
     RoundRobinRouter,
 )
 from ray_tpu.models.prefix_cache import PrefixCacheIndex
@@ -65,6 +70,7 @@ from ray_tpu.models.scheduler import (
     PrefixAffinityPolicy,
     PriorityPolicy,
     SchedulerPolicy,
+    SubmitTimeout,
 )
 
 __all__ = [
@@ -107,14 +113,21 @@ __all__ = [
     "EngineTracer",
     "NullEngineTracer",
     "EngineStatsAutoscaler",
+    "FaultInjector",
     "FIFOPolicy",
     "FleetAutoscalingConfig",
+    "FleetError",
+    "FleetHealthConfig",
     "FleetRouter",
+    "InjectedFault",
     "LLMFleet",
     "PowerOfTwoAffinityRouter",
     "PrefixAffinityPolicy",
     "PrefixCacheIndex",
     "PriorityPolicy",
+    "ReplicaUnavailable",
+    "RetriesExhausted",
     "RoundRobinRouter",
     "SchedulerPolicy",
+    "SubmitTimeout",
 ]

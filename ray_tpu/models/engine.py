@@ -94,7 +94,8 @@ from ray_tpu.models.llama import (LlamaConfig, _rmsnorm,
 from ray_tpu.models.prefix_cache import PrefixCacheIndex, block_bytes
 from ray_tpu.ops.attention import paged_attention
 from ray_tpu.models.scheduler import (EngineDraining, EngineOverloaded,
-                                      SchedulerPolicy, make_policy)
+                                      FIFOPolicy, SchedulerPolicy,
+                                      SubmitTimeout, make_policy)
 from ray_tpu.parallel.mesh import create_mesh
 from ray_tpu.parallel.sharding import (DEFAULT_RULES, named_sharding,
                                        prune_rules_for_mesh,
@@ -1157,6 +1158,7 @@ class DecodeEngine:
                  scheduler: Union[str, SchedulerPolicy] = "fifo",
                  max_queue: Optional[int] = None,
                  on_full: str = "reject",
+                 block_timeout_s: Optional[float] = None,
                  max_prefills_per_step: Optional[int] = None,
                  decode_horizon: int = 8,
                  pipeline_depth: int = 2,
@@ -1184,6 +1186,8 @@ class DecodeEngine:
                              f"got {on_full!r}")
         if max_queue is not None and max_queue < 1:
             raise ValueError("max_queue must be >= 1")
+        if block_timeout_s is not None and block_timeout_s <= 0:
+            raise ValueError("block_timeout_s must be > 0")
         if max_prefills_per_step is not None and max_prefills_per_step < 1:
             raise ValueError("max_prefills_per_step must be >= 1")
         if decode_horizon < 1:
@@ -1227,6 +1231,7 @@ class DecodeEngine:
         self.scheduler = make_policy(scheduler)
         self.max_queue = max_queue
         self.on_full = on_full
+        self.block_timeout_s = block_timeout_s
         self.max_prefills_per_step = max_prefills_per_step
         self.decode_horizon = decode_horizon
         self.pipeline_depth = pipeline_depth
@@ -1372,6 +1377,8 @@ class DecodeEngine:
         self.shed_ids: set = set()      # finished as past-deadline sheds
         self.requests_shed = 0          # plain int (enable_metrics=False)
         self.draining = False           # begin_drain(): no new submits
+        self.halted = False             # halt(): state discarded (fleet
+        #                                 failover abandoned this engine)
         # Dispatch/transfer accounting (plain ints so the benchmark's
         # enable_metrics=False engines still report them):
         self.decode_dispatches = 0     # fused decode program launches
@@ -1578,7 +1585,8 @@ class DecodeEngine:
                priority: int = 0,
                rng: Optional[jax.Array] = None,
                deadline_s: Optional[float] = None,
-               greedy: Optional[bool] = None) -> int:
+               greedy: Optional[bool] = None,
+               resume_tokens: Optional[List[int]] = None) -> int:
         """Enqueue a request; returns its id (see `results`).
 
         ``priority`` (lower = sooner) orders admission under the
@@ -1609,7 +1617,24 @@ class DecodeEngine:
         prefill already paid). ``deadline_s <= 0`` sheds immediately
         (reject-before-prefill). After ``begin_drain()`` submit raises
         EngineDraining — a draining replica finishes what it holds but
-        takes nothing new."""
+        takes nothing new.
+
+        ``resume_tokens`` is the fleet-failover resume path: tokens
+        this request ALREADY emitted on a replica that died. Admission
+        replays prompt + resume_tokens as the prefill (recompute — the
+        same discipline as paged preempt="recompute"), starts the
+        budget and sampling-stream index at len(resume_tokens), and
+        the request's final ``tokens`` list is resume_tokens plus
+        everything decoded here — bit-identical to a run that never
+        failed, because `step_rng_key(rng, i)` depends only on the
+        request key and the token index, never on the engine, row, or
+        step that samples it. Resumed requests are exempt from
+        deadline shedding (they were admitted once already) and their
+        replay is NOT registered in the prefix trie (emitted tokens
+        are not a shareable prompt). Pass the SAME ``rng`` as the
+        original submission — sampled identity is the caller's key
+        discipline (the fleet pins one key per request for exactly
+        this reason)."""
         if self.draining:
             raise EngineDraining(
                 "engine is draining (begin_drain was called): it will "
@@ -1634,6 +1659,19 @@ class DecodeEngine:
                 f"{self.max_len}: the verify chunk writes up to "
                 "spec_window slots past the last emitted token, so "
                 "speculative engines need that margin")
+        resume = None
+        if resume_tokens:
+            resume = [int(t) for t in resume_tokens]
+            if len(resume) >= max_new_tokens:
+                raise ValueError(
+                    f"resume_tokens ({len(resume)}) must be shorter "
+                    f"than max_new_tokens ({max_new_tokens}): a "
+                    "completed request has nothing to resume")
+            if deadline_s is not None:
+                raise ValueError(
+                    "resume_tokens and deadline_s are mutually "
+                    "exclusive: a resumed request was admitted once "
+                    "and is exempt from deadline shedding")
         if self.paged:
             # A request must fit the pool ALONE in the worst case
             # (every other row preempted, every cold prefix block
@@ -1676,13 +1714,35 @@ class DecodeEngine:
                 raise EngineOverloaded(
                     f"queue full ({self.max_queue} queued requests); "
                     f"shed load or use on_full='block'")
+            t_block = self._clock()
             while len(self.scheduler) >= self.max_queue:
+                if self.block_timeout_s is not None and \
+                        self._clock() - t_block >= self.block_timeout_s:
+                    self.metrics.on_reject()
+                    raise SubmitTimeout(
+                        f"queue still full ({self.max_queue} queued "
+                        f"requests) after blocking "
+                        f"{self.block_timeout_s}s: the engine made no "
+                        "room — wedged, or hopelessly oversubscribed")
                 self.step()   # admissions + finishes drain the queue
         req = _Request(self._next_id, prompt, max_new_tokens,
                        priority=priority, seq=self._next_id,
                        rng=None if rng is None else _key_data(rng),
                        deadline=deadline)
         req.greedy = greedy
+        if resume is not None:
+            # Fleet failover resume: the request continues, not
+            # restarts — admission replays prompt + these tokens and
+            # the sampling stream picks up at token len(resume).
+            req.tokens = resume
+            req.resume = True
+            if self.paged:
+                # Ride the existing recompute swap-in path: a k=None
+                # ledger entry makes `_admit_rows_paged` replay
+                # prompt + tokens exactly like a preempted row.
+                self._swapped[req.req_id] = _SwapState(
+                    None, None, 0, 0, len(resume),
+                    max_new_tokens - len(resume), None)
         self._next_id += 1
         self.scheduler.push(req)
         self.results[req.req_id] = req
@@ -2321,6 +2381,49 @@ class DecodeEngine:
         self.begin_drain()
         return self.run()
 
+    def halt(self) -> None:
+        """Abandon this engine's work WITHOUT completing it — the
+        fleet's failure path (the opposite of drain's flush-before-
+        removal). Discards the async pipeline ring (in-flight device
+        steps are never replayed), releases every live row's paged KV
+        blocks (refcount hygiene: trie-shared blocks survive through
+        the trie's own references, private blocks free), drops the
+        swap ledger and the queue, and refuses new submits. Host-side
+        request bookkeeping (`results`: prompt, emitted tokens,
+        priority) is deliberately KEPT — it is what the fleet
+        reconstructs failover resubmissions from. Idempotent; never
+        raises (the engine may be arbitrarily broken when called)."""
+        if self.halted:
+            return
+        self.halted = True
+        self.draining = True
+        if self.trace.enabled:
+            self.trace.instant(
+                "halt", lane="events",
+                args={"queued": len(self.scheduler),
+                      "live_rows": sum(r is not None
+                                       for r in self.row_req),
+                      "inflight_steps": len(self._ring)})
+        self._ring.clear()
+        self._row_prefill.clear()
+        for row in range(self.B):
+            if self.paged:
+                try:
+                    self._release_row_blocks(row)
+                except Exception:
+                    pass
+            self.row_req[row] = None
+            self.row_len[row] = 0
+            self.row_budget[row] = 0
+            self._tok_idx[row] = 0
+        if self.paged:
+            self._swapped.clear()
+        # Drop the queue wholesale (a fresh empty policy, not N pops:
+        # a deferring policy could legally return None forever once
+        # its probe's world is gone). The queued _Request objects stay
+        # reachable through `results` for failover reconstruction.
+        self.scheduler = FIFOPolicy()
+
     def pending_prefill_tokens(self) -> int:
         """Prompt tokens this engine has accepted but not yet
         prefilled: every queued request's full prompt plus the
@@ -2456,6 +2559,29 @@ class DecodeEngine:
             if self.trace.enabled:
                 self.trace.close("queue_wait", req.req_id)
                 self.trace.instant("admit", req.req_id, {"row": row})
+            if req.resume and req.tokens:
+                # Fleet-failover resume (dense engine): replay
+                # prompt + already-emitted tokens as the prefill —
+                # mathematically the K/V the dead replica held — and
+                # continue the stream at the saved token index. No
+                # trie traffic: emitted tokens are not a shareable
+                # prompt, and this replica may never have seen the
+                # prompt's blocks.
+                replay = list(req.prompt) + list(req.tokens)
+                self.row_req[row] = req
+                self.row_len[row] = 0
+                self.row_budget[row] = (req.max_new_tokens
+                                        - len(req.tokens))
+                self._tok_idx[row] = len(req.tokens)
+                self._row_keys[row] = self._req_key(req)
+                self._row_greedy[row] = (self.greedy
+                                         if req.greedy is None
+                                         else bool(req.greedy))
+                self._row_prefill[row] = _PrefillState(req, 0, [],
+                                                       prompt=replay)
+                if self.spec_enabled:
+                    draft_seeds.append((row, replay))
+                continue
             start = 0
             nodes: list = []
             if self._prefix is not None:

@@ -8,7 +8,7 @@ won once the kernel is fast: in the ROUTER (which replica gets the
 request) and the SCALING POLICY (when replicas appear and disappear) —
 so those are the two first-class objects here.
 
-Three planes, one `submit()`-shaped facade (`LLMFleet`):
+Four planes, one `submit()`-shaped facade (`LLMFleet`):
 
 - ROUTING. Each request is placed by scoring replicas on their live
   `engine.stats()`-plane signals — queue depth, slot occupancy,
@@ -41,26 +41,58 @@ Three planes, one `submit()`-shaped facade (`LLMFleet`):
   Shed requests surface through the same finished/pop_result path with
   `shed_ids` membership, so one polling loop serves both outcomes.
 
-Every replica keeps the engine's token-identity invariant: routing,
-scale-up, drain, and shedding change WHICH engine runs a request and
-WHEN it is admitted — never what it computes. Outputs stay
-token-identical to solo `generate` (greedy, and sampled with a pinned
-per-request rng), which `tests/test_fleet.py` asserts as a matrix.
+- FAULT TOLERANCE. Every `engine.step()` runs under the fleet's
+  supervision: a per-replica HEALTH STATE MACHINE (RUNNING -> SUSPECT
+  -> UNHEALTHY -> RETIRED, `FleetHealthConfig`) driven by step
+  exceptions, a step-deadline watchdog on the injected clock,
+  consecutive-slow-step probes, and a no-progress (silent) detector —
+  the blueprint's raylet-heartbeat / NodeManager failure-detection
+  role, done in-process. The router only offers RUNNING replicas
+  whose CIRCUIT BREAKER is closed (a replica that keeps flapping into
+  SUSPECT stops receiving traffic for a cooldown before it fails
+  again). When a replica goes UNHEALTHY the fleet performs
+  DETERMINISTIC FAILOVER: every in-flight and queued request on it is
+  reconstructed from host-side bookkeeping (prompt + tokens already
+  emitted + the per-request rng key the fleet pinned at submit) and
+  resubmitted to a healthy replica with resume semantics — the final
+  token stream is bit-identical to a fault-free run, greedy AND
+  sampled, because sampling streams depend only on (key, token index)
+  and the fleet derives each request's key from its FLEET id, never
+  from placement. Retries get exponential backoff with deterministic
+  jitter from the request seed; a request that runs out of
+  `max_retries` (or of replicas) surfaces as a typed
+  `RetriesExhausted` / `ReplicaUnavailable` through `pop_result()` /
+  `run()` instead of hanging. `tokens_lost_to_failure` stays 0 by
+  construction and is counted, not assumed.
 
-Fleet health exports as `llm_fleet_*` gauges through the ordinary
+Every replica keeps the engine's token-identity invariant: routing,
+scale-up, drain, shedding, and FAILOVER change WHICH engine runs a
+request and WHEN it is admitted — never what it computes. Outputs stay
+token-identical to solo `generate` (greedy, and sampled with a pinned
+per-request rng), which `tests/test_fleet.py` and
+`tests/test_fleet_faults.py` assert as a matrix.
+
+Fleet health exports as `llm_fleet_*` gauges plus the
+`llm_fleet_replica_failures_total` / `llm_fleet_requests_recovered_total`
+/ `llm_fleet_retries_total` counters through the ordinary
 `ray_tpu.util.metrics` plane (tagged by fleet id, same pattern as the
 engine's `llm_engine_*` series) and as a flat `stats()` snapshot.
 """
 
 from __future__ import annotations
 
+import heapq
 import json
 import random
 import time
 from typing import Callable, Dict, List, Optional, Tuple, Union
 
+import numpy as np
+
+from ray_tpu.models.engine import _key_data
 from ray_tpu.models.engine_trace import resolve_tracer
-from ray_tpu.util.metrics import Gauge
+from ray_tpu.models.scheduler import EngineDraining, EngineOverloaded
+from ray_tpu.util.metrics import Counter, Gauge
 
 __all__ = [
     "LLMFleet",
@@ -68,10 +100,45 @@ __all__ = [
     "RoundRobinRouter",
     "PowerOfTwoAffinityRouter",
     "FleetAutoscalingConfig",
+    "FleetHealthConfig",
     "EngineStatsAutoscaler",
+    "FleetError",
+    "ReplicaUnavailable",
+    "RetriesExhausted",
     "make_router",
     "replica_score",
 ]
+
+
+# ---------------------------------------------------------------------------
+# Typed errors
+# ---------------------------------------------------------------------------
+
+class FleetError(RuntimeError):
+    """Base class for typed fleet serving failures (replaces the bare
+    RuntimeErrors the fleet used to raise)."""
+
+
+class ReplicaUnavailable(FleetError):
+    """No replica can take the work: none RUNNING at submit, or every
+    survivor retired with replacement disabled before a recovery could
+    land."""
+
+
+class RetriesExhausted(FleetError):
+    """A request's replica died and its retry budget ran out.
+
+    When raised by `run()` it aggregates: ``failed`` maps each lost
+    fleet request id to its underlying error, ``partial`` carries the
+    results of every request that DID finish (so a caller can keep
+    them instead of re-running the world)."""
+
+    def __init__(self, msg: str, *,
+                 failed: Optional[Dict[int, Exception]] = None,
+                 partial: Optional[Dict[int, List[int]]] = None):
+        super().__init__(msg)
+        self.failed = failed or {}
+        self.partial = partial or {}
 
 
 # ---------------------------------------------------------------------------
@@ -80,15 +147,22 @@ __all__ = [
 
 RUNNING = "RUNNING"
 DRAINING = "DRAINING"
+SUSPECT = "SUSPECT"       # probation: router skips it, step() watches it
+UNHEALTHY = "UNHEALTHY"   # condemned: failover in progress
+RETIRED = "RETIRED"       # out of the pool (failed replicas only;
+#                           drained replicas are simply removed)
 
 
 class _Replica:
     """One DecodeEngine plus its fleet bookkeeping: the replica-local
     request-id -> fleet request-id map (each engine numbers its own
-    requests from 0) and the RUNNING/DRAINING state the router and
-    scaler act on."""
+    requests from 0), the health/lifecycle state the router and scaler
+    act on, and the health-probe streaks the state machine runs on."""
 
-    __slots__ = ("name", "engine", "state", "rid_to_fid", "routed")
+    __slots__ = ("name", "engine", "state", "rid_to_fid", "routed",
+                 "slow_streak", "silent_streak", "good_streak",
+                 "failures", "timeouts", "suspect_events",
+                 "breaker_open_until", "breaker_trips")
 
     def __init__(self, name: str, engine):
         self.name = name
@@ -96,6 +170,41 @@ class _Replica:
         self.state = RUNNING
         self.rid_to_fid: Dict[int, int] = {}
         self.routed = 0          # requests this replica has been given
+        # Health-probe streaks (reset on a good step):
+        self.slow_streak = 0     # consecutive steps over slow_step_s
+        self.silent_streak = 0   # consecutive no-progress steps
+        self.good_streak = 0     # consecutive clean steps (recovery)
+        self.failures = 0        # step() exceptions seen
+        self.timeouts = 0        # watchdog (step_deadline_s) breaches
+        self.suspect_events: List[float] = []   # SUSPECT entry times
+        self.breaker_open_until = 0.0           # clock time; 0 = closed
+        self.breaker_trips = 0
+
+
+class _FleetReq:
+    """Host-side bookkeeping for one fleet request — everything
+    deterministic failover needs to reconstruct it on another replica:
+    the normalized prompt, the budget/priority/greedy knobs, and the
+    PINNED sampling key (fleet-derived from the fleet id/seed and the
+    FLEET request id, so the stream survives any re-placement)."""
+
+    __slots__ = ("fid", "prompt", "max_new_tokens", "priority",
+                 "greedy", "rng", "attempts", "emitted", "tokens",
+                 "recovering")
+
+    def __init__(self, fid: int, prompt: List[int],
+                 max_new_tokens: int, priority: int, greedy,
+                 rng: np.ndarray):
+        self.fid = fid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.priority = priority
+        self.greedy = greedy
+        self.rng = rng
+        self.attempts = 1        # submissions so far (retries = n-1)
+        self.emitted = 0         # tokens already streamed to the caller
+        self.tokens: List[int] = []   # salvage buffer while recovering
+        self.recovering = False  # in the retry queue right now
 
 
 # ---------------------------------------------------------------------------
@@ -139,8 +248,8 @@ def replica_score(replica: _Replica, prompt: List[int],
 
 class FleetRouter:
     """Chooses the replica a request is submitted to. Only RUNNING
-    replicas are offered (the fleet filters DRAINING out before
-    calling)."""
+    replicas with a closed circuit breaker are offered (the fleet
+    filters the rest out before calling)."""
 
     name = "base"
 
@@ -301,6 +410,110 @@ class FleetAutoscalingConfig:
         self.custom_metric_source = custom_metric_source
 
 
+class FleetHealthConfig:
+    """Fault-tolerance knobs for the fleet's per-replica health state
+    machine, retry policy, and circuit breaker.
+
+    Health probes (all evaluated by the fleet around each
+    `engine.step()`, on the fleet's injected clock):
+
+    - ``step_deadline_s`` — the WATCHDOG: a step that takes at least
+      this long is a timeout event; ``unhealthy_after_timeouts`` of
+      them (cumulative) condemn the replica. None disables.
+    - ``slow_step_s`` — softer probe: ``suspect_after_slow``
+      CONSECUTIVE steps at least this slow put the replica on
+      SUSPECT probation (routed around, still stepped). None disables.
+    - ``suspect_after_silent`` / ``unhealthy_after_silent`` —
+      no-progress detection: a step that returns without advancing the
+      engine at all (its step counter frozen while work is pending —
+      the failure mode of a wedged or hijacked step) is a silent
+      event; consecutive silents escalate SUSPECT then UNHEALTHY.
+    - ``max_step_failures`` — a step() EXCEPTION condemns the replica
+      once this many have been seen (default 1: fail fast; raise it to
+      tolerate transient errors via SUSPECT first).
+    - ``recover_after`` — clean consecutive steps that promote a
+      SUSPECT replica back to RUNNING.
+
+    Retry/backoff (per request, on replica failure): the first
+    failover resubmits immediately; retry n >= 2 waits
+    ``backoff_base_s * backoff_factor**(n-2)`` capped at
+    ``backoff_max_s``, stretched by up to 50% deterministic jitter
+    derived from the REQUEST's rng key (reproducible chaos runs).
+    After ``max_retries`` retries the request surfaces as
+    `RetriesExhausted`.
+
+    Circuit breaker (per replica): ``breaker_trips`` entries into
+    SUSPECT within ``breaker_window_s`` open the breaker for
+    ``breaker_cooldown_s`` — the router stops offering the replica
+    even after it recovers to RUNNING, until the cooldown lapses
+    (half-open). Failover RESUBMISSIONS ignore the breaker:
+    a recovery must land somewhere, and the breaker's job is load
+    placement, not correctness.
+
+    ``replace_failed`` — a condemned replica is REPLACED (a fresh
+    replica from the factory joins as it retires), not merely counted
+    out, so capacity survives the failure; the autoscaler never sees
+    the dead replica in its replica count."""
+
+    def __init__(self, *, step_deadline_s: Optional[float] = None,
+                 slow_step_s: Optional[float] = None,
+                 suspect_after_slow: int = 3,
+                 suspect_after_silent: int = 2,
+                 unhealthy_after_silent: int = 4,
+                 unhealthy_after_timeouts: int = 2,
+                 max_step_failures: int = 1,
+                 recover_after: int = 2,
+                 max_retries: int = 3,
+                 backoff_base_s: float = 0.02,
+                 backoff_factor: float = 2.0,
+                 backoff_max_s: float = 1.0,
+                 breaker_trips: int = 3,
+                 breaker_window_s: float = 30.0,
+                 breaker_cooldown_s: float = 5.0,
+                 replace_failed: bool = True):
+        if step_deadline_s is not None and step_deadline_s <= 0:
+            raise ValueError("step_deadline_s must be > 0")
+        if slow_step_s is not None and slow_step_s <= 0:
+            raise ValueError("slow_step_s must be > 0")
+        if step_deadline_s is not None and slow_step_s is not None \
+                and slow_step_s > step_deadline_s:
+            raise ValueError("slow_step_s must be <= step_deadline_s")
+        for nm, v in (("suspect_after_slow", suspect_after_slow),
+                      ("suspect_after_silent", suspect_after_silent),
+                      ("unhealthy_after_silent", unhealthy_after_silent),
+                      ("unhealthy_after_timeouts",
+                       unhealthy_after_timeouts),
+                      ("max_step_failures", max_step_failures),
+                      ("recover_after", recover_after),
+                      ("breaker_trips", breaker_trips)):
+            if v < 1:
+                raise ValueError(f"{nm} must be >= 1")
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if backoff_base_s < 0 or backoff_max_s < 0:
+            raise ValueError("backoff times must be >= 0")
+        if backoff_factor < 1.0:
+            raise ValueError("backoff_factor must be >= 1.0")
+        if breaker_window_s <= 0 or breaker_cooldown_s <= 0:
+            raise ValueError("breaker window/cooldown must be > 0")
+        self.step_deadline_s = step_deadline_s
+        self.slow_step_s = slow_step_s
+        self.suspect_after_slow = suspect_after_slow
+        self.suspect_after_silent = suspect_after_silent
+        self.unhealthy_after_silent = unhealthy_after_silent
+        self.unhealthy_after_timeouts = unhealthy_after_timeouts
+        self.max_step_failures = max_step_failures
+        self.recover_after = recover_after
+        self.max_retries = max_retries
+        self.backoff_base_s = backoff_base_s
+        self.backoff_factor = backoff_factor
+        self.backoff_max_s = backoff_max_s
+        self.breaker_trips = breaker_trips
+        self.breaker_window_s = breaker_window_s
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self.replace_failed = replace_failed
+
+
 class EngineStatsAutoscaler:
     """Hysteresis state machine over per-replica engine stats.
 
@@ -404,6 +617,7 @@ class EngineStatsAutoscaler:
 # ---------------------------------------------------------------------------
 
 _fleet_gauges: Dict[str, Gauge] = {}
+_fleet_counters: Dict[str, Counter] = {}
 
 
 class LLMFleet:
@@ -414,27 +628,43 @@ class LLMFleet:
     per-engine `llm_engine_*` series stay separable). The fleet owns
     replica lifecycle: it starts with `initial_replicas` (or the
     autoscaler's min), the router places every `submit`, `step()`
-    advances every replica one engine step and applies at most one
-    scale decision, and DRAINING replicas leave the pool only once
-    empty.
+    advances every replica one engine step — under the health state
+    machine's supervision — and applies at most one scale decision;
+    DRAINING replicas leave the pool only once empty, UNHEALTHY ones
+    fail over their work and are replaced.
 
     The API mirrors DecodeEngine on purpose — submit / step / run /
     pending / pop_result / finished / shed_ids / stats — so a serving
     loop written against one engine drives a fleet unchanged. Request
     ids are FLEET-scoped (each engine numbers its own; the fleet maps
-    engine ids back per replica)."""
+    engine ids back per replica). The fleet pins every request's
+    sampling key at submit (derived from `rng_seed` and the FLEET id
+    when the caller passes none), which is what makes failover
+    deterministic: the stream depends on the request, never on the
+    replica that happens to run it.
+
+    ``fault_injector`` (a `models.fault_injection.FaultInjector`) is
+    armed on every replica the factory builds — including autoscale
+    and failure replacements — so chaos schedules keep biting
+    mid-churn."""
 
     def __init__(self, engine_factory: Callable[[str], object], *,
                  initial_replicas: Optional[int] = None,
                  router: Union[str, FleetRouter] = "pow2_affinity",
                  autoscaling: Optional[FleetAutoscalingConfig] = None,
+                 health: Optional[FleetHealthConfig] = None,
                  fleet_id: str = "fleet-0",
+                 rng_seed: int = 0,
+                 fault_injector=None,
                  trace=None,
                  clock: Callable[[], float] = time.monotonic):
         self._factory = engine_factory
         self.router = make_router(router)
         self.fleet_id = fleet_id
         self._clock = clock
+        self.health = health if health is not None else \
+            FleetHealthConfig()
+        self._injector = fault_injector
         # Fleet-level tracer: holds the `route` spans (one per submit,
         # carrying the router's scoring decision) that stitch replica
         # traces into one request story. Same knob semantics as
@@ -443,7 +673,7 @@ class LLMFleet:
         # dump_trace() merges whatever replicas traced.
         self.trace = resolve_tracer(trace, engine_id=fleet_id,
                                     clock=clock)
-        self._retired_trace: List[dict] = []   # drained replicas' spans
+        self._retired_trace: List[dict] = []   # removed replicas' spans
         self.autoscaler = (EngineStatsAutoscaler(autoscaling, clock)
                            if autoscaling is not None else None)
         n = initial_replicas
@@ -464,14 +694,37 @@ class LLMFleet:
             self.add_replica()
         self._next_fid = 0
         self._placement: Dict[int, Tuple[_Replica, int]] = {}
+        self._requests: Dict[int, _FleetReq] = {}
         self._done: Dict[int, List[int]] = {}
         self.finished: set = set()
         self.shed_ids: set = set()
+        self.failed: Dict[int, FleetError] = {}
+        self.failed_ids: set = set()
+        # Retry queue: (ready_at, seq, fid) min-heap; seq keeps pops
+        # FIFO among retries due at the same instant.
+        self._retry: List[Tuple[float, int, int]] = []
+        self._retry_seq = 0
+        # Tokens salvaged from a dead replica that were never streamed
+        # through step()'s emissions — surfaced in the NEXT step's
+        # merged dict so streaming callers see a gapless sequence.
+        self._pending_emit: Dict[int, List[int]] = {}
         self.requests_routed = 0
         self.requests_shed = 0
+        self.requests_failed = 0
+        self.requests_recovered = 0
+        self.retries = 0
         self.replicas_removed = 0
+        self.replicas_failed = 0
         self.tokens_lost_to_drain = 0   # stays 0 by construction;
         #                                 asserted in tests AND here
+        self.tokens_lost_to_failure = 0  # ditto, for the failover path
+        # Per-request sampling-key root: two 32-bit halves mixed from
+        # rng_seed (splitmix-style), XOR-folded with the fleet request
+        # id in `_fid_key`.
+        s = (rng_seed * 0x9E3779B97F4A7C15 + 0xD1B54A32D192ED03) \
+            & 0xFFFFFFFFFFFFFFFF
+        self._seed0 = (s >> 32) & 0xFFFFFFFF
+        self._seed1 = s & 0xFFFFFFFF
         # Weak registration in the serving state API: summarize_fleet /
         # the status CLI find this fleet (and attribute its replicas'
         # engines) without the fleet holding any extra lifecycle.
@@ -482,10 +735,15 @@ class LLMFleet:
 
     def add_replica(self) -> str:
         """Build a fresh replica via the factory and put it in the
-        routing rotation; returns its name."""
+        routing rotation; returns its name. Arms the fleet's fault
+        injector (when one is configured) so chaos schedules cover
+        replacements too."""
         name = f"{self.fleet_id}-r{self._next_replica}"
         self._next_replica += 1
-        self.replicas.append(_Replica(name, self._factory(name)))
+        engine = self._factory(name)
+        if self._injector is not None:
+            self._injector.arm(engine, name)
+        self.replicas.append(_Replica(name, engine))
         return name
 
     def drain_replica(self, name: str) -> None:
@@ -506,23 +764,52 @@ class LLMFleet:
     def _running(self) -> List[_Replica]:
         return [r for r in self.replicas if r.state == RUNNING]
 
+    def _routable(self) -> List[_Replica]:
+        """RUNNING replicas whose circuit breaker is closed. Falls back
+        to ALL RUNNING replicas when every breaker is open — serving
+        somewhere beats serving nowhere."""
+        running = self._running()
+        now = self._clock()
+        closed = [r for r in running if now >= r.breaker_open_until]
+        return closed or running
+
     # -- request path ------------------------------------------------------
+
+    def _fid_key(self, fid: int) -> np.ndarray:
+        """The pinned per-request sampling key: a distinct uint32[2]
+        stream mixed host-side from the fleet seed and the FLEET
+        request id. Deriving from the fleet id — never the replica or
+        its engine-local request numbering — is the failover
+        determinism guarantee for sampled requests: any replica that
+        (re)runs request `fid` samples the identical stream."""
+        mix0 = (fid * 0x9E3779B9 + 0x7F4A7C15) & 0xFFFFFFFF
+        mix1 = (fid * 0x85EBCA6B + 0xC2B2AE35) & 0xFFFFFFFF
+        return np.array([self._seed0 ^ mix0, self._seed1 ^ mix1],
+                        np.uint32)
 
     def submit(self, prompt: List[int], max_new_tokens: int = 32,
                priority: int = 0, rng=None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               greedy: Optional[bool] = None) -> int:
         """Route and enqueue one request; returns its FLEET id.
 
-        priority / rng / deadline_s pass straight through to the chosen
-        engine's submit — the fleet adds placement, nothing else, so
-        per-replica token identity is the engine's own guarantee. A
+        priority / deadline_s / greedy pass straight through to the
+        chosen engine's submit. The sampling key does NOT pass through
+        untouched: when ``rng`` is None the fleet derives a per-request
+        key from its own seed and the fleet request id and pins it, so
+        the request's sampled stream is a function of the REQUEST, not
+        of whichever replica runs (or re-runs, after a failure) it. A
         dead-on-arrival deadline still routes (the engine sheds it
         before it can occupy a queue slot) and is visible in
-        `finished` + `shed_ids` immediately."""
-        running = self._running()
-        if not running:
-            raise RuntimeError(
+        `finished` + `shed_ids` immediately. Raises
+        `ReplicaUnavailable` when no RUNNING replica exists."""
+        routable = self._routable()
+        if not routable:
+            raise ReplicaUnavailable(
                 "fleet has no RUNNING replicas to route to")
+        prompt = [int(t) for t in prompt]
+        fid = self._next_fid
+        key = self._fid_key(fid) if rng is None else rng
         tr = self.trace
         if tr.enabled:
             # Snapshot what the router is about to see (pure peek
@@ -530,14 +817,13 @@ class LLMFleet:
             # the scoring decision, not a post-hoc reconstruction.
             t0 = tr.now()
             scores = {r.name: round(replica_score(r, prompt), 2)
-                      for r in running}
+                      for r in routable}
             warm = {r.name: r.engine.prefix_match_tokens(prompt)
-                    for r in running}
-        rep = self.router.choose(running, prompt)
+                    for r in routable}
+        rep = self.router.choose(routable, prompt)
         rid = rep.engine.submit(prompt, max_new_tokens,
-                                priority=priority, rng=rng,
-                                deadline_s=deadline_s)
-        fid = self._next_fid
+                                priority=priority, rng=key,
+                                deadline_s=deadline_s, greedy=greedy)
         self._next_fid += 1
         if tr.enabled:
             tr.add("route", t0, tr.now() - t0, req_id=fid,
@@ -546,6 +832,12 @@ class LLMFleet:
                                            type(self.router).__name__),
                          "scores": scores, "warm_tokens": warm,
                          "warm": warm.get(rep.name, 0) > 0})
+        # Pin the key in canonical host form (raw uint32[2] bits):
+        # failover resubmission must replay the SAME stream whether the
+        # caller passed a legacy key array, a typed key, or nothing.
+        self._requests[fid] = _FleetReq(
+            fid, prompt, max_new_tokens, priority, greedy,
+            _key_data(key))
         rep.rid_to_fid[rid] = fid
         self._placement[fid] = (rep, rid)
         rep.routed += 1
@@ -556,8 +848,10 @@ class LLMFleet:
     def step(self) -> Dict[int, List[int]]:
         """Advance every replica one engine step; returns the merged
         {fleet_id: new tokens} emissions. Also applies at most one
-        autoscaler decision and retires DRAINING replicas that have
-        run empty.
+        autoscaler decision, runs the health state machine over every
+        step (exceptions, watchdog, slow/silent probes — failing
+        replicas fail over their work here), resubmits due retries,
+        and retires DRAINING replicas that have run empty.
 
         The scale decision is taken on the PRE-step snapshots: submits
         land between steps, so the backlog visible now — before this
@@ -570,43 +864,395 @@ class LLMFleet:
                 [r.engine.stats() for r in self.replicas],
                 len(self._running())))
         emitted: Dict[int, List[int]] = {}
+        if self._pending_emit:
+            # Tokens salvaged from a failed replica that step() never
+            # streamed: surface them now so the caller's stream is
+            # gapless across the failover.
+            emitted.update(self._pending_emit)
+            self._pending_emit = {}
+        self._drain_retries()
         for rep in list(self.replicas):
+            if rep.state in (UNHEALTHY, RETIRED):
+                continue
             if not rep.engine.pending():
                 self._sweep_finished(rep)
+                # No step ran: streaks can't accumulate on idleness,
+                # and an idle SUSPECT replica (routed around, so it
+                # can never earn good steps) recovers on clean sweeps.
+                rep.slow_streak = 0
+                rep.silent_streak = 0
+                self._note_good(rep)
                 continue
-            em = rep.engine.step()
+            steps_before = getattr(rep.engine, "steps_total", 0)
+            t0 = self._clock()
+            try:
+                em = rep.engine.step()
+            except Exception as exc:   # noqa: BLE001 — any step error
+                #                        is a replica health event
+                self._on_step_error(rep, exc)
+                continue
+            dt = self._clock() - t0
             for rid, toks in em.items():
                 fid = rep.rid_to_fid.get(rid)
                 if fid is not None and toks:
                     emitted.setdefault(fid, []).extend(toks)
+                    meta = self._requests.get(fid)
+                    if meta is not None:
+                        meta.emitted += len(toks)
             self._sweep_finished(rep)
+            progressed = getattr(rep.engine, "steps_total",
+                                 steps_before + 1) != steps_before
+            self._health_after_step(rep, dt, progressed)
         self._retire_drained()
         return emitted
 
     def pending(self) -> bool:
-        return any(r.engine.pending() for r in self.replicas)
+        return bool(self._retry) or any(
+            r.engine.pending() for r in self.replicas
+            if r.state != RETIRED)
 
     def run(self) -> Dict[int, List[int]]:
         """Drain every replica; returns {fleet_id: tokens} for every
-        finished request and pops them (like DecodeEngine.run)."""
+        finished request and pops them (like DecodeEngine.run). If any
+        request was LOST — its replica died and retries ran out, or no
+        replica remained to recover onto — raises `RetriesExhausted`
+        (or `ReplicaUnavailable` when no retry budget was even
+        consumed) carrying the per-request errors in ``.failed`` and
+        every successful result in ``.partial``, instead of hanging on
+        tokens that will never arrive."""
         while self.pending():
             self.step()
         for rep in list(self.replicas):
             self._sweep_finished(rep)
         self._retire_drained()
-        return {fid: self.pop_result(fid)
-                for fid in list(self.finished)}
+        results: Dict[int, List[int]] = {}
+        errors: Dict[int, FleetError] = {}
+        for fid in list(self.finished):
+            if fid in self.failed:
+                self.finished.discard(fid)
+                self.failed_ids.discard(fid)
+                errors[fid] = self.failed.pop(fid)
+            else:
+                results[fid] = self.pop_result(fid)
+        if errors:
+            kind = (RetriesExhausted
+                    if any(isinstance(e, RetriesExhausted)
+                           for e in errors.values())
+                    else ReplicaUnavailable)
+            err = kind(
+                f"{len(errors)} request(s) lost to replica failure: "
+                f"{sorted(errors)}", failed=errors, partial=results) \
+                if kind is RetriesExhausted else kind(
+                f"{len(errors)} request(s) lost to replica failure: "
+                f"{sorted(errors)}")
+            if kind is ReplicaUnavailable:
+                err.failed = errors          # same introspection shape
+                err.partial = results
+            raise err
+        return results
 
     def pop_result(self, fid: int) -> List[int]:
         """Tokens of a FINISHED fleet request (empty for a shed one —
-        check `shed_ids` before popping, same contract as the
-        engine)."""
+        check `shed_ids` before popping, same contract as the engine).
+        For a request whose replica died with retries exhausted,
+        raises its typed `RetriesExhausted` / `ReplicaUnavailable`
+        (check `failed_ids` first to branch without try/except)."""
+        if fid in self.failed:
+            self.finished.discard(fid)
+            self.failed_ids.discard(fid)
+            raise self.failed.pop(fid)
         if fid not in self.finished:
             raise KeyError(f"fleet request {fid} unknown or "
                            f"not finished")
         self.finished.discard(fid)
         self.shed_ids.discard(fid)
         return self._done.pop(fid)
+
+    # -- health state machine + failover -----------------------------------
+
+    def _note_good(self, rep: _Replica) -> None:
+        rep.good_streak += 1
+        if rep.state == SUSPECT and \
+                rep.good_streak >= self.health.recover_after:
+            rep.state = RUNNING
+            if self.trace.enabled:
+                self.trace.instant("replica_recovered", lane="events",
+                                   args={"replica": rep.name})
+
+    def _suspect(self, rep: _Replica, why: str) -> None:
+        """Put a replica on probation (RUNNING -> SUSPECT): the router
+        skips it, step() keeps watching it. Entering SUSPECT counts
+        toward the circuit breaker — `breaker_trips` entries within
+        `breaker_window_s` open it for `breaker_cooldown_s`, so a
+        flapping replica stops taking traffic BEFORE its next failure.
+        DRAINING replicas stay DRAINING (already unrouted)."""
+        rep.good_streak = 0
+        if rep.state != RUNNING:
+            return
+        rep.state = SUSPECT
+        if self.trace.enabled:
+            self.trace.instant("replica_suspect", lane="events",
+                               args={"replica": rep.name, "why": why})
+        now = self._clock()
+        cfg = self.health
+        rep.suspect_events.append(now)
+        rep.suspect_events = [
+            t for t in rep.suspect_events
+            if now - t <= cfg.breaker_window_s]
+        if len(rep.suspect_events) >= cfg.breaker_trips:
+            rep.breaker_open_until = now + cfg.breaker_cooldown_s
+            rep.breaker_trips += 1
+            rep.suspect_events.clear()
+            if self.trace.enabled:
+                self.trace.instant(
+                    "breaker_open", lane="events",
+                    args={"replica": rep.name,
+                          "until": rep.breaker_open_until})
+
+    def _on_step_error(self, rep: _Replica, exc: Exception) -> None:
+        rep.failures += 1
+        if self.trace.enabled:
+            self.trace.instant(
+                "replica_step_error", lane="events",
+                args={"replica": rep.name, "failures": rep.failures,
+                      "error": f"{type(exc).__name__}: {exc}"})
+        if rep.failures >= self.health.max_step_failures:
+            self._fail_replica(rep, exc)
+        else:
+            self._suspect(rep, "step_error")
+
+    def _health_after_step(self, rep: _Replica, dt: float,
+                           progressed: bool) -> None:
+        """Classify one completed (non-raising) step: watchdog timeout,
+        silent (no engine progress while work is pending), slow, or
+        good — and advance the replica's health state accordingly."""
+        cfg = self.health
+        if cfg.step_deadline_s is not None and \
+                dt >= cfg.step_deadline_s:
+            rep.timeouts += 1
+            if self.trace.enabled:
+                self.trace.instant(
+                    "replica_watchdog_timeout", lane="events",
+                    args={"replica": rep.name, "step_s": dt,
+                          "timeouts": rep.timeouts})
+            if rep.timeouts >= cfg.unhealthy_after_timeouts:
+                self._fail_replica(rep, FleetError(
+                    f"replica {rep.name}: {rep.timeouts} watchdog "
+                    f"timeouts (step >= {cfg.step_deadline_s}s)"))
+                return
+            self._suspect(rep, "watchdog_timeout")
+            return
+        if not progressed:
+            rep.silent_streak += 1
+            if rep.silent_streak >= cfg.unhealthy_after_silent:
+                self._fail_replica(rep, FleetError(
+                    f"replica {rep.name}: silent for "
+                    f"{rep.silent_streak} steps (no engine progress "
+                    "with work pending)"))
+                return
+            if rep.silent_streak >= cfg.suspect_after_silent:
+                self._suspect(rep, "silent")
+            return
+        if cfg.slow_step_s is not None and dt >= cfg.slow_step_s:
+            rep.silent_streak = 0
+            rep.slow_streak += 1
+            if rep.slow_streak >= cfg.suspect_after_slow:
+                self._suspect(rep, "slow_steps")
+            return
+        rep.slow_streak = 0
+        rep.silent_streak = 0
+        self._note_good(rep)
+
+    def _fail_replica(self, rep: _Replica, cause: Exception) -> None:
+        """Condemn a replica and fail its work over: harvest results
+        it already finished, reconstruct every in-flight and queued
+        request from host bookkeeping (prompt + emitted tokens + the
+        pinned key), halt the engine (pipeline discarded, paged-KV
+        refcounts released), retire the replica, schedule the
+        reconstructed requests for resubmission with backoff, and —
+        by default — add a replacement replica."""
+        if rep.state == RETIRED:
+            return
+        rep.state = UNHEALTHY
+        self.replicas_failed += 1
+        self._count("replica_failures", 1)
+        if self.trace.enabled:
+            self.trace.instant(
+                "replica_failed", lane="events",
+                args={"replica": rep.name,
+                      "error": f"{type(cause).__name__}: {cause}",
+                      "inflight": len(rep.rid_to_fid)})
+        # Results the replica finished before dying are ordinary
+        # completions: sweep them first (host-side state survives any
+        # step() exception — nothing below touches the device).
+        try:
+            self._sweep_finished(rep)
+        except Exception:
+            pass
+        salvaged: List[Tuple[int, List[int]]] = []
+        results = getattr(rep.engine, "results", {})
+        for rid, fid in list(rep.rid_to_fid.items()):
+            req = results.get(rid)
+            toks = list(req.tokens) if req is not None else []
+            meta = self._requests.get(fid)
+            if meta is not None:
+                # Tokens already streamed to the caller must all be in
+                # the salvage (req.tokens accrues at drain, BEFORE the
+                # fleet ever sees an emission) — counted, not trusted.
+                self.tokens_lost_to_failure += max(
+                    0, meta.emitted - len(toks))
+                gap = toks[meta.emitted:]
+                if gap:
+                    self._pending_emit.setdefault(fid, []).extend(gap)
+                    meta.emitted = len(toks)
+            salvaged.append((fid, toks))
+            self._placement.pop(fid, None)
+        rep.rid_to_fid.clear()
+        try:
+            rep.engine.halt()
+        except Exception:
+            pass               # the engine may be arbitrarily broken
+        self._harvest_trace(rep)
+        rep.state = RETIRED
+        if rep in self.replicas:
+            self.replicas.remove(rep)
+        self.replicas_removed += 1
+        for fid, toks in salvaged:
+            self._schedule_retry(fid, toks, cause)
+        if self.health.replace_failed:
+            name = self.add_replica()
+            if self.trace.enabled:
+                self.trace.instant(
+                    "replica_replaced", lane="events",
+                    args={"failed": rep.name, "replacement": name})
+
+    def _schedule_retry(self, fid: int, toks: List[int],
+                        cause: Exception) -> None:
+        meta = self._requests.get(fid)
+        if meta is None:
+            return
+        if len(toks) >= meta.max_new_tokens:
+            # The salvage IS the complete answer (the replica died
+            # between finishing and being swept): finish directly.
+            self._done[fid] = toks
+            self.finished.add(fid)
+            self._requests.pop(fid, None)
+            return
+        n = meta.attempts           # next submission = retry #n
+        if n > self.health.max_retries:
+            self._fail_request(fid, RetriesExhausted(
+                f"fleet request {fid}: replica failed "
+                f"({type(cause).__name__}: {cause}) and all "
+                f"{self.health.max_retries} retries are spent"))
+            return
+        meta.tokens = toks
+        meta.recovering = True
+        delay = self._backoff_delay(meta, n)
+        heapq.heappush(self._retry,
+                       (self._clock() + delay, self._retry_seq, fid))
+        self._retry_seq += 1
+        if self.trace.enabled:
+            self.trace.instant(
+                "failover_scheduled", fid,
+                args={"retry": n, "delay_s": round(delay, 4),
+                      "resume_tokens": len(toks)})
+
+    def _backoff_delay(self, meta: _FleetReq, n: int) -> float:
+        """Retry n's wait. The first failover is immediate (the
+        failure is already detected — waiting buys nothing); later
+        retries back off exponentially, stretched by up to 50%
+        deterministic jitter mixed from the request's own key — so a
+        herd of failed-over requests de-synchronizes the same way
+        every run (reproducible chaos)."""
+        if n <= 1:
+            return 0.0
+        cfg = self.health
+        base = min(cfg.backoff_max_s,
+                   cfg.backoff_base_s * cfg.backoff_factor ** (n - 2))
+        seed0 = int(meta.rng[0]) if meta.rng is not None else meta.fid
+        frac = (((seed0 & 0xFFFFFFFF) * 0x9E3779B9
+                 + n * 0x85EBCA6B) & 0xFFFF) / 65535.0
+        return base * (1.0 + 0.5 * frac)
+
+    def _fail_request(self, fid: int, err: FleetError) -> None:
+        meta = self._requests.pop(fid, None)
+        if meta is not None and meta.tokens:
+            err.partial = {fid: list(meta.tokens)}
+        self.failed[fid] = err
+        self.failed_ids.add(fid)
+        self.finished.add(fid)    # wakes pollers; pop_result raises
+        self.requests_failed += 1
+
+    def _drain_retries(self) -> None:
+        """Resubmit every retry whose backoff has lapsed. Retries
+        route over ALL RUNNING replicas — the circuit breaker is
+        ignored here (a recovery must land somewhere; the breaker
+        shapes new-traffic placement, not correctness). With zero
+        RUNNING replicas: wait while any survivor could still recover
+        or drain out (SUSPECT/DRAINING), else fail the request with
+        `ReplicaUnavailable` — never hang `run()`."""
+        now = self._clock()
+        while self._retry and self._retry[0][0] <= now:
+            ready, seq, fid = heapq.heappop(self._retry)
+            meta = self._requests.get(fid)
+            if meta is None:
+                continue
+            running = self._running()
+            if not running:
+                if any(r.state in (SUSPECT, DRAINING)
+                       for r in self.replicas):
+                    # A survivor may yet recover (or a drain finish):
+                    # park the retry and re-check next step.
+                    heapq.heappush(self._retry, (ready, seq, fid))
+                    return
+                self._fail_request(fid, ReplicaUnavailable(
+                    f"fleet request {fid}: no RUNNING replica left to "
+                    "recover onto (replacement disabled or exhausted)"))
+                continue
+            self._resubmit(meta, running, ready, seq)
+
+    def _resubmit(self, meta: _FleetReq, cands: List[_Replica],
+                  ready: float, seq: int) -> None:
+        rep = self.router.choose(cands, meta.prompt)
+        try:
+            rid = rep.engine.submit(
+                meta.prompt, meta.max_new_tokens,
+                priority=meta.priority, rng=meta.rng,
+                greedy=meta.greedy,
+                resume_tokens=meta.tokens or None)
+        except (EngineDraining, EngineOverloaded):
+            # Raced a drain/overload on the chosen replica: park the
+            # retry one backoff-base further out, attempt unconsumed.
+            heapq.heappush(self._retry,
+                           (self._clock() + self.health.backoff_base_s,
+                            seq, meta.fid))
+            return
+        meta.attempts += 1
+        meta.recovering = False
+        rep.rid_to_fid[rid] = meta.fid
+        self._placement[meta.fid] = (rep, rid)
+        rep.routed += 1
+        self.retries += 1
+        self._count("retries", 1)
+        if self.trace.enabled:
+            self.trace.instant(
+                "failover", meta.fid,
+                args={"replica": rep.name, "rid": rid,
+                      "attempt": meta.attempts,
+                      "resume_tokens": len(meta.tokens)})
+        self._sweep_finished(rep)
+
+    def _harvest_trace(self, rep: _Replica) -> None:
+        """Keep a leaving replica's spans so dump_trace() still tells
+        the whole story — bounded like the rings it collects from
+        (oldest spans trimmed first)."""
+        etr = getattr(rep.engine, "trace", None)
+        if etr is None or not etr.enabled:
+            return
+        self._retired_trace.extend(etr.chrome_events(pid=rep.name))
+        cap = 4 * getattr(etr, "capacity", 16384)
+        if len(self._retired_trace) > cap:
+            self._retired_trace = self._retired_trace[-cap:]
 
     # -- internals ---------------------------------------------------------
 
@@ -620,6 +1266,10 @@ class LLMFleet:
                 continue
             shed = rid in rep.engine.shed_ids
             toks = rep.engine.pop_result(rid)
+            meta = self._requests.pop(fid, None)
+            if meta is not None and meta.attempts > 1:
+                self.requests_recovered += 1
+                self._count("requests_recovered", 1)
             self._done[fid] = toks
             self.finished.add(fid)
             self._placement.pop(fid, None)
@@ -638,16 +1288,7 @@ class LLMFleet:
             if rep.engine.pending() or rep.engine.finished or \
                     rep.rid_to_fid:
                 continue    # still owes work or unswept results: kept
-            etr = getattr(rep.engine, "trace", None)
-            if etr is not None and etr.enabled:
-                # Keep the drained replica's spans so dump_trace()
-                # still tells the whole story — bounded like the rings
-                # it collects from (oldest spans trimmed first).
-                self._retired_trace.extend(
-                    etr.chrome_events(pid=rep.name))
-                cap = 4 * getattr(etr, "capacity", 16384)
-                if len(self._retired_trace) > cap:
-                    self._retired_trace = self._retired_trace[-cap:]
+            self._harvest_trace(rep)
             self.replicas.remove(rep)
             self.replicas_removed += 1
 
@@ -669,13 +1310,37 @@ class LLMFleet:
 
     # -- telemetry ---------------------------------------------------------
 
+    def recovering_requests(self) -> List[Dict[str, object]]:
+        """One dict per request currently parked in the retry queue —
+        the state API's `status="recovering"` source. Host-only."""
+        out = []
+        for ready, _seq, fid in sorted(self._retry):
+            meta = self._requests.get(fid)
+            if meta is None or not meta.recovering:
+                continue
+            out.append({
+                "req_id": fid,
+                "prompt_tokens": len(meta.prompt),
+                "max_new_tokens": meta.max_new_tokens,
+                "tokens_out": len(meta.tokens),
+                "priority": meta.priority,
+                "attempts": meta.attempts,
+                "retry_ready_at": ready,
+            })
+        return out
+
+    def replica_health(self) -> Dict[str, str]:
+        """{replica name -> health/lifecycle state} for every pooled
+        replica (the state API / status CLI health column)."""
+        return {r.name: r.state for r in self.replicas}
+
     def dump_trace(self, path: Optional[str] = None) -> List[dict]:
         """One chrome://tracing JSON for the whole fleet: the fleet
         tracer's `route` spans (pid = fleet id, tid = fleet request
         lane) merged with every replica engine's lifecycle spans
         (pid = replica name, tid = replica-local request lane) plus
-        spans harvested from replicas already drained out of the pool.
-        A route span's args carry the chosen replica and its
+        spans harvested from replicas already drained or failed out of
+        the pool. A route span's args carry the chosen replica and its
         replica-local rid, which is the join key between the two pid
         groups. Writes JSON to `path` when given; returns the event
         list (empty when nothing traced)."""
@@ -697,15 +1362,28 @@ class LLMFleet:
         tagged with the fleet id through util.metrics."""
         running = self._running()
         draining = [r for r in self.replicas if r.state == DRAINING]
+        suspect = [r for r in self.replicas if r.state == SUSPECT]
+        now = self._clock()
         per = [r.engine.stats() for r in self.replicas]
         out: Dict[str, float] = {
             "replicas": float(len(self.replicas)),
             "replicas_running": float(len(running)),
             "replicas_draining": float(len(draining)),
+            "replicas_suspect": float(len(suspect)),
             "replicas_removed": float(self.replicas_removed),
+            "replicas_failed": float(self.replicas_failed),
+            "breakers_open": float(sum(
+                1 for r in self.replicas
+                if now < r.breaker_open_until)),
             "requests_routed": float(self.requests_routed),
             "requests_shed": float(self.requests_shed),
+            "requests_failed": float(self.requests_failed),
+            "requests_recovered": float(self.requests_recovered),
+            "retries": float(self.retries),
+            "retry_queue_depth": float(len(self._retry)),
             "tokens_lost_to_drain": float(self.tokens_lost_to_drain),
+            "tokens_lost_to_failure": float(
+                self.tokens_lost_to_failure),
             "queue_depth": sum(s.get("queue_depth", 0.0) for s in per),
             "pending_prefill_tokens": sum(
                 s.get("pending_prefill_tokens", 0.0) for s in per),
@@ -785,3 +1463,15 @@ class LLMFleet:
                     name, f"LLMFleet stats field {field!r}",
                     tag_keys=("fleet",))
             g.set(float(value), tags={"fleet": self.fleet_id})
+
+    def _count(self, event: str, value: float) -> None:
+        """Monotonic fault-plane counters (`llm_fleet_<event>_total`),
+        incremented at event time — unlike the gauges, which republish
+        whole snapshots on stats()."""
+        name = f"llm_fleet_{event}_total"
+        c = _fleet_counters.get(name)
+        if c is None:
+            c = _fleet_counters[name] = Counter(
+                name, f"LLMFleet fault-tolerance event {event!r}",
+                tag_keys=("fleet",))
+        c.inc(float(value), tags={"fleet": self.fleet_id})

@@ -11,7 +11,9 @@ server grows:
 - a BOUNDED queue with backpressure (`max_queue` + `on_full`): reject
   (raise `EngineOverloaded`, the caller sheds load / retries elsewhere)
   or block (drive the engine until a queue slot frees — the
-  single-threaded analog of awaiting queue room);
+  single-threaded analog of awaiting queue room; `block_timeout_s`
+  bounds the wait and raises `SubmitTimeout` when a wedged engine
+  would otherwise block the caller forever);
 - a per-step PREFILL ADMISSION BUDGET (`max_prefills_per_step`): each
   admission runs a whole prompt-prefill program before the shared
   decode step, so a burst of long prompts admitted at once would stall
@@ -43,6 +45,15 @@ class EngineDraining(RuntimeError):
     """Raised by `DecodeEngine.submit()` after `begin_drain()`: a
     draining engine finishes its in-flight and queued work but accepts
     no new requests (the fleet routes around it until removal)."""
+
+
+class SubmitTimeout(EngineOverloaded):
+    """Raised by `DecodeEngine.submit()` in on_full="block" mode when
+    the queue stays full past ``block_timeout_s``: the engine was
+    driven that long without freeing a queue slot, so it is wedged or
+    hopelessly oversubscribed — surface a typed error instead of
+    spinning forever. Subclasses EngineOverloaded so existing
+    overload handlers keep catching it."""
 
 
 class SchedulerPolicy:

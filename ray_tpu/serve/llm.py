@@ -29,7 +29,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Union
 
-from ray_tpu.models.fleet import (FleetAutoscalingConfig, FleetRouter,
+from ray_tpu.models.fleet import (FleetAutoscalingConfig,
+                                  FleetHealthConfig, FleetRouter,
                                   LLMFleet)
 from ray_tpu.serve import metrics as serve_metrics
 
@@ -41,18 +42,23 @@ class LLMFleetServer:
 
     ``engine_factory(name) -> DecodeEngine`` builds each replica's
     engine. ``autoscaling`` may be a `FleetAutoscalingConfig` or a
-    plain dict of its kwargs (config-file friendly). All other kwargs
-    pass through to `LLMFleet`."""
+    plain dict of its kwargs (config-file friendly), and ``health``
+    (a `FleetHealthConfig` or dict) tunes the fleet's replica health
+    state machine / retry policy the same way. All other kwargs pass
+    through to `LLMFleet`."""
 
     def __init__(self, engine_factory: Callable[[str], object], *,
                  router: Union[str, FleetRouter] = "pow2_affinity",
                  autoscaling: Union[FleetAutoscalingConfig, dict,
                                     None] = None,
+                 health: Union[FleetHealthConfig, dict, None] = None,
                  fleet_id: str = "llm-fleet",
                  report_stats: bool = True,
                  **fleet_kwargs):
         if isinstance(autoscaling, dict):
             autoscaling = FleetAutoscalingConfig(**autoscaling)
+        if isinstance(health, dict):
+            health = FleetHealthConfig(**health)
         if autoscaling is not None and \
                 autoscaling.target_custom_metric is not None and \
                 autoscaling.custom_metric_source is None:
@@ -62,7 +68,7 @@ class LLMFleetServer:
             autoscaling.custom_metric_source = \
                 serve_metrics.recorded_autoscaling_metric
         self.fleet = LLMFleet(engine_factory, router=router,
-                              autoscaling=autoscaling,
+                              autoscaling=autoscaling, health=health,
                               fleet_id=fleet_id, **fleet_kwargs)
         self._report_stats = report_stats
         # Serving state API registration (weak): the deployment body
@@ -79,7 +85,11 @@ class LLMFleetServer:
         "shed": bool}`` — a shed request (past its deadline before
         prefill) comes back with the bare prompt and shed=True instead
         of an error, so callers distinguish 'declined under overload'
-        from failure."""
+        from failure. A request whose replica DIED propagates the
+        fleet's typed error (`RetriesExhausted` after the retry budget,
+        `ReplicaUnavailable` with no replica left to recover onto)
+        instead of looping forever — failed requests join `finished`
+        and `pop_result` raises."""
         fid = self.fleet.submit(token_ids, max_new_tokens,
                                 priority=priority,
                                 deadline_s=deadline_s)
@@ -144,8 +154,9 @@ def llm_deployment(engine_factory: Callable[[str], object], *,
     at bind time; the rest are `@serve.deployment` options."""
     from ray_tpu.serve.deployment import deployment
 
-    shim_keys = ("router", "autoscaling", "fleet_id", "report_stats",
-                 "initial_replicas", "trace", "clock")
+    shim_keys = ("router", "autoscaling", "health", "fleet_id",
+                 "report_stats", "initial_replicas", "trace", "clock",
+                 "rng_seed", "fault_injector")
     shim_kwargs = {k: deployment_options.pop(k)
                    for k in list(deployment_options)
                    if k in shim_keys}

@@ -25,6 +25,10 @@ Request phases (`list_requests(status=...)`):
 - ``swapped``     preempted out of the pool, spilled state waiting to
                   swap back in (the request is also re-queued; the
                   swap ledger takes precedence here)
+- ``recovering``  parked in a fleet's retry queue after its replica
+                  failed: reconstructed host-side, waiting out its
+                  backoff before resubmission (these rows live on the
+                  FLEET, not any engine — their ``engine_id`` is None)
 - ``draining``    not a phase but a FILTER: any request, in any phase,
                   living on an engine that has begun draining
 """
@@ -105,14 +109,17 @@ def reset_serving_state() -> None:
 # ---------------------------------------------------------------------------
 
 def _fleet_of(engine) -> Dict[str, Optional[str]]:
-    """(fleet_id, replica name) owning `engine`, by identity walk over
-    registered fleets — engines carry no back-pointer on purpose (the
-    models layer stays fleet-blind)."""
+    """(fleet_id, replica name, health state) owning `engine`, by
+    identity walk over registered fleets — engines carry no
+    back-pointer on purpose (the models layer stays fleet-blind).
+    `health` is the fleet's replica lifecycle state (RUNNING /
+    SUSPECT / DRAINING / ...); a loose engine reports None."""
     for fleet in fleets():
         for rep in getattr(fleet, "replicas", []):
             if rep.engine is engine:
-                return {"fleet": fleet.fleet_id, "replica": rep.name}
-    return {"fleet": None, "replica": None}
+                return {"fleet": fleet.fleet_id, "replica": rep.name,
+                        "health": rep.state}
+    return {"fleet": None, "replica": None, "health": None}
 
 
 def engine_state(engine) -> Dict[str, Any]:
@@ -222,7 +229,7 @@ def engine_requests(engine) -> List[Dict[str, Any]]:
 # ---------------------------------------------------------------------------
 
 REQUEST_STATUSES = ("queued", "prefilling", "decoding", "swapped",
-                    "draining")
+                    "recovering", "draining")
 
 
 def list_engines(limit: int = 1000) -> List[Dict[str, Any]]:
@@ -236,8 +243,10 @@ def list_requests(status: Optional[str] = None,
     """Every in-flight request across registered engines.
 
     ``status`` filters to one phase (queued / prefilling / decoding /
-    swapped) or to ``draining`` — all requests, any phase, on engines
-    that have begun draining. ``engine_id`` restricts to one engine."""
+    swapped / recovering) or to ``draining`` — all requests, any
+    phase, on engines that have begun draining. ``engine_id``
+    restricts to one engine (``recovering`` rows belong to a FLEET,
+    not an engine, so an engine_id filter excludes them)."""
     if status is not None and status not in REQUEST_STATUSES:
         raise ValueError(
             f"unknown status {status!r} "
@@ -247,6 +256,16 @@ def list_requests(status: Optional[str] = None,
         if engine_id is not None and eng.engine_id != engine_id:
             continue
         rows.extend(engine_requests(eng))
+    if engine_id is None:
+        # Failed-over requests waiting out their retry backoff are
+        # fleet-side state (no engine holds them yet).
+        for fleet in fleets():
+            for r in fleet.recovering_requests():
+                rows.append({**r, "engine_id": None,
+                             "status": "recovering", "row": None,
+                             "fleet": fleet.fleet_id,
+                             "age_s": None,
+                             "engine_draining": False})
     if status == "draining":
         rows = [r for r in rows if r["engine_draining"]]
     elif status is not None:
@@ -294,6 +313,13 @@ def _phase_counts(rows: List[Dict[str, Any]]) -> Dict[str, int]:
     return counts
 
 
+def _health_counts(fleet) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for state in fleet.replica_health().values():
+        counts[state] = counts.get(state, 0) + 1
+    return counts
+
+
 def summarize_fleet() -> Dict[str, Any]:
     """`ray status`-shaped rollup: one block per registered fleet plus
     totals over every registered engine (fleet members and loose
@@ -332,6 +358,15 @@ def summarize_fleet() -> Dict[str, Any]:
             "requests_routed": fleet.requests_routed,
             "requests_shed": fleet.requests_shed,
             "requests": _phase_counts(member_reqs),
+            # Fault-tolerance plane: replica health census + recovery
+            # counters (all host-side reads, like everything here).
+            "health": _health_counts(fleet),
+            "replicas_failed": fleet.replicas_failed,
+            "requests_recovering": len(fleet.recovering_requests()),
+            "requests_recovered": fleet.requests_recovered,
+            "requests_failed": fleet.requests_failed,
+            "retries": fleet.retries,
+            "tokens_lost_to_failure": fleet.tokens_lost_to_failure,
         })
 
     attached = {r["engine_id"] for r in engine_rows

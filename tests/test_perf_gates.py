@@ -232,6 +232,47 @@ def test_gate_null_tracer_zero_allocations_on_decode_path():
         + "; ".join(str(s) for s in stats[:5]))
 
 
+def test_gate_armed_idle_fault_injector_zero_allocations():
+    """Gate (r13, fault injection): a FaultInjector ARMED on an engine
+    but with nothing to inject (no script for this replica, no random
+    rates) adds ZERO bytes of allocation inside fault_injection.py
+    across a decode churn — the zero-cost-when-idle contract, held the
+    same way as the null tracer's gate. Fails if the wrapped step ever
+    does bookkeeping before checking the per-replica active flag."""
+    import tracemalloc
+
+    jax = pytest.importorskip("jax")
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models import fault_injection
+    from ray_tpu.models.engine import DecodeEngine
+    from ray_tpu.models.fault_injection import FaultInjector
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    eng = DecodeEngine(params, cfg, batch_slots=2, max_len=32)
+    inj = FaultInjector(schedule={"other-replica": [(0, "kill")]})
+    inj.arm(eng, "idle-replica")     # armed, but nothing can fire
+    eng.submit([5, 6, 7], 4)
+    eng.run()                        # compile outside the window
+
+    trace_filter = tracemalloc.Filter(
+        True, fault_injection.__file__)
+    tracemalloc.start()
+    try:
+        for i in range(3):
+            eng.submit([5, 6, 7 + i], 4)
+        eng.run()
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    stats = snap.filter_traces([trace_filter]).statistics("lineno")
+    total = sum(s.size for s in stats)
+    assert total == 0, (
+        f"idle armed injector allocated {total} bytes on the decode "
+        "path: " + "; ".join(str(s) for s in stats[:5]))
+    assert not inj.fired
+
+
 def test_gate_tracer_ring_bounded_under_flood():
     """Gate (r9, tracing): 10k events through a small ring stay
     BOUNDED — capacity records live, the rest counted in

@@ -282,7 +282,8 @@ def test_summarize_fleet_attribution_and_counts(nano_model):
     assert summary["engines_unattached"] >= 1
     assert summary["requests"] == {
         s: len(serving.list_requests(status=s))
-        for s in ("queued", "prefilling", "decoding", "swapped")}
+        for s in ("queued", "prefilling", "decoding", "swapped",
+                  "recovering")}
     assert summary["requests_inflight"] == \
         len(serving.list_requests())
     fleet.run(), loose.run()

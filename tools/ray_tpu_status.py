@@ -3,8 +3,11 @@
 Renders, from one snapshot:
 
 - fleet topology: replicas per fleet, router, tp degree, draining
-  flags, autoscaler presence;
-- one line per engine with occupancy / queue / KV-pool bars;
+  flags, autoscaler presence, replica health census (SUSPECT /
+  failed / recovered counters when the fault-tolerance plane has
+  anything to say);
+- one line per engine with occupancy / queue / KV-pool bars and its
+  fleet health state (SUSPECT and worse shown as a flag);
 - SLO percentiles (TTFT/TPOT p50/p95) with trend arrows derived from
   the metrics-history ring;
 - the top-N longest-running in-flight requests with their current
@@ -67,8 +70,11 @@ def _bar(frac: float, width: int = 20) -> str:
 
 
 def _phases_line(counts: Dict[str, int]) -> str:
-    order = ("queued", "prefilling", "decoding", "swapped")
-    return " / ".join(f"{counts.get(p, 0)} {p}" for p in order)
+    order = ("queued", "prefilling", "decoding", "swapped",
+             "recovering")
+    parts = [f"{counts.get(p, 0)} {p}" for p in order
+             if p != "recovering" or counts.get(p, 0)]
+    return " / ".join(parts)
 
 
 def _trends(history: Dict[str, Any]) -> Dict[str, int]:
@@ -96,12 +102,23 @@ def format_status(data: Dict[str, Any], top: int = 5) -> str:
         drain = (f", {fb['replicas_draining']} draining"
                  if fb["replicas_draining"] else "")
         auto = " autoscaling" if fb.get("autoscaling") else ""
+        health = fb.get("health", {})
+        suspect = (f", {health['SUSPECT']} suspect"
+                   if health.get("SUSPECT") else "")
         lines.append(
             f"fleet {fb['fleet_id']}: {fb['replicas']} replicas "
-            f"({fb['replicas_running']} running{drain}) "
+            f"({fb['replicas_running']} running{drain}{suspect}) "
             f"router={fb['router']} tp={fb['tp_degree_max']}{auto}")
         lines.append(f"  requests: {_phases_line(fb['requests'])}"
                      f"   shed total: {fb['requests_shed']}")
+        if fb.get("replicas_failed") or fb.get("retries") or \
+                fb.get("requests_recovering"):
+            lines.append(
+                f"  faults: {fb.get('replicas_failed', 0)} replica(s) "
+                f"failed, {fb.get('requests_recovered', 0)} requests "
+                f"recovered ({fb.get('retries', 0)} retries), "
+                f"{fb.get('requests_recovering', 0)} recovering now, "
+                f"{fb.get('tokens_lost_to_failure', 0)} tokens lost")
     if not summary["fleets"]:
         lines.append("no fleets registered")
     if summary["engines_unattached"]:
@@ -130,8 +147,13 @@ def format_status(data: Dict[str, Any], top: int = 5) -> str:
             spec = (f" spec w{e.get('spec_window', 0)} "
                     f"acc {e.get('spec_acceptance_rate', 0.0) * 100:.0f}%"
                     f" {spec_arrow}")
+        health = e.get("health")
         flags = "".join(
             [" DRAINING" if e["draining"] else "",
+             # RUNNING is the quiet default; anything else (SUSPECT,
+             # UNHEALTHY) is worth a loud flag on the replica line.
+             f" {health}" if health not in (None, "RUNNING",
+                                            "DRAINING") else "",
              f" tp={e['tp_degree']}" if e["tp_degree"] > 1 else "",
              " paged" if e["paged"] else ""])
         lines.append(

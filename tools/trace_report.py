@@ -122,6 +122,46 @@ def spec_summary(
     }
 
 
+def failover_summary(
+        events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Aggregate the fleet's fault-tolerance instants (replica_failed /
+    failover / replica_recovered / replica_suspect / breaker_open)
+    into one summary dict, or None for a trace with no fault activity.
+    Like `spec_summary`, these are event-lane markers, not request
+    phases — a failover shows up in a request's own lane as a fresh
+    queue_wait on the replacement replica, so attributing the instants
+    via PHASE_OF would double count."""
+    failed: List[str] = []
+    failovers = 0
+    resumed_tokens = 0
+    recovered = suspects = breakers = 0
+    for ev in events:
+        name = ev.get("name", "")
+        if name == "replica_failed":
+            failed.append((ev.get("args") or {}).get("replica", "?"))
+        elif name == "failover":
+            failovers += 1
+            resumed_tokens += (ev.get("args") or {}).get(
+                "resume_tokens", 0)
+        elif name == "replica_recovered":
+            recovered += 1
+        elif name == "replica_suspect":
+            suspects += 1
+        elif name == "breaker_open":
+            breakers += 1
+    if not (failed or failovers or recovered or suspects or breakers):
+        return None
+    return {
+        "replicas_failed": len(failed),
+        "failed_replicas": failed,
+        "failovers": failovers,
+        "resumed_tokens": resumed_tokens,
+        "replicas_recovered": recovered,
+        "suspect_events": suspects,
+        "breakers_opened": breakers,
+    }
+
+
 def totals(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Aggregate line over breakdown rows — the ONE place the summary
     numbers are computed, shared by the text report's footer and the
@@ -137,7 +177,8 @@ def totals(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
 
 
 def format_report(rows: List[Dict[str, Any]], top: int = 5,
-                  spec: Optional[Dict[str, Any]] = None) -> str:
+                  spec: Optional[Dict[str, Any]] = None,
+                  faults: Optional[Dict[str, Any]] = None) -> str:
     lines = [f"{'request':>10} {'pid':>8} {'e2e_ms':>9} "
              f"{'queue%':>7} {'prefill%':>9} {'decode%':>8} "
              f"{'swap%':>6} {'tokens':>7}"]
@@ -175,6 +216,15 @@ def format_report(rows: List[Dict[str, Any]], top: int = 5,
             f"{spec['spec_accepted']}/{spec['spec_proposed']} accepted "
             f"({spec['spec_acceptance_rate'] * 100:.1f}%), "
             f"{spec['spec_prefills']} draft prefills")
+    if faults is not None:
+        names = ", ".join(faults["failed_replicas"]) or "-"
+        lines.append(
+            f"-- faults: {faults['replicas_failed']} replica(s) "
+            f"failed ({names}), {faults['failovers']} failovers "
+            f"resuming {faults['resumed_tokens']} tokens, "
+            f"{faults['suspect_events']} suspect events, "
+            f"{faults['replicas_recovered']} recoveries, "
+            f"{faults['breakers_opened']} breakers opened")
     return "\n".join(lines)
 
 
@@ -190,13 +240,17 @@ def main(argv: Optional[List[str]] = None) -> None:
     events = load_trace(args.trace)
     rows = request_breakdowns(events)
     spec = spec_summary(events)
+    faults = failover_summary(events)
     if args.json:
         payload = {"requests": rows, "totals": totals(rows)}
         if spec is not None:
             payload["speculation"] = spec
+        if faults is not None:
+            payload["faults"] = faults
         print(json.dumps(payload, indent=1))
     else:
-        print(format_report(rows, top=args.top, spec=spec))
+        print(format_report(rows, top=args.top, spec=spec,
+                            faults=faults))
 
 
 if __name__ == "__main__":
