@@ -1266,6 +1266,17 @@ def main():
         out[f"large_{k}"] = v
     serving.setdefault("backend", jax.default_backend())
     serving["host_load_at_start"] = round(gate["load"], 2)
+    # graftlint sweep over the serving tree: tracked scalar so a hot-path
+    # violation regression shows up in the bench record, not just CI.
+    try:
+        from ray_tpu._private.lint import lint_paths
+
+        _lint_report = lint_paths(
+            ["ray_tpu/models", "ray_tpu/serve", "ray_tpu/util"])
+        serving["lint_violations_total"] = (
+            len(_lint_report.open) + len(_lint_report.errors))
+    except Exception as e:
+        serving["lint_violations_total"] = f"error: {type(e).__name__}"
     # Serving block on its own line; the train block stays the LAST
     # line (the driver's historical parse contract).
     print(json.dumps(serving))

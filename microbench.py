@@ -686,6 +686,33 @@ def _state_snapshot_section(quick: bool) -> list:
     return results
 
 
+def _graft_lint_section(quick: bool) -> list:
+    """Wall time of one full graftlint sweep (all four analyzers over
+    the serving tree — the same work `test_graft_lint.py::test_tree_is_clean`
+    does in tier-1 CI). Budget: < 2 s, so the gate stays cheap enough to
+    run on every commit; also reports per-file microseconds and the open
+    finding count (must be 0 — bench.py tracks it as
+    `lint_violations_total`)."""
+    from ray_tpu._private.lint import lint_paths
+
+    paths = ["ray_tpu/models", "ray_tpu/serve", "ray_tpu/util"]
+    lint_paths(paths)                       # warm import + glossary cache
+    trials = 1 if quick else TRIALS
+    times = []
+    report = None
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        report = lint_paths(paths)
+        times.append(time.perf_counter() - t0)
+    sweep = statistics.median(times)
+    return [
+        ("lint_sweep_seconds", sweep, "s"),
+        ("lint_us_per_file",
+         sweep / max(report.files_scanned, 1) * 1e6, "us"),
+        ("lint_violations_total", float(len(report.open)), "count"),
+    ]
+
+
 def main(quick: bool = False):
     import numpy as np
 
@@ -694,6 +721,9 @@ def main(quick: bool = False):
     scale = 0.1 if quick else 1.0
     # Print the serving-engine sections immediately: their numbers must
     # survive an environment-specific failure in a later section.
+    for name, value, unit in _graft_lint_section(quick):
+        print(json.dumps({"metric": name, "value": round(value, 4),
+                          "unit": unit}), flush=True)
     for name, value, unit in _decode_dispatch_section(quick):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)

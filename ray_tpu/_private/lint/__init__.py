@@ -1,0 +1,39 @@
+"""graftlint: repo-invariant static analysis + sanitizer glue for ray_tpu.
+
+Public surface re-exported from :mod:`ray_tpu._private.lint.core`; the four
+analyzers self-register on import via :func:`default_rules`.
+"""
+
+from ray_tpu._private.lint.core import (
+    DEFAULT_BASELINE,
+    Finding,
+    LintConfig,
+    LintReport,
+    RULE_REGISTRY,
+    Rule,
+    baseline_entries,
+    default_rules,
+    diff_baseline,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    register,
+    save_baseline,
+)
+
+__all__ = [
+    "DEFAULT_BASELINE",
+    "Finding",
+    "LintConfig",
+    "LintReport",
+    "RULE_REGISTRY",
+    "Rule",
+    "baseline_entries",
+    "default_rules",
+    "diff_baseline",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "register",
+    "save_baseline",
+]

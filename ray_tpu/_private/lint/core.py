@@ -1,0 +1,597 @@
+"""graftlint core: AST-based static analysis for ray_tpu's serving hot path.
+
+The serving PRs defend a handful of repo invariants (one host pull per decode
+dispatch, guarded tracer spans, zero steady-state retraces, metric naming
+conventions).  graftlint turns those invariants into machine-checked rules:
+
+* a :class:`Rule` registry (``@register`` decorator, one module per rule),
+* per-line suppression comments::
+
+      something_deliberate()  # graftlint: disable=host-sync -- reason why
+
+* a checked-in baseline (``baseline.json``) keyed by ``(rule, path, symbol)``
+  so deliberate keeps survive line drift without re-triggering CI,
+* text / JSON reporters shared by ``tools/graft_lint.py`` and the tier-1
+  pytest gate (``tests/test_graft_lint.py::test_tree_is_clean``).
+
+Rules receive a :class:`FileContext` (source, AST, parent links, suppression
+table) and return :class:`Finding` objects; the runner marks findings landing
+on a suppressed line and the reporters split open vs. suppressed.
+
+See ``docs/lint.md`` for the rule catalogue and how to add a rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import io
+import json
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# Repo root (ray_tpu/_private/lint/core.py -> three parents up).
+_REPO_ROOT = Path(__file__).resolve().parents[3]
+
+_SUPPRESS_RE = re.compile(
+    r"graftlint:\s*disable=([A-Za-z0-9_,\- ]+?)\s*(?:--\s*(?P<reason>.*))?$"
+)
+
+_METRIC_NAME_RE = re.compile(r"^(llm_|serve_llm_)[a-z0-9_]+$")
+_GLOSSARY_TOKEN_RE = re.compile(r"`((?:llm_|serve_llm_)[a-z0-9_*]+)`")
+
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a specific source location.
+
+    ``symbol`` is the dotted enclosing scope (``Class.method`` or function
+    name, ``<module>`` at top level); the baseline keys on
+    ``(rule, path, symbol)`` so entries survive unrelated line drift.
+    """
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    symbol: str = "<module>"
+    suppressed: bool = False
+    reason: str = ""
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "symbol": self.symbol,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "reason": self.reason,
+        }
+
+    def format(self) -> str:
+        tag = " [suppressed]" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}{tag}"
+
+
+# ---------------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Knobs shared by all rules.
+
+    ``force_hot`` treats every scanned file as hot-path (used by the synthetic
+    fixture tests, which lint in-memory snippets with throwaway names).
+    """
+
+    hot_path_files: frozenset = frozenset(
+        {"engine.py", "fleet.py", "generate.py", "speculative.py", "block_pool.py"}
+    )
+    host_sync_allowed_functions: frozenset = frozenset({"_device_get", "_emit_block"})
+    metric_prefixes: Tuple[str, ...] = (
+        "llm_engine_",
+        "llm_fleet_",
+        "llm_spec_",
+        "serve_llm_",
+    )
+    glossary_path: Optional[Path] = None
+    glossary: Optional[frozenset] = None
+    force_hot: bool = False
+
+    def is_hot_path(self, path: Path) -> bool:
+        return self.force_hot or path.name in self.hot_path_files
+
+    def metric_glossary(self) -> frozenset:
+        if self.glossary is None:
+            doc = self.glossary_path or (_REPO_ROOT / "docs" / "serving.md")
+            entries: Set[str] = set()
+            try:
+                text = doc.read_text()
+            except OSError:
+                text = ""
+            for match in _GLOSSARY_TOKEN_RE.finditer(text):
+                entries.add(match.group(1))
+            self.glossary = frozenset(entries)
+        return self.glossary
+
+    def glossary_has(self, name: str) -> bool:
+        glossary = self.metric_glossary()
+        if name in glossary:
+            return True
+        for entry in glossary:
+            if "*" in entry and fnmatch.fnmatchcase(name, entry):
+                return True
+        return False
+
+    def glossary_has_prefix(self, head: str) -> bool:
+        """True if any glossary entry could complete a dynamic name ``head + ...``."""
+        glossary = self.metric_glossary()
+        for entry in glossary:
+            if entry.startswith(head):
+                return True
+            if "*" in entry and fnmatch.fnmatchcase(head + "x", entry):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+
+RULE_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator adding a Rule subclass to the global registry."""
+    if not getattr(cls, "name", ""):
+        raise ValueError(f"rule class {cls.__name__} has no name")
+    RULE_REGISTRY[cls.name] = cls
+    return cls
+
+
+class Rule:
+    """Base class for analyzers.  Subclasses set ``name``/``description`` and
+    implement :meth:`check` returning findings for one file."""
+
+    name = ""
+    description = ""
+
+    def check(self, ctx: "FileContext") -> List[Finding]:
+        raise NotImplementedError
+
+
+def default_rules(names: Optional[Sequence[str]] = None) -> List[Rule]:
+    """Instantiate registered rules (all four analyzers import-registered)."""
+    # Import for side effect: each module registers its rule class.
+    from ray_tpu._private.lint import (  # noqa: F401
+        rules_host_sync,
+        rules_jit_hygiene,
+        rules_metrics_name,
+        rules_trace_guard,
+    )
+
+    if names:
+        unknown = [n for n in names if n not in RULE_REGISTRY]
+        if unknown:
+            raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
+        selected = [RULE_REGISTRY[n] for n in names]
+    else:
+        selected = [RULE_REGISTRY[n] for n in sorted(RULE_REGISTRY)]
+    return [cls() for cls in selected]
+
+
+# ---------------------------------------------------------------------------
+# per-file context
+# ---------------------------------------------------------------------------
+
+
+class FileContext:
+    """Parsed source plus the derived tables every rule needs: parent links,
+    enclosing-scope lookup, and the per-line suppression map."""
+
+    def __init__(self, path: Path, source: str, config: LintConfig):
+        self.path = path
+        self.rel = _relpath(path)
+        self.source = source
+        self.config = config
+        self.tree = ast.parse(source, filename=str(path))
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        # line -> (set of rule names or {"*"}, reason)
+        self.suppressions: Dict[int, Tuple[Set[str], str]] = _parse_suppressions(source)
+
+    def symbol_at(self, node: ast.AST) -> str:
+        names: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                names.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(names)) if names else "<module>"
+
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> Optional[ast.FunctionDef]:
+        cur: Optional[ast.AST] = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def finding(
+        self, rule: str, node: ast.AST, message: str
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(
+            rule=rule,
+            path=self.rel,
+            line=line,
+            col=col,
+            message=message,
+            symbol=self.symbol_at(node),
+        )
+
+
+def _relpath(path: Path) -> str:
+    try:
+        return path.resolve().relative_to(_REPO_ROOT).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def _parse_suppressions(source: str) -> Dict[int, Tuple[Set[str], str]]:
+    """Map physical line -> (suppressed rule names, reason).
+
+    Uses the tokenizer so string literals containing ``graftlint:`` are never
+    mistaken for directives.  ``disable=all`` (or ``*``) suppresses every rule
+    on that line.
+    """
+    table: Dict[int, Tuple[Set[str], str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            match = _SUPPRESS_RE.search(tok.string)
+            if not match:
+                continue
+            rules = {r.strip() for r in match.group(1).split(",") if r.strip()}
+            if "all" in rules or "*" in rules:
+                rules = {"*"}
+            reason = (match.group("reason") or "").strip()
+            table[tok.start[0]] = (rules, reason)
+    except tokenize.TokenError:
+        pass
+    return table
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (used by several rules)
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``jax.jit`` -> "jax.jit"; "" when the expression is not a pure dotted
+    name (calls, subscripts, ...)."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def root_name(node: ast.AST) -> str:
+    """Leftmost Name of an attribute/subscript chain (``self.cache[i]`` -> "self")."""
+    cur = node
+    while isinstance(cur, (ast.Attribute, ast.Subscript)):
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        return cur.id
+    return ""
+
+
+def expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+@dataclasses.dataclass
+class JitInfo:
+    """Signature facts for one module-level jitted function."""
+
+    name: str
+    lineno: int
+    params: List[str]
+    static_names: Set[str]
+    donate_names: Set[str]
+    donate_positions: Set[int]
+
+
+def _str_elements(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+    return out
+
+
+def _int_elements(node: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+    return out
+
+
+def _jit_kwargs(call: ast.Call) -> Tuple[Set[str], Set[str], Set[int]]:
+    static: Set[str] = set()
+    donate_names: Set[str] = set()
+    donate_pos: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            static |= _str_elements(kw.value)
+        elif kw.arg == "donate_argnames":
+            donate_names |= _str_elements(kw.value)
+        elif kw.arg == "donate_argnums":
+            donate_pos |= _int_elements(kw.value)
+        elif kw.arg == "static_argnums":
+            # positional statics are resolved against params by the caller
+            donate_pos  # no-op; kept explicit for symmetry
+    return static, donate_names, donate_pos
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    """True for ``jax.jit(...)`` and ``functools.partial(jax.jit, ...)``."""
+    fn = dotted_name(call.func)
+    if fn in ("jax.jit", "jit"):
+        return True
+    if fn in ("functools.partial", "partial") and call.args:
+        return dotted_name(call.args[0]) in ("jax.jit", "jit")
+    return False
+
+
+def collect_jitted(tree: ast.Module) -> Dict[str, JitInfo]:
+    """Module-level jitted functions: decorated defs and ``f = jax.jit(g, ...)``
+    style assignments.  Returns name -> JitInfo."""
+    infos: Dict[str, JitInfo] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if isinstance(deco, ast.Call) and _is_jit_call(deco):
+                    static, dnames, dpos = _jit_kwargs(deco)
+                elif dotted_name(deco) in ("jax.jit", "jit"):
+                    static, dnames, dpos = set(), set(), set()
+                else:
+                    continue
+                params = [a.arg for a in node.args.args]
+                infos[node.name] = JitInfo(
+                    name=node.name,
+                    lineno=node.lineno,
+                    params=params,
+                    static_names=static,
+                    donate_names=dnames,
+                    donate_positions=dpos,
+                )
+                break
+        elif isinstance(node, ast.Assign):
+            value = node.value
+            if isinstance(value, ast.Call) and _is_jit_call(value):
+                static, dnames, dpos = _jit_kwargs(value)
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        infos[target.id] = JitInfo(
+                            name=target.id,
+                            lineno=node.lineno,
+                            params=[],
+                            static_names=static,
+                            donate_names=dnames,
+                            donate_positions=dpos,
+                        )
+    return infos
+
+
+# ---------------------------------------------------------------------------
+# runner + report
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: List[Finding]
+    files_scanned: int
+    errors: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def open(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "files_scanned": self.files_scanned,
+            "open_count": len(self.open),
+            "suppressed_count": len(self.suppressed),
+            "errors": list(self.errors),
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+    def format_text(self, show_suppressed: bool = False) -> str:
+        lines: List[str] = []
+        for f in sorted(self.findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+            if f.suppressed and not show_suppressed:
+                continue
+            lines.append(f.format())
+        for err in self.errors:
+            lines.append(f"error: {err}")
+        lines.append(
+            f"{self.files_scanned} file(s) scanned, {len(self.open)} open finding(s), "
+            f"{len(self.suppressed)} suppressed"
+        )
+        return "\n".join(lines)
+
+
+def _apply_suppressions(ctx: FileContext, findings: List[Finding]) -> List[Finding]:
+    out: List[Finding] = []
+    for f in findings:
+        entry = ctx.suppressions.get(f.line)
+        if entry is not None and ("*" in entry[0] or f.rule in entry[0]):
+            f = dataclasses.replace(f, suppressed=True, reason=entry[1])
+        out.append(f)
+    return out
+
+
+def lint_source(
+    source: str,
+    path: str = "<memory>.py",
+    config: Optional[LintConfig] = None,
+    rules: Optional[Sequence[Rule]] = None,
+) -> List[Finding]:
+    """Lint an in-memory snippet (the fixture-test entry point)."""
+    config = config or LintConfig()
+    rules = list(rules) if rules is not None else default_rules()
+    ctx = FileContext(Path(path), source, config)
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+    return _apply_suppressions(ctx, findings)
+
+
+def iter_python_files(paths: Sequence[Path]) -> List[Path]:
+    files: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    return files
+
+
+def lint_paths(
+    paths: Sequence[Path],
+    config: Optional[LintConfig] = None,
+    rules: Optional[Sequence[Rule]] = None,
+) -> LintReport:
+    config = config or LintConfig()
+    rules = list(rules) if rules is not None else default_rules()
+    findings: List[Finding] = []
+    errors: List[str] = []
+    files = iter_python_files(paths)
+    for path in files:
+        try:
+            source = path.read_text()
+            ctx = FileContext(path, source, config)
+        except (OSError, SyntaxError, ValueError) as exc:
+            errors.append(f"{path}: {exc}")
+            continue
+        file_findings: List[Finding] = []
+        for rule in rules:
+            file_findings.extend(rule.check(ctx))
+        findings.extend(_apply_suppressions(ctx, file_findings))
+    return LintReport(findings=findings, files_scanned=len(files), errors=errors)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def baseline_entries(report: LintReport) -> List[Dict[str, object]]:
+    """Aggregate *suppressed* findings into stable baseline entries."""
+    counts: Dict[Tuple[str, str, str], Dict[str, object]] = {}
+    for f in report.suppressed:
+        entry = counts.setdefault(
+            f.key(),
+            {"rule": f.rule, "path": f.path, "symbol": f.symbol, "count": 0, "reason": f.reason},
+        )
+        entry["count"] = int(entry["count"]) + 1
+        if f.reason and not entry["reason"]:
+            entry["reason"] = f.reason
+    return sorted(
+        counts.values(), key=lambda e: (str(e["path"]), str(e["rule"]), str(e["symbol"]))
+    )
+
+
+def load_baseline(path: Path = DEFAULT_BASELINE) -> List[Dict[str, object]]:
+    try:
+        data = json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return []
+    return list(data.get("suppressions", []))
+
+
+def save_baseline(report: LintReport, path: Path = DEFAULT_BASELINE) -> None:
+    payload = {
+        "comment": "graftlint baseline: deliberate, inline-suppressed findings. "
+        "Regenerate with tools/graft_lint.py --update-baseline.",
+        "suppressions": baseline_entries(report),
+    }
+    Path(path).write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+
+
+def diff_baseline(
+    report: LintReport, baseline: List[Dict[str, object]]
+) -> List[str]:
+    """Human-readable drift between current suppressions and the baseline."""
+    current = {
+        (str(e["rule"]), str(e["path"]), str(e["symbol"])): int(e["count"])
+        for e in baseline_entries(report)
+    }
+    recorded = {
+        (str(e["rule"]), str(e["path"]), str(e["symbol"])): int(e.get("count", 0))
+        for e in baseline
+    }
+    msgs: List[str] = []
+    for key in sorted(set(current) | set(recorded)):
+        cur, rec = current.get(key, 0), recorded.get(key, 0)
+        if cur != rec:
+            rule, path, symbol = key
+            msgs.append(
+                f"baseline drift: {rule} in {path}:{symbol} "
+                f"(baseline {rec}, tree {cur}) -- run tools/graft_lint.py --update-baseline"
+            )
+    return msgs

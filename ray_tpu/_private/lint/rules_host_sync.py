@@ -1,0 +1,294 @@
+"""host-sync: implicit device->host transfers in hot-path modules.
+
+The serving invariant since PR 5 is *one* blocking host pull per decode
+dispatch, routed through the ``_device_get`` choke point so the engine can
+account bytes and the runtime sanitizer can mark the pull expected.  Anything
+else that forces a sync on the hot path — ``.item()``, ``float()/int()/bool()``
+on a jax value, ``np.asarray`` on a device array, truthiness branching on an
+array — stalls the dispatch ring and silently serialises the pipeline.
+
+Detection is a per-function intra-procedural taint pass: values are "device"
+tainted when they come from a ``jnp.*``/``jax.*`` expression or from a call to
+a module-level jitted function, and taint propagates through assignments,
+tuple unpacking, arithmetic, subscripts and method calls.  Sync-forcing
+operations on tainted values are findings.  Functions on the whitelist
+(``_device_get``, ``_emit_block``) are the sanctioned choke points and are
+skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ray_tpu._private.lint.core import (
+    FileContext,
+    Finding,
+    Rule,
+    collect_jitted,
+    register,
+    root_name,
+)
+
+_SYNC_BUILTINS = {"float", "int", "bool"}
+_SYNC_METHODS = {"item", "tolist"}
+_NP_SYNC_FUNCS = {
+    "np.asarray",
+    "np.array",
+    "np.ascontiguousarray",
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.ascontiguousarray",
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class _Taint:
+    """Tracks which local names hold device values inside one function."""
+
+    def __init__(self, jitted: Set[str]):
+        self.jitted = jitted
+        self.names: Set[str] = set()
+
+    def expr(self, node: ast.AST) -> bool:
+        """Is this expression device-tainted?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            # array metadata lives on the host; reading it never syncs
+            if node.attr in ("shape", "ndim", "dtype", "size", "nbytes",
+                            "sharding", "device", "itemsize"):
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            fn = _dotted(node.func)
+            root = fn.split(".", 1)[0] if fn else ""
+            if root in ("jnp", "jax", "lax"):
+                return True
+            if fn in self.jitted:
+                return True
+            # method call on a tainted receiver (x.astype(...), x.reshape(...))
+            if isinstance(node.func, ast.Attribute) and self.expr(node.func.value):
+                return True
+            return False
+        if isinstance(node, ast.BinOp):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.Compare):
+            return self.expr(node.left) or any(self.expr(c) for c in node.comparators)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self.expr(node.body) or self.expr(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        return False
+
+    def assign(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.names.add(target.id)
+            else:
+                self.names.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self.assign(elt, tainted)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, tainted)
+        # Attribute / Subscript targets (self.cache = ...) are not tracked:
+        # attribute taint would need whole-object analysis and the hot-path
+        # rules below only fire on locally provable device values.
+
+
+@register
+class HostSyncRule(Rule):
+    name = "host-sync"
+    description = (
+        "implicit device->host sync on a hot-path module outside the "
+        "_device_get/_emit_block choke points"
+    )
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        if not ctx.config.is_hot_path(ctx.path):
+            return []
+        jitted = set(collect_jitted(ctx.tree))
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in ctx.config.host_sync_allowed_functions:
+                continue
+            findings.extend(self._check_function(ctx, node, jitted))
+        return findings
+
+    def _check_function(
+        self, ctx: FileContext, fn: ast.FunctionDef, jitted: Set[str]
+    ) -> List[Finding]:
+        taint = _Taint(jitted)
+        findings: Dict[tuple, Finding] = {}
+        # Two passes: the first only builds taint (so loop-carried values seen
+        # late in the body taint their uses earlier in the next iteration),
+        # the second reports.
+        for report in (False, True):
+            self._walk_body(ctx, fn.body, taint, findings if report else None)
+        return list(findings.values())
+
+    # -- statement walk (source order so taint respects def-before-use) -----
+
+    def _walk_body(self, ctx, body, taint, findings) -> None:
+        for stmt in body:
+            self._walk_stmt(ctx, stmt, taint, findings)
+
+    def _walk_stmt(self, ctx, stmt, taint, findings) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested scopes are visited independently
+        if isinstance(stmt, ast.Assign):
+            self._scan_expr(ctx, stmt.value, taint, findings)
+            tainted = taint.expr(stmt.value)
+            for target in stmt.targets:
+                taint.assign(target, tainted)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._scan_expr(ctx, stmt.value, taint, findings)
+            taint.assign(stmt.target, taint.expr(stmt.value))
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._scan_expr(ctx, stmt.value, taint, findings)
+            if taint.expr(stmt.value):
+                taint.assign(stmt.target, True)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._check_truthiness(ctx, stmt.test, taint, findings)
+            self._scan_expr(ctx, stmt.test, taint, findings)
+            self._walk_body(ctx, stmt.body, taint, findings)
+            self._walk_body(ctx, stmt.orelse, taint, findings)
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_expr(ctx, stmt.iter, taint, findings)
+            taint.assign(stmt.target, taint.expr(stmt.iter))
+            self._walk_body(ctx, stmt.body, taint, findings)
+            self._walk_body(ctx, stmt.orelse, taint, findings)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._scan_expr(ctx, item.context_expr, taint, findings)
+            self._walk_body(ctx, stmt.body, taint, findings)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_body(ctx, stmt.body, taint, findings)
+            for handler in stmt.handlers:
+                self._walk_body(ctx, handler.body, taint, findings)
+            self._walk_body(ctx, stmt.orelse, taint, findings)
+            self._walk_body(ctx, stmt.finalbody, taint, findings)
+            return
+        if isinstance(stmt, ast.Assert):
+            self._check_truthiness(ctx, stmt.test, taint, findings)
+            self._scan_expr(ctx, stmt.test, taint, findings)
+            return
+        # Return / Expr / Raise / Delete / Global / ... : scan expressions.
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_expr(ctx, child, taint, findings)
+
+    # -- expression scan ----------------------------------------------------
+
+    def _scan_expr(self, ctx, expr, taint, findings) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._check_call(ctx, node, taint, findings)
+            elif isinstance(node, ast.IfExp):
+                self._check_truthiness(ctx, node.test, taint, findings)
+
+    def _check_call(self, ctx, call: ast.Call, taint, findings) -> None:
+        fn = _dotted(call.func)
+        if fn in ("jax.device_get", "jax.block_until_ready"):
+            self._emit(
+                ctx,
+                call,
+                findings,
+                f"`{fn}` blocks on a device->host transfer on the hot path; "
+                "route the pull through _device_get",
+            )
+            return
+        if fn in _NP_SYNC_FUNCS and call.args and taint.expr(call.args[0]):
+            self._emit(
+                ctx,
+                call,
+                findings,
+                f"`{fn}` on a device value forces an implicit device->host "
+                "transfer; route the pull through _device_get",
+            )
+            return
+        if (
+            isinstance(call.func, ast.Name)
+            and call.func.id in _SYNC_BUILTINS
+            and len(call.args) == 1
+            and taint.expr(call.args[0])
+        ):
+            self._emit(
+                ctx,
+                call,
+                findings,
+                f"`{call.func.id}()` on a device value forces a blocking host "
+                "sync; pull via _device_get first",
+            )
+            return
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in _SYNC_METHODS
+            and taint.expr(call.func.value)
+        ):
+            self._emit(
+                ctx,
+                call,
+                findings,
+                f"`.{call.func.attr}()` on a device value forces a blocking "
+                "host sync; pull via _device_get first",
+            )
+
+    def _check_truthiness(self, ctx, test, taint, findings) -> None:
+        # `if device_array:` / `while not mask:` — __bool__ on a jax array is
+        # a hidden sync (and a ConcretizationError under jit).
+        candidates = [test]
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            candidates.append(test.operand)
+        if isinstance(test, ast.BoolOp):
+            candidates.extend(test.values)
+        for cand in candidates:
+            if isinstance(cand, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in cand.ops
+            ):
+                continue  # `x is None` never syncs, tainted or not
+            if isinstance(cand, (ast.Name, ast.Attribute, ast.Subscript, ast.BinOp, ast.Compare, ast.Call)):
+                if taint.expr(cand):
+                    self._emit(
+                        ctx,
+                        test,
+                        findings,
+                        "truthiness of a device value in a branch condition "
+                        "forces a hidden host sync; compare on a host copy",
+                    )
+                    return
+
+    def _emit(self, ctx, node, findings, message: str) -> None:
+        if findings is None:
+            return  # taint-building pass
+        key = (node.lineno, node.col_offset, message)
+        if key not in findings:
+            findings[key] = ctx.finding(self.name, node, message)

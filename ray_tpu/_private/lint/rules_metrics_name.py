@@ -1,0 +1,168 @@
+"""metrics-name: naming conventions + glossary coverage for serving metrics.
+
+Every metric emitted through ``ray_tpu.util.metrics`` follows the serving
+naming conventions (``llm_engine_*``, ``llm_fleet_*``, ``llm_spec_*``,
+``serve_llm_*``) and must appear in the docs/serving.md glossary (exact name
+or a documented wildcard like ``llm_engine_kv_*``) so dashboards never chase
+undocumented names.
+
+The rule scans string literals whose *entire* value is shaped like a metric
+name (``^(llm_|serve_llm_)[a-z0-9_]+$``) wherever they appear — constructor
+args, dict keys, one-hop ``name = "..."`` locals — plus f-strings whose
+leading literal matches the prefix (``f"llm_fleet_{field}"``; validated
+against glossary entries that can complete the dynamic tail).  Docstrings are
+exempt.  Strings that merely *look* like metric names but are not
+(deployment ids etc.) carry an inline suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ray_tpu._private.lint.core import (
+    _METRIC_NAME_RE,
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+
+def _is_docstring(ctx: FileContext, node: ast.Constant) -> bool:
+    parent = ctx.parents.get(node)
+    if not isinstance(parent, ast.Expr):
+        return False
+    grand = ctx.parents.get(parent)
+    return isinstance(
+        grand, (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    )
+
+
+def _in_dunder_all(ctx: FileContext, node: ast.Constant) -> bool:
+    """Strings inside ``__all__ = [...]`` are identifiers, not metrics."""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.Assign):
+            return any(
+                isinstance(t, ast.Name) and t.id == "__all__" for t in anc.targets
+            )
+    return False
+
+
+def _is_prefix_context(ctx: FileContext, node: ast.Constant) -> bool:
+    """True when the literal is a metric-name *head*: the value of a
+    ``prefix=`` keyword or the default of a parameter named ``prefix``
+    (``report_engine_stats(stats, prefix="serve_llm_fleet")``)."""
+    parent = ctx.parents.get(node)
+    if isinstance(parent, ast.keyword) and parent.arg == "prefix":
+        return True
+    if isinstance(parent, ast.arguments):
+        defaults = parent.defaults
+        if node in defaults:
+            pos_args = parent.args[-len(defaults):] if defaults else []
+            idx = defaults.index(node)
+            if idx < len(pos_args) and pos_args[idx].arg == "prefix":
+                return True
+        for arg, default in zip(parent.kwonlyargs, parent.kw_defaults):
+            if default is node and arg.arg == "prefix":
+                return True
+    return False
+
+
+@register
+class MetricsNameRule(Rule):
+    name = "metrics-name"
+    description = (
+        "metric names must follow llm_engine_*/llm_fleet_*/llm_spec_*/"
+        "serve_llm_* conventions and appear in the docs/serving.md glossary"
+    )
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        prefixes = ctx.config.metric_prefixes
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                value = node.value
+                if not _METRIC_NAME_RE.match(value):
+                    continue
+                if _is_docstring(ctx, node) or _in_dunder_all(ctx, node):
+                    continue
+                if isinstance(ctx.parents.get(node), ast.JoinedStr):
+                    continue  # f-string heads are handled below
+                if _is_prefix_context(ctx, node):
+                    head = value if value.endswith("_") else value + "_"
+                    if not head.startswith(prefixes):
+                        findings.append(
+                            ctx.finding(
+                                self.name,
+                                node,
+                                f'metric prefix "{value}" does not use a '
+                                f"convention prefix ({', '.join(prefixes)})",
+                            )
+                        )
+                    elif not ctx.config.glossary_has_prefix(head):
+                        findings.append(
+                            ctx.finding(
+                                self.name,
+                                node,
+                                f'metric prefix "{value}" has no glossary entry '
+                                "starting with that head; document the family "
+                                f'(e.g. a "{head}*" wildcard)',
+                            )
+                        )
+                    continue
+                if not value.startswith(prefixes):
+                    findings.append(
+                        ctx.finding(
+                            self.name,
+                            node,
+                            f'metric-shaped name "{value}" does not use a '
+                            f"convention prefix ({', '.join(prefixes)})",
+                        )
+                    )
+                elif not ctx.config.glossary_has(value):
+                    findings.append(
+                        ctx.finding(
+                            self.name,
+                            node,
+                            f'metric "{value}" is not in the docs/serving.md '
+                            "glossary; document it (or a covering wildcard)",
+                        )
+                    )
+            elif isinstance(node, ast.JoinedStr):
+                findings.extend(self._check_fstring(ctx, node, prefixes))
+        return findings
+
+    def _check_fstring(
+        self, ctx: FileContext, node: ast.JoinedStr, prefixes
+    ) -> List[Finding]:
+        if not node.values:
+            return []
+        head = node.values[0]
+        if not (isinstance(head, ast.Constant) and isinstance(head.value, str)):
+            return []
+        text = head.value
+        if not (text.startswith("llm_") or text.startswith("serve_llm_")):
+            return []
+        if not _METRIC_NAME_RE.match(text):
+            return []
+        if not text.startswith(prefixes):
+            return [
+                ctx.finding(
+                    self.name,
+                    node,
+                    f'dynamic metric name head "{text}..." does not use a '
+                    f"convention prefix ({', '.join(prefixes)})",
+                )
+            ]
+        if not ctx.config.glossary_has_prefix(text):
+            return [
+                ctx.finding(
+                    self.name,
+                    node,
+                    f'dynamic metric name "{text}{{...}}" has no glossary '
+                    "entry starting with that head; add one (wildcards like "
+                    f'"{text}*" count)',
+                )
+            ]
+        return []

@@ -1,0 +1,311 @@
+"""Runtime sanitizer: steady-state retrace + device->host transfer gating.
+
+The static half of graftlint (``ray_tpu/_private/lint``) catches hot-path
+hazards at review time; this module catches them at *run* time.  With
+``RAY_TPU_SANITIZE=1`` (or an explicit ``DecodeEngine(sanitize=True)``) the
+engine builds a :class:`Sanitizer`, runs its normal warmup, then **arms**:
+
+* **retrace counter** — the compile-cache size (``_cache_size()``) of every
+  watched jitted entry point is snapshotted at arm time;
+  :meth:`Sanitizer.retraces` reports any growth.  The steady decode path
+  must stay at zero (the ``jit-hygiene`` lint's runtime twin).
+
+* **transfer interposition** — the sync-forcing dunders of
+  ``jax._src.array.ArrayImpl`` (``__array__``/``__bool__``/``__float__``/
+  ``__int__``/``__index__``/``item``/``tolist``) are wrapped while armed.
+  Any device->host pull *not* routed through the engine's ``_device_get``
+  choke point (which calls :meth:`Sanitizer.expected_get`) raises
+  :class:`SanitizerError` (strict mode, the default) or is tallied in
+  ``unexpected_transfers``.  This works on every backend — including the
+  CPU backend used by tier-1 tests, where ``jax.transfer_guard`` is a
+  no-op because host-resident arrays never physically transfer.  One CPU
+  nuance: ``np.asarray`` on a CPU-backend array uses the C buffer
+  protocol (a zero-copy host view), so it bypasses ``__array__`` and is
+  caught by the *static* host-sync lint instead; on accelerator backends
+  it routes through ``__array__``/transfer-guard and is caught here too.
+
+* **transfer guard** — ``jax_transfer_guard_device_to_host`` is additionally
+  set to ``"disallow"`` while armed (belt and braces for real TPU/GPU
+  backends); expected pulls run inside an ``"allow"`` scope.
+
+Environment knobs (read by :func:`resolve`):
+
+* ``RAY_TPU_SANITIZE=1``      — build a sanitizer when the engine doesn't pass one
+* ``RAY_TPU_SANITIZE_STRICT=0`` — count unexpected transfers instead of raising
+* ``RAY_TPU_SANITIZE_WARMUP=N`` — auto-arm after N engine steps (default 8)
+
+Only one sanitizer may be armed at a time (the interposition is
+process-global).  The off path costs one module-global ``is None`` check in
+``_device_get`` — nothing else.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+ENV_SANITIZE = "RAY_TPU_SANITIZE"
+ENV_STRICT = "RAY_TPU_SANITIZE_STRICT"
+ENV_WARMUP = "RAY_TPU_SANITIZE_WARMUP"
+
+DEFAULT_WARMUP_STEPS = 8
+
+_PATCHED_ATTRS = (
+    "__array__",
+    "__bool__",
+    "__float__",
+    "__int__",
+    "__index__",
+    "item",
+    "tolist",
+)
+
+# The process-global armed sanitizer (None = sanitizing off; the engine's
+# _device_get does exactly one read of this via active()).
+_ACTIVE: Optional["Sanitizer"] = None
+
+
+class SanitizerError(RuntimeError):
+    """An unexpected device->host transfer while the sanitizer was armed."""
+
+
+def active() -> Optional["Sanitizer"]:
+    return _ACTIVE
+
+
+def _env_true(name: str, default: str = "0") -> bool:
+    return os.environ.get(name, default).strip().lower() not in ("", "0", "false", "no")
+
+
+def warmup_steps() -> int:
+    try:
+        return max(0, int(os.environ.get(ENV_WARMUP, DEFAULT_WARMUP_STEPS)))
+    except ValueError:
+        return DEFAULT_WARMUP_STEPS
+
+
+def resolve(spec) -> Optional["Sanitizer"]:
+    """Engine-facing constructor mirroring ``engine_trace.resolve_tracer``:
+
+    * ``Sanitizer`` instance — used as-is
+    * truthy (``True``/``1``/``"strict"``) — fresh strict sanitizer
+    * ``None`` — consult ``RAY_TPU_SANITIZE`` (off unless set)
+    * falsy — off
+    """
+    if isinstance(spec, Sanitizer):
+        return spec
+    if spec is None:
+        if not _env_true(ENV_SANITIZE):
+            return None
+        return Sanitizer(strict=_env_true(ENV_STRICT, default="1"))
+    if spec:
+        return Sanitizer()
+    return None
+
+
+class Sanitizer:
+    """Retrace counter + transfer interposition for one engine's hot loop."""
+
+    def __init__(self, *, strict: bool = True, label: str = ""):
+        self.strict = strict
+        self.label = label
+        self.armed = False
+        self.expected_pulls = 0
+        self.expected_async = 0
+        self.unexpected_transfers: List[str] = []
+        self._watched: Dict[str, Callable] = {}
+        self._baseline: Dict[str, int] = {}
+        self._in_expected = 0
+        self._saved_attrs: Dict[str, Callable] = {}
+        self._saved_guard = None
+        self._guard_armed = False
+
+    # -- watch list ---------------------------------------------------------
+
+    def watch(self, name: str, fn) -> None:
+        """Register a jitted callable for retrace accounting (idempotent;
+        silently skips objects without a compile cache)."""
+        if fn is None or not hasattr(fn, "_cache_size"):
+            return
+        self._watched[name] = fn
+
+    # -- arm / disarm -------------------------------------------------------
+
+    def arm(self) -> None:
+        global _ACTIVE
+        if self.armed:
+            return
+        if _ACTIVE is not None:
+            raise RuntimeError(
+                "another Sanitizer is already armed (the transfer "
+                "interposition is process-global); disarm it first"
+            )
+        self._baseline = {
+            name: fn._cache_size() for name, fn in self._watched.items()
+        }
+        self._patch_array_impl()
+        self._arm_transfer_guard()
+        self.armed = True
+        _ACTIVE = self
+
+    def disarm(self) -> None:
+        global _ACTIVE
+        if not self.armed:
+            return
+        self._unpatch_array_impl()
+        self._disarm_transfer_guard()
+        self.armed = False
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+    def __enter__(self) -> "Sanitizer":
+        self.arm()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+    # -- the expected choke point ------------------------------------------
+
+    def expected_get(self, x) -> np.ndarray:
+        """The sanctioned blocking pull (engine ``_device_get`` routes here)."""
+        self._in_expected += 1
+        try:
+            with self._allow_guard():
+                out = np.asarray(x)
+        finally:
+            self._in_expected -= 1
+        self.expected_pulls += 1
+        return out
+
+    def expected_copy_async(self, x) -> None:
+        """The sanctioned async host copy (engine dispatch ring)."""
+        self._in_expected += 1
+        try:
+            with self._allow_guard():
+                try:
+                    x.copy_to_host_async()
+                except AttributeError:
+                    pass
+        finally:
+            self._in_expected -= 1
+        self.expected_async += 1
+
+    # -- accounting ---------------------------------------------------------
+
+    def retraces(self) -> Dict[str, int]:
+        """Watched functions whose compile cache grew since arm()."""
+        out: Dict[str, int] = {}
+        for name, fn in self._watched.items():
+            base = self._baseline.get(name)
+            if base is None:
+                continue
+            delta = fn._cache_size() - base
+            if delta:
+                out[name] = delta
+        return out
+
+    def total_retraces(self) -> int:
+        return sum(self.retraces().values())
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "armed": self.armed,
+            "strict": self.strict,
+            "expected_pulls": self.expected_pulls,
+            "expected_async": self.expected_async,
+            "unexpected_transfers": len(self.unexpected_transfers),
+            "retraces": self.retraces(),
+            "watched": sorted(self._watched),
+        }
+
+    # -- interposition ------------------------------------------------------
+
+    def _on_transfer(self, kind: str) -> None:
+        if self._in_expected:
+            return
+        msg = (
+            f"unexpected device->host transfer via ArrayImpl.{kind} while the "
+            f"sanitizer was armed{(' (' + self.label + ')') if self.label else ''}; "
+            "hot-path pulls must route through _device_get"
+        )
+        self.unexpected_transfers.append(msg)
+        if self.strict:
+            raise SanitizerError(msg)
+
+    def _patch_array_impl(self) -> None:
+        cls = _array_impl_class()
+        if cls is None:
+            return
+        for attr in _PATCHED_ATTRS:
+            orig = getattr(cls, attr, None)
+            if orig is None:
+                continue
+            self._saved_attrs[attr] = orig
+
+            def _make(orig=orig, attr=attr):
+                def _guarded(arr, *args, **kwargs):
+                    san = _ACTIVE
+                    if san is not None:
+                        san._on_transfer(attr)
+                    return orig(arr, *args, **kwargs)
+
+                return _guarded
+
+            setattr(cls, attr, _make())
+
+    def _unpatch_array_impl(self) -> None:
+        cls = _array_impl_class()
+        if cls is None:
+            return
+        for attr, orig in self._saved_attrs.items():
+            setattr(cls, attr, orig)
+        self._saved_attrs.clear()
+
+    # -- transfer guard (no-op on the CPU backend, real on TPU/GPU) ---------
+
+    def _arm_transfer_guard(self) -> None:
+        try:
+            import jax
+
+            self._saved_guard = jax.config.jax_transfer_guard_device_to_host
+            jax.config.update("jax_transfer_guard_device_to_host", "disallow")
+            self._guard_armed = True
+        except Exception:
+            self._guard_armed = False
+
+    def _disarm_transfer_guard(self) -> None:
+        if not self._guard_armed:
+            return
+        try:
+            import jax
+
+            jax.config.update(
+                "jax_transfer_guard_device_to_host", self._saved_guard
+            )
+        except Exception:
+            pass
+        self._guard_armed = False
+        self._saved_guard = None
+
+    def _allow_guard(self):
+        if not self._guard_armed:
+            return contextlib.nullcontext()
+        try:
+            import jax
+
+            return jax.transfer_guard_device_to_host("allow")
+        except Exception:
+            return contextlib.nullcontext()
+
+
+def _array_impl_class():
+    try:
+        from jax._src.array import ArrayImpl
+
+        return ArrayImpl
+    except Exception:
+        return None

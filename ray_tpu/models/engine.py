@@ -83,6 +83,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu._private import sanitize as _sanitize
 from ray_tpu.models.block_pool import BlockPool
 from ray_tpu.models.engine_metrics import EngineMetrics, NullEngineMetrics
 from ray_tpu.models.engine_trace import resolve_tracer
@@ -114,7 +115,7 @@ def _key_data(key) -> np.ndarray:
     try:
         return np.asarray(key, np.uint32).reshape(2)
     except (TypeError, ValueError):
-        return np.asarray(jax.random.key_data(key),
+        return np.asarray(jax.random.key_data(key),  # graftlint: disable=host-sync -- cold-path key normalisation at submit time (8 bytes, never on the decode loop)
                           np.uint32).reshape(2)
 
 
@@ -128,8 +129,28 @@ def _device_get(x) -> np.ndarray:
     pipeline the pull is usually a no-op wait: the block's
     `copy_to_host_async` was issued at dispatch, one or more fused
     steps earlier (tests/test_engine_pipeline.py gates that the next
-    dispatch is issued BEFORE this fetch)."""
+    dispatch is issued BEFORE this fetch). When a runtime sanitizer is
+    armed (RAY_TPU_SANITIZE=1 / DecodeEngine(sanitize=...)) the pull is
+    marked EXPECTED — any device->host sync outside this funnel trips
+    the sanitizer's ArrayImpl interposition."""
+    san = _sanitize.active()
+    if san is not None:
+        return san.expected_get(x)
     return np.asarray(x)
+
+
+def _host_async(x) -> None:
+    """Start the sanctioned async device->host copy for a dispatched token
+    block (pairs with the `_device_get` wait in `_drain_one`). Mirrors
+    `_device_get`'s sanitizer contract for the non-blocking half."""
+    san = _sanitize.active()
+    if san is not None:
+        san.expected_copy_async(x)
+        return
+    try:
+        x.copy_to_host_async()
+    except AttributeError:
+        pass                       # non-jax.Array backends (tests)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1179,6 +1200,7 @@ class DecodeEngine:
                  engine_id: Optional[str] = None,
                  enable_metrics: bool = True,
                  trace=None,
+                 sanitize=None,
                  clock: Callable[[], float] = time.monotonic):
         _check_sampling_knobs(greedy, top_k, top_p)
         if on_full not in ("reject", "block"):
@@ -1250,6 +1272,15 @@ class DecodeEngine:
                                        if enable_metrics else "engine")
         self.trace = resolve_tracer(trace, engine_id=self.engine_id,
                                     clock=clock)
+        # Runtime sanitizer (_private/sanitize.py): `sanitize=` takes a
+        # Sanitizer, True (build a strict one), False (force off), or
+        # None — defer to the RAY_TPU_SANITIZE env gate. When present it
+        # auto-arms after RAY_TPU_SANITIZE_WARMUP steps (compiles are
+        # expected during warmup); `arm_sanitizer()` arms it on demand.
+        # The off path costs one module-global read in `_device_get`.
+        self.sanitizer = _sanitize.resolve(sanitize)
+        self._san_steps = 0
+        self._san_warmup = _sanitize.warmup_steps()
 
         # Tensor parallelism over an ICI mesh: `tp=n` builds a
         # {"tp": n} mesh over the first n visible devices; `mesh=`
@@ -1761,6 +1792,41 @@ class DecodeEngine:
         return bool(len(self.scheduler)) or any(
             r is not None for r in self.row_req)
 
+    # The fused entry points whose compile caches the sanitizer audits:
+    # any growth after arm() is a steady-state retrace regression.
+    _SANITIZER_JIT_ENTRY_POINTS = (
+        "_prefill_rows", "_prefill_rows_paged", "_prefix_copy_in",
+        "_prefix_copy_out", "_decode_multi", "_decode_multi_paged",
+        "_spec_round", "_spec_round_paged", "_cow_blocks",
+        "_swap_out_gather", "_swap_in_scatter")
+
+    def arm_sanitizer(self):
+        """Snapshot the jit caches and arm the runtime sanitizer: from
+        this call on, any recompile of a fused entry point or any
+        device->host pull outside `_device_get`/`_host_async` is a
+        violation (raised in strict mode, tallied otherwise). Builds a
+        strict sanitizer on the fly if the engine was constructed
+        without one. Perf gates call this after warmup; under
+        RAY_TPU_SANITIZE=1 it fires automatically after
+        RAY_TPU_SANITIZE_WARMUP (default 8) steps."""
+        if self.sanitizer is None:
+            self.sanitizer = _sanitize.Sanitizer(label=self.engine_id)
+        for name in self._SANITIZER_JIT_ENTRY_POINTS:
+            self.sanitizer.watch(name, globals().get(name))
+        self.sanitizer.arm()
+        return self.sanitizer
+
+    def disarm_sanitizer(self) -> None:
+        """Restore the un-sanitized fast path (interposition off)."""
+        if self.sanitizer is not None:
+            self.sanitizer.disarm()
+
+    def sanitizer_stats(self) -> Dict[str, Any]:
+        """Snapshot of the sanitizer plane; {} when sanitizing is off."""
+        if self.sanitizer is None:
+            return {}
+        return self.sanitizer.stats()
+
     def step(self, horizon: Optional[int] = None) -> Dict[int, List[int]]:
         """Admit queued requests into free slots (at most
         max_prefills_per_step of them, same-bucket admissions batched
@@ -1788,6 +1854,10 @@ class DecodeEngine:
         if horizon is not None and horizon < 1:
             raise ValueError("horizon must be >= 1")
         self.steps_total += 1
+        if self.sanitizer is not None and not self.sanitizer.armed:
+            self._san_steps += 1
+            if self._san_steps > self._san_warmup:
+                self.arm_sanitizer()
         emitted: Dict[int, List[int]] = {}
         # Flush the pipeline before any admission / prefill / prefix
         # copy: those paths mutate the cache from the host side and
@@ -2014,10 +2084,7 @@ class DecodeEngine:
                 jnp.asarray(self._row_keys), rg, wr, self.temperature,
                 self.cfg, self.draft_cfg, W, all_greedy, self.top_k,
                 self.top_p, self.eos_id, shardings=self._shardings)
-        try:
-            toks.copy_to_host_async()
-        except AttributeError:
-            pass                   # non-jax.Array backends (tests)
+        _host_async(toks)
         self._ring.append(_InflightStep(
             toks, W + 1, list(rows), run_ahead=chain is not None,
             chain=(rl, ac, bu, ti, dl, dt), spec=True, w_max=W,
@@ -2084,10 +2151,7 @@ class DecodeEngine:
                     jnp.asarray(self._row_keys), rg, self.temperature,
                     self.cfg, H, all_greedy, self.top_k, self.top_p,
                     self.eos_id, shardings=self._shardings)
-        try:
-            toks.copy_to_host_async()
-        except AttributeError:
-            pass                   # non-jax.Array backends (tests)
+        _host_async(toks)
         self._ring.append(_InflightStep(toks, H, list(rows),
                                         run_ahead=chain is not None,
                                         chain=(rl, ac, bu, ti)))
@@ -2633,7 +2697,7 @@ class DecodeEngine:
             bids[n:] = bids[n - 1]     # values, deterministic result
             self.cache = _prefix_copy_in(
                 self.cache, self._pool_k, self._pool_v,
-                jnp.asarray(bids), jnp.asarray(rows), nbp,
+                jnp.asarray(bids), jnp.asarray(rows), nbp,  # graftlint: disable=jit-hygiene -- one compile per chain-length bucket is deliberate; nbp is bounded by max_len/prefix_block
                 self.prefix_block, shardings=self._shardings)
             self.prefix_copy_dispatches += 1
         self._seed_draft_rows(draft_seeds)
@@ -2954,13 +3018,10 @@ class DecodeEngine:
                                     shardings=self._shardings)
             lg = self._last_logits[row]
             for x in (k, v, lg):
-                try:
-                    x.copy_to_host_async()
-                except AttributeError:
-                    pass
-            k = np.asarray(k)
-            v = np.asarray(v)
-            lg = np.asarray(lg)
+                _host_async(x)
+            k = _device_get(k)
+            v = _device_get(v)
+            lg = _device_get(lg)
             self._swapped[req.req_id] = _SwapState(
                 k, v, n, int(self.row_len[row]),
                 int(self._tok_idx[row]), int(self.row_budget[row]), lg)
@@ -3205,7 +3266,7 @@ class DecodeEngine:
             self._pool_k, self._pool_v = _prefix_copy_out(
                 self.cache["k"], self.cache["v"], self._pool_k,
                 self._pool_v, row,
-                run[0][0] * T, jnp.asarray(bids), nbp, T,
+                run[0][0] * T, jnp.asarray(bids), nbp, T,  # graftlint: disable=jit-hygiene -- nbp is power-of-two bucketed (_pow2), distinct static values are log-bounded
                 shardings=self._shardings)
             self.prefix_copy_dispatches += 1
             for _, node in run:

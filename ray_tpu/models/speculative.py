@@ -230,7 +230,7 @@ def speculative_generate(
     _, d_cache = _prefill_jit(draft_params, prompt, d_cache, draft_cfg)
 
     stats = SpecStats()
-    first_toks = np.asarray(jnp.argmax(t_logits[:, -1], axis=-1))
+    first_toks = np.asarray(jnp.argmax(t_logits[:, -1], axis=-1))  # graftlint: disable=host-sync -- solo spec loop pulls the prefill token once before the round loop
     emitted: List[List[int]] = [[int(first_toks[b])] for b in range(B)]
     # seq_b = prompt tokens + emitted[b]. Invariants before each round:
     #   target cache row b holds K/V for seq_b[:-1] (slots [0, n_b));
@@ -259,8 +259,8 @@ def speculative_generate(
         verdict, t_cache = _verify_rows(
             target_params, chunk, t_cache, jnp.asarray(n, jnp.int32),
             target_cfg)
-        prop = np.asarray(proposals)          # [B, window]
-        ver = np.asarray(verdict)             # ver[i] follows chunk[:, i]
+        prop = np.asarray(proposals)          # graftlint: disable=host-sync -- solo spec accept/reject runs on the host; one pull per round by design
+        ver = np.asarray(verdict)             # graftlint: disable=host-sync -- ver[i] follows chunk[:, i]; paired with the proposals pull above
         match = prop == ver[:, :window]
         accept = np.cumprod(match, axis=1).sum(axis=1)  # [B], 0..window
         stats.rounds += 1

@@ -440,3 +440,155 @@ def test_gate_spec_host_syncs_quartered():
     assert s["host_syncs_per_token"] <= base_spt / 4.0, (
         f"spec engine pays {s['host_syncs_per_token']:.3f} syncs/token "
         f"vs H=1 baseline {base_spt:.3f}; want <= baseline/4")
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer gates (RAY_TPU_SANITIZE): zero retraces + zero
+# unexpected device->host transfers on steady decode, per feature combo
+# ---------------------------------------------------------------------------
+
+# The sanitizer gates below count COMPILES and TRANSFERS, not time, so
+# they need no quiesce and hold on any box. Contract: after a warmup
+# that exercises the exact steady workload (two full passes — pass 1
+# compiles the cold paths, pass 2 compiles warm-hit paths like the
+# prefix-cache copy-in), an armed pass over the same workload must (a)
+# never grow a fused entry point's compile cache, (b) never pull
+# device->host outside the _device_get/_host_async choke points, and
+# (c) still emit token streams identical to solo `generate`.
+
+SANITIZER_COMBOS = {
+    "dense": {},
+    "prefix": {"prefix_cache": True},
+    "paged": {"paged": True},
+    "paged_prefix": {"paged": True, "prefix_cache": True},
+    "pipeline": {"pipeline_depth": 3},
+    "spec": {"spec": True},
+    "spec_paged": {"spec": True, "paged": True},
+    "tp": {"tp": 2},
+}
+
+_SAN_PROMPTS = [[5, 6, 7], [9, 8, 7, 6, 5]]
+_SAN_BUDGET = 10
+
+
+@pytest.fixture(autouse=True)
+def _disarm_leftover_sanitizer():
+    """Never leak an armed sanitizer (process-global interposition)
+    into other tests, even when an assertion fires mid-gate."""
+    yield
+    from ray_tpu._private import sanitize
+    san = sanitize.active()
+    if san is not None:
+        san.disarm()
+
+
+def _san_engine(params, cfg, combo):
+    from ray_tpu.models.engine import DecodeEngine
+    kw = dict(combo)
+    if kw.pop("spec", False):
+        kw.update(draft_params=params, draft_cfg=cfg, spec_window=4)
+    return DecodeEngine(params, cfg, batch_slots=2, max_len=64,
+                        decode_horizon=4, **kw)
+
+
+def _san_workload(eng):
+    out = {}
+    rids = [eng.submit(p, _SAN_BUDGET) for p in _SAN_PROMPTS]
+    got = eng.run()
+    for rid in rids:
+        out[rid] = got[rid]
+    return [out[r] for r in rids]
+
+
+@pytest.mark.parametrize("combo", sorted(SANITIZER_COMBOS))
+def test_gate_sanitizer_steady_decode(combo):
+    """Gate: zero recompiles + zero unexpected transfers on steady
+    decode, with sanitized output token-identical to solo generate."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.generate import generate
+    from ray_tpu._private.sanitize import SanitizerError
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    eng = _san_engine(params, cfg, SANITIZER_COMBOS[combo])
+
+    _san_workload(eng)           # pass 1: cold compiles (+ prefix commits)
+    _san_workload(eng)           # pass 2: warm-hit paths compile
+    san = eng.arm_sanitizer()
+    try:
+        emitted = _san_workload(eng)   # armed pass: must be all-cached
+    except SanitizerError as exc:
+        pytest.fail(f"[{combo}] unexpected device->host transfer on the "
+                    f"steady decode path: {exc}")
+    finally:
+        eng.disarm_sanitizer()
+
+    assert san.total_retraces() == 0, (
+        f"[{combo}] steady-decode retraces: {san.retraces()}")
+    assert san.unexpected_transfers == [], san.unexpected_transfers
+    assert san.expected_pulls > 0, "armed pass should pull via _device_get"
+
+    for prompt, toks in zip(_SAN_PROMPTS, emitted):
+        solo = np.asarray(generate(
+            params, jnp.asarray([prompt], jnp.int32), cfg,
+            max_new_tokens=_SAN_BUDGET))[0, len(prompt):].tolist()
+        assert toks == solo[:len(toks)] and len(toks) == _SAN_BUDGET, (
+            f"[{combo}] sanitized engine diverged from solo generate")
+
+
+def test_gate_sanitizer_env_auto_arm(monkeypatch):
+    """RAY_TPU_SANITIZE=1 builds the sanitizer at engine construction
+    and auto-arms it after RAY_TPU_SANITIZE_WARMUP steps — no code
+    changes needed to sanitize a deployment."""
+    jax = pytest.importorskip("jax")
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu._private import sanitize
+
+    monkeypatch.setenv("RAY_TPU_SANITIZE", "1")
+    monkeypatch.setenv("RAY_TPU_SANITIZE_WARMUP", "3")
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    eng = _san_engine(params, cfg, {})
+    assert eng.sanitizer is not None and not eng.sanitizer.armed
+    eng.submit(_SAN_PROMPTS[0], 24)
+    steps = 0
+    while eng.pending():
+        eng.step()
+        steps += 1
+        if steps <= 3:
+            assert not eng.sanitizer.armed    # still warming up
+    assert steps >= 4 and eng.sanitizer.armed  # armed mid-flight, no trips
+    assert eng.sanitizer.unexpected_transfers == []
+    stats = eng.sanitizer_stats()
+    assert stats["expected_pulls"] > 0
+    eng.disarm_sanitizer()
+    assert sanitize.active() is None
+
+
+def test_gate_sanitizer_catches_stray_pull_and_restores():
+    """Negative control: while armed, a pull OUTSIDE _device_get raises
+    SanitizerError (strict mode); disarm restores pristine behavior and
+    the transfer-guard config."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu._private.sanitize import SanitizerError
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    eng = _san_engine(params, cfg, {})
+    _san_workload(eng)
+    eng.arm_sanitizer()
+    try:
+        with pytest.raises(SanitizerError):
+            float(jnp.ones(()) * 3)            # stray implicit pull
+        with pytest.raises(SanitizerError):
+            jnp.arange(4).tolist()             # stray bulk pull
+        with pytest.raises(SanitizerError):
+            bool(jnp.ones(()) > 0)             # stray truthiness sync
+    finally:
+        eng.disarm_sanitizer()
+    assert float(jnp.ones(()) * 3) == 3.0      # interposition removed
+    assert jnp.arange(4).tolist() == [0, 1, 2, 3]

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""graft_lint: run the graftlint static-analysis suite over the tree.
+
+Usage:
+    python tools/graft_lint.py [paths...]             # text report, exit 1 on findings
+    python tools/graft_lint.py --json [paths...]      # machine-readable report
+    python tools/graft_lint.py --rule host-sync ...   # single analyzer
+    python tools/graft_lint.py --list-rules
+    python tools/graft_lint.py --update-baseline      # re-record suppressions
+
+Default paths are the serving tree (ray_tpu/models ray_tpu/serve ray_tpu/util).
+Exit status is non-zero when there are unsuppressed findings, parse errors, or
+the inline suppressions drift from the checked-in baseline
+(ray_tpu/_private/lint/baseline.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(_REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(_REPO_ROOT))
+
+from ray_tpu._private.lint import (  # noqa: E402
+    DEFAULT_BASELINE,
+    RULE_REGISTRY,
+    default_rules,
+    diff_baseline,
+    lint_paths,
+    load_baseline,
+    save_baseline,
+)
+
+DEFAULT_PATHS = ["ray_tpu/models", "ray_tpu/serve", "ray_tpu/util"]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help=f"files or directories to lint (default: {' '.join(DEFAULT_PATHS)})",
+    )
+    parser.add_argument("--json", action="store_true", help="emit a JSON report")
+    parser.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        help="run only this analyzer (repeatable)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list registered analyzers and exit"
+    )
+    parser.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="include suppressed findings in the text report",
+    )
+    parser.add_argument(
+        "--baseline",
+        type=Path,
+        default=DEFAULT_BASELINE,
+        help="baseline file recording deliberate suppressions",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="skip the baseline drift check",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline from the current tree's suppressions",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        rules = default_rules(args.rule)
+    except KeyError as exc:
+        print(f"graft_lint: {exc.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.list_rules:
+        for name in sorted(RULE_REGISTRY):
+            print(f"{name}: {RULE_REGISTRY[name].description}")
+        return 0
+
+    raw_paths = args.paths or DEFAULT_PATHS
+    paths = []
+    for p in raw_paths:
+        path = Path(p)
+        if not path.exists() and (_REPO_ROOT / p).exists():
+            path = _REPO_ROOT / p
+        paths.append(path)
+
+    report = lint_paths(paths, rules=rules)
+
+    if args.update_baseline:
+        save_baseline(report, args.baseline)
+        print(f"baseline updated: {args.baseline} "
+              f"({len(report.suppressed)} suppressed finding(s) recorded)")
+        return 0
+
+    # The baseline is a tree-level contract: only check it when linting
+    # the default serving tree (no paths, or exactly the default set).
+    on_default_tree = not args.paths or sorted(args.paths) == sorted(DEFAULT_PATHS)
+    drift = []
+    if not args.no_baseline and args.rule is None and on_default_tree:
+        drift = diff_baseline(report, load_baseline(args.baseline))
+
+    if args.json:
+        payload = report.to_dict()
+        payload["baseline_drift"] = drift
+        print(json.dumps(payload, indent=2))
+    else:
+        text = report.format_text(show_suppressed=args.show_suppressed)
+        if text:
+            print(text)
+        for msg in drift:
+            print(msg)
+
+    if report.open or report.errors or drift:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
