@@ -1275,6 +1275,16 @@ def main():
             ["ray_tpu/models", "ray_tpu/serve", "ray_tpu/util"])
         serving["lint_violations_total"] = (
             len(_lint_report.open) + len(_lint_report.errors))
+        # Per-rule open counts: a regression names its analyzer directly
+        # (all zero on a clean tree, so the keys are stable).
+        _by_rule = {}
+        for _f in _lint_report.open:
+            _by_rule[_f.rule] = _by_rule.get(_f.rule, 0) + 1
+        from ray_tpu._private.lint import RULE_REGISTRY
+
+        for _rule in sorted(RULE_REGISTRY):
+            serving[f"lint_open_{_rule.replace('-', '_')}"] = (
+                _by_rule.get(_rule, 0))
     except Exception as e:
         serving["lint_violations_total"] = f"error: {type(e).__name__}"
     # Serving block on its own line; the train block stays the LAST

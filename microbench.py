@@ -687,12 +687,12 @@ def _state_snapshot_section(quick: bool) -> list:
 
 
 def _graft_lint_section(quick: bool) -> list:
-    """Wall time of one full graftlint sweep (all four analyzers over
-    the serving tree — the same work `test_graft_lint.py::test_tree_is_clean`
-    does in tier-1 CI). Budget: < 2 s, so the gate stays cheap enough to
-    run on every commit; also reports per-file microseconds and the open
-    finding count (must be 0 — bench.py tracks it as
-    `lint_violations_total`)."""
+    """Wall time of one full graftlint sweep (all eight analyzers,
+    interprocedural summaries included, over the serving tree — the same
+    work `test_graft_lint.py::test_tree_is_clean` does in tier-1 CI).
+    Budget: < 4 s full-tree, so the gate stays cheap enough to run on
+    every commit; also reports per-file microseconds and the open finding
+    count (must be 0 — bench.py tracks it as `lint_violations_total`)."""
     from ray_tpu._private.lint import lint_paths
 
     paths = ["ray_tpu/models", "ray_tpu/serve", "ray_tpu/util"]

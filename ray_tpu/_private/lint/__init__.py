@@ -1,7 +1,9 @@
 """graftlint: repo-invariant static analysis + sanitizer glue for ray_tpu.
 
-Public surface re-exported from :mod:`ray_tpu._private.lint.core`; the four
-analyzers self-register on import via :func:`default_rules`.
+Public surface re-exported from :mod:`ray_tpu._private.lint.core`; the
+analyzers self-register on import via :func:`default_rules`.  v2 adds the
+interprocedural layer (:mod:`.dataflow`) and the kv-refcount / flush-order /
+sharding-pin invariant analyzers.
 """
 
 from ray_tpu._private.lint.core import (
@@ -14,6 +16,7 @@ from ray_tpu._private.lint.core import (
     baseline_entries,
     default_rules,
     diff_baseline,
+    iter_python_files,
     lint_paths,
     lint_source,
     load_baseline,
@@ -31,6 +34,7 @@ __all__ = [
     "baseline_entries",
     "default_rules",
     "diff_baseline",
+    "iter_python_files",
     "lint_paths",
     "lint_source",
     "load_baseline",

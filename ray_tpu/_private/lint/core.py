@@ -103,6 +103,10 @@ class LintConfig:
     hot_path_files: frozenset = frozenset(
         {"engine.py", "fleet.py", "generate.py", "speculative.py", "block_pool.py"}
     )
+    # Files that own BlockPool handles (kv-refcount) / the dispatch ring
+    # (flush-order) / donated sharded carries (sharding-pin).  The invariant
+    # analyzers only fire where the invariant lives.
+    kv_files: frozenset = frozenset({"engine.py", "prefix_cache.py", "block_pool.py"})
     host_sync_allowed_functions: frozenset = frozenset({"_device_get", "_emit_block"})
     metric_prefixes: Tuple[str, ...] = (
         "llm_engine_",
@@ -116,6 +120,9 @@ class LintConfig:
 
     def is_hot_path(self, path: Path) -> bool:
         return self.force_hot or path.name in self.hot_path_files
+
+    def is_kv_path(self, path: Path) -> bool:
+        return self.force_hot or path.name in self.kv_files
 
     def metric_glossary(self) -> frozenset:
         if self.glossary is None:
@@ -178,12 +185,15 @@ class Rule:
 
 
 def default_rules(names: Optional[Sequence[str]] = None) -> List[Rule]:
-    """Instantiate registered rules (all four analyzers import-registered)."""
+    """Instantiate registered rules (all analyzers import-registered)."""
     # Import for side effect: each module registers its rule class.
     from ray_tpu._private.lint import (  # noqa: F401
+        rules_flush_order,
         rules_host_sync,
         rules_jit_hygiene,
+        rules_kv_refcount,
         rules_metrics_name,
+        rules_sharding_pin,
         rules_trace_guard,
     )
 
@@ -195,6 +205,56 @@ def default_rules(names: Optional[Sequence[str]] = None) -> List[Rule]:
     else:
         selected = [RULE_REGISTRY[n] for n in sorted(RULE_REGISTRY)]
     return [cls() for cls in selected]
+
+
+@register
+class SuppressionSyntaxRule(Rule):
+    """Malformed ``# graftlint: disable=...`` directives are findings, not
+    silent no-ops: a missing ``-- reason`` makes the directive inert, and an
+    unknown rule name means the keep guards nothing."""
+
+    name = "suppression-syntax"
+    description = (
+        "graftlint directives need known rule names and a '-- reason'; "
+        "malformed directives are inert and flagged"
+    )
+
+    def check(self, ctx: "FileContext") -> List[Finding]:
+        findings: List[Finding] = []
+        for line, col, rules, problem in ctx.suppression_issues:
+            names = ",".join(sorted(rules)) or "?"
+            findings.append(
+                Finding(
+                    rule=self.name,
+                    path=ctx.rel,
+                    line=line,
+                    col=col,
+                    message=(
+                        f"malformed suppression (disable={names}): {problem}; "
+                        "directive has no effect"
+                    ),
+                    symbol=ctx.symbol_at_line(line),
+                )
+            )
+        for line, (rules, _reason) in sorted(ctx.suppressions.items()):
+            unknown = sorted(
+                r for r in rules if r != "*" and r not in RULE_REGISTRY
+            )
+            if unknown:
+                findings.append(
+                    Finding(
+                        rule=self.name,
+                        path=ctx.rel,
+                        line=line,
+                        col=0,
+                        message=(
+                            "unknown rule name(s) in suppression: "
+                            + ", ".join(unknown)
+                        ),
+                        symbol=ctx.symbol_at_line(line),
+                    )
+                )
+        return findings
 
 
 # ---------------------------------------------------------------------------
@@ -216,8 +276,24 @@ class FileContext:
         for parent in ast.walk(self.tree):
             for child in ast.iter_child_nodes(parent):
                 self.parents[child] = parent
-        # line -> (set of rule names or {"*"}, reason)
-        self.suppressions: Dict[int, Tuple[Set[str], str]] = _parse_suppressions(source)
+        # line -> (set of rule names or {"*"}, reason); malformed directives
+        # (missing `-- reason`) are inert and land in suppression_issues.
+        self.suppressions, self.suppression_issues = _parse_suppressions(source)
+        self._summaries = None
+
+    @property
+    def summaries(self):
+        """Lazy :class:`~.dataflow.ModuleSummaries` for this file — the
+        interprocedural rules share one function table + summary cache.
+        Imported lazily: dataflow depends on core's helpers."""
+        if self._summaries is None:
+            from ray_tpu._private.lint.dataflow import ModuleSummaries
+
+            self._summaries = ModuleSummaries(
+                self.tree,
+                sync_exempt=self.config.host_sync_allowed_functions,
+            )
+        return self._summaries
 
     def symbol_at(self, node: ast.AST) -> str:
         names: List[str] = []
@@ -227,6 +303,19 @@ class FileContext:
                 names.append(cur.name)
             cur = self.parents.get(cur)
         return ".".join(reversed(names)) if names else "<module>"
+
+    def symbol_at_line(self, line: int) -> str:
+        """Dotted scope covering a physical line (deepest def/class whose
+        span contains it) — for findings that anchor to comments rather
+        than AST nodes."""
+        best: Optional[ast.AST] = None
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                end = getattr(node, "end_lineno", node.lineno)
+                if node.lineno <= line <= end:
+                    if best is None or node.lineno >= best.lineno:
+                        best = node
+        return self.symbol_at(best) if best is not None else "<module>"
 
     def enclosing_function(
         self, node: ast.AST
@@ -266,14 +355,26 @@ def _relpath(path: Path) -> str:
         return path.as_posix()
 
 
-def _parse_suppressions(source: str) -> Dict[int, Tuple[Set[str], str]]:
-    """Map physical line -> (suppressed rule names, reason).
+def _parse_suppressions(
+    source: str,
+) -> Tuple[Dict[int, Tuple[Set[str], str]], List[Tuple[int, int, Set[str], str]]]:
+    """Parse ``# graftlint: disable=rule[,rule...] -- reason`` directives.
 
-    Uses the tokenizer so string literals containing ``graftlint:`` are never
-    mistaken for directives.  ``disable=all`` (or ``*``) suppresses every rule
-    on that line.
+    Returns ``(table, issues)``:
+
+    * ``table``: physical line -> (suppressed rule names, reason) for
+      well-formed directives.  Multi-rule lists split on commas;
+      ``disable=all`` (or ``*``) suppresses every rule on that line.
+    * ``issues``: ``(line, col, rules, problem)`` for malformed directives.
+      A directive with no ``-- reason`` is **inert** (it suppresses
+      nothing) and is reported by the ``suppression-syntax`` rule instead
+      of being silently honoured or silently dropped.
+
+    Uses the tokenizer so string literals containing ``graftlint:`` are
+    never mistaken for directives.
     """
     table: Dict[int, Tuple[Set[str], str]] = {}
+    issues: List[Tuple[int, int, Set[str], str]] = []
     try:
         tokens = tokenize.generate_tokens(io.StringIO(source).readline)
         for tok in tokens:
@@ -281,15 +382,25 @@ def _parse_suppressions(source: str) -> Dict[int, Tuple[Set[str], str]]:
                 continue
             match = _SUPPRESS_RE.search(tok.string)
             if not match:
+                if "graftlint:" in tok.string and "disable" in tok.string:
+                    issues.append(
+                        (tok.start[0], tok.start[1], set(),
+                         "unparseable graftlint directive")
+                    )
                 continue
             rules = {r.strip() for r in match.group(1).split(",") if r.strip()}
             if "all" in rules or "*" in rules:
                 rules = {"*"}
             reason = (match.group("reason") or "").strip()
+            if match.group("reason") is None or not reason:
+                issues.append(
+                    (tok.start[0], tok.start[1], rules, "missing '-- reason'")
+                )
+                continue  # inert: a keep without a why is not a keep
             table[tok.start[0]] = (rules, reason)
     except tokenize.TokenError:
         pass
-    return table
+    return table, issues
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,247 @@
+"""flush-order: admission/slot-table mutation must be flush-dominated.
+
+With ``pipeline_depth > 1`` the engine keeps un-drained dispatches in
+``self._ring``; each in-flight step snapshotted the slot tables at dispatch
+time.  Mutating admission state while the ring is non-empty (admitting into
+a row a queued dispatch still writes, popping the scheduler, rebinding
+prefill state) corrupts the snapshot the drain path will commit against —
+the PR-5 ring invariant that ``step()`` enforces by hand with its
+flush-before-admission call sites.
+
+The rule encodes that discipline per class that defines
+``_flush_pipeline``:
+
+* **sensitive mutations** — subscript stores / ``del`` / ``.pop()`` /
+  ``.clear()`` on the admission state attributes (``row_req``,
+  ``row_len``, ``row_budget``, ``_tok_idx``, ``_row_prefill``) and
+  ``self.scheduler.pop()``.  Block-table growth (``_row_blocks`` /
+  ``_bt``) is deliberately NOT sensitive: ``_top_up_pipeline`` legally
+  grows block chains mid-flight because the device snapshotted the block
+  table at dispatch.
+* **dominators** — an earlier ``self._flush_pipeline(...)`` call
+  (including the conditional flush-already-done form), an
+  ``assert not self._ring`` precondition, or ``self._ring.clear()``.
+  Dominance is approximated by source order within the method body.
+* **propagation** — a method is *needy* when a sensitive mutation (or a
+  call to a needy method) precedes its first dominator; neediness flows
+  up the class-local call graph to a fixpoint.  Findings are emitted only
+  at the boundary where the obligation escapes static view: needy
+  **public** methods (anyone may call them mid-flight) and needy private
+  methods with **no class-local callers**.  Needy helpers reached only
+  from dominated callers (``step()`` flushes, then admits) are the
+  sanctioned shape and stay silent.
+* the flush machinery itself (``_flush_pipeline``, ``_drain_one``,
+  ``_emit_block``) and ``__init__`` are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ray_tpu._private.lint.core import FileContext, Finding, Rule, register
+from ray_tpu._private.lint.dataflow import call_tail
+
+SENSITIVE_ATTRS = frozenset(
+    {"row_req", "row_len", "row_budget", "_tok_idx", "_row_prefill"}
+)
+_MUTATING_METHODS = frozenset({"pop", "clear", "popitem"})
+_EXEMPT = frozenset(
+    {"_flush_pipeline", "_drain_one", "_emit_block", "__init__"}
+)
+_RING_ATTRS = frozenset({"_ring"})
+
+
+def _self_attr(node: ast.AST) -> str:
+    """`self.<attr>`/`self.<attr>[...]` -> attr name, else ""."""
+    cur = node
+    if isinstance(cur, ast.Subscript):
+        cur = cur.value
+    if isinstance(cur, ast.Attribute) and \
+            isinstance(cur.value, ast.Name) and cur.value.id == "self":
+        return cur.attr
+    return ""
+
+
+class _MethodFacts:
+    __slots__ = ("name", "node", "first_dominator", "mutations", "calls")
+
+    def __init__(self, name: str, node: ast.AST):
+        self.name = name
+        self.node = node
+        self.first_dominator: Optional[int] = None
+        # [(lineno, node, description)]
+        self.mutations: List[tuple] = []
+        # [(lineno, node, callee_name)]
+        self.calls: List[tuple] = []
+
+
+@register
+class FlushOrderRule(Rule):
+    name = "flush-order"
+    description = (
+        "admission-state/slot-table mutation in a pipelined engine must be "
+        "dominated by _flush_pipeline (or a drained-ring guard)"
+    )
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and any(
+                isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and c.name == "_flush_pipeline"
+                for c in node.body
+            ):
+                findings.extend(self._check_class(ctx, node))
+        return findings
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> List[Finding]:
+        facts: Dict[str, _MethodFacts] = {}
+        for child in cls.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                facts[child.name] = self._scan_method(child)
+
+        callers: Dict[str, Set[str]] = {name: set() for name in facts}
+        for name, mf in facts.items():
+            for _line, _node, callee in mf.calls:
+                if callee in callers and callee != name:
+                    callers[callee].add(name)
+
+        # Fixpoint: needy = mutation or needy-callee call before the first
+        # dominator (source order).
+        needy: Dict[str, Optional[tuple]] = {}   # name -> offending site
+        for name, mf in facts.items():
+            if name in _EXEMPT:
+                continue
+            site = self._first_undominated(mf, set())
+            if site is not None:
+                needy[name] = site
+        changed = True
+        while changed:
+            changed = False
+            for name, mf in facts.items():
+                if name in _EXEMPT or name in needy:
+                    continue
+                site = self._first_undominated(mf, set(needy))
+                if site is not None:
+                    needy[name] = site
+                    changed = True
+
+        findings: List[Finding] = []
+        for name, site in sorted(needy.items()):
+            public = not name.startswith("_")
+            orphan = not callers.get(name)
+            if not (public or orphan):
+                continue   # private, only reachable via dominated callers
+            line, node, what = site
+            how = ("public entry point" if public
+                   else "no class-local caller establishes the flush")
+            findings.append(ctx.finding(
+                self.name,
+                node,
+                f"{what} while the dispatch ring may be non-empty "
+                f"({how}); call _flush_pipeline (or assert a drained ring) "
+                "first",
+            ))
+        return findings
+
+    # -- per-method scan -----------------------------------------------------
+
+    def _scan_method(self, fn: ast.AST) -> _MethodFacts:
+        mf = _MethodFacts(fn.name, fn)
+        for node in self._own_nodes(fn):
+            line = getattr(node, "lineno", 0)
+            if self._is_dominator(node):
+                if mf.first_dominator is None or line < mf.first_dominator:
+                    mf.first_dominator = line
+                continue
+            mut = self._mutation_desc(node)
+            if mut is not None:
+                mf.mutations.append((line, node, mut))
+            elif isinstance(node, ast.Call):
+                attr = ""
+                if isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self":
+                    attr = node.func.attr
+                if attr:
+                    mf.calls.append((line, node, attr))
+        mf.mutations.sort(key=lambda t: t[0])
+        mf.calls.sort(key=lambda t: t[0])
+        return mf
+
+    def _first_undominated(self, mf: _MethodFacts,
+                           needy: Set[str]) -> Optional[tuple]:
+        dom = mf.first_dominator
+        for line, node, what in mf.mutations:
+            if dom is None or line < dom:
+                return (line, node, what)
+        for line, node, callee in mf.calls:
+            if callee in needy and (dom is None or line < dom):
+                return (line, node,
+                        f"call to `{callee}()` which mutates admission "
+                        "state")
+        return None
+
+    def _is_dominator(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            if call_tail(node) == "_flush_pipeline":
+                return True
+            # self._ring.clear(): the ring is empty afterwards
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "clear" and \
+                    _self_attr(node.func.value) in _RING_ATTRS:
+                return True
+            return False
+        if isinstance(node, ast.Assert):
+            test = node.test
+            if isinstance(test, ast.UnaryOp) and \
+                    isinstance(test.op, ast.Not) and \
+                    _self_attr(test.operand) in _RING_ATTRS:
+                return True
+        return False
+
+    def _mutation_desc(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    attr = _self_attr(tgt)
+                    if attr in SENSITIVE_ATTRS:
+                        return f"write to `self.{attr}[...]`"
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Subscript):
+                attr = _self_attr(node.target)
+                if attr in SENSITIVE_ATTRS:
+                    return f"in-place update of `self.{attr}[...]`"
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    attr = _self_attr(tgt)
+                    if attr in SENSITIVE_ATTRS:
+                        return f"`del self.{attr}[...]`"
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            method = node.func.attr
+            recv = node.func.value
+            if method in _MUTATING_METHODS:
+                attr = _self_attr(recv)
+                if attr in SENSITIVE_ATTRS:
+                    return f"`self.{attr}.{method}()`"
+            if method == "pop" and isinstance(recv, ast.Attribute) and \
+                    recv.attr == "scheduler" and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self":
+                return "`self.scheduler.pop()`"
+        return None
+
+    @staticmethod
+    def _own_nodes(fn: ast.AST):
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))

@@ -7,13 +7,22 @@ else that forces a sync on the hot path — ``.item()``, ``float()/int()/bool()`
 on a jax value, ``np.asarray`` on a device array, truthiness branching on an
 array — stalls the dispatch ring and silently serialises the pipeline.
 
-Detection is a per-function intra-procedural taint pass: values are "device"
-tainted when they come from a ``jnp.*``/``jax.*`` expression or from a call to
-a module-level jitted function, and taint propagates through assignments,
-tuple unpacking, arithmetic, subscripts and method calls.  Sync-forcing
-operations on tainted values are findings.  Functions on the whitelist
-(``_device_get``, ``_emit_block``) are the sanctioned choke points and are
-skipped.
+Detection is a per-function taint pass: values are "device" tainted when they
+come from a ``jnp.*``/``jax.*`` expression or from a call to a module-level
+jitted function, and taint propagates through assignments, tuple unpacking,
+arithmetic, subscripts and method calls.  Sync-forcing operations on tainted
+values are findings.  Functions on the whitelist (``_device_get``,
+``_emit_block``) are the sanctioned choke points and are skipped.
+
+v2 makes the pass **interprocedural** (one summary level, via
+:mod:`.dataflow`): a call to a module-local helper whose summary says
+``returns_device`` taints the call result even when the helper's ``jnp``
+roots are out of view, and passing a tainted value to a helper whose
+summary says it *syncs* that parameter (``sync_params``) is reported at the
+call site — the sync happens one frame down, but the hot-path caller is the
+code that has to change.  Suppressions that only existed because the old
+analyzer could not follow a helper call are now either real findings or
+deletable.
 """
 
 from __future__ import annotations
@@ -55,10 +64,17 @@ def _dotted(node: ast.AST) -> str:
 
 
 class _Taint:
-    """Tracks which local names hold device values inside one function."""
+    """Tracks which local names hold device values inside one function.
 
-    def __init__(self, jitted: Set[str]):
+    When constructed with module ``summaries`` (and the enclosing
+    function's info as ``scope``), calls into module-local helpers whose
+    summary says ``returns_device`` are tainted too — one level of
+    interprocedural propagation."""
+
+    def __init__(self, jitted: Set[str], summaries=None, scope=None):
         self.jitted = jitted
+        self.summaries = summaries
+        self.scope = scope
         self.names: Set[str] = set()
 
     def expr(self, node: ast.AST) -> bool:
@@ -83,6 +99,10 @@ class _Taint:
             # method call on a tainted receiver (x.astype(...), x.reshape(...))
             if isinstance(node.func, ast.Attribute) and self.expr(node.func.value):
                 return True
+            if self.summaries is not None:
+                callee = self.summaries.resolve_call(node, self.scope)
+                if callee is not None and self.summaries.returns_device(callee):
+                    return True
             return False
         if isinstance(node, ast.BinOp):
             return self.expr(node.left) or self.expr(node.right)
@@ -140,7 +160,9 @@ class HostSyncRule(Rule):
     def _check_function(
         self, ctx: FileContext, fn: ast.FunctionDef, jitted: Set[str]
     ) -> List[Finding]:
-        taint = _Taint(jitted)
+        summaries = ctx.summaries
+        taint = _Taint(jitted, summaries=summaries,
+                       scope=summaries.info_for(fn))
         findings: Dict[tuple, Finding] = {}
         # Two passes: the first only builds taint (so loop-carried values seen
         # late in the body taint their uses earlier in the next iteration),
@@ -261,6 +283,27 @@ class HostSyncRule(Rule):
                 f"`.{call.func.attr}()` on a device value forces a blocking "
                 "host sync; pull via _device_get first",
             )
+            return
+        # Interprocedural: a tainted argument handed to a local helper whose
+        # summary says it syncs that parameter.  The sync happens one frame
+        # down; the hot-path call site is where the fix belongs.
+        if taint.summaries is not None:
+            callee = taint.summaries.resolve_call(call, taint.scope)
+            if callee is not None:
+                synced = taint.summaries.sync_params(callee)
+                if synced:
+                    for pname, arg in callee.bind_args(call):
+                        if pname in synced and taint.expr(arg):
+                            self._emit(
+                                ctx,
+                                call,
+                                findings,
+                                f"device value passed to `{callee.name}()`, "
+                                f"which forces a host sync on parameter "
+                                f"`{pname}`; pull via _device_get first or "
+                                "pass a host copy",
+                            )
+                            break
 
     def _check_truthiness(self, ctx, test, taint, findings) -> None:
         # `if device_array:` / `while not mask:` — __bool__ on a jax array is

@@ -1,0 +1,501 @@
+"""kv-refcount: BlockPool acquire/release balance on all exit paths.
+
+The paged KV planner hands out block ids through a host-side refcount
+ledger (``BlockPool.alloc`` -> refcount 1, ``incref`` -> +1 per sharer,
+``decref`` -> -1, freed at zero).  The runtime identity tests catch a
+drifted ledger only when a seeded workload happens to hit the leaky path;
+this analyzer checks the discipline statically: **every acquire must reach
+a matching release or ownership transfer on every exit — including
+exception edges — and nothing may be released twice.**
+
+Ownership model (per function, module-local):
+
+* **acquire** — binding the result of an ``*.alloc(...)`` call or of a
+  local callee whose summary says ``returns_acquired`` (``_pool_alloc``);
+  ``incref(name)`` also acquires: it creates one more obligation on the
+  blocks ``name`` denotes.
+* **release** — ``decref(name)``.  A second ``decref`` of the same
+  obligation is a double-free finding.
+* **transfer** — ownership leaves the frame: the name is stored into an
+  attribute/subscript/container (``self._row_blocks[row] = chain``,
+  ``self._bt.append(ids)``), returned or yielded, passed to a local callee
+  whose summary stores or releases that parameter (``_bind_row``,
+  ``_Node(...)``), or passed to a call the module summaries cannot resolve
+  (cross-module escape — module-local precision by design).
+* **move** — ``chain = shared + new_ids`` shifts the obligations of the
+  mentioned owned names onto the new binding.
+* **None narrowing** — inside ``if x is None:`` (and the body of
+  ``while x is None:`` retry loops) the acquire failed, so ``x`` owns
+  nothing on that path.
+
+Exits checked: ``return`` / ``yield`` (owned names not in the returned
+expression leak), ``raise`` outside a same-function handler (the
+leak-on-raise class the runtime tests cannot see), ``continue`` and
+for-loop iteration end for names acquired inside that loop, and function
+fall-through.  Branch merges are may-analysis: released on *some* paths
+but owned on others reports "not released on all paths".
+
+Fires only on the files that own pool handles (``engine.py``,
+``prefix_cache.py``, ``block_pool.py``) or under ``force_hot``.
+"""
+
+from __future__ import annotations
+
+import ast
+import copy
+from typing import Dict, List, Optional, Set
+
+from ray_tpu._private.lint.core import FileContext, Finding, Rule, register
+from ray_tpu._private.lint.dataflow import (
+    ACQUIRE_TAILS,
+    INCREF_TAILS,
+    RELEASE_TAILS,
+    call_tail,
+)
+
+_OWNED = "owned"
+_MAYBE = "maybe"          # released/transferred on some paths only
+_RELEASED = "released"
+_TRANSFERRED = "transferred"
+
+
+class _Obligation:
+    __slots__ = ("state", "node", "loop_depth")
+
+    def __init__(self, state: str, node: ast.AST, loop_depth: int):
+        self.state = state
+        self.node = node
+        self.loop_depth = loop_depth
+
+
+class _FnChecker:
+    """Single-function ownership walk (source order, branch-merging)."""
+
+    def __init__(self, rule: "KvRefcountRule", ctx: FileContext,
+                 fn: ast.AST):
+        self.rule = rule
+        self.ctx = ctx
+        self.fn = fn
+        self.summaries = ctx.summaries
+        self.scope = self.summaries.info_for(fn)
+        self.findings: Dict[tuple, Finding] = {}
+        self.state: Dict[str, _Obligation] = {}
+        self.loop_depth = 0
+        self.try_depth = 0          # inside a try body that has handlers
+
+    def run(self) -> List[Finding]:
+        terminated = self._walk_body(self.fn.body)
+        if not terminated:
+            self._check_exit("falling off the end of the function", self.fn)
+        return list(self.findings.values())
+
+    # -- findings ------------------------------------------------------------
+
+    def _emit(self, node: ast.AST, message: str) -> None:
+        key = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0),
+               message)
+        if key not in self.findings:
+            self.findings[key] = self.ctx.finding(
+                self.rule.name, node, message)
+
+    def _check_exit(self, how: str, at: ast.AST,
+                    keep: Set[str] = frozenset(),
+                    min_depth: Optional[int] = None) -> None:
+        """Report owned obligations that do not survive this exit.
+
+        One finding per acquire site: an acquire that leaks on several
+        exits (loop iteration AND fall-through) is one bug, keyed so the
+        first-seen exit describes it."""
+        line = getattr(at, "lineno", 0)
+        for name, ob in self.state.items():
+            if name in keep or ob.state not in (_OWNED, _MAYBE):
+                continue
+            if min_depth is not None and ob.loop_depth < min_depth:
+                continue
+            key = ("leak", getattr(ob.node, "lineno", 0),
+                   getattr(ob.node, "col_offset", 0), name)
+            if key in self.findings:
+                continue
+            qualifier = "" if ob.state == _OWNED else " on some paths"
+            self.findings[key] = self.ctx.finding(
+                self.rule.name,
+                ob.node,
+                f"block handles acquired into `{name}` are not released or "
+                f"transferred{qualifier} when {how} (line {line}) — "
+                "refcount leak",
+            )
+
+    # -- events --------------------------------------------------------------
+
+    def _is_acquire_call(self, call: ast.Call) -> bool:
+        if call_tail(call) in ACQUIRE_TAILS:
+            return True
+        callee = self.summaries.resolve_call(call, self.scope)
+        return callee is not None and self.summaries.returns_acquired(callee)
+
+    def _acquire(self, name: str, node: ast.AST) -> None:
+        prev = self.state.get(name)
+        if prev is not None and prev.state in (_OWNED, _MAYBE):
+            self._emit(
+                prev.node,
+                f"block handles acquired into `{name}` are overwritten by a "
+                f"new acquire at line {getattr(node, 'lineno', 0)} without a "
+                "release — refcount leak",
+            )
+        self.state[name] = _Obligation(_OWNED, node, self.loop_depth)
+
+    def _mentioned_tracked(self, expr: ast.AST) -> List[str]:
+        out = []
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in self.state and \
+                    self.state[n.id].state in (_OWNED, _MAYBE):
+                out.append(n.id)
+        return out
+
+    def _settle(self, names, state: str) -> None:
+        for name in names:
+            ob = self.state.get(name)
+            if ob is not None:
+                ob.state = state
+
+    # -- calls ---------------------------------------------------------------
+
+    def _handle_call(self, call: ast.Call, is_stmt: bool) -> None:
+        tail = call_tail(call)
+        if tail in RELEASE_TAILS:
+            for arg in call.args:
+                for name in {n.id for n in ast.walk(arg)
+                             if isinstance(n, ast.Name)
+                             and n.id in self.state}:
+                    ob = self.state[name]
+                    if ob.state == _RELEASED:
+                        self._emit(
+                            call,
+                            f"`{name}` is decref'd again after its "
+                            "obligation was already released — double free",
+                        )
+                    ob.state = _RELEASED
+            return
+        if tail in INCREF_TAILS:
+            if len(call.args) == 1 and isinstance(call.args[0], ast.Name):
+                self._acquire(call.args[0].id, call)
+            return
+        if is_stmt and self._is_acquire_call(call):
+            self._emit(
+                call,
+                "acquire result discarded: the allocated block handles can "
+                "never be released — refcount leak",
+            )
+            return
+        tracked = self._mentioned_tracked(call)
+        if not tracked:
+            return
+        callee = self.summaries.resolve_call(call, self.scope)
+        if callee is None:
+            # Cross-module / unresolvable callee: assume the callee takes
+            # ownership (escape).  Module-local precision, documented.
+            self._settle(tracked, _TRANSFERRED)
+            return
+        sinks = self.summaries.stores_params(callee) | \
+            self.summaries.releases_params(callee)
+        bound_params = {}
+        for pname, arg in callee.bind_args(call):
+            for name in self._mentioned_tracked(arg):
+                bound_params.setdefault(name, set()).add(pname)
+        for name in tracked:
+            params = bound_params.get(name)
+            if params is None:
+                # starred/overflow argument we could not bind: escape.
+                self._settle([name], _TRANSFERRED)
+            elif params & sinks:
+                self._settle([name], _TRANSFERRED)
+            # else: the callee provably neither stores nor releases it —
+            # the obligation stays with this frame.
+
+    def _scan_calls(self, expr: ast.AST, top_stmt: bool = False) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._handle_call(node, is_stmt=top_stmt and node is expr)
+
+    # -- statements ----------------------------------------------------------
+
+    def _walk_body(self, body) -> bool:
+        """Walk statements in order; True when every path terminated."""
+        for stmt in body:
+            if self._walk_stmt(stmt):
+                return True
+        return False
+
+    def _walk_stmt(self, stmt) -> bool:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return False            # nested scopes checked independently
+        if isinstance(stmt, ast.Assign):
+            self._handle_assign(stmt)
+            return False
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._handle_assign(ast.Assign(
+                    targets=[stmt.target], value=stmt.value,
+                    lineno=stmt.lineno, col_offset=stmt.col_offset))
+            return False
+        if isinstance(stmt, ast.AugAssign):
+            self._scan_calls(stmt.value)
+            # `self.x += ids` style accumulation is a store.
+            if isinstance(stmt.target, (ast.Attribute, ast.Subscript)):
+                self._settle(self._mentioned_tracked(stmt.value),
+                             _TRANSFERRED)
+            return False
+        if isinstance(stmt, ast.Expr):
+            if isinstance(stmt.value, ast.Call):
+                self._scan_calls(stmt.value, top_stmt=True)
+            elif isinstance(stmt.value, (ast.Yield, ast.YieldFrom)):
+                inner = stmt.value.value
+                if inner is not None:
+                    self._scan_calls(inner)
+                    self._settle(self._mentioned_tracked(inner),
+                                 _TRANSFERRED)
+            else:
+                self._scan_calls(stmt.value)
+            return False
+        if isinstance(stmt, ast.Return):
+            keep: Set[str] = set()
+            if stmt.value is not None:
+                self._scan_calls(stmt.value)
+                keep = set(self._mentioned_tracked(stmt.value))
+                self._settle(keep, _TRANSFERRED)
+            self._check_exit("returning", stmt, keep=keep)
+            return True
+        if isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self._scan_calls(stmt.exc)
+            if self.try_depth == 0:
+                self._check_exit("raising", stmt)
+            return True
+        if isinstance(stmt, ast.If):
+            return self._handle_if(stmt)
+        if isinstance(stmt, ast.While):
+            return self._handle_while(stmt)
+        if isinstance(stmt, ast.For):
+            return self._handle_for(stmt)
+        if isinstance(stmt, ast.Try):
+            return self._handle_try(stmt)
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._scan_calls(item.context_expr)
+            return self._walk_body(stmt.body)
+        if isinstance(stmt, ast.Continue):
+            self._check_exit("continuing the loop", stmt,
+                             min_depth=self.loop_depth)
+            return True
+        if isinstance(stmt, ast.Break):
+            return True             # ownership survives to after the loop
+        if isinstance(stmt, (ast.Assert, ast.Delete, ast.Global,
+                             ast.Nonlocal, ast.Pass, ast.Import,
+                             ast.ImportFrom)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._scan_calls(child)
+            return False
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_calls(child)
+        return False
+
+    def _handle_assign(self, stmt: ast.Assign) -> None:
+        value = stmt.value
+        self._scan_calls(value)
+        target = stmt.targets[0] if len(stmt.targets) == 1 else None
+        acquired = isinstance(value, ast.Call) and \
+            self._is_acquire_call(value)
+        moved = self._mentioned_tracked(value)
+        if isinstance(target, ast.Name):
+            if acquired:
+                self._acquire(target.id, stmt)
+                return
+            if moved:
+                # move: `chain = shared + new_ids` shifts the obligations
+                depth = min(self.state[n].loop_depth for n in moved)
+                self._settle(moved, _TRANSFERRED)
+                self.state[target.id] = _Obligation(_OWNED, stmt, depth)
+                return
+            prev = self.state.get(target.id)
+            if prev is not None and prev.state in (_OWNED, _MAYBE):
+                self._emit(
+                    prev.node,
+                    f"block handles acquired into `{target.id}` are "
+                    f"overwritten at line {stmt.lineno} without a release "
+                    "— refcount leak",
+                )
+            self.state.pop(target.id, None)
+            return
+        if target is not None and isinstance(
+                target, (ast.Attribute, ast.Subscript)):
+            # store into longer-lived storage: ownership transferred
+            self._settle(moved, _TRANSFERRED)
+            return
+        if acquired:
+            # tuple-unpack of an acquire: untracked, warn nothing (rare)
+            return
+        self._settle(moved, _TRANSFERRED)   # conservative escape
+
+    def _handle_if(self, stmt: ast.If) -> bool:
+        self._scan_calls(stmt.test)
+        narrow_none, narrow_some = self._none_narrowing(stmt.test)
+        saved = self._snapshot()
+        # then-branch
+        for name in narrow_none:
+            self.state.pop(name, None)      # x is None: nothing owned here
+        t_term = self._walk_body(stmt.body)
+        t_state = self._snapshot()
+        # else-branch
+        self._restore(saved)
+        for name in narrow_some:
+            self.state.pop(name, None)      # x is not None -> else: None
+        e_term = self._walk_body(stmt.orelse)
+        e_state = self._snapshot()
+        if t_term and e_term:
+            return True
+        if t_term:
+            self._restore(e_state)
+        elif e_term:
+            self._restore(t_state)
+        else:
+            self._restore(self._merge(t_state, e_state))
+        return False
+
+    def _handle_while(self, stmt: ast.While) -> bool:
+        self._scan_calls(stmt.test)
+        narrow_none, _ = self._none_narrowing(stmt.test)
+        entry = self._snapshot()
+        for name in narrow_none:
+            self.state.pop(name, None)      # retry loop: alloc failed
+        self.loop_depth += 1
+        self._walk_body(stmt.body)
+        self.loop_depth -= 1
+        # No end-of-iteration check for while loops: the dominant shape is
+        # the alloc-retry loop whose condition re-narrows the handle.
+        merged = self._merge(entry, self._snapshot())
+        self._restore(merged)
+        if stmt.orelse:
+            return self._walk_body(stmt.orelse)
+        return False
+
+    def _handle_for(self, stmt: ast.For) -> bool:
+        self._scan_calls(stmt.iter)
+        entry = self._snapshot()
+        self.loop_depth += 1
+        terminated = self._walk_body(stmt.body)
+        if not terminated:
+            # End of an iteration: anything acquired inside this loop and
+            # still owned is re-leaked every pass.
+            self._check_exit("finishing a loop iteration", stmt,
+                             min_depth=self.loop_depth)
+        self.loop_depth -= 1
+        merged = self._merge(entry, self._snapshot())
+        self._restore(merged)
+        if stmt.orelse:
+            return self._walk_body(stmt.orelse)
+        return False
+
+    def _handle_try(self, stmt: ast.Try) -> bool:
+        pre = self._snapshot()
+        if stmt.handlers:
+            self.try_depth += 1
+        body_term = self._walk_body(stmt.body)
+        if stmt.handlers:
+            self.try_depth -= 1
+        body_state = self._snapshot()
+        states = [] if body_term else [body_state]
+        for handler in stmt.handlers:
+            # The body may have failed anywhere: the handler sees the merge
+            # of entry and post-body obligations.
+            self._restore(self._merge(pre, body_state))
+            if not self._walk_body(handler.body):
+                states.append(self._snapshot())
+        if stmt.orelse and not body_term:
+            self._restore(body_state)
+            if not self._walk_body(stmt.orelse):
+                states[0] = self._snapshot()
+        if not states:
+            return True
+        merged = states[0]
+        for other in states[1:]:
+            merged = self._merge(merged, other)
+        self._restore(merged)
+        if stmt.finalbody:
+            return self._walk_body(stmt.finalbody)
+        return False
+
+    # -- state plumbing ------------------------------------------------------
+
+    def _snapshot(self) -> Dict[str, _Obligation]:
+        # Per-entry shallow copies: obligation STATE forks per branch, but
+        # the acquire AST node must stay the original object (findings
+        # resolve their symbol through the file's parent map).
+        return {name: _Obligation(ob.state, ob.node, ob.loop_depth)
+                for name, ob in self.state.items()}
+
+    def _restore(self, state: Dict[str, _Obligation]) -> None:
+        self.state = state
+
+    def _merge(self, a: Dict[str, _Obligation],
+               b: Dict[str, _Obligation]) -> Dict[str, _Obligation]:
+        out: Dict[str, _Obligation] = {}
+        for name in set(a) | set(b):
+            oa, ob = a.get(name), b.get(name)
+            if oa is None or ob is None:
+                live = oa or ob
+                if live.state in (_OWNED, _MAYBE):
+                    live = copy.copy(live)
+                    live.state = _MAYBE    # owned on one path, absent on the other
+                out[name] = live
+                continue
+            merged = copy.copy(oa)
+            states = {oa.state, ob.state}
+            if states == {_OWNED}:
+                merged.state = _OWNED
+            elif _OWNED in states or _MAYBE in states:
+                merged.state = (_MAYBE if states & {_RELEASED, _TRANSFERRED,
+                                                    _MAYBE}
+                                else _OWNED)
+            elif states == {_RELEASED}:
+                merged.state = _RELEASED
+            else:
+                merged.state = _TRANSFERRED
+            out[name] = merged
+        return out
+
+    @staticmethod
+    def _none_narrowing(test: ast.AST):
+        """(names_none_in_then, names_none_in_else) for `x is None` tests."""
+        none_then: Set[str] = set()
+        none_else: Set[str] = set()
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+                isinstance(test.left, ast.Name) and \
+                len(test.comparators) == 1 and \
+                isinstance(test.comparators[0], ast.Constant) and \
+                test.comparators[0].value is None:
+            if isinstance(test.ops[0], ast.Is):
+                none_then.add(test.left.id)
+            elif isinstance(test.ops[0], ast.IsNot):
+                none_else.add(test.left.id)
+        return none_then, none_else
+
+
+@register
+class KvRefcountRule(Rule):
+    name = "kv-refcount"
+    description = (
+        "BlockPool acquire/incref must reach a matching decref or ownership "
+        "transfer on every exit path (including raises); no double-frees"
+    )
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        if not ctx.config.is_kv_path(ctx.path):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(_FnChecker(self, ctx, node).run())
+        return findings

@@ -111,11 +111,15 @@ def _pow2(n: int) -> int:
 
 
 def _key_data(key) -> np.ndarray:
-    """Raw uint32[2] bits of a PRNG key (legacy array or typed key)."""
+    """Raw uint32[2] bits of a PRNG key (legacy array or typed key).
+
+    Cold path (submit-time key normalisation, 8 bytes): the typed-key
+    branch still routes its pull through the `_device_get` choke point so
+    the sanitizer sees an expected transfer and telemetry counts it."""
     try:
         return np.asarray(key, np.uint32).reshape(2)
     except (TypeError, ValueError):
-        return np.asarray(jax.random.key_data(key),  # graftlint: disable=host-sync -- cold-path key normalisation at submit time (8 bytes, never on the decode loop)
+        return np.asarray(_device_get(jax.random.key_data(key)),
                           np.uint32).reshape(2)
 
 

@@ -1,0 +1,44 @@
+"""flush-order negatives: every mutation is flush-dominated.
+
+Never imported — linted as AST by tests/test_lint_corpus.py.
+"""
+
+
+class Engine:
+    def step(self, req):
+        # NEGATIVE: the conditional flush-already-done guard dominates
+        # the admission below (the engine.step() shape).
+        if self._ring and self.scheduler.admissions_pending():
+            self._flush_pipeline({})
+        cand = self.scheduler.pop()
+        self._admit(0, cand)
+        self._advance()
+
+    def _admit(self, row, req):
+        # NEGATIVE: needy, but only reachable through the dominated
+        # caller above — the sanctioned helper shape.
+        self.row_req[row] = req
+        self.row_len[row] = 0
+
+    def _advance(self):
+        self._row_prefill.pop(0, None)
+
+    def preempt(self, row):
+        # NEGATIVE: drained-ring precondition.
+        assert not self._ring, "preemption needs a drained pipeline"
+        self.row_req[row] = None
+
+    def halt(self):
+        # NEGATIVE: clearing the ring empties it before the wipe.
+        self._ring.clear()
+        self._row_prefill.clear()
+
+    def top_up(self, rows):
+        # NEGATIVE: block-table growth mid-flight is legal (the device
+        # snapshotted the block table at dispatch) — not sensitive.
+        self._row_blocks[rows[0]].extend([1, 2])
+        self._bt[rows[0]] = [1, 2]
+
+    def _flush_pipeline(self, emitted):
+        while self._ring:
+            self._drain_one(emitted)

@@ -1,0 +1,26 @@
+"""flush-order positives: admission state mutated with a live ring.
+
+Never imported — linted as AST by tests/test_lint_corpus.py.
+"""
+
+
+class Engine:
+    def admit(self, row, req):
+        # POSITIVE: public entry point writes the slot table with no
+        # earlier flush — a queued dispatch may still own this row.
+        self.row_req[row] = req
+        self.row_len[row] = 0
+
+    def pop_next(self):
+        # POSITIVE: popping the scheduler re-orders admission under the
+        # ring's feet.
+        return self.scheduler.pop()
+
+    def _orphan_rebind(self, row):
+        # POSITIVE: private, but no class-local caller establishes the
+        # flush, so the obligation escapes static view.
+        del self._row_prefill[row]
+
+    def _flush_pipeline(self, emitted):
+        while self._ring:
+            self._drain_one(emitted)

@@ -1,0 +1,13 @@
+"""flush-order suppressed: a reasoned keep stays out of the open set.
+
+Never imported — linted as AST by tests/test_lint_corpus.py.
+"""
+
+
+class Engine:
+    def force_reset(self, row):
+        self.row_req[row] = None  # graftlint: disable=flush-order -- crash-only teardown: the ring is abandoned, not replayed
+
+    def _flush_pipeline(self, emitted):
+        while self._ring:
+            self._drain_one(emitted)

@@ -1,0 +1,27 @@
+"""host-sync interprocedural negatives: choke points stay sanctioned.
+
+Never imported — linted as AST by tests/test_lint_corpus.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _device_get(x):
+    # The whitelisted choke point: its sync is the sanctioned one, and
+    # its RETURN is a host copy, not a device value.
+    return np.asarray(x)
+
+
+def _shape_of(x):
+    # Metadata-only helper: no sync on the parameter.
+    return x.shape[0]
+
+
+def hot_routed_through_choke_point(a):
+    # NEGATIVE: the pull goes through _device_get; numpy math after a
+    # choke-point pull is host-side and clean.
+    y = jnp.argmax(a, axis=-1)
+    host = _device_get(y)
+    n = _shape_of(y)
+    return float(host.max()) + n

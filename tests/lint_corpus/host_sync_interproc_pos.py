@@ -1,0 +1,30 @@
+"""host-sync interprocedural positives: the sync hides one frame down.
+
+These are the shapes the r09 intraprocedural analyzer could not see.
+Never imported — linted as AST by tests/test_lint_corpus.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pull(x):
+    # The helper syncs its parameter...
+    return np.asarray(x)
+
+
+def _make_mask(a):
+    # ...and this one returns a device value.
+    return jnp.cumsum(a) > 0
+
+
+def hot_pass_device_to_syncing_helper(a):
+    # POSITIVE: tainted argument handed to a summary-synced parameter.
+    y = jnp.argmax(a, axis=-1)
+    return _pull(y)
+
+
+def hot_sync_helper_result(a):
+    # POSITIVE: the helper's return is device-tainted; float() syncs it.
+    mask = _make_mask(a)
+    return float(mask)

@@ -1,0 +1,57 @@
+"""kv-refcount negatives: the engine's sanctioned ownership shapes.
+
+Never imported — linted as AST by tests/test_lint_corpus.py.
+"""
+
+
+class Engine:
+    def release_on_all_paths(self, shared, n):
+        # NEGATIVE: the engine admission shape — incref-shared-first, alloc,
+        # decref the share when the alloc fails, else move into the chain
+        # and transfer to the slot table.
+        self.kv_pool.incref(shared)
+        new_ids = self.kv_pool.alloc(n)
+        if new_ids is None:
+            self.kv_pool.decref(shared)
+            return False
+        chain = shared + new_ids
+        self._bind_row(0, chain)
+        return True
+
+    def _bind_row(self, row, chain):
+        self._row_blocks[row] = chain
+
+    def retry_loop(self, n):
+        # NEGATIVE: _pool_alloc's shape — the while-condition re-narrows
+        # the handle (alloc failed => nothing owned) each retry.
+        ids = self.kv_pool.alloc(n)
+        while ids is None:
+            if not self._evict_one():
+                return None
+            ids = self.kv_pool.alloc(n)
+        return ids
+
+    def returns_acquired(self, n):
+        # NEGATIVE: ownership is the caller's — returning is a transfer.
+        return self.kv_pool.alloc(n)
+
+    def store_then_grow(self, row, n):
+        # NEGATIVE: container stores transfer ownership.
+        got = self.kv_pool.alloc(n)
+        if got is None:
+            return False
+        self._row_blocks[row].extend(got)
+        return True
+
+    def release_in_finally(self, n):
+        # NEGATIVE: the handler path and the happy path both settle it.
+        ids = self.kv_pool.alloc(n)
+        if ids is None:
+            return None
+        try:
+            self._copy_in(ids)
+        except RuntimeError:
+            self.kv_pool.decref(ids)
+            raise
+        self._row_blocks[0] = ids
+        return True

@@ -1,0 +1,56 @@
+"""kv-refcount positives: every function here leaks or double-frees.
+
+Never imported — linted as AST by tests/test_lint_corpus.py.
+"""
+
+
+class Engine:
+    def leak_on_raise(self, n):
+        # POSITIVE: raise escapes with `ids` still owned (exception edge).
+        ids = self.kv_pool.alloc(n)
+        if not self._fits(n):
+            raise RuntimeError("no room")
+        self._row_blocks[0] = ids
+
+    def leak_on_some_paths(self, n):
+        # POSITIVE: released under the flag, owned on the fall-through.
+        ids = self.kv_pool.alloc(n)
+        if self.cond:
+            self.kv_pool.decref(ids)
+
+    def double_free(self, n):
+        # POSITIVE: the obligation is released twice.
+        ids = self.kv_pool.alloc(n)
+        self.kv_pool.decref(ids)
+        self.kv_pool.decref(ids)
+
+    def discarded_acquire(self, n):
+        # POSITIVE: the handle list is dropped on the floor.
+        self.kv_pool.alloc(n)
+
+    def leak_per_iteration(self, rows):
+        # POSITIVE: re-acquired every loop pass, never released.
+        for _row in rows:
+            ids = self.kv_pool.alloc(1)
+            self.count += 1
+
+    def leak_via_incref(self, shared, n):
+        # POSITIVE: the incref'd share is never decref'd when alloc fails.
+        self.kv_pool.incref(shared)
+        new_ids = self.kv_pool.alloc(n)
+        if new_ids is None:
+            return None
+        chain = shared + new_ids
+        self._row_blocks[0] = chain
+        return True
+
+    def leak_through_helper(self, n):
+        # POSITIVE (interprocedural): _grab acquires, caller drops it.
+        ids = self._grab(n)
+        if not self._fits(n):
+            raise RuntimeError("no room")
+        self._row_blocks[0] = ids
+
+    def _grab(self, n):
+        got = self.kv_pool.alloc(n)
+        return got

@@ -1,0 +1,10 @@
+"""kv-refcount suppressed: a reasoned keep stays out of the open set.
+
+Never imported — linted as AST by tests/test_lint_corpus.py.
+"""
+
+
+class Engine:
+    def intentional_leak(self, n):
+        ids = self.kv_pool.alloc(n)  # graftlint: disable=kv-refcount -- scratch blocks freed wholesale by pool reset in teardown
+        self.scratch_armed = True

@@ -1,0 +1,39 @@
+"""sharding-pin negatives: every carry rebuild is pinned.
+
+Never imported — linted as AST by tests/test_lint_corpus.py.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnames=("pool_k", "pool_v"))
+def _cow_blocks(pool_k, pool_v, src, dst, shardings=None):
+    return pool_k, pool_v
+
+
+class Engine:
+    def swap_in(self, row, logits):
+        # NEGATIVE: the repo convention — host scatter, immediate re-pin.
+        self._last_logits = self._last_logits.at[row].set(
+            jnp.asarray(logits))
+        if self._shardings is not None:
+            self._last_logits = jax.device_put(self._last_logits,
+                                               self._shardings.logits)
+
+    def cow(self, src, dst):
+        # NEGATIVE: produced inside jit — pinning is the jit's contract.
+        self._pool_k, self._pool_v = _cow_blocks(
+            self._pool_k, self._pool_v, src, dst,
+            shardings=self._shardings)
+
+    def init_cache(self, cfg):
+        # NEGATIVE: explicit sharding kwarg at the build site.
+        self.cache = build_cache(cfg, sharding=self._shardings.cache)
+
+    def teardown(self):
+        # NEGATIVE: None sentinel and plain moves never decay a layout.
+        self._pool_k = self._pool_v = None
+        self.cache = self._checkpoint_cache

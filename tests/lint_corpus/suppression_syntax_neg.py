@@ -1,0 +1,21 @@
+"""suppression-syntax negatives: well-formed directives, incl. multi-rule.
+
+Never imported — linted as AST by tests/test_lint_corpus.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def hot_multi_rule(x):
+    # NEGATIVE: multi-rule directive with a reason suppresses both rules.
+    y = jnp.argmax(x)
+    return np.asarray(y)  # graftlint: disable=host-sync,trace-guard -- deliberate solo pull, span unguarded by design
+
+
+def hot_wildcard(x):
+    y = jnp.sum(x)
+    return float(y)  # graftlint: disable=all -- benchmark harness line, every rule waived
+
+
+FAKE = "a string mentioning graftlint: disable=host-sync is not a directive"

@@ -1,0 +1,20 @@
+"""suppression-syntax positives: malformed directives are inert + flagged.
+
+Never imported — linted as AST by tests/test_lint_corpus.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def hot_missing_reason(x):
+    # POSITIVE x2: the directive has no `-- reason`, so it is inert (the
+    # host-sync finding stays OPEN) and itself a suppression-syntax finding.
+    y = jnp.argmax(x)
+    return np.asarray(y)  # graftlint: disable=host-sync
+
+
+def hot_unknown_rule(x):
+    # POSITIVE: unknown rule name — the keep guards nothing.
+    n = x + 1  # graftlint: disable=hots-ync -- typo'd rule name
+    return n

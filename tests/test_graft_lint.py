@@ -323,6 +323,93 @@ class TestMetricsName:
 
 
 # ---------------------------------------------------------------------------
+# suppression parser v2
+# ---------------------------------------------------------------------------
+
+
+class TestSuppressionParserV2:
+    def test_multi_rule_directive_suppresses_each_listed_rule(self):
+        findings = _lint("""
+            import numpy as np, jax.numpy as jnp
+
+            def hot(x):
+                y = jnp.argmax(x)
+                return np.asarray(y)  # graftlint: disable=host-sync,trace-guard -- deliberate pull
+        """)
+        assert _open(findings, "host-sync") == []
+        sup = _suppressed(findings, "host-sync")
+        assert len(sup) == 1 and sup[0].reason == "deliberate pull"
+        assert _open(findings, "suppression-syntax") == []
+
+    def test_missing_reason_is_inert_and_flagged(self):
+        findings = _lint("""
+            import numpy as np, jax.numpy as jnp
+
+            def hot(x):
+                y = jnp.argmax(x)
+                return np.asarray(y)  # graftlint: disable=host-sync
+        """)
+        # the underlying finding stays OPEN: a keep without a why is no keep
+        assert len(_open(findings, "host-sync")) == 1
+        assert _suppressed(findings, "host-sync") == []
+        syn = _open(findings, "suppression-syntax")
+        assert len(syn) == 1 and "reason" in syn[0].message
+
+    def test_unknown_rule_name_flagged(self):
+        findings = _lint("""
+            n = 1  # graftlint: disable=hots-ync -- typo'd rule name
+        """)
+        syn = _open(findings, "suppression-syntax")
+        assert len(syn) == 1 and "hots-ync" in syn[0].message
+
+    def test_wildcard_with_reason_still_fine(self):
+        findings = _lint("""
+            import jax.numpy as jnp
+
+            def hot(x):
+                return float(jnp.sum(x))  # graftlint: disable=all -- bench harness line
+        """)
+        assert _open(findings, "host-sync") == []
+        assert _open(findings, "suppression-syntax") == []
+
+
+# ---------------------------------------------------------------------------
+# v2 analyzers: path gating (full behavior is pinned by tests/lint_corpus/)
+# ---------------------------------------------------------------------------
+
+
+KV_LEAK = """
+    class Engine:
+        def leak(self, n):
+            ids = self.kv_pool.alloc(n)
+            if not ids:
+                raise RuntimeError("oom")
+            self.row_blocks[0] = ids
+"""
+
+
+def test_kv_refcount_gated_to_kv_modules():
+    """The ownership analyzer runs only on the block-pool-touching files;
+    an identical snippet under another name is out of scope."""
+    hot = _lint(KV_LEAK, path="engine.py", force_hot=False)
+    assert len(_open(hot, "kv-refcount")) == 1
+    cold = _lint(KV_LEAK, path="router.py", force_hot=False)
+    assert _open(cold, "kv-refcount") == []
+
+
+def test_sharding_pin_gated_on_sharding_machinery():
+    src = """
+        class Engine:
+            def swap(self, row):
+                self.cache = self.host_cache[row]
+    """
+    # force_hot opts the snippet in even without `_shardings` in source
+    assert len(_open(_lint(src), "sharding-pin")) == 1
+    cold = _lint(src, force_hot=False, path="engine.py")
+    assert _open(cold, "sharding-pin") == []
+
+
+# ---------------------------------------------------------------------------
 # the tree gate + baseline
 # ---------------------------------------------------------------------------
 
@@ -369,7 +456,9 @@ def test_cli_exit_codes_and_rule_filter(tmp_path, capsys):
     capsys.readouterr()
     assert main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rule in ("host-sync", "trace-guard", "jit-hygiene", "metrics-name"):
+    for rule in ("host-sync", "trace-guard", "jit-hygiene", "metrics-name",
+                 "kv-refcount", "flush-order", "sharding-pin",
+                 "suppression-syntax"):
         assert rule in out
 
 
@@ -393,6 +482,43 @@ def test_cli_default_tree_clean(capsys):
     from tools.graft_lint import main
 
     assert main(TREE) == 0
+
+
+def test_cli_changed_mode(tmp_path, capsys, monkeypatch):
+    """--changed lints exactly the git-reported files inside scope; an
+    empty diff short-circuits to success without the drift check."""
+    import tools.graft_lint as gl
+
+    bad = tmp_path / "engine.py"
+    bad.write_text(BAD_SNIPPET)
+    clean = tmp_path / "router.py"
+    clean.write_text("VERSION = 3\n")
+    elsewhere = tmp_path / "outside" / "engine.py"
+    elsewhere.parent.mkdir()
+    elsewhere.write_text(BAD_SNIPPET)
+
+    scope = tmp_path  # pass the scope dir positionally; outside/ is excluded
+
+    monkeypatch.setattr(gl, "_changed_files",
+                        lambda base, root: [bad, clean, elsewhere])
+    # `elsewhere` is filtered out by scope, `bad` still fails the run
+    assert gl.main(["--changed", str(scope / "engine.py"),
+                    str(scope / "router.py")]) == 1
+    out = capsys.readouterr().out
+    assert "engine.py" in out and "outside" not in out
+
+    monkeypatch.setattr(gl, "_changed_files", lambda base, root: [])
+    assert gl.main(["--changed", str(scope)]) == 0
+    assert "no changed python files" in capsys.readouterr().out
+
+
+def test_changed_files_sees_worktree_state():
+    """_changed_files vs HEAD returns a (possibly empty) list of existing
+    .py paths — the live-repo smoke check for the git plumbing."""
+    from tools.graft_lint import _REPO_ROOT, _changed_files
+
+    files = _changed_files("HEAD", _REPO_ROOT)
+    assert all(f.suffix == ".py" and f.exists() for f in files)
 
 
 def test_unknown_rule_rejected():

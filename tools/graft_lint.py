@@ -5,10 +5,16 @@ Usage:
     python tools/graft_lint.py [paths...]             # text report, exit 1 on findings
     python tools/graft_lint.py --json [paths...]      # machine-readable report
     python tools/graft_lint.py --rule host-sync ...   # single analyzer
+    python tools/graft_lint.py --changed              # lint only files touched vs HEAD
+    python tools/graft_lint.py --changed --base main  # ... vs another ref
     python tools/graft_lint.py --list-rules
     python tools/graft_lint.py --update-baseline      # re-record suppressions
 
 Default paths are the serving tree (ray_tpu/models ray_tpu/serve ray_tpu/util).
+`--changed` narrows that to files git reports as modified/added (staged,
+unstaged, or untracked) relative to `--base` (default HEAD) — the incremental
+mode for pre-commit loops; the baseline-drift check is a tree-level contract
+and only runs in full-tree mode.
 Exit status is non-zero when there are unsuppressed findings, parse errors, or
 the inline suppressions drift from the checked-in baseline
 (ray_tpu/_private/lint/baseline.json).
@@ -18,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
 
@@ -38,6 +45,29 @@ from ray_tpu._private.lint import (  # noqa: E402
 DEFAULT_PATHS = ["ray_tpu/models", "ray_tpu/serve", "ray_tpu/util"]
 
 
+def _changed_files(base: str, root: Path) -> list:
+    """Python files touched vs `base`: committed-diff + staged + unstaged
+    (ACMR: added/copied/modified/renamed) plus untracked, deduped."""
+    names = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "--diff-filter=ACMR", base],
+        ["git", "diff", "--name-only", "--diff-filter=ACMR", "--cached", base],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            out = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True, check=True
+            ).stdout
+        except (OSError, subprocess.CalledProcessError) as exc:
+            raise RuntimeError(
+                f"git failed ({' '.join(cmd)}): {exc}"
+            ) from exc
+        names.update(line.strip() for line in out.splitlines() if line.strip())
+    return sorted(
+        root / n for n in names if n.endswith(".py") and (root / n).exists()
+    )
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -54,6 +84,17 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="list registered analyzers and exit"
+    )
+    parser.add_argument(
+        "--changed",
+        action="store_true",
+        help="lint only files touched vs --base (git diff + untracked); "
+        "restricted to the given paths (default: the serving tree)",
+    )
+    parser.add_argument(
+        "--base",
+        default="HEAD",
+        help="git ref --changed diffs against (default: HEAD)",
     )
     parser.add_argument(
         "--show-suppressed",
@@ -97,6 +138,22 @@ def main(argv=None) -> int:
             path = _REPO_ROOT / p
         paths.append(path)
 
+    if args.changed:
+        try:
+            changed = _changed_files(args.base, _REPO_ROOT)
+        except RuntimeError as exc:
+            print(f"graft_lint: {exc}", file=sys.stderr)
+            return 2
+        scopes = [p.resolve() for p in paths]
+        paths = [
+            f for f in changed
+            if any(f.resolve() == s or s in f.resolve().parents
+                   for s in scopes)
+        ]
+        if not paths:
+            print(f"no changed python files vs {args.base} in scope")
+            return 0
+
     report = lint_paths(paths, rules=rules)
 
     if args.update_baseline:
@@ -106,8 +163,11 @@ def main(argv=None) -> int:
         return 0
 
     # The baseline is a tree-level contract: only check it when linting
-    # the default serving tree (no paths, or exactly the default set).
-    on_default_tree = not args.paths or sorted(args.paths) == sorted(DEFAULT_PATHS)
+    # the full default serving tree (no paths, or exactly the default set),
+    # never in --changed incremental mode.
+    on_default_tree = not args.changed and (
+        not args.paths or sorted(args.paths) == sorted(DEFAULT_PATHS)
+    )
     drift = []
     if not args.no_baseline and args.rule is None and on_default_tree:
         drift = diff_baseline(report, load_baseline(args.baseline))
