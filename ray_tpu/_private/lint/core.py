@@ -106,7 +106,8 @@ class LintConfig:
     # Files that own BlockPool handles (kv-refcount) / the dispatch ring
     # (flush-order) / donated sharded carries (sharding-pin).  The invariant
     # analyzers only fire where the invariant lives.
-    kv_files: frozenset = frozenset({"engine.py", "prefix_cache.py", "block_pool.py"})
+    kv_files: frozenset = frozenset({"engine.py", "prefix_cache.py", "block_pool.py",
+                                     "adapter_pool.py"})
     host_sync_allowed_functions: frozenset = frozenset({"_device_get", "_emit_block"})
     metric_prefixes: Tuple[str, ...] = (
         "llm_engine_",

@@ -35,8 +35,10 @@ from ray_tpu.models.lora import (
     lora_merge,
     lora_num_params,
     lora_param_specs,
+    lora_stack_specs,
     make_lora_train_step,
 )
+from ray_tpu.models.adapter_pool import AdapterPool
 from ray_tpu.models.t5 import (
     T5Config,
     t5_init,
@@ -64,6 +66,7 @@ from ray_tpu.models.fleet import (
 )
 from ray_tpu.models.prefix_cache import PrefixCacheIndex
 from ray_tpu.models.scheduler import (
+    AdapterAffinityPolicy,
     EngineDraining,
     EngineOverloaded,
     FIFOPolicy,
@@ -98,7 +101,10 @@ __all__ = [
     "lora_merge",
     "lora_num_params",
     "lora_param_specs",
+    "lora_stack_specs",
     "make_lora_train_step",
+    "AdapterPool",
+    "AdapterAffinityPolicy",
     "T5Config",
     "t5_init",
     "t5_forward",

@@ -1,0 +1,379 @@
+"""HBM adapter residency for multi-LoRA serving (S-LoRA / Punica style).
+
+The fleet's economics problem: thousands of per-customer fine-tunes
+over ONE base model, but a naive deployment needs one replica per
+adapter because the engine can only run one weight set. The fix is to
+keep the base matmul shared and apply per-row low-rank deltas — which
+turns adapter weights into CACHE STATE: a small working set lives in
+HBM, stacked along a slot axis so a single gather serves every row of
+a heterogeneous batch, and everything else stays on the host until
+traffic warms it.
+
+This module owns that residency:
+
+- Device stacks ``{name: {"a": [L, A, n_in, r], "b": [L, A, r,
+  n_out]}}`` with A = max_live_adapters + 1. Slot 0 is the NULL
+  adapter (all zeros — base-only rows gather an exactly-zero delta,
+  so one fused program serves mixed adapter/base batches with no
+  branching). The b-stacks are pre-scaled by ``alpha/rank`` at
+  registration so the decode path pays no per-step multiply.
+- Sharding: stacks go through `lora_stack_specs` under the SAME
+  pruned rule table as the engine's base weights, so adapters degrade
+  to replicated exactly when the base axis does.
+- Residency: LRU over refcount-0 residents. A slot acquired by a live
+  row (`alloc`/`incref`) is pinned — it can never be an eviction
+  victim until every holder `decref`s. This is the paged-KV block
+  discipline applied to adapter slots, and graftlint's kv-refcount
+  ownership rule audits call sites the same way.
+- Prefetch: cold adapters stage host→device with an ASYNC
+  `jax.device_put` (the swap ledger's transfer idiom — enqueue, don't
+  block) and commit into a slot on a later `drain_prefetches` call via
+  one jitted donated scatter (`_adapter_commit`, slot index traced so
+  every slot shares one compile). The scheduler defers the requester
+  meanwhile instead of stalling the step.
+
+Telemetry flows through the engine's metrics plane
+(``llm_engine_adapter_*``, see engine_metrics.py) and the request
+tracer ("adapter_prefetch" / "adapter_evict" instants).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models.lora import (LoraConfig, _in_out_split,
+                                 lora_stack_specs)
+from ray_tpu.models.llama import LlamaConfig, _layer_shapes
+
+Params = Dict[str, Any]
+
+
+@functools.partial(jax.jit, static_argnames=("shardings",),
+                   donate_argnames=("stacks",))
+def _adapter_commit(stacks, staged, slot, shardings=None):
+    """Scatter one staged adapter into stack slot ``slot`` (traced
+    scalar — one XLA program covers every slot; a static slot would
+    retrace per slot and trip the armed sanitizer on adapter churn).
+    ``stacks`` is donated: the pool holds the only reference, and a
+    copy of the full [L, A, ...] buffers per commit would dwarf the
+    adapter itself."""
+    sh = dict(shardings) if shardings is not None else {}
+    out = {}
+    for name in sorted(stacks):
+        ab = stacks[name]
+        a = ab["a"].at[:, slot].set(staged[name]["a"].astype(ab["a"].dtype))
+        b = ab["b"].at[:, slot].set(staged[name]["b"].astype(ab["b"].dtype))
+        if name in sh:
+            a = jax.lax.with_sharding_constraint(a, sh[name][0])
+            b = jax.lax.with_sharding_constraint(b, sh[name][1])
+        out[name] = {"a": a, "b": b}
+    return out
+
+
+class AdapterPool:
+    """LRU residency manager for stacked LoRA adapters in HBM.
+
+    Ownership contract (mirrors block_pool.py's KV blocks): `alloc`
+    returns a slot with one reference taken; the holder must `decref`
+    exactly once (row retirement, preemption, halt) or hand the slot
+    to another owner. `incref` adds holders. Slot 0 (null adapter) is
+    refcount-exempt: it is never evicted and never freed.
+    """
+
+    def __init__(self, cfg: LlamaConfig, lora_cfg: LoraConfig, *,
+                 max_live_adapters: int = 4,
+                 mesh: Optional[Mesh] = None,
+                 rules=None, metrics=None, trace=None):
+        if max_live_adapters < 1:
+            raise ValueError(
+                f"max_live_adapters must be >= 1, got {max_live_adapters}")
+        self.cfg = cfg
+        self.lora_cfg = lora_cfg
+        self.max_live_adapters = max_live_adapters
+        self.n_slots = max_live_adapters + 1    # + slot 0 = null adapter
+        self.mesh = mesh
+        self.metrics = metrics
+        self.trace = trace
+
+        shapes = _layer_shapes(cfg)
+        self._dims: Dict[str, Tuple[int, int]] = {}
+        for name in lora_cfg.targets:
+            shape, _logical, fan_in = shapes[name]
+            self._dims[name] = _in_out_split(shape, fan_in)
+
+        dt = cfg.param_dtype
+        self._np_dtype = np.dtype(jnp.zeros((), dt).dtype)
+        stacks: Params = {}
+        for name, (n_in, n_out) in self._dims.items():
+            stacks[name] = {
+                "a": jnp.zeros((cfg.n_layers, self.n_slots, n_in,
+                                lora_cfg.rank), dt),
+                "b": jnp.zeros((cfg.n_layers, self.n_slots,
+                                lora_cfg.rank, n_out), dt),
+            }
+        self._commit_shardings = None
+        self._staged_sh: Optional[Dict[str, Tuple]] = None
+        if mesh is not None:
+            specs = lora_stack_specs(cfg, lora_cfg, rules)
+            stacks = {
+                name: {k: jax.device_put(
+                    v, NamedSharding(mesh, specs[name][k]))
+                    for k, v in ab.items()}
+                for name, ab in stacks.items()}
+            # Static tuple for the jitted commit's output constraint,
+            # plus per-adapter staging shardings (stack spec minus the
+            # slot axis) so the async device_put lands pre-sharded.
+            self._commit_shardings = tuple(
+                (name, (NamedSharding(mesh, specs[name]["a"]),
+                        NamedSharding(mesh, specs[name]["b"])))
+                for name in sorted(self._dims))
+            self._staged_sh = {
+                name: (NamedSharding(mesh, P(specs[name]["a"][0],
+                                             specs[name]["a"][2],
+                                             specs[name]["a"][3])),
+                       NamedSharding(mesh, P(specs[name]["b"][0],
+                                             specs[name]["b"][2],
+                                             specs[name]["b"][3])))
+                for name in self._dims}
+        self.stacks = stacks
+
+        # Host-side ledger. _registry holds pre-scaled host copies (the
+        # "disk tier"); _slot_of/_slot_aid map residency; _refs pins;
+        # _lru orders refcount-0 residents for eviction; _fetching holds
+        # in-flight async host->device stages.
+        self._registry: Dict[str, Params] = {}
+        self._slot_of: Dict[str, int] = {}
+        self._slot_aid: List[Optional[str]] = [None] * self.n_slots
+        self._refs = [0] * self.n_slots
+        self._free: List[int] = list(range(self.n_slots - 1, 0, -1))
+        self._lru: "collections.OrderedDict[str, int]" = \
+            collections.OrderedDict()
+        self._fetching: Dict[str, Params] = {}
+        self._doomed: set = set()
+
+        self.lookups = 0
+        self.hits = 0
+        self.prefetches = 0
+        self.evictions = 0
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, adapter_id: str, lora: Params) -> None:
+        """Admit an adapter's weights to the host tier. ``lora`` is a
+        `lora_init`-shaped tree ({"layers": {name: {"a","b"}}}); the
+        b factors are pre-scaled by alpha/rank here so decode gathers
+        need no scale multiply. Host copies only — HBM is touched by
+        `prefetch`, not registration."""
+        if not adapter_id:
+            raise ValueError("adapter_id must be a non-empty string")
+        layers = lora.get("layers", lora)
+        missing = set(self._dims) - set(layers)
+        if missing:
+            raise ValueError(
+                f"adapter {adapter_id!r} missing targets {sorted(missing)} "
+                f"(pool targets: {sorted(self._dims)})")
+        host: Params = {}
+        scale = self.lora_cfg.scale
+        for name, (n_in, n_out) in self._dims.items():
+            a = np.asarray(layers[name]["a"], np.float32)
+            b = np.asarray(layers[name]["b"], np.float32)
+            want_a = (self.cfg.n_layers, n_in, self.lora_cfg.rank)
+            want_b = (self.cfg.n_layers, self.lora_cfg.rank, n_out)
+            if a.shape != want_a or b.shape != want_b:
+                raise ValueError(
+                    f"adapter {adapter_id!r} {name}: shapes "
+                    f"{a.shape}/{b.shape}, want {want_a}/{want_b}")
+            host[name] = {"a": a.astype(self._np_dtype),
+                          "b": (b * scale).astype(self._np_dtype)}
+        self._registry[adapter_id] = host
+        self._doomed.discard(adapter_id)
+
+    def unregister(self, adapter_id: str) -> bool:
+        """Drop an adapter. If it is pinned by live rows the removal is
+        DEFERRED until the last decref (returns False); otherwise it
+        leaves the registry — and its slot, if resident — immediately
+        (returns True). Stale stack bytes in a freed slot are
+        unreachable: no row holds its index and the next commit
+        overwrites it."""
+        if adapter_id not in self._registry:
+            return True
+        slot = self._slot_of.get(adapter_id)
+        if slot is not None and self._refs[slot] > 0:
+            self._doomed.add(adapter_id)
+            return False
+        self._fetching.pop(adapter_id, None)
+        if slot is not None:
+            self._release_slot(adapter_id, slot)
+        del self._registry[adapter_id]
+        self._doomed.discard(adapter_id)
+        return True
+
+    def registered(self, adapter_id: str) -> bool:
+        return adapter_id in self._registry
+
+    def adapter_ids(self) -> List[str]:
+        return sorted(self._registry)
+
+    # -- residency queries -------------------------------------------------
+
+    def resident(self, adapter_id: Optional[str]) -> bool:
+        return adapter_id is None or adapter_id in self._slot_of
+
+    def fetching(self, adapter_id: str) -> bool:
+        return adapter_id in self._fetching
+
+    # -- slot ownership (kv-refcount discipline) ---------------------------
+
+    def alloc(self, adapter_id: Optional[str]) -> Optional[int]:
+        """Acquire a slot for one row. None adapter -> slot 0 (no
+        reference taken; the null slot is permanent). A resident
+        adapter returns its slot with one reference added (pinning it
+        against eviction); a cold adapter returns None — call
+        `prefetch` and retry after `drain_prefetches` commits."""
+        if adapter_id is None:
+            return 0
+        if adapter_id not in self._registry:
+            raise KeyError(f"unknown adapter_id {adapter_id!r}")
+        self.lookups += 1
+        slot = self._slot_of.get(adapter_id)
+        hit = slot is not None
+        if self.metrics is not None:
+            self.metrics.on_adapter_lookup(hit)
+        if not hit:
+            return None
+        self.hits += 1
+        self._lru.pop(adapter_id, None)
+        self._refs[slot] += 1
+        return slot
+
+    def incref(self, slot: int) -> None:
+        if slot == 0:
+            return
+        aid = self._slot_aid[slot]
+        if aid is None:
+            raise ValueError(f"incref on unowned slot {slot}")
+        self._lru.pop(aid, None)
+        self._refs[slot] += 1
+
+    def decref(self, slot: int) -> None:
+        if slot == 0:
+            return
+        aid = self._slot_aid[slot]
+        if aid is None or self._refs[slot] <= 0:
+            raise ValueError(f"decref on unheld slot {slot}")
+        self._refs[slot] -= 1
+        if self._refs[slot] == 0:
+            if aid in self._doomed:
+                self._release_slot(aid, slot)
+                self._registry.pop(aid, None)
+                self._doomed.discard(aid)
+            else:
+                self._lru[aid] = slot       # newest eviction candidate
+
+    def _release_slot(self, adapter_id: str, slot: int) -> None:
+        self._slot_of.pop(adapter_id, None)
+        self._lru.pop(adapter_id, None)
+        self._slot_aid[slot] = None
+        self._refs[slot] = 0
+        self._free.append(slot)
+
+    # -- prefetch / commit -------------------------------------------------
+
+    def prefetch(self, adapter_id: str) -> bool:
+        """Begin warming a cold adapter: enqueue its host tree on an
+        async host->device transfer. Non-blocking — the commit into a
+        stack slot happens at the next `drain_prefetches`. Returns
+        True if the adapter is already resident (nothing to do)."""
+        if adapter_id in self._slot_of:
+            return True
+        if adapter_id not in self._registry:
+            raise KeyError(f"unknown adapter_id {adapter_id!r}")
+        if adapter_id in self._fetching:
+            return False
+        host = self._registry[adapter_id]
+        if self._staged_sh is not None:
+            staged = {name: {
+                "a": jax.device_put(ab["a"], self._staged_sh[name][0]),
+                "b": jax.device_put(ab["b"], self._staged_sh[name][1])}
+                for name, ab in host.items()}
+        else:
+            staged = {name: {"a": jax.device_put(ab["a"]),
+                             "b": jax.device_put(ab["b"])}
+                      for name, ab in host.items()}
+        self._fetching[adapter_id] = staged
+        self.prefetches += 1
+        if self.metrics is not None:
+            self.metrics.on_adapter_prefetch()
+        if self.trace is not None and self.trace.enabled:
+            self.trace.instant("adapter_prefetch", lane="events",
+                               args={"adapter_id": adapter_id})
+        return False
+
+    def drain_prefetches(self) -> int:
+        """Commit every staged adapter that can get a slot (free slot
+        first, else the LRU refcount-0 resident is evicted). Staged
+        adapters left slotless — every slot pinned — stay in flight
+        and retry next drain. Returns the number committed."""
+        if not self._fetching:
+            return 0
+        committed = 0
+        for aid in list(self._fetching):
+            slot = self._take_slot()
+            if slot is None:
+                break                       # every slot pinned
+            staged = self._fetching.pop(aid)
+            self.stacks = _adapter_commit(
+                self.stacks, staged, jnp.int32(slot),
+                shardings=self._commit_shardings)
+            self._slot_of[aid] = slot
+            self._slot_aid[slot] = aid
+            self._refs[slot] = 0
+            self._lru[aid] = slot
+            committed += 1
+        if committed and self.metrics is not None:
+            self.metrics.on_adapter_slots(self.n_slots - 1,
+                                          len(self._slot_of),
+                                          self.pinned_slots())
+        return committed
+
+    def _take_slot(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        if not self._lru:
+            return None
+        victim_aid, slot = self._lru.popitem(last=False)   # coldest
+        del self._slot_of[victim_aid]
+        self._slot_aid[slot] = None
+        self.evictions += 1
+        if self.metrics is not None:
+            self.metrics.on_adapter_evict()
+        if self.trace is not None and self.trace.enabled:
+            self.trace.instant("adapter_evict", lane="events",
+                               args={"adapter_id": victim_aid})
+        return slot
+
+    # -- introspection -----------------------------------------------------
+
+    def pinned_slots(self) -> int:
+        return sum(1 for r in self._refs[1:] if r > 0)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "adapters_registered": float(len(self._registry)),
+            "adapter_slots": float(self.n_slots - 1),
+            "adapter_slots_resident": float(len(self._slot_of)),
+            "adapter_slots_pinned": float(self.pinned_slots()),
+            "adapter_lookups": float(self.lookups),
+            "adapter_hits": float(self.hits),
+            "adapter_hit_rate": (self.hits / self.lookups
+                                 if self.lookups else 0.0),
+            "adapter_prefetches": float(self.prefetches),
+            "adapter_evictions": float(self.evictions),
+        }

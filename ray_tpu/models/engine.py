@@ -84,6 +84,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu._private import sanitize as _sanitize
+from ray_tpu.models.adapter_pool import AdapterPool
 from ray_tpu.models.block_pool import BlockPool
 from ray_tpu.models.engine_metrics import EngineMetrics, NullEngineMetrics
 from ray_tpu.models.engine_trace import resolve_tracer
@@ -200,7 +201,9 @@ class _EngineShardings:
 def _prefill_rows(params: Params, prompts: jax.Array, cache,
                   last_logits, rows: jax.Array, starts: jax.Array,
                   last_idx: jax.Array, cfg: LlamaConfig,
-                  shardings: Optional[_EngineShardings] = None):
+                  shardings: Optional[_EngineShardings] = None,
+                  adapters: Optional[Params] = None,
+                  row_slot: Optional[jax.Array] = None):
     """Batched admission/continuation prefill: write N same-bucket
     chunks' [N, Cb] K/V into N slots in ONE program — each row at its
     OWN cache offset ``starts[n]`` (0 for a cold admission; the cached
@@ -220,10 +223,18 @@ def _prefill_rows(params: Params, prompts: jax.Array, cache,
     `last_logits` (earlier chunks' scatters are overwritten before the
     row ever decodes). `rows` may contain duplicates (power-of-two
     group padding repeats the last admission verbatim): duplicate
-    scatters write identical values, so the result is deterministic."""
+    scatters write identical values, so the result is deterministic.
+
+    Multi-LoRA: ``adapters``/``row_slot`` (the pool stacks + this
+    chunk's PER-CHUNK slot lane [N], gathered from the engine's [B]
+    lane at the dispatch site) thread to `_layer_body`'s per-row
+    deltas; None (the default) adds no pytree leaves, so adapter-less
+    engines trace the exact pre-LoRA program."""
     row_cache = {"k": cache["k"][:, rows], "v": cache["v"][:, rows]}
     logits, row_cache = forward_cached_rows(params, prompts, row_cache,
-                                            starts, cfg)
+                                            starts, cfg,
+                                            adapters=adapters,
+                                            row_slot=row_slot)
     cache = {
         "k": cache["k"].at[:, rows].set(row_cache["k"]),
         "v": cache["v"].at[:, rows].set(row_cache["v"]),
@@ -324,7 +335,7 @@ def _prefix_copy_out(cache_k, cache_v, pool_k, pool_v, row,
 
 
 def _decode_layer_rows(h, layer, k_cache, v_cache, write_slots,
-                       cfg: LlamaConfig):
+                       cfg: LlamaConfig, lora=None, lora_slots=None):
     """One decoder layer, one new token per row, each row writing its
     K/V at its own slot (scatter) and attending its own prefix.
 
@@ -349,11 +360,12 @@ def _decode_layer_rows(h, layer, k_cache, v_cache, write_slots,
 
     return _layer_body(h, layer, k_cache, v_cache,
                        write_slots[:, None], write_kv,
-                       write_slots[:, None], k_cache.shape[1], cfg)
+                       write_slots[:, None], k_cache.shape[1], cfg,
+                       lora=lora, lora_slots=lora_slots)
 
 
 def _decode_core(params: Params, toks: jax.Array, cache, row_len,
-                 cfg: LlamaConfig):
+                 cfg: LlamaConfig, adapters=None, row_slot=None):
     """One decode step for ALL slots: row b's token `toks[b]` is
     written at slot `row_len[b]` and attends slots [0, row_len[b]].
     Dead/frozen rows compute discarded garbage at their frontier slot —
@@ -366,13 +378,20 @@ def _decode_core(params: Params, toks: jax.Array, cache, row_len,
 
     def body(carry, xs):
         h = carry
-        layer, k_c, v_c = xs
+        if adapters is None:
+            layer, k_c, v_c = xs
+            lora = None
+        else:
+            layer, k_c, v_c, lora = xs
         h, k_c, v_c = _decode_layer_rows(h, layer, k_c, v_c,
-                                         write_slots, cfg)
+                                         write_slots, cfg, lora=lora,
+                                         lora_slots=row_slot)
         return h, (k_c, v_c)
 
-    h, (k_new, v_new) = jax.lax.scan(
-        body, h, (params["layers"], cache["k"], cache["v"]))
+    xs = (params["layers"], cache["k"], cache["v"])
+    if adapters is not None:
+        xs = xs + (adapters,)
+    h, (k_new, v_new) = jax.lax.scan(body, h, xs)
     h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bsd,dv->bsv", h,
                         params["lm_head"].astype(cfg.dtype),
@@ -390,7 +409,9 @@ def _decode_multi(params: Params, cache, last_logits, row_len, active,
                   cfg: LlamaConfig, horizon: int, greedy: bool,
                   top_k: Optional[int], top_p: Optional[float],
                   eos_id: Optional[int],
-                  shardings: Optional[_EngineShardings] = None):
+                  shardings: Optional[_EngineShardings] = None,
+                  adapters: Optional[Params] = None,
+                  row_slot: Optional[jax.Array] = None):
     """Fuse `horizon` decode iterations into ONE program: a `lax.scan`
     whose body samples every row's next token ON DEVICE from the
     carried `last_logits` (greedy argmax, or per-row rng streams — see
@@ -447,7 +468,9 @@ def _decode_multi(params: Params, cache, last_logits, row_len, active,
         if eos_id is not None:
             done_now = done_now | (tok == eos_id)
         cont = active & ~done_now
-        logits, cache = _decode_core(params, tok, cache, row_len, cfg)
+        logits, cache = _decode_core(params, tok, cache, row_len, cfg,
+                                     adapters=adapters,
+                                     row_slot=row_slot)
         row_len = row_len + cont.astype(jnp.int32)
         last_logits = jnp.where(cont[:, None], logits, last_logits)
         if shardings is not None:
@@ -650,7 +673,9 @@ def _prefill_rows_paged(params: Params, prompts: jax.Array, pool_k,
                         pool_v, last_logits, bt: jax.Array,
                         rows: jax.Array, starts: jax.Array,
                         last_idx: jax.Array, cfg: LlamaConfig,
-                        shardings: Optional[_EngineShardings] = None):
+                        shardings: Optional[_EngineShardings] = None,
+                        adapters: Optional[Params] = None,
+                        row_slot: Optional[jax.Array] = None):
     """`_prefill_rows` for the block pool: gather each admission row's
     full [max_len] view through its block table, run the SAME
     `forward_cached_rows` math, scatter the view back block-by-block.
@@ -682,7 +707,9 @@ def _prefill_rows_paged(params: Params, prompts: jax.Array, pool_k,
         "v": blk_v.reshape(L, N, MB * T, *blk_v.shape[4:]),
     }
     logits, row_cache = forward_cached_rows(params, prompts, row_cache,
-                                            starts, cfg)
+                                            starts, cfg,
+                                            adapters=adapters,
+                                            row_slot=row_slot)
     k = row_cache["k"].reshape(L, N, MB, T, *blk_k.shape[4:])
     v = row_cache["v"].reshape(L, N, MB, T, *blk_v.shape[4:])
     pool_k = pool_k.at[:, bt].set(k.astype(pool_k.dtype))
@@ -699,7 +726,8 @@ def _prefill_rows_paged(params: Params, prompts: jax.Array, pool_k,
 
 
 def _decode_layer_rows_paged(h, layer, k_pages, v_pages, bt,
-                             write_slots, cfg: LlamaConfig):
+                             write_slots, cfg: LlamaConfig,
+                             lora=None, lora_slots=None):
     """`_decode_layer_rows` against the pool: row b's new K/V scatter
     into physical block ``bt[b, slot//T]`` at offset ``slot%T`` and
     attention reads back through `ops.attention.paged_attention` (the
@@ -727,11 +755,12 @@ def _decode_layer_rows_paged(h, layer, k_pages, v_pages, bt,
 
     return _layer_body(h, layer, k_pages, v_pages, write_slots[:, None],
                        write_kv, write_slots[:, None], span, cfg,
-                       attend=attend)
+                       attend=attend, lora=lora, lora_slots=lora_slots)
 
 
 def _decode_core_paged(params: Params, toks: jax.Array, pool_k, pool_v,
-                       bt, row_len, cfg: LlamaConfig):
+                       bt, row_len, cfg: LlamaConfig, adapters=None,
+                       row_slot=None):
     """`_decode_core` over the pool: the layer scan unstacks the pool's
     layer axis exactly as the dense scan unstacks the cache's. Plain
     function so `_decode_multi_paged`'s scan can inline it."""
@@ -740,13 +769,21 @@ def _decode_core_paged(params: Params, toks: jax.Array, pool_k, pool_v,
 
     def body(carry, xs):
         h = carry
-        layer, k_p, v_p = xs
+        if adapters is None:
+            layer, k_p, v_p = xs
+            lora = None
+        else:
+            layer, k_p, v_p, lora = xs
         h, k_p, v_p = _decode_layer_rows_paged(h, layer, k_p, v_p, bt,
-                                               write_slots, cfg)
+                                               write_slots, cfg,
+                                               lora=lora,
+                                               lora_slots=row_slot)
         return h, (k_p, v_p)
 
-    h, (k_new, v_new) = jax.lax.scan(
-        body, h, (params["layers"], pool_k, pool_v))
+    xs = (params["layers"], pool_k, pool_v)
+    if adapters is not None:
+        xs = xs + (adapters,)
+    h, (k_new, v_new) = jax.lax.scan(body, h, xs)
     h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bsd,dv->bsv", h,
                         params["lm_head"].astype(cfg.dtype),
@@ -766,7 +803,9 @@ def _decode_multi_paged(params: Params, pool_k, pool_v, bt,
                         horizon: int, greedy: bool,
                         top_k: Optional[int], top_p: Optional[float],
                         eos_id: Optional[int],
-                        shardings: Optional[_EngineShardings] = None):
+                        shardings: Optional[_EngineShardings] = None,
+                        adapters: Optional[Params] = None,
+                        row_slot: Optional[jax.Array] = None):
     """`_decode_multi` with the pool + block tables standing in for
     the dense cache: identical scan body, identical per-iteration
     transition, identical [H, B] single-transfer contract — only the
@@ -796,7 +835,8 @@ def _decode_multi_paged(params: Params, pool_k, pool_v, bt,
             done_now = done_now | (tok == eos_id)
         cont = active & ~done_now
         logits, pool_k, pool_v = _decode_core_paged(
-            params, tok, pool_k, pool_v, bt, row_len, cfg)
+            params, tok, pool_k, pool_v, bt, row_len, cfg,
+            adapters=adapters, row_slot=row_slot)
         row_len = row_len + cont.astype(jnp.int32)
         last_logits = jnp.where(cont[:, None], logits, last_logits)
         if shardings is not None:
@@ -1010,7 +1050,7 @@ def _swap_in_scatter(pool_k, pool_v, host_k, host_v,
 class _Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "tokens", "done",
                  "priority", "seq", "rng", "deadline", "shed", "resume",
-                 "greedy")
+                 "greedy", "adapter_id")
 
     def __init__(self, req_id: int, prompt: List[int],
                  max_new_tokens: int, priority: int = 0, seq: int = 0,
@@ -1028,6 +1068,7 @@ class _Request:
         self.shed = False           # retired past-deadline, no prefill run
         self.resume = False         # preempted; re-queued to swap back in
         self.greedy = None          # per-request decode-mode override
+        self.adapter_id = None      # LoRA adapter (None = base model)
 
 
 class _PrefillState:
@@ -1198,6 +1239,8 @@ class DecodeEngine:
                  draft_params: Optional[Params] = None,
                  draft_cfg: Optional[LlamaConfig] = None,
                  spec_window: int = 4,
+                 lora: Optional["LoraConfig"] = None,
+                 max_live_adapters: int = 4,
                  mesh: Optional[Mesh] = None,
                  tp: Optional[int] = None,
                  sharding_rules=None,
@@ -1239,6 +1282,15 @@ class DecodeEngine:
                     "needs a shared tokenizer")
             if spec_window < 1:
                 raise ValueError("spec_window must be >= 1")
+        if lora is not None:
+            if draft_params is not None:
+                raise ValueError(
+                    "lora= and draft_params= are mutually exclusive: "
+                    "the speculative draft/verify programs do not "
+                    "thread per-row adapter deltas (multi-LoRA "
+                    "speculative decoding is follow-up work)")
+            if max_live_adapters < 1:
+                raise ValueError("max_live_adapters must be >= 1")
         self.params = params
         self.cfg = cfg
         self.B = batch_slots
@@ -1371,6 +1423,33 @@ class DecodeEngine:
             self._shardings = None
             self._d_shardings = None
         self.metrics.on_tp_degree(self.tp_degree)
+
+        # Multi-LoRA serving plane (models/adapter_pool.py): device
+        # stacks of up to max_live_adapters LoRA weight sets, one slot
+        # lane mapping each batch row to its adapter (0 = base-only),
+        # and a pending map carrying the slot reference taken at the
+        # ADMISSION GATE to the row bind — the incref happens at the
+        # gate, not at bind, so a later candidate's prefetch-commit in
+        # the same admission round can never evict an adapter a
+        # decision was already made against. lora=None engines carry
+        # adapter_pool=None and every dispatch passes adapters=None
+        # (zero extra pytree leaves -> byte-identical programs).
+        self.lora_cfg = lora
+        self.adapter_pool = None
+        if lora is not None:
+            self.adapter_pool = AdapterPool(
+                cfg, lora, max_live_adapters=max_live_adapters,
+                mesh=self.mesh, rules=self._rules,
+                metrics=self.metrics, trace=self.trace)
+            self.metrics.on_adapter_slots(max_live_adapters, 0, 0)
+        self._row_slot = np.zeros((self.B,), np.int32)
+        self._pending_slots: Dict[int, int] = {}
+        self.adapter_deferrals = 0     # cold-adapter admission defers
+        if self.adapter_pool is not None:
+            attach = getattr(self.scheduler, "attach_adapter_probe",
+                             None)
+            if attach is not None:
+                attach(self._adapter_probe)
 
         # Paged KV mode: no dense per-slot cache at all — every row's
         # K/V lives in pool blocks behind its block table (state built
@@ -1621,7 +1700,8 @@ class DecodeEngine:
                rng: Optional[jax.Array] = None,
                deadline_s: Optional[float] = None,
                greedy: Optional[bool] = None,
-               resume_tokens: Optional[List[int]] = None) -> int:
+               resume_tokens: Optional[List[int]] = None,
+               adapter_id: Optional[str] = None) -> int:
         """Enqueue a request; returns its id (see `results`).
 
         ``priority`` (lower = sooner) orders admission under the
@@ -1669,7 +1749,24 @@ class DecodeEngine:
         are not a shareable prompt). Pass the SAME ``rng`` as the
         original submission — sampled identity is the caller's key
         discipline (the fleet pins one key per request for exactly
-        this reason)."""
+        this reason).
+
+        ``adapter_id`` routes this request through a registered LoRA
+        adapter (see `register_adapter`): its rows decode with that
+        adapter's low-rank delta fused into the SAME batched program
+        as every other row — heterogeneous-adapter batches are the
+        point. A cold adapter defers the request at the admission gate
+        while its weights prefetch host->device; None (default) is the
+        base model, bit-identical to an engine without lora=."""
+        if adapter_id is not None:
+            if self.adapter_pool is None:
+                raise ValueError(
+                    "adapter_id= needs an engine built with lora= "
+                    "(a LoraConfig enabling the multi-LoRA plane)")
+            if not self.adapter_pool.registered(adapter_id):
+                raise KeyError(
+                    f"unknown adapter_id {adapter_id!r}: call "
+                    "register_adapter first")
         if self.draining:
             raise EngineDraining(
                 "engine is draining (begin_drain was called): it will "
@@ -1730,6 +1827,7 @@ class DecodeEngine:
                            rng=None if rng is None else _key_data(rng),
                            deadline=deadline)
             req.greedy = greedy
+            req.adapter_id = adapter_id
             self._next_id += 1
             self.results[req.req_id] = req
             self.metrics.on_submit(req.req_id)
@@ -1765,6 +1863,7 @@ class DecodeEngine:
                        rng=None if rng is None else _key_data(rng),
                        deadline=deadline)
         req.greedy = greedy
+        req.adapter_id = adapter_id
         if resume is not None:
             # Fleet failover resume: the request continues, not
             # restarts — admission replays prompt + these tokens and
@@ -1796,6 +1895,41 @@ class DecodeEngine:
         return bool(len(self.scheduler)) or any(
             r is not None for r in self.row_req)
 
+    # -- multi-LoRA adapter table ------------------------------------------
+
+    def register_adapter(self, adapter_id: str, lora_params: Params
+                         ) -> None:
+        """Admit a LoRA adapter's weights (a `lora_init`-shaped tree)
+        to the engine's host-side adapter table. HBM is untouched
+        until traffic warms the adapter through the prefetch path."""
+        if self.adapter_pool is None:
+            raise ValueError(
+                "register_adapter needs an engine built with lora=")
+        self.adapter_pool.register(adapter_id, lora_params)
+
+    def unregister_adapter(self, adapter_id: str) -> bool:
+        """Drop an adapter (deferred until its last live row retires
+        if currently pinned; returns False then, True when immediate).
+        Requests still QUEUED for it must not outlive the
+        registration — the admission gate raises on unknown ids."""
+        if self.adapter_pool is None:
+            return True
+        return self.adapter_pool.unregister(adapter_id)
+
+    def adapter_resident(self, adapter_id: str) -> bool:
+        """True when the adapter currently occupies an HBM slot — the
+        fleet router's residency-affinity probe."""
+        return (self.adapter_pool is not None
+                and self.adapter_pool.resident(adapter_id))
+
+    def _adapter_probe(self, adapter_id: Optional[str]
+                       ) -> Tuple[bool, bool]:
+        """(resident, fetching) for the adapter-affinity scheduler."""
+        if adapter_id is None or self.adapter_pool is None:
+            return True, False
+        return (self.adapter_pool.resident(adapter_id),
+                self.adapter_pool.fetching(adapter_id))
+
     # The fused entry points whose compile caches the sanitizer audits:
     # any growth after arm() is a steady-state retrace regression.
     _SANITIZER_JIT_ENTRY_POINTS = (
@@ -1817,6 +1951,10 @@ class DecodeEngine:
             self.sanitizer = _sanitize.Sanitizer(label=self.engine_id)
         for name in self._SANITIZER_JIT_ENTRY_POINTS:
             self.sanitizer.watch(name, globals().get(name))
+        if self.adapter_pool is not None:
+            from ray_tpu.models import adapter_pool as _adapter_pool
+            self.sanitizer.watch("_adapter_commit",
+                                 _adapter_pool._adapter_commit)
         self.sanitizer.arm()
         return self.sanitizer
 
@@ -1876,6 +2014,13 @@ class DecodeEngine:
         begin = getattr(self.scheduler, "begin_admission_round", None)
         if begin is not None:
             begin()
+        # Commit any landed adapter prefetches before gating: the
+        # commit donates the stacks, so it must never race an
+        # in-flight dispatch — with the ring empty (flushed above
+        # whenever admissions were pending) nothing on device still
+        # reads the old stack buffers.
+        if self.adapter_pool is not None and not self._ring:
+            self.adapter_pool.drain_prefetches()
         deferred = False
         for row in range(self.B):
             if budget <= 0 or deferred:
@@ -1907,6 +2052,21 @@ class DecodeEngine:
                     self._requeue_front(cand)
                     deferred = True
                     break
+                if cand.adapter_id is not None:
+                    # Adapter residency gate: acquire the slot HERE
+                    # (refcount taken) so nothing admitted later this
+                    # round can evict it; a cold adapter starts its
+                    # async prefetch and the request waits at the
+                    # queue front instead of stalling the step.
+                    slot = self.adapter_pool.alloc(cand.adapter_id)
+                    if slot is None:
+                        self.adapter_pool.prefetch(cand.adapter_id)
+                        self._requeue_front(cand)
+                        self.adapter_deferrals += 1
+                        self.metrics.on_adapter_defer()
+                        deferred = True
+                        break
+                    self._pending_slots[cand.req_id] = slot
                 req = cand
                 break
             if req is None:
@@ -2132,6 +2292,15 @@ class DecodeEngine:
         # engines compile the same two programs they always did.
         rg = jnp.asarray(self._row_greedy)
         all_greedy = bool(self._row_greedy.all())
+        # Multi-LoRA lane: the pool stacks + the [B] slot lane ride
+        # every dispatch (slot 0 = zero null adapter, so base-only
+        # rows are untouched); adapter_pool=None passes None/None —
+        # no extra pytree leaves, the exact pre-LoRA programs.
+        if self.adapter_pool is not None:
+            adapters = self.adapter_pool.stacks
+            row_slot = jnp.asarray(self._row_slot)
+        else:
+            adapters = row_slot = None
         if self.paged:
             # Snapshot the block table at dispatch: jnp.asarray copies
             # it to device, so host-side growth between chained
@@ -2147,14 +2316,16 @@ class DecodeEngine:
                 self._last_logits, *args, jnp.asarray(self._row_keys),
                 rg, self.temperature, self.cfg, H, all_greedy,
                 self.top_k, self.top_p, self.eos_id,
-                shardings=self._shardings)
+                shardings=self._shardings, adapters=adapters,
+                row_slot=row_slot)
         else:
             toks, self.cache, self._last_logits, rl, ac, bu, ti = \
                 _decode_multi(
                     self.params, self.cache, self._last_logits, *args,
                     jnp.asarray(self._row_keys), rg, self.temperature,
                     self.cfg, H, all_greedy, self.top_k, self.top_p,
-                    self.eos_id, shardings=self._shardings)
+                    self.eos_id, shardings=self._shardings,
+                    adapters=adapters, row_slot=row_slot)
         _host_async(toks)
         self._ring.append(_InflightStep(toks, H, list(rows),
                                         run_ahead=chain is not None,
@@ -2397,6 +2568,21 @@ class DecodeEngine:
         if self.spec_enabled and self.paged:
             out["spec_kv_pool_blocks_in_use"] = float(
                 self.kv_pool_d.blocks_in_use)
+        # Multi-LoRA plane: identically 0.0 with no adapter pool, so
+        # fleet rollups (and the perf gate's zero check) need no mode
+        # branch. Pool fields come from AdapterPool.stats().
+        out["adapter_enabled"] = 1.0 if self.adapter_pool else 0.0
+        out["adapter_prefetch_deferrals"] = float(self.adapter_deferrals)
+        if self.adapter_pool is not None:
+            out.update(self.adapter_pool.stats())
+        else:
+            out.update({
+                "adapters_registered": 0.0, "adapter_slots": 0.0,
+                "adapter_slots_resident": 0.0,
+                "adapter_slots_pinned": 0.0, "adapter_lookups": 0.0,
+                "adapter_hits": 0.0, "adapter_hit_rate": 0.0,
+                "adapter_prefetches": 0.0, "adapter_evictions": 0.0,
+            })
         return out
 
     def run(self) -> Dict[int, List[int]]:
@@ -2480,10 +2666,23 @@ class DecodeEngine:
                     self._release_row_blocks(row)
                 except Exception:
                     pass
+            if self._row_slot[row] and self.adapter_pool is not None:
+                try:
+                    self.adapter_pool.decref(int(self._row_slot[row]))
+                except Exception:
+                    pass
+            self._row_slot[row] = 0
             self.row_req[row] = None
             self.row_len[row] = 0
             self.row_budget[row] = 0
             self._tok_idx[row] = 0
+        if self.adapter_pool is not None:
+            for slot in self._pending_slots.values():
+                try:
+                    self.adapter_pool.decref(slot)
+                except Exception:
+                    pass
+        self._pending_slots.clear()
         if self.paged:
             self._swapped.clear()
         # Drop the queue wholesale (a fresh empty policy, not N pops:
@@ -2645,6 +2844,8 @@ class DecodeEngine:
                 self._row_greedy[row] = (self.greedy
                                          if req.greedy is None
                                          else bool(req.greedy))
+                self._row_slot[row] = self._pending_slots.pop(
+                    req.req_id, 0)
                 self._row_prefill[row] = _PrefillState(req, 0, [],
                                                        prompt=replay)
                 if self.spec_enabled:
@@ -2652,7 +2853,11 @@ class DecodeEngine:
                 continue
             start = 0
             nodes: list = []
-            if self._prefix is not None:
+            # Adapter rows BYPASS the prefix trie entirely: their K/V
+            # depends on the adapter's deltas, so a block produced
+            # under adapter X must never be matched by (or registered
+            # for) a request under adapter Y or the base model.
+            if self._prefix is not None and req.adapter_id is None:
                 ids, _ = self._prefix.match(req.prompt)
                 self.prefix_lookups += 1
                 T = self.prefix_block
@@ -2682,6 +2887,7 @@ class DecodeEngine:
             self._row_keys[row] = self._req_key(req)
             self._row_greedy[row] = (self.greedy if req.greedy is None
                                      else bool(req.greedy))
+            self._row_slot[row] = self._pending_slots.pop(req.req_id, 0)
             self._row_prefill[row] = _PrefillState(req, start, nodes)
             if self.spec_enabled:
                 # The draft plane has no prefix cache: even a warm
@@ -2731,6 +2937,7 @@ class DecodeEngine:
                     # earlier admission this step took the headroom):
                     # requeue; the slot stays empty this round.
                     self._swapped[req.req_id] = swap
+                    self._drop_pending_slot(req)
                     self._requeue_front(req)
                 elif self.spec_enabled:
                     # The swap ledger never carries the draft plane:
@@ -2747,7 +2954,9 @@ class DecodeEngine:
             shared: List[int] = []
             cow_src: Optional[int] = None
             nodes: list = []
-            if self._prefix is not None:
+            # Adapter rows bypass the trie (see _admit_rows): shared
+            # K/V must not cross adapter boundaries.
+            if self._prefix is not None and req.adapter_id is None:
                 ids, _ = self._prefix.match(req.prompt, allow_full=True)
                 self.prefix_lookups += 1
                 if ids and len(ids) * T == len(req.prompt):
@@ -2770,6 +2979,7 @@ class DecodeEngine:
             new_ids = self._pool_alloc(n_total - len(shared))
             if new_ids is None:
                 self.kv_pool.decref(shared)
+                self._drop_pending_slot(req)
                 if self.trace.enabled:
                     # Back to the queue: re-open queue_wait so the
                     # retry wait stays a span, not a trace gap.
@@ -2781,7 +2991,7 @@ class DecodeEngine:
                 self.kv_block_cows += 1
                 self.metrics.on_kv_cow()
             chain = shared + new_ids
-            if self._prefix is not None:
+            if self._prefix is not None and req.adapter_id is None:
                 hit = bool(shared) or cow_src is not None
                 if hit:
                     self.prefix_hits += 1
@@ -2891,6 +3101,7 @@ class DecodeEngine:
         self._row_keys[row] = self._req_key(req)
         self._row_greedy[row] = (self.greedy if req.greedy is None
                                  else bool(req.greedy))
+        self._row_slot[row] = self._pending_slots.pop(req.req_id, 0)
         self._row_admit_seq[row] = self._admit_seq
         self._admit_seq += 1
 
@@ -2898,6 +3109,17 @@ class DecodeEngine:
         pf = getattr(self.scheduler, "push_front", None)
         (pf if pf is not None else self.scheduler.push)(req)
         self.metrics.observe_queue_depth(len(self.scheduler))
+
+    def _drop_pending_slot(self, req: _Request) -> None:
+        """Return the adapter-slot reference the admission gate took
+        for a request that is being requeued AFTER the gate (stale
+        capacity estimate, swap-in failure). The request re-allocs —
+        re-increfs — at the gate on its next admission round, so the
+        pending reference must be dropped here or the slot leaks a
+        count and can never evict."""
+        slot = self._pending_slots.pop(req.req_id, 0)
+        if slot and self.adapter_pool is not None:
+            self.adapter_pool.decref(slot)
 
     def _pool_alloc(self, n: int) -> Optional[List[int]]:
         """n fresh blocks, evicting cold committed prefix blocks
@@ -3041,6 +3263,11 @@ class DecodeEngine:
                 int(self._tok_idx[row]), int(self.row_budget[row]),
                 None)
         self._release_row_blocks(row)
+        if self._row_slot[row]:
+            # The row's adapter reference dies with the row; the gate
+            # re-allocs (and may have to re-prefetch) at re-admission.
+            self.adapter_pool.decref(int(self._row_slot[row]))
+            self._row_slot[row] = 0
         self.row_req[row] = None
         self.row_len[row] = 0
         self.row_budget[row] = 0
@@ -3148,7 +3375,8 @@ class DecodeEngine:
                 need = -(-(len(req.prompt) + len(req.tokens)) // T)
         else:
             need = -(-len(req.prompt) // T)
-            if self._prefix is not None:
+            # Adapter rows take no prefix credit: they bypass the trie.
+            if self._prefix is not None and req.adapter_id is None:
                 ids, _ = self._prefix.match(req.prompt, peek=True,
                                             allow_full=True)
                 if ids and len(ids) * T == len(req.prompt):
@@ -3206,6 +3434,14 @@ class DecodeEngine:
             rows[n:] = rows[n - 1]          # duplicate scatters write
             starts[n:] = starts[n - 1]      # identical values
             last_idx[n:] = last_idx[n - 1]
+            # Per-chunk adapter-slot lane gathered from the engine's
+            # [B] lane (filler rows repeat the last real row, so the
+            # gather stays well-defined).
+            if self.adapter_pool is not None:
+                adapters = self.adapter_pool.stacks
+                row_slot = jnp.asarray(self._row_slot[rows])
+            else:
+                adapters = row_slot = None
             if self.paged:
                 bt_grp = self._bt[rows]            # [n_pad, MB]
                 (self._pool_k, self._pool_v,
@@ -3214,13 +3450,15 @@ class DecodeEngine:
                     self._pool_v, self._last_logits,
                     jnp.asarray(bt_grp), jnp.asarray(rows),
                     jnp.asarray(starts), jnp.asarray(last_idx),
-                    self.cfg, shardings=self._shardings)
+                    self.cfg, shardings=self._shardings,
+                    adapters=adapters, row_slot=row_slot)
             else:
                 self.cache, self._last_logits = _prefill_rows(
                     self.params, jnp.asarray(prompts), self.cache,
                     self._last_logits, jnp.asarray(rows),
                     jnp.asarray(starts), jnp.asarray(last_idx),
-                    self.cfg, shardings=self._shardings)
+                    self.cfg, shardings=self._shardings,
+                    adapters=adapters, row_slot=row_slot)
             self.prefill_dispatches += 1
             padded = n_pad * Cb - real
             self.prefill_real_tokens += real
@@ -3379,6 +3617,13 @@ class DecodeEngine:
                     # is what lets admission capacity track finished
                     # tokens instead of max-live slots.
                     self._release_row_blocks(b)
+                if self._row_slot[b]:
+                    # Retirement drops the row's adapter pin; a
+                    # refcount-0 slot stays RESIDENT (LRU) so the next
+                    # same-adapter request is a hit, it just becomes
+                    # evictable.
+                    self.adapter_pool.decref(int(self._row_slot[b]))
+                    self._row_slot[b] = 0
             else:
                 self.row_len[b] += count  # the fed tokens took their slots
                 if entry.spec:

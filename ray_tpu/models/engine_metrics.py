@@ -321,6 +321,48 @@ class EngineMetrics:
         self._m_spec_rate = gauge(
             "llm_engine_spec_acceptance_rate",
             "Cumulative accepted / proposed (0..1; 0 with spec off)")
+        # Multi-LoRA plane (PR: batched heterogeneous-adapter decode
+        # with HBM adapter residency). Counters track the AdapterPool's
+        # LRU: a lookup is one admission-gate slot acquisition attempt,
+        # a hit means the adapter was already resident.
+        self.adapter_lookups = 0
+        self.adapter_hits = 0
+        self.adapter_prefetches = 0
+        self.adapter_evictions = 0
+        self.adapter_deferrals = 0
+        self.adapter_slots = 0
+        self.adapter_slots_resident = 0
+        self.adapter_slots_pinned = 0
+        self._m_adapter_lookups = counter(
+            "llm_engine_adapter_lookups_total",
+            "Adapter-slot acquisition attempts at the admission gate")
+        self._m_adapter_hits = counter(
+            "llm_engine_adapter_hits_total",
+            "Slot acquisitions that found the adapter already "
+            "resident in HBM")
+        self._m_adapter_prefetches = counter(
+            "llm_engine_adapter_prefetches_total",
+            "Async host->device adapter weight transfers started for "
+            "cold adapters")
+        self._m_adapter_evictions = counter(
+            "llm_engine_adapter_evictions_total",
+            "Refcount-0 resident adapters evicted LRU-first to free "
+            "a slot for a committing prefetch")
+        self._m_adapter_deferrals = counter(
+            "llm_engine_adapter_prefetch_deferrals_total",
+            "Admissions requeued because their adapter was cold and "
+            "its prefetch had not committed yet")
+        self._m_adapter_slots = gauge(
+            "llm_engine_adapter_slots",
+            "Adapter slots in the device-resident stacks (null slot "
+            "0 excluded)")
+        self._m_adapter_resident = gauge(
+            "llm_engine_adapter_slots_resident",
+            "Slots currently holding a committed adapter")
+        self._m_adapter_pinned = gauge(
+            "llm_engine_adapter_slots_pinned",
+            "Resident slots pinned by >= 1 live row (ineligible for "
+            "eviction)")
 
     # -- lifecycle hooks (called by DecodeEngine) --------------------------
 
@@ -557,6 +599,42 @@ class EngineMetrics:
             self._m_spec_rate.set(self.spec_accepted
                                   / self.spec_proposed)
 
+    def on_adapter_lookup(self, hit: bool) -> None:
+        """One adapter-slot acquisition attempt at the admission gate
+        (AdapterPool.alloc for a non-None adapter_id)."""
+        self.adapter_lookups += 1
+        self._m_adapter_lookups.inc()
+        if hit:
+            self.adapter_hits += 1
+            self._m_adapter_hits.inc()
+
+    def on_adapter_prefetch(self, n: int = 1) -> None:
+        if n > 0:
+            self.adapter_prefetches += n
+            self._m_adapter_prefetches.inc(n)
+
+    def on_adapter_evict(self, n: int = 1) -> None:
+        if n > 0:
+            self.adapter_evictions += n
+            self._m_adapter_evictions.inc(n)
+
+    def on_adapter_defer(self, n: int = 1) -> None:
+        """An admission was requeued waiting on its adapter's
+        prefetch instead of stalling the step."""
+        if n > 0:
+            self.adapter_deferrals += n
+            self._m_adapter_deferrals.inc(n)
+
+    def on_adapter_slots(self, total: int, resident: int,
+                         pinned: int) -> None:
+        """Gauge update after a pool state change (commit/evict)."""
+        self.adapter_slots = total
+        self.adapter_slots_resident = resident
+        self.adapter_slots_pinned = pinned
+        self._m_adapter_slots.set(total)
+        self._m_adapter_resident.set(resident)
+        self._m_adapter_pinned.set(pinned)
+
     def observe_queue_depth(self, depth: int) -> None:
         """Gauge update outside a step (e.g. right after submit)."""
         self.queue_depth = depth
@@ -631,6 +709,17 @@ class EngineMetrics:
         out["spec_acceptance_rate"] = (
             self.spec_accepted / self.spec_proposed
             if self.spec_proposed else 0.0)
+        out["adapter_lookups"] = self.adapter_lookups
+        out["adapter_hits"] = self.adapter_hits
+        out["adapter_hit_rate"] = (
+            self.adapter_hits / self.adapter_lookups
+            if self.adapter_lookups else 0.0)
+        out["adapter_prefetches"] = self.adapter_prefetches
+        out["adapter_evictions"] = self.adapter_evictions
+        out["adapter_prefetch_deferrals"] = self.adapter_deferrals
+        out["adapter_slots"] = self.adapter_slots
+        out["adapter_slots_resident"] = self.adapter_slots_resident
+        out["adapter_slots_pinned"] = self.adapter_slots_pinned
         self.queue_wait_s.fields("queue_wait_s", out)
         self.ttft_s.fields("ttft_s", out)
         self.tpot_s.fields("tpot_s", out)
@@ -693,6 +782,16 @@ class NullEngineMetrics:
     def on_prefill_stall(self, n=1): pass
 
     def on_spec_round(self, rounds, proposed, accepted): pass
+
+    def on_adapter_lookup(self, hit): pass
+
+    def on_adapter_prefetch(self, n=1): pass
+
+    def on_adapter_evict(self, n=1): pass
+
+    def on_adapter_defer(self, n=1): pass
+
+    def on_adapter_slots(self, total, resident, pinned): pass
 
     def observe_queue_depth(self, depth): pass
 

@@ -189,18 +189,19 @@ class _FleetReq:
     FLEET request id, so the stream survives any re-placement)."""
 
     __slots__ = ("fid", "prompt", "max_new_tokens", "priority",
-                 "greedy", "rng", "attempts", "emitted", "tokens",
-                 "recovering")
+                 "greedy", "rng", "adapter_id", "attempts", "emitted",
+                 "tokens", "recovering")
 
     def __init__(self, fid: int, prompt: List[int],
                  max_new_tokens: int, priority: int, greedy,
-                 rng: np.ndarray):
+                 rng: np.ndarray, adapter_id: Optional[str] = None):
         self.fid = fid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.priority = priority
         self.greedy = greedy
         self.rng = rng
+        self.adapter_id = adapter_id
         self.attempts = 1        # submissions so far (retries = n-1)
         self.emitted = 0         # tokens already streamed to the caller
         self.tokens: List[int] = []   # salvage buffer while recovering
@@ -249,9 +250,15 @@ def replica_score(replica: _Replica, prompt: List[int],
 class FleetRouter:
     """Chooses the replica a request is submitted to. Only RUNNING
     replicas with a closed circuit breaker are offered (the fleet
-    filters the rest out before calling)."""
+    filters the rest out before calling).
+
+    Routers that score on multi-LoRA adapter residency set
+    `supports_adapter_affinity = True` and accept an ``adapter_id``
+    keyword in `choose`; the fleet only passes the keyword to routers
+    that advertise it, so existing custom routers keep working."""
 
     name = "base"
+    supports_adapter_affinity = False
 
     def choose(self, replicas: List[_Replica],
                prompt: List[int]) -> _Replica:
@@ -287,6 +294,16 @@ class PowerOfTwoAffinityRouter(FleetRouter):
     cache-affinity hotspot). Past the cap the request routes by load
     and becomes the group's cache seed on a second replica.
 
+    Multi-LoRA requests get the same treatment one level up: when the
+    fleet passes ``adapter_id``, a replica whose AdapterPool already
+    holds that adapter RESIDENT in HBM wins (lowest-score resident
+    candidate), under the same overload cap — routing to a cold
+    replica costs a host->device adapter transfer plus an admission
+    deferral, which is the adapter analog of recomputing a cached
+    prefix. Adapter affinity outranks prefix affinity: adapter rows
+    bypass the prefix trie entirely, so their prefix term is always
+    cold anyway.
+
     Otherwise pow-2: sample two distinct candidates with a SEEDED
     stream (deterministic tests and benches), pick the lower score.
     Two random choices get within a constant factor of scanning all N
@@ -294,6 +311,7 @@ class PowerOfTwoAffinityRouter(FleetRouter):
     everything stats() knows, not just queue length."""
 
     name = "pow2_affinity"
+    supports_adapter_affinity = True
 
     def __init__(self, *, seed: int = 0, affinity: bool = True,
                  affinity_overload_factor: float = 4.0,
@@ -306,16 +324,30 @@ class PowerOfTwoAffinityRouter(FleetRouter):
         self.queue_cost = queue_cost
         self.slot_cost = slot_cost
         self.affinity_wins = 0   # decisions the prefix override took
+        self.adapter_wins = 0    # decisions the adapter override took
         self.pow2_wins = 0       # decisions left to power-of-two
 
     def _score(self, rep: _Replica, prompt: List[int]) -> float:
         return replica_score(rep, prompt, queue_cost=self.queue_cost,
                              slot_cost=self.slot_cost)
 
-    def choose(self, replicas: List[_Replica],
-               prompt: List[int]) -> _Replica:
+    def choose(self, replicas: List[_Replica], prompt: List[int],
+               adapter_id: Optional[str] = None) -> _Replica:
         if len(replicas) == 1:
             return replicas[0]
+        if self.affinity and adapter_id is not None:
+            scores = [self._score(r, prompt) for r in replicas]
+            best_score = min(scores)
+            warm = [
+                i for i, r in enumerate(replicas)
+                if getattr(r.engine, "adapter_resident",
+                           lambda _aid: False)(adapter_id)]
+            if warm:
+                i = min(warm, key=lambda k: scores[k])
+                if scores[i] <= self.affinity_overload_factor * \
+                        (best_score + 1.0):
+                    self.adapter_wins += 1
+                    return replicas[i]
         if self.affinity:
             scores = [self._score(r, prompt) for r in replicas]
             best_score = min(scores)
@@ -688,6 +720,12 @@ class LLMFleet:
                 f"initial_replicas {n} outside autoscaling bounds "
                 f"[{autoscaling.min_replicas}, "
                 f"{autoscaling.max_replicas}]")
+        # Fleet-level adapter table: {adapter_id: lora_init-shaped
+        # host tree}. register_adapter fans out to every replica and
+        # REPLAYS onto replicas that join later (autoscale, failure
+        # replacement), so routing never depends on when a replica was
+        # born relative to a registration.
+        self._adapters: Dict[str, object] = {}
         self.replicas: List[_Replica] = []
         self._next_replica = 0
         for _ in range(n):
@@ -743,8 +781,39 @@ class LLMFleet:
         engine = self._factory(name)
         if self._injector is not None:
             self._injector.arm(engine, name)
+        if self._adapters and \
+                getattr(engine, "adapter_pool", None) is not None:
+            for aid, params in self._adapters.items():
+                engine.register_adapter(aid, params)
         self.replicas.append(_Replica(name, engine))
         return name
+
+    def register_adapter(self, adapter_id: str, lora_params) -> None:
+        """Admit a LoRA adapter fleet-wide: register its weights on
+        every pooled replica that carries an AdapterPool (and on every
+        future replica, via the fleet table). Raises if NO replica can
+        serve adapters — a silent no-op would route adapter traffic
+        into per-engine submit errors later."""
+        pools = [r for r in self.replicas
+                 if getattr(r.engine, "adapter_pool", None) is not None]
+        if not pools:
+            raise ValueError(
+                "register_adapter: no replica was built with lora= "
+                "(engine_factory must enable the adapter pool)")
+        for rep in pools:
+            rep.engine.register_adapter(adapter_id, lora_params)
+        self._adapters[adapter_id] = lora_params
+
+    def unregister_adapter(self, adapter_id: str) -> None:
+        """Drop an adapter fleet-wide (per-replica removal defers
+        until that replica's last live row using it retires)."""
+        self._adapters.pop(adapter_id, None)
+        for rep in self.replicas:
+            if getattr(rep.engine, "adapter_pool", None) is not None:
+                rep.engine.unregister_adapter(adapter_id)
+
+    def adapter_ids(self) -> List[str]:
+        return sorted(self._adapters)
 
     def drain_replica(self, name: str) -> None:
         """Move a replica to DRAINING: its engine refuses new submits
@@ -790,7 +859,8 @@ class LLMFleet:
     def submit(self, prompt: List[int], max_new_tokens: int = 32,
                priority: int = 0, rng=None,
                deadline_s: Optional[float] = None,
-               greedy: Optional[bool] = None) -> int:
+               greedy: Optional[bool] = None,
+               adapter_id: Optional[str] = None) -> int:
         """Route and enqueue one request; returns its FLEET id.
 
         priority / deadline_s / greedy pass straight through to the
@@ -802,11 +872,20 @@ class LLMFleet:
         dead-on-arrival deadline still routes (the engine sheds it
         before it can occupy a queue slot) and is visible in
         `finished` + `shed_ids` immediately. Raises
-        `ReplicaUnavailable` when no RUNNING replica exists."""
+        `ReplicaUnavailable` when no RUNNING replica exists.
+
+        ``adapter_id`` selects a registered LoRA adapter (None = base
+        model): the router scores on HBM residency when it advertises
+        adapter affinity, and the id passes through to the engine's
+        adapter-gated admission."""
         routable = self._routable()
         if not routable:
             raise ReplicaUnavailable(
                 "fleet has no RUNNING replicas to route to")
+        if adapter_id is not None and adapter_id not in self._adapters:
+            raise KeyError(
+                f"unknown adapter_id {adapter_id!r}: call "
+                "register_adapter first")
         prompt = [int(t) for t in prompt]
         fid = self._next_fid
         key = self._fid_key(fid) if rng is None else rng
@@ -820,10 +899,14 @@ class LLMFleet:
                       for r in routable}
             warm = {r.name: r.engine.prefix_match_tokens(prompt)
                     for r in routable}
-        rep = self.router.choose(routable, prompt)
+        rep = self._choose(routable, prompt, adapter_id)
+        # adapter_id rides as a kwarg only when set: stub/legacy
+        # engines without the multi-LoRA plane keep working.
+        ad_kw = {} if adapter_id is None else {"adapter_id": adapter_id}
         rid = rep.engine.submit(prompt, max_new_tokens,
                                 priority=priority, rng=key,
-                                deadline_s=deadline_s, greedy=greedy)
+                                deadline_s=deadline_s, greedy=greedy,
+                                **ad_kw)
         self._next_fid += 1
         if tr.enabled:
             tr.add("route", t0, tr.now() - t0, req_id=fid,
@@ -837,7 +920,7 @@ class LLMFleet:
         # caller passed a legacy key array, a typed key, or nothing.
         self._requests[fid] = _FleetReq(
             fid, prompt, max_new_tokens, priority, greedy,
-            _key_data(key))
+            _key_data(key), adapter_id)
         rep.rid_to_fid[rid] = fid
         self._placement[fid] = (rep, rid)
         rep.routed += 1
@@ -1211,15 +1294,29 @@ class LLMFleet:
                 continue
             self._resubmit(meta, running, ready, seq)
 
+    def _choose(self, cands: List[_Replica], prompt: List[int],
+                adapter_id: Optional[str]) -> _Replica:
+        """Route, passing adapter_id only to routers that advertise
+        adapter affinity (back-compat with custom routers)."""
+        if adapter_id is not None and \
+                getattr(self.router, "supports_adapter_affinity",
+                        False):
+            return self.router.choose(cands, prompt,
+                                      adapter_id=adapter_id)
+        return self.router.choose(cands, prompt)
+
     def _resubmit(self, meta: _FleetReq, cands: List[_Replica],
                   ready: float, seq: int) -> None:
-        rep = self.router.choose(cands, meta.prompt)
+        rep = self._choose(cands, meta.prompt, meta.adapter_id)
+        ad_kw = ({} if meta.adapter_id is None
+                 else {"adapter_id": meta.adapter_id})
         try:
             rid = rep.engine.submit(
                 meta.prompt, meta.max_new_tokens,
                 priority=meta.priority, rng=meta.rng,
                 greedy=meta.greedy,
-                resume_tokens=meta.tokens or None)
+                resume_tokens=meta.tokens or None,
+                **ad_kw)
         except (EngineDraining, EngineOverloaded):
             # Raced a drain/overload on the chosen replica: park the
             # retry one backoff-base further out, attempt unconsumed.
@@ -1444,8 +1541,27 @@ class LLMFleet:
             sp_prop / sp_rounds if sp_rounds else 0.0)
         out["spec_draft_tokens_wasted"] = sum(
             s.get("spec_draft_tokens_wasted", 0.0) for s in per)
+        # Multi-LoRA plane (all-zero when no replica carries an
+        # adapter pool). Hit rate re-derived from summed counters, like
+        # the spec plane.
+        ad_lk = sum(s.get("adapter_lookups", 0.0) for s in per)
+        ad_hit = sum(s.get("adapter_hits", 0.0) for s in per)
+        out["adapter_replicas"] = sum(
+            s.get("adapter_enabled", 0.0) for s in per)
+        out["adapters_registered"] = float(len(self._adapters))
+        out["adapter_lookups"] = ad_lk
+        out["adapter_hits"] = ad_hit
+        out["adapter_hit_rate"] = ad_hit / ad_lk if ad_lk else 0.0
+        out["adapter_prefetches"] = sum(
+            s.get("adapter_prefetches", 0.0) for s in per)
+        out["adapter_evictions"] = sum(
+            s.get("adapter_evictions", 0.0) for s in per)
+        out["adapter_prefetch_deferrals"] = sum(
+            s.get("adapter_prefetch_deferrals", 0.0) for s in per)
         out["router_affinity_wins"] = float(
             getattr(self.router, "affinity_wins", 0))
+        out["router_adapter_wins"] = float(
+            getattr(self.router, "adapter_wins", 0))
         out["router_pow2_wins"] = float(
             getattr(self.router, "pow2_wins", 0))
         if self.autoscaler is not None:

@@ -69,9 +69,22 @@ def _cached_attention(q, k_cache, v_cache, q_slots, kv_valid_len,
     return out.astype(q.dtype)
 
 
+def _lora_delta(x, ab, slots, dt):
+    """Per-row gathered low-rank delta (S-LoRA): x [B, S, n_in] through
+    row-selected adapter factors a [A, n_in, r] / b [A, r, n_out]
+    (b pre-scaled by alpha/rank at pool registration) -> [B, S, n_out].
+    Slot 0 holds the all-zero null adapter, so base-only rows compute
+    an exactly-zero delta inside the same fused program."""
+    a = ab["a"][slots].astype(dt)                 # [B, n_in, r]
+    b = ab["b"][slots].astype(dt)                 # [B, r, n_out]
+    return jnp.einsum("bsr,bro->bso",
+                      jnp.einsum("bsi,bir->bsr", x, a), b)
+
+
 def _layer_body(h, layer, k_cache, v_cache, positions, write_kv,
                 q_slots, kv_valid_len, cfg: LlamaConfig,
-                slot_live=None, attend=None):
+                slot_live=None, attend=None, lora=None,
+                lora_slots=None):
     """The decoder-layer math shared by ALL cached decode paths —
     generate.py's contiguous-chunk writes, engine.py's per-row
     scatter writes, and the paged engine's block-pool writes: rmsnorm
@@ -87,12 +100,30 @@ def _layer_body(h, layer, k_cache, v_cache, positions, write_kv,
     its block pool — which stays op-for-op lockstep with
     `_cached_attention`, so token identity across paths holds). Every
     other op is shared by construction (a norm tweak or attention
-    change here reaches every engine automatically)."""
+    change here reaches every engine automatically).
+
+    Multi-LoRA: ``lora`` (optional) is ONE layer's slice of the
+    adapter-pool stacks ({name: {"a": [A, n_in, r], "b": [A, r,
+    n_out]}}) and ``lora_slots`` [B] maps each row to its adapter
+    slot; every projection named in the stacks gains a per-row
+    `_lora_delta` on top of the shared base matmul. Both are pytree
+    leaves of the enclosing jit — lora=None paths trace a program
+    byte-identical to before this feature existed."""
     dt = cfg.dtype
     x = _rmsnorm(h, layer["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", x, layer["wq"].astype(dt))
     k = jnp.einsum("bsd,dhk->bshk", x, layer["wk"].astype(dt))
     v = jnp.einsum("bsd,dhk->bshk", x, layer["wv"].astype(dt))
+    if lora is not None:
+        if "wq" in lora:
+            q = q + _lora_delta(x, lora["wq"], lora_slots,
+                                dt).reshape(q.shape)
+        if "wk" in lora:
+            k = k + _lora_delta(x, lora["wk"], lora_slots,
+                                dt).reshape(k.shape)
+        if "wv" in lora:
+            v = v + _lora_delta(x, lora["wv"], lora_slots,
+                                dt).reshape(v.shape)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     k_cache, v_cache = write_kv(k_cache, v_cache, k, v)
@@ -101,12 +132,26 @@ def _layer_body(h, layer, k_cache, v_cache, positions, write_kv,
     else:
         o = _cached_attention(q, k_cache, v_cache, q_slots,
                               kv_valid_len, cfg, slot_live=slot_live)
-    h = h + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
+    attn_out = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
+    if lora is not None and "wo" in lora:
+        o_flat = o.reshape(o.shape[0], o.shape[1], -1)
+        attn_out = attn_out + _lora_delta(o_flat, lora["wo"],
+                                          lora_slots, dt)
+    h = h + attn_out
     x = _rmsnorm(h, layer["mlp_norm"], cfg.norm_eps)
     gate = jnp.einsum("bsd,df->bsf", x, layer["w_gate"].astype(dt))
     up = jnp.einsum("bsd,df->bsf", x, layer["w_up"].astype(dt))
-    h = h + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
-                       layer["w_down"].astype(dt))
+    if lora is not None:
+        if "w_gate" in lora:
+            gate = gate + _lora_delta(x, lora["w_gate"], lora_slots, dt)
+        if "w_up" in lora:
+            up = up + _lora_delta(x, lora["w_up"], lora_slots, dt)
+    act = jax.nn.silu(gate) * up
+    mlp_out = jnp.einsum("bsf,fd->bsd", act, layer["w_down"].astype(dt))
+    if lora is not None and "w_down" in lora:
+        mlp_out = mlp_out + _lora_delta(act, lora["w_down"],
+                                        lora_slots, dt)
+    h = h + mlp_out
     return h, k_cache, v_cache
 
 
@@ -167,7 +212,9 @@ def forward_cached(params: Params, tokens: jax.Array, cache: Cache,
 
 
 def forward_cached_rows(params: Params, tokens: jax.Array, cache: Cache,
-                        starts: jax.Array, cfg: LlamaConfig
+                        starts: jax.Array, cfg: LlamaConfig, *,
+                        adapters: Optional[Params] = None,
+                        row_slot: Optional[jax.Array] = None
                         ) -> Tuple[jax.Array, Cache]:
     """Run a token chunk [B, S] with a PER-ROW cache offset: row b's
     tokens land at cache slots ``starts[b] + i`` (scatter writes) and
@@ -191,7 +238,14 @@ def forward_cached_rows(params: Params, tokens: jax.Array, cache: Cache,
     engine's speculative path leans on this as its no-rollback cache
     discipline — a rejected draft window's K/V is left in place and the
     next round's verify chunk lands exactly on top of it, the causal
-    mask hiding whatever lies beyond the chunk."""
+    mask hiding whatever lies beyond the chunk.
+
+    Multi-LoRA: ``adapters`` is the full adapter-pool stack tree
+    ({name: {"a": [L, A, n_in, r], "b": [L, A, r, n_out]}}, leading
+    layer axis unstacked by the scan) and ``row_slot`` [B] int32 maps
+    each row to its adapter slot (0 = base-only). Both absent -> the
+    scan carries its original 3-tuple and the traced program is
+    byte-identical to the pre-LoRA path."""
     B, S = tokens.shape
     h = params["tok_embed"].astype(cfg.dtype)[tokens]
     slot_ids = starts[:, None] + jnp.arange(S)[None, :]      # [B, S]
@@ -199,7 +253,11 @@ def forward_cached_rows(params: Params, tokens: jax.Array, cache: Cache,
 
     def body(carry, xs):
         h = carry
-        layer, k_c, v_c = xs
+        if adapters is None:
+            layer, k_c, v_c = xs
+            lora = None
+        else:
+            layer, k_c, v_c, lora = xs
 
         def write_kv(k_cache, v_cache, k, v):
             k_cache = k_cache.at[bidx[:, None], slot_ids].set(
@@ -209,11 +267,14 @@ def forward_cached_rows(params: Params, tokens: jax.Array, cache: Cache,
             return k_cache, v_cache
 
         h, k_c, v_c = _layer_body(h, layer, k_c, v_c, slot_ids,
-                                  write_kv, slot_ids, k_c.shape[1], cfg)
+                                  write_kv, slot_ids, k_c.shape[1], cfg,
+                                  lora=lora, lora_slots=row_slot)
         return h, (k_c, v_c)
 
-    h, (k_new, v_new) = jax.lax.scan(
-        body, h, (params["layers"], cache["k"], cache["v"]))
+    xs = (params["layers"], cache["k"], cache["v"])
+    if adapters is not None:
+        xs = xs + (adapters,)
+    h, (k_new, v_new) = jax.lax.scan(body, h, xs)
     h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bsd,dv->bsv", h,
                         params["lm_head"].astype(cfg.dtype),

@@ -115,6 +115,45 @@ def lora_param_specs(lora_cfg: LoraConfig,
                        for name in lora_cfg.targets}}
 
 
+def lora_stack_specs(cfg: LlamaConfig, lora_cfg: LoraConfig,
+                     rules: Optional[LogicalAxisRules] = None) -> Params:
+    """PartitionSpecs for the serving AdapterPool's device-resident
+    stacks ``{name: {"a": [L, A, n_in, rank], "b": [L, A, rank,
+    n_out]}}`` (A = adapter slots, slot 0 = null adapter).
+
+    Unlike `lora_param_specs` (training adapters, replicated), serving
+    stacks follow the BASE weight's per-axis rules: the a-stack's
+    fan-in axis takes the base weight's leading input logical axis and
+    the b-stack's fan-out axis takes the base weight's first output
+    logical axis, both resolved through the SAME (pruned) rule table
+    the engine built for its base params — so a rank-r adapter
+    degrades to replicated exactly when the base axis does (e.g. kv
+    heads not divisible by tp). The slot and rank axes always
+    replicate. Flattened axes stay divisible whenever the base axis
+    is: n_in/n_out are products whose leading factor is the base dim
+    the rule was pruned against."""
+    shapes = _layer_shapes(cfg)
+    out = {}
+    for name in lora_cfg.targets:
+        shape, logical, fan_in = shapes[name]
+        acc, split = 1, None
+        for i, s in enumerate(shape):
+            acc *= s
+            if acc == fan_in:
+                split = i
+                break
+        if split is None:
+            raise ValueError(
+                f"fan_in {fan_in} is not a prefix product of {shape}")
+        out[name] = {
+            "a": logical_to_mesh(("layers", None, logical[0], None),
+                                 rules),
+            "b": logical_to_mesh(("layers", None, None,
+                                  logical[split + 1]), rules),
+        }
+    return out
+
+
 def lora_merge(base: Params, lora: Params, cfg: LlamaConfig,
                lora_cfg: LoraConfig) -> Params:
     """base + scale * A@B, reshaped per weight. Returns a full param tree

@@ -77,9 +77,28 @@ class LLMFleetServer:
         from ray_tpu.util.state.serving import register_server
         register_server(self)
 
+    def register_model(self, model_id: str, lora_params) -> None:
+        """Admit a LoRA fine-tune under a serving model id: fans out
+        to every fleet replica's AdapterPool (and future replicas).
+        `generate(model_id=...)` — or the Serve multiplex header, via
+        `get_multiplexed_model_id()` — then resolves through this
+        table to a per-row adapter in the shared batch."""
+        self.fleet.register_adapter(model_id, lora_params)
+
+    def unregister_model(self, model_id: str, *_evicted) -> None:
+        """Drop a registered fine-tune fleet-wide. Also suitable as a
+        `serve.multiplexed(on_evict=...)` callback (extra positional
+        model payload ignored), so multiplex LRU eviction and the
+        adapter pools cannot disagree about residency."""
+        self.fleet.unregister_adapter(model_id)
+
+    def model_ids(self) -> List[str]:
+        return self.fleet.adapter_ids()
+
     def generate(self, token_ids: List[int],
                  max_new_tokens: int = 32, priority: int = 0,
-                 deadline_s: Optional[float] = None) -> Dict:
+                 deadline_s: Optional[float] = None,
+                 model_id: Optional[str] = None) -> Dict:
         """Route one request through the fleet and drive it to
         completion. Returns ``{"tokens": prompt + generated,
         "shed": bool}`` — a shed request (past its deadline before
@@ -89,10 +108,24 @@ class LLMFleetServer:
         fleet's typed error (`RetriesExhausted` after the retry budget,
         `ReplicaUnavailable` with no replica left to recover onto)
         instead of looping forever — failed requests join `finished`
-        and `pop_result` raises."""
+        and `pop_result` raises.
+
+        ``model_id`` selects a fine-tune registered through
+        `register_model` (None/"" = base model). When omitted INSIDE a
+        Serve replica, it defaults to the request's multiplex header
+        (`serve.get_multiplexed_model_id()`), so a deployment fronted
+        by the Serve router's multiplex-aware placement resolves to
+        the right adapter with no per-call plumbing. An id that was
+        never registered raises KeyError — not silent base-model
+        fallback, which would return wrong-model tokens."""
+        if model_id is None:
+            from ray_tpu.serve.multiplex import get_multiplexed_model_id
+            model_id = get_multiplexed_model_id()
+        adapter_id = model_id or None      # "" = no multiplex header
         fid = self.fleet.submit(token_ids, max_new_tokens,
                                 priority=priority,
-                                deadline_s=deadline_s)
+                                deadline_s=deadline_s,
+                                adapter_id=adapter_id)
         while fid not in self.fleet.finished:
             self.fleet.step()
         shed = fid in self.fleet.shed_ids

@@ -16,7 +16,18 @@ from typing import Callable, Optional
 
 
 def multiplexed(_fn: Optional[Callable] = None, *,
-                max_num_models_per_replica: int = 3):
+                max_num_models_per_replica: int = 3,
+                on_evict: Optional[Callable] = None):
+    """Decorator: per-replica LRU cache over a model loader.
+
+    ``on_evict(model_id, model)`` is called synchronously whenever the
+    LRU drops a model — the seam that keeps EXTERNAL residency ledgers
+    (e.g. a DecodeEngine AdapterPool whose adapter table mirrors the
+    multiplex cache) consistent with the wrapper's own records: the
+    router's multiplexed-model advertisement and the adapter pool
+    must never disagree about what this replica holds. Callback
+    exceptions are swallowed (an eviction must never fail the request
+    that triggered it)."""
     def wrap(fn):
         caches = {}
 
@@ -43,8 +54,13 @@ def multiplexed(_fn: Optional[Callable] = None, *,
             cache[model_id] = model
             _record_model(model_id)
             while len(cache) > max_num_models_per_replica:
-                evicted_id, _evicted = cache.popitem(last=False)
+                evicted_id, evicted = cache.popitem(last=False)
                 _unrecord_model(evicted_id)
+                if on_evict is not None:
+                    try:
+                        on_evict(evicted_id, evicted)
+                    except Exception:
+                        pass
             return model
 
         wrapper._is_serve_multiplexed = True

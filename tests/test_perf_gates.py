@@ -592,3 +592,94 @@ def test_gate_sanitizer_catches_stray_pull_and_restores():
         eng.disarm_sanitizer()
     assert float(jnp.ones(()) * 3) == 3.0      # interposition removed
     assert jnp.arange(4).tolist() == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# Gate: multi-LoRA plane is free when unused
+# ---------------------------------------------------------------------------
+
+def test_gate_adapter_off_zero_allocations_in_adapter_path():
+    """Gate (multi-LoRA): an engine built WITHOUT lora= pays nothing
+    for the adapter plane — a decode churn allocates ZERO bytes inside
+    adapter_pool.py (no AdapterPool, no per-round residency objects)
+    and the adapter stats stay identically 0. Fails if any dispatch
+    seam ever builds adapter state before checking `adapter_pool is
+    None`."""
+    import tracemalloc
+
+    jax = pytest.importorskip("jax")
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models import adapter_pool
+    from ray_tpu.models.engine import DecodeEngine
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    eng = DecodeEngine(params, cfg, batch_slots=2, max_len=32)
+    eng.submit([5, 6, 7], 4)
+    eng.run()                        # compile outside the window
+
+    tracemalloc.start()
+    try:
+        for i in range(3):
+            eng.submit([5, 6, 7 + i], 4)
+        eng.run()
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    stats = snap.filter_traces(
+        [tracemalloc.Filter(True, adapter_pool.__file__)]).statistics(
+            "lineno")
+    total = sum(s.size for s in stats)
+    assert total == 0, (
+        f"adapter-off engine allocated {total} bytes in adapter_pool.py: "
+        + "; ".join(str(s) for s in stats[:5]))
+    s = eng.stats()
+    assert s["adapter_enabled"] == 0.0
+    for k in ("adapter_lookups", "adapter_hits", "adapter_prefetches",
+              "adapter_evictions", "adapter_prefetch_deferrals",
+              "adapter_slots", "adapter_slots_resident",
+              "adapter_slots_pinned"):
+        assert s[k] == 0.0, f"{k} nonzero on an adapter-less engine"
+
+
+def test_gate_adapter_enabled_base_traffic_zero_retrace():
+    """Gate (multi-LoRA): an adapter-ENABLED engine serving ONLY
+    adapter_id=None traffic recompiles nothing and leaks no transfers
+    once warm — the slot-0 null adapter rides the same fused programs,
+    so turning the feature on costs base traffic zero steady-state
+    work. Output stays identical to solo generate (bit-identity vs a
+    lora=None engine is test_engine_lora.py's job)."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    from ray_tpu.models import LlamaConfig, LoraConfig, llama_init
+    from ray_tpu.models.engine import DecodeEngine
+    from ray_tpu.models.generate import generate
+    from ray_tpu._private.sanitize import SanitizerError
+
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    eng = DecodeEngine(params, cfg, batch_slots=2, max_len=64,
+                       decode_horizon=4, lora=LoraConfig(rank=4),
+                       max_live_adapters=2)
+
+    _san_workload(eng)           # pass 1: cold compiles
+    _san_workload(eng)           # pass 2: warm-hit paths
+    san = eng.arm_sanitizer()
+    try:
+        emitted = _san_workload(eng)
+    except SanitizerError as exc:
+        pytest.fail("adapter-enabled engine pulled device->host on "
+                    f"base-only traffic: {exc}")
+    finally:
+        eng.disarm_sanitizer()
+
+    assert san.total_retraces() == 0, san.retraces()
+    assert san.unexpected_transfers == [], san.unexpected_transfers
+    for prompt, toks in zip(_SAN_PROMPTS, emitted):
+        solo = np.asarray(generate(
+            params, jnp.asarray([prompt], jnp.int32), cfg,
+            max_new_tokens=_SAN_BUDGET))[0, len(prompt):].tolist()
+        assert toks == solo
+    s = eng.stats()
+    assert s["adapter_enabled"] == 1.0
+    assert s["adapter_lookups"] == 0.0
