@@ -621,6 +621,124 @@ def _bench_paged(cfg, *, prefix_len: int, suffix_len: int,
     }
 
 
+def _bench_kv_quant(cfg, *, prompt_len: int, batch_slots: int,
+                    n_requests: int, new_tokens: int, trials: int,
+                    block_tokens: int = 16) -> dict:
+    """Quantized-KV concurrency at fixed HBM (the int8/fp8 tentpole's
+    end-to-end number): the SAME `kv_pool_bytes` budget buys a bf16,
+    an int8, and an fp8-e4m3 pool; the headline
+    `kv_quant_concurrency_ratio` is how many more requests' worth of
+    blocks the int8 pool holds (scale slab included — ~1.9-2x, the
+    "double the users per HBM byte" claim, gated in CI by
+    tests/test_engine_kv_quant.py's tolerance check on the SAME
+    comparison). Also reported:
+
+    - decode tokens/s per mode on identical greedy traffic (the
+      dequant-in-gather per-step price; microbench isolates the op),
+    - the quant-on quality gate inline: greedy token-match fraction
+      vs the bf16 engine on the same prompts,
+    - preempt-swap traffic ratio on SAME-BLOCK-COUNT tight pools
+      (quantized blocks spill quantized bytes + scales — ~half the
+      bf16 swap bytes per preemption).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import llama_init
+    from ray_tpu.models.engine import DecodeEngine
+    from ray_tpu.models.prefix_cache import block_bytes
+
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(11)
+    T = block_tokens
+    max_len = prompt_len + new_tokens + 1
+    max_len = -(-max_len // T) * T
+    per_row = max_len // T
+    prompts = [rng.randint(1, cfg.vocab_size, size=prompt_len).tolist()
+               for _ in range(n_requests)]
+    bb_dense = block_bytes(cfg.n_layers, T, cfg.n_kv_heads,
+                           cfg.head_dim, jnp.dtype(cfg.dtype).itemsize)
+    bb_quant = block_bytes(cfg.n_layers, T, cfg.n_kv_heads,
+                           cfg.head_dim, 1) \
+        + 2 * cfg.n_layers * cfg.n_kv_heads * 4
+    # Budget: exactly batch_slots rows' worth of bf16 blocks — the
+    # fixed HBM everyone gets.
+    budget = batch_slots * per_row * bb_dense
+
+    def run(quant, *, pool_bytes=budget, preempt=None):
+        kw = {} if preempt is None else {"preempt": preempt}
+        eng = DecodeEngine(params, cfg, batch_slots=batch_slots,
+                           max_len=max_len, paged=True,
+                           kv_block_tokens=T, kv_pool_bytes=pool_bytes,
+                           kv_quant=quant, enable_metrics=False, **kw)
+        rates = []
+        toks = None
+        for trial in range(trials + 1):
+            ids = [eng.submit(p, new_tokens) for p in prompts]
+            t0 = time.perf_counter()
+            out = eng.run()
+            dt = time.perf_counter() - t0
+            if trial:
+                rates.append(n_requests * new_tokens / dt)
+            toks = [out[i] for i in ids]
+        return statistics.median(rates), toks, eng
+
+    rate_bf, toks_bf, eng_bf = run(None)
+    rate_i8, toks_i8, eng_i8 = run("int8")
+    rate_f8, toks_f8, eng_f8 = run("fp8_e4m3")
+
+    def conc(eng):
+        return eng.kv_pool.blocks_total // per_row
+
+    def match_frac(a, b):
+        tot = sum(len(x) for x in a)
+        hit = sum(int(x == y) for xs, ys in zip(a, b)
+                  for x, y in zip(xs, ys))
+        return hit / tot if tot else 0.0
+
+    # Preempt-swap traffic: SAME BLOCK COUNT both modes (so the
+    # preemption pattern matches), bytes differ by the quant layout.
+    tight = max(per_row + 1, int(per_row * batch_slots * 0.6))
+    _, _, eng_sw_bf = run(None, pool_bytes=tight * bb_dense,
+                          preempt="swap")
+    _, _, eng_sw_i8 = run("int8", pool_bytes=tight * bb_quant,
+                          preempt="swap")
+    sw_bf = eng_sw_bf.stats()
+    sw_i8 = eng_sw_i8.stats()
+
+    ratio = conc(eng_i8) / conc(eng_bf) if conc(eng_bf) else 0.0
+    return {
+        "metric": "kv_quant_concurrency_ratio",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "kv_pool_bytes": budget,
+        "block_tokens": T,
+        "bytes_per_block_bf16": eng_bf.kv_bytes_per_block,
+        "bytes_per_block_int8": eng_i8.kv_bytes_per_block,
+        "bytes_per_block_fp8": eng_f8.kv_bytes_per_block,
+        "bytes_per_token_bf16": eng_bf.kv_bytes_per_token,
+        "bytes_per_token_int8": eng_i8.kv_bytes_per_token,
+        "concurrency_bf16": conc(eng_bf),
+        "concurrency_int8": conc(eng_i8),
+        "concurrency_fp8": conc(eng_f8),
+        "kv_quant_concurrency_ratio_fp8": round(
+            conc(eng_f8) / conc(eng_bf), 3) if conc(eng_bf) else 0.0,
+        "decode_tokens_per_sec_bf16": round(rate_bf, 1),
+        "decode_tokens_per_sec_int8": round(rate_i8, 1),
+        "decode_tokens_per_sec_fp8": round(rate_f8, 1),
+        "token_match_frac_int8": round(match_frac(toks_bf, toks_i8), 4),
+        "token_match_frac_fp8": round(match_frac(toks_bf, toks_f8), 4),
+        "swap_out_bytes_bf16": sw_bf["swap_out_bytes"],
+        "swap_out_bytes_int8": sw_i8["swap_out_bytes"],
+        "swap_preemptions_bf16": sw_bf["preemptions"],
+        "swap_preemptions_int8": sw_i8["preemptions"],
+        "swap_bytes_ratio_int8": round(
+            sw_i8["swap_out_bytes"] / sw_bf["swap_out_bytes"], 3)
+        if sw_bf["swap_out_bytes"] else 0.0,
+    }
+
+
 def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
                  suffix_len: int, n_requests: int, new_tokens: int,
                  batch_slots: int, replica_counts=(2, 4),
@@ -1302,6 +1420,14 @@ def main():
                 "metric": "llama_decode_tokens_per_sec_paged",
                 "error": f"{type(e).__name__}: {str(e)[:200]}"}
         try:
+            serving["kv_quant"] = _bench_kv_quant(
+                flagship_config(), prompt_len=128, batch_slots=8,
+                n_requests=16, new_tokens=64, trials=TRIALS)
+        except Exception as e:
+            serving["kv_quant"] = {
+                "metric": "kv_quant_concurrency_ratio",
+                "error": f"{type(e).__name__}: {str(e)[:200]}"}
+        try:
             serving["fleet"] = _bench_fleet(
                 flagship_config(), n_groups=4, prefix_len=256,
                 suffix_len=32, n_requests=48, new_tokens=32,
@@ -1360,6 +1486,14 @@ def main():
             LlamaConfig.nano(max_seq_len=1024), prefix_len=64,
             suffix_len=16, batch_slots=4, n_requests=16, new_tokens=8,
             trials=1, block_tokens=16)
+        # Quantized-KV workload, CPU dry run: the concurrency ratio at
+        # fixed kv_pool_bytes, the token-match quality gate, and the
+        # swap-traffic ratio are layout facts — real on any backend;
+        # absolute tokens/s is not.
+        serving["kv_quant"] = _bench_kv_quant(
+            LlamaConfig.nano(max_seq_len=256), prompt_len=16,
+            batch_slots=4, n_requests=8, new_tokens=8, trials=1,
+            block_tokens=8)
         # Fleet churn, CPU dry run: 2 and 4 replicas over shared-
         # prefix + mixed-priority traffic — the router comparison
         # (affinity vs round-robin TTFT p95) and the shed rate are

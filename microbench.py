@@ -519,6 +519,75 @@ def _paged_gather_section(quick: bool) -> list:
     return results
 
 
+def _kv_quant_gather_section(quick: bool) -> list:
+    """Per-step cost of dequant-in-gather paged attention
+    (ops/kv_quant.py + ops/attention.py): the same decode-shaped
+    block-table attention as `_paged_gather_section`, read (a) from a
+    dense f32 pool and (b) from an int8 pool with per-block scales
+    dequantized INSIDE the gather. The delta is the pure price of the
+    widening multiply the quantized plane pays per decode step — buying
+    ~2x pool blocks per HBM byte (bench.py `kv_quant` section reports
+    the concurrency side). Runs anywhere: both lower to the same XLA
+    reference einsums off-TPU, so the dequant overhead measured is the
+    real added op count, not a kernel artifact."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.attention import paged_attention
+    from ray_tpu.ops.kv_quant import (block_scale, quantize,
+                                      resolve_kv_quant)
+
+    B, H, KV, D, T = 8, 4, 2, 16, 16
+    spans = (256,) if quick else (256, 1024)
+    qspec = resolve_kv_quant("int8")
+    results = []
+    for span in spans:
+        MB = span // T
+        NB = 4 * MB + 1
+        key = jax.random.PRNGKey(span)
+        q = jax.random.normal(key, (B, 1, H, D), jnp.float32)
+        pool_k = jax.random.normal(key, (NB, T, KV, D), jnp.float32)
+        pool_v = pool_k + 1.0
+        amax_k = jnp.max(jnp.abs(pool_k), axis=(1, 3))
+        amax_v = jnp.max(jnp.abs(pool_v), axis=(1, 3))
+        sk = block_scale(amax_k, qspec)
+        sv = block_scale(amax_v, qspec)
+        qk = quantize(pool_k, sk[:, None, :, None], qspec)
+        qv = quantize(pool_v, sv[:, None, :, None], qspec)
+        bt = (1 + (jnp.arange(B * MB) * 7) % (NB - 1)).reshape(B, MB)
+        bt = bt.astype(jnp.int32)
+        slots = jnp.full((B, 1), span - 1, jnp.int32)
+
+        dense_fn = jax.jit(lambda q, k, v: paged_attention(
+            q, k, v, bt, slots, kv_valid_len=span))
+        quant_fn = jax.jit(lambda q, k, v, sk, sv: paged_attention(
+            q, k, v, bt, slots, kv_valid_len=span, k_scale=sk,
+            v_scale=sv))
+        dense_fn(q, pool_k, pool_v).block_until_ready()
+        quant_fn(q, qk, qv, sk, sv).block_until_ready()
+
+        def run(fn, *args):
+            ts = []
+            for _ in range(TRIALS):
+                t0 = time.perf_counter()
+                for _ in range(20):
+                    out = fn(*args)
+                out.block_until_ready()
+                ts.append((time.perf_counter() - t0) / 20 * 1000)
+            return statistics.median(ts)
+
+        d_ms = run(dense_fn, q, pool_k, pool_v)
+        z_ms = run(quant_fn, q, qk, qv, sk, sv)
+        results.append((f"paged_attention_dense_gather_ms_s{span}",
+                        d_ms, "ms"))
+        results.append((f"paged_attention_dequant_gather_ms_s{span}",
+                        z_ms, "ms"))
+        results.append((f"paged_attention_dequant_overhead_pct_s{span}",
+                        (z_ms - d_ms) / d_ms * 100.0 if d_ms else 0.0,
+                        "%"))
+    return results
+
+
 def _fleet_router_section(quick: bool) -> list:
     """Per-decision cost of the fleet routers (models/fleet.py): the
     wall microseconds one `submit()` spends choosing a replica, per
@@ -740,6 +809,9 @@ def main(quick: bool = False):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     for name, value, unit in _paged_gather_section(quick):
+        print(json.dumps({"metric": name, "value": round(value, 4),
+                          "unit": unit}), flush=True)
+    for name, value, unit in _kv_quant_gather_section(quick):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     for name, value, unit in _fleet_router_section(quick):

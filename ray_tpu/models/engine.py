@@ -95,6 +95,11 @@ from ray_tpu.models.llama import (LlamaConfig, _rmsnorm,
                                   llama_param_specs)
 from ray_tpu.models.prefix_cache import PrefixCacheIndex, block_bytes
 from ray_tpu.ops.attention import paged_attention
+from ray_tpu.ops.kv_quant import (KVQuantSpec, block_scale as
+                                  _kv_block_scale, dequantize as
+                                  _kv_dequantize, paged_quant_write,
+                                  quantize as _kv_quantize,
+                                  resolve_kv_quant)
 from ray_tpu.models.scheduler import (EngineDraining, EngineOverloaded,
                                       FIFOPolicy, SchedulerPolicy,
                                       SubmitTimeout, make_policy)
@@ -176,6 +181,10 @@ class _EngineShardings:
                split its kv heads over the same mesh the target can).
                None on non-speculative engines, so every existing
                program signature hashes exactly as before.
+    ``scale``/``d_scale`` [L, NB, KV] — the quantized pool's per-block
+               per-kv-head scale slabs, sharded by the SAME pruned KV
+               rules as the pool they dequantize. None when kv_quant
+               is off (again: identical hashes for existing engines).
     """
 
     cache: NamedSharding
@@ -183,6 +192,8 @@ class _EngineShardings:
     pool: NamedSharding
     d_cache: Optional[NamedSharding] = None
     d_pool: Optional[NamedSharding] = None
+    scale: Optional[NamedSharding] = None
+    d_scale: Optional[NamedSharding] = None
 
     @property
     def replicated(self) -> NamedSharding:
@@ -667,15 +678,19 @@ def _spec_round(params: Params, d_params: Params, cache, d_cache,
 # gathers/scatters dump garbage into it, and no mask ever admits it.
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "shardings"),
-                   donate_argnames=("pool_k", "pool_v", "last_logits"))
+@functools.partial(jax.jit, static_argnames=("cfg", "shardings",
+                                             "qspec"),
+                   donate_argnames=("pool_k", "pool_v", "scale_k",
+                                    "scale_v", "last_logits"))
 def _prefill_rows_paged(params: Params, prompts: jax.Array, pool_k,
                         pool_v, last_logits, bt: jax.Array,
                         rows: jax.Array, starts: jax.Array,
                         last_idx: jax.Array, cfg: LlamaConfig,
                         shardings: Optional[_EngineShardings] = None,
                         adapters: Optional[Params] = None,
-                        row_slot: Optional[jax.Array] = None):
+                        row_slot: Optional[jax.Array] = None,
+                        scale_k=None, scale_v=None,
+                        qspec: Optional[KVQuantSpec] = None):
     """`_prefill_rows` for the block pool: gather each admission row's
     full [max_len] view through its block table, run the SAME
     `forward_cached_rows` math, scatter the view back block-by-block.
@@ -689,9 +704,25 @@ def _prefill_rows_paged(params: Params, prompts: jax.Array, pool_k,
     are rewritten with the unmodified gathered bytes), duplicate
     block-table entries across rows are either shared blocks (same
     bytes) or the null block (garbage nobody reads), and duplicate
-    padded rows repeat the last admission verbatim."""
+    padded rows repeat the last admission verbatim.
+
+    Quantized pools (``qspec`` + the f32 ``scale_k``/``scale_v`` slabs)
+    run the identical math on the DEQUANTIZED gathered view — kept f32
+    end to end — then requantize the whole view on write-back with
+    per-block scales recomputed over each row's valid slots (slots at or
+    beyond ``starts + last_idx + 1`` are zeroed first so bucket-padding
+    filler and stale previous-tenant garbage never poison a block's
+    absmax). Shared prefix blocks survive this byte-identically:
+    requantization of an unmodified dequantized block is byte-stable
+    (see ops/kv_quant.py), which is what keeps zero-copy shares safe
+    under the whole-view write-back."""
     blk_k = pool_k[:, bt]                  # [L, N, MB, T, KV, D]
     blk_v = pool_v[:, bt]
+    if qspec is not None:
+        blk_k = _kv_dequantize(
+            blk_k, scale_k[:, bt][:, :, :, None, :, None])
+        blk_v = _kv_dequantize(
+            blk_v, scale_v[:, bt][:, :, :, None, :, None])
     if shardings is not None:
         # Same chip-local discipline as _prefix_copy_in: the gathered
         # view carries the pool's KV-head sharding.
@@ -712,22 +743,44 @@ def _prefill_rows_paged(params: Params, prompts: jax.Array, pool_k,
                                             row_slot=row_slot)
     k = row_cache["k"].reshape(L, N, MB, T, *blk_k.shape[4:])
     v = row_cache["v"].reshape(L, N, MB, T, *blk_v.shape[4:])
-    pool_k = pool_k.at[:, bt].set(k.astype(pool_k.dtype))
-    pool_v = pool_v.at[:, bt].set(v.astype(pool_v.dtype))
+    if qspec is None:
+        pool_k = pool_k.at[:, bt].set(k.astype(pool_k.dtype))
+        pool_v = pool_v.at[:, bt].set(v.astype(pool_v.dtype))
+    else:
+        valid = starts + last_idx + 1                       # [N]
+        live = (jnp.arange(MB * T)[None, :] < valid[:, None]) \
+            .reshape(1, N, MB, T, 1, 1)
+
+        def _writeback(pool, scales, x):
+            x = jnp.where(live, x.astype(jnp.float32), 0.0)
+            amax = jnp.max(jnp.abs(x), axis=(3, 5))         # [L,N,MB,KV]
+            s = _kv_block_scale(amax, qspec)
+            pool = pool.at[:, bt].set(
+                _kv_quantize(x, s[:, :, :, None, :, None], qspec))
+            return pool, scales.at[:, bt].set(s)
+
+        pool_k, scale_k = _writeback(pool_k, scale_k, k)
+        pool_v, scale_v = _writeback(pool_v, scale_v, v)
     n = prompts.shape[0]
     last = logits[jnp.arange(n), last_idx]              # [N, vocab]
     out_logits = last_logits.at[rows].set(last)
     if shardings is not None:
         pool_k = jax.lax.with_sharding_constraint(pool_k, shardings.pool)
         pool_v = jax.lax.with_sharding_constraint(pool_v, shardings.pool)
+        if qspec is not None and shardings.scale is not None:
+            scale_k = jax.lax.with_sharding_constraint(
+                scale_k, shardings.scale)
+            scale_v = jax.lax.with_sharding_constraint(
+                scale_v, shardings.scale)
         out_logits = jax.lax.with_sharding_constraint(
             out_logits, shardings.logits)
-    return pool_k, pool_v, out_logits
+    return pool_k, pool_v, scale_k, scale_v, out_logits
 
 
 def _decode_layer_rows_paged(h, layer, k_pages, v_pages, bt,
                              write_slots, cfg: LlamaConfig,
-                             lora=None, lora_slots=None):
+                             lora=None, lora_slots=None,
+                             qspec: Optional[KVQuantSpec] = None):
     """`_decode_layer_rows` against the pool: row b's new K/V scatter
     into physical block ``bt[b, slot//T]`` at offset ``slot%T`` and
     attention reads back through `ops.attention.paged_attention` (the
@@ -736,22 +789,46 @@ def _decode_layer_rows_paged(h, layer, k_pages, v_pages, bt,
     is never a write target (full-prompt prefix hits copy-on-write
     their tail block at admission) — so the scatter pairs are unique
     across live rows; retired/empty rows scatter garbage into the
-    null block."""
+    null block.
+
+    Quantized pools thread ``k_pages``/``v_pages`` as (pages, scales)
+    tuples — `_layer_body` only ever touches them through the closures
+    below, which unpack/repack them around `paged_quant_write`'s
+    frontier-block read-modify-write (gather + dequant + token write +
+    stale-slot zero + requant) and hand `paged_attention` the scales so
+    dequant happens inside its gather."""
     B = h.shape[0]
     bidx = jnp.arange(B)
-    T = k_pages.shape[1]
+    T = (k_pages[0] if qspec is not None else k_pages).shape[1]
     span = bt.shape[1] * T                 # == engine max_len
     blk = bt[bidx, write_slots // T]       # [B] physical frontier block
     off = write_slots % T
 
-    def write_kv(k_pages, v_pages, k, v):
-        k_pages = k_pages.at[blk, off].set(k[:, 0].astype(k_pages.dtype))
-        v_pages = v_pages.at[blk, off].set(v[:, 0].astype(v_pages.dtype))
-        return k_pages, v_pages
+    if qspec is None:
+        def write_kv(k_pages, v_pages, k, v):
+            k_pages = k_pages.at[blk, off].set(
+                k[:, 0].astype(k_pages.dtype))
+            v_pages = v_pages.at[blk, off].set(
+                v[:, 0].astype(v_pages.dtype))
+            return k_pages, v_pages
 
-    def attend(q, k_pages, v_pages):
-        return paged_attention(q, k_pages, v_pages, bt,
-                               write_slots[:, None], kv_valid_len=span)
+        def attend(q, k_pages, v_pages):
+            return paged_attention(q, k_pages, v_pages, bt,
+                                   write_slots[:, None],
+                                   kv_valid_len=span)
+    else:
+        def write_kv(kc, vc, k, v):
+            kp, ks = paged_quant_write(kc[0], kc[1], bt, write_slots,
+                                       k[:, :1], qspec)
+            vp, vs = paged_quant_write(vc[0], vc[1], bt, write_slots,
+                                       v[:, :1], qspec)
+            return (kp, ks), (vp, vs)
+
+        def attend(q, kc, vc):
+            return paged_attention(q, kc[0], vc[0], bt,
+                                   write_slots[:, None],
+                                   kv_valid_len=span, k_scale=kc[1],
+                                   v_scale=vc[1])
 
     return _layer_body(h, layer, k_pages, v_pages, write_slots[:, None],
                        write_kv, write_slots[:, None], span, cfg,
@@ -760,42 +837,63 @@ def _decode_layer_rows_paged(h, layer, k_pages, v_pages, bt,
 
 def _decode_core_paged(params: Params, toks: jax.Array, pool_k, pool_v,
                        bt, row_len, cfg: LlamaConfig, adapters=None,
-                       row_slot=None):
+                       row_slot=None, scale_k=None, scale_v=None,
+                       qspec: Optional[KVQuantSpec] = None):
     """`_decode_core` over the pool: the layer scan unstacks the pool's
-    layer axis exactly as the dense scan unstacks the cache's. Plain
-    function so `_decode_multi_paged`'s scan can inline it."""
+    layer axis exactly as the dense scan unstacks the cache's (the
+    quantized scale slabs ride the same scan as two extra xs entries).
+    Plain function so `_decode_multi_paged`'s scan can inline it."""
     write_slots = row_len                                   # [B]
     h = params["tok_embed"].astype(cfg.dtype)[toks[:, None]]
 
     def body(carry, xs):
         h = carry
-        if adapters is None:
-            layer, k_p, v_p = xs
-            lora = None
+        lora = None
+        if qspec is None:
+            ks = vs = None
+            if adapters is None:
+                layer, k_p, v_p = xs
+            else:
+                layer, k_p, v_p, lora = xs
+            kc, vc = k_p, v_p
         else:
-            layer, k_p, v_p, lora = xs
-        h, k_p, v_p = _decode_layer_rows_paged(h, layer, k_p, v_p, bt,
-                                               write_slots, cfg,
-                                               lora=lora,
-                                               lora_slots=row_slot)
-        return h, (k_p, v_p)
+            if adapters is None:
+                layer, k_p, v_p, ks, vs = xs
+            else:
+                layer, k_p, v_p, ks, vs, lora = xs
+            kc, vc = (k_p, ks), (v_p, vs)
+        h, kc, vc = _decode_layer_rows_paged(h, layer, kc, vc, bt,
+                                             write_slots, cfg,
+                                             lora=lora,
+                                             lora_slots=row_slot,
+                                             qspec=qspec)
+        if qspec is None:
+            return h, (kc, vc)
+        return h, (kc[0], vc[0], kc[1], vc[1])
 
     xs = (params["layers"], pool_k, pool_v)
+    if qspec is not None:
+        xs = xs + (scale_k, scale_v)
     if adapters is not None:
         xs = xs + (adapters,)
-    h, (k_new, v_new) = jax.lax.scan(body, h, xs)
+    h, ys = jax.lax.scan(body, h, xs)
+    if qspec is None:
+        (k_new, v_new), s_k, s_v = ys, None, None
+    else:
+        k_new, v_new, s_k, s_v = ys
     h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bsd,dv->bsv", h,
                         params["lm_head"].astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
-    return logits[:, 0], k_new, v_new
+    return logits[:, 0], k_new, v_new, s_k, s_v
 
 
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "horizon", "greedy",
                                     "top_k", "top_p", "eos_id",
-                                    "shardings"),
-                   donate_argnames=("pool_k", "pool_v", "last_logits"))
+                                    "shardings", "qspec"),
+                   donate_argnames=("pool_k", "pool_v", "scale_k",
+                                    "scale_v", "last_logits"))
 def _decode_multi_paged(params: Params, pool_k, pool_v, bt,
                         last_logits, row_len, active, budget, tok_idx,
                         row_keys, row_greedy, temperature,
@@ -805,19 +903,23 @@ def _decode_multi_paged(params: Params, pool_k, pool_v, bt,
                         eos_id: Optional[int],
                         shardings: Optional[_EngineShardings] = None,
                         adapters: Optional[Params] = None,
-                        row_slot: Optional[jax.Array] = None):
+                        row_slot: Optional[jax.Array] = None,
+                        scale_k=None, scale_v=None,
+                        qspec: Optional[KVQuantSpec] = None):
     """`_decode_multi` with the pool + block tables standing in for
     the dense cache: identical scan body, identical per-iteration
     transition, identical [H, B] single-transfer contract — only the
     KV write (block scatter) and the attention read (block-table
     gather) differ, both inside `_decode_core_paged`. The block table
     is a step invariant: the host grows/rebuilds it between
-    dispatches, never inside one."""
+    dispatches, never inside one. A quantized pool adds the scale
+    slabs to the fused carry; qspec=None leaves every pytree and the
+    traced program exactly as before."""
     max_len = bt.shape[1] * pool_k.shape[2]
 
     def body(carry, _):
-        pool_k, pool_v, last_logits, row_len, active, budget, \
-            tok_idx = carry
+        pool_k, pool_v, scale_k, scale_v, last_logits, row_len, \
+            active, budget, tok_idx = carry
         tok = sample_rows(last_logits, row_keys, tok_idx,
                           greedy=greedy, temperature=temperature,
                           top_k=top_k, top_p=top_p)
@@ -834,9 +936,10 @@ def _decode_multi_paged(params: Params, pool_k, pool_v, bt,
         if eos_id is not None:
             done_now = done_now | (tok == eos_id)
         cont = active & ~done_now
-        logits, pool_k, pool_v = _decode_core_paged(
+        logits, pool_k, pool_v, scale_k, scale_v = _decode_core_paged(
             params, tok, pool_k, pool_v, bt, row_len, cfg,
-            adapters=adapters, row_slot=row_slot)
+            adapters=adapters, row_slot=row_slot, scale_k=scale_k,
+            scale_v=scale_v, qspec=qspec)
         row_len = row_len + cont.astype(jnp.int32)
         last_logits = jnp.where(cont[:, None], logits, last_logits)
         if shardings is not None:
@@ -844,52 +947,80 @@ def _decode_multi_paged(params: Params, pool_k, pool_v, bt,
                 pool_k, shardings.pool)
             pool_v = jax.lax.with_sharding_constraint(
                 pool_v, shardings.pool)
+            if qspec is not None and shardings.scale is not None:
+                scale_k = jax.lax.with_sharding_constraint(
+                    scale_k, shardings.scale)
+                scale_v = jax.lax.with_sharding_constraint(
+                    scale_v, shardings.scale)
             last_logits = jax.lax.with_sharding_constraint(
                 last_logits, shardings.logits)
-        return (pool_k, pool_v, last_logits, row_len, cont, budget,
-                tok_idx), emit
+        return (pool_k, pool_v, scale_k, scale_v, last_logits, row_len,
+                cont, budget, tok_idx), emit
 
-    (pool_k, pool_v, last_logits, row_len, active, budget, tok_idx), \
-        toks = jax.lax.scan(
-            body, (pool_k, pool_v, last_logits, row_len, active,
-                   budget, tok_idx),
+    (pool_k, pool_v, scale_k, scale_v, last_logits, row_len, active,
+     budget, tok_idx), toks = jax.lax.scan(
+            body, (pool_k, pool_v, scale_k, scale_v, last_logits,
+                   row_len, active, budget, tok_idx),
             None, length=horizon)
     if shardings is not None:
         toks = jax.lax.with_sharding_constraint(
             toks, shardings.replicated)
-    return (toks, pool_k, pool_v, last_logits, row_len, active,
-            budget, tok_idx)
+    return (toks, pool_k, pool_v, scale_k, scale_v, last_logits,
+            row_len, active, budget, tok_idx)
 
 
 def _spec_layer_rows_paged(h, layer, k_pages, v_pages, bt, slots,
-                           cfg: LlamaConfig):
+                           cfg: LlamaConfig,
+                           qspec: Optional[KVQuantSpec] = None):
     """S-wide `_decode_layer_rows_paged`: each row's S new K/V entries
     scatter through its block table and the S queries attend through
     it, with per-query causal masking inside `paged_attention`. Slots
     past a row's allocated chain map to the null block (write garbage
     nobody reads; only overshoot queries — whose results the accept
-    mask discards — ever look that far)."""
-    T = k_pages.shape[1]
+    mask discards — ever look that far). The quantized path hands
+    `paged_quant_write` the whole S-wide window — its static
+    window-block loop handles windows straddling block boundaries —
+    with (pages, scales) tuples threaded through `_layer_body` exactly
+    as in the decode layer."""
+    if qspec is None:
+        T = k_pages.shape[1]
+    else:
+        T = k_pages[0].shape[1]
     span = bt.shape[1] * T
     bidx = jnp.arange(slots.shape[0])[:, None]
     blk = bt[bidx, slots // T]             # [B, S]
     off = slots % T
 
-    def write_kv(k_pages, v_pages, k, v):
-        k_pages = k_pages.at[blk, off].set(k.astype(k_pages.dtype))
-        v_pages = v_pages.at[blk, off].set(v.astype(v_pages.dtype))
-        return k_pages, v_pages
+    if qspec is None:
+        def write_kv(k_pages, v_pages, k, v):
+            k_pages = k_pages.at[blk, off].set(k.astype(k_pages.dtype))
+            v_pages = v_pages.at[blk, off].set(v.astype(v_pages.dtype))
+            return k_pages, v_pages
 
-    def attend(q, k_pages, v_pages):
-        return paged_attention(q, k_pages, v_pages, bt, slots,
-                               kv_valid_len=span)
+        def attend(q, k_pages, v_pages):
+            return paged_attention(q, k_pages, v_pages, bt, slots,
+                                   kv_valid_len=span)
+    else:
+        def write_kv(kc, vc, k, v):
+            kp, ks = paged_quant_write(kc[0], kc[1], bt, slots[:, 0],
+                                       k, qspec)
+            vp, vs = paged_quant_write(vc[0], vc[1], bt, slots[:, 0],
+                                       v, qspec)
+            return (kp, ks), (vp, vs)
+
+        def attend(q, kc, vc):
+            return paged_attention(q, kc[0], vc[0], bt, slots,
+                                   kv_valid_len=span, k_scale=kc[1],
+                                   v_scale=vc[1])
 
     return _layer_body(h, layer, k_pages, v_pages, slots, write_kv,
                        slots, span, cfg, attend=attend)
 
 
 def _spec_core_paged(params: Params, toks: jax.Array, pool_k, pool_v,
-                     bt, starts, cfg: LlamaConfig):
+                     bt, starts, cfg: LlamaConfig, scale_k=None,
+                     scale_v=None,
+                     qspec: Optional[KVQuantSpec] = None):
     """S-wide `_decode_core_paged`: feed each row's [S] chunk at slots
     ``starts + arange(S)`` and return the full [B, S, vocab] logits —
     the draft consume/scan steps and the target verify pass are all
@@ -900,26 +1031,41 @@ def _spec_core_paged(params: Params, toks: jax.Array, pool_k, pool_v,
 
     def body(carry, xs):
         h = carry
-        layer, k_p, v_p = xs
-        h, k_p, v_p = _spec_layer_rows_paged(h, layer, k_p, v_p, bt,
-                                             slots, cfg)
-        return h, (k_p, v_p)
+        if qspec is None:
+            layer, k_p, v_p = xs
+            kc, vc = k_p, v_p
+        else:
+            layer, k_p, v_p, ks, vs = xs
+            kc, vc = (k_p, ks), (v_p, vs)
+        h, kc, vc = _spec_layer_rows_paged(h, layer, kc, vc, bt,
+                                           slots, cfg, qspec=qspec)
+        if qspec is None:
+            return h, (kc, vc)
+        return h, (kc[0], vc[0], kc[1], vc[1])
 
-    h, (k_new, v_new) = jax.lax.scan(
-        body, h, (params["layers"], pool_k, pool_v))
+    xs = (params["layers"], pool_k, pool_v)
+    if qspec is not None:
+        xs = xs + (scale_k, scale_v)
+    h, ys = jax.lax.scan(body, h, xs)
+    if qspec is None:
+        (k_new, v_new), s_k, s_v = ys, None, None
+    else:
+        k_new, v_new, s_k, s_v = ys
     h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bsd,dv->bsv", h,
                         params["lm_head"].astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
-    return logits, k_new, v_new
+    return logits, k_new, v_new, s_k, s_v
 
 
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "d_cfg", "window", "greedy",
                                     "top_k", "top_p", "eos_id",
-                                    "shardings"),
+                                    "shardings", "qspec"),
                    donate_argnames=("pool_k", "pool_v", "pool_dk",
-                                    "pool_dv", "last_logits"))
+                                    "pool_dv", "scale_k", "scale_v",
+                                    "scale_dk", "scale_dv",
+                                    "last_logits"))
 def _spec_round_paged(params: Params, d_params: Params, pool_k, pool_v,
                       pool_dk, pool_dv, bt, bt_d, last_logits, row_len,
                       active, budget, tok_idx, d_lag, d_tok, row_keys,
@@ -927,12 +1073,18 @@ def _spec_round_paged(params: Params, d_params: Params, pool_k, pool_v,
                       d_cfg: LlamaConfig, window: int, greedy: bool,
                       top_k: Optional[int], top_p: Optional[float],
                       eos_id: Optional[int],
-                      shardings: Optional[_EngineShardings] = None):
+                      shardings: Optional[_EngineShardings] = None,
+                      scale_k=None, scale_v=None, scale_dk=None,
+                      scale_dv=None,
+                      qspec: Optional[KVQuantSpec] = None):
     """`_spec_round` over the block pools: the target plane reaches its
     K/V through `bt`, the draft plane through its own private table
     `bt_d` (draft blocks are never shared — the trie only indexes the
     target pool). Same round structure, same `_spec_accept`, same emit
-    contract."""
+    contract. With kv_quant BOTH planes are quantized — each pool
+    carries its own scale slab; a rejected window's stale K/V is
+    zeroed out of the next overlapping write's absmax by
+    `paged_quant_write`, so no-rollback cache discipline still holds."""
     B = row_len.shape[0]
     bidx = jnp.arange(B)
     W = window
@@ -949,28 +1101,31 @@ def _spec_round_paged(params: Params, d_params: Params, pool_k, pool_v,
 
     pend = jnp.where(d_lag == 1, d_tok, t0)
     chunk2 = jnp.stack([pend, t0], axis=1)
-    d_logits, pool_dk, pool_dv = _spec_core_paged(
+    d_logits, pool_dk, pool_dv, scale_dk, scale_dv = _spec_core_paged(
         d_params, chunk2, pool_dk, pool_dv, bt_d, row_len - d_lag,
-        d_cfg)
+        d_cfg, scale_k=scale_dk, scale_v=scale_dv, qspec=qspec)
     first = jnp.argmax(d_logits[bidx, d_lag],
                        axis=-1).astype(jnp.int32)
 
     def dstep(carry, j):
-        tok, pool_dk, pool_dv = carry
-        lg, pool_dk, pool_dv = _spec_core_paged(
+        tok, pool_dk, pool_dv, scale_dk, scale_dv = carry
+        lg, pool_dk, pool_dv, scale_dk, scale_dv = _spec_core_paged(
             d_params, tok[:, None], pool_dk, pool_dv, bt_d,
-            row_len + 1 + j, d_cfg)
+            row_len + 1 + j, d_cfg, scale_k=scale_dk, scale_v=scale_dv,
+            qspec=qspec)
         nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
-        return (nxt, pool_dk, pool_dv), tok
+        return (nxt, pool_dk, pool_dv, scale_dk, scale_dv), tok
 
-    (lastp, pool_dk, pool_dv), dtoks = jax.lax.scan(
-        dstep, (first, pool_dk, pool_dv), jnp.arange(W - 1))
+    (lastp, pool_dk, pool_dv, scale_dk, scale_dv), dtoks = jax.lax.scan(
+        dstep, (first, pool_dk, pool_dv, scale_dk, scale_dv),
+        jnp.arange(W - 1))
     proposals = jnp.concatenate([dtoks.T, lastp[:, None]], axis=1) \
         if W > 1 else lastp[:, None]
 
     chunk = jnp.concatenate([t0[:, None], proposals], axis=1)
-    v_logits, pool_k, pool_v = _spec_core_paged(
-        params, chunk, pool_k, pool_v, bt, row_len, cfg)
+    v_logits, pool_k, pool_v, scale_k, scale_v = _spec_core_paged(
+        params, chunk, pool_k, pool_v, bt, row_len, cfg,
+        scale_k=scale_k, scale_v=scale_v, qspec=qspec)
     ver = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
 
     (emits, last_logits, row_len, active, budget, tok_idx, d_lag,
@@ -987,60 +1142,103 @@ def _spec_round_paged(params: Params, d_params: Params, pool_k, pool_v,
                                                    shardings.d_pool)
         pool_dv = jax.lax.with_sharding_constraint(pool_dv,
                                                    shardings.d_pool)
+        if qspec is not None and shardings.scale is not None:
+            scale_k = jax.lax.with_sharding_constraint(
+                scale_k, shardings.scale)
+            scale_v = jax.lax.with_sharding_constraint(
+                scale_v, shardings.scale)
+        if qspec is not None and shardings.d_scale is not None:
+            scale_dk = jax.lax.with_sharding_constraint(
+                scale_dk, shardings.d_scale)
+            scale_dv = jax.lax.with_sharding_constraint(
+                scale_dv, shardings.d_scale)
         last_logits = jax.lax.with_sharding_constraint(
             last_logits, shardings.logits)
         emits = jax.lax.with_sharding_constraint(emits,
                                                  shardings.replicated)
-    return (emits, pool_k, pool_v, pool_dk, pool_dv, last_logits,
-            row_len, active, budget, tok_idx, d_lag, d_tok)
+    return (emits, pool_k, pool_v, pool_dk, pool_dv, scale_k, scale_v,
+            scale_dk, scale_dv, last_logits, row_len, active, budget,
+            tok_idx, d_lag, d_tok)
 
 
 @functools.partial(jax.jit, static_argnames=("shardings",),
-                   donate_argnames=("pool_k", "pool_v"))
+                   donate_argnames=("pool_k", "pool_v", "scale_k",
+                                    "scale_v"))
 def _cow_blocks(pool_k, pool_v, src: jax.Array, dst: jax.Array,
-                shardings: Optional[_EngineShardings] = None):
+                shardings: Optional[_EngineShardings] = None,
+                scale_k=None, scale_v=None):
     """Copy-on-write block duplication: ONE program copies every
     (src -> dst) pair of this admission round. Dispatched when a warm
     admission matched its FULL prompt — the tail block must still grow
     the row's generated tokens, so the row gets a private copy instead
     of a share (every non-tail matched block stays zero-copy). src/dst
-    are power-of-two padded with (0, 0): null -> null, harmless."""
+    are power-of-two padded with (0, 0): null -> null, harmless. A
+    quantized pool copies its per-block scales alongside — the copy is
+    byte-exact, never a requantization."""
     pool_k = pool_k.at[:, dst].set(pool_k[:, src])
     pool_v = pool_v.at[:, dst].set(pool_v[:, src])
+    if scale_k is not None:
+        scale_k = scale_k.at[:, dst].set(scale_k[:, src])
+        scale_v = scale_v.at[:, dst].set(scale_v[:, src])
     if shardings is not None:
         pool_k = jax.lax.with_sharding_constraint(pool_k, shardings.pool)
         pool_v = jax.lax.with_sharding_constraint(pool_v, shardings.pool)
-    return pool_k, pool_v
+        if scale_k is not None and shardings.scale is not None:
+            scale_k = jax.lax.with_sharding_constraint(
+                scale_k, shardings.scale)
+            scale_v = jax.lax.with_sharding_constraint(
+                scale_v, shardings.scale)
+    return pool_k, pool_v, scale_k, scale_v
 
 
 @functools.partial(jax.jit, static_argnames=("shardings",))
 def _swap_out_gather(pool_k, pool_v, block_ids: jax.Array,
-                     shardings: Optional[_EngineShardings] = None):
+                     shardings: Optional[_EngineShardings] = None,
+                     scale_k=None, scale_v=None):
     """Gather a preemption victim's blocks [L, n, T, KV, D] out of the
     pool into fresh buffers. The caller issues `copy_to_host_async` on
     the result and drops the device reference once the host copy
     lands, so the victim's HBM is actually reclaimed. block_ids is
     power-of-two padded with the null block (its garbage rides along
-    and is scattered straight back at swap-in)."""
-    return pool_k[:, block_ids], pool_v[:, block_ids]
+    and is scattered straight back at swap-in). A quantized pool ships
+    the QUANTIZED bytes plus the [L, n, KV] scales — roughly half the
+    bf16 swap traffic — and the round trip is byte-exact by
+    construction (no dequantization happens on either leg)."""
+    if scale_k is None:
+        return (pool_k[:, block_ids], pool_v[:, block_ids], None, None)
+    return (pool_k[:, block_ids], pool_v[:, block_ids],
+            scale_k[:, block_ids], scale_v[:, block_ids])
 
 
 @functools.partial(jax.jit, static_argnames=("shardings",),
-                   donate_argnames=("pool_k", "pool_v"))
+                   donate_argnames=("pool_k", "pool_v", "scale_k",
+                                    "scale_v"))
 def _swap_in_scatter(pool_k, pool_v, host_k, host_v,
                      block_ids: jax.Array,
-                     shardings: Optional[_EngineShardings] = None):
+                     shardings: Optional[_EngineShardings] = None,
+                     scale_k=None, scale_v=None, host_sk=None,
+                     host_sv=None):
     """Scatter a swapped-out request's host K/V into a freshly
     allocated block chain — the other half of preempt-and-swap. The
     new physical block ids need not match the old ones: the block
     table indirection is what makes the bytes land logically where
-    they were."""
+    they were. Quantized bytes + scales scatter back verbatim."""
     pool_k = pool_k.at[:, block_ids].set(host_k.astype(pool_k.dtype))
     pool_v = pool_v.at[:, block_ids].set(host_v.astype(pool_v.dtype))
+    if scale_k is not None:
+        scale_k = scale_k.at[:, block_ids].set(
+            host_sk.astype(scale_k.dtype))
+        scale_v = scale_v.at[:, block_ids].set(
+            host_sv.astype(scale_v.dtype))
     if shardings is not None:
         pool_k = jax.lax.with_sharding_constraint(pool_k, shardings.pool)
         pool_v = jax.lax.with_sharding_constraint(pool_v, shardings.pool)
-    return pool_k, pool_v
+        if scale_k is not None and shardings.scale is not None:
+            scale_k = jax.lax.with_sharding_constraint(
+                scale_k, shardings.scale)
+            scale_v = jax.lax.with_sharding_constraint(
+                scale_v, shardings.scale)
+    return pool_k, pool_v, scale_k, scale_v
 
 
 # ---------------------------------------------------------------------------
@@ -1106,10 +1304,10 @@ class _SwapState:
     request's key and tok_idx — never on which row or which step."""
 
     __slots__ = ("k", "v", "n_blocks", "row_len", "tok_idx", "budget",
-                 "logits")
+                 "logits", "sk", "sv")
 
     def __init__(self, k, v, n_blocks: int, row_len: int, tok_idx: int,
-                 budget: int, logits):
+                 budget: int, logits, sk=None, sv=None):
         self.k = k
         self.v = v
         self.n_blocks = n_blocks
@@ -1117,6 +1315,10 @@ class _SwapState:
         self.tok_idx = tok_idx
         self.budget = budget
         self.logits = logits
+        # quantized pools spill their per-block scales alongside the
+        # (quantized) bytes; None for a dense-precision pool
+        self.sk = sk
+        self.sv = sv
 
 
 class _InflightStep:
@@ -1235,6 +1437,7 @@ class DecodeEngine:
                  paged: bool = False,
                  kv_block_tokens: Optional[int] = None,
                  kv_pool_bytes: Optional[int] = None,
+                 kv_quant: Optional[str] = None,
                  preempt: str = "swap",
                  draft_params: Optional[Params] = None,
                  draft_cfg: Optional[LlamaConfig] = None,
@@ -1272,6 +1475,13 @@ class DecodeEngine:
                              f"got {preempt!r}")
         if kv_block_tokens is not None and kv_block_tokens < 1:
             raise ValueError("kv_block_tokens must be >= 1")
+        self.kv_quant_spec = resolve_kv_quant(kv_quant)
+        self.kv_quant = kv_quant if self.kv_quant_spec is not None \
+            else None
+        if self.kv_quant_spec is not None and not paged:
+            raise ValueError(
+                "kv_quant requires paged=True: quantization scales are "
+                "per-block slabs of the paged KV pool")
         if draft_params is not None:
             if draft_cfg is None:
                 raise ValueError("draft_params needs draft_cfg")
@@ -1378,7 +1588,7 @@ class DecodeEngine:
             self._rules = rules
             self.params = shard_pytree(
                 params, llama_param_specs(cfg, rules), mesh)
-            d_cache_sh = d_pool_sh = None
+            d_cache_sh = d_pool_sh = d_scale_sh = None
             self._d_shardings = None
             if draft_params is not None:
                 # The draft shards over the SAME mesh, but its rules
@@ -1401,6 +1611,9 @@ class DecodeEngine:
                 d_pool_sh = named_sharding(
                     mesh, "layers", None, None, "kv", "head_dim",
                     rules=d_rules)
+                if self.kv_quant_spec is not None:
+                    d_scale_sh = named_sharding(
+                        mesh, "layers", None, "kv", rules=d_rules)
                 # A second shardings view with the DRAFT plane in the
                 # primary slots, so `_prefill_rows(_paged)` runs
                 # unchanged when seeding the draft cache.
@@ -1408,7 +1621,14 @@ class DecodeEngine:
                     cache=d_cache_sh,
                     logits=named_sharding(mesh, "batch", "vocab",
                                           rules=d_rules),
-                    pool=d_pool_sh)
+                    pool=d_pool_sh,
+                    scale=d_scale_sh)
+            scale_sh = None
+            if self.kv_quant_spec is not None:
+                # scale slab [L, NB, KV]: same pruned KV rules as the
+                # pool it dequantizes, so gather stays chip-local
+                scale_sh = named_sharding(mesh, "layers", None, "kv",
+                                          rules=rules)
             self._shardings = _EngineShardings(
                 cache=named_sharding(mesh, "layers", "batch", "length",
                                      "kv", "head_dim", rules=rules),
@@ -1416,7 +1636,8 @@ class DecodeEngine:
                                       rules=rules),
                 pool=named_sharding(mesh, "layers", None, None, "kv",
                                     "head_dim", rules=rules),
-                d_cache=d_cache_sh, d_pool=d_pool_sh)
+                d_cache=d_cache_sh, d_pool=d_pool_sh,
+                scale=scale_sh, d_scale=d_scale_sh)
         else:
             self.tp_degree = 1
             self._rules = None
@@ -1551,7 +1772,19 @@ class DecodeEngine:
         kv_dtype = jnp.dtype(cfg.dtype)
         if paged:
             T = self.prefix_block
-            bb = block_bytes(L, T, KV, D, kv_dtype.itemsize)
+            if self.kv_quant_spec is not None:
+                # Quantized pool: 1-byte values + the per-block scale
+                # slab's footprint (2 slabs x L x KV f32 scales per
+                # block) — the ~2x concurrency-per-HBM-byte lever.
+                pool_dtype = self.kv_quant_spec.dtype
+                bb = block_bytes(L, T, KV, D,
+                                 self.kv_quant_spec.itemsize) \
+                    + 2 * L * KV * 4
+            else:
+                pool_dtype = kv_dtype
+                bb = block_bytes(L, T, KV, D, kv_dtype.itemsize)
+            self.kv_bytes_per_block = float(bb)
+            self.kv_bytes_per_token = bb / T
             budget_bytes = (kv_pool_bytes if kv_pool_bytes is not None
                             else prefix_cache_bytes)
             if budget_bytes is None:
@@ -1568,18 +1801,38 @@ class DecodeEngine:
             self._swapped: Dict[int, _SwapState] = {}
             self._admit_seq = 0            # preemption recency order
             self._row_admit_seq = np.zeros((self.B,), np.int64)
-            self._pool_k = jnp.zeros((L, n_blocks, T, KV, D), kv_dtype)
-            self._pool_v = jnp.zeros((L, n_blocks, T, KV, D), kv_dtype)
+            self._pool_k = jnp.zeros((L, n_blocks, T, KV, D),
+                                     pool_dtype)
+            self._pool_v = jnp.zeros((L, n_blocks, T, KV, D),
+                                     pool_dtype)
+            self._scale_k = self._scale_v = None
+            if self.kv_quant_spec is not None:
+                # zero scales: dequant of the zero-initialised pool
+                # (incl. the null block) is exactly 0.0 everywhere
+                self._scale_k = jnp.zeros((L, n_blocks, KV),
+                                          jnp.float32)
+                self._scale_v = jnp.zeros((L, n_blocks, KV),
+                                          jnp.float32)
             if self._shardings is not None:
                 self._pool_k = jax.device_put(self._pool_k,
                                               self._shardings.pool)
                 self._pool_v = jax.device_put(self._pool_v,
                                               self._shardings.pool)
+                if self._scale_k is not None:
+                    self._scale_k = jax.device_put(
+                        self._scale_k, self._shardings.scale)
+                    self._scale_v = jax.device_put(
+                        self._scale_v, self._shardings.scale)
             if prefix_cache:
                 self._prefix = PrefixCacheIndex(
                     block_tokens=T, n_blocks=n_blocks,
                     on_evict=self._on_prefix_evict, pool=self.kv_pool)
         elif prefix_cache:
+            self._scale_k = self._scale_v = None
+            self.kv_bytes_per_block = float(block_bytes(
+                L, prefix_block, KV, D, kv_dtype.itemsize))
+            self.kv_bytes_per_token = self.kv_bytes_per_block \
+                / prefix_block
             bb = block_bytes(L, prefix_block, KV, D, kv_dtype.itemsize)
             if prefix_cache_bytes is None:
                 n_blocks = 1 + (2 * self.B * self.max_len) // prefix_block
@@ -1604,6 +1857,11 @@ class DecodeEngine:
                                               self._shardings.pool)
         else:
             self._pool_k = self._pool_v = None
+            self._scale_k = self._scale_v = None
+            # dense per-slot cache: 2 (K+V) x L x KV x D per token
+            self.kv_bytes_per_token = float(
+                2 * L * KV * D * kv_dtype.itemsize)
+            self.kv_bytes_per_block = 0.0
         if self._prefix is not None:
             attach = getattr(self.scheduler, "attach_prefix_probe", None)
             if attach is not None:
@@ -1652,15 +1910,29 @@ class DecodeEngine:
                 self._bt_d = np.zeros((self.B, self._mb), np.int32)
                 self._row_blocks_d: List[List[int]] = [
                     [] for _ in range(self.B)]
+                d_pool_dtype = (self.kv_quant_spec.dtype
+                                if self.kv_quant_spec is not None
+                                else d_dtype)
                 self._pool_dk = jnp.zeros(
-                    (L_d, n_blocks_d, T, KV_d, D_d), d_dtype)
+                    (L_d, n_blocks_d, T, KV_d, D_d), d_pool_dtype)
                 self._pool_dv = jnp.zeros(
-                    (L_d, n_blocks_d, T, KV_d, D_d), d_dtype)
+                    (L_d, n_blocks_d, T, KV_d, D_d), d_pool_dtype)
+                self._scale_dk = self._scale_dv = None
+                if self.kv_quant_spec is not None:
+                    self._scale_dk = jnp.zeros(
+                        (L_d, n_blocks_d, KV_d), jnp.float32)
+                    self._scale_dv = jnp.zeros(
+                        (L_d, n_blocks_d, KV_d), jnp.float32)
                 if self._d_shardings is not None:
                     self._pool_dk = jax.device_put(
                         self._pool_dk, self._d_shardings.pool)
                     self._pool_dv = jax.device_put(
                         self._pool_dv, self._d_shardings.pool)
+                    if self._scale_dk is not None:
+                        self._scale_dk = jax.device_put(
+                            self._scale_dk, self._d_shardings.scale)
+                        self._scale_dv = jax.device_put(
+                            self._scale_dv, self._d_shardings.scale)
                 self._d_cache = None
             else:
                 self.kv_pool_d = None
@@ -1669,6 +1941,7 @@ class DecodeEngine:
                     sharding=None if self._d_shardings is None
                     else self._d_shardings.cache)
                 self._pool_dk = self._pool_dv = None
+                self._scale_dk = self._scale_dv = None
             if enable_metrics:
                 # llm_spec_* Prometheus counters share the engine's
                 # tag, so fleet dashboards can join the spec plane onto
@@ -2113,7 +2386,8 @@ class DecodeEngine:
         if self.paged:
             self.metrics.on_kv_pool(self.kv_pool.blocks_total,
                                     self.kv_pool.blocks_in_use,
-                                    self.kv_pool.free_blocks)
+                                    self.kv_pool.free_blocks,
+                                    bytes_per_token=self.kv_bytes_per_token)
         return emitted
 
     # -- async pipeline ----------------------------------------------------
@@ -2232,14 +2506,18 @@ class DecodeEngine:
                 btd_dev = jax.device_put(btd_dev,
                                          self._shardings.replicated)
             (toks, self._pool_k, self._pool_v, self._pool_dk,
-             self._pool_dv, self._last_logits, rl, ac, bu, ti, dl,
-             dt) = _spec_round_paged(
+             self._pool_dv, self._scale_k, self._scale_v,
+             self._scale_dk, self._scale_dv, self._last_logits, rl,
+             ac, bu, ti, dl, dt) = _spec_round_paged(
                 self.params, self.draft_params, self._pool_k,
                 self._pool_v, self._pool_dk, self._pool_dv, bt_dev,
                 btd_dev, self._last_logits, *args,
                 jnp.asarray(self._row_keys), rg, wr, self.temperature,
                 self.cfg, self.draft_cfg, W, all_greedy, self.top_k,
-                self.top_p, self.eos_id, shardings=self._shardings)
+                self.top_p, self.eos_id, shardings=self._shardings,
+                scale_k=self._scale_k, scale_v=self._scale_v,
+                scale_dk=self._scale_dk, scale_dv=self._scale_dv,
+                qspec=self.kv_quant_spec)
         else:
             (toks, self.cache, self._d_cache, self._last_logits, rl,
              ac, bu, ti, dl, dt) = _spec_round(
@@ -2310,14 +2588,16 @@ class DecodeEngine:
             if self._shardings is not None:
                 bt_dev = jax.device_put(bt_dev,
                                         self._shardings.replicated)
-            (toks, self._pool_k, self._pool_v, self._last_logits,
+            (toks, self._pool_k, self._pool_v, self._scale_k,
+             self._scale_v, self._last_logits,
              rl, ac, bu, ti) = _decode_multi_paged(
                 self.params, self._pool_k, self._pool_v, bt_dev,
                 self._last_logits, *args, jnp.asarray(self._row_keys),
                 rg, self.temperature, self.cfg, H, all_greedy,
                 self.top_k, self.top_p, self.eos_id,
                 shardings=self._shardings, adapters=adapters,
-                row_slot=row_slot)
+                row_slot=row_slot, scale_k=self._scale_k,
+                scale_v=self._scale_v, qspec=self.kv_quant_spec)
         else:
             toks, self.cache, self._last_logits, rl, ac, bu, ti = \
                 _decode_multi(
@@ -2537,6 +2817,12 @@ class DecodeEngine:
         out["swap_in_bytes"] = float(self.swap_in_bytes)
         out["swap_out_bytes"] = float(self.swap_out_bytes)
         out["kv_used_fraction"] = self.kv_used_fraction()
+        # Quantized-KV plane: bytes/token is the concurrency lever the
+        # fleet watches (see docs/serving.md); identically dense-sized
+        # (and quant_enabled 0.0) on an unquantized engine.
+        out["kv_quant_enabled"] = 1.0 if self.kv_quant else 0.0
+        out["kv_bytes_per_token"] = float(self.kv_bytes_per_token)
+        out["kv_bytes_per_block"] = float(self.kv_bytes_per_block)
         if self.paged:
             pool = self.kv_pool
             out["kv_pool_blocks_total"] = float(pool.blocks_total)
@@ -3019,9 +3305,11 @@ class DecodeEngine:
             for i, (s, d) in enumerate(cow_pairs):
                 src[i] = s
                 dst[i] = d
-            self._pool_k, self._pool_v = _cow_blocks(
+            (self._pool_k, self._pool_v, self._scale_k,
+             self._scale_v) = _cow_blocks(
                 self._pool_k, self._pool_v, jnp.asarray(src),
-                jnp.asarray(dst), shardings=self._shardings)
+                jnp.asarray(dst), shardings=self._shardings,
+                scale_k=self._scale_k, scale_v=self._scale_v)
         self._seed_draft_rows(draft_seeds)
 
     def _seed_draft_rows(
@@ -3066,13 +3354,16 @@ class DecodeEngine:
             last_idx[n:] = last_idx[n - 1]  # identical values
             if self.paged:
                 bt_grp = self._bt_d[rows]
-                (self._pool_dk, self._pool_dv,
+                (self._pool_dk, self._pool_dv, self._scale_dk,
+                 self._scale_dv,
                  self._d_last_logits) = _prefill_rows_paged(
                     self.draft_params, jnp.asarray(prompts),
                     self._pool_dk, self._pool_dv, self._d_last_logits,
                     jnp.asarray(bt_grp), jnp.asarray(rows),
                     jnp.asarray(starts), jnp.asarray(last_idx),
-                    self.draft_cfg, shardings=self._d_shardings)
+                    self.draft_cfg, shardings=self._d_shardings,
+                    scale_k=self._scale_dk, scale_v=self._scale_dv,
+                    qspec=self.kv_quant_spec)
             else:
                 self._d_cache, self._d_last_logits = _prefill_rows(
                     self.draft_params, jnp.asarray(prompts),
@@ -3239,19 +3530,27 @@ class DecodeEngine:
             nbp = _pow2(max(1, n))
             bids = np.zeros((nbp,), np.int32)
             bids[:n] = ids
-            k, v = _swap_out_gather(self._pool_k, self._pool_v,
-                                    jnp.asarray(bids),
-                                    shardings=self._shardings)
+            k, v, sk, sv = _swap_out_gather(
+                self._pool_k, self._pool_v, jnp.asarray(bids),
+                shardings=self._shardings, scale_k=self._scale_k,
+                scale_v=self._scale_v)
             lg = self._last_logits[row]
-            for x in (k, v, lg):
-                _host_async(x)
+            for x in (k, v, lg, sk, sv):
+                if x is not None:
+                    _host_async(x)
             k = _device_get(k)
             v = _device_get(v)
             lg = _device_get(lg)
+            if sk is not None:
+                sk = _device_get(sk)
+                sv = _device_get(sv)
             self._swapped[req.req_id] = _SwapState(
                 k, v, n, int(self.row_len[row]),
-                int(self._tok_idx[row]), int(self.row_budget[row]), lg)
+                int(self._tok_idx[row]), int(self.row_budget[row]), lg,
+                sk=sk, sv=sv)
             nbytes = k.nbytes + v.nbytes + lg.nbytes
+            if sk is not None:
+                nbytes += sk.nbytes + sv.nbytes
             self.swap_outs += 1
             self.swap_out_bytes += nbytes
             self.metrics.on_swap_out(nbytes)
@@ -3318,10 +3617,14 @@ class DecodeEngine:
         bids = np.zeros((nbp,), np.int32)      # pad = null block: the
         bids[:swap.n_blocks] = ids             # gather's padding lands
         #                                        back where it came from
-        self._pool_k, self._pool_v = _swap_in_scatter(
+        (self._pool_k, self._pool_v, self._scale_k,
+         self._scale_v) = _swap_in_scatter(
             self._pool_k, self._pool_v, jnp.asarray(swap.k),
             jnp.asarray(swap.v), jnp.asarray(bids),
-            shardings=self._shardings)
+            shardings=self._shardings, scale_k=self._scale_k,
+            scale_v=self._scale_v,
+            host_sk=None if swap.sk is None else jnp.asarray(swap.sk),
+            host_sv=None if swap.sv is None else jnp.asarray(swap.sv))
         self._last_logits = self._last_logits.at[row].set(
             jnp.asarray(swap.logits))
         if self._shardings is not None:
@@ -3331,6 +3634,8 @@ class DecodeEngine:
         self.row_budget[row] = swap.budget
         self._tok_idx[row] = swap.tok_idx
         nbytes = swap.k.nbytes + swap.v.nbytes + swap.logits.nbytes
+        if swap.sk is not None:
+            nbytes += swap.sk.nbytes + swap.sv.nbytes
         self.swap_ins += 1
         self.swap_in_bytes += nbytes
         self.metrics.on_swap_in(nbytes)
@@ -3444,14 +3749,17 @@ class DecodeEngine:
                 adapters = row_slot = None
             if self.paged:
                 bt_grp = self._bt[rows]            # [n_pad, MB]
-                (self._pool_k, self._pool_v,
+                (self._pool_k, self._pool_v, self._scale_k,
+                 self._scale_v,
                  self._last_logits) = _prefill_rows_paged(
                     self.params, jnp.asarray(prompts), self._pool_k,
                     self._pool_v, self._last_logits,
                     jnp.asarray(bt_grp), jnp.asarray(rows),
                     jnp.asarray(starts), jnp.asarray(last_idx),
                     self.cfg, shardings=self._shardings,
-                    adapters=adapters, row_slot=row_slot)
+                    adapters=adapters, row_slot=row_slot,
+                    scale_k=self._scale_k, scale_v=self._scale_v,
+                    qspec=self.kv_quant_spec)
             else:
                 self.cache, self._last_logits = _prefill_rows(
                     self.params, jnp.asarray(prompts), self.cache,

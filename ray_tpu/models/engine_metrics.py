@@ -273,6 +273,7 @@ class EngineMetrics:
         self.kv_pool_blocks_total = 0
         self.kv_pool_blocks_in_use = 0
         self.kv_pool_blocks_free = 0
+        self.kv_bytes_per_token = 0.0
         self._m_kv_shared = counter(
             "llm_engine_kv_blocks_shared_total",
             "Prefix-cache blocks SHARED into warm admissions by "
@@ -302,6 +303,11 @@ class EngineMetrics:
         self._m_kv_pool_free = gauge(
             "llm_engine_kv_pool_blocks_free",
             "KV pool blocks on the free list")
+        self._m_kv_bytes_per_token = gauge(
+            "llm_engine_kv_bytes_per_token",
+            "HBM bytes one cached token costs (quant dtype + its "
+            "share of the per-block scale slab; the admission-"
+            "capacity lever — see docs/serving.md)")
         # Speculative plane (PR: engine-integrated draft/verify). The
         # per-spec-plane llm_spec_* series live in SpecMetrics, tagged
         # with the SAME engine id; these engine-tagged aggregates let
@@ -553,14 +559,21 @@ class EngineMetrics:
             self.swap_in_bytes += nbytes
             self._m_swap_in.inc(nbytes)
 
-    def on_kv_pool(self, total: int, in_use: int, free: int) -> None:
-        """Gauge update at step end: pool occupancy in blocks."""
+    def on_kv_pool(self, total: int, in_use: int, free: int,
+                   bytes_per_token: float = 0.0) -> None:
+        """Gauge update at step end: pool occupancy in blocks, plus
+        the engine's per-token KV cost (constant per engine — quant
+        dtype + scale-slab share — but exported per step so the fleet
+        plane can weight occupancy into bytes)."""
         self.kv_pool_blocks_total = total
         self.kv_pool_blocks_in_use = in_use
         self.kv_pool_blocks_free = free
         self._m_kv_pool_total.set(total)
         self._m_kv_pool_in_use.set(in_use)
         self._m_kv_pool_free.set(free)
+        if bytes_per_token > 0:
+            self.kv_bytes_per_token = bytes_per_token
+            self._m_kv_bytes_per_token.set(bytes_per_token)
 
     def on_prefill_batch(self, real_tokens: int,
                          padded_tokens: int) -> None:
@@ -696,6 +709,7 @@ class EngineMetrics:
         out["kv_pool_blocks_total"] = self.kv_pool_blocks_total
         out["kv_pool_blocks_in_use"] = self.kv_pool_blocks_in_use
         out["kv_pool_blocks_free"] = self.kv_pool_blocks_free
+        out["kv_bytes_per_token"] = self.kv_bytes_per_token
         out["kv_pool_occupancy"] = (
             self.kv_pool_blocks_in_use / self.kv_pool_blocks_total
             if self.kv_pool_blocks_total else 0.0)
@@ -775,7 +789,7 @@ class NullEngineMetrics:
 
     def on_swap_in(self, nbytes): pass
 
-    def on_kv_pool(self, total, in_use, free): pass
+    def on_kv_pool(self, total, in_use, free, bytes_per_token=0.0): pass
 
     def on_prefill_batch(self, real_tokens, padded_tokens): pass
 

@@ -1519,6 +1519,15 @@ class LLMFleet:
             "kv_used_fraction_mean": (
                 sum(s.get("kv_used_fraction", 0.0) for s in per)
                 / len(per)) if per else 0.0,
+            # Quantized-KV plane: replicas are homogeneous in
+            # practice, so the mean bytes/token IS the fleet's KV cost
+            # per cached token; quant_replicas counts how many run a
+            # low-bit pool (0 = dense fleet).
+            "kv_quant_replicas": sum(
+                s.get("kv_quant_enabled", 0.0) for s in per),
+            "kv_bytes_per_token_mean": (
+                sum(s.get("kv_bytes_per_token", 0.0) for s in per)
+                / len(per)) if per else 0.0,
         }
         # Speculative plane (all-zero when no replica carries a draft
         # model). Rates are re-derived from the summed raw counters —

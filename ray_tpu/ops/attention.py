@@ -130,6 +130,8 @@ def paged_attention(q: jax.Array,
                     *,
                     kv_valid_len,
                     sm_scale: Optional[float] = None,
+                    k_scale: Optional[jax.Array] = None,
+                    v_scale: Optional[jax.Array] = None,
                     impl: str = "auto") -> jax.Array:
     """Attention over PAGED K/V: each query row reads its keys/values
     through a per-row block table instead of a contiguous cache row —
@@ -148,6 +150,10 @@ def paged_attention(q: jax.Array,
       q_slots      [B, S]         the cache slot each query occupies
       kv_valid_len scalar         slots >= this are masked (the
                                   engine's max_len)
+      k/v_scale    [NB, KV]       per-block per-kv-head f32 dequant
+                                  scales when the pool is quantized
+                                  (int8/fp8 — see ops/kv_quant.py);
+                                  None for a dense-precision pool
 
     Semantics are EXACTLY the dense path's `_cached_attention` (see
     models/generate.py) evaluated on the gathered view: causal mask
@@ -157,27 +163,47 @@ def paged_attention(q: jax.Array,
     (tests/test_engine_paged.py) rests on it. Positions gathered from
     unallocated/garbage block entries are always masked: exp(-1e30 -
     max) underflows to exactly 0.0, so any finite garbage contributes
-    exactly nothing.
+    exactly nothing. With scales, dequantization happens INSIDE the
+    gather (the pool itself stays quantized; only the per-row view is
+    widened, to f32, and XLA fuses it into the einsums).
 
-    ``impl`` mirrors `attention`'s dispatch seam. Only the pure-lax
-    "reference" lowering exists today — the gather materializes the
-    [B, MB*T, KV, D] view and XLA fuses it into the einsums, which is
-    the right CPU/interpret-mode form (Pallas is unavailable in this
-    environment); a Mosaic kernel that walks the block table in-VMEM
-    without materializing the view slots in here under impl="flash"
-    when the toolchain lands. "auto" therefore resolves to "reference"
-    on every backend for now."""
+    ``impl`` mirrors `attention`'s dispatch seam. "reference" is the
+    pure-lax lowering above; "flash" routes to the Pallas/Mosaic kernel
+    in ops/paged_attention_kernel.py that walks the block table
+    block-by-block with an online-softmax inner loop — gather + dequant
+    + attend fused, no materialized [B, MB*T, KV, D] view (off-TPU the
+    kernel runs in interpret mode, which is how it is unit-tested
+    against this reference). "auto" resolves to "flash" on TPU and
+    "reference" elsewhere, same policy as `attention`."""
     if impl not in ("auto", "flash", "reference"):
         raise ValueError(f"impl must be auto|flash|reference, got {impl!r}")
     B, S, H, D = q.shape
     NB, T, KV, _ = k_pages.shape
     if H % KV:
         raise ValueError(f"q heads {H} not a multiple of kv heads {KV}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "reference"
+    if impl == "flash":
+        from ray_tpu.ops.paged_attention_kernel import paged_attention_kernel
+
+        return paged_attention_kernel(
+            q, k_pages, v_pages, block_tables, q_slots,
+            kv_valid_len=kv_valid_len, sm_scale=sm_scale,
+            k_scale=k_scale, v_scale=v_scale)
     # Gather the per-row dense view: [B, MB, T, KV, D] -> [B, MB*T, ..]
     # (logical slot p*T + t of row b is block_tables[b, p] slot t, so
     # the reshape restores contiguous slot order per row).
     k = k_pages[block_tables]
     v = v_pages[block_tables]
+    if k_scale is not None:
+        # dequant-in-gather; the view must stay f32 (requantization
+        # byte-stability — see ops/kv_quant.py)
+        k = k.astype(jnp.float32) * k_scale[block_tables][:, :, None, :,
+                                                          None]
+        v = v.astype(jnp.float32) * v_scale[block_tables][:, :, None, :,
+                                                          None]
     span = k.shape[1] * T
     k = k.reshape(B, span, KV, D)
     v = v.reshape(B, span, KV, D)

@@ -1,0 +1,159 @@
+"""Low-bit paged-KV quantization: per-block, per-kv-head scales.
+
+The paged engine stores its KV pool as ``[L, NB, T, KV, D]`` blocks; to
+double the concurrent requests per HBM byte the pool can instead hold
+int8 (qmax 127) or fp8-e4m3 (qmax 448) values plus a parallel f32 scale
+slab shaped ``[L, NB, KV]`` — one scale per block per kv head, indexed
+by the SAME physical block ids as the pages so the refcounted BlockPool
+ledger covers both with no extra alloc/free sites.
+
+Quantization is symmetric absmax: ``s = amax / qmax`` over a block's
+valid slots (``s = 1.0`` for all-zero blocks so dequant stays exact and
+finite), ``q = round_or_cast(clip(x / s, -qmax, qmax))``, dequant
+``x' = q.astype(f32) * s``.  Two properties the engine leans on:
+
+* **Requantization is byte-stable.** Re-quantizing a dequantized block
+  with a freshly recomputed scale reproduces the identical bytes: the
+  recomputed ``amax' = max|q|*s`` differs from ``amax`` only by float
+  rounding, so ``s'/s = 1 ± O(2^-23)`` and ``round(q * s/s')`` (int8) /
+  nearest-fp8 rounding (e4m3, whose relative spacing is ≥ 2^-3) lands
+  back on ``q`` exactly.  This is what keeps shared prefix blocks
+  byte-identical under `_prefill_rows_paged`'s whole-view write-back —
+  provided the dequantized view stays float32 end to end (a bf16
+  round-trip would break it).
+* **Stale slots are zeroed at every write.** A block's scale is an
+  absmax over ALL its slots, so garbage left by a previous tenant (or a
+  rejected speculative window) would silently coarsen the valid tokens'
+  quantization.  Every write site therefore zeroes slots at/beyond the
+  row's written frontier before recomputing the scale.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "KVQuantSpec",
+    "KV_QUANT_MODES",
+    "resolve_kv_quant",
+    "block_scale",
+    "quantize",
+    "dequantize",
+    "paged_quant_write",
+]
+
+KV_QUANT_MODES = ("int8", "fp8_e4m3")
+
+
+@dataclasses.dataclass(frozen=True)
+class KVQuantSpec:
+    """Hashable description of one quantized-KV mode (safe to pass as a
+    jit static argument: all fields are plain python scalars)."""
+
+    name: str         # "int8" | "fp8_e4m3"
+    dtype_name: str   # numpy dtype name of the stored pool values
+    qmax: float       # largest representable magnitude pre-scale
+    itemsize: int = 1  # bytes per stored value
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.dtype_name)
+
+    @property
+    def is_int(self) -> bool:
+        return self.name == "int8"
+
+
+_SPECS = {
+    "int8": KVQuantSpec("int8", "int8", 127.0, 1),
+    "fp8_e4m3": KVQuantSpec("fp8_e4m3", "float8_e4m3fn", 448.0, 1),
+}
+
+
+def resolve_kv_quant(name: Optional[str]) -> Optional[KVQuantSpec]:
+    """Map an engine-level ``kv_quant`` knob to a spec (None -> None)."""
+    if name is None:
+        return None
+    spec = _SPECS.get(name)
+    if spec is None:
+        raise ValueError(
+            f"kv_quant must be one of {KV_QUANT_MODES} or None, got "
+            f"{name!r}")
+    return spec
+
+
+def block_scale(amax: jax.Array, qspec: KVQuantSpec) -> jax.Array:
+    """amax -> scale with the all-zero guard (scale 1.0 so dequant of a
+    zero block is exactly zero and never divides by zero)."""
+    return jnp.where(amax > 0, amax / qspec.qmax, 1.0).astype(jnp.float32)
+
+
+def quantize(x: jax.Array, scale: jax.Array,
+             qspec: KVQuantSpec) -> jax.Array:
+    """``x`` f32 -> stored dtype; ``scale`` must broadcast against x."""
+    y = jnp.clip(x.astype(jnp.float32) / scale, -qspec.qmax, qspec.qmax)
+    if qspec.is_int:
+        y = jnp.round(y)
+    return y.astype(qspec.dtype)
+
+
+def dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Stored dtype -> f32.  Keep the result f32 (see module docstring:
+    a bf16 round-trip breaks requantization byte-stability)."""
+    return q.astype(jnp.float32) * scale
+
+
+def paged_quant_write(pages: jax.Array, scales: jax.Array, bt: jax.Array,
+                      start: jax.Array, vals: jax.Array,
+                      qspec: KVQuantSpec
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Read-modify-write ``vals`` [B, S, KV, D] into quantized ``pages``
+    [NB, T, KV, D] at contiguous cache slots ``start[b] + s`` routed
+    through block table ``bt`` [B, MB], recomputing the per-block
+    per-kv-head ``scales`` [NB, KV] of every touched block.
+
+    This is the decode/spec write site: S == 1 for plain decode, S ==
+    the draft/verify window for speculation.  The window can straddle
+    block boundaries, so the write is a static loop over the (at most
+    ``(S + T - 2)//T + 1``) window blocks; each iteration RMWs ONE block
+    per row — gather + dequant, scatter this window's tokens that land
+    in that block (offset T + ``mode="drop"`` masks the rest), zero
+    every slot at/beyond ``start + S`` (stale garbage from a previous
+    tenant or a rejected speculative window must not leak into the
+    absmax), requantize with the fresh scale, scatter back.
+
+    Rows whose window block index runs off the table (retired rows, or
+    frontiers at max_len) resolve to physical block 0 — the reserved
+    null block, never attended — exactly like the unquantized write
+    path's masked scatter.
+    """
+    B, S, KV, D = vals.shape
+    T = pages.shape[1]
+    MB = bt.shape[1]
+    vals = vals.astype(jnp.float32)
+    bidx = jnp.arange(B)
+    nbw = (S + T - 2) // T + 1            # max blocks a window can touch
+    off0 = start % T                      # [B] offset in first block
+    for w in range(nbw):
+        lb = start // T + w               # [B] logical block index
+        blk = jnp.where(lb < MB, bt[bidx, jnp.minimum(lb, MB - 1)], 0)
+        cur = dequantize(pages[blk], scales[blk][:, None, :, None])
+        # token s sits at window position off0 + s; it lands in this
+        # iteration's block iff (off0 + s) // T == w.  Offset T is OOB
+        # and dropped.
+        pos = off0[:, None] + jnp.arange(S)[None, :]          # [B, S]
+        offs = jnp.where(pos // T == w, pos % T, T)
+        cur = cur.at[bidx[:, None], offs].set(vals, mode="drop")
+        # zero stale slots at/beyond the written frontier
+        slot = (lb * T)[:, None] + jnp.arange(T)[None, :]     # [B, T]
+        live = slot < (start + S)[:, None]
+        cur = jnp.where(live[:, :, None, None], cur, 0.0)
+        amax = jnp.max(jnp.abs(cur), axis=(1, 3))             # [B, KV]
+        s_new = block_scale(amax, qspec)
+        pages = pages.at[blk].set(quantize(
+            cur, s_new[:, None, :, None], qspec))
+        scales = scales.at[blk].set(s_new)
+    return pages, scales

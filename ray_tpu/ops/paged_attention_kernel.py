@@ -1,0 +1,178 @@
+"""Fused block-walking paged decode-attention kernel (Pallas/Mosaic).
+
+The pure-lax reference in `ops/attention.py:paged_attention` gathers a
+per-row dense view ``[B, MB*T, KV, D]`` and lets XLA fuse it — correct,
+but the gathered view is materialization pressure exactly proportional
+to the block-table span. This kernel instead walks each row's block
+table block-by-block in VMEM with a flash-style online-softmax inner
+loop: the physical page for grid step ``j`` is resolved through a
+scalar-prefetched block table inside the BlockSpec index map, so page
+gather + (optional int8/fp8) dequantization + attend are fused and no
+dense view ever exists.
+
+Grid is ``(B, H, MB)`` with the block-walk axis innermost and marked
+"arbitrary" (the online-softmax recurrence is sequential); scratch is
+the usual flash trio — f32 accumulator ``[S, D]`` plus running max/sum
+``[S, 1]`` — carried across the walk and finalized on the last block.
+Masked positions follow the reference exactly: causal ``slot <=
+q_slot`` plus the ``kv_valid_len`` cap, fully-masked rows produce 0.
+
+Pallas cannot lower to this box's TPU toolchain, so the kernel is
+validated in **interpret mode** against the pure-lax reference
+(tests/test_engine_kv_quant.py sweeps (B, MB, T, KV, D) shapes incl.
+GQA, ragged valid lengths and quantized pools) — the same oracle
+pattern ops/flash_attention.py uses. On TPU `impl="auto"` routes here;
+off-TPU it stays on the reference path and this kernel runs only when
+asked for explicitly (then in interpret mode).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:  # pragma: no cover - import guard for broken toolchains
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _PALLAS_ERR = None
+except Exception as _e:  # noqa: BLE001
+    pl = None
+    pltpu = None
+    _PALLAS_ERR = _e
+
+_NEG_INF = -1e30
+
+__all__ = ["paged_attention_kernel"]
+
+
+def _kernel(bt_ref, lim_ref, q_ref, k_ref, v_ref, *rest, scale, n_blocks,
+            seq_q, has_scale):
+    """One (b, h, j) grid step: fold page j of row b into the online
+    softmax. Scalar-prefetch refs: ``bt_ref`` [B, MB] block table (also
+    consumed by the BlockSpec index maps), ``lim_ref`` [B, S+1] packing
+    each query's cache slot plus the valid-length cap."""
+    if has_scale:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, :, 0, :].astype(jnp.float32)          # [S, D]
+    k = k_ref[0, :, 0, :].astype(jnp.float32)          # [T, D]
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    if has_scale:
+        k = k * ks_ref[0, 0]                           # dequant in VMEM
+        v = v * vs_ref[0, 0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    t = k.shape[0]
+    slot = j * t + jax.lax.broadcasted_iota(jnp.int32, (seq_q, t), 1)
+    q_slots = jnp.stack([lim_ref[b, i] for i in range(seq_q)])
+    valid_len = lim_ref[b, seq_q]
+    mask = (slot <= q_slots[:, None]) & (slot < valid_len)
+    s = jnp.where(mask, s, _NEG_INF)
+    m_prev = m_ref[...]                                # [S, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    # explicit zero (not just exp underflow): a fully-masked block with
+    # m still at -inf would otherwise yield exp(0) == 1 per position
+    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[...] = m_new
+
+    @pl.when(j == n_blocks - 1)
+    def _finalize():
+        l = l_ref[...]
+        l = jnp.where(l == 0.0, 1.0, l)
+        row_live = m_ref[...] > _NEG_INF / 2
+        o_ref[0, :, 0, :] = jnp.where(
+            row_live, acc_ref[...] / l, 0.0).astype(o_ref.dtype)
+
+
+def paged_attention_kernel(q: jax.Array,
+                           k_pages: jax.Array,
+                           v_pages: jax.Array,
+                           block_tables: jax.Array,
+                           q_slots: jax.Array,
+                           *,
+                           kv_valid_len,
+                           sm_scale: Optional[float] = None,
+                           k_scale: Optional[jax.Array] = None,
+                           v_scale: Optional[jax.Array] = None,
+                           interpret: Optional[bool] = None) -> jax.Array:
+    """Same contract as `ops.attention.paged_attention` (reference
+    impl), fused. ``interpret=None`` resolves to True off-TPU."""
+    if pl is None:  # pragma: no cover
+        raise NotImplementedError(
+            f"paged_attention impl='flash' needs Pallas, which failed "
+            f"to import in this environment: {_PALLAS_ERR!r}")
+    B, S, H, D = q.shape
+    NB, T, KV, _ = k_pages.shape
+    MB = block_tables.shape[1]
+    if H % KV:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {KV}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    g = H // KV
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+    has_scale = k_scale is not None
+
+    bt = block_tables.astype(jnp.int32)
+    lim = jnp.concatenate(
+        [q_slots.astype(jnp.int32),
+         jnp.full((B, 1), kv_valid_len, jnp.int32)], axis=1)   # [B, S+1]
+
+    def page_map(b, h, j, bt_ref, lim_ref):
+        return (bt_ref[b, j], 0, h // g, 0)
+
+    def scale_map(b, h, j, bt_ref, lim_ref):
+        return (bt_ref[b, j], h // g)
+
+    in_specs = [
+        pl.BlockSpec((1, S, 1, D), lambda b, h, j, bt_ref, lim_ref:
+                     (b, 0, h, 0)),                    # q
+        pl.BlockSpec((1, T, 1, D), page_map),          # k page
+        pl.BlockSpec((1, T, 1, D), page_map),          # v page
+    ]
+    args = [q, k_pages, v_pages]
+    if has_scale:
+        in_specs += [pl.BlockSpec((1, 1), scale_map),
+                     pl.BlockSpec((1, 1), scale_map)]
+        args += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, MB),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, S, 1, D), lambda b, h, j, bt_ref,
+                               lim_ref: (b, 0, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((S, D), jnp.float32),
+            pltpu.VMEM((S, 1), jnp.float32),
+            pltpu.VMEM((S, 1), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_kernel, scale=scale, n_blocks=MB,
+                               seq_q=S, has_scale=has_scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(bt, lim, *args)

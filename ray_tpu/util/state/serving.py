@@ -287,6 +287,15 @@ def list_kv_pools(limit: int = 1000) -> List[Dict[str, Any]]:
             "engine_id": eng.engine_id,
             "kind": "paged" if pool is not None else "prefix",
             "block_tokens": eng.prefix_block,
+            # Quantized-KV plane: storage dtype (None = dense kv_dtype)
+            # and the byte cost one block/token actually pays, scale
+            # slab included. getattr defaults keep pre-quant engine
+            # objects (or test doubles) listable.
+            "quant": getattr(eng, "kv_quant", None),
+            "bytes_per_block": float(
+                getattr(eng, "kv_bytes_per_block", 0.0)),
+            "bytes_per_token": float(
+                getattr(eng, "kv_bytes_per_token", 0.0)),
         }
         if pool is not None:
             row.update(pool.snapshot())

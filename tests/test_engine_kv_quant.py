@@ -1,0 +1,467 @@
+"""Quantized paged KV storage (ray_tpu/ops/kv_quant.py + engine
+`kv_quant=`) and the fused paged-attention kernel
+(ray_tpu/ops/paged_attention_kernel.py).
+
+Two distinct contracts, tested separately because they have different
+strengths:
+
+- QUANT OFF IS FREE. `kv_quant=None` (the default) traces the exact
+  programs the engine traced before this feature existed — token
+  streams stay BIT-IDENTICAL to solo `generate` across the whole
+  feature matrix (paged x prefix x pipeline x spec x tp2 x
+  preemption). Any "if quant" leak into the quant-off trace breaks
+  this file first.
+- QUANT ON IS TOLERANCE-GATED. int8/fp8 storage rounds the KV bytes,
+  so token streams may diverge from bf16 after enough steps; the gate
+  is a greedy token-match FRACTION against the dense-precision run
+  plus an op-level logit error bound — not identity. What IS exact
+  under quant: swap round-trips (quantized bytes + scales move
+  verbatim), recompute preemption (requantizing an f32 dequantized
+  view with a recomputed scale lands on identical bytes), and CoW
+  tails (block copies are byte copies). Those paths assert full token
+  identity against an unpreempted run of the SAME quant mode.
+- The Pallas kernel (impl="flash") is validated off-TPU in interpret
+  mode against the pure-lax reference over a shape sweep including
+  GQA, ragged valid lengths, and quantized pools.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.models import LlamaConfig, llama_init  # noqa: E402
+from ray_tpu.models.engine import DecodeEngine  # noqa: E402
+from ray_tpu.models.generate import generate  # noqa: E402
+from ray_tpu.models.prefix_cache import block_bytes  # noqa: E402
+from ray_tpu.ops.attention import paged_attention  # noqa: E402
+from ray_tpu.ops.kv_quant import (  # noqa: E402
+    block_scale, dequantize, paged_quant_write, quantize,
+    resolve_kv_quant)
+
+T = 4
+MAX_LEN = 32
+
+
+@pytest.fixture(scope="module")
+def nano_model():
+    cfg = LlamaConfig.nano()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _prompts(n, cfg, seed=11, lo=3, hi=9):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, cfg.vocab_size,
+                        size=rng.randint(lo, hi)).tolist()
+            for _ in range(n)]
+
+
+def _solo(params, cfg, prompt, n, mode=None, rng=None):
+    out = np.asarray(generate(params, jnp.asarray([prompt], jnp.int32),
+                              cfg, max_new_tokens=n, rng=rng,
+                              **(mode or {})))
+    return out[0, len(prompt):].tolist()
+
+
+def _run(params, cfg, prompts, budgets, *, eng_kw=None, keys=None,
+         slots=2):
+    eng = DecodeEngine(params, cfg, batch_slots=slots, max_len=MAX_LEN,
+                       **(eng_kw or {}))
+    ids = [eng.submit(p, n, rng=None if keys is None else keys[i])
+           for i, (p, n) in enumerate(zip(prompts, budgets))]
+    out = eng.run()
+    return [out[r] for r in ids], eng
+
+
+def _quant_pool_bytes(cfg, n_blocks, qspec_name="int8"):
+    """Bytes buying exactly `n_blocks` usable QUANTIZED pool blocks:
+    1-byte payload plus the two [KV] f32 scale rows per layer."""
+    bb = block_bytes(cfg.n_layers, T, cfg.n_kv_heads, cfg.head_dim, 1)
+    bb += 2 * cfg.n_layers * cfg.n_kv_heads * 4
+    return n_blocks * bb
+
+
+# ---------------------------------------------------------------------------
+# Quant OFF: bit-identity across the feature matrix
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("features", [
+    {},
+    {"prefix_cache": True},
+    {"prefix_cache": True, "pipeline_depth": 2},
+    {"tp": 2},
+    {"spec": True},
+], ids=["plain", "prefix", "prefix_pipeline", "tp2", "spec"])
+def test_quant_off_bit_identity_matrix(nano_model, features):
+    """kv_quant=None engines are the pre-quant engines: token streams
+    match solo `generate` exactly, with the quant knob passed
+    EXPLICITLY so the None path is exercised on purpose."""
+    cfg, params = nano_model
+    kw = dict(features)
+    if kw.pop("spec", False):
+        kw.update(draft_params=params, draft_cfg=cfg, spec_window=4)
+    prompts = _prompts(4, cfg)
+    budgets = [7, 4, 6, 5]
+    ref = [_solo(params, cfg, p, n)
+           for p, n in zip(prompts, budgets)]
+    got, eng = _run(params, cfg, prompts, budgets,
+                    eng_kw={**kw, "paged": True, "kv_block_tokens": T,
+                            "kv_quant": None})
+    assert got == ref, "quant-off paged engine diverged from solo"
+    s = eng.stats()
+    assert s["kv_quant_enabled"] == 0.0
+    # quant-off byte accounting reports the dense dtype cost
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    assert s["kv_bytes_per_token"] == pytest.approx(
+        2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * itemsize)
+
+
+def test_quant_off_preemption_identity(nano_model):
+    """Quant-off preempt-and-swap keeps the r8 identity contract."""
+    cfg, params = nano_model
+    prompts = [[7, 8, 9, 10, 11], [3, 1, 4, 1, 5],
+               [2, 7, 1, 8, 2], [9, 9, 8, 8, 7]]
+    M = 12
+    dense_bb = block_bytes(cfg.n_layers, T, cfg.n_kv_heads,
+                           cfg.head_dim, jnp.dtype(cfg.dtype).itemsize)
+    eng = DecodeEngine(params, cfg, batch_slots=4, max_len=MAX_LEN,
+                       paged=True, kv_block_tokens=T, kv_quant=None,
+                       kv_pool_bytes=10 * dense_bb, prefix_cache=False)
+    ids = [eng.submit(p, M) for p in prompts]
+    out = eng.run()
+    for rid, p in zip(ids, prompts):
+        assert out[rid] == _solo(params, cfg, p, M)
+    assert eng.stats()["preemptions"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Quant ON: tolerance gate vs dense precision
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("quant", ["int8", "fp8_e4m3"])
+@pytest.mark.parametrize("mode", [
+    {"greedy": True},
+    {"greedy": False, "temperature": 0.9, "top_k": 5},
+], ids=["greedy", "top_k"])
+def test_quant_on_token_tolerance_gate(nano_model, quant, mode):
+    """Quantized decode tracks the dense-precision engine: the
+    elementwise token-match fraction across the workload must clear a
+    floor. Divergence compounds (one different token reroutes the
+    rest of that stream), so the floor is deliberately below the
+    typical per-token agreement — it catches a broken quant path
+    (garbage scales, stale-slot bleed), not rounding."""
+    cfg, params = nano_model
+    prompts = _prompts(4, cfg, seed=5)
+    budgets = [8, 8, 8, 8]
+    keys = (None if mode["greedy"]
+            else [jax.random.PRNGKey(3000 + i)
+                  for i in range(len(prompts))])
+    rng_kw = {} if mode["greedy"] else {"rng": jax.random.PRNGKey(7)}
+    base_kw = {**mode, **rng_kw, "paged": True, "kv_block_tokens": T}
+    dense, _ = _run(params, cfg, prompts, budgets,
+                    eng_kw=base_kw, keys=keys)
+    qtoks, eng = _run(params, cfg, prompts, budgets,
+                      eng_kw={**base_kw, "kv_quant": quant}, keys=keys)
+    total = sum(budgets)
+    match = sum(int(a == b)
+                for dt, qt in zip(dense, qtoks)
+                for a, b in zip(dt, qt))
+    assert all(len(t) == n for t, n in zip(qtoks, budgets))
+    assert match / total >= 0.5, (
+        f"{quant} matched only {match}/{total} tokens vs dense "
+        "precision — quantized KV path is broken, not just rounding")
+    s = eng.stats()
+    assert s["kv_quant_enabled"] == 1.0
+    assert 0 < s["kv_bytes_per_token"] < \
+        2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * \
+        jnp.dtype(cfg.dtype).itemsize
+
+
+@pytest.mark.parametrize("quant", ["int8", "fp8_e4m3"])
+def test_quant_logit_error_bound(quant):
+    """Op-level bound: attention over a quantized pool stays within a
+    small max-abs-err of attention over the f32 original. Per-block
+    per-head absmax scaling bounds elementwise KV error by
+    amax/(2*qmax) (int8) and softmax averaging keeps the output error
+    the same order."""
+    qspec = resolve_kv_quant(quant)
+    rng = np.random.RandomState(0)
+    B, MB, NB, TT, KV, D, H = 2, 4, 9, 8, 2, 16, 4
+    kf = jnp.asarray(rng.randn(NB, TT, KV, D), jnp.float32)
+    vf = jnp.asarray(rng.randn(NB, TT, KV, D), jnp.float32)
+    amax_k = jnp.max(jnp.abs(kf), axis=(1, 3))
+    amax_v = jnp.max(jnp.abs(vf), axis=(1, 3))
+    sk, sv = block_scale(amax_k, qspec), block_scale(amax_v, qspec)
+    kq = quantize(kf, sk[:, None, :, None], qspec)
+    vq = quantize(vf, sv[:, None, :, None], qspec)
+    q = jnp.asarray(rng.randn(B, 1, H, D), jnp.float32)
+    bt = jnp.asarray(rng.randint(1, NB, size=(B, MB)), jnp.int32)
+    q_slots = jnp.asarray([[MB * TT - 1]] * B, jnp.int32)
+    exact = paged_attention(q, kf, vf, bt, q_slots,
+                            kv_valid_len=MB * TT, impl="reference")
+    approx = paged_attention(q, kq, vq, bt, q_slots,
+                             kv_valid_len=MB * TT, k_scale=sk,
+                             v_scale=sv, impl="reference")
+    err = float(jnp.max(jnp.abs(exact - approx)))
+    assert err < 0.05, f"{quant} attention max-abs-err {err}"
+
+
+# ---------------------------------------------------------------------------
+# Quant ON: exact paths — swap round trip, recompute, CoW
+# ---------------------------------------------------------------------------
+
+def test_quant_swap_round_trip_exact(nano_model):
+    """Preempt-and-swap under int8 moves the quantized bytes AND the
+    scale rows host-and-back verbatim, so a preempted run emits
+    tokens IDENTICAL to an unpreempted run of the same quant mode."""
+    cfg, params = nano_model
+    prompts = [[7, 8, 9, 10, 11], [3, 1, 4, 1, 5],
+               [2, 7, 1, 8, 2], [9, 9, 8, 8, 7]]
+    M = 12
+    ample = DecodeEngine(params, cfg, batch_slots=4, max_len=MAX_LEN,
+                         paged=True, kv_block_tokens=T,
+                         kv_quant="int8", prefix_cache=False)
+    ids = [ample.submit(p, M) for p in prompts]
+    want = ample.run()
+    want = [want[r] for r in ids]
+
+    tight = DecodeEngine(params, cfg, batch_slots=4, max_len=MAX_LEN,
+                         paged=True, kv_block_tokens=T,
+                         kv_quant="int8", prefix_cache=False,
+                         kv_pool_bytes=_quant_pool_bytes(cfg, 10))
+    assert tight.kv_pool.blocks_total == 10
+    ids = [tight.submit(p, M) for p in prompts]
+    out = tight.run()
+    assert [out[r] for r in ids] == want, \
+        "int8 tokens changed across a swap round trip"
+    s = tight.stats()
+    assert s["preemptions"] >= 1
+    assert s["swap_out_bytes"] > 0 and s["swap_in_bytes"] > 0
+    # swapped bytes include the f32 scale rows for the moved blocks,
+    # and the payload is 1 byte/elem — far below the dense dtype cost
+    assert s["swap_out_bytes"] == s["swap_in_bytes"]
+
+
+def test_quant_recompute_preemption_exact(nano_model):
+    """preempt="recompute" under int8: replaying prompt+emitted through
+    the quantized prefill lands on the same bytes (the dequantized
+    view is f32 end-to-end, so requantizing with a recomputed scale is
+    byte-stable) — tokens match the unpreempted int8 run exactly."""
+    cfg, params = nano_model
+    prompts = [[7, 8, 9, 10, 11], [3, 1, 4, 1, 5],
+               [2, 7, 1, 8, 2], [9, 9, 8, 8, 7]]
+    M = 12
+    ample = DecodeEngine(params, cfg, batch_slots=4, max_len=MAX_LEN,
+                         paged=True, kv_block_tokens=T,
+                         kv_quant="int8", prefix_cache=False)
+    ids = [ample.submit(p, M) for p in prompts]
+    want = ample.run()
+    want = [want[r] for r in ids]
+
+    rec = DecodeEngine(params, cfg, batch_slots=4, max_len=MAX_LEN,
+                       paged=True, kv_block_tokens=T, kv_quant="int8",
+                       preempt="recompute", prefix_cache=False,
+                       kv_pool_bytes=_quant_pool_bytes(cfg, 10))
+    ids = [rec.submit(p, M) for p in prompts]
+    out = rec.run()
+    assert [out[r] for r in ids] == want, \
+        "int8 recompute preemption is not byte-stable"
+    s = rec.stats()
+    assert s["preemptions"] >= 1
+    assert s["swap_out_bytes"] == 0.0 and s["swap_in_bytes"] == 0.0
+
+
+def test_quant_cow_on_shared_tail_exact(nano_model):
+    """A full-prompt prefix hit on a QUANTIZED chain pays exactly one
+    CoW block — the copy moves quantized bytes plus the scale rows, so
+    the warm request's tokens equal the cold request's."""
+    cfg, params = nano_model
+    sys_p = list(range(1, 13))       # exactly 3 blocks at T=4
+    eng = DecodeEngine(params, cfg, batch_slots=2, max_len=MAX_LEN,
+                       paged=True, kv_block_tokens=T, kv_quant="int8",
+                       prefix_cache=True)
+    a = eng.submit(sys_p, 4)
+    out = eng.run()
+    cold = out[a]
+    s0 = eng.stats()
+    b = eng.submit(sys_p, 4)         # full-prompt hit -> CoW tail
+    out = eng.run()
+    assert out[b] == cold, "CoW'd quantized tail changed the tokens"
+    s1 = eng.stats()
+    assert s1["kv_block_cows"] - s0["kv_block_cows"] == 1
+    assert s1["kv_blocks_shared"] - s0["kv_blocks_shared"] == 2
+    assert s1["prefix_copy_dispatches"] == s0["prefix_copy_dispatches"]
+
+
+# ---------------------------------------------------------------------------
+# ops/kv_quant.py unit coverage
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("quant", ["int8", "fp8_e4m3"])
+def test_requantize_is_byte_stable(quant):
+    """The preemption-recompute keystone: dequantize -> recompute scale
+    -> requantize reproduces the original bytes exactly."""
+    qspec = resolve_kv_quant(quant)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(5, 8, 2, 16), jnp.float32)
+    s = block_scale(jnp.max(jnp.abs(x), axis=(1, 3)), qspec)
+    q1 = quantize(x, s[:, None, :, None], qspec)
+    deq = dequantize(q1, s[:, None, :, None])
+    s2 = block_scale(jnp.max(jnp.abs(deq), axis=(1, 3)), qspec)
+    q2 = quantize(deq, s2[:, None, :, None], qspec)
+    assert jnp.array_equal(
+        q1.view(jnp.uint8), q2.view(jnp.uint8)), \
+        f"{quant} requantization is not byte-stable"
+
+
+def test_paged_quant_write_matches_dense_write():
+    """paged_quant_write through a block table lands the same values
+    (up to quantization) a dense slot-write would, and zeroes stale
+    slots at-and-past the write frontier so garbage can't coarsen a
+    later block's scale."""
+    qspec = resolve_kv_quant("int8")
+    rng = np.random.RandomState(2)
+    NB, TT, KV, D, B, S = 7, 4, 2, 8, 2, 6
+    pages = jnp.zeros((NB, TT, KV, D), qspec.dtype)
+    scales = jnp.zeros((NB, KV), jnp.float32)
+    bt = jnp.asarray([[1, 2, 3, 0], [4, 5, 6, 0]], jnp.int32)
+    vals = jnp.asarray(rng.randn(B, S, KV, D), jnp.float32)
+    start = jnp.asarray([1, 3], jnp.int32)
+    pages, scales = paged_quant_write(pages, scales, bt, start, vals,
+                                      qspec)
+    for b in range(B):
+        for s_i in range(S):
+            pos = int(start[b]) + s_i
+            blk, off = bt[b, pos // TT], pos % TT
+            got = dequantize(pages[blk, off], scales[blk][:, None])
+            ref = vals[b, s_i]
+            tol = jnp.max(jnp.abs(ref)) / qspec.qmax + 1e-6
+            assert float(jnp.max(jnp.abs(got - ref))) <= float(tol), \
+                f"row {b} slot {pos} dequantized wrong"
+    # the null block stays all-zero (scale slab zero-init -> dequant 0)
+    assert not jnp.any(pages[0].view(jnp.uint8))
+    assert not jnp.any(scales[0])
+
+
+def test_resolve_kv_quant_names():
+    assert resolve_kv_quant(None) is None
+    assert resolve_kv_quant("int8").name == "int8"
+    assert resolve_kv_quant("fp8_e4m3").name == "fp8_e4m3"
+    with pytest.raises(ValueError, match="kv_quant"):
+        resolve_kv_quant("int4")
+
+
+def test_engine_rejects_quant_without_paged(nano_model):
+    cfg, params = nano_model
+    with pytest.raises(ValueError, match="paged"):
+        DecodeEngine(params, cfg, batch_slots=2, max_len=MAX_LEN,
+                     kv_quant="int8")
+
+
+# ---------------------------------------------------------------------------
+# Fused kernel: interpret-mode parity vs the pure-lax reference
+# ---------------------------------------------------------------------------
+
+# (B, MB, T, KV, D, gqa_mult) — covers single/multi block walks, GQA
+# replication, and a pool bigger than any one table.
+_KERNEL_SHAPES = [
+    (1, 1, 4, 1, 8, 1),
+    (2, 4, 4, 2, 16, 1),
+    (2, 4, 4, 2, 16, 2),     # GQA: H = 2*KV
+    (3, 2, 8, 1, 32, 4),     # deep GQA, wider blocks
+    (1, 8, 2, 2, 8, 1),      # long walk, tiny blocks
+]
+
+
+@pytest.mark.parametrize("shape", _KERNEL_SHAPES,
+                         ids=["b1", "b2", "gqa2", "gqa4", "walk8"])
+@pytest.mark.parametrize("quant", [None, "int8", "fp8_e4m3"],
+                         ids=["dense", "int8", "fp8"])
+def test_kernel_matches_reference(shape, quant):
+    """The Pallas block-walking kernel in interpret mode reproduces
+    the reference gather path to fp32 tolerance on every shape —
+    ragged per-row valid lengths (q_slots mid-block) included."""
+    B, MB, TT, KV, D, gm = shape
+    H = KV * gm
+    NB = MB * B + 3
+    rng = np.random.RandomState(B * 100 + MB * 10 + KV)
+    kf = jnp.asarray(rng.randn(NB, TT, KV, D), jnp.float32)
+    vf = jnp.asarray(rng.randn(NB, TT, KV, D), jnp.float32)
+    q = jnp.asarray(rng.randn(B, 1, H, D), jnp.float32)
+    # distinct live blocks per row; block 0 stays the null block
+    bt = jnp.asarray(
+        1 + np.arange(B * MB).reshape(B, MB), jnp.int32)
+    # ragged: each row's frontier lands at a different mid-block slot
+    q_slots = jnp.asarray(
+        [[min(MB * TT - 1, 1 + 3 * b)] for b in range(B)], jnp.int32)
+    sk = sv = None
+    if quant is not None:
+        qspec = resolve_kv_quant(quant)
+        sk = block_scale(jnp.max(jnp.abs(kf), axis=(1, 3)), qspec)
+        sv = block_scale(jnp.max(jnp.abs(vf), axis=(1, 3)), qspec)
+        kf = quantize(kf, sk[:, None, :, None], qspec)
+        vf = quantize(vf, sv[:, None, :, None], qspec)
+    kw = dict(kv_valid_len=MB * TT, k_scale=sk, v_scale=sv)
+    ref = paged_attention(q, kf, vf, bt, q_slots, impl="reference",
+                          **kw)
+    got = paged_attention(q, kf, vf, bt, q_slots, impl="flash", **kw)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_masks_garbage_blocks():
+    """Slots past the frontier and whole unallocated table entries
+    (pointing at block 0 or at another row's blocks) contribute
+    exactly nothing, same as the reference's -1e30 fill."""
+    rng = np.random.RandomState(9)
+    NB, TT, KV, D = 6, 4, 2, 16
+    kf = jnp.asarray(rng.randn(NB, TT, KV, D), jnp.float32)
+    vf = jnp.asarray(rng.randn(NB, TT, KV, D), jnp.float32)
+    q = jnp.asarray(rng.randn(1, 1, 2, D), jnp.float32)
+    short = jnp.asarray([[1, 0, 0, 0]], jnp.int32)   # 1 live block
+    long = jnp.asarray([[1, 5, 4, 3]], jnp.int32)    # garbage tail
+    q_slots = jnp.asarray([[2]], jnp.int32)          # frontier slot 2
+    outs = [paged_attention(q, kf, vf, bt, q_slots, kv_valid_len=16,
+                            impl=impl)
+            for bt in (short, long) for impl in ("reference", "flash")]
+    for o in outs[1:]:
+        np.testing.assert_allclose(np.asarray(o), np.asarray(outs[0]),
+                                   atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch seam (the small-fix satellite)
+# ---------------------------------------------------------------------------
+
+def test_paged_attention_impl_dispatch_seam():
+    """`impl=` is an explicit seam: "reference" and "flash" agree
+    off-TPU (flash -> interpret mode), "auto" resolves to the
+    reference off-TPU, and bad arguments fail loudly."""
+    rng = np.random.RandomState(4)
+    NB, TT, KV, D = 5, 4, 2, 16
+    kf = jnp.asarray(rng.randn(NB, TT, KV, D), jnp.float32)
+    vf = jnp.asarray(rng.randn(NB, TT, KV, D), jnp.float32)
+    q = jnp.asarray(rng.randn(2, 1, 4, D), jnp.float32)
+    bt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    q_slots = jnp.asarray([[5], [7]], jnp.int32)
+    kw = dict(kv_valid_len=8)
+    ref = paged_attention(q, kf, vf, bt, q_slots, impl="reference",
+                          **kw)
+    fla = paged_attention(q, kf, vf, bt, q_slots, impl="flash", **kw)
+    np.testing.assert_allclose(np.asarray(fla), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    if jax.default_backend() != "tpu":
+        auto = paged_attention(q, kf, vf, bt, q_slots, impl="auto",
+                               **kw)
+        assert jnp.array_equal(auto, ref)   # auto == reference off-TPU
+
+    with pytest.raises(ValueError, match="impl"):
+        paged_attention(q, kf, vf, bt, q_slots, impl="fused", **kw)
+    with pytest.raises(ValueError, match="together"):
+        paged_attention(q, kf, vf, bt, q_slots,
+                        k_scale=jnp.ones((NB, KV)), **kw)
+    with pytest.raises(ValueError, match="heads"):
+        paged_attention(jnp.zeros((2, 1, 3, D)), kf, vf, bt, q_slots,
+                        **kw)

@@ -138,10 +138,18 @@ def format_status(data: Dict[str, Any], top: int = 5) -> str:
          if "spec_acceptance_rate" in s])]
     for e in engines:
         pool = pools.get(e["engine_id"])
-        kv = (f" kv {_bar(pool.get('occupancy', 0.0), 10)} "
-              f"{pool.get('blocks_in_use', 0)}/"
-              f"{pool.get('blocks_total', 0)} blk"
-              if pool else "")
+        if pool:
+            # A quantized pool tags its KV bar with the storage dtype
+            # and per-block byte cost (scale slab included) — the
+            # concurrency-per-HBM-byte lever at a glance.
+            quant = pool.get("quant")
+            qtag = (f" {quant} {pool.get('bytes_per_block', 0.0):.0f}B/blk"
+                    if quant else "")
+            kv = (f" kv {_bar(pool.get('occupancy', 0.0), 10)} "
+                  f"{pool.get('blocks_in_use', 0)}/"
+                  f"{pool.get('blocks_total', 0)} blk{qtag}")
+        else:
+            kv = ""
         spec = ""
         if e.get("spec_enabled"):
             spec = (f" spec w{e.get('spec_window', 0)} "
