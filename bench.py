@@ -1004,6 +1004,207 @@ def _bench_fleet(cfg, *, n_groups: int, prefix_len: int,
     }
 
 
+def _bench_disagg(cfg, *, prompt_len: int, new_tokens: int,
+                  n_requests: int, batch_slots: int,
+                  prefill_replicas: int = 2,
+                  decode_replicas: int = 2,
+                  block_tokens: int = 16,
+                  tpot_idle_slack: float = 1.25,
+                  ttft_slack: float = 1.1) -> dict:
+    """Disaggregated prefill/decode fleet (the r13 tentpole's
+    end-to-end number): the SAME churn arrival sequence — a few
+    submits per step, so admissions land while earlier requests
+    decode — served three ways:
+
+    - ``colocated``: P+D replicas in one shared pool (the control):
+      every replica interleaves chunked prefill with fused decode, so
+      each admission stretches the inter-token gaps of whatever was
+      decoding on that replica — the TPOT tail degrades with arrival
+      rate;
+    - ``disagg``: the same replica budget split P prefill / D decode
+      with KV handed off at prefill completion. Decode replicas never
+      run a prefill, so the TPOT tail is INDEPENDENT of admissions —
+      that independence is the whole point of the split;
+    - ``idle``: decode-class-sized colocated fleet with every request
+      submitted before the first step and few enough to admit in one
+      wave — quiet-decode TPOT, the floor the disagg arm is gated
+      against.
+
+    Headline: ``tpot_p95_colocated_over_disagg`` (>1.0 = the split
+    shields decode; the control degrades while disagg holds) and
+    ``tpot_p95_disagg_over_idle`` (~1.0 = decode under churn is as
+    quiet as decode with admission idle). TTFT is measured at the
+    BENCH level (submit wall-time -> first emission from fleet.step)
+    identically for both churn arms so the ratio is apples-to-apples
+    — fleet/engine TTFT windows differ between the two shapes. The
+    closing CHAOS arm kills the first decode-class replica mid-churn:
+    token-identity vs the fault-free disagg arm and
+    ``tokens_lost_to_failure == 0`` are the gate. Ratios and gates are
+    real on any backend; absolute tokens/s is not.
+
+    ``tpot_idle_slack`` / ``ttft_slack`` set the gate thresholds. The
+    defaults are the TPU targets; the CPU dry run passes looser values
+    — there a fleet step costs as much as a whole nano prefill, so the
+    handoff's fixed +1-step latency (noise at real model scale, where
+    prefill dwarfs a decode step) and host co-tenant jitter both land
+    squarely in the measured tails."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import FaultInjector, LLMFleet, llama_init
+    from ray_tpu.models.engine import DecodeEngine
+
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(13)
+    max_len = prompt_len + new_tokens + 1
+    max_len += (-max_len) % block_tokens    # paged rows span max_len
+    n_total = prefill_replicas + decode_replicas
+    arrivals = [rng.randint(1, cfg.vocab_size,
+                            size=prompt_len).tolist()
+                for _ in range(n_requests)]
+
+    def factory(name):
+        return DecodeEngine(params, cfg, batch_slots=batch_slots,
+                            max_len=max_len, paged=True,
+                            kv_block_tokens=block_tokens,
+                            engine_id=name)
+
+    def churn(fleet, prompts, upfront=False):
+        """Drive the arrival sequence; returns wall, bench-side TTFT
+        samples, per-fid results."""
+        submit_t = {}
+        ttft = []
+        results = {}
+
+        def drink(emissions):
+            now = time.perf_counter()
+            for fid, toks in emissions.items():
+                if toks and fid in submit_t:
+                    ttft.append(now - submit_t.pop(fid))
+
+        t0 = time.perf_counter()
+        if upfront:
+            for p in prompts:
+                submit_t[fleet.submit(p, new_tokens)] = \
+                    time.perf_counter()
+        else:
+            for i, p in enumerate(prompts):
+                submit_t[fleet.submit(p, new_tokens)] = \
+                    time.perf_counter()
+                if i % 2 == 1:      # two arrivals per engine step
+                    drink(fleet.step())
+        while fleet.pending():
+            drink(fleet.step())
+        for fid in list(fleet.finished):
+            results[fid] = fleet.pop_result(fid)
+        wall = time.perf_counter() - t0
+        return wall, ttft, results
+
+    def p95(xs):
+        return sorted(xs)[max(0, int(0.95 * len(xs)) - 1)] if xs \
+            else 0.0
+
+    def colocated(n, fleet_id):
+        return LLMFleet(factory, initial_replicas=n,
+                        router="pow2_affinity", fleet_id=fleet_id)
+
+    def disagg(fleet_id, inj=None):
+        return LLMFleet(factory, disaggregated=True,
+                        prefill_replicas=prefill_replicas,
+                        decode_replicas=decode_replicas,
+                        router="pow2_affinity", fleet_id=fleet_id,
+                        fault_injector=inj)
+
+    # Untimed warmup per fleet SHAPE (colocated and split place
+    # different prefix-chain lengths -> different compiled programs).
+    churn(colocated(n_total, "disagg-warm-co"), arrivals[:4])
+    churn(disagg("disagg-warm-dis"), arrivals[:4])
+
+    co_fleet = colocated(n_total, "disagg-co")
+    co_wall, co_ttft, co_res = churn(co_fleet, arrivals)
+    dis_fleet = disagg("disagg-dis")
+    dis_wall, dis_ttft, dis_res = churn(dis_fleet, arrivals)
+    ds = dis_fleet.stats()
+    # Idle-admission floor: one admission wave (every slot filled
+    # before step 1), then pure decode on the decode-class replica
+    # budget — no mid-decode prefill by construction.
+    idle_n = min(len(arrivals), decode_replicas * batch_slots)
+    idle_fleet = colocated(decode_replicas, "disagg-idle")
+    _, _, _ = churn(idle_fleet, arrivals[:idle_n], upfront=True)
+
+    # TPOT p95 from the engines' own sliding windows: colocated takes
+    # the worst replica; disagg takes the worst DECODE-class replica
+    # (prefill-class windows are empty — those engines never decode).
+    co_tpot = max(r.engine.stats()["tpot_s_p95"]
+                  for r in co_fleet.replicas)
+    dis_tpot = max(r.engine.stats()["tpot_s_p95"]
+                   for r in dis_fleet.replicas
+                   if r.replica_class == "decode")
+    idle_tpot = max(r.engine.stats()["tpot_s_p95"]
+                    for r in idle_fleet.replicas)
+
+    # Chaos arm: identical disagg shape and arrivals, first
+    # decode-class replica scripted dead mid-churn. The fault-free
+    # disagg arm above IS the control (same fid->key derivation).
+    chaos_id = "disagg-chaos"
+    killed = f"{chaos_id}-r{prefill_replicas}"   # first decode-class
+    inj = FaultInjector(schedule={killed: [(3, "kill")]})
+    chaos_fleet = disagg(chaos_id, inj=inj)
+    chaos_wall, _, chaos_res = churn(chaos_fleet, arrivals)
+    cs = chaos_fleet.stats()
+
+    return {
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "n_requests": n_requests,
+        "prefill_replicas": prefill_replicas,
+        "decode_replicas": decode_replicas,
+        "colocated_replicas": n_total,
+        "wall_colocated_s": round(co_wall, 3),
+        "wall_disagg_s": round(dis_wall, 3),
+        "tpot_p95_colocated_s": round(co_tpot, 5),
+        "tpot_p95_disagg_s": round(dis_tpot, 5),
+        "tpot_p95_idle_s": round(idle_tpot, 5),
+        # Headline gate pair: the control degrades under churn while
+        # the split holds decode at its idle-admission floor.
+        "tpot_p95_colocated_over_disagg": round(
+            co_tpot / dis_tpot, 3) if dis_tpot else 0.0,
+        "tpot_p95_disagg_over_idle": round(
+            dis_tpot / idle_tpot, 3) if idle_tpot else 0.0,
+        "gate_decode_tpot_shielded": bool(
+            dis_tpot and idle_tpot
+            and dis_tpot <= idle_tpot * tpot_idle_slack
+            and co_tpot >= dis_tpot),
+        "ttft_p95_colocated_s": round(p95(co_ttft), 4),
+        "ttft_p95_disagg_s": round(p95(dis_ttft), 4),
+        "ttft_p95_disagg_over_colocated": round(
+            p95(dis_ttft) / p95(co_ttft), 3) if p95(co_ttft) else 0.0,
+        "gate_ttft_no_worse": bool(
+            p95(co_ttft) and p95(dis_ttft) <= p95(co_ttft)
+            * ttft_slack),
+        "handoffs": int(ds["handoffs"]),
+        "handoff_out_bytes": int(ds["handoff_out_bytes"]),
+        "handoff_parked_end": int(ds["handoff_parked"]),
+        "ttft_p95_fleet_window_s": round(ds["ttft_s_p95_fleet"], 4),
+        "chaos": {
+            "killed_replica": killed,
+            "kill_fired": bool(inj.fired),
+            "identical_to_fault_free": chaos_res == dis_res,
+            "tokens_lost_to_failure": int(
+                cs["tokens_lost_to_failure"]),
+            "requests_recovered": int(cs["requests_recovered"]),
+            "replicas_failed": int(cs["replicas_failed"]),
+            "replicas_decode_after": int(cs["replicas_decode"]),
+            "handoff_parked_end": int(cs["handoff_parked"]),
+            "wall_s": round(chaos_wall, 3),
+            "wall_fault_free_s": round(dis_wall, 3),
+        },
+        # Same submit order -> same fid -> same pinned sampling key in
+        # both fleets: the dicts must agree entry-for-entry.
+        "identical_colocated_vs_disagg": co_res == dis_res,
+    }
+
+
 def _bench_multichip_serving(cfg, *, tps=(1, 2, 4), prompt_len: int,
                              new_tokens: int, batch_slots: int,
                              trials: int) -> dict:
@@ -1436,6 +1637,14 @@ def main():
             serving["fleet"] = {
                 "error": f"{type(e).__name__}: {str(e)[:200]}"}
         try:
+            serving["disagg"] = _bench_disagg(
+                flagship_config(), prompt_len=256, new_tokens=64,
+                n_requests=48, batch_slots=8, prefill_replicas=2,
+                decode_replicas=2)
+        except Exception as e:
+            serving["disagg"] = {
+                "error": f"{type(e).__name__}: {str(e)[:200]}"}
+        try:
             serving["multichip"] = _bench_multichip_serving(
                 flagship_config(), tps=(1, 2, 4), prompt_len=256,
                 new_tokens=32, batch_slots=8, trials=TRIALS)
@@ -1502,6 +1711,17 @@ def main():
             LlamaConfig.nano(max_seq_len=256), n_groups=4,
             prefix_len=192, suffix_len=8, n_requests=24, new_tokens=8,
             batch_slots=4)
+        # Disaggregated prefill/decode churn, CPU dry run: the TPOT
+        # shielding ratio (colocated control degrades under admission
+        # churn while the decode class holds its idle-admission
+        # floor), the bench-side TTFT ratio, the token-identity and
+        # chaos zero-loss gates are real on any backend; absolute
+        # tokens/s is not.
+        serving["disagg"] = _bench_disagg(
+            LlamaConfig.nano(max_seq_len=256), prompt_len=128,
+            new_tokens=64, n_requests=24, batch_slots=12,
+            prefill_replicas=3, decode_replicas=2, block_tokens=32,
+            tpot_idle_slack=2.0, ttft_slack=1.5)
         # Tensor-parallel sweep, CPU dry run: tp in {1,2,4} over the
         # forced 8-device world — the bytes/token FLATNESS across tp
         # (the choke-point gate) is real on any backend; absolute

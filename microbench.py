@@ -588,6 +588,79 @@ def _kv_quant_gather_section(quick: bool) -> list:
     return results
 
 
+def _handoff_section(quick: bool) -> list:
+    """Disaggregated handoff seam cost (models/engine.py
+    `export_request` / `import_request` — the spill a prefill-class
+    replica pays per finished prefill and the re-admission a
+    decode-class replica pays per import): per prompt span, the wall
+    ms to EXPORT (pow2-padded block gather + device->host pull + host
+    staging), to IMPORT (re-submit + planting the paged swap pre-seed;
+    no device work), and to ADMIT (the first decode step after the
+    import: host->device scatter + decode dispatch), plus the payload
+    bytes per request — dense f32 KV vs int8-quantized blocks. The
+    quant plane moves ~4x fewer KV bytes (per-block scale rows ride
+    along), which is the handoff-bandwidth side of the kv_quant
+    trade. Runs anywhere: the staging copies and op counts are
+    host-side and real on any backend."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import LlamaConfig, llama_init
+    from ray_tpu.models.engine import DecodeEngine
+
+    spans = (128,) if quick else (128, 512, 2048)
+    cfg = LlamaConfig.nano(max_seq_len=max(spans) + 64)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(17)
+    results = []
+    for span in spans:
+        prompt = rng.randint(1, cfg.vocab_size, size=span).tolist()
+        max_len = span + 16
+        for quant in (None, "int8"):
+            def make(name):
+                return DecodeEngine(params, cfg, batch_slots=1,
+                                    max_len=max_len, paged=True,
+                                    kv_block_tokens=16,
+                                    kv_quant=quant, engine_id=name)
+
+            pre = make(f"hb-pre-{span}-{quant}")
+            pre.prefill_only = True
+            dec = make(f"hb-dec-{span}-{quant}")
+            ex, im, ad = [], [], []
+
+            def cycle(timed):
+                rid = pre.submit(prompt, 4)
+                while not pre.handoff_ready():
+                    pre.step()
+                t0 = time.perf_counter()
+                h = pre.export_request(rid)
+                t1 = time.perf_counter()
+                dec.import_request(h)
+                t2 = time.perf_counter()
+                dec.step()          # admission: swap-in scatter
+                t3 = time.perf_counter()
+                dec.run()           # drain so the next cycle is clean
+                if timed:
+                    ex.append((t1 - t0) * 1000)
+                    im.append((t2 - t1) * 1000)
+                    ad.append((t3 - t2) * 1000)
+
+            cycle(False)            # compile gather/scatter programs
+            for _ in range(TRIALS):
+                cycle(True)
+            tag = "_int8" if quant else ""
+            per_req_bytes = pre.handoff_out_bytes / (TRIALS + 1)
+            results.append((f"handoff_export_ms_s{span}{tag}",
+                            statistics.median(ex), "ms"))
+            results.append((f"handoff_import_ms_s{span}{tag}",
+                            statistics.median(im), "ms"))
+            results.append((f"handoff_admit_ms_s{span}{tag}",
+                            statistics.median(ad), "ms"))
+            results.append((f"handoff_bytes_s{span}{tag}",
+                            per_req_bytes, "bytes"))
+    return results
+
+
 def _fleet_router_section(quick: bool) -> list:
     """Per-decision cost of the fleet routers (models/fleet.py): the
     wall microseconds one `submit()` spends choosing a replica, per
@@ -812,6 +885,9 @@ def main(quick: bool = False):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     for name, value, unit in _kv_quant_gather_section(quick):
+        print(json.dumps({"metric": name, "value": round(value, 4),
+                          "unit": unit}), flush=True)
+    for name, value, unit in _handoff_section(quick):
         print(json.dumps({"metric": name, "value": round(value, 4),
                           "unit": unit}), flush=True)
     for name, value, unit in _fleet_router_section(quick):

@@ -1248,7 +1248,7 @@ def _swap_in_scatter(pool_k, pool_v, host_k, host_v,
 class _Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "tokens", "done",
                  "priority", "seq", "rng", "deadline", "shed", "resume",
-                 "greedy", "adapter_id")
+                 "greedy", "adapter_id", "handoff")
 
     def __init__(self, req_id: int, prompt: List[int],
                  max_new_tokens: int, priority: int = 0, seq: int = 0,
@@ -1267,6 +1267,8 @@ class _Request:
         self.resume = False         # preempted; re-queued to swap back in
         self.greedy = None          # per-request decode-mode override
         self.adapter_id = None      # LoRA adapter (None = base model)
+        self.handoff = False        # imported from a prefill-class
+        #                             replica, awaiting decode admission
 
 
 class _PrefillState:
@@ -1739,6 +1741,20 @@ class DecodeEngine:
         self.swap_outs = 0             # swap-mode spills to host
         self.swap_in_bytes = 0         # host->device swap traffic
         self.swap_out_bytes = 0        # device->host swap traffic
+        # Disaggregated prefill/decode plane (plain ints; identically
+        # zero on a colocated engine so fleet rollups sum blindly).
+        # `prefill_only` is set by the fleet on prefill-class replicas:
+        # step() then parks rows whose prefill frontier completed in
+        # `_handoff_ready` instead of decoding them, and the fleet
+        # export_request()s each one to a decode-class replica.
+        self.prefill_only = False      # fleet-set replica-class switch
+        self.replica_class = None      # "prefill" / "decode" / None
+        self._handoff_ready: List[int] = []   # req_ids parked post-prefill
+        self._handoff_ready_set: set = set()
+        self.handoffs_out = 0          # requests exported post-prefill
+        self.handoffs_in = 0           # requests imported for decode
+        self.handoff_out_bytes = 0     # KV+logits bytes staged to host
+        self.handoff_in_bytes = 0      # KV+logits bytes accepted
         # Async pipeline: dispatched-but-undrained fused steps, oldest
         # first. Same plain-int discipline for the counters so
         # enable_metrics=False benches still report the pipeline plane.
@@ -2362,6 +2378,26 @@ class DecodeEngine:
         # still hold an intermediate chunk's scatter. They ride along
         # frozen (active=False) and take their next chunk next step.
         decodable = [b for b in live if b not in self._row_prefill]
+        if self.prefill_only:
+            # Prefill-class replica (disaggregated fleet): a row whose
+            # prefill frontier just completed holds final last_logits
+            # and tok_idx=0 — exactly a preemption-at-first-token
+            # state. Park it for export_request() instead of decoding;
+            # the fleet hands it to a decode-class replica. Never
+            # dispatch a decode program here, so the ring stays empty
+            # and export never races an in-flight block.
+            for b in decodable:
+                rid = self.row_req[b].req_id
+                if rid not in self._handoff_ready_set:
+                    self._handoff_ready_set.add(rid)
+                    self._handoff_ready.append(rid)
+                    if self.trace.enabled:
+                        self.trace.instant(
+                            "handoff_ready", lane="events",
+                            args={"req": rid,
+                                  "prompt_tokens": int(self.row_len[b])})
+            self.metrics.on_step(len(live), len(self.scheduler), 0)
+            return emitted
         if len(decodable) < len(live):
             self.chunked_prefill_stalls += 1
             self.metrics.on_prefill_stall()
@@ -2817,6 +2853,15 @@ class DecodeEngine:
         out["swap_in_bytes"] = float(self.swap_in_bytes)
         out["swap_out_bytes"] = float(self.swap_out_bytes)
         out["kv_used_fraction"] = self.kv_used_fraction()
+        # Disaggregated-handoff plane: identically 0.0 on a colocated
+        # engine (prefill_only never set, import never called) so
+        # fleet rollups sum blindly.
+        out["prefill_only"] = 1.0 if self.prefill_only else 0.0
+        out["handoffs_out"] = float(self.handoffs_out)
+        out["handoffs_in"] = float(self.handoffs_in)
+        out["handoff_out_bytes"] = float(self.handoff_out_bytes)
+        out["handoff_in_bytes"] = float(self.handoff_in_bytes)
+        out["requests_handoff_ready"] = float(len(self._handoff_ready))
         # Quantized-KV plane: bytes/token is the concurrency lever the
         # fleet watches (see docs/serving.md); identically dense-sized
         # (and quant_enabled 0.0) on an unquantized engine.
@@ -2969,6 +3014,8 @@ class DecodeEngine:
                 except Exception:
                     pass
         self._pending_slots.clear()
+        self._handoff_ready.clear()
+        self._handoff_ready_set.clear()
         if self.paged:
             self._swapped.clear()
         # Drop the queue wholesale (a fresh empty policy, not N pops:
@@ -3645,6 +3692,189 @@ class DecodeEngine:
                 {"mode": "swap", "bytes": nbytes,
                  "blocks": swap.n_blocks})
         return True
+
+    # -- disaggregated prefill/decode handoff ------------------------------
+
+    def handoff_ready(self) -> List[int]:
+        """Request ids parked post-prefill on a prefill-only engine,
+        oldest first — each is waiting for the fleet to
+        `export_request` it to a decode-class replica. Always empty on
+        a colocated engine."""
+        return list(self._handoff_ready)
+
+    def export_request(self, req_id: int) -> dict:
+        """Extract a request whose prefill frontier has completed —
+        the engine half of the disaggregated prefill→decode handoff.
+
+        The request must be bound to a live row that is NOT
+        mid-chunked-prefill, with the async pipeline empty (on a
+        prefill-only engine the ring is always empty: it never
+        dispatches a decode program). A paged engine gathers the row's
+        KV blocks to host via the preempt-and-swap `_swap_out_gather`
+        path — quantized bytes plus their scale rows move verbatim —
+        together with the row's last-prompt-token logits; a dense
+        engine exports no bytes and the importer re-prefills
+        (recompute handoff). Either way the row's blocks are decref'd,
+        its adapter pin released, and the request leaves this engine
+        entirely (`results` included): it now lives wherever
+        `import_request` lands it.
+
+        Token identity holds because a completed prefill IS a
+        preemption at tok_idx=0: the first decode token is sampled
+        from the carried logits with `step_rng_key(rng, 0)`, exactly
+        what this engine would have done next."""
+        row = None
+        for b in range(self.B):
+            r = self.row_req[b]
+            if r is not None and r.req_id == req_id:
+                row = b
+                break
+        if row is None:
+            raise RuntimeError(
+                f"export_request: request {req_id} is not bound to a "
+                "row (still queued, already finished, or unknown)")
+        if row in self._row_prefill:
+            raise RuntimeError(
+                f"export_request: request {req_id} is still "
+                "mid-chunked-prefill; export only after its frontier "
+                "completes (see handoff_ready())")
+        if self._ring:
+            raise RuntimeError(
+                "export_request needs a drained pipeline (in-flight "
+                "fused decode blocks still reference row state); "
+                "step() flushes before admissions — export between "
+                "steps")
+        # Drained-ring dominator for the row-state writes below (the
+        # raise above enforces it with a typed error; flush-order
+        # wants the guard in assert form).
+        assert not self._ring
+        req = self.row_req[row]
+        kv = None
+        nbytes = 0
+        if self.paged:
+            ids = self._row_blocks[row]
+            n = len(ids)
+            nbp = _pow2(max(1, n))
+            bids = np.zeros((nbp,), np.int32)
+            bids[:n] = ids
+            k, v, sk, sv = _swap_out_gather(
+                self._pool_k, self._pool_v, jnp.asarray(bids),
+                shardings=self._shardings, scale_k=self._scale_k,
+                scale_v=self._scale_v)
+            lg = self._last_logits[row]
+            for x in (k, v, lg, sk, sv):
+                if x is not None:
+                    _host_async(x)
+            k = _device_get(k)
+            v = _device_get(v)
+            lg = _device_get(lg)
+            if sk is not None:
+                sk = _device_get(sk)
+                sv = _device_get(sv)
+            nbytes = k.nbytes + v.nbytes + lg.nbytes
+            if sk is not None:
+                nbytes += sk.nbytes + sv.nbytes
+            kv = {"k": k, "v": v, "sk": sk, "sv": sv,
+                  "n_blocks": n,
+                  "row_len": int(self.row_len[row]),
+                  "tok_idx": int(self._tok_idx[row]),
+                  "budget": int(self.row_budget[row]),
+                  "logits": lg,
+                  "block_tokens": self.prefix_block,
+                  "quant": self.kv_quant,
+                  "pool_shape": tuple(self._pool_k.shape[i]
+                                      for i in (0, 3, 4))}
+            self._release_row_blocks(row)
+        if self._row_slot[row]:
+            # The exporting row's adapter pin dies here; the importing
+            # engine's admission gate re-pins (and prefetches a cold
+            # adapter) on its own pool.
+            self.adapter_pool.decref(int(self._row_slot[row]))
+            self._row_slot[row] = 0
+        handoff = {"req_id": req.req_id,
+                   "prompt": list(req.prompt),
+                   "max_new_tokens": req.max_new_tokens,
+                   "priority": req.priority,
+                   "greedy": req.greedy,
+                   "rng": req.rng,
+                   "adapter_id": req.adapter_id,
+                   "tokens": list(req.tokens),
+                   "kv": kv}
+        self.row_req[row] = None
+        self.row_len[row] = 0
+        self.row_budget[row] = 0
+        self._tok_idx[row] = 0
+        self.results.pop(req.req_id, None)
+        if req.req_id in self._handoff_ready_set:
+            self._handoff_ready_set.discard(req.req_id)
+            self._handoff_ready.remove(req.req_id)
+        self.handoffs_out += 1
+        self.handoff_out_bytes += nbytes
+        self.metrics.on_handoff_out(req.req_id, nbytes)
+        if self.trace.enabled:
+            self.trace.span_since_mark(
+                "handoff_export", req.req_id,
+                {"bytes": nbytes,
+                 "blocks": 0 if kv is None else kv["n_blocks"],
+                 "tokens": len(req.tokens)})
+        return handoff
+
+    def import_request(self, handoff: dict) -> int:
+        """Admit a request exported from another engine — the decode
+        half of the handoff. Re-submits it under THIS engine's queue
+        discipline (same rng key, greedy mode, priority, adapter), and
+        when the exported KV payload is compatible with this engine's
+        pool (paged, same block size, same quantization, same KV
+        geometry) pre-seeds the paged swap ledger with it: admission
+        then scatters the bytes back via `_swap_in_scatter` and the
+        row is decodable immediately — no re-prefill. Incompatible or
+        dense payloads fall back to recompute (prompt + any emitted
+        tokens replay), which is slower but bit-identical. Returns the
+        request id on this engine."""
+        kv = handoff.get("kv")
+        toks = handoff.get("tokens") or []
+        rng = handoff.get("rng")
+        rid = self.submit(
+            handoff["prompt"], handoff["max_new_tokens"],
+            priority=handoff.get("priority", 0),
+            rng=rng,
+            greedy=handoff.get("greedy"),
+            resume_tokens=toks or None,
+            adapter_id=handoff.get("adapter_id"))
+        req = self.results[rid]
+        req.handoff = True
+        compatible = (
+            kv is not None and self.paged
+            and kv["block_tokens"] == self.prefix_block
+            and kv["quant"] == self.kv_quant
+            and kv["pool_shape"] == tuple(self._pool_k.shape[i]
+                                          for i in (0, 3, 4)))
+        if compatible:
+            # Pre-seed the swap ledger with the exported bytes: the
+            # recompute entry submit() may have planted (resume path)
+            # is replaced by the byte-carrying state, and
+            # `_admit_rows_paged` scatters it back like any preempted
+            # row returning home.
+            self._swapped[rid] = _SwapState(
+                kv["k"], kv["v"], kv["n_blocks"], kv["row_len"],
+                kv["tok_idx"], kv["budget"], kv["logits"],
+                sk=kv["sk"], sv=kv["sv"])
+            req.resume = True
+            nbytes = kv["k"].nbytes + kv["v"].nbytes \
+                + kv["logits"].nbytes
+            if kv["sk"] is not None:
+                nbytes += kv["sk"].nbytes + kv["sv"].nbytes
+        else:
+            nbytes = 0
+        self.handoffs_in += 1
+        self.handoff_in_bytes += nbytes
+        self.metrics.on_handoff_in(nbytes)
+        if self.trace.enabled:
+            self.trace.span_since_mark(
+                "handoff_import", rid,
+                {"bytes": nbytes, "mode":
+                 "swap" if compatible else "recompute"})
+        return rid
 
     def _release_row_blocks(self, row: int) -> None:
         """Drop the row's reference on its chain (trie-shared blocks

@@ -293,6 +293,27 @@ class EngineMetrics:
         self._m_swap_in = counter(
             "llm_engine_swap_in_bytes_total",
             "Bytes restored host->device by preemption swap-ins")
+        # Disaggregated prefill/decode handoff plane:
+        self.handoffs_out = 0
+        self.handoffs_in = 0
+        self.handoff_out_bytes = 0
+        self.handoff_in_bytes = 0
+        self._m_handoffs_out = counter(
+            "llm_engine_handoffs_out_total",
+            "Requests exported post-prefill to a decode-class "
+            "replica (disaggregated fleet handoff)")
+        self._m_handoffs_in = counter(
+            "llm_engine_handoffs_in_total",
+            "Requests imported from a prefill-class replica for "
+            "decode (disaggregated fleet handoff)")
+        self._m_handoff_out = counter(
+            "llm_engine_handoff_out_bytes_total",
+            "KV + logits bytes staged device->host by handoff "
+            "exports")
+        self._m_handoff_in = counter(
+            "llm_engine_handoff_in_bytes_total",
+            "KV + logits bytes accepted by handoff imports (swap "
+            "pre-seed; 0 for a recompute-fallback import)")
         self._m_kv_pool_total = gauge(
             "llm_engine_kv_pool_blocks",
             "KV pool size in blocks (scratch block excluded)")
@@ -559,6 +580,25 @@ class EngineMetrics:
             self.swap_in_bytes += nbytes
             self._m_swap_in.inc(nbytes)
 
+    def on_handoff_out(self, req_id: int, nbytes: int) -> None:
+        """A request left this engine mid-flight (prefill→decode
+        handoff): its per-request timing record goes with it — the
+        importing engine owns TTFT/TPOT from here (the fleet stitches
+        end-to-end TTFT itself)."""
+        self.handoffs_out += 1
+        self._m_handoffs_out.inc()
+        if nbytes > 0:
+            self.handoff_out_bytes += nbytes
+            self._m_handoff_out.inc(nbytes)
+        self._req.pop(req_id, None)
+
+    def on_handoff_in(self, nbytes: int) -> None:
+        self.handoffs_in += 1
+        self._m_handoffs_in.inc()
+        if nbytes > 0:
+            self.handoff_in_bytes += nbytes
+            self._m_handoff_in.inc(nbytes)
+
     def on_kv_pool(self, total: int, in_use: int, free: int,
                    bytes_per_token: float = 0.0) -> None:
         """Gauge update at step end: pool occupancy in blocks, plus
@@ -706,6 +746,10 @@ class EngineMetrics:
         out["preemptions"] = self.preemptions
         out["swap_in_bytes"] = self.swap_in_bytes
         out["swap_out_bytes"] = self.swap_out_bytes
+        out["handoffs_out"] = self.handoffs_out
+        out["handoffs_in"] = self.handoffs_in
+        out["handoff_out_bytes"] = self.handoff_out_bytes
+        out["handoff_in_bytes"] = self.handoff_in_bytes
         out["kv_pool_blocks_total"] = self.kv_pool_blocks_total
         out["kv_pool_blocks_in_use"] = self.kv_pool_blocks_in_use
         out["kv_pool_blocks_free"] = self.kv_pool_blocks_free
@@ -788,6 +832,10 @@ class NullEngineMetrics:
     def on_swap_out(self, nbytes): pass
 
     def on_swap_in(self, nbytes): pass
+
+    def on_handoff_out(self, req_id, nbytes): pass
+
+    def on_handoff_in(self, nbytes): pass
 
     def on_kv_pool(self, total, in_use, free, bytes_per_token=0.0): pass
 

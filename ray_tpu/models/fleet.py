@@ -90,6 +90,7 @@ from typing import Callable, Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from ray_tpu.models.engine import _key_data
+from ray_tpu.models.engine_metrics import _Agg
 from ray_tpu.models.engine_trace import resolve_tracer
 from ray_tpu.models.scheduler import EngineDraining, EngineOverloaded
 from ray_tpu.util.metrics import Counter, Gauge
@@ -162,11 +163,18 @@ class _Replica:
     __slots__ = ("name", "engine", "state", "rid_to_fid", "routed",
                  "slow_streak", "silent_streak", "good_streak",
                  "failures", "timeouts", "suspect_events",
-                 "breaker_open_until", "breaker_trips")
+                 "breaker_open_until", "breaker_trips",
+                 "replica_class")
 
-    def __init__(self, name: str, engine):
+    def __init__(self, name: str, engine,
+                 replica_class: Optional[str] = None):
         self.name = name
         self.engine = engine
+        # Disaggregated fleets run two replica classes: "prefill"
+        # (admission + chunked prefill only; finished KV is handed
+        # off) and "decode" (imports handoffs, runs fused decode).
+        # None = colocated (both workloads), the default.
+        self.replica_class = replica_class
         self.state = RUNNING
         self.rid_to_fid: Dict[int, int] = {}
         self.routed = 0          # requests this replica has been given
@@ -190,7 +198,7 @@ class _FleetReq:
 
     __slots__ = ("fid", "prompt", "max_new_tokens", "priority",
                  "greedy", "rng", "adapter_id", "attempts", "emitted",
-                 "tokens", "recovering")
+                 "tokens", "recovering", "handoff", "submit_t")
 
     def __init__(self, fid: int, prompt: List[int],
                  max_new_tokens: int, priority: int, greedy,
@@ -206,6 +214,10 @@ class _FleetReq:
         self.emitted = 0         # tokens already streamed to the caller
         self.tokens: List[int] = []   # salvage buffer while recovering
         self.recovering = False  # in the retry queue right now
+        self.handoff = None      # exported engine state while the
+        #                          request is between replica classes
+        self.submit_t: Optional[float] = None   # fleet-clock submit
+        #                          time (fleet-side TTFT in disagg)
 
 
 # ---------------------------------------------------------------------------
@@ -235,13 +247,35 @@ def replica_score(replica: _Replica, prompt: List[int],
     of KV pool blocks not free-or-evictable, so a replica whose pool
     is nearly dry — about to preempt — scores as loaded even when its
     row slots look empty, and the router steers toward free KV blocks.
-    All host-side reads, zero device work per decision."""
+    All host-side reads, zero device work per decision.
+
+    Replica CLASSES score on what they actually do (disaggregated
+    fleets): a "prefill" replica's cost is its prefill backlog —
+    queue + pending prompt tokens + the newcomer's cold suffix; its
+    decode-slot terms are meaningless (it never decodes). A "decode"
+    replica's cost is decode interference — live slots plus KV-pool
+    pressure (the preemption predictor) plus queue; the prompt's cold
+    suffix is irrelevant because its KV arrives pre-computed through
+    the handoff. Colocated replicas (class None) keep the historical
+    blended score."""
     eng = replica.engine
     queued = float(len(eng.scheduler))
     if hasattr(eng, "kv_used_fraction"):
         occupied = eng.kv_used_fraction() * len(eng.row_req)
     else:
         occupied = float(sum(r is not None for r in eng.row_req))
+    klass = getattr(replica, "replica_class", None)
+    if klass == "prefill":
+        pending = float(eng.pending_prefill_tokens())
+        cold = float(max(len(prompt)
+                         - eng.prefix_match_tokens(prompt), 1))
+        return queued * queue_cost + pending + cold
+    if klass == "decode":
+        live = float(sum(r is not None for r in eng.row_req))
+        kv_pressure = (eng.kv_used_fraction()
+                       if hasattr(eng, "kv_used_fraction") else 0.0)
+        return (queued * queue_cost + live * slot_cost
+                + kv_pressure * len(eng.row_req) * slot_cost + 1.0)
     pending = float(eng.pending_prefill_tokens())
     cold = float(max(len(prompt) - eng.prefix_match_tokens(prompt), 1))
     return queued * queue_cost + occupied * slot_cost + pending + cold
@@ -415,6 +449,7 @@ class FleetAutoscalingConfig:
 
     def __init__(self, *, min_replicas: int = 1, max_replicas: int = 4,
                  ttft_p95_slo_s: Optional[float] = None,
+                 tpot_p95_slo_s: Optional[float] = None,
                  occupancy_high: float = 0.85,
                  occupancy_low: float = 0.30,
                  upscale_hold_s: float = 3.0,
@@ -434,6 +469,10 @@ class FleetAutoscalingConfig:
         self.min_replicas = min_replicas
         self.max_replicas = max_replicas
         self.ttft_p95_slo_s = ttft_p95_slo_s
+        # TPOT tail SLO: the decode-side twin of ttft_p95_slo_s. In a
+        # disaggregated fleet the decode class scales on this (TTFT
+        # gates the prefill class); a colocated fleet may set both.
+        self.tpot_p95_slo_s = tpot_p95_slo_s
         self.occupancy_high = occupancy_high
         self.occupancy_low = occupancy_low
         self.upscale_hold_s = upscale_hold_s
@@ -573,8 +612,10 @@ class EngineStatsAutoscaler:
         self.last_signals: Dict[str, float] = {}
 
     def _signals(self, stats_list: List[Dict[str, float]]
-                 ) -> Tuple[float, float, float, Optional[float]]:
+                 ) -> Tuple[float, float, float, float, Optional[float]]:
         ttft_p95 = max((s.get("ttft_s_p95", 0.0) for s in stats_list),
+                       default=0.0)
+        tpot_p95 = max((s.get("tpot_s_p95", 0.0) for s in stats_list),
                        default=0.0)
         occ = (sum(s.get("slot_occupancy", 0.0) for s in stats_list)
                / len(stats_list)) if stats_list else 0.0
@@ -582,7 +623,7 @@ class EngineStatsAutoscaler:
         custom = None
         if self.config.custom_metric_source is not None:
             custom = self.config.custom_metric_source()
-        return ttft_p95, occ, qdepth, custom
+        return ttft_p95, tpot_p95, occ, qdepth, custom
 
     def tick(self, stats_list: List[Dict[str, float]],
              n_replicas: int) -> int:
@@ -590,9 +631,10 @@ class EngineStatsAutoscaler:
         Call at the fleet's step cadence; returns +1 / 0 / -1."""
         cfg = self.config
         now = self._clock()
-        ttft_p95, occ, qdepth, custom = self._signals(stats_list)
+        ttft_p95, tpot_p95, occ, qdepth, custom = \
+            self._signals(stats_list)
 
-        # TTFT p95 is a sliding WINDOW over past requests — once
+        # TTFT/TPOT p95 are sliding WINDOWS over past requests — once
         # traffic stops the window goes stale at its last (bad) value.
         # A latency breach therefore only counts while the fleet is
         # actually busy (work queued or slots occupied); an idle fleet
@@ -601,6 +643,9 @@ class EngineStatsAutoscaler:
         breach = occ > cfg.occupancy_high
         if busy and cfg.ttft_p95_slo_s is not None and \
                 ttft_p95 > cfg.ttft_p95_slo_s:
+            breach = True
+        if busy and cfg.tpot_p95_slo_s is not None and \
+                tpot_p95 > cfg.tpot_p95_slo_s:
             breach = True
         if cfg.target_custom_metric is not None and custom is not None \
                 and custom > cfg.target_custom_metric:
@@ -612,7 +657,8 @@ class EngineStatsAutoscaler:
             idle = False
 
         self.last_signals = {
-            "ttft_p95": ttft_p95, "occupancy": occ,
+            "ttft_p95": ttft_p95, "tpot_p95": tpot_p95,
+            "occupancy": occ,
             "queue_depth": qdepth,
             "custom": float("nan") if custom is None else custom,
             "breach": 1.0 if breach else 0.0,
@@ -689,7 +735,14 @@ class LLMFleet:
                  rng_seed: int = 0,
                  fault_injector=None,
                  trace=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 disaggregated: bool = False,
+                 prefill_replicas: Optional[int] = None,
+                 decode_replicas: Optional[int] = None,
+                 prefill_autoscaling: Optional[
+                     FleetAutoscalingConfig] = None,
+                 decode_autoscaling: Optional[
+                     FleetAutoscalingConfig] = None):
         self._factory = engine_factory
         self.router = make_router(router)
         self.fleet_id = fleet_id
@@ -706,20 +759,37 @@ class LLMFleet:
         self.trace = resolve_tracer(trace, engine_id=fleet_id,
                                     clock=clock)
         self._retired_trace: List[dict] = []   # removed replicas' spans
+        # Disaggregated prefill/decode (DistServe/Splitwise shape):
+        # the replica pool splits into a "prefill" class (admission +
+        # chunked prefill only; finished KV is exported) and a
+        # "decode" class (imports handoffs, runs fused decode), each
+        # scaled by its OWN autoscaler — TTFT p95 gates prefill
+        # capacity, TPOT p95 gates decode capacity. Colocated fleets
+        # (the default) keep the single shared pool and scaler.
+        self.disaggregated = bool(disaggregated)
+        if not self.disaggregated and (
+                prefill_replicas is not None
+                or decode_replicas is not None
+                or prefill_autoscaling is not None
+                or decode_autoscaling is not None):
+            raise ValueError(
+                "prefill_*/decode_* fleet knobs require "
+                "disaggregated=True")
+        if self.disaggregated and (autoscaling is not None
+                                   or initial_replicas is not None):
+            raise ValueError(
+                "disaggregated=True sizes and scales per class: use "
+                "prefill_replicas/decode_replicas and "
+                "prefill_autoscaling/decode_autoscaling instead of "
+                "initial_replicas/autoscaling")
         self.autoscaler = (EngineStatsAutoscaler(autoscaling, clock)
                            if autoscaling is not None else None)
-        n = initial_replicas
-        if n is None:
-            n = autoscaling.min_replicas if autoscaling else 2
-        if n < 1:
-            raise ValueError("initial_replicas must be >= 1")
-        if autoscaling is not None and \
-                not autoscaling.min_replicas <= n \
-                <= autoscaling.max_replicas:
-            raise ValueError(
-                f"initial_replicas {n} outside autoscaling bounds "
-                f"[{autoscaling.min_replicas}, "
-                f"{autoscaling.max_replicas}]")
+        self._prefill_scaler = (
+            EngineStatsAutoscaler(prefill_autoscaling, clock)
+            if prefill_autoscaling is not None else None)
+        self._decode_scaler = (
+            EngineStatsAutoscaler(decode_autoscaling, clock)
+            if decode_autoscaling is not None else None)
         # Fleet-level adapter table: {adapter_id: lora_init-shaped
         # host tree}. register_adapter fans out to every replica and
         # REPLAYS onto replicas that join later (autoscale, failure
@@ -728,8 +798,55 @@ class LLMFleet:
         self._adapters: Dict[str, object] = {}
         self.replicas: List[_Replica] = []
         self._next_replica = 0
-        for _ in range(n):
-            self.add_replica()
+        if self.disaggregated:
+            n_pre = prefill_replicas
+            if n_pre is None:
+                n_pre = (prefill_autoscaling.min_replicas
+                         if prefill_autoscaling else 1)
+            n_dec = decode_replicas
+            if n_dec is None:
+                n_dec = (decode_autoscaling.min_replicas
+                         if decode_autoscaling else 1)
+            for klass, n_k, cfg_k in (
+                    ("prefill", n_pre, prefill_autoscaling),
+                    ("decode", n_dec, decode_autoscaling)):
+                if n_k < 1:
+                    raise ValueError(
+                        f"{klass}_replicas must be >= 1")
+                if cfg_k is not None and not \
+                        cfg_k.min_replicas <= n_k \
+                        <= cfg_k.max_replicas:
+                    raise ValueError(
+                        f"{klass}_replicas {n_k} outside autoscaling "
+                        f"bounds [{cfg_k.min_replicas}, "
+                        f"{cfg_k.max_replicas}]")
+            for _ in range(n_pre):
+                self.add_replica(replica_class="prefill")
+            for _ in range(n_dec):
+                self.add_replica(replica_class="decode")
+        else:
+            n = initial_replicas
+            if n is None:
+                n = autoscaling.min_replicas if autoscaling else 2
+            if n < 1:
+                raise ValueError("initial_replicas must be >= 1")
+            if autoscaling is not None and \
+                    not autoscaling.min_replicas <= n \
+                    <= autoscaling.max_replicas:
+                raise ValueError(
+                    f"initial_replicas {n} outside autoscaling bounds "
+                    f"[{autoscaling.min_replicas}, "
+                    f"{autoscaling.max_replicas}]")
+            for _ in range(n):
+                self.add_replica()
+        # Handoff plane: fids whose exported engine state is parked on
+        # the host (no decode replica could import right now), plus
+        # the fleet's own submit->first-token latency window — prefill
+        # engines never emit tokens, so the fleet measures the
+        # user-visible TTFT itself and feeds it to the prefill scaler.
+        self._handoff_parked: List[int] = []
+        self.handoffs = 0
+        self._ttft_agg = _Agg()
         self._next_fid = 0
         self._placement: Dict[int, Tuple[_Replica, int]] = {}
         self._requests: Dict[int, _FleetReq] = {}
@@ -771,21 +888,36 @@ class LLMFleet:
 
     # -- replica lifecycle -------------------------------------------------
 
-    def add_replica(self) -> str:
+    def add_replica(self,
+                    replica_class: Optional[str] = None) -> str:
         """Build a fresh replica via the factory and put it in the
         routing rotation; returns its name. Arms the fleet's fault
         injector (when one is configured) so chaos schedules cover
-        replacements too."""
+        replacements too.
+
+        ``replica_class`` ("prefill" | "decode" | None) is a FLEET
+        placement attribute stamped onto the engine after construction
+        — any engine_factory works unchanged. A "prefill" engine gets
+        `prefill_only = True`: its step() parks completed prefills for
+        export instead of decoding them."""
+        if replica_class not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"replica_class must be 'prefill', 'decode' or None, "
+                f"got {replica_class!r}")
         name = f"{self.fleet_id}-r{self._next_replica}"
         self._next_replica += 1
         engine = self._factory(name)
+        if replica_class is not None:
+            engine.replica_class = replica_class
+            if replica_class == "prefill":
+                engine.prefill_only = True
         if self._injector is not None:
             self._injector.arm(engine, name)
         if self._adapters and \
                 getattr(engine, "adapter_pool", None) is not None:
             for aid, params in self._adapters.items():
                 engine.register_adapter(aid, params)
-        self.replicas.append(_Replica(name, engine))
+        self.replicas.append(_Replica(name, engine, replica_class))
         return name
 
     def register_adapter(self, adapter_id: str, lora_params) -> None:
@@ -879,6 +1011,14 @@ class LLMFleet:
         adapter affinity, and the id passes through to the engine's
         adapter-gated admission."""
         routable = self._routable()
+        if self.disaggregated:
+            # New requests land on the prefill class — that is the
+            # whole point of the split. Fall back to whatever runs
+            # (decode replicas are full colocated engines) only when
+            # the prefill class is momentarily empty mid-churn.
+            pre = [r for r in routable
+                   if r.replica_class == "prefill"]
+            routable = pre or routable
         if not routable:
             raise ReplicaUnavailable(
                 "fleet has no RUNNING replicas to route to")
@@ -921,6 +1061,8 @@ class LLMFleet:
         self._requests[fid] = _FleetReq(
             fid, prompt, max_new_tokens, priority, greedy,
             _key_data(key), adapter_id)
+        if self.disaggregated:
+            self._requests[fid].submit_t = self._clock()
         rep.rid_to_fid[rid] = fid
         self._placement[fid] = (rep, rid)
         rep.routed += 1
@@ -946,6 +1088,8 @@ class LLMFleet:
             self._apply_scale(self.autoscaler.tick(
                 [r.engine.stats() for r in self.replicas],
                 len(self._running())))
+        if self.disaggregated:
+            self._tick_class_scalers()
         emitted: Dict[int, List[int]] = {}
         if self._pending_emit:
             # Tokens salvaged from a failed replica that step() never
@@ -981,16 +1125,26 @@ class LLMFleet:
                     emitted.setdefault(fid, []).extend(toks)
                     meta = self._requests.get(fid)
                     if meta is not None:
+                        if meta.emitted == 0 and \
+                                meta.submit_t is not None:
+                            # Fleet-side TTFT: submit -> first token,
+                            # SPANNING the handoff (the number a user
+                            # feels; prefill engines never emit, so no
+                            # engine window covers it).
+                            self._ttft_agg.add(
+                                self._clock() - meta.submit_t)
                         meta.emitted += len(toks)
             self._sweep_finished(rep)
             progressed = getattr(rep.engine, "steps_total",
                                  steps_before + 1) != steps_before
             self._health_after_step(rep, dt, progressed)
+        if self.disaggregated:
+            self._process_handoffs()
         self._retire_drained()
         return emitted
 
     def pending(self) -> bool:
-        return bool(self._retry) or any(
+        return bool(self._retry) or bool(self._handoff_parked) or any(
             r.engine.pending() for r in self.replicas
             if r.state != RETIRED)
 
@@ -1203,7 +1357,10 @@ class LLMFleet:
         for fid, toks in salvaged:
             self._schedule_retry(fid, toks, cause)
         if self.health.replace_failed:
-            name = self.add_replica()
+            # Replacement inherits the dead replica's class: losing a
+            # decode replica must not quietly shrink decode capacity
+            # into a colocated pool.
+            name = self.add_replica(replica_class=rep.replica_class)
             if self.trace.enabled:
                 self.trace.instant(
                     "replica_replaced", lane="events",
@@ -1292,6 +1449,15 @@ class LLMFleet:
                     f"fleet request {fid}: no RUNNING replica left to "
                     "recover onto (replacement disabled or exhausted)"))
                 continue
+            if self.disaggregated:
+                # Recoveries re-enter through the prefill class: the
+                # recompute replay IS a prefill, and the finished
+                # frontier rides the ordinary handoff to decode. Only
+                # when no prefill replica runs does a recovery land on
+                # decode (a decode engine is a full colocated engine).
+                pre = [r for r in running
+                       if r.replica_class == "prefill"]
+                running = pre or running
             self._resubmit(meta, running, ready, seq)
 
     def _choose(self, cands: List[_Replica], prompt: List[int],
@@ -1389,21 +1555,168 @@ class LLMFleet:
             self.replicas.remove(rep)
             self.replicas_removed += 1
 
-    def _apply_scale(self, decision: int) -> None:
+    def _apply_scale(self, decision: int,
+                     replica_class: Optional[str] = None) -> None:
         if decision > 0:
-            self.add_replica()
+            self.add_replica(replica_class=replica_class)
         elif decision < 0:
-            running = self._running()
-            if len(running) <= 1:
-                return          # never drain the last live replica
+            pool = self._running()
+            if replica_class is not None:
+                pool = [r for r in pool
+                        if r.replica_class == replica_class]
+            if len(pool) <= 1:
+                return    # never drain the last live replica
+            #             # (of its class, in a disaggregated fleet)
             # Drain the replica with the least outstanding work — the
             # cheapest flush, so capacity leaves the pool fastest.
             victim = min(
-                running,
+                pool,
                 key=lambda r: (r.engine.pending_prefill_tokens()
                                + sum(x is not None
                                      for x in r.engine.row_req)))
             self.drain_replica(victim.name)
+
+    # -- disaggregated prefill/decode handoff ------------------------------
+
+    def _class_replicas(self, klass: str) -> List[_Replica]:
+        return [r for r in self.replicas
+                if r.replica_class == klass and r.state != RETIRED]
+
+    def _tick_class_scalers(self) -> None:
+        """One scale decision PER CLASS: the prefill scaler gates on
+        TTFT p95 (admission latency — add prefill replicas when the
+        first token lags), the decode scaler on TPOT p95 (steady-state
+        decode latency — add decode replicas when streams stutter).
+        Which signal each class uses is the config's choice
+        (ttft_p95_slo_s / tpot_p95_slo_s); the split is what makes the
+        two SLOs independently tunable."""
+        for klass, scaler in (("prefill", self._prefill_scaler),
+                              ("decode", self._decode_scaler)):
+            if scaler is None:
+                continue
+            reps = self._class_replicas(klass)
+            stats_list = [r.engine.stats() for r in reps]
+            if klass == "prefill":
+                # Prefill engines never emit tokens, so their engine
+                # TTFT windows are empty forever: inject the fleet's
+                # own submit->first-token tail (measured ACROSS the
+                # handoff) so the scaler sees what users feel.
+                t = self._ttft_agg.percentile(95.0)
+                for s in stats_list:
+                    s["ttft_s_p95"] = t
+            n_running = sum(1 for r in reps if r.state == RUNNING)
+            self._apply_scale(scaler.tick(stats_list, n_running),
+                              replica_class=klass)
+
+    def _process_handoffs(self) -> None:
+        """Drain the handoff pipeline once per fleet step: re-place
+        parked exports first (a decode replica may have appeared),
+        then export every prefill-complete request and import it on a
+        decode replica. DRAINING prefill replicas still export — the
+        handoff IS their flush path; only condemned replicas are
+        skipped (their work goes through ordinary failover)."""
+        if self._handoff_parked:
+            parked, self._handoff_parked = self._handoff_parked, []
+            for fid in parked:
+                self._place_handoff(fid)
+        for rep in list(self.replicas):
+            if rep.replica_class != "prefill" or \
+                    rep.state in (UNHEALTHY, RETIRED):
+                continue
+            eng = rep.engine
+            for rid in list(eng.handoff_ready()):
+                fid = rep.rid_to_fid.get(rid)
+                meta = self._requests.get(fid) \
+                    if fid is not None else None
+                if meta is None:
+                    continue
+                h = eng.export_request(rid)
+                rep.rid_to_fid.pop(rid, None)
+                self._placement.pop(fid, None)
+                meta.handoff = h
+                self.handoffs += 1
+                self._count("handoffs", 1)
+                if self.trace.enabled:
+                    self.trace.instant(
+                        "handoff", fid,
+                        args={"from": rep.name,
+                              "prompt_tokens": len(meta.prompt),
+                              "resume_tokens": len(h["tokens"])})
+                self._place_handoff(fid)
+
+    def _place_handoff(self, fid: int) -> None:
+        """Import one exported request on a decode-class replica. No
+        importable replica right now -> the payload parks on the host
+        (the KV lives in numpy arrays inside `meta.handoff`, safe
+        across any replica's death) and is retried every step; the
+        request only fails when the decode class is GONE."""
+        meta = self._requests.get(fid)
+        if meta is None or meta.handoff is None:
+            return
+        cands = [r for r in self._routable()
+                 if r.replica_class == "decode"]
+        if not cands:
+            if any(r.replica_class == "decode"
+                   and r.state in (RUNNING, SUSPECT, DRAINING)
+                   for r in self.replicas):
+                self._handoff_parked.append(fid)
+                return
+            self._fail_request(fid, ReplicaUnavailable(
+                f"fleet request {fid}: no decode-class replica left "
+                "to import the handoff onto"))
+            return
+        rep = self._choose(cands, meta.prompt, meta.adapter_id)
+        try:
+            rid = rep.engine.import_request(meta.handoff)
+        except (EngineDraining, EngineOverloaded):
+            self._handoff_parked.append(fid)
+            return
+        meta.handoff = None
+        rep.rid_to_fid[rid] = fid
+        self._placement[fid] = (rep, rid)
+        rep.routed += 1
+        if self.trace.enabled:
+            self.trace.instant(
+                "handoff_placed", fid,
+                args={"replica": rep.name, "rid": rid})
+        self._sweep_finished(rep)
+
+    def handoff_requests(self) -> List[Dict[str, object]]:
+        """One dict per request whose export is parked between replica
+        classes — the state API's fleet-side `status="handoff"`
+        source. Host-only."""
+        out = []
+        for fid in self._handoff_parked:
+            meta = self._requests.get(fid)
+            if meta is None or meta.handoff is None:
+                continue
+            out.append({
+                "req_id": fid,
+                "prompt_tokens": len(meta.prompt),
+                "max_new_tokens": meta.max_new_tokens,
+                "tokens_out": len(meta.handoff["tokens"]),
+                "priority": meta.priority,
+                "attempts": meta.attempts,
+            })
+        return out
+
+    def adapter_miss_rate(self) -> float:
+        """Fleet-wide adapter HBM-residency miss rate over the live
+        pool counters (1 - hits/lookups; 0.0 before any lookup).
+        Exposed as the `llm_fleet_adapter_miss_rate` gauge and usable
+        directly as an autoscaling `custom_metric_source` — a decode
+        class thrashing adapter slots wants MORE replicas (each added
+        replica's pool spreads the working set), which plain occupancy
+        and latency signals under-read."""
+        lk = hit = 0.0
+        for r in self.replicas:
+            pool = getattr(r.engine, "adapter_pool", None)
+            if pool is None:
+                continue
+            s = pool.stats()
+            lk += s.get("adapter_lookups", 0.0)
+            hit += s.get("adapter_hits", 0.0)
+        return (1.0 - hit / lk) if lk else 0.0
 
     # -- telemetry ---------------------------------------------------------
 
@@ -1567,6 +1880,37 @@ class LLMFleet:
             s.get("adapter_evictions", 0.0) for s in per)
         out["adapter_prefetch_deferrals"] = sum(
             s.get("adapter_prefetch_deferrals", 0.0) for s in per)
+        # Disaggregated prefill/decode plane (all-zero for colocated
+        # fleets). `handoffs` counts fleet-level export->import moves;
+        # the per-engine out/in counters and byte totals roll up so a
+        # leak (out != in + parked) is visible from one snapshot.
+        out["disaggregated"] = 1.0 if self.disaggregated else 0.0
+        out["replicas_prefill"] = float(
+            len(self._class_replicas("prefill")))
+        out["replicas_decode"] = float(
+            len(self._class_replicas("decode")))
+        out["handoffs"] = float(self.handoffs)
+        out["handoff_parked"] = float(len(self._handoff_parked))
+        out["handoffs_out"] = sum(
+            s.get("handoffs_out", 0.0) for s in per)
+        out["handoffs_in"] = sum(
+            s.get("handoffs_in", 0.0) for s in per)
+        out["handoff_out_bytes"] = sum(
+            s.get("handoff_out_bytes", 0.0) for s in per)
+        out["handoff_in_bytes"] = sum(
+            s.get("handoff_in_bytes", 0.0) for s in per)
+        out["adapter_miss_rate"] = self.adapter_miss_rate()
+        out["ttft_s_p95_fleet"] = self._ttft_agg.percentile(95.0)
+        if self._prefill_scaler is not None:
+            out["prefill_scale_ups"] = float(
+                self._prefill_scaler.scale_ups)
+            out["prefill_scale_downs"] = float(
+                self._prefill_scaler.scale_downs)
+        if self._decode_scaler is not None:
+            out["decode_scale_ups"] = float(
+                self._decode_scaler.scale_ups)
+            out["decode_scale_downs"] = float(
+                self._decode_scaler.scale_downs)
         out["router_affinity_wins"] = float(
             getattr(self.router, "affinity_wins", 0))
         out["router_adapter_wins"] = float(

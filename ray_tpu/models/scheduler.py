@@ -123,6 +123,11 @@ class SchedulerPolicy:
                  "prompt_tokens": len(r.prompt),
                  "max_new_tokens": r.max_new_tokens,
                  "deadline": r.deadline, "resume": r.resume,
+                 # Imported from a prefill-class replica, waiting for
+                 # decode admission (disaggregated fleets; always
+                 # False elsewhere). Surfaced flat so state-API
+                 # callers need not reach into the request object.
+                 "handoff": bool(getattr(r, "handoff", False)),
                  "request": r} for r in reqs]
 
     def horizon_hint(self, *, free_slots: int,

@@ -25,6 +25,15 @@ Request phases (`list_requests(status=...)`):
 - ``swapped``     preempted out of the pool, spilled state waiting to
                   swap back in (the request is also re-queued; the
                   swap ledger takes precedence here)
+- ``handoff``     moving between replica classes in a disaggregated
+                  fleet: prefill finished and the KV is being exported
+                  (parked on a prefill-class engine), parked host-side
+                  on the fleet (no decode replica importable yet —
+                  ``engine_id`` is None), or imported on a decode-class
+                  engine and awaiting its decode admission. Handoff
+                  WINS over ``swapped``: an imported request also sits
+                  in the importer's swap ledger, and counting it twice
+                  would double the in-flight census
 - ``recovering``  parked in a fleet's retry queue after its replica
                   failed: reconstructed host-side, waiting out its
                   backoff before resubmission (these rows live on the
@@ -118,8 +127,11 @@ def _fleet_of(engine) -> Dict[str, Optional[str]]:
         for rep in getattr(fleet, "replicas", []):
             if rep.engine is engine:
                 return {"fleet": fleet.fleet_id, "replica": rep.name,
-                        "health": rep.state}
-    return {"fleet": None, "replica": None, "health": None}
+                        "health": rep.state,
+                        "replica_class": getattr(
+                            rep, "replica_class", None)}
+    return {"fleet": None, "replica": None, "health": None,
+            "replica_class": None}
 
 
 def engine_state(engine) -> Dict[str, Any]:
@@ -197,12 +209,16 @@ def engine_requests(engine) -> List[Dict[str, Any]]:
     now = engine._clock()
     rows: List[Dict[str, Any]] = []
     swapped_ids = set(engine._swapped) if engine.paged else set()
+    prefill_only = bool(getattr(engine, "prefill_only", False))
     for b, st in engine._row_prefill.items():
         rows.append(_req_row(engine, st.req, "prefilling", row=b,
                              prefill_pos=st.pos, now=now))
     for b, req in enumerate(engine.row_req):
         if req is not None and b not in engine._row_prefill:
-            rows.append(_req_row(engine, req, "decoding", row=b,
+            # A prefill-class engine never decodes: a bound row past
+            # its prefill frontier is PARKED for export, not emitting.
+            status = "handoff" if prefill_only else "decoding"
+            rows.append(_req_row(engine, req, status, row=b,
                                  now=now))
     for entry in engine.scheduler.queued_state():
         req = entry.get("request")
@@ -214,9 +230,17 @@ def engine_requests(engine) -> List[Dict[str, Any]]:
                          "age_s": None,
                          "engine_draining": bool(engine.draining)})
             continue
-        status = ("swapped" if req.req_id in swapped_ids else "queued")
+        # An imported handoff waiting for decode admission also sits
+        # in the swap ledger (its KV pre-seed) — "handoff" wins so the
+        # request is counted exactly once, in its true phase.
+        if getattr(req, "handoff", False):
+            status = "handoff"
+        elif req.req_id in swapped_ids:
+            status = "swapped"
+        else:
+            status = "queued"
         row = _req_row(engine, req, status, now=now)
-        if status == "swapped":
+        if req.req_id in swapped_ids:
             swap = engine._swapped[req.req_id]
             row["swap_blocks"] = swap.n_blocks
             row["swap_resident"] = swap.k is not None
@@ -229,7 +253,7 @@ def engine_requests(engine) -> List[Dict[str, Any]]:
 # ---------------------------------------------------------------------------
 
 REQUEST_STATUSES = ("queued", "prefilling", "decoding", "swapped",
-                    "recovering", "draining")
+                    "handoff", "recovering", "draining")
 
 
 def list_engines(limit: int = 1000) -> List[Dict[str, Any]]:
@@ -243,10 +267,11 @@ def list_requests(status: Optional[str] = None,
     """Every in-flight request across registered engines.
 
     ``status`` filters to one phase (queued / prefilling / decoding /
-    swapped / recovering) or to ``draining`` — all requests, any
-    phase, on engines that have begun draining. ``engine_id``
-    restricts to one engine (``recovering`` rows belong to a FLEET,
-    not an engine, so an engine_id filter excludes them)."""
+    swapped / handoff / recovering) or to ``draining`` — all requests,
+    any phase, on engines that have begun draining. ``engine_id``
+    restricts to one engine (``recovering`` rows and host-parked
+    ``handoff`` rows belong to a FLEET, not an engine, so an engine_id
+    filter excludes them)."""
     if status is not None and status not in REQUEST_STATUSES:
         raise ValueError(
             f"unknown status {status!r} "
@@ -263,6 +288,14 @@ def list_requests(status: Optional[str] = None,
             for r in fleet.recovering_requests():
                 rows.append({**r, "engine_id": None,
                              "status": "recovering", "row": None,
+                             "fleet": fleet.fleet_id,
+                             "age_s": None,
+                             "engine_draining": False})
+            # Exports parked between replica classes (disaggregated
+            # fleets only): host-side payloads no engine holds yet.
+            for r in getattr(fleet, "handoff_requests", list)():
+                rows.append({**r, "engine_id": None,
+                             "status": "handoff", "row": None,
                              "fleet": fleet.fleet_id,
                              "age_s": None,
                              "engine_draining": False})
@@ -376,6 +409,16 @@ def summarize_fleet() -> Dict[str, Any]:
             "requests_failed": fleet.requests_failed,
             "retries": fleet.retries,
             "tokens_lost_to_failure": fleet.tokens_lost_to_failure,
+            # Disaggregated plane (zeros for colocated fleets).
+            "disaggregated": bool(
+                getattr(fleet, "disaggregated", False)),
+            "replicas_prefill": sum(
+                1 for r in members
+                if r.get("replica_class") == "prefill"),
+            "replicas_decode": sum(
+                1 for r in members
+                if r.get("replica_class") == "decode"),
+            "handoffs": int(getattr(fleet, "handoffs", 0)),
         })
 
     attached = {r["engine_id"] for r in engine_rows

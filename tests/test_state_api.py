@@ -171,7 +171,8 @@ def test_list_requests_filters_and_errors(nano_model):
                 for r in rows]
 
     everything = serving.list_requests(engine_id="filt")
-    for status in ("queued", "prefilling", "decoding", "swapped"):
+    for status in ("queued", "prefilling", "decoding", "swapped",
+                   "handoff"):
         got = serving.list_requests(status=status, engine_id="filt")
         want = [r for r in everything if r["status"] == status]
         assert _stable(got) == _stable(want)
@@ -283,7 +284,7 @@ def test_summarize_fleet_attribution_and_counts(nano_model):
     assert summary["requests"] == {
         s: len(serving.list_requests(status=s))
         for s in ("queued", "prefilling", "decoding", "swapped",
-                  "recovering")}
+                  "handoff", "recovering")}
     assert summary["requests_inflight"] == \
         len(serving.list_requests())
     fleet.run(), loose.run()

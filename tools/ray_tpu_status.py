@@ -70,10 +70,12 @@ def _bar(frac: float, width: int = 20) -> str:
 
 
 def _phases_line(counts: Dict[str, int]) -> str:
+    # handoff/recovering are disagg/fault-plane phases: shown only
+    # when non-zero so the common colocated report stays four terms.
     order = ("queued", "prefilling", "decoding", "swapped",
-             "recovering")
+             "handoff", "recovering")
     parts = [f"{counts.get(p, 0)} {p}" for p in order
-             if p != "recovering" or counts.get(p, 0)]
+             if p not in ("handoff", "recovering") or counts.get(p, 0)]
     return " / ".join(parts)
 
 
@@ -102,6 +104,12 @@ def format_status(data: Dict[str, Any], top: int = 5) -> str:
         drain = (f", {fb['replicas_draining']} draining"
                  if fb["replicas_draining"] else "")
         auto = " autoscaling" if fb.get("autoscaling") else ""
+        if fb.get("disaggregated"):
+            # Class census + handoff counter: the disagg fleet's
+            # topology at a glance (prefill/decode split).
+            auto += (f" disagg[{fb.get('replicas_prefill', 0)}P/"
+                     f"{fb.get('replicas_decode', 0)}D "
+                     f"{fb.get('handoffs', 0)} handoffs]")
         health = fb.get("health", {})
         suspect = (f", {health['SUSPECT']} suspect"
                    if health.get("SUSPECT") else "")
@@ -156,12 +164,16 @@ def format_status(data: Dict[str, Any], top: int = 5) -> str:
                     f"acc {e.get('spec_acceptance_rate', 0.0) * 100:.0f}%"
                     f" {spec_arrow}")
         health = e.get("health")
+        klass = e.get("replica_class")
         flags = "".join(
             [" DRAINING" if e["draining"] else "",
              # RUNNING is the quiet default; anything else (SUSPECT,
              # UNHEALTHY) is worth a loud flag on the replica line.
              f" {health}" if health not in (None, "RUNNING",
                                             "DRAINING") else "",
+             # Replica class column (disaggregated fleets): colocated
+             # replicas stay untagged so mixed pools read cleanly.
+             f" class={klass}" if klass else "",
              f" tp={e['tp_degree']}" if e["tp_degree"] > 1 else "",
              " paged" if e["paged"] else ""])
         lines.append(
